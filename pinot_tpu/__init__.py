@@ -1,0 +1,10 @@
+"""pinot_tpu — a TPU-native distributed OLAP engine.
+
+A from-scratch rebuild of Apache Pinot's capabilities (columnar immutable
+segments, scatter/gather SQL, streaming + batch ingestion) where the
+per-segment filter → project → group-by → aggregate engine is a compiled
+JAX/XLA program over dictionary-encoded dense column planes resident in HBM.
+See SURVEY.md for the reference structural map this is built against.
+"""
+
+__version__ = "0.1.0"

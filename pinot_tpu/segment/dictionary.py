@@ -1,0 +1,117 @@
+"""Per-column sorted immutable dictionaries.
+
+Reference: pinot-segment-local/.../segment/index/readers/BaseImmutableDictionary
+and SegmentDictionaryCreator. As in the reference, dictionaries are SORTED, so
+dict ids preserve value order — the property the TPU filter path exploits:
+a range predicate on values becomes an integer interval test on dict ids, and
+EQ/IN become integer compares, all evaluated on-device against the int32
+forward plane with zero string handling on the TPU.
+
+Numeric dictionaries can additionally be shipped to HBM for on-device
+dict-decode (e.g. SUM over a dict-encoded metric = gather + sum).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..spi.data_types import DataType
+
+
+@dataclass
+class Dictionary:
+    """Sorted value dictionary: dict id == rank of value."""
+
+    data_type: DataType
+    values: np.ndarray  # sorted; dtype per type (object for STRING/BYTES)
+
+    @property
+    def cardinality(self) -> int:
+        return int(self.values.shape[0])
+
+    def get(self, dict_id: int):
+        return self.values[dict_id]
+
+    def take(self, dict_ids: np.ndarray) -> np.ndarray:
+        return self.values[dict_ids]
+
+    def index_of(self, value) -> int:
+        """Exact lookup; -1 if absent (reference Dictionary.indexOf)."""
+        v = self._coerce(value)
+        i = int(np.searchsorted(self.values, v))
+        if i < self.cardinality and self.values[i] == v:
+            return i
+        return -1
+
+    def insertion_index(self, value, side: str = "left") -> int:
+        """searchsorted position — used to turn value ranges into dict-id ranges."""
+        return int(np.searchsorted(self.values, self._coerce(value), side=side))
+
+    def _coerce(self, value):
+        if self.data_type in (DataType.STRING, DataType.JSON, DataType.BIG_DECIMAL):
+            return str(value)
+        if self.data_type == DataType.BYTES:
+            return bytes(value)
+        # Numerics stay uncoerced: np.searchsorted compares int columns against
+        # float probe values exactly, whereas casting 3.5 -> int32(3) would
+        # produce false EQ matches and off-by-one range bounds.
+        return value
+
+    @property
+    def min_value(self):
+        return self.values[0] if self.cardinality else None
+
+    @property
+    def max_value(self):
+        return self.values[-1] if self.cardinality else None
+
+
+def build_dictionary(raw_values: np.ndarray, data_type: DataType) -> tuple[Dictionary, np.ndarray]:
+    """Build sorted dictionary + dict-id plane from raw values.
+
+    Returns (dictionary, dict_ids[int32]). np.unique gives sorted uniques and
+    inverse indices in one pass — this IS the dictionary encode.
+    """
+    if data_type in (DataType.STRING, DataType.JSON, DataType.BIG_DECIMAL):
+        arr = np.asarray([str(v) for v in raw_values], dtype=object)
+        uniques, inverse = _unique_object(arr)
+    elif data_type == DataType.BYTES:
+        arr = np.asarray([bytes(v) for v in raw_values], dtype=object)
+        uniques, inverse = _unique_object(arr)
+    else:
+        arr = np.ascontiguousarray(raw_values, dtype=data_type.numpy_dtype)
+        uniques, inverse = np.unique(arr, return_inverse=True)
+    return Dictionary(data_type, uniques), inverse.astype(np.int32)
+
+
+def _unique_object(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    uniques, inverse = np.unique(arr, return_inverse=True)
+    return uniques.astype(object), inverse
+
+
+def serialize_dictionary(d: Dictionary) -> bytes:
+    """Flat bytes form: numeric = raw array; var-width = u32 offsets + blob."""
+    if d.data_type.is_fixed_width:
+        return d.values.tobytes()
+    blobs = [v.encode("utf-8") if isinstance(v, str) else bytes(v) for v in d.values]
+    offsets = np.zeros(len(blobs) + 1, dtype=np.uint32)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    return offsets.tobytes() + b"".join(blobs)
+
+
+def deserialize_dictionary(data: bytes, data_type: DataType, cardinality: int) -> Dictionary:
+    if data_type.is_fixed_width:
+        values = np.frombuffer(data, dtype=data_type.numpy_dtype, count=cardinality).copy()
+        return Dictionary(data_type, values)
+    offsets = np.frombuffer(data, dtype=np.uint32, count=cardinality + 1)
+    blob = data[(cardinality + 1) * 4 :]
+    if data_type == DataType.BYTES:
+        values = np.asarray([blob[offsets[i] : offsets[i + 1]] for i in range(cardinality)], dtype=object)
+    else:
+        values = np.asarray(
+            [blob[offsets[i] : offsets[i + 1]].decode("utf-8") for i in range(cardinality)], dtype=object
+        )
+    return Dictionary(data_type, values)

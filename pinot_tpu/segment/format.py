@@ -1,0 +1,151 @@
+"""On-disk segment format.
+
+Layout mirrors the reference's v3 single-file segment directory
+(pinot-segment-local/.../segment/store/SingleFileIndexDirectory.java,
+SegmentVersion.java:21-24): one `data.bin` holding every column buffer
+back-to-back plus a `metadata.json` carrying the buffer index map and
+column metadata. Unlike the reference's row-group-free but chunked layout,
+buffers here are whole-column (the unit of TPU transfer is the column plane,
+not a 10K-doc block — see SURVEY.md §7 design stance).
+
+Buffer kinds per column:
+  fwd    packed dict ids (fixed-bit LSB-first) for DICT encoding, or raw
+         fixed-width values for RAW encoding
+  dict   serialized sorted dictionary (DICT only)
+  nulls  packed null bitmap (present iff column had nulls)
+  mvoff  u32 row-offsets into the MV value stream (MV columns only)
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+DATA_FILE = "data.bin"
+METADATA_FILE = "metadata.json"
+
+
+@dataclass
+class ColumnMetadata:
+    name: str
+    data_type: str              # DataType.value
+    field_type: str             # FieldType.value
+    encoding: str               # "DICT" | "RAW"
+    single_value: bool = True
+    cardinality: int = 0
+    bits_per_value: int = 0
+    min_value: Optional[object] = None
+    max_value: Optional[object] = None
+    is_sorted: bool = False
+    has_nulls: bool = False
+    total_number_of_entries: int = 0   # == num_docs for SV; total MV values for MV
+    max_number_of_multi_values: int = 0
+    partition_function: Optional[str] = None
+    partition_id: Optional[int] = None
+
+    def to_json(self) -> dict:
+        d = dict(self.__dict__)
+        for k in ("min_value", "max_value"):
+            v = d[k]
+            if isinstance(v, (np.integer,)):
+                d[k] = int(v)
+            elif isinstance(v, (np.floating,)):
+                d[k] = float(v)
+            elif isinstance(v, bytes):
+                d[k] = v.hex()
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ColumnMetadata":
+        m = cls(**d)
+        if m.data_type == "BYTES":
+            for k in ("min_value", "max_value"):
+                v = getattr(m, k)
+                if isinstance(v, str):
+                    setattr(m, k, bytes.fromhex(v))
+        return m
+
+
+@dataclass
+class SegmentMetadata:
+    segment_name: str
+    table_name: str
+    num_docs: int
+    columns: dict[str, ColumnMetadata] = field(default_factory=dict)
+    buffers: dict[str, list[int]] = field(default_factory=dict)  # name -> [offset, size]
+    time_column: Optional[str] = None
+    start_time: Optional[int] = None
+    end_time: Optional[int] = None
+    format_version: int = FORMAT_VERSION
+    crc: Optional[str] = None
+    creation_time_ms: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "segmentName": self.segment_name,
+            "tableName": self.table_name,
+            "numDocs": self.num_docs,
+            "formatVersion": self.format_version,
+            "timeColumn": self.time_column,
+            "startTime": self.start_time,
+            "endTime": self.end_time,
+            "crc": self.crc,
+            "creationTimeMs": self.creation_time_ms,
+            "columns": {k: v.to_json() for k, v in self.columns.items()},
+            "buffers": self.buffers,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SegmentMetadata":
+        return cls(
+            segment_name=d["segmentName"],
+            table_name=d["tableName"],
+            num_docs=d["numDocs"],
+            format_version=d.get("formatVersion", FORMAT_VERSION),
+            time_column=d.get("timeColumn"),
+            start_time=d.get("startTime"),
+            end_time=d.get("endTime"),
+            crc=d.get("crc"),
+            creation_time_ms=d.get("creationTimeMs", 0),
+            columns={k: ColumnMetadata.from_json(v) for k, v in d.get("columns", {}).items()},
+            buffers=d.get("buffers", {}),
+        )
+
+
+class SegmentWriter:
+    """Accumulates named buffers and writes data.bin + metadata.json."""
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        self._buffers: list[tuple[str, bytes]] = []
+
+    def add_buffer(self, name: str, data: bytes | np.ndarray) -> None:
+        if isinstance(data, np.ndarray):
+            data = data.tobytes()
+        self._buffers.append((name, data))
+
+    def write(self, metadata: SegmentMetadata) -> None:
+        import zlib
+
+        self.directory.mkdir(parents=True, exist_ok=True)
+        offset = 0
+        crc = 0
+        with open(self.directory / DATA_FILE, "wb") as f:
+            for name, data in self._buffers:
+                metadata.buffers[name] = [offset, len(data)]
+                f.write(data)
+                crc = zlib.crc32(data, crc)
+                offset += len(data)
+        metadata.crc = format(crc, "08x")
+        with open(self.directory / METADATA_FILE, "w") as f:
+            json.dump(metadata.to_json(), f, indent=1, default=str)
+
+
+def read_metadata(directory: str | Path) -> SegmentMetadata:
+    with open(Path(directory) / METADATA_FILE) as f:
+        return SegmentMetadata.from_json(json.load(f))

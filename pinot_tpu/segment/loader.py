@@ -1,0 +1,151 @@
+"""Immutable segment loader.
+
+Reference: pinot-segment-local/.../indexsegment/immutable/
+ImmutableSegmentLoader.java:67 — loads a segment directory, mmaps buffers, and
+exposes per-column data sources. Here `data.bin` is np.memmap'd (the analogue
+of PinotDataBuffer.mapFile, pinot-segment-spi/.../memory/PinotDataBuffer.java:272)
+and columns decode lazily into host int32/float planes, cached, ready for a
+single DMA to HBM via device_cache.SegmentDeviceCache.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from ..spi.data_types import DataType
+from . import bitpack
+from .dictionary import Dictionary, deserialize_dictionary
+from .format import DATA_FILE, ColumnMetadata, SegmentMetadata, read_metadata
+
+
+class ImmutableSegment:
+    """A loaded immutable segment: metadata + lazily decoded column planes."""
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        self.metadata: SegmentMetadata = read_metadata(self.directory)
+        self._data = np.memmap(self.directory / DATA_FILE, dtype=np.uint8, mode="r")
+        self._dictionaries: dict[str, Dictionary] = {}
+        self._dict_ids: dict[str, np.ndarray] = {}
+        self._raw: dict[str, np.ndarray] = {}
+        self._nulls: dict[str, Optional[np.ndarray]] = {}
+        self._mv_offsets: dict[str, np.ndarray] = {}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.metadata.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self.metadata.num_docs
+
+    def column_metadata(self, column: str) -> ColumnMetadata:
+        return self.metadata.columns[column]
+
+    def has_column(self, column: str) -> bool:
+        return column in self.metadata.columns
+
+    def columns(self) -> list[str]:
+        return list(self.metadata.columns)
+
+    # -- buffers -----------------------------------------------------------
+    def _buffer(self, name: str) -> np.ndarray:
+        off, size = self.metadata.buffers[name]
+        return self._data[off : off + size]
+
+    def get_dictionary(self, column: str) -> Dictionary:
+        if column not in self._dictionaries:
+            m = self.column_metadata(column)
+            assert m.encoding == "DICT", f"{column} has no dictionary"
+            raw = bytes(self._buffer(f"{column}.dict"))
+            self._dictionaries[column] = deserialize_dictionary(raw, DataType(m.data_type), m.cardinality)
+        return self._dictionaries[column]
+
+    def get_dict_ids(self, column: str) -> np.ndarray:
+        """Decoded int32 dict-id plane (SV) or flat MV dict-id stream."""
+        if column not in self._dict_ids:
+            m = self.column_metadata(column)
+            assert m.encoding == "DICT"
+            count = m.total_number_of_entries
+            self._dict_ids[column] = bitpack.unpack(self._buffer(f"{column}.fwd"), m.bits_per_value, count)
+        return self._dict_ids[column]
+
+    def get_mv_offsets(self, column: str) -> np.ndarray:
+        if column not in self._mv_offsets:
+            self._mv_offsets[column] = np.frombuffer(
+                self._buffer(f"{column}.mvoff"), dtype=np.uint32, count=self.num_docs + 1
+            ).astype(np.int64)
+        return self._mv_offsets[column]
+
+    def get_mv_dict_id_matrix(self, column: str) -> np.ndarray:
+        """(num_docs, max_mv) int32 matrix padded with `cardinality` sentinel.
+
+        The pad id is out of dictionary range so every predicate evaluates
+        false on pad slots; device MV predicates reduce with any() across the
+        MV axis.
+        """
+        m = self.column_metadata(column)
+        ids = self.get_dict_ids(column)
+        offsets = self.get_mv_offsets(column)
+        max_mv = max(1, m.max_number_of_multi_values)
+        out = np.full((self.num_docs, max_mv), m.cardinality, dtype=np.int32)
+        lens = np.diff(offsets)
+        col_idx = np.arange(max_mv)[None, :]
+        mask = col_idx < lens[:, None]
+        out[mask] = ids
+        return out
+
+    def get_raw(self, column: str) -> np.ndarray:
+        if column not in self._raw:
+            m = self.column_metadata(column)
+            assert m.encoding == "RAW"
+            dt = DataType(m.data_type).numpy_dtype
+            self._raw[column] = np.frombuffer(self._buffer(f"{column}.fwd"), dtype=dt, count=self.num_docs)
+        return self._raw[column]
+
+    def get_null_bitmap(self, column: str) -> Optional[np.ndarray]:
+        """Boolean null vector, or None when the column has no nulls
+        (reference NullValueVectorReaderImpl)."""
+        if column not in self._nulls:
+            m = self.column_metadata(column)
+            if not m.has_nulls:
+                self._nulls[column] = None
+            else:
+                self._nulls[column] = bitpack.unpack_bitmap(self._buffer(f"{column}.nulls"), self.num_docs)
+        return self._nulls[column]
+
+    # -- materialized values (host path / test oracle) ---------------------
+    def get_values(self, column: str) -> np.ndarray:
+        """Fully materialized value array (SV) — used by the CPU oracle path."""
+        m = self.column_metadata(column)
+        if m.encoding == "RAW":
+            return self.get_raw(column)
+        if not m.single_value:
+            raise ValueError(f"{column} is MV; use get_mv_values")
+        return self.get_dictionary(column).take(self.get_dict_ids(column))
+
+    def get_mv_values(self, column: str) -> list[np.ndarray]:
+        d = self.get_dictionary(column)
+        ids = self.get_dict_ids(column)
+        offsets = self.get_mv_offsets(column)
+        return [d.take(ids[offsets[i] : offsets[i + 1]]) for i in range(self.num_docs)]
+
+    def destroy(self) -> None:
+        """Release all decoded planes and the data.bin mapping.
+
+        The segment is unusable afterwards (reference
+        ImmutableSegmentImpl.destroy semantics — called on segment drop)."""
+        self._dict_ids.clear()
+        self._raw.clear()
+        self._dictionaries.clear()
+        self._nulls.clear()
+        self._mv_offsets.clear()
+        self._data = None
+
+
+def load_segment(directory: str | Path) -> ImmutableSegment:
+    return ImmutableSegment(directory)

@@ -1,0 +1,143 @@
+"""Table configuration model.
+
+Subset of the reference's TableConfig
+(pinot-spi/.../spi/config/table/TableConfig.java:38): table type, indexing
+hints, segment config, ingestion config. JSON-round-trippable so configs can
+live in the (future) cluster property store exactly like the reference keeps
+TableConfig JSON in ZooKeeper.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class TableType(enum.Enum):
+    OFFLINE = "OFFLINE"
+    REALTIME = "REALTIME"
+
+
+@dataclass
+class IndexingConfig:
+    """Per-table index declarations (reference IndexingConfig.java).
+
+    In the TPU build most of these change meaning: 'invertedIndexColumns'
+    requests host-side posting lists used for segment pruning + device mask
+    precomputation; 'sortedColumn' enables range-slice filtering; star-tree
+    configs request pre-aggregated device arrays.
+    """
+
+    inverted_index_columns: list[str] = field(default_factory=list)
+    range_index_columns: list[str] = field(default_factory=list)
+    bloom_filter_columns: list[str] = field(default_factory=list)
+    no_dictionary_columns: list[str] = field(default_factory=list)
+    sorted_column: Optional[str] = None
+    star_tree_index_configs: list[dict] = field(default_factory=list)
+    json_index_columns: list[str] = field(default_factory=list)
+    text_index_columns: list[str] = field(default_factory=list)
+    vector_index_columns: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SegmentsValidationConfig:
+    time_column_name: Optional[str] = None
+    time_type: str = "MILLISECONDS"
+    retention_time_unit: Optional[str] = None
+    retention_time_value: Optional[int] = None
+    replication: int = 1
+
+
+@dataclass
+class UpsertConfig:
+    mode: str = "NONE"  # NONE | FULL | PARTIAL
+    partial_upsert_strategies: dict[str, str] = field(default_factory=dict)
+    comparison_columns: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DedupConfig:
+    enabled: bool = False
+
+
+@dataclass
+class IngestionConfig:
+    """Stream + transform config (reference IngestionConfig.java)."""
+
+    stream_configs: dict[str, Any] = field(default_factory=dict)
+    transform_configs: list[dict] = field(default_factory=list)  # {columnName, transformFunction}
+    filter_function: Optional[str] = None
+
+
+@dataclass
+class TableConfig:
+    table_name: str
+    table_type: TableType = TableType.OFFLINE
+    indexing: IndexingConfig = field(default_factory=IndexingConfig)
+    validation: SegmentsValidationConfig = field(default_factory=SegmentsValidationConfig)
+    upsert: UpsertConfig = field(default_factory=UpsertConfig)
+    dedup: DedupConfig = field(default_factory=DedupConfig)
+    ingestion: IngestionConfig = field(default_factory=IngestionConfig)
+    tenants: dict[str, str] = field(default_factory=dict)
+    query_options: dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if isinstance(self.table_type, str):
+            self.table_type = TableType(self.table_type)
+
+    @property
+    def table_name_with_type(self) -> str:
+        return f"{self.table_name}_{self.table_type.value}"
+
+    def to_json(self) -> dict:
+        return {
+            "tableName": self.table_name,
+            "tableType": self.table_type.value,
+            "tableIndexConfig": {
+                "invertedIndexColumns": self.indexing.inverted_index_columns,
+                "rangeIndexColumns": self.indexing.range_index_columns,
+                "bloomFilterColumns": self.indexing.bloom_filter_columns,
+                "noDictionaryColumns": self.indexing.no_dictionary_columns,
+                "sortedColumn": self.indexing.sorted_column,
+                "starTreeIndexConfigs": self.indexing.star_tree_index_configs,
+            },
+            "segmentsConfig": {
+                "timeColumnName": self.validation.time_column_name,
+                "replication": self.validation.replication,
+            },
+            "upsertConfig": {"mode": self.upsert.mode},
+            "ingestionConfig": {
+                "streamConfigs": self.ingestion.stream_configs,
+                "transformConfigs": self.ingestion.transform_configs,
+                "filterFunction": self.ingestion.filter_function,
+            },
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TableConfig":
+        idx = d.get("tableIndexConfig", {})
+        seg = d.get("segmentsConfig", {})
+        ing = d.get("ingestionConfig", {})
+        return cls(
+            table_name=d["tableName"],
+            table_type=TableType(d.get("tableType", "OFFLINE")),
+            indexing=IndexingConfig(
+                inverted_index_columns=idx.get("invertedIndexColumns") or [],
+                range_index_columns=idx.get("rangeIndexColumns") or [],
+                bloom_filter_columns=idx.get("bloomFilterColumns") or [],
+                no_dictionary_columns=idx.get("noDictionaryColumns") or [],
+                sorted_column=idx.get("sortedColumn"),
+                star_tree_index_configs=idx.get("starTreeIndexConfigs") or [],
+            ),
+            validation=SegmentsValidationConfig(
+                time_column_name=seg.get("timeColumnName"),
+                replication=int(seg.get("replication", 1)),
+            ),
+            upsert=UpsertConfig(mode=(d.get("upsertConfig") or {}).get("mode", "NONE")),
+            ingestion=IngestionConfig(
+                stream_configs=ing.get("streamConfigs") or {},
+                transform_configs=ing.get("transformConfigs") or [],
+                filter_function=ing.get("filterFunction"),
+            ),
+        )

@@ -1,0 +1,48 @@
+"""Round-trip tests for fixed-bit packing (reference parity:
+pinot-segment-local FixedBitIntReaderTest / PinotDataBitSetTest)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.segment import bitpack
+
+
+@pytest.mark.parametrize("num_bits", [1, 2, 3, 5, 7, 8, 11, 13, 16, 17, 24, 31, 32])
+def test_pack_unpack_roundtrip(num_bits, rng):
+    n = 10_007  # deliberately not a multiple of 8
+    hi = 2**num_bits if num_bits < 32 else 2**32
+    values = rng.integers(0, hi, size=n, dtype=np.uint64).astype(np.uint32)
+    packed = bitpack.pack(values, num_bits)
+    assert packed.dtype == np.uint8
+    expected_bytes = (n * num_bits + 7) // 8
+    assert packed.shape[0] == expected_bytes
+    out = bitpack.unpack(packed, num_bits, n, dtype=np.int64)
+    np.testing.assert_array_equal(out, values.astype(np.int64))
+
+
+def test_num_bits_for_cardinality():
+    assert bitpack.num_bits_for_cardinality(1) == 1
+    assert bitpack.num_bits_for_cardinality(2) == 1
+    assert bitpack.num_bits_for_cardinality(3) == 2
+    assert bitpack.num_bits_for_cardinality(256) == 8
+    assert bitpack.num_bits_for_cardinality(257) == 9
+    assert bitpack.num_bits_for_cardinality(2**31) == 31
+
+
+def test_empty():
+    packed = bitpack.pack(np.array([], dtype=np.uint32), 7)
+    assert bitpack.unpack(packed, 7, 0).shape == (0,)
+
+
+def test_bitmap_roundtrip(rng):
+    bools = rng.random(1234) < 0.1
+    packed = bitpack.pack_bitmap(bools)
+    np.testing.assert_array_equal(bitpack.unpack_bitmap(packed, 1234), bools)
+
+
+def test_chunk_boundary(rng):
+    # Cross the 1M-row chunk boundary with an odd bit width.
+    n = (1 << 20) + 12345
+    values = rng.integers(0, 2**5, size=n).astype(np.uint32)
+    packed = bitpack.pack(values, 5)
+    np.testing.assert_array_equal(bitpack.unpack(packed, 5, n), values.astype(np.int32))

@@ -1,0 +1,132 @@
+"""Segment build → load round-trip tests.
+
+Mirrors the reference's writer→reader round-trip strategy per index type
+(pinot-segment-local/src/test — SURVEY.md §4.1).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+
+@pytest.fixture
+def schema():
+    return Schema.build(
+        "testTable",
+        dimensions=[("teamID", "STRING"), ("league", "STRING"), ("year", "INT")],
+        metrics=[("runs", "INT"), ("salary", "DOUBLE")],
+        date_times=[("ts", "TIMESTAMP")],
+    )
+
+
+def make_rows(n, rng):
+    teams = ["BOS", "NYA", "CHA", "SFN", "LAN", "ATL"]
+    leagues = ["AL", "NL"]
+    return [
+        {
+            "teamID": teams[int(rng.integers(len(teams)))],
+            "league": leagues[int(rng.integers(2))],
+            "year": int(rng.integers(1900, 2024)),
+            "runs": int(rng.integers(0, 150)),
+            "salary": float(rng.random() * 1e6),
+            "ts": int(rng.integers(1_500_000_000_000, 1_700_000_000_000)),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_build_load_roundtrip(tmp_path, schema, rng):
+    rows = make_rows(500, rng)
+    builder = SegmentBuilder(schema, segment_name="seg_0")
+    builder.build_from_rows(rows, tmp_path / "seg_0")
+
+    seg = load_segment(tmp_path / "seg_0")
+    assert seg.num_docs == 500
+    assert seg.name == "seg_0"
+    assert set(seg.columns()) == {"teamID", "league", "year", "runs", "salary", "ts"}
+
+    for col, key in [("teamID", "teamID"), ("year", "year"), ("runs", "runs"), ("salary", "salary")]:
+        got = seg.get_values(col)
+        want = np.asarray([r[key] for r in rows])
+        if got.dtype == object:
+            assert list(got) == list(want)
+        else:
+            np.testing.assert_allclose(got.astype(np.float64), want.astype(np.float64))
+
+
+def test_dictionary_sorted_and_metadata(tmp_path, schema, rng):
+    rows = make_rows(200, rng)
+    SegmentBuilder(schema, segment_name="s").build_from_rows(rows, tmp_path / "s")
+    seg = load_segment(tmp_path / "s")
+
+    d = seg.get_dictionary("teamID")
+    assert list(d.values) == sorted(d.values)
+    m = seg.column_metadata("year")
+    years = [r["year"] for r in rows]
+    assert int(m.min_value) == min(years)
+    assert int(m.max_value) == max(years)
+    assert m.cardinality == len(set(years))
+    assert m.bits_per_value >= 1
+    # dict ids decode to within cardinality
+    ids = seg.get_dict_ids("year")
+    assert ids.min() >= 0 and ids.max() < m.cardinality
+
+
+def test_raw_column(tmp_path, rng):
+    schema = Schema.build("t", dimensions=[("d", "INT")], metrics=[("m", "DOUBLE")])
+    cfg = TableConfig(table_name="t", indexing=IndexingConfig(no_dictionary_columns=["m"]))
+    vals = rng.random(100)
+    cols = {"d": list(range(100)), "m": list(vals)}
+    SegmentBuilder(schema, cfg, "s").build(cols, tmp_path / "s")
+    seg = load_segment(tmp_path / "s")
+    assert seg.column_metadata("m").encoding == "RAW"
+    np.testing.assert_allclose(seg.get_raw("m"), vals)
+    assert seg.column_metadata("d").is_sorted
+
+
+def test_nulls(tmp_path):
+    schema = Schema.build("t", dimensions=[("d", "STRING")], metrics=[("m", "INT")])
+    cols = {"d": ["a", None, "b", None], "m": [1, 2, None, 4]}
+    SegmentBuilder(schema, segment_name="s").build(cols, tmp_path / "s")
+    seg = load_segment(tmp_path / "s")
+    np.testing.assert_array_equal(seg.get_null_bitmap("d"), [False, True, False, True])
+    np.testing.assert_array_equal(seg.get_null_bitmap("m"), [False, False, True, False])
+    # defaults: dimension string -> "null", metric int -> 0
+    assert list(seg.get_values("d")) == ["a", "null", "b", "null"]
+    np.testing.assert_array_equal(seg.get_values("m"), [1, 2, 0, 4])
+
+
+def test_mv_column(tmp_path):
+    schema = Schema("t")
+    schema.add_field(FieldSpec("tags", DataType.STRING, FieldType.DIMENSION, single_value=False))
+    schema.add_field(FieldSpec("m", DataType.INT, FieldType.METRIC))
+    cols = {"tags": [["x", "y"], ["y"], [], ["z", "x", "y"]], "m": [1, 2, 3, 4]}
+    SegmentBuilder(schema, segment_name="s").build(cols, tmp_path / "s")
+    seg = load_segment(tmp_path / "s")
+    m = seg.column_metadata("tags")
+    assert not m.single_value
+    assert m.max_number_of_multi_values == 3
+    mv = seg.get_mv_values("tags")
+    assert list(mv[0]) == ["x", "y"]
+    assert list(mv[1]) == ["y"]
+    assert list(mv[2]) == []
+    assert list(mv[3]) == ["z", "x", "y"]
+    mat = seg.get_mv_dict_id_matrix("tags")
+    assert mat.shape == (4, 3)
+    # pad slots carry the sentinel id == cardinality
+    assert mat[1, 1] == m.cardinality and mat[1, 2] == m.cardinality
+
+
+def test_time_column_range(tmp_path, schema, rng):
+    rows = make_rows(50, rng)
+    cfg = TableConfig(table_name="t")
+    cfg.validation.time_column_name = "ts"
+    SegmentBuilder(schema, cfg, "s").build_from_rows(rows, tmp_path / "s")
+    seg = load_segment(tmp_path / "s")
+    ts = [r["ts"] for r in rows]
+    assert seg.metadata.start_time == min(ts)
+    assert seg.metadata.end_time == max(ts)
