@@ -1,0 +1,110 @@
+"""Compiled query representation.
+
+Reference: pinot-core/.../query/request/context/QueryContext.java — the single
+compiled form the whole V1 engine consumes: select expressions, filter tree,
+aggregations, group-by expressions, HAVING, ORDER BY, limit/offset, options.
+The TPU engine additionally derives a *kernel signature* from it (see
+engine/plan.py) so structurally identical queries share one compiled XLA
+program regardless of literal values.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .expressions import (
+    ExpressionContext,
+    contains_aggregation,
+    extract_aggregations,
+    is_aggregation,
+)
+from .filter import FilterContext
+
+
+@dataclass
+class OrderByExpressionContext:
+    expression: ExpressionContext
+    ascending: bool = True
+    nulls_last: Optional[bool] = None  # None = default per direction
+
+    def __str__(self) -> str:
+        return f"{self.expression} {'ASC' if self.ascending else 'DESC'}"
+
+
+@dataclass
+class QueryContext:
+    table_name: str
+    select_expressions: list[ExpressionContext] = field(default_factory=list)
+    aliases: list[Optional[str]] = field(default_factory=list)  # parallel to select
+    distinct: bool = False
+    filter: Optional[FilterContext] = None
+    group_by_expressions: list[ExpressionContext] = field(default_factory=list)
+    having_filter: Optional[FilterContext] = None
+    order_by_expressions: list[OrderByExpressionContext] = field(default_factory=list)
+    limit: int = 10  # reference default LIMIT 10 (CalciteSqlParser DEFAULT_LIMIT)
+    offset: int = 0
+    query_options: dict[str, Any] = field(default_factory=dict)
+    explain: bool = False
+
+    # Derived (filled by finish()):
+    aggregations: list[ExpressionContext] = field(default_factory=list)
+
+    def finish(self) -> "QueryContext":
+        """Derive aggregation list from select/having/order-by expressions
+        (reference QueryContext.Builder.build → generateAggregationFunctions)."""
+        aggs: list[ExpressionContext] = []
+        for e in self.select_expressions:
+            extract_aggregations(e, aggs)
+        if self.having_filter is not None:
+            _extract_from_filter(self.having_filter, aggs)
+        for o in self.order_by_expressions:
+            extract_aggregations(o.expression, aggs)
+        self.aggregations = aggs
+        return self
+
+    @property
+    def is_aggregation_query(self) -> bool:
+        return bool(self.aggregations)
+
+    @property
+    def is_group_by(self) -> bool:
+        return bool(self.group_by_expressions)
+
+    @property
+    def is_selection(self) -> bool:
+        return not self.aggregations and not self.distinct
+
+    def referenced_columns(self) -> set[str]:
+        cols: set[str] = set()
+        for e in self.select_expressions:
+            cols |= e.columns()
+        if self.filter is not None:
+            cols |= self.filter.columns()
+        for e in self.group_by_expressions:
+            cols |= e.columns()
+        if self.having_filter is not None:
+            cols |= self.having_filter.columns()
+        for o in self.order_by_expressions:
+            cols |= o.expression.columns()
+        return cols
+
+    def __str__(self) -> str:
+        parts = [f"SELECT {', '.join(map(str, self.select_expressions))}", f"FROM {self.table_name}"]
+        if self.filter:
+            parts.append(f"WHERE {self.filter}")
+        if self.group_by_expressions:
+            parts.append(f"GROUP BY {', '.join(map(str, self.group_by_expressions))}")
+        if self.having_filter:
+            parts.append(f"HAVING {self.having_filter}")
+        if self.order_by_expressions:
+            parts.append(f"ORDER BY {', '.join(map(str, self.order_by_expressions))}")
+        parts.append(f"LIMIT {self.limit}")
+        return " ".join(parts)
+
+
+def _extract_from_filter(f: FilterContext, out: list) -> None:
+    if f.predicate is not None:
+        extract_aggregations(f.predicate.lhs, out)
+    for c in f.children:
+        _extract_from_filter(c, out)

@@ -1,0 +1,117 @@
+"""Boolean expression tree → FilterContext conversion.
+
+Reference: pinot-common/.../request/context/RequestContextUtils.getFilter —
+the parser produces pure expression trees (and/or/not/equals/... as
+functions); WHERE and HAVING convert those into the typed predicate tree the
+filter planner consumes. Comparisons with the literal on the left are
+flipped; non-predicate boolean expressions become `expr = true`.
+"""
+
+from __future__ import annotations
+
+from .expressions import ExpressionContext
+from .filter import FilterContext, Predicate, PredicateType
+
+
+class FilterConversionError(Exception):
+    pass
+
+
+def filter_from_expression(expr: ExpressionContext) -> FilterContext:
+    if expr.is_literal:
+        if isinstance(expr.literal, bool):
+            return FilterContext.constant(expr.literal)
+        raise FilterConversionError(f"non-boolean literal in filter: {expr.literal!r}")
+    if expr.is_identifier:
+        # bare boolean column: `WHERE flag`
+        return FilterContext.pred(
+            Predicate(PredicateType.EQ, expr, values=(True,))
+        )
+    fn = expr.function
+    name = fn.name
+    args = fn.arguments
+    if name == "and":
+        return FilterContext.and_(*[filter_from_expression(a) for a in args])
+    if name == "or":
+        return FilterContext.or_(*[filter_from_expression(a) for a in args])
+    if name == "not":
+        return FilterContext.not_(filter_from_expression(args[0]))
+
+    if name in ("equals", "notequals"):
+        lhs, value = _split_comparison(args[0], args[1])
+        ptype = PredicateType.EQ if name == "equals" else PredicateType.NOT_EQ
+        return FilterContext.pred(Predicate(ptype, lhs, values=(value,)))
+
+    if name in ("lessthan", "lessthanorequal", "greaterthan", "greaterthanorequal"):
+        lhs, value, flipped = _split_comparison_flip(args[0], args[1])
+        if flipped:
+            name = {
+                "lessthan": "greaterthan",
+                "lessthanorequal": "greaterthanorequal",
+                "greaterthan": "lessthan",
+                "greaterthanorequal": "lessthanorequal",
+            }[name]
+        if name == "lessthan":
+            p = Predicate(PredicateType.RANGE, lhs, upper=value, upper_inclusive=False)
+        elif name == "lessthanorequal":
+            p = Predicate(PredicateType.RANGE, lhs, upper=value, upper_inclusive=True)
+        elif name == "greaterthan":
+            p = Predicate(PredicateType.RANGE, lhs, lower=value, lower_inclusive=False)
+        else:
+            p = Predicate(PredicateType.RANGE, lhs, lower=value, lower_inclusive=True)
+        return FilterContext.pred(p)
+
+    if name == "between":
+        lo = _require_literal(args[1])
+        hi = _require_literal(args[2])
+        return FilterContext.pred(
+            Predicate(PredicateType.RANGE, args[0], lower=lo, upper=hi,
+                      lower_inclusive=True, upper_inclusive=True))
+
+    if name in ("in", "notin"):
+        values = tuple(_require_literal(a) for a in args[1:])
+        ptype = PredicateType.IN if name == "in" else PredicateType.NOT_IN
+        return FilterContext.pred(Predicate(ptype, args[0], values=values))
+
+    if name == "like":
+        return FilterContext.pred(
+            Predicate(PredicateType.LIKE, args[0], values=(_require_literal(args[1]),)))
+    if name in ("regexplike", "regexp"):
+        return FilterContext.pred(
+            Predicate(PredicateType.REGEXP_LIKE, args[0], values=(_require_literal(args[1]),)))
+    if name == "textmatch":
+        return FilterContext.pred(
+            Predicate(PredicateType.TEXT_MATCH, args[0], values=(_require_literal(args[1]),)))
+    if name == "jsonmatch":
+        return FilterContext.pred(
+            Predicate(PredicateType.JSON_MATCH, args[0], values=(_require_literal(args[1]),)))
+    if name == "isnull":
+        return FilterContext.pred(Predicate(PredicateType.IS_NULL, args[0]))
+    if name == "isnotnull":
+        return FilterContext.pred(Predicate(PredicateType.IS_NOT_NULL, args[0]))
+
+    # fallback: arbitrary boolean-valued expression — evaluate `expr = true`
+    return FilterContext.pred(Predicate(PredicateType.EQ, expr, values=(True,)))
+
+
+def _split_comparison(a: ExpressionContext, b: ExpressionContext):
+    """Return (lhs_expr, literal_value); flips literal-on-left comparisons."""
+    if b.is_literal:
+        return a, b.literal
+    if a.is_literal:
+        return b, a.literal
+    raise FilterConversionError(f"comparison requires a literal side: {a} vs {b}")
+
+
+def _split_comparison_flip(a: ExpressionContext, b: ExpressionContext):
+    if b.is_literal:
+        return a, b.literal, False
+    if a.is_literal:
+        return b, a.literal, True
+    raise FilterConversionError(f"comparison requires a literal side: {a} vs {b}")
+
+
+def _require_literal(e: ExpressionContext):
+    if not e.is_literal:
+        raise FilterConversionError(f"expected literal, got {e}")
+    return e.literal

@@ -1,0 +1,132 @@
+"""Query expression tree.
+
+Reference: pinot-common/.../request/context/ExpressionContext.java — an
+expression is a LITERAL, an IDENTIFIER, or a FUNCTION over child expressions.
+This compiled form is shared by the whole engine: filters, projections,
+group-by keys, aggregation inputs, post-aggregation, HAVING and ORDER BY all
+hold ExpressionContext nodes.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+class ExpressionType(enum.Enum):
+    LITERAL = "LITERAL"
+    IDENTIFIER = "IDENTIFIER"
+    FUNCTION = "FUNCTION"
+
+
+@dataclass(frozen=True)
+class FunctionContext:
+    name: str  # canonical lower-case, e.g. "sum", "plus", "cast"
+    arguments: tuple["ExpressionContext", ...] = ()
+
+    def __str__(self) -> str:
+        return f"{self.name}({','.join(map(str, self.arguments))})"
+
+
+@dataclass(frozen=True)
+class ExpressionContext:
+    type: ExpressionType
+    identifier: Optional[str] = None
+    literal: Any = None
+    function: Optional[FunctionContext] = None
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def for_identifier(name: str) -> "ExpressionContext":
+        return ExpressionContext(ExpressionType.IDENTIFIER, identifier=name)
+
+    @staticmethod
+    def for_literal(value: Any) -> "ExpressionContext":
+        return ExpressionContext(ExpressionType.LITERAL, literal=value)
+
+    @staticmethod
+    def for_function(name: str, *args: "ExpressionContext") -> "ExpressionContext":
+        return ExpressionContext(
+            ExpressionType.FUNCTION, function=FunctionContext(name.lower(), tuple(args))
+        )
+
+    # -- predicates --------------------------------------------------------
+    @property
+    def is_identifier(self) -> bool:
+        return self.type == ExpressionType.IDENTIFIER
+
+    @property
+    def is_literal(self) -> bool:
+        return self.type == ExpressionType.LITERAL
+
+    @property
+    def is_function(self) -> bool:
+        return self.type == ExpressionType.FUNCTION
+
+    def columns(self) -> set[str]:
+        """All identifiers referenced under this expression."""
+        if self.is_identifier:
+            return {self.identifier}
+        if self.is_function:
+            out: set[str] = set()
+            for a in self.function.arguments:
+                out |= a.columns()
+            return out
+        return set()
+
+    def __str__(self) -> str:
+        if self.is_identifier:
+            return self.identifier
+        if self.is_literal:
+            if isinstance(self.literal, str):
+                return f"'{self.literal}'"
+            return str(self.literal)
+        return str(self.function)
+
+
+# Aggregation function names recognized by the engine. Mirrors the reference's
+# AggregationFunctionType enum (pinot-segment-spi/.../AggregationFunctionType.java);
+# grows as engine/aggregation.py implements more.
+AGGREGATION_FUNCTIONS = frozenset(
+    {
+        "count", "sum", "min", "max", "avg",
+        "minmaxrange", "sumprecision",
+        "distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
+        "distinctcounthll", "distinctcounthllplus", "distinctsum", "distinctavg",
+        "percentile", "percentileest", "percentiletdigest", "percentilekll",
+        "mode", "firstwithtime", "lastwithtime",
+        "arrayagg", "listagg",
+        "boolagg", "booland", "boolor",
+        "exprmin", "exprmax",
+        "stddevpop", "stddevsamp", "varpop", "varsamp", "skewness", "kurtosis",
+        "covarpop", "covarsamp", "corr",
+        "countmv", "summv", "minmv", "maxmv", "avgmv", "distinctcountmv",
+        "percentilemv", "percentileestmv", "percentiletdigestmv", "minmaxrangemv",
+        "histogram", "frequentstrings", "frequentlongs",
+        "funnelcount", "funnelmatchstep", "funnelcompletecount", "funnelmaxstep",
+    }
+)
+
+
+def is_aggregation(expr: ExpressionContext) -> bool:
+    return expr.is_function and expr.function.name in AGGREGATION_FUNCTIONS
+
+
+def contains_aggregation(expr: ExpressionContext) -> bool:
+    if is_aggregation(expr):
+        return True
+    if expr.is_function:
+        return any(contains_aggregation(a) for a in expr.function.arguments)
+    return False
+
+
+def extract_aggregations(expr: ExpressionContext, out: list) -> None:
+    """Collect aggregation sub-expressions in evaluation order (dedup by eq)."""
+    if is_aggregation(expr):
+        if expr not in out:
+            out.append(expr)
+        return
+    if expr.is_function:
+        for a in expr.function.arguments:
+            extract_aggregations(a, out)

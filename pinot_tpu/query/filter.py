@@ -1,0 +1,125 @@
+"""Filter tree + predicates.
+
+Reference: pinot-common/.../request/context/FilterContext.java and
+pinot-core/.../operator/filter/predicate/ predicate evaluators. The filter is
+an AND/OR/NOT tree with typed leaf predicates over one expression (usually an
+identifier). On TPU, every leaf lowers to a vectorized compare against the
+int32 dict-id plane (dictionary-encoded) or the raw value plane, and the tree
+lowers to boolean algebra on masks — there is no iterator/bitmap machinery
+because masks are free on the MXU-adjacent VPU.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .expressions import ExpressionContext
+
+
+class FilterNodeType(enum.Enum):
+    AND = "AND"
+    OR = "OR"
+    NOT = "NOT"
+    PREDICATE = "PREDICATE"
+    CONSTANT = "CONSTANT"  # TRUE / FALSE
+
+
+class PredicateType(enum.Enum):
+    EQ = "EQ"
+    NOT_EQ = "NOT_EQ"
+    IN = "IN"
+    NOT_IN = "NOT_IN"
+    RANGE = "RANGE"
+    LIKE = "LIKE"
+    REGEXP_LIKE = "REGEXP_LIKE"
+    TEXT_MATCH = "TEXT_MATCH"
+    JSON_MATCH = "JSON_MATCH"
+    IS_NULL = "IS_NULL"
+    IS_NOT_NULL = "IS_NOT_NULL"
+    VECTOR_SIMILARITY = "VECTOR_SIMILARITY"
+
+
+@dataclass(frozen=True)
+class Predicate:
+    """Leaf predicate over `lhs` (reference Predicate.java subclasses).
+
+    RANGE carries [lower, upper] with inclusivity flags; None bound = open
+    (reference RangePredicate uses "(*" / "*)" sentinels).
+    """
+
+    type: PredicateType
+    lhs: ExpressionContext
+    values: tuple = ()  # EQ/NOT_EQ: 1 value; IN/NOT_IN: n values; LIKE/REGEXP: pattern
+    lower: Any = None
+    upper: Any = None
+    lower_inclusive: bool = True
+    upper_inclusive: bool = True
+
+    def __str__(self) -> str:
+        if self.type == PredicateType.RANGE:
+            lb = "[" if self.lower_inclusive else "("
+            ub = "]" if self.upper_inclusive else ")"
+            lo = "*" if self.lower is None else self.lower
+            hi = "*" if self.upper is None else self.upper
+            return f"{self.lhs} {lb}{lo},{hi}{ub}"
+        return f"{self.lhs} {self.type.value} {list(self.values)}"
+
+
+@dataclass(frozen=True)
+class FilterContext:
+    type: FilterNodeType
+    children: tuple["FilterContext", ...] = ()
+    predicate: Optional[Predicate] = None
+    constant_value: bool = True  # for CONSTANT nodes
+
+    @staticmethod
+    def and_(*children: "FilterContext") -> "FilterContext":
+        flat = []
+        for c in children:
+            if c.type == FilterNodeType.AND:
+                flat.extend(c.children)
+            else:
+                flat.append(c)
+        return FilterContext(FilterNodeType.AND, tuple(flat))
+
+    @staticmethod
+    def or_(*children: "FilterContext") -> "FilterContext":
+        flat = []
+        for c in children:
+            if c.type == FilterNodeType.OR:
+                flat.extend(c.children)
+            else:
+                flat.append(c)
+        return FilterContext(FilterNodeType.OR, tuple(flat))
+
+    @staticmethod
+    def not_(child: "FilterContext") -> "FilterContext":
+        return FilterContext(FilterNodeType.NOT, (child,))
+
+    @staticmethod
+    def pred(p: Predicate) -> "FilterContext":
+        return FilterContext(FilterNodeType.PREDICATE, predicate=p)
+
+    @staticmethod
+    def constant(value: bool) -> "FilterContext":
+        return FilterContext(FilterNodeType.CONSTANT, constant_value=value)
+
+    def columns(self) -> set[str]:
+        if self.type == FilterNodeType.PREDICATE:
+            return self.predicate.lhs.columns()
+        out: set[str] = set()
+        for c in self.children:
+            out |= c.columns()
+        return out
+
+    def __str__(self) -> str:
+        if self.type == FilterNodeType.PREDICATE:
+            return str(self.predicate)
+        if self.type == FilterNodeType.CONSTANT:
+            return str(self.constant_value).upper()
+        if self.type == FilterNodeType.NOT:
+            return f"NOT({self.children[0]})"
+        sep = f" {self.type.value} "
+        return "(" + sep.join(map(str, self.children)) + ")"

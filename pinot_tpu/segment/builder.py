@@ -112,8 +112,7 @@ class SegmentBuilder:
                 total_number_of_entries=num_docs,
             )
         else:
-            dictionary, dict_ids = build_dictionary(np.asarray(values, dtype=object) if not dt.is_fixed_width
-                                                    else np.asarray(values, dtype=dt.numpy_dtype), dt)
+            dictionary, dict_ids = build_dictionary(values, dt)
             bits = bitpack.num_bits_for_cardinality(dictionary.cardinality)
             writer.add_buffer(f"{name}.fwd", bitpack.pack(dict_ids, bits))
             writer.add_buffer(f"{name}.dict", serialize_dictionary(dictionary))
@@ -149,8 +148,7 @@ class SegmentBuilder:
                 v = [v]
             flat.extend(v)
             offsets[i + 1] = len(flat)
-        dictionary, dict_ids = build_dictionary(
-            np.asarray(flat, dtype=object) if not dt.is_fixed_width else np.asarray(flat, dtype=dt.numpy_dtype), dt)
+        dictionary, dict_ids = build_dictionary(flat, dt)
         bits = bitpack.num_bits_for_cardinality(dictionary.cardinality)
         writer.add_buffer(f"{name}.fwd", bitpack.pack(dict_ids, bits))
         writer.add_buffer(f"{name}.dict", serialize_dictionary(dictionary))

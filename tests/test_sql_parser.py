@@ -1,0 +1,141 @@
+"""SQL parser tests (reference parity: pinot-common CalciteSqlCompilerTest)."""
+
+import pytest
+
+from pinot_tpu.query.expressions import ExpressionContext, ExpressionType
+from pinot_tpu.query.filter import FilterNodeType, PredicateType
+from pinot_tpu.query.parser.sql import SqlParseError, parse_sql
+
+
+def test_basic_group_by():
+    qc = parse_sql("SELECT teamID, SUM(runs) FROM baseballStats GROUP BY teamID")
+    assert qc.table_name == "baseballStats"
+    assert len(qc.select_expressions) == 2
+    assert qc.select_expressions[0].identifier == "teamID"
+    agg = qc.select_expressions[1]
+    assert agg.function.name == "sum"
+    assert agg.function.arguments[0].identifier == "runs"
+    assert qc.group_by_expressions[0].identifier == "teamID"
+    assert qc.aggregations == [agg]
+    assert qc.limit == 10  # default
+    assert qc.is_aggregation_query and qc.is_group_by
+
+
+def test_where_tree():
+    qc = parse_sql(
+        "SELECT COUNT(*) FROM t WHERE a = 5 AND (b > 2.5 OR c IN ('x','y')) AND d BETWEEN 1 AND 10"
+    )
+    f = qc.filter
+    assert f.type == FilterNodeType.AND
+    assert len(f.children) == 3
+    p0 = f.children[0].predicate
+    assert p0.type == PredicateType.EQ and p0.values == (5,)
+    or_node = f.children[1]
+    assert or_node.type == FilterNodeType.OR
+    assert or_node.children[0].predicate.type == PredicateType.RANGE
+    assert or_node.children[0].predicate.lower == 2.5
+    assert not or_node.children[0].predicate.lower_inclusive
+    assert or_node.children[1].predicate.type == PredicateType.IN
+    assert or_node.children[1].predicate.values == ("x", "y")
+    p2 = f.children[2].predicate
+    assert p2.type == PredicateType.RANGE and p2.lower == 1 and p2.upper == 10
+
+
+def test_count_star_and_distinct():
+    qc = parse_sql("SELECT COUNT(*), COUNT(DISTINCT x) FROM t")
+    assert qc.aggregations[0].function.name == "count"
+    assert qc.aggregations[0].function.arguments[0].identifier == "*"
+    assert qc.aggregations[1].function.name == "distinctcount"
+
+
+def test_order_limit_offset():
+    qc = parse_sql("SELECT a FROM t ORDER BY a DESC, b LIMIT 25 OFFSET 5")
+    assert not qc.order_by_expressions[0].ascending
+    assert qc.order_by_expressions[1].ascending
+    assert qc.limit == 25 and qc.offset == 5
+    qc2 = parse_sql("SELECT a FROM t LIMIT 5, 20")
+    assert qc2.offset == 5 and qc2.limit == 20
+
+
+def test_aliases():
+    qc = parse_sql("SELECT a AS x, SUM(b) total FROM t GROUP BY a")
+    assert qc.aliases == ["x", "total"]
+
+
+def test_arithmetic_precedence():
+    qc = parse_sql("SELECT a + b * 2 FROM t")
+    e = qc.select_expressions[0]
+    assert e.function.name == "plus"
+    assert e.function.arguments[1].function.name == "times"
+
+
+def test_flipped_comparison():
+    qc = parse_sql("SELECT * FROM t WHERE 5 < x")
+    p = qc.filter.predicate
+    assert p.type == PredicateType.RANGE
+    assert p.lower == 5 and not p.lower_inclusive
+
+
+def test_not_in_like_null():
+    qc = parse_sql(
+        "SELECT * FROM t WHERE a NOT IN (1,2) AND b LIKE 'foo%' AND c IS NOT NULL AND NOT d = 3"
+    )
+    kids = qc.filter.children
+    assert kids[0].predicate.type == PredicateType.NOT_IN
+    assert kids[1].predicate.type == PredicateType.LIKE
+    assert kids[2].predicate.type == PredicateType.IS_NOT_NULL
+    assert kids[3].type == FilterNodeType.NOT
+
+
+def test_having_and_options():
+    qc = parse_sql(
+        "SET useMultistageEngine=true; SELECT a, SUM(b) FROM t GROUP BY a HAVING SUM(b) > 100"
+    )
+    assert qc.query_options["useMultistageEngine"] is True
+    assert qc.having_filter.predicate.type == PredicateType.RANGE
+    # HAVING's SUM(b) dedups against select's
+    assert len(qc.aggregations) == 1
+
+
+def test_case_cast_functions():
+    qc = parse_sql(
+        "SELECT CASE WHEN a > 1 THEN 'hi' ELSE 'lo' END, CAST(b AS DOUBLE), datetrunc('DAY', ts) FROM t"
+    )
+    assert qc.select_expressions[0].function.name == "case"
+    assert qc.select_expressions[1].function.name == "cast"
+    assert qc.select_expressions[1].function.arguments[1].literal == "DOUBLE"
+    assert qc.select_expressions[2].function.name == "datetrunc"
+
+
+def test_quoted_identifiers_and_strings():
+    qc = parse_sql('SELECT "weird col" FROM t WHERE name = \'O\'\'Brien\'')
+    assert qc.select_expressions[0].identifier == "weird col"
+    assert qc.filter.predicate.values == ("O'Brien",)
+
+
+def test_negative_numbers():
+    qc = parse_sql("SELECT * FROM t WHERE a > -5 AND b = -2.5")
+    assert qc.filter.children[0].predicate.lower == -5
+    assert qc.filter.children[1].predicate.values == (-2.5,)
+
+
+def test_explain():
+    qc = parse_sql("EXPLAIN PLAN FOR SELECT * FROM t")
+    assert qc.explain
+
+
+def test_parse_errors():
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT FROM t")
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT a FROM t WHERE")
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT a t")  # missing FROM
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT a FROM t LIMIT x")
+
+
+def test_underscore_function_canonicalization():
+    qc = parse_sql("SELECT DISTINCT_COUNT(a), distinct_count_hll(b) FROM t")
+    assert qc.aggregations[0].function.name == "distinctcount"
+    assert qc.aggregations[1].function.name == "distinctcounthll"
