@@ -1,0 +1,228 @@
+"""Aggregation functions: device lowering + host semantics.
+
+Reference: pinot-core/.../query/aggregation/function/ (93 impls behind
+AggregationFunction.aggregate/aggregateGroupBySV — .../AggregationFunction.java:74-82).
+The TPU design splits each SQL aggregation into:
+  1. *primitive device reductions* (AggOp: count/sum/min/max/sumsq/
+     distinct_bitmap) fused into the segment kernel (ops/kernels.py),
+  2. a host-side *intermediate state* per group (analogue of the reference's
+     intermediate results shipped in DataTables),
+  3. shared `AggSemantics` (merge across segments/servers + finalize at
+     broker reduce + result type) used identically by the device path and
+     the host (numpy) fallback engine, so the two paths can never drift.
+
+Result types follow the reference: COUNT→LONG, SUM/MIN/MAX/AVG→DOUBLE,
+DISTINCTCOUNT→INT.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..query.expressions import ExpressionContext
+from . import ir
+
+
+class UnsupportedQueryError(Exception):
+    """Raised when a query shape can't lower to the device kernel; callers
+    fall back to the host (numpy) engine."""
+
+
+@dataclass
+class AggSemantics:
+    """Cross-segment merge + broker finalize for one aggregation function."""
+
+    merge: Callable  # (a, b) -> state
+    finalize: Callable  # (state) -> python scalar
+    result_type: str
+    empty_value: object  # result when zero rows matched (aggregation query)
+
+
+@dataclass
+class LoweredAgg:
+    """Device lowering of one SQL aggregation: how to read kernel outputs.
+
+    extract(outs, g) builds the per-group intermediate state from the kernel
+    output tuple (outs[0] is always the per-group row count).
+    """
+
+    name: str
+    semantics: AggSemantics
+    extract: Callable  # (outs, g) -> state
+
+
+def _var_finalize(name: str):
+    def fin(state):
+        n, s, sq = state
+        if n == 0 or (name.endswith("samp") and n < 2):
+            return math.nan
+        var = sq / n - (s / n) ** 2
+        if name.endswith("samp"):
+            var = var * n / (n - 1)
+        var = max(var, 0.0)
+        return math.sqrt(var) if name.startswith("stddev") else var
+
+    return fin
+
+
+def _merge3(a, b):
+    return (a[0] + b[0], a[1] + b[1], a[2] + b[2])
+
+
+def get_semantics(name: str) -> AggSemantics:
+    if name == "count":
+        return AggSemantics(lambda a, b: a + b, lambda s: s, "LONG", 0)
+    if name in ("sum", "summv"):
+        return AggSemantics(lambda a, b: a + b, lambda s: s, "DOUBLE", 0.0)
+    if name in ("min", "minmv"):
+        return AggSemantics(min, lambda s: s, "DOUBLE", math.inf)
+    if name in ("max", "maxmv"):
+        return AggSemantics(max, lambda s: s, "DOUBLE", -math.inf)
+    if name == "minmaxrange":
+        return AggSemantics(lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
+                            lambda s: s[1] - s[0], "DOUBLE", -math.inf)
+    if name in ("avg", "avgmv"):
+        return AggSemantics(lambda a, b: (a[0] + b[0], a[1] + b[1]),
+                            lambda s: (s[0] / s[1]) if s[1] else math.nan,
+                            "DOUBLE", math.nan)
+    if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
+                "distinctcountmv"):
+        return AggSemantics(lambda a, b: a | b, len, "INT", 0)
+    if name == "distinctsum":
+        return AggSemantics(lambda a, b: a | b, lambda s: float(sum(s)), "DOUBLE", 0.0)
+    if name == "distinctavg":
+        return AggSemantics(lambda a, b: a | b,
+                            lambda s: sum(s) / len(s) if s else math.nan, "DOUBLE", math.nan)
+    if name in ("stddevpop", "stddevsamp", "varpop", "varsamp"):
+        return AggSemantics(_merge3, _var_finalize(name), "DOUBLE", math.nan)
+    if name == "booland":
+        return AggSemantics(lambda a, b: a and b, bool, "BOOLEAN", False)
+    if name in ("boolor", "boolagg"):
+        return AggSemantics(lambda a, b: a or b, bool, "BOOLEAN", False)
+    raise UnsupportedQueryError(f"aggregation {name} not implemented")
+
+
+# ---------------------------------------------------------------------------
+# Device lowering
+# ---------------------------------------------------------------------------
+
+
+class AggPlanContext:
+    """Planner callback surface used by lowerings to register device ops."""
+
+    def __init__(self):
+        self.ops: list[ir.AggOp] = []
+
+    def add_op(self, op: ir.AggOp) -> int:
+        """Register a primitive op, dedup'd; returns its kernel output index
+        (output 0 is the group count)."""
+        if op in self.ops:
+            return 1 + self.ops.index(op)
+        self.ops.append(op)
+        return len(self.ops)
+
+    # provided by SegmentPlanner (engine/plan.py):
+    def value_expr(self, e: ExpressionContext) -> ir.ValueExpr:  # pragma: no cover
+        raise NotImplementedError
+
+    def dict_info(self, e: ExpressionContext):  # pragma: no cover
+        raise NotImplementedError
+
+
+def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAgg:
+    fn = expr.function
+    name, args = fn.name, fn.arguments
+    label = str(expr)
+    sem = get_semantics(name)
+
+    if name == "count":
+        return LoweredAgg(label, sem, lambda outs, g: int(outs[0][g]))
+
+    if name in ("sum", "min", "max"):
+        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.value_expr(args[0])))
+        return LoweredAgg(label, sem, lambda outs, g: float(outs[i][g]))
+
+    if name == "minmaxrange":
+        i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.value_expr(args[0])))
+        i_max = ctx.add_op(ir.AggOp("max", vexpr=ctx.value_expr(args[0])))
+        return LoweredAgg(label, sem,
+                          lambda outs, g: (float(outs[i_min][g]), float(outs[i_max][g])))
+
+    if name == "avg":
+        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(args[0])))
+        return LoweredAgg(label, sem, lambda outs, g: (float(outs[i][g]), int(outs[0][g])))
+
+    if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
+                "distinctsum", "distinctavg"):
+        info = ctx.dict_info(args[0])
+        if info is None:
+            raise UnsupportedQueryError(f"distinct aggregation needs a dict-encoded column: {args[0]}")
+        ids_slot, card, dictionary = info
+        i = ctx.add_op(ir.AggOp("distinct_bitmap", ids_slot=ids_slot, card=card))
+        numeric = name in ("distinctsum", "distinctavg")
+
+        def extract(outs, g, _i=i, _d=dictionary, _numeric=numeric):
+            sel = _d.values[np.nonzero(outs[_i][g])[0]]
+            if _numeric:
+                return frozenset(float(v) for v in sel)
+            return frozenset(sel.tolist())
+
+        return LoweredAgg(label, sem, extract)
+
+    if name in ("stddevpop", "stddevsamp", "varpop", "varsamp"):
+        i_s = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(args[0])))
+        i_q = ctx.add_op(ir.AggOp("sumsq", vexpr=ctx.value_expr(args[0])))
+        return LoweredAgg(
+            label, sem,
+            lambda outs, g: (int(outs[0][g]), float(outs[i_s][g]), float(outs[i_q][g])))
+
+    if name in ("booland", "boolor", "boolagg"):
+        # booleans are 0/1 ints: AND = min (empty→+inf→True), OR = max (empty→-inf→False)
+        kind = "min" if name == "booland" else "max"
+        i = ctx.add_op(ir.AggOp(kind, vexpr=ctx.value_expr(args[0])))
+        return LoweredAgg(label, sem, lambda outs, g: bool(outs[i][g] > 0.5))
+
+    raise UnsupportedQueryError(f"aggregation {name} not yet lowered to device")
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy) states — used by the fallback engine and the test oracle
+# ---------------------------------------------------------------------------
+
+
+def host_state(name: str, values: Optional[np.ndarray]):
+    """Per-group intermediate state from the group's (already filtered) raw
+    values. Must produce states mergeable/finalizable by get_semantics(name)
+    — i.e. identical shape to the device path's LoweredAgg.extract."""
+    n = 0 if values is None else len(values)
+    if name == "count":
+        return n
+    if values is None:
+        raise UnsupportedQueryError(f"{name} requires an argument")
+    if name in ("sum", "summv"):
+        return float(np.sum(values)) if n else 0.0
+    if name in ("min", "minmv"):
+        return float(np.min(values)) if n else math.inf
+    if name in ("max", "maxmv"):
+        return float(np.max(values)) if n else -math.inf
+    if name == "minmaxrange":
+        return (float(np.min(values)), float(np.max(values))) if n else (math.inf, -math.inf)
+    if name in ("avg", "avgmv"):
+        return (float(np.sum(values)), n)
+    if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
+                "distinctcountmv"):
+        return frozenset(np.unique(values).tolist())
+    if name in ("distinctsum", "distinctavg"):
+        return frozenset(float(v) for v in np.unique(values))
+    if name in ("stddevpop", "stddevsamp", "varpop", "varsamp"):
+        v = values.astype(np.float64)
+        return (n, float(v.sum()), float((v * v).sum()))
+    if name == "booland":
+        return bool(np.all(values)) if n else True
+    if name in ("boolor", "boolagg"):
+        return bool(np.any(values)) if n else False
+    raise UnsupportedQueryError(f"aggregation {name} not implemented on host")

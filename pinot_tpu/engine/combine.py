@@ -1,0 +1,59 @@
+"""Cross-segment combine.
+
+Reference: pinot-core/.../operator/combine/ (GroupByCombineOperator merging
+into ConcurrentIndexedTable keyed on group Records —
+GroupByCombineOperator.java:102-140). Here intermediates are already keyed by
+group VALUES, so combine is a dict merge using each aggregation's shared
+AggSemantics.merge.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .aggregation import AggSemantics
+from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
+
+
+def combine_group_by(
+    intermediates: Sequence[GroupByIntermediate], semantics: list[AggSemantics]
+) -> GroupByIntermediate:
+    merged: dict[tuple, list] = {}
+    scanned = 0
+    for im in intermediates:
+        scanned += im.num_docs_scanned
+        for key, states in im.groups.items():
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = list(states)
+            else:
+                for i, sem in enumerate(semantics):
+                    cur[i] = sem.merge(cur[i], states[i])
+    return GroupByIntermediate(merged, scanned)
+
+
+def combine_aggregation(
+    intermediates: Sequence[AggIntermediate], semantics: list[AggSemantics]
+) -> AggIntermediate:
+    it = iter(intermediates)
+    first = next(it)
+    states = list(first.states)
+    scanned = first.num_docs_scanned
+    for im in it:
+        scanned += im.num_docs_scanned
+        for i, sem in enumerate(semantics):
+            states[i] = sem.merge(states[i], im.states[i])
+    return AggIntermediate(states, scanned)
+
+
+def combine_selection(
+    intermediates: Sequence[SelectionIntermediate],
+) -> SelectionIntermediate:
+    it = iter(intermediates)
+    first = next(it)
+    rows = list(first.rows)
+    scanned = first.num_docs_scanned
+    for im in it:
+        scanned += im.num_docs_scanned
+        rows.extend(im.rows)
+    return SelectionIntermediate(first.columns, rows, scanned)

@@ -1,0 +1,94 @@
+"""Per-segment device executor.
+
+Reference analogue: the server-side operator chain execution under
+ServerQueryExecutorV1Impl (pinot-core/.../query/executor/
+ServerQueryExecutorV1Impl.java:141) — but one segment = ONE device dispatch
+(run_program), not a pull loop of 10K-doc blocks. Host work is limited to:
+planning (dictionary lookups), launching the kernel, and decoding occupied
+group keys back to values.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.kernels import run_program
+from ..query.context import QueryContext
+from ..segment.device_cache import GLOBAL_DEVICE_CACHE, DeviceSegmentCache
+from ..segment.loader import ImmutableSegment
+from .aggregation import UnsupportedQueryError
+from .plan import SegmentPlan, SegmentPlanner
+from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
+
+
+class TpuSegmentExecutor:
+    """Executes one QueryContext against one segment on the device."""
+
+    def __init__(self, cache: DeviceSegmentCache = None):
+        self.cache = cache or GLOBAL_DEVICE_CACHE
+
+    def plan(self, query: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
+        return SegmentPlanner(query, segment).plan()
+
+    def execute(self, query: QueryContext, segment: ImmutableSegment):
+        plan = self.plan(query, segment)
+        return self.execute_plan(query, segment, plan)
+
+    def execute_plan(self, query: QueryContext, segment: ImmutableSegment, plan: SegmentPlan):
+        view = self.cache.view(segment)
+        arrays = plan.gather_arrays(view)
+        params = tuple(jnp.asarray(p) for p in plan.params)
+        outs = run_program(plan.program, arrays, params, jnp.int32(segment.num_docs), view.padded)
+        outs = [np.asarray(o) for o in outs]
+        mode = plan.program.mode
+        if mode == "selection":
+            return self._selection_result(query, segment, plan, outs[0])
+        if mode == "aggregation":
+            states = [la.extract(outs, 0) for la in plan.lowered_aggs]
+            return AggIntermediate(states, num_docs_scanned=int(outs[0][0]))
+        return self._group_by_result(plan, outs)
+
+    def _group_by_result(self, plan: SegmentPlan, outs) -> GroupByIntermediate:
+        num_groups = plan.program.num_groups
+        counts = outs[0][:num_groups]
+        gids = np.nonzero(counts)[0]
+        # decompose linear gid → per-dim dict ids → values
+        # (inverse of DictionaryBasedGroupKeyGenerator's cartesian key,
+        # pinot-core/.../groupby/DictionaryBasedGroupKeyGenerator.java:119-137)
+        key_cols = []
+        for dim, stride in zip(plan.group_dims, plan.program.group_strides):
+            ids = (gids // stride) % dim.cardinality
+            key_cols.append(dim.dictionary.values[ids])
+        groups = {}
+        for row, g in enumerate(gids):
+            key = tuple(_to_python(col[row]) for col in key_cols)
+            groups[key] = [la.extract(outs, g) for la in plan.lowered_aggs]
+        return GroupByIntermediate(groups, num_docs_scanned=int(counts.sum()))
+
+    def _selection_result(self, query, segment, plan, mask) -> SelectionIntermediate:
+        mask = mask[: segment.num_docs]
+        doc_ids = np.nonzero(mask)[0]
+        total = int(doc_ids.shape[0])
+        cap = query.offset + query.limit
+        if not query.order_by_expressions:
+            doc_ids = doc_ids[:cap]
+        cols = [segment.get_values(c)[doc_ids] for c in plan.selection_columns]
+        rows = list(zip(*[c.tolist() for c in cols])) if cols else []
+        if query.order_by_expressions:
+            idx = {c: i for i, c in enumerate(plan.selection_columns)}
+            sort_keys = []
+            for ob in reversed(query.order_by_expressions):
+                if not ob.expression.is_identifier or ob.expression.identifier not in idx:
+                    raise UnsupportedQueryError("selection ORDER BY must reference selected columns")
+                sort_keys.append((idx[ob.expression.identifier], ob.ascending))
+            for col_i, asc in sort_keys:
+                rows.sort(key=lambda r: r[col_i], reverse=not asc)
+            rows = rows[:cap]
+        return SelectionIntermediate(plan.selection_columns, rows, num_docs_scanned=total)
+
+
+def _to_python(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

@@ -1,0 +1,273 @@
+"""Host (numpy) per-segment engine — fallback path + differential oracle.
+
+Role mirrors the reference's scalar CPU engine remaining the default while
+the TPU backend handles supported shapes (BASELINE.json: "the existing CPU
+path remains the default"). Semantics here define correctness: the device
+executor must produce identical intermediates (tests/test_queries.py runs
+both and compares). Kept deliberately simple — vectorized numpy where easy,
+python where not — clarity over speed.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+from ..query.context import QueryContext
+from ..query.expressions import ExpressionContext
+from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
+from ..segment.loader import ImmutableSegment
+from .aggregation import UnsupportedQueryError, host_state
+from .plan import like_to_regex
+from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
+
+
+class HostSegmentExecutor:
+    def execute(self, query: QueryContext, segment: ImmutableSegment):
+        mask = self._filter_mask(query.filter, segment)
+        if query.is_aggregation_query or query.distinct or query.is_group_by:
+            group_exprs = list(query.group_by_expressions)
+            if query.distinct and not query.is_aggregation_query:
+                group_exprs = list(query.select_expressions)
+            if group_exprs:
+                return self._group_by(query, segment, mask, group_exprs)
+            return self._aggregation(query, segment, mask)
+        return self._selection(query, segment, mask)
+
+    # -- filter ------------------------------------------------------------
+    def _filter_mask(self, f, segment: ImmutableSegment) -> np.ndarray:
+        n = segment.num_docs
+        if f is None:
+            return np.ones(n, dtype=bool)
+        return self._eval_filter(f, segment)
+
+    def _eval_filter(self, f: FilterContext, segment) -> np.ndarray:
+        n = segment.num_docs
+        if f.type == FilterNodeType.AND:
+            m = np.ones(n, dtype=bool)
+            for c in f.children:
+                m &= self._eval_filter(c, segment)
+            return m
+        if f.type == FilterNodeType.OR:
+            m = np.zeros(n, dtype=bool)
+            for c in f.children:
+                m |= self._eval_filter(c, segment)
+            return m
+        if f.type == FilterNodeType.NOT:
+            return ~self._eval_filter(f.children[0], segment)
+        if f.type == FilterNodeType.CONSTANT:
+            return np.full(n, f.constant_value, dtype=bool)
+        return self._eval_predicate(f.predicate, segment)
+
+    def _eval_predicate(self, p: Predicate, segment) -> np.ndarray:
+        n = segment.num_docs
+        if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+            col = p.lhs.identifier
+            nulls = segment.get_null_bitmap(col)
+            m = np.zeros(n, dtype=bool) if nulls is None else nulls.copy()
+            return ~m if p.type == PredicateType.IS_NOT_NULL else m
+
+        # MV columns: row matches if ANY value matches (reference MV predicate
+        # semantics)
+        if p.lhs.is_identifier and not segment.column_metadata(p.lhs.identifier).single_value:
+            return self._eval_mv_predicate(p, segment)
+
+        v = self.eval_value(p.lhs, segment)
+        if p.type == PredicateType.EQ:
+            return v == _coerce_to(v, p.values[0])
+        if p.type == PredicateType.NOT_EQ:
+            return v != _coerce_to(v, p.values[0])
+        if p.type in (PredicateType.IN, PredicateType.NOT_IN):
+            m = np.zeros(n, dtype=bool)
+            for val in p.values:
+                m |= v == _coerce_to(v, val)
+            return ~m if p.type == PredicateType.NOT_IN else m
+        if p.type == PredicateType.RANGE:
+            m = np.ones(n, dtype=bool)
+            if p.lower is not None:
+                lo = _coerce_to(v, p.lower)
+                m &= (v >= lo) if p.lower_inclusive else (v > lo)
+            if p.upper is not None:
+                hi = _coerce_to(v, p.upper)
+                m &= (v <= hi) if p.upper_inclusive else (v < hi)
+            return m
+        if p.type in (PredicateType.LIKE, PredicateType.REGEXP_LIKE):
+            regex = (like_to_regex(p.values[0]) if p.type == PredicateType.LIKE
+                     else re.compile(str(p.values[0])))
+            return np.asarray([regex.search(str(x)) is not None for x in v], dtype=bool)
+        raise UnsupportedQueryError(f"host predicate {p.type}")
+
+    def _eval_mv_predicate(self, p: Predicate, segment) -> np.ndarray:
+        col = p.lhs.identifier
+        rows = segment.get_mv_values(col)
+
+        def match_one(val) -> bool:
+            if p.type == PredicateType.EQ:
+                return any(x == p.values[0] for x in val)
+            if p.type == PredicateType.NOT_EQ:
+                return any(x != p.values[0] for x in val)
+            if p.type == PredicateType.IN:
+                return any(x in p.values for x in val)
+            if p.type == PredicateType.NOT_IN:
+                return any(x not in p.values for x in val)
+            if p.type == PredicateType.RANGE:
+                for x in val:
+                    ok = True
+                    if p.lower is not None:
+                        ok &= (x >= p.lower) if p.lower_inclusive else (x > p.lower)
+                    if p.upper is not None:
+                        ok &= (x <= p.upper) if p.upper_inclusive else (x < p.upper)
+                    if ok:
+                        return True
+                return False
+            raise UnsupportedQueryError(f"host MV predicate {p.type}")
+
+        return np.asarray([match_one(r) for r in rows], dtype=bool)
+
+    # -- value expressions -------------------------------------------------
+    def eval_value(self, e: ExpressionContext, segment) -> np.ndarray:
+        n = segment.num_docs
+        if e.is_literal:
+            v = e.literal
+            if isinstance(v, bool):
+                v = int(v)
+            return np.full(n, v)
+        if e.is_identifier:
+            vals = segment.get_values(e.identifier)
+            from ..spi.data_types import DataType
+
+            if DataType(segment.column_metadata(e.identifier).data_type) == DataType.BOOLEAN:
+                return vals.astype(np.int64)
+            return vals
+        fn = e.function
+        name, args = fn.name, fn.arguments
+        if name in _NP_BIN:
+            return _NP_BIN[name](self.eval_value(args[0], segment), self.eval_value(args[1], segment))
+        if name in _NP_UN:
+            return _NP_UN[name](self.eval_value(args[0], segment))
+        if name == "cast":
+            return _np_cast(self.eval_value(args[0], segment), str(args[1].literal).upper())
+        if name == "case":
+            out = self.eval_value(args[-1], segment)
+            for i in range(len(args) - 3, -1, -2):
+                cond = self.eval_value(args[i], segment).astype(bool)
+                out = np.where(cond, self.eval_value(args[i + 1], segment), out)
+            return out
+        raise UnsupportedQueryError(f"host transform {name}")
+
+    # -- shapes ------------------------------------------------------------
+    def _aggregation(self, query, segment, mask) -> AggIntermediate:
+        states = []
+        for agg in query.aggregations:
+            states.append(self._agg_state(agg, segment, mask))
+        return AggIntermediate(states, num_docs_scanned=int(mask.sum()))
+
+    def _agg_state(self, agg: ExpressionContext, segment, mask):
+        name = agg.function.name
+        args = agg.function.arguments
+        if name == "count":
+            return int(mask.sum())
+        vals = self.eval_value(args[0], segment)
+        return host_state(name, np.asarray(vals)[mask])
+
+    def _group_by(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
+        key_cols = [np.asarray(self.eval_value(e, segment)) for e in group_exprs]
+        sel = np.nonzero(mask)[0]
+        groups: dict[tuple, list] = {}
+        # factorize each key col then group by linear code
+        codes = np.zeros(len(sel), dtype=np.int64)
+        uniqs = []
+        for col in key_cols:
+            u, inv = np.unique(col[sel], return_inverse=True)
+            codes = codes * len(u) + inv if len(u) else codes
+            uniqs.append(u)
+        order = np.argsort(codes, kind="stable")
+        sel_sorted = sel[order]
+        codes_sorted = codes[order]
+        boundaries = np.nonzero(np.diff(codes_sorted))[0] + 1
+        starts = np.concatenate([[0], boundaries])
+        ends = np.concatenate([boundaries, [len(sel_sorted)]])
+        agg_args = []
+        for agg in query.aggregations:
+            if agg.function.name == "count":
+                agg_args.append(None)
+            else:
+                agg_args.append(np.asarray(self.eval_value(agg.function.arguments[0], segment)))
+        for s, e in zip(starts, ends):
+            if s == e:
+                continue
+            rows = sel_sorted[s:e]
+            key = tuple(_to_python(col[rows[0]]) for col in key_cols)
+            states = []
+            for agg, vals in zip(query.aggregations, agg_args):
+                if vals is None:
+                    states.append(len(rows))
+                else:
+                    states.append(host_state(agg.function.name, vals[rows]))
+            groups[key] = states
+        return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
+
+    def _selection(self, query, segment, mask) -> SelectionIntermediate:
+        cols: list[str] = []
+        for e in query.select_expressions:
+            if e.is_identifier:
+                if e.identifier == "*":
+                    cols.extend(segment.columns())
+                else:
+                    cols.append(e.identifier)
+            else:
+                raise UnsupportedQueryError("selection transforms unsupported")
+        doc_ids = np.nonzero(mask)[0]
+        total = len(doc_ids)
+        cap = query.offset + query.limit
+        if not query.order_by_expressions:
+            doc_ids = doc_ids[:cap]
+        data = [segment.get_values(c)[doc_ids] for c in cols]
+        rows = list(zip(*[c.tolist() for c in data])) if data else []
+        if query.order_by_expressions:
+            idx = {c: i for i, c in enumerate(cols)}
+            for ob in reversed(query.order_by_expressions):
+                if not ob.expression.is_identifier or ob.expression.identifier not in idx:
+                    raise UnsupportedQueryError("selection ORDER BY must reference selected columns")
+                rows.sort(key=lambda r: r[idx[ob.expression.identifier]], reverse=not ob.ascending)
+            rows = rows[:cap]
+        return SelectionIntermediate(cols, rows, num_docs_scanned=total)
+
+
+_NP_BIN = {
+    "plus": np.add, "minus": np.subtract, "times": np.multiply,
+    "divide": np.true_divide, "mod": np.mod, "pow": np.power, "power": np.power,
+    "equals": lambda a, b: a == b, "notequals": lambda a, b: a != b,
+    "lessthan": lambda a, b: a < b, "lessthanorequal": lambda a, b: a <= b,
+    "greaterthan": lambda a, b: a > b, "greaterthanorequal": lambda a, b: a >= b,
+    "and": np.logical_and, "or": np.logical_or,
+    "least": np.minimum, "greatest": np.maximum,
+}
+
+_NP_UN = {
+    "neg": np.negative, "abs": np.abs, "not": np.logical_not, "exp": np.exp,
+    "ln": np.log, "log10": np.log10, "log2": np.log2, "sqrt": np.sqrt,
+    "ceiling": np.ceil, "ceil": np.ceil, "floor": np.floor, "sign": np.sign,
+}
+
+
+def _np_cast(v, to):
+    m = {"INT": np.int32, "LONG": np.int64, "FLOAT": np.float32, "DOUBLE": np.float64,
+         "BOOLEAN": bool, "STRING": np.str_, "TIMESTAMP": np.int64}
+    if to not in m:
+        raise UnsupportedQueryError(f"cast to {to}")
+    return v.astype(m[to])
+
+
+def _coerce_to(arr: np.ndarray, value):
+    if isinstance(value, bool) and np.issubdtype(arr.dtype, np.number):
+        return int(value)
+    return value
+
+
+def _to_python(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

@@ -1,0 +1,193 @@
+"""Kernel program IR — the static shape of a per-segment query kernel.
+
+This is the TPU build's replacement for the reference's operator tree
+(pinot-core/.../plan/ — GroupByPlanNode/AggregationPlanNode/SelectionPlanNode
+over Operator.nextBlock pull loops). Instead of virtual-call operators pulling
+10K-doc blocks, a query compiles to a *Program*: a small frozen (hashable)
+tree interpreted once inside `jax.jit` (ops/kernels.py:run_program). Because
+the Program is a static jit argument, all literal values live in the runtime
+`params` tuple — structurally identical queries over same-shaped segments hit
+the XLA compile cache regardless of literals.
+
+Slot model: `arrays[i]` are device-resident column planes (dict-id planes,
+raw value planes, numeric dictionaries, null bitmaps); `params[i]` are
+per-query values (interval bounds, LUTs, IN-lists). The planner
+(engine/plan.py) assigns slots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# Value expressions (→ reference TransformFunction,
+# pinot-core/.../operator/transform/function/TransformFunction.java:35)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ValueExpr:
+    pass
+
+
+@dataclass(frozen=True)
+class Col(ValueExpr):
+    """A raw value plane already on device."""
+
+    slot: int
+
+
+@dataclass(frozen=True)
+class DictGather(ValueExpr):
+    """dictionary[dict_ids] — numeric dict decode on device."""
+
+    ids_slot: int
+    dict_slot: int
+
+
+@dataclass(frozen=True)
+class IdsCol(ValueExpr):
+    """The dict-id plane itself (used for group keys / dict-space compares)."""
+
+    slot: int
+
+
+@dataclass(frozen=True)
+class ConstParam(ValueExpr):
+    """Scalar literal passed at runtime (params[idx])."""
+
+    idx: int
+
+
+@dataclass(frozen=True)
+class Bin(ValueExpr):
+    op: str  # add sub mul div mod pow eq ne lt le gt ge and or min max
+    a: ValueExpr
+    b: ValueExpr
+
+
+@dataclass(frozen=True)
+class Un(ValueExpr):
+    op: str  # neg abs not exp ln log10 log2 sqrt ceil floor sign
+    a: ValueExpr
+
+
+@dataclass(frozen=True)
+class Cast(ValueExpr):
+    a: ValueExpr
+    to: str  # INT LONG FLOAT DOUBLE BOOLEAN
+
+
+@dataclass(frozen=True)
+class Where(ValueExpr):
+    cond: ValueExpr
+    a: ValueExpr
+    b: ValueExpr
+
+
+# ---------------------------------------------------------------------------
+# Filter nodes (→ reference BaseFilterOperator tree,
+# pinot-core/.../operator/filter/; predicates become vector compares)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FilterNode:
+    pass
+
+
+@dataclass(frozen=True)
+class FConst(FilterNode):
+    value: bool
+
+
+@dataclass(frozen=True)
+class Interval(FilterNode):
+    """lo <= v <= hi with optional open bounds; params hold the bounds.
+
+    Dict-encoded predicates are normalized on host to a dict-id interval
+    (sorted dictionaries make value ranges id ranges); raw predicates compare
+    in value space.
+    """
+
+    vexpr: ValueExpr
+    lo_param: Optional[int] = None
+    hi_param: Optional[int] = None
+    lo_inclusive: bool = True
+    hi_inclusive: bool = True
+
+
+@dataclass(frozen=True)
+class Lut(FilterNode):
+    """mask = lut[dict_ids] — arbitrary dictionary predicate (IN, LIKE, REGEXP,
+    NOT_IN...) evaluated against the dictionary on host into a boolean LUT.
+    MV-safe: LUT is sized cardinality+1 with the pad sentinel false."""
+
+    ids_slot: int
+    lut_param: int
+    mv: bool = False
+
+
+@dataclass(frozen=True)
+class Isin(FilterNode):
+    """Raw-column IN: compare against a small padded value array
+    (pad = repeat of first value, harmless for membership)."""
+
+    vexpr: ValueExpr
+    values_param: int
+
+
+@dataclass(frozen=True)
+class Null(FilterNode):
+    """mask = null bitmap plane (IS_NULL)."""
+
+    null_slot: int
+
+
+@dataclass(frozen=True)
+class FAnd(FilterNode):
+    children: tuple[FilterNode, ...]
+
+
+@dataclass(frozen=True)
+class FOr(FilterNode):
+    children: tuple[FilterNode, ...]
+
+
+@dataclass(frozen=True)
+class FNot(FilterNode):
+    child: FilterNode
+
+
+# ---------------------------------------------------------------------------
+# Aggregation ops (primitive device reductions; SQL agg functions lower to
+# one or more of these — engine/aggregation.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AggOp:
+    kind: str  # count | sum | min | max | sumsq | distinct_bitmap
+    vexpr: Optional[ValueExpr] = None
+    # distinct_bitmap: dict-id plane slot + static cardinality
+    ids_slot: Optional[int] = None
+    card: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Program
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Program:
+    mode: str  # "group_by" | "aggregation" | "selection"
+    filter: Optional[FilterNode]
+    aggs: tuple[AggOp, ...] = ()
+    # group-by: per-dim dict-id plane slots + cartesian strides
+    # (reference DictionaryBasedGroupKeyGenerator cartesian-product int keys,
+    # pinot-core/.../groupby/DictionaryBasedGroupKeyGenerator.java:119-137)
+    group_slots: tuple[int, ...] = ()
+    group_strides: tuple[int, ...] = ()
+    num_groups: int = 1

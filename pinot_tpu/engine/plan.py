@@ -1,0 +1,373 @@
+"""Per-segment query planner: QueryContext + segment → kernel Program.
+
+Reference: pinot-core/.../plan/maker/InstancePlanMakerImplV2.java:275
+(makeSegmentPlanNode dispatches on query shape) plus the predicate-evaluator
+layer (pinot-core/.../operator/filter/predicate/PredicateEvaluatorProvider) —
+there, predicates resolve against dictionaries at planning time; here that
+resolution produces *device kernel parameters*: sorted dictionaries turn
+value predicates into dict-id intervals or boolean LUTs, so the kernel never
+touches a string.
+
+Unsupported shapes raise UnsupportedQueryError and the caller falls back to
+the host (numpy) engine — mirroring how the reference keeps the scalar path
+as default (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..query.context import QueryContext
+from ..query.expressions import ExpressionContext, is_aggregation
+from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
+from ..segment.device_cache import SegmentDeviceView
+from ..segment.loader import ImmutableSegment
+from ..spi.data_types import DataType
+from . import ir
+from .aggregation import AggPlanContext, LoweredAgg, UnsupportedQueryError, lower_aggregation
+
+DENSE_GROUP_LIMIT = 1 << 21  # beyond this the dense segment_sum table blows HBM
+
+
+@dataclass
+class GroupDim:
+    column: str
+    cardinality: int
+    dictionary: object  # segment Dictionary (host) — decodes ids at combine
+
+
+@dataclass
+class SegmentPlan:
+    program: ir.Program
+    slots: list  # (column, kind) in slot order; kind ∈ ids|mvids|raw|dict|null
+    params: list  # host param values in order (np scalars / arrays)
+    lowered_aggs: list[LoweredAgg] = field(default_factory=list)
+    group_dims: list[GroupDim] = field(default_factory=list)
+    selection_columns: list[str] = field(default_factory=list)
+
+    def gather_arrays(self, view: SegmentDeviceView) -> tuple:
+        out = []
+        for column, kind in self.slots:
+            if kind == "ids":
+                out.append(view.dict_ids(column))
+            elif kind == "mvids":
+                out.append(view.mv_dict_ids(column))
+            elif kind == "raw":
+                out.append(view.raw(column))
+            elif kind == "dict":
+                out.append(view.dict_values(column))
+            elif kind == "null":
+                out.append(view.null_plane(column))
+            else:  # pragma: no cover
+                raise ValueError(kind)
+        return tuple(out)
+
+
+class SegmentPlanner(AggPlanContext):
+    def __init__(self, query: QueryContext, segment: ImmutableSegment):
+        super().__init__()
+        self.query = query
+        self.segment = segment
+        self._slots: list[tuple[str, str]] = []
+        self._slot_index: dict[tuple[str, str], int] = {}
+        self._params: list = []
+
+    # -- slot/param bookkeeping -------------------------------------------
+    def slot(self, column: str, kind: str) -> int:
+        key = (column, kind)
+        if key not in self._slot_index:
+            self._slot_index[key] = len(self._slots)
+            self._slots.append(key)
+        return self._slot_index[key]
+
+    def param(self, value) -> int:
+        self._params.append(value)
+        return len(self._params) - 1
+
+    # -- column helpers ----------------------------------------------------
+    def _meta(self, column: str):
+        if not self.segment.has_column(column):
+            raise UnsupportedQueryError(f"unknown column {column}")
+        return self.segment.column_metadata(column)
+
+    def dict_info(self, e: ExpressionContext):
+        if not e.is_identifier or e.identifier == "*":
+            return None
+        m = self._meta(e.identifier)
+        if m.encoding != "DICT":
+            return None
+        kind = "ids" if m.single_value else "mvids"
+        return self.slot(e.identifier, kind), m.cardinality, self.segment.get_dictionary(e.identifier)
+
+    # -- value expressions (device transform functions) --------------------
+    def value_expr(self, e: ExpressionContext) -> ir.ValueExpr:
+        if e.is_literal:
+            v = e.literal
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)):
+                raise UnsupportedQueryError(f"non-numeric literal in value context: {v!r}")
+            return ir.ConstParam(self.param(np.float64(v) if isinstance(v, float) else np.int64(v)))
+        if e.is_identifier:
+            m = self._meta(e.identifier)
+            if not m.single_value:
+                raise UnsupportedQueryError(f"MV column {e.identifier} in value context")
+            dt = DataType(m.data_type)
+            if not dt.is_fixed_width:
+                raise UnsupportedQueryError(f"var-width column {e.identifier} in value context")
+            if m.encoding == "RAW":
+                return ir.Col(self.slot(e.identifier, "raw"))
+            return ir.DictGather(self.slot(e.identifier, "ids"), self.slot(e.identifier, "dict"))
+        fn = e.function
+        name, args = fn.name, fn.arguments
+        if name in _BIN_FN:
+            return ir.Bin(_BIN_FN[name], self.value_expr(args[0]), self.value_expr(args[1]))
+        if name in _UN_FN:
+            return ir.Un(_UN_FN[name], self.value_expr(args[0]))
+        if name == "cast":
+            return ir.Cast(self.value_expr(args[0]), str(args[1].literal).upper())
+        if name == "case":
+            # case(c1,v1,c2,v2,...,else) → nested Where
+            pairs = args[:-1]
+            out = self.value_expr(args[-1])
+            for i in range(len(pairs) - 2, -1, -2):
+                out = ir.Where(self.value_expr(pairs[i]), self.value_expr(pairs[i + 1]), out)
+            return out
+        raise UnsupportedQueryError(f"transform function {name} not lowered to device")
+
+    # -- filter lowering ---------------------------------------------------
+    def lower_filter(self, f: Optional[FilterContext]) -> Optional[ir.FilterNode]:
+        if f is None:
+            return None
+        return self._lower_filter(f)
+
+    def _lower_filter(self, f: FilterContext) -> ir.FilterNode:
+        if f.type == FilterNodeType.AND:
+            return ir.FAnd(tuple(self._lower_filter(c) for c in f.children))
+        if f.type == FilterNodeType.OR:
+            return ir.FOr(tuple(self._lower_filter(c) for c in f.children))
+        if f.type == FilterNodeType.NOT:
+            return ir.FNot(self._lower_filter(f.children[0]))
+        if f.type == FilterNodeType.CONSTANT:
+            return ir.FConst(f.constant_value)
+        return self._lower_predicate(f.predicate)
+
+    def _lower_predicate(self, p: Predicate) -> ir.FilterNode:
+        lhs = p.lhs
+        if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+            if not lhs.is_identifier:
+                raise UnsupportedQueryError("IS NULL on expressions unsupported")
+            m = self._meta(lhs.identifier)
+            if not m.has_nulls:
+                node = ir.FConst(False)
+            else:
+                node = ir.Null(self.slot(lhs.identifier, "null"))
+            return ir.FNot(node) if p.type == PredicateType.IS_NOT_NULL else node
+
+        info = self.dict_info(lhs) if lhs.is_identifier else None
+        if info is not None:
+            return self._lower_dict_predicate(p, lhs, info)
+        return self._lower_value_predicate(p)
+
+    def _lower_dict_predicate(self, p: Predicate, lhs, info) -> ir.FilterNode:
+        ids_slot, card, d = info
+        m = self._meta(lhs.identifier)
+        mv = not m.single_value
+        dt = DataType(m.data_type)
+
+        def coerce(v):
+            if dt.is_numeric and isinstance(v, bool):
+                return int(v)
+            return v
+
+        if p.type in (PredicateType.EQ, PredicateType.NOT_EQ):
+            did = d.index_of(coerce(p.values[0]))
+            if mv:
+                # MV predicate semantics are per-VALUE ("any value matches"),
+                # so NOT_EQ needs an inverted LUT, not a document-level NOT
+                lut = np.zeros(card + 1, dtype=bool)
+                if did >= 0:
+                    lut[did] = True
+                if p.type == PredicateType.NOT_EQ:
+                    lut[:card] = ~lut[:card]
+                return ir.Lut(ids_slot, self.param(lut), mv=True)
+            if did < 0:
+                node = ir.FConst(False)
+            else:
+                node = self._id_interval(ids_slot, did, did, mv, card)
+            return ir.FNot(node) if p.type == PredicateType.NOT_EQ else node
+
+        if p.type == PredicateType.RANGE:
+            lo_id = 0
+            hi_id = card - 1
+            if p.lower is not None:
+                lo_id = d.insertion_index(coerce(p.lower), "left" if p.lower_inclusive else "right")
+            if p.upper is not None:
+                hi_id = d.insertion_index(coerce(p.upper), "right" if p.upper_inclusive else "left") - 1
+            if lo_id > hi_id:
+                return ir.FConst(False)
+            if lo_id <= 0 and hi_id >= card - 1 and not mv:
+                return ir.FConst(True)
+            return self._id_interval(ids_slot, lo_id, hi_id, mv, card)
+
+        if p.type in (PredicateType.IN, PredicateType.NOT_IN):
+            lut = np.zeros(card + 1, dtype=bool)
+            for v in p.values:
+                did = d.index_of(coerce(v))
+                if did >= 0:
+                    lut[did] = True
+            if p.type == PredicateType.NOT_IN:
+                lut[:card] = ~lut[:card]
+            return ir.Lut(ids_slot, self.param(lut), mv=mv)
+
+        if p.type in (PredicateType.LIKE, PredicateType.REGEXP_LIKE):
+            pattern = p.values[0]
+            regex = like_to_regex(pattern) if p.type == PredicateType.LIKE else re.compile(str(pattern))
+            lut = np.zeros(card + 1, dtype=bool)
+            for i, v in enumerate(d.values):
+                if regex.search(str(v)) is not None:
+                    lut[i] = True
+            return ir.Lut(ids_slot, self.param(lut), mv=mv)
+
+        raise UnsupportedQueryError(f"predicate {p.type} not lowered")
+
+    def _id_interval(self, ids_slot, lo_id, hi_id, mv, card) -> ir.FilterNode:
+        if mv:
+            lut = np.zeros(card + 1, dtype=bool)
+            lut[lo_id : hi_id + 1] = True
+            return ir.Lut(ids_slot, self.param(lut), mv=True)
+        return ir.Interval(
+            ir.IdsCol(ids_slot),
+            lo_param=self.param(np.int32(lo_id)),
+            hi_param=self.param(np.int32(hi_id)),
+        )
+
+    def _lower_value_predicate(self, p: Predicate) -> ir.FilterNode:
+        ve = self.value_expr(p.lhs)
+        if p.type in (PredicateType.EQ, PredicateType.NOT_EQ):
+            v = p.values[0]
+            if isinstance(v, bool):
+                v = int(v)
+            if isinstance(v, str):
+                raise UnsupportedQueryError("string compare on raw column")
+            pi = self.param(np.float64(v) if isinstance(v, float) else np.int64(v))
+            node = ir.Interval(ve, lo_param=pi, hi_param=pi)
+            return ir.FNot(node) if p.type == PredicateType.NOT_EQ else node
+        if p.type == PredicateType.RANGE:
+            lo = None if p.lower is None else self.param(_num(p.lower))
+            hi = None if p.upper is None else self.param(_num(p.upper))
+            return ir.Interval(ve, lo_param=lo, hi_param=hi,
+                               lo_inclusive=p.lower_inclusive, hi_inclusive=p.upper_inclusive)
+        if p.type in (PredicateType.IN, PredicateType.NOT_IN):
+            vals = np.asarray([_num(v) for v in p.values])
+            node = ir.Isin(ve, self.param(vals))
+            return ir.FNot(node) if p.type == PredicateType.NOT_IN else node
+        raise UnsupportedQueryError(f"predicate {p.type} on raw column not lowered")
+
+    # -- top-level plan ----------------------------------------------------
+    def plan(self) -> SegmentPlan:
+        q = self.query
+        filt = self.lower_filter(q.filter)
+
+        if q.is_aggregation_query or q.distinct or q.is_group_by:
+            group_dims: list[GroupDim] = []
+            group_exprs = list(q.group_by_expressions)
+            if q.distinct and not q.is_aggregation_query:
+                group_exprs = [e for e in q.select_expressions]
+            group_slots = []
+            cards = []
+            for ge in group_exprs:
+                info = self.dict_info(ge)
+                if info is None:
+                    raise UnsupportedQueryError(f"group-by on non-dict expression {ge}")
+                m = self._meta(ge.identifier)
+                if not m.single_value:
+                    raise UnsupportedQueryError("group-by on MV column needs host path")
+                slot, card, d = info
+                group_slots.append(slot)
+                cards.append(card)
+                group_dims.append(GroupDim(ge.identifier, card, d))
+            num_groups = 1
+            for c in cards:
+                num_groups *= c
+            if num_groups > DENSE_GROUP_LIMIT:
+                raise UnsupportedQueryError(
+                    f"group cardinality product {num_groups} exceeds dense limit")
+            # row-major strides (reference DictionaryBasedGroupKeyGenerator:119-137)
+            strides = [1] * len(cards)
+            for i in range(len(cards) - 2, -1, -1):
+                strides[i] = strides[i + 1] * cards[i + 1]
+
+            lowered = [lower_aggregation(self, a) for a in q.aggregations]
+            for op in self.ops:
+                # distinct_bitmap materializes a (num_groups, card) occupancy
+                # matrix and addresses it with int32 — bound the product
+                if op.kind == "distinct_bitmap" and num_groups * op.card > DENSE_GROUP_LIMIT:
+                    raise UnsupportedQueryError(
+                        f"distinct occupancy {num_groups}x{op.card} exceeds dense limit")
+            program = ir.Program(
+                mode="group_by" if group_exprs else "aggregation",
+                filter=filt,
+                aggs=tuple(self.ops),
+                group_slots=tuple(group_slots),
+                group_strides=tuple(strides),
+                num_groups=num_groups,
+            )
+            return SegmentPlan(program, self._slots, self._params, lowered, group_dims)
+
+        # selection: kernel computes the mask; host materializes rows
+        sel_cols = []
+        for e in q.select_expressions:
+            if e.is_identifier:
+                if e.identifier == "*":
+                    sel_cols.extend(self.segment.columns())
+                else:
+                    self._meta(e.identifier)
+                    sel_cols.append(e.identifier)
+            else:
+                raise UnsupportedQueryError("selection transforms need host path")
+        program = ir.Program(mode="selection", filter=filt)
+        return SegmentPlan(program, self._slots, self._params, selection_columns=sel_cols)
+
+
+_BIN_FN = {
+    "plus": "add", "minus": "sub", "times": "mul", "divide": "div", "mod": "mod",
+    "pow": "pow", "power": "pow",
+    "equals": "eq", "notequals": "ne", "lessthan": "lt", "lessthanorequal": "le",
+    "greaterthan": "gt", "greaterthanorequal": "ge",
+    "and": "and", "or": "or", "least": "min", "greatest": "max",
+}
+
+_UN_FN = {
+    "neg": "neg", "abs": "abs", "not": "not", "exp": "exp", "ln": "ln",
+    "log10": "log10", "log2": "log2", "sqrt": "sqrt", "ceiling": "ceil",
+    "ceil": "ceil", "floor": "floor", "sign": "sign",
+}
+
+
+def _num(v):
+    if isinstance(v, bool):
+        return np.int64(int(v))
+    if isinstance(v, int):
+        return np.int64(v)
+    if isinstance(v, float):
+        return np.float64(v)
+    raise UnsupportedQueryError(f"non-numeric literal {v!r} on raw column")
+
+
+def like_to_regex(pattern: str):
+    """SQL LIKE → compiled regex (reference RegexpPatternConverterUtils:
+    % → .*, _ → ., everything else escaped)."""
+    out = []
+    for ch in str(pattern):
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$")

@@ -1,0 +1,125 @@
+"""In-process query executor: tables of segments → BrokerResponse.
+
+The round-1 equivalent of the reference's in-process test harness topology
+(BaseQueriesTest.getBrokerResponse, pinot-core/src/test/.../BaseQueriesTest.java:126-207
+— plan maker → per-segment operators → combine → broker reduce, no
+networking). The cluster layer (broker/server processes over gRPC) builds on
+exactly these pieces.
+
+Per segment, the TPU path is tried first; UnsupportedQueryError falls back to
+the host engine — mirroring BASELINE.json's "CPU path remains the default"
+backend selection, inverted: TPU is the default here, host is the safety net.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..query.context import QueryContext
+from ..query.parser.sql import SqlParseError, parse_sql
+from ..segment.loader import ImmutableSegment
+from ..spi.data_types import Schema
+from .aggregation import UnsupportedQueryError, get_semantics
+from .combine import combine_aggregation, combine_group_by, combine_selection
+from .executor import TpuSegmentExecutor
+from .host_executor import HostSegmentExecutor
+from .reduce import BrokerReducer
+from .results import (
+    AggIntermediate,
+    BrokerResponse,
+    GroupByIntermediate,
+    SelectionIntermediate,
+)
+
+
+@dataclass
+class Table:
+    name: str
+    schema: Schema
+    segments: list[ImmutableSegment] = field(default_factory=list)
+
+
+class QueryExecutor:
+    """Executes SQL over registered tables. backend: "tpu" | "host" | "auto"
+    (auto = tpu with host fallback per query shape)."""
+
+    def __init__(self, backend: str = "auto"):
+        self.backend = backend
+        self.tables: dict[str, Table] = {}
+        self.tpu = TpuSegmentExecutor()
+        self.host = HostSegmentExecutor()
+
+    def add_table(self, schema: Schema, segments: list[ImmutableSegment], name: Optional[str] = None):
+        self.tables[name or schema.schema_name] = Table(name or schema.schema_name, schema, list(segments))
+
+    def execute_sql(self, sql: str) -> BrokerResponse:
+        try:
+            query = parse_sql(sql)
+        except SqlParseError as e:
+            return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
+        return self.execute(query)
+
+    def execute(self, query: QueryContext) -> BrokerResponse:
+        t0 = time.perf_counter()
+        table = self.tables.get(query.table_name)
+        if table is None:
+            # tolerate _OFFLINE/_REALTIME suffixes (reference table name with type)
+            base = query.table_name.rsplit("_", 1)[0]
+            table = self.tables.get(base)
+        if table is None:
+            return BrokerResponse(exceptions=[f"table {query.table_name} not found"])
+
+        intermediates = []
+        total_docs = 0
+        try:
+            for segment in table.segments:
+                total_docs += segment.num_docs
+                intermediates.append(self._execute_segment(query, segment))
+
+            combined = self._combine(query, intermediates)
+            reducer = BrokerReducer(table.schema)
+            result = reducer.reduce(query, combined)
+        except Exception as e:  # clean broker-style error (reference QueryException)
+            return BrokerResponse(
+                exceptions=[f"{type(e).__name__}: {e}"],
+                total_docs=total_docs,
+                num_segments_queried=len(table.segments),
+                time_used_ms=(time.perf_counter() - t0) * 1000,
+            )
+        resp = BrokerResponse(
+            result_table=result,
+            num_docs_scanned=getattr(combined, "num_docs_scanned", 0),
+            total_docs=total_docs,
+            num_segments_queried=len(table.segments),
+            num_segments_processed=len(table.segments),
+            time_used_ms=(time.perf_counter() - t0) * 1000,
+        )
+        return resp
+
+    def _execute_segment(self, query: QueryContext, segment: ImmutableSegment):
+        if self.backend == "host":
+            return self.host.execute(query, segment)
+        if self.backend == "tpu":
+            return self.tpu.execute(query, segment)
+        try:
+            return self.tpu.execute(query, segment)
+        except UnsupportedQueryError:
+            return self.host.execute(query, segment)
+
+    def _combine(self, query: QueryContext, intermediates):
+        semantics = [get_semantics(a.function.name) for a in query.aggregations]
+        first = intermediates[0] if intermediates else None
+        if isinstance(first, GroupByIntermediate):
+            return combine_group_by(intermediates, semantics)
+        if isinstance(first, AggIntermediate):
+            return combine_aggregation(intermediates, semantics)
+        if isinstance(first, SelectionIntermediate):
+            return combine_selection(intermediates)
+        # no segments: shape an empty intermediate from the query
+        if query.is_aggregation_query and not query.is_group_by and not query.distinct:
+            return AggIntermediate([])
+        if query.is_group_by or query.distinct or query.is_aggregation_query:
+            return GroupByIntermediate({})
+        return SelectionIntermediate([e.identifier for e in query.select_expressions if e.is_identifier], [])

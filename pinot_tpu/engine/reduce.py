@@ -1,0 +1,281 @@
+"""Broker-side reduce: combined intermediates → final ResultTable.
+
+Reference: pinot-core/.../query/reduce/BrokerReduceService.java:61 and the
+per-shape reducers (GroupByDataTableReducer handles HAVING, post-aggregation,
+ORDER BY, trim). Post-aggregation expressions (e.g. SUM(a)/COUNT(b)) are
+evaluated on host over finalized aggregation values, exactly like the
+reference's PostAggregationHandler.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..query.context import QueryContext
+from ..query.expressions import ExpressionContext, is_aggregation
+from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
+from ..spi.data_types import DataType, Schema
+from .aggregation import UnsupportedQueryError, get_semantics
+from .plan import like_to_regex
+from .results import (
+    AggIntermediate,
+    BrokerResponse,
+    DataSchema,
+    GroupByIntermediate,
+    ResultTable,
+    SelectionIntermediate,
+)
+
+
+class BrokerReducer:
+    def __init__(self, schema: Optional[Schema] = None):
+        self.schema = schema
+
+    # -- entry -------------------------------------------------------------
+    def reduce(self, query: QueryContext, combined) -> ResultTable:
+        if isinstance(combined, GroupByIntermediate):
+            return self._reduce_group_by(query, combined)
+        if isinstance(combined, AggIntermediate):
+            return self._reduce_aggregation(query, combined)
+        if isinstance(combined, SelectionIntermediate):
+            return self._reduce_selection(query, combined)
+        raise TypeError(type(combined))
+
+    # -- group by ----------------------------------------------------------
+    def _reduce_group_by(self, query: QueryContext, combined: GroupByIntermediate) -> ResultTable:
+        group_exprs = list(query.group_by_expressions)
+        if query.distinct and not query.is_aggregation_query:
+            group_exprs = list(query.select_expressions)
+        agg_exprs = query.aggregations
+        semantics = [get_semantics(a.function.name) for a in agg_exprs]
+
+        # env rows: expression-string → value (+ select aliases, so ORDER BY
+        # and HAVING can reference them like the reference's alias handling)
+        env_rows = []
+        for key, states in combined.groups.items():
+            env = {}
+            for ge, kv in zip(group_exprs, key):
+                env[str(ge)] = kv
+            for ae, sem, st in zip(agg_exprs, semantics, states):
+                env[str(ae)] = sem.finalize(st)
+            for se, alias in zip(query.select_expressions, query.aliases):
+                if alias:
+                    env[alias] = _eval_post(se, env)
+            env_rows.append(env)
+
+        if query.having_filter is not None:
+            env_rows = [e for e in env_rows if _eval_having(query.having_filter, e)]
+
+        # ORDER BY
+        if query.order_by_expressions:
+            for ob in reversed(query.order_by_expressions):
+                env_rows.sort(
+                    key=lambda env, _ob=ob: _sort_key(_eval_post(_ob.expression, env)),
+                    reverse=not ob.ascending,
+                )
+        rows = []
+        names, types = self._select_schema(query, group_exprs)
+        for env in env_rows[query.offset : query.offset + query.limit]:
+            rows.append([_round_type(_eval_post(e, env), t)
+                         for e, t in zip(query.select_expressions, types)])
+        return ResultTable(DataSchema(names, types), rows)
+
+    def _reduce_aggregation(self, query: QueryContext, combined: AggIntermediate) -> ResultTable:
+        agg_exprs = query.aggregations
+        semantics = [get_semantics(a.function.name) for a in agg_exprs]
+        env = {}
+        if combined.states:
+            for ae, sem, st in zip(agg_exprs, semantics, combined.states):
+                env[str(ae)] = sem.finalize(st)
+        else:  # no segments at all: per-function empty results
+            for ae, sem in zip(agg_exprs, semantics):
+                env[str(ae)] = sem.empty_value
+        names, types = self._select_schema(query, [])
+        row = [_round_type(_eval_post(e, env), t) for e, t in zip(query.select_expressions, types)]
+        return ResultTable(DataSchema(names, types), [row])
+
+    def _reduce_selection(self, query: QueryContext, combined: SelectionIntermediate) -> ResultTable:
+        rows = combined.rows
+        if query.order_by_expressions:
+            idx = {c: i for i, c in enumerate(combined.columns)}
+            rows = list(rows)
+            for ob in reversed(query.order_by_expressions):
+                ci = idx[ob.expression.identifier]
+                rows.sort(key=lambda r, _ci=ci: _sort_key(r[_ci]), reverse=not ob.ascending)
+        rows = [list(r) for r in rows[query.offset : query.offset + query.limit]]
+        types = [self._column_type(c) for c in combined.columns]
+        return ResultTable(DataSchema(list(combined.columns), types), rows)
+
+    # -- schema ------------------------------------------------------------
+    def _select_schema(self, query: QueryContext, group_exprs):
+        names, types = [], []
+        group_set = {str(e) for e in group_exprs}
+        for e, alias in zip(query.select_expressions, query.aliases):
+            names.append(alias or str(e))
+            types.append(self._expr_type(e, group_set))
+        return names, types
+
+    def _expr_type(self, e: ExpressionContext, group_set) -> str:
+        if is_aggregation(e):
+            return get_semantics(e.function.name).result_type
+        if e.is_identifier:
+            return self._column_type(e.identifier)
+        if e.is_literal:
+            v = e.literal
+            if isinstance(v, bool):
+                return "BOOLEAN"
+            if isinstance(v, int):
+                return "LONG"
+            if isinstance(v, float):
+                return "DOUBLE"
+            return "STRING"
+        return "DOUBLE"  # post-aggregation arithmetic
+
+    def _column_type(self, column: str) -> str:
+        if self.schema is not None and self.schema.has_column(column):
+            return self.schema.field_spec(column).data_type.value
+        return "STRING"
+
+
+# -- post-aggregation expression eval (host scalars) -------------------------
+
+
+def _eval_post(e: ExpressionContext, env: dict):
+    key = str(e)
+    if key in env:
+        return env[key]
+    if e.is_literal:
+        return e.literal
+    if e.is_identifier:
+        if e.identifier in env:
+            return env[e.identifier]
+        raise UnsupportedQueryError(f"column {e.identifier} not in group-by result")
+    fn = e.function
+    name, args = fn.name, fn.arguments
+    a = [_eval_post(x, env) for x in args]
+    if name == "plus":
+        return a[0] + a[1]
+    if name == "minus":
+        return a[0] - a[1]
+    if name == "times":
+        return a[0] * a[1]
+    if name == "divide":
+        return a[0] / a[1] if a[1] else math.nan
+    if name == "mod":
+        return a[0] % a[1]
+    if name in ("pow", "power"):
+        return a[0] ** a[1]
+    if name == "abs":
+        return abs(a[0])
+    if name == "neg":
+        return -a[0]
+    if name == "sqrt":
+        return math.sqrt(a[0])
+    if name == "ln":
+        return math.log(a[0])
+    if name == "log10":
+        return math.log10(a[0])
+    if name == "exp":
+        return math.exp(a[0])
+    if name in ("ceil", "ceiling"):
+        return math.ceil(a[0])
+    if name == "floor":
+        return math.floor(a[0])
+    if name == "cast":
+        to = str(args[1].literal).upper()
+        v = a[0]
+        if to in ("INT", "LONG"):
+            return int(v)
+        if to in ("FLOAT", "DOUBLE"):
+            return float(v)
+        if to == "STRING":
+            return str(v)
+        if to == "BOOLEAN":
+            return bool(v)
+        return v
+    if name == "equals":
+        return a[0] == a[1]
+    if name == "notequals":
+        return a[0] != a[1]
+    if name == "greaterthan":
+        return a[0] > a[1]
+    if name == "greaterthanorequal":
+        return a[0] >= a[1]
+    if name == "lessthan":
+        return a[0] < a[1]
+    if name == "lessthanorequal":
+        return a[0] <= a[1]
+    if name == "and":
+        return bool(a[0]) and bool(a[1])
+    if name == "or":
+        return bool(a[0]) or bool(a[1])
+    if name == "not":
+        return not a[0]
+    if name == "case":
+        for i in range(0, len(a) - 1, 2):
+            if a[i]:
+                return a[i + 1]
+        return a[-1]
+    raise UnsupportedQueryError(f"post-aggregation function {name}")
+
+
+def _eval_having(f: FilterContext, env: dict) -> bool:
+    if f.type == FilterNodeType.AND:
+        return all(_eval_having(c, env) for c in f.children)
+    if f.type == FilterNodeType.OR:
+        return any(_eval_having(c, env) for c in f.children)
+    if f.type == FilterNodeType.NOT:
+        return not _eval_having(f.children[0], env)
+    if f.type == FilterNodeType.CONSTANT:
+        return f.constant_value
+    p: Predicate = f.predicate
+    v = _eval_post(p.lhs, env)
+    if p.type == PredicateType.EQ:
+        return v == p.values[0]
+    if p.type == PredicateType.NOT_EQ:
+        return v != p.values[0]
+    if p.type == PredicateType.IN:
+        return v in p.values
+    if p.type == PredicateType.NOT_IN:
+        return v not in p.values
+    if p.type == PredicateType.RANGE:
+        ok = True
+        if p.lower is not None:
+            ok = ok and ((v >= p.lower) if p.lower_inclusive else (v > p.lower))
+        if p.upper is not None:
+            ok = ok and ((v <= p.upper) if p.upper_inclusive else (v < p.upper))
+        return ok
+    if p.type == PredicateType.LIKE:
+        return like_to_regex(p.values[0]).search(str(v)) is not None
+    raise UnsupportedQueryError(f"HAVING predicate {p.type}")
+
+
+def _sort_key(v):
+    # mixed-type safe ordering: None/NaN last-ish, bools as ints
+    if v is None:
+        return (2, 0)
+    if isinstance(v, float) and math.isnan(v):
+        return (1, 0)
+    if isinstance(v, bool):
+        return (0, int(v))
+    return (0, v)
+
+
+def _round_type(v, t: str):
+    """Coerce finalized values to the declared result type (reference
+    ColumnDataType.convert)."""
+    if v is None:
+        return None
+    try:
+        if t == "LONG" or t == "INT" or t == "TIMESTAMP":
+            if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+                return v
+            return int(v)
+        if t == "DOUBLE" or t == "FLOAT":
+            return float(v)
+        if t == "BOOLEAN":
+            return bool(v)
+    except (TypeError, ValueError):
+        return v
+    return v

@@ -1,0 +1,81 @@
+"""Intermediate + final result containers.
+
+Reference analogues: per-segment IntermediateResultsBlock, per-server
+DataTable (pinot-common/.../datatable/DataTableImplV4.java:82), broker
+ResultTable. Intermediates here are host-side (keys are group VALUES, not
+dict ids — dict ids are segment-local, exactly why the reference's
+IndexedTable keys on Record values too).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class DataSchema:
+    column_names: list[str]
+    column_types: list[str]  # INT/LONG/FLOAT/DOUBLE/BOOLEAN/STRING/BYTES/TIMESTAMP
+
+    def to_json(self) -> dict:
+        return {"columnNames": self.column_names, "columnDataTypes": self.column_types}
+
+
+@dataclass
+class ResultTable:
+    schema: DataSchema
+    rows: list[list]
+
+    def to_json(self) -> dict:
+        return {"dataSchema": self.schema.to_json(), "rows": self.rows}
+
+
+@dataclass
+class BrokerResponse:
+    """Final response shape (reference BrokerResponseNative)."""
+
+    result_table: Optional[ResultTable] = None
+    num_docs_scanned: int = 0
+    total_docs: int = 0
+    num_segments_queried: int = 0
+    num_segments_processed: int = 0
+    num_segments_pruned: int = 0
+    time_used_ms: float = 0.0
+    exceptions: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "resultTable": self.result_table.to_json() if self.result_table else None,
+            "numDocsScanned": self.num_docs_scanned,
+            "totalDocs": self.total_docs,
+            "numSegmentsQueried": self.num_segments_queried,
+            "numSegmentsProcessed": self.num_segments_processed,
+            "numSegmentsPrunedByServer": self.num_segments_pruned,
+            "timeUsedMs": self.time_used_ms,
+            "exceptions": self.exceptions,
+        }
+
+
+# -- per-segment intermediates ----------------------------------------------
+
+
+@dataclass
+class GroupByIntermediate:
+    """group key tuple (values) → list of per-agg states."""
+
+    groups: dict[tuple, list]
+    num_docs_scanned: int = 0
+
+
+@dataclass
+class AggIntermediate:
+    states: list  # one state per aggregation
+    num_docs_scanned: int = 0
+
+
+@dataclass
+class SelectionIntermediate:
+    columns: list[str]
+    rows: list[tuple]
+    num_docs_scanned: int = 0

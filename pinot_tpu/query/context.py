@@ -13,12 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from .expressions import (
-    ExpressionContext,
-    contains_aggregation,
-    extract_aggregations,
-    is_aggregation,
-)
+from .expressions import ExpressionContext, extract_aggregations
 from .filter import FilterContext
 
 

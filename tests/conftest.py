@@ -7,10 +7,17 @@ driver dry-runs the multichip path. Must set env vars BEFORE jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the shell env pins JAX_PLATFORMS=axon (the real-TPU tunnel);
+# tests must run on the virtual CPU mesh. The axon plugin ignores the env var,
+# so set the jax config flag too (authoritative).
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
