@@ -1,0 +1,150 @@
+"""Benchmark: SSB-style filter + group-by on one chip.
+
+Reproduces BASELINE.json configs #2/#3 (SSB 100M rows, 1 segment): Q1.1-style
+range-filter + SUM, and Q2-style dictionary filter + GROUP BY 2 dims. The CPU
+baseline is this repo's host (numpy) engine — the reference publishes no
+absolute numbers (BASELINE.md), so the ratio is measured against the
+vectorized CPU path on this machine, per BASELINE.md's instruction to
+generate our own CPU reference numbers.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": rows/sec/chip, "unit": "rows/s", "vs_baseline": x}
+
+Env knobs: BENCH_ROWS (default 100M), BENCH_ITERS (default 10).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 100_000_000))
+ITERS = int(os.environ.get("BENCH_ITERS", 10))
+CACHE_DIR = Path(__file__).parent / ".bench_cache" / f"ssb_{ROWS}"
+
+Q1 = ("SELECT SUM(lo_extendedprice) FROM ssb WHERE d_year = 1993 "
+      "AND lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25")
+Q2 = ("SELECT d_year, p_brand, SUM(lo_revenue) FROM ssb "
+      "WHERE s_region = 'ASIA' GROUP BY d_year, p_brand LIMIT 10000")
+
+
+def build_segment():
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi.data_types import Schema
+    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+    rng = np.random.default_rng(2024)
+    print(f"[bench] generating {ROWS:,} rows", file=sys.stderr)
+    cols = {
+        "d_year": rng.integers(1992, 1999, ROWS).astype(np.int32),
+        "p_brand": (rng.integers(0, 1000, ROWS)).astype(np.int32),
+        "s_region": np.asarray(["AMERICA", "ASIA", "EUROPE", "AFRICA", "MIDDLE EAST"],
+                               dtype=object)[rng.integers(0, 5, ROWS)],
+        "lo_discount": rng.integers(0, 11, ROWS).astype(np.int32),
+        "lo_quantity": rng.integers(1, 51, ROWS).astype(np.int32),
+        "lo_extendedprice": rng.integers(1, 55_001, ROWS).astype(np.int32),
+        "lo_revenue": rng.integers(1, 600_000, ROWS).astype(np.int32),
+    }
+    schema = Schema.build(
+        "ssb",
+        dimensions=[("d_year", "INT"), ("p_brand", "INT"), ("s_region", "STRING"),
+                    ("lo_discount", "INT"), ("lo_quantity", "INT")],
+        metrics=[("lo_extendedprice", "INT"), ("lo_revenue", "INT")],
+    )
+    cfg = TableConfig(table_name="ssb", indexing=IndexingConfig(
+        no_dictionary_columns=["lo_extendedprice", "lo_revenue"]))
+    print("[bench] building segment", file=sys.stderr)
+    t0 = time.perf_counter()
+    SegmentBuilder(schema, cfg, "ssb_0").build(cols, CACHE_DIR)
+    print(f"[bench] built in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+    return schema
+
+
+def main():
+    if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local runs; axon default
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.spi.data_types import Schema
+
+    if not (CACHE_DIR / "metadata.json").exists():
+        schema = build_segment()
+    else:
+        print("[bench] using cached segment", file=sys.stderr)
+        schema = None
+    segment = load_segment(CACHE_DIR)
+    if schema is None:
+        schema = Schema.build(
+            "ssb",
+            dimensions=[("d_year", "INT"), ("p_brand", "INT"), ("s_region", "STRING"),
+                        ("lo_discount", "INT"), ("lo_quantity", "INT")],
+            metrics=[("lo_extendedprice", "INT"), ("lo_revenue", "INT")],
+        )
+
+    import jax
+    print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
+
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, [segment])
+    host = QueryExecutor(backend="host")
+    host.add_table(schema, [segment])
+
+    results = {}
+    for name, sql in [("q1_filter_sum", Q1), ("q2_groupby", Q2)]:
+        # warmup / compile (also pushes planes to HBM once)
+        r = tpu.execute_sql(sql)
+        if r.exceptions:
+            raise RuntimeError(f"{name}: {r.exceptions}")
+        times = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            r = tpu.execute_sql(sql)
+            times.append(time.perf_counter() - t0)
+        p50 = float(np.median(times))
+        t0 = time.perf_counter()
+        rh = host.execute_sql(sql)
+        host_s = time.perf_counter() - t0
+        if rh.exceptions:
+            raise RuntimeError(f"host {name}: {rh.exceptions}")
+        assert r.result_table.rows is not None
+        match = _rows_match(r.result_table.rows, rh.result_table.rows)
+        results[name] = {
+            "tpu_p50_s": p50,
+            "rows_per_sec": ROWS / p50,
+            "host_s": host_s,
+            "speedup": host_s / p50,
+            "match": match,
+        }
+        print(f"[bench] {name}: p50 {p50*1000:.1f}ms "
+              f"({ROWS/p50/1e9:.2f}B rows/s), host {host_s*1000:.0f}ms, "
+              f"speedup {host_s/p50:.1f}x, match={match}", file=sys.stderr)
+
+    q2 = results["q2_groupby"]
+    print(json.dumps({
+        "metric": "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip",
+        "value": round(q2["rows_per_sec"]),
+        "unit": "rows/s",
+        "vs_baseline": round(q2["speedup"], 2),
+        "detail": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                       for kk, vv in v.items()} for k, v in results.items()},
+        "rows": ROWS,
+    }))
+
+
+def _rows_match(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    sa = sorted(map(repr, a))
+    sb = sorted(map(repr, b))
+    return sa == sb
+
+
+if __name__ == "__main__":
+    main()

@@ -100,7 +100,8 @@ def get_semantics(name: str) -> AggSemantics:
     if name in ("stddevpop", "stddevsamp", "varpop", "varsamp"):
         return AggSemantics(_merge3, _var_finalize(name), "DOUBLE", math.nan)
     if name == "booland":
-        return AggSemantics(lambda a, b: a and b, bool, "BOOLEAN", False)
+        # empty state is the AND identity (True) on both engines
+        return AggSemantics(lambda a, b: a and b, bool, "BOOLEAN", True)
     if name in ("boolor", "boolagg"):
         return AggSemantics(lambda a, b: a or b, bool, "BOOLEAN", False)
     raise UnsupportedQueryError(f"aggregation {name} not implemented")
@@ -129,7 +130,7 @@ class AggPlanContext:
     def value_expr(self, e: ExpressionContext) -> ir.ValueExpr:  # pragma: no cover
         raise NotImplementedError
 
-    def dict_info(self, e: ExpressionContext):  # pragma: no cover
+    def dict_info(self, e: ExpressionContext, sv_only: bool = False):  # pragma: no cover
         raise NotImplementedError
 
 
@@ -158,9 +159,10 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
 
     if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
                 "distinctsum", "distinctavg"):
-        info = ctx.dict_info(args[0])
+        info = ctx.dict_info(args[0], sv_only=True)
         if info is None:
-            raise UnsupportedQueryError(f"distinct aggregation needs a dict-encoded column: {args[0]}")
+            raise UnsupportedQueryError(
+                f"distinct aggregation needs a dict-encoded SV column: {args[0]}")
         ids_slot, card, dictionary = info
         i = ctx.add_op(ir.AggOp("distinct_bitmap", ids_slot=ids_slot, card=card))
         numeric = name in ("distinctsum", "distinctavg")

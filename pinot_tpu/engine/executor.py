@@ -20,6 +20,7 @@ from ..segment.loader import ImmutableSegment
 from .aggregation import UnsupportedQueryError
 from .plan import SegmentPlan, SegmentPlanner
 from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
+from .selection import selection_from_mask
 
 
 class TpuSegmentExecutor:
@@ -67,25 +68,8 @@ class TpuSegmentExecutor:
         return GroupByIntermediate(groups, num_docs_scanned=int(counts.sum()))
 
     def _selection_result(self, query, segment, plan, mask) -> SelectionIntermediate:
-        mask = mask[: segment.num_docs]
-        doc_ids = np.nonzero(mask)[0]
-        total = int(doc_ids.shape[0])
-        cap = query.offset + query.limit
-        if not query.order_by_expressions:
-            doc_ids = doc_ids[:cap]
-        cols = [segment.get_values(c)[doc_ids] for c in plan.selection_columns]
-        rows = list(zip(*[c.tolist() for c in cols])) if cols else []
-        if query.order_by_expressions:
-            idx = {c: i for i, c in enumerate(plan.selection_columns)}
-            sort_keys = []
-            for ob in reversed(query.order_by_expressions):
-                if not ob.expression.is_identifier or ob.expression.identifier not in idx:
-                    raise UnsupportedQueryError("selection ORDER BY must reference selected columns")
-                sort_keys.append((idx[ob.expression.identifier], ob.ascending))
-            for col_i, asc in sort_keys:
-                rows.sort(key=lambda r: r[col_i], reverse=not asc)
-            rows = rows[:cap]
-        return SelectionIntermediate(plan.selection_columns, rows, num_docs_scanned=total)
+        return selection_from_mask(query, segment, plan.selection_columns,
+                                   np.asarray(mask[: segment.num_docs]))
 
 
 def _to_python(v):

@@ -22,6 +22,7 @@ from ..segment.loader import ImmutableSegment
 from .aggregation import UnsupportedQueryError, host_state
 from .plan import like_to_regex
 from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
+from .selection import selection_from_mask
 
 
 class HostSegmentExecutor:
@@ -169,7 +170,15 @@ class HostSegmentExecutor:
         args = agg.function.arguments
         if name == "count":
             return int(mask.sum())
-        vals = self.eval_value(args[0], segment)
+        arg = args[0] if args else None
+        if (arg is not None and arg.is_identifier and segment.has_column(arg.identifier)
+                and not segment.column_metadata(arg.identifier).single_value):
+            # MV argument: aggregate over ALL values of the selected rows
+            # (reference *MV aggregation functions)
+            mv_rows = segment.get_mv_values(arg.identifier)
+            flat = [v for i in np.nonzero(mask)[0] for v in mv_rows[i]]
+            return host_state(name, np.asarray(flat))
+        vals = self.eval_value(arg, segment)
         return host_state(name, np.asarray(vals)[mask])
 
     def _group_by(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
@@ -219,21 +228,7 @@ class HostSegmentExecutor:
                     cols.append(e.identifier)
             else:
                 raise UnsupportedQueryError("selection transforms unsupported")
-        doc_ids = np.nonzero(mask)[0]
-        total = len(doc_ids)
-        cap = query.offset + query.limit
-        if not query.order_by_expressions:
-            doc_ids = doc_ids[:cap]
-        data = [segment.get_values(c)[doc_ids] for c in cols]
-        rows = list(zip(*[c.tolist() for c in data])) if data else []
-        if query.order_by_expressions:
-            idx = {c: i for i, c in enumerate(cols)}
-            for ob in reversed(query.order_by_expressions):
-                if not ob.expression.is_identifier or ob.expression.identifier not in idx:
-                    raise UnsupportedQueryError("selection ORDER BY must reference selected columns")
-                rows.sort(key=lambda r: r[idx[ob.expression.identifier]], reverse=not ob.ascending)
-            rows = rows[:cap]
-        return SelectionIntermediate(cols, rows, num_docs_scanned=total)
+        return selection_from_mask(query, segment, cols, mask)
 
 
 _NP_BIN = {

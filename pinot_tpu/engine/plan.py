@@ -94,11 +94,13 @@ class SegmentPlanner(AggPlanContext):
             raise UnsupportedQueryError(f"unknown column {column}")
         return self.segment.column_metadata(column)
 
-    def dict_info(self, e: ExpressionContext):
+    def dict_info(self, e: ExpressionContext, sv_only: bool = False):
         if not e.is_identifier or e.identifier == "*":
             return None
         m = self._meta(e.identifier)
         if m.encoding != "DICT":
+            return None
+        if sv_only and not m.single_value:
             return None
         kind = "ids" if m.single_value else "mvids"
         return self.slot(e.identifier, kind), m.cardinality, self.segment.get_dictionary(e.identifier)

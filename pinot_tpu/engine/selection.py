@@ -1,0 +1,36 @@
+"""Shared selection materialization used by BOTH backends.
+
+One implementation so the device and host engines cannot diverge on
+cap/sort/trim semantics (reference: SelectionOperatorService ordering rules).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .aggregation import UnsupportedQueryError
+from .results import SelectionIntermediate
+
+
+def selection_from_mask(query, segment, columns: list[str], mask: np.ndarray) -> SelectionIntermediate:
+    """Materialize selected rows from a boolean doc mask (len == num_docs).
+
+    Without ORDER BY, rows are capped at offset+limit per segment; with
+    ORDER BY, rows sort per segment then trim to offset+limit (a valid
+    per-segment top-k — the broker re-sorts the merged rows)."""
+    doc_ids = np.nonzero(mask)[0]
+    total = int(doc_ids.shape[0])
+    cap = query.offset + query.limit
+    if not query.order_by_expressions:
+        doc_ids = doc_ids[:cap]
+    cols = [segment.get_values(c)[doc_ids] for c in columns]
+    rows = list(zip(*[c.tolist() for c in cols])) if cols else []
+    if query.order_by_expressions:
+        idx = {c: i for i, c in enumerate(columns)}
+        for ob in reversed(query.order_by_expressions):
+            if not ob.expression.is_identifier or ob.expression.identifier not in idx:
+                raise UnsupportedQueryError("selection ORDER BY must reference selected columns")
+            ci = idx[ob.expression.identifier]
+            rows.sort(key=lambda r, _ci=ci: r[_ci], reverse=not ob.ascending)
+        rows = rows[:cap]
+    return SelectionIntermediate(columns, rows, num_docs_scanned=total)

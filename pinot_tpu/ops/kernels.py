@@ -143,7 +143,8 @@ def _eval_filter(node: ir.FilterNode, arrays, params, n: int):
 
 
 @partial(jax.jit, static_argnames=("program", "padded"))
-def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, padded: int):
+def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, padded: int,
+                row_offset=0):
     """Execute a Program over padded column planes. Returns a tuple:
 
     selection   → (mask,)
@@ -151,9 +152,17 @@ def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, pad
     group_by    → (counts[G+1], agg_0[G+1], ...)
 
     `padded` is the bucket row count (static); every SV plane has that length.
+    `row_offset` supports row-sharded multi-device execution (shard_map over a
+    mesh row axis — parallel/mesh.py): each shard sees rows
+    [row_offset, row_offset+padded) of the global segment.
     """
+    return _run_program_impl(program, arrays, params, num_docs, padded, row_offset)
+
+
+def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_docs, padded: int,
+                      row_offset=0):
     n = padded
-    valid = jnp.arange(n, dtype=jnp.int32) < num_docs
+    valid = (jnp.arange(n, dtype=jnp.int32) + row_offset) < num_docs
     if program.filter is not None:
         mask = valid & _eval_filter(program.filter, arrays, params, n)
     else:

@@ -1,0 +1,123 @@
+"""Multi-device execution: row-sharded segments over a jax Mesh.
+
+This is the capability the reference lacks (SURVEY.md §2.10: "the analogue —
+splitting one segment's rows across workers — does not exist in Pinot; the
+segment is the atom"). Here one large segment's column planes shard across
+TPU cores on a mesh row axis; every device runs the same fused kernel on its
+row slice and the per-group partials combine with XLA collectives riding ICI:
+
+    sum/count/sumsq      → psum
+    min / max            → pmin / pmax
+    distinct occupancy   → any() via pmax
+    selection mask       → stays sharded (masks are row-aligned)
+
+A second mesh axis shards *segments* (scatter/gather parallelism, the
+reference's per-server fan-out), giving the dp×sp layout used by
+__graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine import ir
+from ..ops.kernels import _run_program_impl
+
+ROW_AXIS = "sp"  # intra-segment row sharding (sequence-parallel analogue)
+SEGMENT_AXIS = "dp"  # across segments (data-parallel analogue)
+
+
+def make_mesh(n_devices: int | None = None, axes=(ROW_AXIS,)) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    arr = np.array(devices)
+    if len(axes) == 2:
+        # favor more row-shards than segment-shards
+        n = len(devices)
+        seg = 2 if n % 2 == 0 and n > 2 else 1
+        arr = arr.reshape(seg, n // seg)
+    return Mesh(arr, axes)
+
+
+def _combine_collectives(program: ir.Program, outs: tuple, axis: str) -> tuple:
+    """Merge per-shard kernel outputs across the row axis."""
+    merged = [jax.lax.psum(outs[0], axis)]
+    for agg, o in zip(program.aggs, outs[1:]):
+        if agg.kind in ("sum", "sumsq", "count"):
+            merged.append(jax.lax.psum(o, axis))
+        elif agg.kind == "min":
+            merged.append(jax.lax.pmin(o, axis))
+        elif agg.kind == "max":
+            merged.append(jax.lax.pmax(o, axis))
+        elif agg.kind == "distinct_bitmap":
+            merged.append(jax.lax.pmax(o.astype(jnp.int32), axis) > 0)
+        else:  # pragma: no cover
+            raise ValueError(agg.kind)
+    return tuple(merged)
+
+
+def slot_specs(slots) -> tuple:
+    """PartitionSpecs per kernel input slot: row planes shard on ROW_AXIS,
+    dictionaries replicate. Driven by slot KIND, never by shape (a dictionary
+    whose cardinality equals the pad bucket must still replicate)."""
+    return tuple(P() if kind == "dict" else P(ROW_AXIS) for _col, kind in slots)
+
+
+@partial(jax.jit, static_argnames=("program", "padded", "mesh", "kinds"))
+def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_docs,
+                      padded: int, mesh: Mesh, kinds: tuple):
+    n_shards = mesh.shape[ROW_AXIS]
+    local_n = padded // n_shards
+    array_specs = tuple(P() if k == "dict" else P(ROW_AXIS) for k in kinds)
+
+    def shard_fn(arrays_l, params_l, num_docs_l):
+        idx = jax.lax.axis_index(ROW_AXIS)
+        offset = idx.astype(jnp.int32) * jnp.int32(local_n)
+        outs = _run_program_impl(program, arrays_l, params_l, num_docs_l, local_n, offset)
+        if program.mode == "selection":
+            return outs  # masks stay row-sharded
+        return _combine_collectives(program, outs, ROW_AXIS)
+
+    out_specs = P(ROW_AXIS) if program.mode == "selection" else P()
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(array_specs, tuple(P() for _ in params), P()),
+        out_specs=out_specs,
+    )
+    return fn(arrays, params, num_docs)
+
+
+def run_program_row_sharded(program: ir.Program, arrays: tuple, params: tuple,
+                            num_docs, padded: int, mesh: Mesh, slots=None):
+    """Execute one segment's program with rows sharded across mesh[ROW_AXIS].
+
+    `arrays` are global (padded) planes; `padded` must divide evenly by the
+    row-axis size. Group-by/aggregation outputs come back fully combined
+    (every device holds the final table — cheap, tables are small). The jitted
+    executable is cached on (program, padded, mesh, slot kinds) so repeated
+    queries over resident shards skip tracing entirely.
+    """
+    n_shards = mesh.shape[ROW_AXIS]
+    assert padded % n_shards == 0, (padded, n_shards)
+    kinds = tuple(kind for _col, kind in slots) if slots else tuple(
+        "dict" if (a.ndim >= 1 and a.shape[0] != padded) else "ids" for a in arrays)
+    return _row_sharded_call(program, arrays, params, jnp.int32(num_docs), padded, mesh, kinds)
+
+
+def shard_segment_arrays(arrays: tuple, mesh: Mesh, padded: int, slots=None):
+    """Pre-place padded planes with row sharding so repeated queries reuse
+    device-resident shards (the multi-device HBM segment cache)."""
+    if slots is not None:
+        specs = slot_specs(slots)
+    else:
+        specs = tuple(P(ROW_AXIS) if a.ndim >= 1 and a.shape[0] == padded else P()
+                      for a in arrays)
+    return tuple(
+        jax.device_put(a, NamedSharding(mesh, s)) for a, s in zip(arrays, specs)
+    )

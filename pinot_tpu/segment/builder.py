@@ -58,7 +58,9 @@ class SegmentBuilder:
             spec = self.schema.field_spec(name)
             if name not in columns:
                 raise KeyError(f"schema column {name!r} missing from input columns {sorted(columns)}")
-            values = list(columns[name])
+            values = columns[name]
+            if not isinstance(values, np.ndarray):
+                values = list(values)
             if num_docs is None:
                 num_docs = len(values)
             elif len(values) != num_docs:
@@ -90,7 +92,10 @@ class SegmentBuilder:
         writer.write(meta)
         return out_dir
 
-    def _replace_nulls(self, values: list, spec) -> tuple[list, np.ndarray]:
+    def _replace_nulls(self, values, spec) -> tuple[list, np.ndarray]:
+        if isinstance(values, np.ndarray) and values.dtype != object:
+            # numpy fast path: fixed-width arrays cannot hold None
+            return values, np.zeros(len(values), dtype=bool)
         nulls = np.array([v is None for v in values], dtype=bool)
         if nulls.any():
             dv = spec.default_null_value

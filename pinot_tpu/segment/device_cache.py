@@ -11,6 +11,7 @@ scalar.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -129,6 +130,16 @@ class DeviceSegmentCache:
         self._maybe_evict()
         return self._views[key]
 
+    def drop(self, segment: ImmutableSegment) -> None:
+        """Release a retired segment's device planes (call on segment drop —
+        reference: segment replace/delete in BaseTableDataManager)."""
+        key = id(segment)
+        v = self._views.pop(key, None)
+        if v is not None:
+            v.evict()
+        if key in self._order:
+            self._order.remove(key)
+
     def _maybe_evict(self) -> None:
         if self.budget_bytes is None:
             return
@@ -140,4 +151,6 @@ class DeviceSegmentCache:
             del self._views[victim]
 
 
-GLOBAL_DEVICE_CACHE = DeviceSegmentCache()
+# Default budget keeps headroom on a 16GB v5e; override via env.
+_DEFAULT_BUDGET = int(os.environ.get("PINOT_TPU_HBM_BUDGET_BYTES", 12 << 30))
+GLOBAL_DEVICE_CACHE = DeviceSegmentCache(budget_bytes=_DEFAULT_BUDGET)
