@@ -4,26 +4,41 @@ Reference: pinot-core/.../query/aggregation/function/ (93 impls behind
 AggregationFunction.aggregate/aggregateGroupBySV — .../AggregationFunction.java:74-82).
 The TPU design splits each SQL aggregation into:
   1. *primitive device reductions* (AggOp: count/sum/min/max/sumsq/
-     distinct_bitmap) fused into the segment kernel (ops/kernels.py),
+     distinct_bitmap/value_hist/hist_fixed) fused into the segment kernel
+     (ops/kernels.py),
   2. a host-side *intermediate state* per group (analogue of the reference's
      intermediate results shipped in DataTables),
   3. shared `AggSemantics` (merge across segments/servers + finalize at
      broker reduce + result type) used identically by the device path and
      the host (numpy) fallback engine, so the two paths can never drift.
 
-Result types follow the reference: COUNT→LONG, SUM/MIN/MAX/AVG→DOUBLE,
-DISTINCTCOUNT→INT.
+Approximate functions (DISTINCTCOUNTHLL / THETA / PERCENTILETDIGEST / ...)
+use the mergeable sketch states in utils/sketches.py — value-based, so they
+merge across segments whose dictionaries differ.
+
+Result types follow the reference (AggregationFunction.getFinalResultColumnType):
+COUNT→LONG, SUM/MIN/MAX/AVG/PERCENTILE*→DOUBLE, DISTINCTCOUNT→INT,
+DISTINCTCOUNTHLL/THETA→LONG.
 """
 
 from __future__ import annotations
 
 import math
+import re
 from dataclasses import dataclass
+from decimal import Decimal
 from typing import Callable, Optional
 
 import numpy as np
 
-from ..query.expressions import ExpressionContext
+from ..query.expressions import ExpressionContext, FunctionContext
+from ..utils.sketches import (
+    HyperLogLog,
+    SmartDistinctSet,
+    TDigest,
+    ThetaSketch,
+    ValueHist,
+)
 from . import ir
 
 
@@ -55,6 +70,72 @@ class LoweredAgg:
     extract: Callable  # (outs, g) -> state
 
 
+# ---------------------------------------------------------------------------
+# Argument model: leading args are data expressions, the rest are literal
+# parameters (reference: PERCENTILE(col, 95), HISTOGRAM(col, 0, 100, 10),
+# FIRSTWITHTIME(dataCol, timeCol, 'dataType')...).
+# ---------------------------------------------------------------------------
+
+_DATA_ARITY = {
+    "count": 1,
+    "covarpop": 2,
+    "covarsamp": 2,
+    "corr": 2,
+    "exprmin": 2,
+    "exprmax": 2,
+    "firstwithtime": 2,
+    "lastwithtime": 2,
+}
+
+# legacy digit-suffixed percentiles: PERCENTILE95(col) ≡ PERCENTILE(col, 95)
+# (shared pattern — query/expressions.py uses it for is_aggregation too)
+from ..query.expressions import PERCENTILE_SUFFIX_RE as _PCT_SUFFIX  # noqa: E402
+
+
+def canonicalize(name: str, extra: tuple) -> tuple[str, tuple]:
+    m = _PCT_SUFFIX.match(name)
+    if m:
+        base = m.group(1) + (m.group(3) or "")
+        return base, (int(m.group(2)),) + extra
+    return name, extra
+
+
+def split_args(fn: FunctionContext):
+    """→ (data_arg_expressions, literal_extras)."""
+    arity = _DATA_ARITY.get(_PCT_SUFFIX.sub(lambda m: m.group(1), fn.name), 1)
+    data = list(fn.arguments[:arity])
+    extra = []
+    for a in fn.arguments[arity:]:
+        if not a.is_literal:
+            raise UnsupportedQueryError(
+                f"{fn.name}: parameter {a} must be a literal")
+        extra.append(a.literal)
+    return data, tuple(extra)
+
+
+def semantics_for(expr: ExpressionContext) -> AggSemantics:
+    fn = expr.function
+    _, extra = split_args(fn)
+    return get_semantics(fn.name, extra)
+
+
+def _pct(extra, default=50.0) -> float:
+    return float(extra[0]) if extra else default
+
+
+def _merge_maybe(pick):
+    """Merge for states that may be None (empty groups/segments)."""
+
+    def merge(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return pick(a, b)
+
+    return merge
+
+
 def _var_finalize(name: str):
     def fin(state):
         n, s, sq = state
@@ -73,37 +154,131 @@ def _merge3(a, b):
     return (a[0] + b[0], a[1] + b[1], a[2] + b[2])
 
 
-def get_semantics(name: str) -> AggSemantics:
-    if name == "count":
+def _merge_tuple(a, b):
+    return tuple(x + y for x, y in zip(a, b))
+
+
+def _covar_finalize(name: str):
+    def fin(state):
+        n, sx, sy, sxy, sxx, syy = state
+        if n == 0 or (name == "covarsamp" and n < 2):
+            return math.nan
+        cov = sxy / n - (sx / n) * (sy / n)
+        if name == "covarsamp":
+            return cov * n / (n - 1)
+        if name == "corr":
+            vx = sxx / n - (sx / n) ** 2
+            vy = syy / n - (sy / n) ** 2
+            denom = math.sqrt(max(vx, 0.0) * max(vy, 0.0))
+            return cov / denom if denom else math.nan
+        return cov
+
+    return fin
+
+
+def _moments_finalize(name: str):
+    def fin(state):
+        n, s1, s2, s3, s4 = state
+        if n == 0:
+            return math.nan
+        mu = s1 / n
+        m2 = s2 / n - mu * mu
+        if m2 <= 0:
+            return math.nan
+        if name == "skewness":
+            m3 = s3 / n - 3 * mu * s2 / n + 2 * mu**3
+            return m3 / m2**1.5
+        m4 = s4 / n - 4 * mu * s3 / n + 6 * mu * mu * s2 / n - 3 * mu**4
+        return m4 / (m2 * m2) - 3.0
+
+    return fin
+
+
+_EXACT_DISTINCT = (
+    "distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
+    "distinctcountmv", "distinctcountbitmapmv",
+)
+_HLL_FNS = ("distinctcounthll", "distinctcounthllplus", "distinctcountull",
+            "distinctcountcpc", "distinctcounthllmv", "distinctcounthllplusmv")
+_THETA_FNS = ("distinctcounttheta", "distinctcountrawtheta")
+_PCT_EXACT = ("percentile", "percentilemv")
+_PCT_DIGEST = ("percentileest", "percentiletdigest", "percentilekll",
+               "percentilesmarttdigest", "percentileestmv", "percentiletdigestmv",
+               "percentilekllmv", "percentilerawest", "percentilerawtdigest",
+               "percentilerawkll")
+
+
+def get_semantics(name: str, extra: tuple = ()) -> AggSemantics:
+    name, extra = canonicalize(name, extra)
+    if name in ("count", "countmv"):
         return AggSemantics(lambda a, b: a + b, lambda s: s, "LONG", 0)
     if name in ("sum", "summv"):
         return AggSemantics(lambda a, b: a + b, lambda s: s, "DOUBLE", 0.0)
+    if name == "sumprecision":
+        return AggSemantics(lambda a, b: a + b, str, "BIG_DECIMAL", "0")  # Decimal state
     if name in ("min", "minmv"):
         return AggSemantics(min, lambda s: s, "DOUBLE", math.inf)
     if name in ("max", "maxmv"):
         return AggSemantics(max, lambda s: s, "DOUBLE", -math.inf)
-    if name == "minmaxrange":
+    if name in ("minmaxrange", "minmaxrangemv"):
         return AggSemantics(lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
                             lambda s: s[1] - s[0], "DOUBLE", -math.inf)
     if name in ("avg", "avgmv"):
         return AggSemantics(lambda a, b: (a[0] + b[0], a[1] + b[1]),
                             lambda s: (s[0] / s[1]) if s[1] else math.nan,
                             "DOUBLE", math.nan)
-    if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
-                "distinctcountmv"):
+    if name in _EXACT_DISTINCT:
         return AggSemantics(lambda a, b: a | b, len, "INT", 0)
     if name == "distinctsum":
         return AggSemantics(lambda a, b: a | b, lambda s: float(sum(s)), "DOUBLE", 0.0)
     if name == "distinctavg":
         return AggSemantics(lambda a, b: a | b,
                             lambda s: sum(s) / len(s) if s else math.nan, "DOUBLE", math.nan)
+    if name in _HLL_FNS:
+        return AggSemantics(lambda a, b: a.merge(b), lambda s: s.cardinality(), "LONG", 0)
+    if name in _THETA_FNS:
+        return AggSemantics(lambda a, b: a.merge(b), lambda s: s.cardinality(), "LONG", 0)
+    if name in ("distinctcountsmart", "distinctcountsmarthll"):
+        return AggSemantics(lambda a, b: a.merge(b), lambda s: s.cardinality(), "INT", 0)
+    if name in _PCT_EXACT:
+        pct = _pct(extra)
+        return AggSemantics(lambda a, b: a.merge(b),
+                            lambda s, _p=pct: s.percentile(_p), "DOUBLE", math.nan)
+    if name in _PCT_DIGEST:
+        pct = _pct(extra)
+        return AggSemantics(lambda a, b: a.merge(b),
+                            lambda s, _p=pct: s.quantile(_p / 100.0), "DOUBLE", math.nan)
+    if name == "mode":
+        return AggSemantics(lambda a, b: a.merge(b), lambda s: s.mode(), "DOUBLE", math.nan)
+    if name == "histogram":
+        return AggSemantics(lambda a, b: a + b,
+                            lambda s: [float(x) for x in s], "DOUBLE_ARRAY", [])
     if name in ("stddevpop", "stddevsamp", "varpop", "varsamp"):
         return AggSemantics(_merge3, _var_finalize(name), "DOUBLE", math.nan)
+    if name in ("skewness", "kurtosis"):
+        return AggSemantics(_merge_tuple, _moments_finalize(name), "DOUBLE", math.nan)
+    if name in ("covarpop", "covarsamp", "corr"):
+        return AggSemantics(_merge_tuple, _covar_finalize(name), "DOUBLE", math.nan)
     if name == "booland":
         # empty state is the AND identity (True) on both engines
         return AggSemantics(lambda a, b: a and b, bool, "BOOLEAN", True)
     if name in ("boolor", "boolagg"):
         return AggSemantics(lambda a, b: a or b, bool, "BOOLEAN", False)
+    if name in ("exprmin", "firstwithtime"):
+        return AggSemantics(_merge_maybe(lambda a, b: a if a[0] <= b[0] else b),
+                            lambda s: None if s is None else s[1], "OBJECT", None)
+    if name in ("exprmax", "lastwithtime"):
+        return AggSemantics(_merge_maybe(lambda a, b: a if a[0] >= b[0] else b),
+                            lambda s: None if s is None else s[1], "OBJECT", None)
+    if name in ("arrayagg", "listagg"):
+        distinct = len(extra) > 1 and bool(extra[1])
+        dtype = str(extra[0]).upper() if extra else "DOUBLE"
+
+        def fin(s, _d=distinct):
+            vals = list(dict.fromkeys(s)) if _d else list(s)
+            return vals
+
+        return AggSemantics(lambda a, b: a + b, fin, f"{dtype}_ARRAY", [])
     raise UnsupportedQueryError(f"aggregation {name} not implemented")
 
 
@@ -133,38 +308,48 @@ class AggPlanContext:
     def dict_info(self, e: ExpressionContext, sv_only: bool = False):  # pragma: no cover
         raise NotImplementedError
 
+    def col_minmax(self, e: ExpressionContext):  # pragma: no cover
+        raise NotImplementedError
+
+    def param(self, value) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+
+_HIST_BINS = 2048  # fixed-bin device histogram resolution for raw columns
+
+
+def _mul(a: ir.ValueExpr, b: ir.ValueExpr) -> ir.ValueExpr:
+    return ir.Bin("mul", a, b)
+
 
 def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAgg:
     fn = expr.function
-    name, args = fn.name, fn.arguments
+    raw_name, args = fn.name, fn.arguments
     label = str(expr)
-    sem = get_semantics(name)
+    data, extra = split_args(fn)
+    name, extra = canonicalize(raw_name, extra)
+    sem = get_semantics(name, extra)
 
     if name == "count":
         return LoweredAgg(label, sem, lambda outs, g: int(outs[0][g]))
 
     if name in ("sum", "min", "max"):
-        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.value_expr(args[0])))
+        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.value_expr(data[0])))
         return LoweredAgg(label, sem, lambda outs, g: float(outs[i][g]))
 
     if name == "minmaxrange":
-        i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.value_expr(args[0])))
-        i_max = ctx.add_op(ir.AggOp("max", vexpr=ctx.value_expr(args[0])))
+        i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.value_expr(data[0])))
+        i_max = ctx.add_op(ir.AggOp("max", vexpr=ctx.value_expr(data[0])))
         return LoweredAgg(label, sem,
                           lambda outs, g: (float(outs[i_min][g]), float(outs[i_max][g])))
 
     if name == "avg":
-        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(args[0])))
+        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(data[0])))
         return LoweredAgg(label, sem, lambda outs, g: (float(outs[i][g]), int(outs[0][g])))
 
     if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
                 "distinctsum", "distinctavg"):
-        info = ctx.dict_info(args[0], sv_only=True)
-        if info is None:
-            raise UnsupportedQueryError(
-                f"distinct aggregation needs a dict-encoded SV column: {args[0]}")
-        ids_slot, card, dictionary = info
-        i = ctx.add_op(ir.AggOp("distinct_bitmap", ids_slot=ids_slot, card=card))
+        i, dictionary = _occupancy_op(ctx, data[0], name)
         numeric = name in ("distinctsum", "distinctavg")
 
         def extract(outs, g, _i=i, _d=dictionary, _numeric=numeric):
@@ -175,20 +360,147 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
 
         return LoweredAgg(label, sem, extract)
 
+    if name in _HLL_FNS and not name.endswith("mv"):
+        i, dictionary = _occupancy_op(ctx, data[0], name)
+        log2m = int(extra[0]) if extra else 12
+
+        def extract(outs, g, _i=i, _d=dictionary, _m=log2m):
+            sel = _d.values[np.nonzero(outs[_i][g])[0]]
+            return HyperLogLog(_m).add_values(sel)
+
+        return LoweredAgg(label, sem, extract)
+
+    if name in _THETA_FNS:
+        i, dictionary = _occupancy_op(ctx, data[0], name)
+
+        def extract(outs, g, _i=i, _d=dictionary):
+            sel = _d.values[np.nonzero(outs[_i][g])[0]]
+            return ThetaSketch().add_values(sel)
+
+        return LoweredAgg(label, sem, extract)
+
+    if name in ("distinctcountsmart", "distinctcountsmarthll"):
+        i, dictionary = _occupancy_op(ctx, data[0], name)
+
+        def extract(outs, g, _i=i, _d=dictionary):
+            sel = _d.values[np.nonzero(outs[_i][g])[0]]
+            return SmartDistinctSet().add_values(sel)
+
+        return LoweredAgg(label, sem, extract)
+
+    if name in ("percentile", "mode"):
+        i, dictionary = _value_hist_op(ctx, data[0], name)
+        if not _numeric_dictionary(dictionary):
+            raise UnsupportedQueryError(f"{name} requires a numeric column")
+
+        def extract(outs, g, _i=i, _d=dictionary):
+            row = outs[_i][g]
+            nz = np.nonzero(row)[0]
+            return ValueHist.from_arrays(_d.values[nz], row[nz])
+
+        return LoweredAgg(label, sem, extract)
+
+    if name in _PCT_DIGEST and not name.endswith("mv"):
+        info = ctx.dict_info(data[0], sv_only=True)
+        if info is not None and _numeric_dictionary(info[2]):
+            i, dictionary = _value_hist_op(ctx, data[0], name)
+
+            def extract(outs, g, _i=i, _d=dictionary):
+                row = outs[_i][g]
+                nz = np.nonzero(row)[0]
+                return ValueHist.from_arrays(_d.values[nz], row[nz]).to_tdigest()
+
+            return LoweredAgg(label, sem, extract)
+        # raw numeric column: fixed-bin device histogram → weighted t-digest
+        mm = ctx.col_minmax(data[0])
+        if mm is None:
+            raise UnsupportedQueryError(f"{name} needs numeric column stats")
+        lo, hi = float(mm[0]), float(mm[1])
+        if hi <= lo:
+            hi = lo + 1.0
+        i = ctx.add_op(ir.AggOp(
+            "hist_fixed", vexpr=ctx.value_expr(data[0]), bins=_HIST_BINS,
+            lo_param=ctx.param(np.float64(lo)), hi_param=ctx.param(np.float64(hi))))
+        centers = lo + (np.arange(_HIST_BINS) + 0.5) * (hi - lo) / _HIST_BINS
+
+        def extract(outs, g, _i=i, _c=centers):
+            return TDigest().add_weighted(_c, outs[_i][g].astype(np.float64))
+
+        return LoweredAgg(label, sem, extract)
+
+    if name == "histogram":
+        if len(extra) != 3:
+            raise UnsupportedQueryError("histogram(col, lower, upper, numBins)")
+        lo, hi, bins = float(extra[0]), float(extra[1]), int(extra[2])
+        i = ctx.add_op(ir.AggOp(
+            "hist_fixed", vexpr=ctx.value_expr(data[0]), bins=bins,
+            lo_param=ctx.param(np.float64(lo)), hi_param=ctx.param(np.float64(hi))))
+        return LoweredAgg(label, sem,
+                          lambda outs, g: outs[i][g].astype(np.float64))
+
     if name in ("stddevpop", "stddevsamp", "varpop", "varsamp"):
-        i_s = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(args[0])))
-        i_q = ctx.add_op(ir.AggOp("sumsq", vexpr=ctx.value_expr(args[0])))
+        i_s = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(data[0])))
+        i_q = ctx.add_op(ir.AggOp("sumsq", vexpr=ctx.value_expr(data[0])))
         return LoweredAgg(
             label, sem,
             lambda outs, g: (int(outs[0][g]), float(outs[i_s][g]), float(outs[i_q][g])))
 
+    if name in ("skewness", "kurtosis"):
+        # cast before powering: int32 column planes overflow at v**4
+        v = ir.Cast(ctx.value_expr(data[0]), "DOUBLE")
+        i1 = ctx.add_op(ir.AggOp("sum", vexpr=v))
+        i2 = ctx.add_op(ir.AggOp("sumsq", vexpr=v))
+        i3 = ctx.add_op(ir.AggOp("sum", vexpr=_mul(_mul(v, v), v)))
+        i4 = ctx.add_op(ir.AggOp("sum", vexpr=_mul(_mul(v, v), _mul(v, v))))
+        return LoweredAgg(
+            label, sem,
+            lambda outs, g: (int(outs[0][g]), float(outs[i1][g]), float(outs[i2][g]),
+                             float(outs[i3][g]), float(outs[i4][g])))
+
+    if name in ("covarpop", "covarsamp", "corr"):
+        x = ir.Cast(ctx.value_expr(data[0]), "DOUBLE")
+        y = ir.Cast(ctx.value_expr(data[1]), "DOUBLE")
+        ix = ctx.add_op(ir.AggOp("sum", vexpr=x))
+        iy = ctx.add_op(ir.AggOp("sum", vexpr=y))
+        ixy = ctx.add_op(ir.AggOp("sum", vexpr=_mul(x, y)))
+        ixx = ctx.add_op(ir.AggOp("sumsq", vexpr=x))
+        iyy = ctx.add_op(ir.AggOp("sumsq", vexpr=y))
+        return LoweredAgg(
+            label, sem,
+            lambda outs, g: (int(outs[0][g]), float(outs[ix][g]), float(outs[iy][g]),
+                             float(outs[ixy][g]), float(outs[ixx][g]), float(outs[iyy][g])))
+
     if name in ("booland", "boolor", "boolagg"):
         # booleans are 0/1 ints: AND = min (empty→+inf→True), OR = max (empty→-inf→False)
         kind = "min" if name == "booland" else "max"
-        i = ctx.add_op(ir.AggOp(kind, vexpr=ctx.value_expr(args[0])))
+        i = ctx.add_op(ir.AggOp(kind, vexpr=ctx.value_expr(data[0])))
         return LoweredAgg(label, sem, lambda outs, g: bool(outs[i][g] > 0.5))
 
     raise UnsupportedQueryError(f"aggregation {name} not yet lowered to device")
+
+
+def _occupancy_op(ctx: AggPlanContext, arg: ExpressionContext, name: str):
+    info = ctx.dict_info(arg, sv_only=True)
+    if info is None:
+        raise UnsupportedQueryError(
+            f"{name} needs a dict-encoded SV column: {arg}")
+    ids_slot, card, dictionary = info
+    i = ctx.add_op(ir.AggOp("distinct_bitmap", ids_slot=ids_slot, card=card))
+    return i, dictionary
+
+
+def _value_hist_op(ctx: AggPlanContext, arg: ExpressionContext, name: str):
+    info = ctx.dict_info(arg, sv_only=True)
+    if info is None:
+        raise UnsupportedQueryError(
+            f"{name} needs a dict-encoded SV column: {arg}")
+    ids_slot, card, dictionary = info
+    i = ctx.add_op(ir.AggOp("value_hist", ids_slot=ids_slot, card=card))
+    return i, dictionary
+
+
+def _numeric_dictionary(d) -> bool:
+    return np.asarray(d.values).dtype.kind in ("i", "u", "f")
 
 
 # ---------------------------------------------------------------------------
@@ -196,35 +508,96 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
 # ---------------------------------------------------------------------------
 
 
-def host_state(name: str, values: Optional[np.ndarray]):
+def host_state_full(name: str, cols: list, extra: tuple):
     """Per-group intermediate state from the group's (already filtered) raw
-    values. Must produce states mergeable/finalizable by get_semantics(name)
-    — i.e. identical shape to the device path's LoweredAgg.extract."""
+    value arrays — one array per data argument. Must produce states
+    mergeable/finalizable by get_semantics — i.e. identical shape to the
+    device path's LoweredAgg.extract."""
+    name, extra = canonicalize(name, extra)
+    values = cols[0] if cols else None
     n = 0 if values is None else len(values)
-    if name == "count":
+
+    if name in ("count", "countmv"):
         return n
     if values is None:
         raise UnsupportedQueryError(f"{name} requires an argument")
+
     if name in ("sum", "summv"):
         return float(np.sum(values)) if n else 0.0
+    if name == "sumprecision":
+        # exact decimal sum (reference SumPrecisionAggregationFunction's
+        # BigDecimal); column may be stored as strings
+        return sum((Decimal(str(v)) for v in values), Decimal(0))
     if name in ("min", "minmv"):
         return float(np.min(values)) if n else math.inf
     if name in ("max", "maxmv"):
         return float(np.max(values)) if n else -math.inf
-    if name == "minmaxrange":
+    if name in ("minmaxrange", "minmaxrangemv"):
         return (float(np.min(values)), float(np.max(values))) if n else (math.inf, -math.inf)
     if name in ("avg", "avgmv"):
         return (float(np.sum(values)), n)
-    if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
-                "distinctcountmv"):
+    if name in _EXACT_DISTINCT:
         return frozenset(np.unique(values).tolist())
     if name in ("distinctsum", "distinctavg"):
         return frozenset(float(v) for v in np.unique(values))
+    if name in _HLL_FNS:
+        log2m = int(extra[0]) if extra else 12
+        return HyperLogLog(log2m).add_values(np.unique(values))
+    if name in _THETA_FNS:
+        return ThetaSketch().add_values(np.unique(values))
+    if name in ("distinctcountsmart", "distinctcountsmarthll"):
+        return SmartDistinctSet().add_values(np.unique(values))
+    if name in _PCT_EXACT or name == "mode":
+        if np.asarray(values).dtype.kind not in ("i", "u", "f", "b"):
+            raise UnsupportedQueryError(f"{name} requires a numeric column")
+        return ValueHist.from_values(values)
+    if name in _PCT_DIGEST:
+        return TDigest().add_values(np.asarray(values, dtype=np.float64))
+    if name == "histogram":
+        if len(extra) != 3:
+            raise UnsupportedQueryError("histogram(col, lower, upper, numBins)")
+        lo, hi, bins = float(extra[0]), float(extra[1]), int(extra[2])
+        v = np.asarray(values, dtype=np.float64)
+        counts, _ = np.histogram(v[(v >= lo) & (v <= hi)], bins=bins, range=(lo, hi))
+        return counts.astype(np.float64)
     if name in ("stddevpop", "stddevsamp", "varpop", "varsamp"):
-        v = values.astype(np.float64)
+        v = np.asarray(values, dtype=np.float64)
         return (n, float(v.sum()), float((v * v).sum()))
+    if name in ("skewness", "kurtosis"):
+        v = np.asarray(values, dtype=np.float64)
+        return (n, float(v.sum()), float((v**2).sum()), float((v**3).sum()),
+                float((v**4).sum()))
+    if name in ("covarpop", "covarsamp", "corr"):
+        x = np.asarray(cols[0], dtype=np.float64)
+        y = np.asarray(cols[1], dtype=np.float64)
+        return (n, float(x.sum()), float(y.sum()), float((x * y).sum()),
+                float((x * x).sum()), float((y * y).sum()))
     if name == "booland":
         return bool(np.all(values)) if n else True
     if name in ("boolor", "boolagg"):
         return bool(np.any(values)) if n else False
+    if name in ("exprmin", "exprmax"):
+        # EXPR_MIN(projectionCol, measuringCol)
+        proj, measure = cols[0], cols[1]
+        if n == 0:
+            return None
+        idx = int(np.argmin(measure)) if name == "exprmin" else int(np.argmax(measure))
+        return (_item(measure[idx]), _item(proj[idx]))
+    if name in ("firstwithtime", "lastwithtime"):
+        data_col, time_col = cols[0], cols[1]
+        if n == 0:
+            return None
+        idx = int(np.argmin(time_col)) if name == "firstwithtime" else int(np.argmax(time_col))
+        return (_item(time_col[idx]), _item(data_col[idx]))
+    if name in ("arrayagg", "listagg"):
+        return tuple(_item(v) for v in values)
     raise UnsupportedQueryError(f"aggregation {name} not implemented on host")
+
+
+def host_state(name: str, values: Optional[np.ndarray], extra: tuple = ()):
+    """Single-data-argument convenience wrapper (MV flatten path)."""
+    return host_state_full(name, [values] if values is not None else [], extra)
+
+
+def _item(v):
+    return v.item() if isinstance(v, np.generic) else v

@@ -19,7 +19,7 @@ from ..query.context import QueryContext
 from ..query.expressions import ExpressionContext
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
 from ..segment.loader import ImmutableSegment
-from .aggregation import UnsupportedQueryError, host_state
+from .aggregation import UnsupportedQueryError, host_state, host_state_full, split_args
 from .plan import like_to_regex
 from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
 from .selection import selection_from_mask
@@ -167,19 +167,19 @@ class HostSegmentExecutor:
 
     def _agg_state(self, agg: ExpressionContext, segment, mask):
         name = agg.function.name
-        args = agg.function.arguments
         if name == "count":
             return int(mask.sum())
-        arg = args[0] if args else None
-        if (arg is not None and arg.is_identifier and segment.has_column(arg.identifier)
+        data, extra = split_args(agg.function)
+        arg = data[0] if data else None
+        if (len(data) == 1 and arg.is_identifier and segment.has_column(arg.identifier)
                 and not segment.column_metadata(arg.identifier).single_value):
             # MV argument: aggregate over ALL values of the selected rows
             # (reference *MV aggregation functions)
             mv_rows = segment.get_mv_values(arg.identifier)
             flat = [v for i in np.nonzero(mask)[0] for v in mv_rows[i]]
-            return host_state(name, np.asarray(flat))
-        vals = self.eval_value(arg, segment)
-        return host_state(name, np.asarray(vals)[mask])
+            return host_state(name, np.asarray(flat), extra)
+        cols = [np.asarray(self.eval_value(a, segment))[mask] for a in data]
+        return host_state_full(name, cols, extra)
 
     def _group_by(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
         key_cols = [np.asarray(self.eval_value(e, segment)) for e in group_exprs]
@@ -201,20 +201,23 @@ class HostSegmentExecutor:
         agg_args = []
         for agg in query.aggregations:
             if agg.function.name == "count":
-                agg_args.append(None)
+                agg_args.append((None, ()))
             else:
-                agg_args.append(np.asarray(self.eval_value(agg.function.arguments[0], segment)))
+                data, extra = split_args(agg.function)
+                agg_args.append(
+                    ([np.asarray(self.eval_value(a, segment)) for a in data], extra))
         for s, e in zip(starts, ends):
             if s == e:
                 continue
             rows = sel_sorted[s:e]
             key = tuple(_to_python(col[rows[0]]) for col in key_cols)
             states = []
-            for agg, vals in zip(query.aggregations, agg_args):
-                if vals is None:
+            for agg, (cols, extra) in zip(query.aggregations, agg_args):
+                if cols is None:
                     states.append(len(rows))
                 else:
-                    states.append(host_state(agg.function.name, vals[rows]))
+                    states.append(
+                        host_state_full(agg.function.name, [c[rows] for c in cols], extra))
             groups[key] = states
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
 

@@ -168,11 +168,15 @@ class FNot(FilterNode):
 
 @dataclass(frozen=True)
 class AggOp:
-    kind: str  # count | sum | min | max | sumsq | distinct_bitmap
+    kind: str  # count | sum | min | max | sumsq | distinct_bitmap | value_hist | hist_fixed
     vexpr: Optional[ValueExpr] = None
-    # distinct_bitmap: dict-id plane slot + static cardinality
+    # distinct_bitmap / value_hist: dict-id plane slot + static cardinality
     ids_slot: Optional[int] = None
     card: Optional[int] = None
+    # hist_fixed: static bin count + runtime [lo, hi] bounds
+    bins: Optional[int] = None
+    lo_param: Optional[int] = None
+    hi_param: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------

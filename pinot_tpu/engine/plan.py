@@ -105,6 +105,18 @@ class SegmentPlanner(AggPlanContext):
         kind = "ids" if m.single_value else "mvids"
         return self.slot(e.identifier, kind), m.cardinality, self.segment.get_dictionary(e.identifier)
 
+    def col_minmax(self, e: ExpressionContext):
+        """(min, max) stats for a plain numeric column, else None — feeds
+        fixed-bin device histograms (percentile approx on raw columns)."""
+        if not e.is_identifier:
+            return None
+        m = self._meta(e.identifier)
+        if m.min_value is None or m.max_value is None:
+            return None
+        if not DataType(m.data_type).is_numeric:
+            return None
+        return m.min_value, m.max_value
+
     # -- value expressions (device transform functions) --------------------
     def value_expr(self, e: ExpressionContext) -> ir.ValueExpr:
         if e.is_literal:
@@ -306,11 +318,13 @@ class SegmentPlanner(AggPlanContext):
 
             lowered = [lower_aggregation(self, a) for a in q.aggregations]
             for op in self.ops:
-                # distinct_bitmap materializes a (num_groups, card) occupancy
-                # matrix and addresses it with int32 — bound the product
-                if op.kind == "distinct_bitmap" and num_groups * op.card > DENSE_GROUP_LIMIT:
+                # matrix-shaped reductions materialize (num_groups, card|bins)
+                # and address it with int32 — bound the product
+                width = op.card if op.kind in ("distinct_bitmap", "value_hist") else (
+                    op.bins if op.kind == "hist_fixed" else None)
+                if width is not None and num_groups * width > DENSE_GROUP_LIMIT:
                     raise UnsupportedQueryError(
-                        f"distinct occupancy {num_groups}x{op.card} exceeds dense limit")
+                        f"{op.kind} occupancy {num_groups}x{width} exceeds dense limit")
             program = ir.Program(
                 mode="group_by" if group_exprs else "aggregation",
                 filter=filt,

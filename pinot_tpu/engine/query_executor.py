@@ -21,7 +21,7 @@ from ..query.context import QueryContext
 from ..query.parser.sql import SqlParseError, parse_sql
 from ..segment.loader import ImmutableSegment
 from ..spi.data_types import Schema
-from .aggregation import UnsupportedQueryError, get_semantics
+from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
 from .combine import combine_aggregation, combine_group_by, combine_selection
 from .executor import TpuSegmentExecutor
 from .host_executor import HostSegmentExecutor
@@ -109,7 +109,7 @@ class QueryExecutor:
             return self.host.execute(query, segment)
 
     def _combine(self, query: QueryContext, intermediates):
-        semantics = [get_semantics(a.function.name) for a in query.aggregations]
+        semantics = [semantics_for(a) for a in query.aggregations]
         first = intermediates[0] if intermediates else None
         if isinstance(first, GroupByIntermediate):
             return combine_group_by(intermediates, semantics)

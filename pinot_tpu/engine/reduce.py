@@ -16,7 +16,7 @@ from ..query.context import QueryContext
 from ..query.expressions import ExpressionContext, is_aggregation
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
 from ..spi.data_types import DataType, Schema
-from .aggregation import UnsupportedQueryError, get_semantics
+from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
 from .plan import like_to_regex
 from .results import (
     AggIntermediate,
@@ -48,7 +48,7 @@ class BrokerReducer:
         if query.distinct and not query.is_aggregation_query:
             group_exprs = list(query.select_expressions)
         agg_exprs = query.aggregations
-        semantics = [get_semantics(a.function.name) for a in agg_exprs]
+        semantics = [semantics_for(a) for a in agg_exprs]
 
         # env rows: expression-string → value (+ select aliases, so ORDER BY
         # and HAVING can reference them like the reference's alias handling)
@@ -83,7 +83,7 @@ class BrokerReducer:
 
     def _reduce_aggregation(self, query: QueryContext, combined: AggIntermediate) -> ResultTable:
         agg_exprs = query.aggregations
-        semantics = [get_semantics(a.function.name) for a in agg_exprs]
+        semantics = [semantics_for(a) for a in agg_exprs]
         env = {}
         if combined.states:
             for ae, sem, st in zip(agg_exprs, semantics, combined.states):
@@ -118,7 +118,7 @@ class BrokerReducer:
 
     def _expr_type(self, e: ExpressionContext, group_set) -> str:
         if is_aggregation(e):
-            return get_semantics(e.function.name).result_type
+            return semantics_for(e).result_type
         if e.is_identifier:
             return self._column_type(e.identifier)
         if e.is_literal:

@@ -191,18 +191,38 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
 def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n):
     if agg.kind == "count":
         return jax.ops.segment_sum(mask.astype(jnp.int64), gid, num_segments=num_segments)
-    if agg.kind == "distinct_bitmap":
-        # per-(group, dictId) occupancy matrix — shipped to host so distinct
-        # VALUE sets can merge across segments (dict ids are segment-local)
+    if agg.kind in ("distinct_bitmap", "value_hist"):
+        # per-(group, dictId) occupancy/count matrix — shipped to host so
+        # distinct VALUE sets / exact value histograms (percentile, mode)
+        # can merge across segments (dict ids are segment-local)
         card = agg.card
         num_groups = num_segments - 1
         ids = arrays[agg.ids_slot].astype(jnp.int32)
         sid = gid * jnp.int32(card) + ids
         sid = jnp.where(mask, sid, jnp.int32(num_groups * card))
+        dtype = jnp.int32 if agg.kind == "distinct_bitmap" else jnp.int64
         occ = jax.ops.segment_sum(
-            mask.astype(jnp.int32), sid, num_segments=num_groups * card + 1
+            mask.astype(dtype), sid, num_segments=num_groups * card + 1
         )
-        return occ[: num_groups * card].reshape(num_groups, card) > 0
+        occ = occ[: num_groups * card].reshape(num_groups, card)
+        return occ > 0 if agg.kind == "distinct_bitmap" else occ
+    if agg.kind == "hist_fixed":
+        # equal-width bins over [lo, hi]; out-of-range rows are dropped
+        # (reference HistogramAggregationFunction semantics)
+        bins = agg.bins
+        num_groups = num_segments - 1
+        v = _eval_value(agg.vexpr, arrays, params).astype(jnp.float64)
+        lo = params[agg.lo_param]
+        hi = params[agg.hi_param]
+        width = (hi - lo) / bins
+        b = jnp.clip(((v - lo) / width).astype(jnp.int32), 0, bins - 1)
+        inside = mask & (v >= lo) & (v <= hi)
+        sid = gid * jnp.int32(bins) + b
+        sid = jnp.where(inside, sid, jnp.int32(num_groups * bins))
+        counts = jax.ops.segment_sum(
+            inside.astype(jnp.int64), sid, num_segments=num_groups * bins + 1
+        )
+        return counts[: num_groups * bins].reshape(num_groups, bins)
     v = _eval_value(agg.vexpr, arrays, params)
     if agg.kind == "sum":
         v = jnp.where(mask, v, 0).astype(jnp.float64)

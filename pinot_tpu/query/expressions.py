@@ -93,8 +93,15 @@ AGGREGATION_FUNCTIONS = frozenset(
         "count", "sum", "min", "max", "avg",
         "minmaxrange", "sumprecision",
         "distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
-        "distinctcounthll", "distinctcounthllplus", "distinctsum", "distinctavg",
+        "distinctcounthll", "distinctcounthllplus", "distinctcountull",
+        "distinctcountcpc", "distinctcounttheta", "distinctcountrawtheta",
+        "distinctcountsmart", "distinctcountsmarthll", "distinctsum", "distinctavg",
+        "distinctcountbitmapmv", "distinctcounthllmv", "distinctcounthllplusmv",
+        "percentilerawkll",
         "percentile", "percentileest", "percentiletdigest", "percentilekll",
+        "percentilerawest", "percentilerawtdigest", "percentilesmarttdigest",
+        "percentileestmv", "percentiletdigestmv", "percentilekllmv",
+        "skewness", "kurtosis",
         "mode", "firstwithtime", "lastwithtime",
         "arrayagg", "listagg",
         "boolagg", "booland", "boolor",
@@ -109,8 +116,22 @@ AGGREGATION_FUNCTIONS = frozenset(
 )
 
 
+import re as _re
+
+# legacy digit-suffixed percentiles: PERCENTILE95 / PERCENTILETDIGEST99 / ...
+# (reference AggregationFunctionType.getAggregationFunctionType matches \d+).
+# Single source of truth — engine/aggregation.py canonicalizes with this too.
+PERCENTILE_SUFFIX_RE = _re.compile(
+    r"^(percentile(?:est|tdigest|kll|rawest|rawtdigest|rawkll|smarttdigest)?)"
+    r"(\d+)(mv)?$")
+
+
+def is_aggregation_name(name: str) -> bool:
+    return name in AGGREGATION_FUNCTIONS or PERCENTILE_SUFFIX_RE.match(name) is not None
+
+
 def is_aggregation(expr: ExpressionContext) -> bool:
-    return expr.is_function and expr.function.name in AGGREGATION_FUNCTIONS
+    return expr.is_function and is_aggregation_name(expr.function.name)
 
 
 def contains_aggregation(expr: ExpressionContext) -> bool:

@@ -1,0 +1,204 @@
+"""Extended aggregation functions: TPU-vs-host differential + known values.
+
+Covers the sketch-backed family (HLL/theta/smart distinct counts,
+percentile t-digest), exact histogram-backed family (PERCENTILE, MODE,
+HISTOGRAM), moments (SKEWNESS/KURTOSIS/COVAR/CORR), and positional aggs
+(EXPRMIN/EXPRMAX/FIRSTWITHTIME/LASTWITHTIME) — reference inventory in
+pinot-core/.../query/aggregation/function/ (SURVEY.md §2.3).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.utils.sketches import HyperLogLog, TDigest, ThetaSketch, ValueHist
+
+N1, N2 = 1200, 800
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    tmp = tmp_path_factory.mktemp("aggsegs")
+    schema = Schema.build(
+        "stats",
+        dimensions=[("team", "STRING"), ("year", "INT"), ("city", "STRING")],
+        metrics=[("score", "INT"), ("fare", "DOUBLE"), ("ts", "LONG")],
+    )
+    teams = ["A", "B", "C", "D"]
+    cities = [f"city{i}" for i in range(40)]
+    segments = []
+    for si, n in enumerate([N1, N2]):
+        cols = {
+            "team": [teams[int(rng.integers(4))] for _ in range(n)],
+            "year": [int(rng.integers(2000, 2010)) for _ in range(n)],
+            "city": [cities[int(rng.integers(40))] for _ in range(n)],
+            "score": [int(rng.integers(0, 500)) for _ in range(n)],
+            "fare": [float(np.round(rng.random() * 80, 4)) for _ in range(n)],
+            "ts": [int(1_600_000_000 + rng.integers(0, 10_000_000)) for _ in range(n)],
+        }
+        d = tmp / f"seg_{si}"
+        SegmentBuilder(schema, segment_name=f"seg_{si}").build(cols, d)
+        segments.append(load_segment(d))
+    return schema, segments
+
+
+def executors(table):
+    schema, segments = table
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, segments)
+    host = QueryExecutor(backend="host")
+    host.add_table(schema, segments)
+    return tpu, host
+
+
+def rows_of(resp):
+    assert resp.result_table is not None, f"failed: {resp.exceptions}"
+    return resp.result_table.rows
+
+
+DIFFERENTIAL = [
+    "SELECT PERCENTILE(score, 50) FROM stats",
+    "SELECT PERCENTILE(score, 95) FROM stats WHERE year >= 2005",
+    "SELECT team, PERCENTILE(score, 90) FROM stats GROUP BY team",
+    "SELECT PERCENTILE95(score) FROM stats",
+    "SELECT MODE(score) FROM stats",
+    "SELECT team, MODE(year) FROM stats GROUP BY team",
+    "SELECT DISTINCTCOUNTHLL(city) FROM stats",
+    "SELECT team, DISTINCTCOUNTHLL(city) FROM stats GROUP BY team",
+    "SELECT DISTINCTCOUNTTHETA(city) FROM stats",
+    "SELECT DISTINCTCOUNTSMART(city) FROM stats",
+    "SELECT SKEWNESS(score), KURTOSIS(score) FROM stats",
+    "SELECT COVARPOP(score, fare), COVARSAMP(score, fare), CORR(score, fare) FROM stats",
+    "SELECT team, CORR(score, fare) FROM stats GROUP BY team",
+    "SELECT HISTOGRAM(score, 0, 500, 10) FROM stats",
+    "SELECT team, HISTOGRAM(score, 0, 500, 5) FROM stats GROUP BY team",
+    "SELECT DISTINCTSUM(year), DISTINCTAVG(year) FROM stats",
+    "SELECT MINMAXRANGE(fare) FROM stats GROUP BY team",
+]
+
+
+@pytest.mark.parametrize("sql", DIFFERENTIAL)
+def test_differential(table, sql):
+    tpu, host = executors(table)
+    rt = rows_of(tpu.execute_sql(sql))
+    rh = rows_of(host.execute_sql(sql))
+    rt = sorted(rt, key=repr)
+    rh = sorted(rh, key=repr)
+    assert len(rt) == len(rh)
+    for a, b in zip(rt, rh):
+        for x, y in zip(a, b):
+            if isinstance(x, float) and isinstance(y, float):
+                if math.isnan(x) and math.isnan(y):
+                    continue
+                assert x == pytest.approx(y, rel=1e-9), (sql, a, b)
+            elif isinstance(x, list):
+                assert x == pytest.approx(y), (sql, a, b)
+            else:
+                assert x == y, (sql, a, b)
+
+
+def test_percentile_exact_value(table):
+    tpu, host = executors(table)
+    _, segments = table
+    allscores = np.concatenate([s.get_values("score") for s in segments])
+    want = float(np.sort(allscores)[min(int(len(allscores) * 0.5), len(allscores) - 1)])
+    for ex in (tpu, host):
+        got = rows_of(ex.execute_sql("SELECT PERCENTILE(score, 50) FROM stats"))[0][0]
+        assert got == want
+
+
+def test_distinctcount_hll_close_to_exact(table):
+    tpu, host = executors(table)
+    exact = rows_of(tpu.execute_sql("SELECT DISTINCTCOUNT(city) FROM stats"))[0][0]
+    hll = rows_of(tpu.execute_sql("SELECT DISTINCTCOUNTHLL(city) FROM stats"))[0][0]
+    theta = rows_of(tpu.execute_sql("SELECT DISTINCTCOUNTTHETA(city) FROM stats"))[0][0]
+    assert exact == 40
+    assert abs(hll - exact) <= max(2, exact * 0.05)
+    assert theta == exact  # below k → exact
+
+
+def test_percentile_tdigest_close_to_exact(table):
+    tpu, host = executors(table)
+    approx = rows_of(tpu.execute_sql("SELECT PERCENTILETDIGEST(fare, 90) FROM stats"))[0][0]
+    _, segments = table
+    allf = np.sort(np.concatenate([s.get_values("fare") for s in segments]))
+    exact = float(allf[int(len(allf) * 0.9)])
+    assert approx == pytest.approx(exact, abs=2.0)
+    # host path agrees within digest error too
+    h = rows_of(host.execute_sql("SELECT PERCENTILETDIGEST(fare, 90) FROM stats"))[0][0]
+    assert h == pytest.approx(exact, abs=2.0)
+
+
+def test_exprmin_exprmax_firstlast(table):
+    # host-path functions — "auto" backend falls back per query shape
+    schema, segments = table
+    auto = QueryExecutor(backend="auto")
+    auto.add_table(schema, segments)
+    _, host = executors(table)
+    tpu = auto
+    score = np.concatenate([s.get_values("score") for s in segments])
+    fare = np.concatenate([s.get_values("fare") for s in segments])
+    ts = np.concatenate([s.get_values("ts") for s in segments])
+    for ex in (tpu, host):
+        r = rows_of(ex.execute_sql(
+            "SELECT EXPRMIN(fare, score), EXPRMAX(fare, score) FROM stats"))[0]
+        assert r[0] == pytest.approx(float(fare[np.argmin(score)]))
+        assert r[1] == pytest.approx(float(fare[np.argmax(score)]))
+        r = rows_of(ex.execute_sql(
+            "SELECT FIRSTWITHTIME(score, ts, 'INT'), LASTWITHTIME(score, ts, 'INT') FROM stats"))[0]
+        assert r[0] == int(score[np.argmin(ts)])
+        assert r[1] == int(score[np.argmax(ts)])
+
+
+def test_empty_result_empties(table):
+    tpu, host = executors(table)
+    for ex in (tpu, host):
+        r = rows_of(ex.execute_sql(
+            "SELECT PERCENTILE(score, 50), MODE(score), DISTINCTCOUNTHLL(city) "
+            "FROM stats WHERE year > 9999"))[0]
+        assert math.isnan(r[0]) and math.isnan(r[1]) and r[2] == 0
+
+
+# ---------------------------------------------------------------------------
+# sketch unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_hll_accuracy_and_merge():
+    rng = np.random.default_rng(1)
+    a = HyperLogLog().add_values(rng.integers(0, 50_000, 200_000))
+    exact = len(np.unique(rng.integers(0, 50_000, 0)))  # merge check below
+    h1 = HyperLogLog().add_values(np.arange(0, 30_000))
+    h2 = HyperLogLog().add_values(np.arange(20_000, 50_000))
+    m = h1.merge(h2)
+    assert abs(m.cardinality() - 50_000) / 50_000 < 0.05
+
+
+def test_tdigest_quantiles():
+    rng = np.random.default_rng(2)
+    data = rng.normal(100, 15, 100_000)
+    td = TDigest()
+    for chunk in np.array_split(data, 10):
+        td = td.merge(TDigest().add_values(chunk))
+    for q in (0.01, 0.25, 0.5, 0.75, 0.99):
+        assert td.quantile(q) == pytest.approx(np.quantile(data, q), abs=1.0)
+
+
+def test_theta_sketch_estimate():
+    t1 = ThetaSketch(k=1024).add_values(np.arange(100_000))
+    assert abs(t1.cardinality() - 100_000) / 100_000 < 0.10
+
+
+def test_value_hist_percentile_semantics():
+    vh = ValueHist.from_values(np.asarray([1, 2, 2, 3, 3, 3]))
+    assert vh.percentile(0) == 1.0
+    assert vh.percentile(100) == 3.0
+    assert vh.mode() == 3.0
+    merged = vh.merge(ValueHist.from_values(np.asarray([1, 1, 1, 1])))
+    assert merged.mode() == 1.0
