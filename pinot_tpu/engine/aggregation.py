@@ -432,6 +432,8 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
         if len(extra) != 3:
             raise UnsupportedQueryError("histogram(col, lower, upper, numBins)")
         lo, hi, bins = float(extra[0]), float(extra[1]), int(extra[2])
+        if hi <= lo or bins <= 0:
+            raise UnsupportedQueryError("histogram requires upper > lower and numBins > 0")
         i = ctx.add_op(ir.AggOp(
             "hist_fixed", vexpr=ctx.value_expr(data[0]), bins=bins,
             lo_param=ctx.param(np.float64(lo)), hi_param=ctx.param(np.float64(hi))))
@@ -557,6 +559,8 @@ def host_state_full(name: str, cols: list, extra: tuple):
         if len(extra) != 3:
             raise UnsupportedQueryError("histogram(col, lower, upper, numBins)")
         lo, hi, bins = float(extra[0]), float(extra[1]), int(extra[2])
+        if hi <= lo or bins <= 0:
+            raise UnsupportedQueryError("histogram requires upper > lower and numBins > 0")
         v = np.asarray(values, dtype=np.float64)
         counts, _ = np.histogram(v[(v >= lo) & (v <= hi)], bins=bins, range=(lo, hi))
         return counts.astype(np.float64)

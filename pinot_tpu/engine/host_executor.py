@@ -69,6 +69,12 @@ class HostSegmentExecutor:
             nulls = segment.get_null_bitmap(col)
             m = np.zeros(n, dtype=bool) if nulls is None else nulls.copy()
             return ~m if p.type == PredicateType.IS_NOT_NULL else m
+        if p.type == PredicateType.JSON_MATCH:
+            return eval_json_match(p, segment)
+
+        m = self._eval_predicate_with_index(p, segment)
+        if m is not None:
+            return m
 
         # MV columns: row matches if ANY value matches (reference MV predicate
         # semantics)
@@ -99,6 +105,60 @@ class HostSegmentExecutor:
                      else re.compile(str(p.values[0])))
             return np.asarray([regex.search(str(x)) is not None for x in v], dtype=bool)
         raise UnsupportedQueryError(f"host predicate {p.type}")
+
+    def _eval_predicate_with_index(self, p: Predicate, segment):
+        """Index-backed predicate evaluation (reference: index-backed
+        BaseFilterOperators, pinot-core/.../operator/filter/). Returns None
+        when no applicable index exists — caller scans."""
+        lhs = p.lhs
+        if not lhs.is_identifier or not segment.has_column(lhs.identifier):
+            return None
+        col = lhs.identifier
+        n = segment.num_docs
+        m = segment.column_metadata(col)
+        if m.encoding == "DICT" and m.single_value:
+            d = segment.get_dictionary(col)
+            inv = segment.get_inverted_index(col)
+            srt = segment.get_sorted_index(col)
+            if inv is None and srt is None:
+                return None
+            if p.type in (PredicateType.EQ, PredicateType.NOT_EQ):
+                did = d.index_of(p.values[0])
+                mask = self._ids_to_mask(inv, srt, did, did, n)
+                return ~mask if p.type == PredicateType.NOT_EQ else mask
+            if p.type in (PredicateType.IN, PredicateType.NOT_IN):
+                mask = np.zeros(n, dtype=bool)
+                for v in p.values:
+                    did = d.index_of(v)
+                    if did >= 0:
+                        mask |= self._ids_to_mask(inv, srt, did, did, n)
+                return ~mask if p.type == PredicateType.NOT_IN else mask
+            if p.type == PredicateType.RANGE:
+                lo_id = 0
+                hi_id = m.cardinality - 1
+                if p.lower is not None:
+                    lo_id = d.insertion_index(p.lower, "left" if p.lower_inclusive else "right")
+                if p.upper is not None:
+                    hi_id = d.insertion_index(p.upper, "right" if p.upper_inclusive else "left") - 1
+                return self._ids_to_mask(inv, srt, lo_id, hi_id, n)
+            return None
+        if m.encoding == "RAW" and m.single_value and p.type == PredicateType.RANGE:
+            rng = segment.get_range_index(col)
+            if rng is not None:
+                return rng.mask_in_range(n, p.lower, p.upper,
+                                         p.lower_inclusive, p.upper_inclusive)
+        return None
+
+    @staticmethod
+    def _ids_to_mask(inv, srt, lo_id, hi_id, n) -> np.ndarray:
+        if hi_id < lo_id or lo_id < 0:
+            return np.zeros(n, dtype=bool)
+        if srt is not None:
+            s, e = srt.doc_range(lo_id, hi_id)
+            mask = np.zeros(n, dtype=bool)
+            mask[s:e] = True
+            return mask
+        return inv.mask_for_range(lo_id, hi_id, n)
 
     def _eval_mv_predicate(self, p: Predicate, segment) -> np.ndarray:
         col = p.lhs.identifier
@@ -232,6 +292,17 @@ class HostSegmentExecutor:
             else:
                 raise UnsupportedQueryError("selection transforms unsupported")
         return selection_from_mask(query, segment, cols, mask)
+
+
+def eval_json_match(p: Predicate, segment) -> np.ndarray:
+    """JSON_MATCH(col, 'filter') → doc mask via the column's JSON index;
+    builds a transient index when none was persisted (reference requires the
+    index; transient keeps the host oracle able to verify it)."""
+    col = p.lhs.identifier
+    if col is None or not segment.has_column(col):
+        raise UnsupportedQueryError(f"JSON_MATCH needs a column: {p.lhs}")
+    idx = segment.get_json_index(col, or_build=True)
+    return idx.mask_match(str(p.values[0]), segment.num_docs)
 
 
 _NP_BIN = {

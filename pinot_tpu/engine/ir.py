@@ -146,6 +146,15 @@ class Null(FilterNode):
 
 
 @dataclass(frozen=True)
+class MaskParam(FilterNode):
+    """mask = params[idx] — a boolean doc plane evaluated on HOST at plan
+    time (JSON_MATCH / TEXT_MATCH posting lists, precomputed index masks),
+    padded to the segment's shape bucket before dispatch."""
+
+    idx: int
+
+
+@dataclass(frozen=True)
 class FAnd(FilterNode):
     children: tuple[FilterNode, ...]
 

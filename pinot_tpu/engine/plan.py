@@ -172,6 +172,8 @@ class SegmentPlanner(AggPlanContext):
 
     def _lower_predicate(self, p: Predicate) -> ir.FilterNode:
         lhs = p.lhs
+        if p.type == PredicateType.JSON_MATCH:
+            return self._lower_host_mask(p)
         if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
             if not lhs.is_identifier:
                 raise UnsupportedQueryError("IS NULL on expressions unsupported")
@@ -248,6 +250,19 @@ class SegmentPlanner(AggPlanContext):
             return ir.Lut(ids_slot, self.param(lut), mv=mv)
 
         raise UnsupportedQueryError(f"predicate {p.type} not lowered")
+
+    def _lower_host_mask(self, p: Predicate) -> ir.FilterNode:
+        """Predicates without a vector form (JSON_MATCH) evaluate on host via
+        their index into a doc mask shipped as a kernel param plane."""
+        from ..segment.device_cache import pad_bucket
+        from .host_executor import eval_json_match
+
+        if not p.lhs.is_identifier:
+            raise UnsupportedQueryError(f"{p.type} needs a column lhs")
+        mask = eval_json_match(p, self.segment)
+        padded = np.zeros(pad_bucket(max(1, self.segment.num_docs)), dtype=bool)
+        padded[: len(mask)] = mask
+        return ir.MaskParam(self.param(padded))
 
     def _id_interval(self, ids_slot, lo_id, hi_id, mv, card) -> ir.FilterNode:
         if mv:

@@ -1,0 +1,111 @@
+"""Server-side segment pruning: skip segments that cannot match the filter.
+
+Reference: pinot-core/.../query/pruner/ — SegmentPrunerService runs
+ColumnValueSegmentPruner (min/max + partition metadata) and
+BloomFilterSegmentPruner before planning. Pruning is the highest-leverage
+index use in the TPU design: a pruned segment costs zero device dispatches
+(vs. the reference where it saves a thread-pool task).
+
+Conservative semantics: return False ("prune") only when the segment
+PROVABLY has no matching row. Any uncertainty (expressions over multiple
+columns, OR branches we can't bound, missing metadata) keeps the segment.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..query.context import QueryContext
+from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
+from ..segment.loader import ImmutableSegment
+
+
+class SegmentPrunerService:
+    def prune(self, query: QueryContext, segments: list[ImmutableSegment]):
+        """→ (kept_segments, num_pruned)."""
+        f = query.filter
+        if f is None:
+            return list(segments), 0
+        kept = [s for s in segments if self._may_match(f, s)]
+        return kept, len(segments) - len(kept)
+
+    def _may_match(self, f: FilterContext, seg: ImmutableSegment) -> bool:
+        if f.type == FilterNodeType.AND:
+            return all(self._may_match(c, seg) for c in f.children)
+        if f.type == FilterNodeType.OR:
+            return any(self._may_match(c, seg) for c in f.children)
+        if f.type == FilterNodeType.NOT:
+            return True  # NOT(no-match) proves nothing cheaply
+        if f.type == FilterNodeType.CONSTANT:
+            return f.constant_value
+        return self._predicate_may_match(f.predicate, seg)
+
+    def _predicate_may_match(self, p: Predicate, seg: ImmutableSegment) -> bool:
+        lhs = p.lhs
+        if not lhs.is_identifier or not seg.has_column(lhs.identifier):
+            return True
+        col = lhs.identifier
+        m = seg.column_metadata(col)
+        lo, hi = m.min_value, m.max_value
+        if p.type == PredicateType.EQ:
+            v = p.values[0]
+            if _outside(v, lo, hi):
+                return False
+            bf = seg.get_bloom_filter(col)
+            if bf is not None and not bf.might_contain(v):
+                return False
+            return True
+        if p.type == PredicateType.IN:
+            bf = seg.get_bloom_filter(col)
+            for v in p.values:
+                if _outside(v, lo, hi):
+                    continue
+                if bf is not None and not bf.might_contain(v):
+                    continue
+                return True
+            return False
+        if p.type == PredicateType.RANGE:
+            if lo is None or hi is None:
+                return True
+            try:
+                if p.lower is not None:
+                    if (hi < p.lower) or (hi == p.lower and not p.lower_inclusive):
+                        return False
+                if p.upper is not None:
+                    if (lo > p.upper) or (lo == p.upper and not p.upper_inclusive):
+                        return False
+            except TypeError:
+                return True  # incomparable types: keep
+            return True
+        return True
+
+
+def _outside(v, lo, hi) -> bool:
+    if lo is None or hi is None:
+        return False
+    try:
+        return v < lo or v > hi
+    except TypeError:
+        return False
+
+
+def prune_by_time(
+    segments: list[ImmutableSegment],
+    time_column: Optional[str],
+    start: Optional[int],
+    end: Optional[int],
+) -> list[ImmutableSegment]:
+    """Broker-style time pruning off segment metadata start/end times
+    (reference TimeSegmentPruner, pinot-broker/.../routing/segmentpruner/)."""
+    if time_column is None or (start is None and end is None):
+        return list(segments)
+    out = []
+    for s in segments:
+        s0, s1 = s.metadata.start_time, s.metadata.end_time
+        if s0 is None or s1 is None:
+            out.append(s)
+            continue
+        if (end is not None and s0 > end) or (start is not None and s1 < start):
+            continue
+        out.append(s)
+    return out

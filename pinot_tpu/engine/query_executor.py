@@ -25,6 +25,7 @@ from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
 from .combine import combine_aggregation, combine_group_by, combine_selection
 from .executor import TpuSegmentExecutor
 from .host_executor import HostSegmentExecutor
+from .pruner import SegmentPrunerService
 from .reduce import BrokerReducer
 from .results import (
     AggIntermediate,
@@ -50,6 +51,7 @@ class QueryExecutor:
         self.tables: dict[str, Table] = {}
         self.tpu = TpuSegmentExecutor()
         self.host = HostSegmentExecutor()
+        self.pruner = SegmentPrunerService()
 
     def add_table(self, schema: Schema, segments: list[ImmutableSegment], name: Optional[str] = None):
         self.tables[name or schema.schema_name] = Table(name or schema.schema_name, schema, list(segments))
@@ -74,8 +76,10 @@ class QueryExecutor:
         intermediates = []
         total_docs = 0
         try:
+            kept, num_pruned = self.pruner.prune(query, table.segments)
             for segment in table.segments:
                 total_docs += segment.num_docs
+            for segment in kept:
                 intermediates.append(self._execute_segment(query, segment))
 
             combined = self._combine(query, intermediates)
@@ -93,7 +97,8 @@ class QueryExecutor:
             num_docs_scanned=getattr(combined, "num_docs_scanned", 0),
             total_docs=total_docs,
             num_segments_queried=len(table.segments),
-            num_segments_processed=len(table.segments),
+            num_segments_processed=len(kept),
+            num_segments_pruned=num_pruned,
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
         return resp

@@ -127,6 +127,8 @@ def _eval_filter(node: ir.FilterNode, arrays, params, n: int):
         return (v[:, None] == vals[None, :]).any(axis=1)
     if isinstance(node, ir.Null):
         return arrays[node.null_slot]
+    if isinstance(node, ir.MaskParam):
+        return params[node.idx]
     if isinstance(node, ir.FAnd):
         m = _eval_filter(node.children[0], arrays, params, n)
         for c in node.children[1:]:

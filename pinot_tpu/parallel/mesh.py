@@ -57,9 +57,28 @@ def _combine_collectives(program: ir.Program, outs: tuple, axis: str) -> tuple:
             merged.append(jax.lax.pmax(o, axis))
         elif agg.kind == "distinct_bitmap":
             merged.append(jax.lax.pmax(o.astype(jnp.int32), axis) > 0)
+        elif agg.kind in ("value_hist", "hist_fixed"):
+            merged.append(jax.lax.psum(o, axis))  # per-(group,bin) counts add
         else:  # pragma: no cover
             raise ValueError(agg.kind)
     return tuple(merged)
+
+
+def _mask_param_indices(node) -> frozenset:
+    """Param slots holding host-evaluated doc-mask planes (ir.MaskParam) —
+    those are row-aligned and must shard with the row axis."""
+    if node is None:
+        return frozenset()
+    if isinstance(node, ir.MaskParam):
+        return frozenset((node.idx,))
+    if isinstance(node, (ir.FAnd, ir.FOr)):
+        out = frozenset()
+        for c in node.children:
+            out |= _mask_param_indices(c)
+        return out
+    if isinstance(node, ir.FNot):
+        return _mask_param_indices(node.child)
+    return frozenset()
 
 
 def slot_specs(slots) -> tuple:
@@ -84,10 +103,13 @@ def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_doc
             return outs  # masks stay row-sharded
         return _combine_collectives(program, outs, ROW_AXIS)
 
+    mask_idxs = _mask_param_indices(program.filter)
+    param_specs = tuple(
+        P(ROW_AXIS) if i in mask_idxs else P() for i in range(len(params)))
     out_specs = P(ROW_AXIS) if program.mode == "selection" else P()
     fn = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(array_specs, tuple(P() for _ in params), P()),
+        in_specs=(array_specs, param_specs, P()),
         out_specs=out_specs,
     )
     return fn(arrays, params, num_docs)

@@ -20,6 +20,16 @@ from ..spi.table_config import TableConfig
 from . import bitpack
 from .dictionary import build_dictionary, serialize_dictionary
 from .format import ColumnMetadata, SegmentMetadata, SegmentWriter
+from .indexes import (
+    BloomFilter,
+    InvertedIndex,
+    JsonIndex,
+    RawRangeIndex,
+    serialize_bloom,
+    serialize_inverted,
+    serialize_json_index,
+    serialize_raw_range,
+)
 
 
 def rows_to_columns(rows: Sequence[Mapping], schema: Schema) -> dict[str, list]:
@@ -71,6 +81,8 @@ class SegmentBuilder:
                 meta = self._build_sv_column(writer, name, spec, values, num_docs, raw=name in no_dict)
             col_metas[name] = meta
 
+        self._build_indexes(writer, columns, col_metas)
+
         num_docs = num_docs or 0
         time_col = self.table_config.validation.time_column_name
         start_t = end_t = None
@@ -91,6 +103,73 @@ class SegmentBuilder:
         )
         writer.write(meta)
         return out_dir
+
+    def _build_indexes(self, writer, columns, col_metas: dict[str, ColumnMetadata]):
+        """Auxiliary indexes requested by TableConfig.indexing (reference:
+        per-column IndexCreators invoked by SegmentColumnarIndexCreator).
+
+        `is_sorted` dict columns need no stored sorted index — SortedIndex
+        derives from the forward index at load time."""
+        idx = self.table_config.indexing
+
+        def add(col: str, bufs: list):
+            for suffix, arr in bufs:
+                writer.add_buffer(f"{col}.{suffix}", np.ascontiguousarray(arr))
+
+        for col in idx.inverted_index_columns:
+            m = col_metas.get(col)
+            if m is None or m.encoding != "DICT":
+                continue
+            # flat dict-id stream works for SV and MV alike (MV: a doc is
+            # posted under every value it holds — reference MV inverted index)
+            ids = bitpack.unpack(
+                writer.peek_buffer(f"{col}.fwd"), m.bits_per_value, m.total_number_of_entries)
+            if not m.single_value:
+                # entry stream → doc ids: CSR over entries, then map each
+                # entry back to its document
+                off = writer.peek_buffer(f"{col}.mvoff").view(np.uint32)
+                doc_of_entry = np.repeat(
+                    np.arange(len(off) - 1, dtype=np.int64), np.diff(off.astype(np.int64)))
+                b = InvertedIndex.build(ids, m.cardinality)
+                inv = InvertedIndex(b.offsets, doc_of_entry[b.docs].astype(np.uint32))
+            else:
+                inv = InvertedIndex.build(ids, m.cardinality)
+            add(col, serialize_inverted(inv))
+
+        for col in idx.range_index_columns:
+            m = col_metas.get(col)
+            if m is None or not m.single_value:
+                continue
+            if m.encoding == "DICT":
+                # dict range queries ride the CSR inverted index (contiguous
+                # dictId slice) — build one if not already requested
+                if f"{col}.inv.off" not in writer.buffer_names():
+                    ids = bitpack.unpack(
+                        writer.peek_buffer(f"{col}.fwd"), m.bits_per_value,
+                        m.total_number_of_entries)
+                    add(col, serialize_inverted(InvertedIndex.build(ids, m.cardinality)))
+            else:
+                raw = writer.peek_buffer(f"{col}.fwd").view(
+                    DataType(m.data_type).numpy_dtype)
+                add(col, serialize_raw_range(RawRangeIndex.build(raw)))
+
+        for col in idx.bloom_filter_columns:
+            m = col_metas.get(col)
+            if m is None:
+                continue
+            values = columns[col]
+            flat = []
+            for v in values:
+                if isinstance(v, (list, tuple, np.ndarray)):
+                    flat.extend(v)
+                elif v is not None:
+                    flat.append(v)
+            add(col, serialize_bloom(BloomFilter.build(flat)))
+
+        for col in idx.json_index_columns:
+            if col not in columns:
+                continue
+            add(col, serialize_json_index(JsonIndex.build(columns[col])))
 
     def _replace_nulls(self, values, spec) -> tuple[list, np.ndarray]:
         if isinstance(values, np.ndarray) and values.dtype != object:

@@ -129,6 +129,17 @@ class SegmentWriter:
             data = data.tobytes()
         self._buffers.append((name, data))
 
+    def buffer_names(self) -> set[str]:
+        return {name for name, _ in self._buffers}
+
+    def peek_buffer(self, name: str) -> np.ndarray:
+        """Re-read an already-added buffer (index builders derive from the
+        forward index without keeping a second copy of the column)."""
+        for n, data in self._buffers:
+            if n == name:
+                return np.frombuffer(data, dtype=np.uint8)
+        raise KeyError(name)
+
     def write(self, metadata: SegmentMetadata) -> None:
         import zlib
 

@@ -33,6 +33,7 @@ class ImmutableSegment:
         self._raw: dict[str, np.ndarray] = {}
         self._nulls: dict[str, Optional[np.ndarray]] = {}
         self._mv_offsets: dict[str, np.ndarray] = {}
+        self._indexes: dict[tuple, object] = {}
 
     # -- identity ----------------------------------------------------------
     @property
@@ -117,6 +118,97 @@ class ImmutableSegment:
             else:
                 self._nulls[column] = bitpack.unpack_bitmap(self._buffer(f"{column}.nulls"), self.num_docs)
         return self._nulls[column]
+
+    # -- auxiliary indexes (segment/indexes.py) -----------------------------
+    def _has_buffer(self, name: str) -> bool:
+        return name in self.metadata.buffers
+
+    def get_inverted_index(self, column: str):
+        """CSR inverted index if built, else None (reference
+        BitmapInvertedIndexReader; doubles as the dict range index here)."""
+        key = ("inv", column)
+        if key not in self._indexes:
+            if self._has_buffer(f"{column}.inv.off"):
+                from .indexes import deserialize_inverted
+
+                self._indexes[key] = deserialize_inverted(
+                    np.frombuffer(self._buffer(f"{column}.inv.off"), dtype=np.uint32),
+                    np.frombuffer(self._buffer(f"{column}.inv.docs"), dtype=np.uint32),
+                )
+            else:
+                self._indexes[key] = None
+        return self._indexes[key]
+
+    def get_sorted_index(self, column: str):
+        """Derived sorted index for sorted dict columns (no stored buffer —
+        reference SortedIndexReader reads the forward index directly)."""
+        key = ("sorted", column)
+        if key not in self._indexes:
+            m = self.column_metadata(column)
+            if m.encoding == "DICT" and m.single_value and m.is_sorted:
+                from .indexes import SortedIndex
+
+                self._indexes[key] = SortedIndex.build(
+                    self.get_dict_ids(column), m.cardinality)
+            else:
+                self._indexes[key] = None
+        return self._indexes[key]
+
+    def get_range_index(self, column: str):
+        """Raw-column range index (sorted values + permutation), else None."""
+        key = ("rng", column)
+        if key not in self._indexes:
+            if self._has_buffer(f"{column}.rng.perm"):
+                from .indexes import RawRangeIndex
+
+                m = self.column_metadata(column)
+                dt = DataType(m.data_type).numpy_dtype
+                self._indexes[key] = RawRangeIndex(
+                    np.frombuffer(self._buffer(f"{column}.rng.sorted"), dtype=dt),
+                    np.frombuffer(self._buffer(f"{column}.rng.perm"), dtype=np.uint32),
+                )
+            else:
+                self._indexes[key] = None
+        return self._indexes[key]
+
+    def get_bloom_filter(self, column: str):
+        key = ("bloom", column)
+        if key not in self._indexes:
+            if self._has_buffer(f"{column}.bloom.hdr"):
+                from .indexes import deserialize_bloom
+
+                self._indexes[key] = deserialize_bloom(
+                    np.frombuffer(self._buffer(f"{column}.bloom.hdr"), dtype=np.int64),
+                    np.frombuffer(self._buffer(f"{column}.bloom.bits"), dtype=np.uint8),
+                )
+            else:
+                self._indexes[key] = None
+        return self._indexes[key]
+
+    def get_json_index(self, column: str, or_build: bool = False):
+        """Persisted JSON index, or (or_build=True) a transient one built
+        from column values and cached — so repeated JSON_MATCH queries on an
+        unindexed column parse the JSON corpus once, not per query."""
+        key = ("json", column)
+        if key not in self._indexes:
+            if self._has_buffer(f"{column}.json.keys.names"):
+                from .indexes import deserialize_json_index
+
+                bufs = {
+                    suffix: np.frombuffer(self._buffer(f"{column}.{suffix}"), dtype=np.uint8)
+                    for suffix in (
+                        "json.keys.names", "json.keys.off", "json.keys.docs",
+                        "json.paths.names", "json.paths.off", "json.paths.docs",
+                    )
+                }
+                self._indexes[key] = deserialize_json_index(bufs)
+            else:
+                self._indexes[key] = None
+        if self._indexes[key] is None and or_build:
+            from .indexes import JsonIndex
+
+            self._indexes[key] = JsonIndex.build(self.get_values(column))
+        return self._indexes[key]
 
     # -- materialized values (host path / test oracle) ---------------------
     def get_values(self, column: str) -> np.ndarray:

@@ -94,3 +94,22 @@ def test_selection_mask_sharded(segment):
         slots=plan.slots,
     )
     np.testing.assert_array_equal(np.asarray(single[0]), np.asarray(multi[0]))
+
+
+def test_row_sharded_value_hist_percentile(segment):
+    """value_hist kind combines with psum across the row axis."""
+    query = parse_sql("SELECT d1, PERCENTILE(m, 90), MODE(d2) FROM t GROUP BY d1")
+    plan = SegmentPlanner(query, segment).plan()
+    view = SegmentDeviceView(segment)
+    arrays = plan.gather_arrays(view)
+    params = tuple(jnp.asarray(p) for p in plan.params)
+
+    from pinot_tpu.ops.kernels import run_program
+
+    single = run_program(plan.program, arrays, params, jnp.int32(segment.num_docs), view.padded)
+    mesh = make_mesh(8)
+    sharded_arrays = shard_segment_arrays(arrays, mesh, view.padded, plan.slots)
+    sharded = run_program_row_sharded(
+        plan.program, sharded_arrays, params, segment.num_docs, view.padded, mesh, plan.slots)
+    for a, b in zip(single, sharded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
