@@ -52,6 +52,7 @@ class QueryExecutor:
         self.tpu = TpuSegmentExecutor()
         self.host = HostSegmentExecutor()
         self.pruner = SegmentPrunerService()
+        self.use_star_tree = True  # reference: useStarTree query option default true
 
     def add_table(self, schema: Schema, segments: list[ImmutableSegment], name: Optional[str] = None):
         self.tables[name or schema.schema_name] = Table(name or schema.schema_name, schema, list(segments))
@@ -104,14 +105,43 @@ class QueryExecutor:
         return resp
 
     def _execute_segment(self, query: QueryContext, segment: ImmutableSegment):
+        rewrite = None
+        if self.use_star_tree:
+            from ..segment.startree import try_rewrite
+
+            rewrite = try_rewrite(query, segment)
+        run_query, run_segment = (
+            (rewrite.query, rewrite.view) if rewrite is not None else (query, segment))
+
         if self.backend == "host":
-            return self.host.execute(query, segment)
-        if self.backend == "tpu":
-            return self.tpu.execute(query, segment)
-        try:
-            return self.tpu.execute(query, segment)
-        except UnsupportedQueryError:
-            return self.host.execute(query, segment)
+            result = self.host.execute(run_query, run_segment)
+        elif self.backend == "tpu":
+            result = self.tpu.execute(run_query, run_segment)
+        else:
+            try:
+                result = self.tpu.execute(run_query, run_segment)
+            except UnsupportedQueryError:
+                result = self.host.execute(run_query, run_segment)
+        if rewrite is not None:
+            result = self._remap_star_tree(rewrite, result)
+        return result
+
+    @staticmethod
+    def _remap_star_tree(rewrite, result):
+        """Inner (pre-agg) states → outer aggregation states; scanned-doc
+        count reflects pre-agg rows read (the star-tree speedup is visible
+        in numDocsScanned, same as the reference)."""
+        from ..segment.startree import remap_states
+
+        if isinstance(result, GroupByIntermediate):
+            return GroupByIntermediate(
+                {k: remap_states(rewrite, v) for k, v in result.groups.items()},
+                result.num_docs_scanned,
+            )
+        if isinstance(result, AggIntermediate):
+            return AggIntermediate(remap_states(rewrite, result.states),
+                                   result.num_docs_scanned)
+        return result
 
     def _combine(self, query: QueryContext, intermediates):
         semantics = [semantics_for(a) for a in query.aggregations]

@@ -83,6 +83,8 @@ class SegmentBuilder:
 
         self._build_indexes(writer, columns, col_metas)
 
+        star_tree_metas = self._build_star_trees(writer, col_metas)
+
         num_docs = num_docs or 0
         time_col = self.table_config.validation.time_column_name
         start_t = end_t = None
@@ -100,9 +102,59 @@ class SegmentBuilder:
             start_time=start_t,
             end_time=end_t,
             creation_time_ms=int(time.time() * 1000),
+            star_trees=star_tree_metas,
         )
         writer.write(meta)
         return out_dir
+
+    def _build_star_trees(self, writer, col_metas) -> list:
+        """Pre-aggregated dense tables per star_tree_index_configs
+        (segment/startree.py design notes)."""
+        from .dictionary import deserialize_dictionary
+        from .startree import StarTreeConfig, build_star_tree
+
+        metas = []
+        for tree_id, cfg_json in enumerate(self.table_config.indexing.star_tree_index_configs):
+            cfg = StarTreeConfig.from_json(cfg_json) if isinstance(cfg_json, dict) else cfg_json
+            if not cfg.split_order:
+                raise ValueError("star-tree requires a non-empty dimensionsSplitOrder")
+            for d in cfg.split_order:
+                m = col_metas.get(d)
+                if m is None or m.encoding != "DICT" or not m.single_value:
+                    raise ValueError(
+                        f"star-tree split dim {d!r} must be a dict-encoded SV column")
+            for fn, col in cfg.pairs():
+                if (col == "*") != (fn == "count"):
+                    raise ValueError(f"star-tree pair {fn}__{col}: '*' is COUNT-only")
+                if col != "*" and col not in col_metas:
+                    raise ValueError(f"star-tree pair references unknown column {col!r}")
+
+            def decode_ids(col):
+                m = col_metas[col]
+                return bitpack.unpack(
+                    writer.peek_buffer(f"{col}.fwd"), m.bits_per_value,
+                    m.total_number_of_entries)
+
+            dict_ids = {d: decode_ids(d) for d in cfg.split_order}
+            raw_values = {}
+            for fn, col in cfg.pairs():
+                if col == "*" or col in raw_values:
+                    continue
+                m = col_metas[col]
+                if m.encoding == "RAW":
+                    raw_values[col] = writer.peek_buffer(f"{col}.fwd").view(
+                        DataType(m.data_type).numpy_dtype)
+                else:
+                    d = deserialize_dictionary(
+                        bytes(writer.peek_buffer(f"{col}.dict")),
+                        DataType(m.data_type), m.cardinality)
+                    ids = dict_ids.get(col)
+                    raw_values[col] = d.take(ids if ids is not None else decode_ids(col))
+            buffers, meta = build_star_tree(tree_id, cfg, dict_ids, raw_values)
+            for name, arr in buffers:
+                writer.add_buffer(name, np.ascontiguousarray(arr))
+            metas.append(meta)
+        return metas
 
     def _build_indexes(self, writer, columns, col_metas: dict[str, ColumnMetadata]):
         """Auxiliary indexes requested by TableConfig.indexing (reference:

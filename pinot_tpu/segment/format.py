@@ -84,6 +84,7 @@ class SegmentMetadata:
     format_version: int = FORMAT_VERSION
     crc: Optional[str] = None
     creation_time_ms: int = 0
+    star_trees: list = field(default_factory=list)  # build_star_tree meta dicts
 
     def to_json(self) -> dict:
         return {
@@ -98,6 +99,7 @@ class SegmentMetadata:
             "creationTimeMs": self.creation_time_ms,
             "columns": {k: v.to_json() for k, v in self.columns.items()},
             "buffers": self.buffers,
+            "starTrees": self.star_trees,
         }
 
     @classmethod
@@ -114,6 +116,7 @@ class SegmentMetadata:
             creation_time_ms=d.get("creationTimeMs", 0),
             columns={k: ColumnMetadata.from_json(v) for k, v in d.get("columns", {}).items()},
             buffers=d.get("buffers", {}),
+            star_trees=d.get("starTrees", []),
         )
 
 

@@ -210,6 +210,16 @@ class ImmutableSegment:
             self._indexes[key] = JsonIndex.build(self.get_values(column))
         return self._indexes[key]
 
+    def star_trees(self):
+        """Loaded StarTreeViews (pre-aggregated pseudo-segments), cached."""
+        key = ("startree", "*")
+        if key not in self._indexes:
+            from .startree import StarTreeView
+
+            self._indexes[key] = [
+                StarTreeView(self, m) for m in self.metadata.star_trees]
+        return self._indexes[key]
+
     # -- materialized values (host path / test oracle) ---------------------
     def get_values(self, column: str) -> np.ndarray:
         """Fully materialized value array (SV) — used by the CPU oracle path."""
