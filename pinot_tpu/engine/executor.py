@@ -68,8 +68,16 @@ class TpuSegmentExecutor:
         return GroupByIntermediate(groups, num_docs_scanned=int(counts.sum()))
 
     def _selection_result(self, query, segment, plan, mask) -> SelectionIntermediate:
+        evaluator = None
+        if plan.selection_exprs:
+            from .host_executor import HostSegmentExecutor
+
+            host = HostSegmentExecutor()
+            evaluator = lambda e, doc_ids: host.eval_value_at(e, segment, doc_ids)  # noqa: E731
         return selection_from_mask(query, segment, plan.selection_columns,
-                                   np.asarray(mask[: segment.num_docs]))
+                                   np.asarray(mask[: segment.num_docs]),
+                                   extra_exprs=plan.selection_exprs or None,
+                                   evaluator=evaluator)
 
 
 def _to_python(v):

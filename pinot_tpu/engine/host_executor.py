@@ -19,6 +19,7 @@ from ..query.context import QueryContext
 from ..query.expressions import ExpressionContext
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
 from ..segment.loader import ImmutableSegment
+from ..query.transforms import get_transform
 from .aggregation import UnsupportedQueryError, host_state, host_state_full, split_args
 from .plan import like_to_regex
 from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
@@ -216,6 +217,27 @@ class HostSegmentExecutor:
                 cond = self.eval_value(args[i], segment).astype(bool)
                 out = np.where(cond, self.eval_value(args[i + 1], segment), out)
             return out
+        if name == "coalesce" and args and args[0].is_identifier:
+            base = self.eval_value(args[0], segment)
+            nulls = segment.get_null_bitmap(args[0].identifier)
+            if nulls is None or len(args) < 2:
+                return base
+            fallback = self.eval_value(args[1], segment)
+            return np.where(nulls, fallback, base)
+        td = get_transform(name)
+        if td is not None:
+            if td.mv_arg and args and args[0].is_identifier and segment.has_column(
+                    args[0].identifier) and not segment.column_metadata(
+                    args[0].identifier).single_value:
+                rows = segment.get_mv_values(args[0].identifier)
+                arr = np.empty(len(rows), dtype=object)
+                arr[:] = [list(r) for r in rows]
+                rest = [a.literal if a.is_literal else self.eval_value(a, segment)
+                        for a in args[1:]]
+                return td.eval_np(arr, *rest)
+            vals = [(int(a.literal) if isinstance(a.literal, bool) else a.literal)
+                    if a.is_literal else self.eval_value(a, segment) for a in args]
+            return td.eval_np(*vals)
         raise UnsupportedQueryError(f"host transform {name}")
 
     # -- shapes ------------------------------------------------------------
@@ -282,16 +304,26 @@ class HostSegmentExecutor:
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
 
     def _selection(self, query, segment, mask) -> SelectionIntermediate:
-        cols: list[str] = []
-        for e in query.select_expressions:
-            if e.is_identifier:
-                if e.identifier == "*":
-                    cols.extend(segment.columns())
-                else:
-                    cols.append(e.identifier)
-            else:
-                raise UnsupportedQueryError("selection transforms unsupported")
-        return selection_from_mask(query, segment, cols, mask)
+        from .selection import selection_columns_for
+
+        cols, exprs = selection_columns_for(query, segment)
+        return selection_from_mask(
+            query, segment, cols, mask, extra_exprs=exprs or None,
+            evaluator=lambda e, doc_ids: self.eval_value_at(e, segment, doc_ids))
+
+    def eval_value_at(self, e: ExpressionContext, segment, doc_ids) -> np.ndarray:
+        """Evaluate a transform expression over a row subset only (LIMIT-k
+        selections must not pay O(num_docs) python time)."""
+        from ..query.transforms import eval_expr_np
+
+        try:
+            out = eval_expr_np(e, lambda name: segment.get_values(name)[doc_ids])
+        except UnsupportedQueryError:
+            return np.asarray(self.eval_value(e, segment))[doc_ids]
+        out = np.asarray(out)
+        if out.ndim == 0:
+            out = np.broadcast_to(out, (len(doc_ids),)).copy()
+        return out
 
 
 def eval_json_match(p: Predicate, segment) -> np.ndarray:

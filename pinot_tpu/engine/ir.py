@@ -61,8 +61,21 @@ class ConstParam(ValueExpr):
 
 
 @dataclass(frozen=True)
+class ParamGather(ValueExpr):
+    """params[param_idx][ids] — a host-computed lookup table gathered on
+    device. The planner uses this for dictionary transforms: a string/complex
+    transform function is evaluated ONCE over the column's dictionary on host
+    (cardinality values, not num_docs), and the per-row result becomes a
+    single gather — the TPU analogue of the reference evaluating dictionary-
+    based transforms per 10K-doc block."""
+
+    ids: ValueExpr  # int plane (IdsCol or another ParamGather for remaps)
+    param_idx: int
+
+
+@dataclass(frozen=True)
 class Bin(ValueExpr):
-    op: str  # add sub mul div mod pow eq ne lt le gt ge and or min max
+    op: str  # add sub mul div fdiv mod pow eq ne lt le gt ge and or min max
     a: ValueExpr
     b: ValueExpr
 
@@ -204,3 +217,8 @@ class Program:
     group_slots: tuple[int, ...] = ()
     group_strides: tuple[int, ...] = ()
     num_groups: int = 1
+    # expression group keys (derived dimensions): per-dim int ValueExprs,
+    # same strides. Used when a group-by key is a transform of a dict column
+    # (ids remapped through a host-computed LUT — ParamGather). When set,
+    # group_slots is empty.
+    group_vexprs: tuple[ValueExpr, ...] = ()

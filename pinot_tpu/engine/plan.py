@@ -24,6 +24,7 @@ import numpy as np
 from ..query.context import QueryContext
 from ..query.expressions import ExpressionContext, is_aggregation
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
+from ..query.transforms import IRBuilder, eval_expr_np, get_transform
 from ..segment.device_cache import SegmentDeviceView
 from ..segment.loader import ImmutableSegment
 from ..spi.data_types import DataType
@@ -41,6 +42,37 @@ class GroupDim:
 
 
 @dataclass
+class DerivedDictionary:
+    """Group-index → value table for a derived (expression) dimension."""
+
+    values: np.ndarray
+
+
+def collect_identifiers(e: ExpressionContext) -> set:
+    out = set()
+    if e.is_identifier:
+        out.add(e.identifier)
+    elif e.is_function:
+        for a in e.function.arguments:
+            out |= collect_identifiers(a)
+    return out
+
+
+def _coerce_like(vals: np.ndarray, v):
+    """Coerce a predicate literal to the transformed-value dtype."""
+    if vals.dtype.kind in "if":
+        if isinstance(v, bool):
+            return int(v)
+        if isinstance(v, str):
+            try:
+                return float(v) if vals.dtype.kind == "f" else int(float(v))
+            except ValueError:
+                return v
+        return v
+    return str(v)
+
+
+@dataclass
 class SegmentPlan:
     program: ir.Program
     slots: list  # (column, kind) in slot order; kind ∈ ids|mvids|raw|dict|null
@@ -48,6 +80,7 @@ class SegmentPlan:
     lowered_aggs: list[LoweredAgg] = field(default_factory=list)
     group_dims: list[GroupDim] = field(default_factory=list)
     selection_columns: list[str] = field(default_factory=list)
+    selection_exprs: dict = field(default_factory=dict)  # label → transform expr
 
     def gather_arrays(self, view: SegmentDeviceView) -> tuple:
         out = []
@@ -151,7 +184,95 @@ class SegmentPlanner(AggPlanContext):
             for i in range(len(pairs) - 2, -1, -2):
                 out = ir.Where(self.value_expr(pairs[i]), self.value_expr(pairs[i + 1]), out)
             return out
+        if name == "coalesce" and args and args[0].is_identifier:
+            m = self._meta(args[0].identifier)
+            base = self.value_expr(args[0])
+            if not m.has_nulls or len(args) < 2:
+                return base
+            null_slot = self.slot(args[0].identifier, "null")
+            return ir.Where(ir.Un("not", ir.Col(null_slot)), base, self.value_expr(args[1]))
+        td = get_transform(name)
+        if td is not None and td.lower is not None:
+            try:
+                return td.lower(IRBuilder(self), list(args))
+            except (UnsupportedQueryError, ValueError, KeyError):
+                pass
+        ve = self._dict_transform_expr(e)
+        if ve is not None:
+            return ve
         raise UnsupportedQueryError(f"transform function {name} not lowered to device")
+
+    DICT_TRANSFORM_LIMIT = 1 << 18  # max cartesian LUT size for 2-col transforms
+
+    def _dict_transform_expr(self, e: ExpressionContext) -> Optional[ir.ValueExpr]:
+        """Numeric-valued transform over dict-encoded SV columns → evaluate
+        over the DICTIONARY (or the cartesian product of two dictionaries) on
+        host, ship the result as a LUT param, gather by (joint) dict id on
+        device (ir.ParamGather)."""
+        prep = self._dict_transform_values(e)
+        if prep is None:
+            return None
+        index_vexpr, out = prep
+        if out.dtype.kind == "b":
+            out = out.astype(np.int64)
+        if out.dtype.kind not in "if":
+            return None  # string-valued: usable for predicates/group-by only
+        return ir.ParamGather(index_vexpr, self.param(out))
+
+    def _dict_transform_values(self, e: ExpressionContext):
+        """(joint-id ValueExpr, transform(dictionary values)) when e is a
+        function of 1-2 dict-encoded SV columns, else None. For two columns
+        the LUT covers the cardinality cartesian product and the joint id is
+        id_a * card_b + id_b — same arithmetic as the dense group key."""
+        cols = sorted(collect_identifiers(e))
+        if not 1 <= len(cols) <= 2:
+            return None
+        infos = []
+        product = 1
+        for c in cols:
+            if not self.segment.has_column(c):
+                return None
+            m = self.segment.column_metadata(c)
+            if m.encoding != "DICT" or not m.single_value:
+                return None
+            vals = np.asarray(self.segment.get_dictionary(c).values)
+            infos.append((c, len(vals), vals))
+            product *= len(vals)
+        if product > self.DICT_TRANSFORM_LIMIT:
+            return None
+        if len(infos) == 1:
+            c, _, vals = infos[0]
+            grids = {c: vals}
+            index_vexpr: ir.ValueExpr = ir.IdsCol(self.slot(c, "ids"))
+        else:
+            (c1, k1, v1), (c2, k2, v2) = infos
+            grids = {c1: np.repeat(v1, k2), c2: np.tile(v2, k1)}
+            index_vexpr = ir.Bin(
+                "add",
+                ir.Bin("mul", ir.IdsCol(self.slot(c1, "ids")),
+                       ir.ConstParam(self.param(np.int32(k2)))),
+                ir.IdsCol(self.slot(c2, "ids")))
+        try:
+            out = eval_expr_np(e, lambda name: grids[name])
+        except (UnsupportedQueryError, ValueError, KeyError, TypeError):
+            return None
+        out = np.asarray(out)
+        if out.shape != (product,):
+            out = np.broadcast_to(out, (product,)).copy()
+        return index_vexpr, out
+
+    def _derived_dim(self, ge: ExpressionContext):
+        """Group-by key = transform of one dict column: transform the
+        dictionary on host, unique the results, remap dict ids → dense group
+        ids through a LUT gather. Covers GROUP BY year(ts), upper(name),
+        substr(c,0,3)... with the same dense segment_sum fast path."""
+        prep = self._dict_transform_values(ge)
+        if prep is None:
+            return None
+        index_vexpr, out = prep
+        uniq, inv = np.unique(out, return_inverse=True)
+        vexpr = ir.ParamGather(index_vexpr, self.param(inv.astype(np.int32)))
+        return vexpr, len(uniq), DerivedDictionary(uniq)
 
     # -- filter lowering ---------------------------------------------------
     def lower_filter(self, f: Optional[FilterContext]) -> Optional[ir.FilterNode]:
@@ -187,7 +308,58 @@ class SegmentPlanner(AggPlanContext):
         info = self.dict_info(lhs) if lhs.is_identifier else None
         if info is not None:
             return self._lower_dict_predicate(p, lhs, info)
+        if lhs.is_function:
+            try:
+                return self._lower_value_predicate(p)
+            except UnsupportedQueryError:
+                node = self._lower_fn_dict_predicate(p)
+                if node is not None:
+                    return node
+                raise
         return self._lower_value_predicate(p)
+
+    def _lower_fn_dict_predicate(self, p: Predicate) -> Optional[ir.FilterNode]:
+        """Predicate over a (possibly string-valued) transform of one dict
+        column: evaluate transform + predicate against the dictionary on host
+        → boolean LUT over dict ids (e.g. WHERE upper(name) = 'BOS')."""
+        prep = self._dict_transform_values(p.lhs)
+        if prep is None:
+            return None
+        index_vexpr, vals = prep
+        card = len(vals)
+        m = np.zeros(card, dtype=bool)
+        if p.type in (PredicateType.EQ, PredicateType.NOT_EQ):
+            m = vals == _coerce_like(vals, p.values[0])
+            if p.type == PredicateType.NOT_EQ:
+                m = ~m
+        elif p.type in (PredicateType.IN, PredicateType.NOT_IN):
+            for v in p.values:
+                m |= vals == _coerce_like(vals, v)
+            if p.type == PredicateType.NOT_IN:
+                m = ~m
+        elif p.type == PredicateType.RANGE:
+            m = np.ones(card, dtype=bool)
+            if p.lower is not None:
+                lo = _coerce_like(vals, p.lower)
+                m &= (vals >= lo) if p.lower_inclusive else (vals > lo)
+            if p.upper is not None:
+                hi = _coerce_like(vals, p.upper)
+                m &= (vals <= hi) if p.upper_inclusive else (vals < hi)
+        elif p.type in (PredicateType.LIKE, PredicateType.REGEXP_LIKE):
+            regex = (like_to_regex(p.values[0]) if p.type == PredicateType.LIKE
+                     else re.compile(str(p.values[0])))
+            m = np.asarray([regex.search(str(x)) is not None for x in vals], dtype=bool)
+        else:
+            return None
+        if isinstance(index_vexpr, ir.IdsCol):
+            lut = np.zeros(card + 1, dtype=bool)
+            lut[:card] = m
+            return ir.Lut(index_vexpr.slot, self.param(lut), mv=False)
+        # joint-id LUT: gather 0/1 then compare (ids never exceed the product)
+        pi = self.param(np.int32(1))
+        return ir.Interval(
+            ir.ParamGather(index_vexpr, self.param(m.astype(np.int32))),
+            lo_param=pi, hi_param=pi)
 
     def _lower_dict_predicate(self, p: Predicate, lhs, info) -> ir.FilterNode:
         ids_slot, card, d = info
@@ -308,18 +480,31 @@ class SegmentPlanner(AggPlanContext):
             if q.distinct and not q.is_aggregation_query:
                 group_exprs = [e for e in q.select_expressions]
             group_slots = []
+            group_vexprs = []
             cards = []
+            any_derived = False
             for ge in group_exprs:
-                info = self.dict_info(ge)
-                if info is None:
-                    raise UnsupportedQueryError(f"group-by on non-dict expression {ge}")
-                m = self._meta(ge.identifier)
-                if not m.single_value:
-                    raise UnsupportedQueryError("group-by on MV column needs host path")
-                slot, card, d = info
-                group_slots.append(slot)
-                cards.append(card)
-                group_dims.append(GroupDim(ge.identifier, card, d))
+                if ge.is_identifier:
+                    info = self.dict_info(ge)
+                    if info is None:
+                        raise UnsupportedQueryError(f"group-by on non-dict column {ge}")
+                    m = self._meta(ge.identifier)
+                    if not m.single_value:
+                        raise UnsupportedQueryError("group-by on MV column needs host path")
+                    slot, card, d = info
+                    group_slots.append(slot)
+                    group_vexprs.append(ir.IdsCol(slot))
+                    cards.append(card)
+                    group_dims.append(GroupDim(ge.identifier, card, d))
+                else:
+                    derived = self._derived_dim(ge)
+                    if derived is None:
+                        raise UnsupportedQueryError(f"group-by on expression {ge} needs host path")
+                    vexpr, card, dd = derived
+                    any_derived = True
+                    group_vexprs.append(vexpr)
+                    cards.append(card)
+                    group_dims.append(GroupDim(str(ge), card, dd))
             num_groups = 1
             for c in cards:
                 num_groups *= c
@@ -344,25 +529,25 @@ class SegmentPlanner(AggPlanContext):
                 mode="group_by" if group_exprs else "aggregation",
                 filter=filt,
                 aggs=tuple(self.ops),
-                group_slots=tuple(group_slots),
+                group_slots=() if any_derived else tuple(group_slots),
                 group_strides=tuple(strides),
                 num_groups=num_groups,
+                group_vexprs=tuple(group_vexprs) if any_derived else (),
             )
             return SegmentPlan(program, self._slots, self._params, lowered, group_dims)
 
-        # selection: kernel computes the mask; host materializes rows
-        sel_cols = []
-        for e in q.select_expressions:
-            if e.is_identifier:
-                if e.identifier == "*":
-                    sel_cols.extend(self.segment.columns())
-                else:
-                    self._meta(e.identifier)
-                    sel_cols.append(e.identifier)
-            else:
-                raise UnsupportedQueryError("selection transforms need host path")
+        # selection: kernel computes the mask; host materializes rows.
+        # Transform select/order expressions evaluate host-side over the
+        # already-filtered doc ids only — the device's job here is the filter.
+        from .selection import selection_columns_for
+
+        sel_cols, sel_exprs = selection_columns_for(q, self.segment)
+        for c in sel_cols:
+            if c not in sel_exprs:
+                self._meta(c)
         program = ir.Program(mode="selection", filter=filt)
-        return SegmentPlan(program, self._slots, self._params, selection_columns=sel_cols)
+        return SegmentPlan(program, self._slots, self._params,
+                           selection_columns=sel_cols, selection_exprs=sel_exprs)
 
 
 _BIN_FN = {

@@ -101,11 +101,48 @@ class BrokerReducer:
             idx = {c: i for i, c in enumerate(combined.columns)}
             rows = list(rows)
             for ob in reversed(query.order_by_expressions):
-                ci = idx[ob.expression.identifier]
+                key = (ob.expression.identifier if ob.expression.is_identifier
+                       else str(ob.expression))
+                ci = idx[key]
                 rows.sort(key=lambda r, _ci=ci: _sort_key(r[_ci]), reverse=not ob.ascending)
         rows = [list(r) for r in rows[query.offset : query.offset + query.limit]]
-        types = [self._column_type(c) for c in combined.columns]
-        return ResultTable(DataSchema(list(combined.columns), types), rows)
+        # project away hidden ORDER BY-only columns the segments appended
+        final_cols = self._selection_final_columns(query, combined.columns)
+        if final_cols != list(combined.columns):
+            idx = {c: i for i, c in enumerate(combined.columns)}
+            keep = [idx[c] for c in final_cols]
+            rows = [[r[i] for i in keep] for r in rows]
+        types = [self._selection_column_type(c, i, rows)
+                 for i, c in enumerate(final_cols)]
+        return ResultTable(DataSchema(final_cols, types), rows)
+
+    def _selection_final_columns(self, query: QueryContext, columns) -> list[str]:
+        out = []
+        for e in query.select_expressions:
+            if e.is_identifier and e.identifier == "*":
+                out.extend(c for c in columns
+                           if self.schema is not None and self.schema.has_column(c))
+            elif e.is_identifier:
+                out.append(e.identifier)
+            else:
+                out.append(str(e))
+        return out
+
+    def _selection_column_type(self, column: str, ci: int, rows) -> str:
+        if self.schema is not None and self.schema.has_column(column):
+            return self.schema.field_spec(column).data_type.value
+        # transform expression column: infer from materialized values
+        for r in rows:
+            v = r[ci]
+            if isinstance(v, bool):
+                return "BOOLEAN"
+            if isinstance(v, int):
+                return "LONG"
+            if isinstance(v, float):
+                return "DOUBLE"
+            if isinstance(v, str):
+                return "STRING"
+        return "STRING"
 
     # -- schema ------------------------------------------------------------
     def _select_schema(self, query: QueryContext, group_exprs):
@@ -217,7 +254,14 @@ def _eval_post(e: ExpressionContext, env: dict):
             if a[i]:
                 return a[i + 1]
         return a[-1]
-    raise UnsupportedQueryError(f"post-aggregation function {name}")
+    if name == "coalesce":
+        for v in a:
+            if v is not None:
+                return v
+        return None
+    from ..query.transforms import eval_scalar
+
+    return eval_scalar(name, a)
 
 
 def _eval_having(f: FilterContext, env: dict) -> bool:

@@ -12,25 +12,68 @@ from .aggregation import UnsupportedQueryError
 from .results import SelectionIntermediate
 
 
-def selection_from_mask(query, segment, columns: list[str], mask: np.ndarray) -> SelectionIntermediate:
+def selection_from_mask(query, segment, columns: list[str], mask: np.ndarray,
+                        extra_exprs: dict | None = None,
+                        evaluator=None) -> SelectionIntermediate:
     """Materialize selected rows from a boolean doc mask (len == num_docs).
 
     Without ORDER BY, rows are capped at offset+limit per segment; with
     ORDER BY, rows sort per segment then trim to offset+limit (a valid
-    per-segment top-k — the broker re-sorts the merged rows)."""
+    per-segment top-k — the broker re-sorts the merged rows).
+
+    ``extra_exprs`` maps expression labels (appearing in ``columns``) →
+    ExpressionContext for transform select/order expressions;
+    ``evaluator(expr, doc_ids)`` materializes one of them over the already-
+    filtered (and, without ORDER BY, already-capped) doc ids only."""
     doc_ids = np.nonzero(mask)[0]
     total = int(doc_ids.shape[0])
     cap = query.offset + query.limit
     if not query.order_by_expressions:
         doc_ids = doc_ids[:cap]
-    cols = [segment.get_values(c)[doc_ids] for c in columns]
+
+    def column_values(c: str) -> np.ndarray:
+        if extra_exprs is not None and c in extra_exprs:
+            return np.asarray(evaluator(extra_exprs[c], doc_ids))
+        return segment.get_values(c)[doc_ids]
+
+    cols = [column_values(c) for c in columns]
     rows = list(zip(*[c.tolist() for c in cols])) if cols else []
     if query.order_by_expressions:
         idx = {c: i for i, c in enumerate(columns)}
+        order = list(range(len(rows)))
         for ob in reversed(query.order_by_expressions):
-            if not ob.expression.is_identifier or ob.expression.identifier not in idx:
-                raise UnsupportedQueryError("selection ORDER BY must reference selected columns")
-            ci = idx[ob.expression.identifier]
-            rows.sort(key=lambda r, _ci=ci: r[_ci], reverse=not ob.ascending)
-        rows = rows[:cap]
+            key = (ob.expression.identifier if ob.expression.is_identifier
+                   else str(ob.expression))
+            if key not in idx:
+                raise UnsupportedQueryError(
+                    "selection ORDER BY must reference selected columns")
+            arr = cols[idx[key]].tolist()
+            order.sort(key=lambda i, _a=arr: _a[i], reverse=not ob.ascending)
+        rows = [rows[i] for i in order[:cap]]
     return SelectionIntermediate(columns, rows, num_docs_scanned=total)
+
+
+def selection_columns_for(query, segment) -> tuple[list[str], dict]:
+    """(column labels incl. hidden ORDER BY-only transforms, label → expr map
+    for the transform columns). Shared by both planners so the intermediates
+    always carry every column the broker needs to re-sort; the reducer
+    projects hidden columns away after the final sort."""
+    cols: list[str] = []
+    exprs: dict = {}
+    for e in query.select_expressions:
+        if e.is_identifier:
+            if e.identifier == "*":
+                cols.extend(segment.columns())
+            else:
+                cols.append(e.identifier)
+        else:
+            label = str(e)
+            cols.append(label)
+            exprs[label] = e
+    for ob in query.order_by_expressions:
+        if not ob.expression.is_identifier:
+            label = str(ob.expression)
+            if label not in cols:
+                cols.append(label)
+                exprs[label] = ob.expression
+    return cols, exprs

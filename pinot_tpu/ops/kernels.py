@@ -40,6 +40,9 @@ def _eval_value(node: ir.ValueExpr, arrays, params):
         return arrays[node.dict_slot][arrays[node.ids_slot]]
     if isinstance(node, ir.ConstParam):
         return params[node.idx]
+    if isinstance(node, ir.ParamGather):
+        ids = _eval_value(node.ids, arrays, params)
+        return params[node.param_idx][ids]
     if isinstance(node, ir.Bin):
         a = _eval_value(node.a, arrays, params)
         b = _eval_value(node.b, arrays, params)
@@ -62,6 +65,7 @@ _BIN_OPS = {
     "sub": jnp.subtract,
     "mul": jnp.multiply,
     "div": jnp.true_divide,
+    "fdiv": jnp.floor_divide,
     "mod": jnp.mod,
     "pow": jnp.power,
     "eq": lambda a, b: a == b,
@@ -176,8 +180,13 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
     num_groups = program.num_groups
     if program.mode == "group_by":
         gid = jnp.zeros((n,), dtype=jnp.int32)
-        for slot, stride in zip(program.group_slots, program.group_strides):
-            gid = gid + arrays[slot].astype(jnp.int32) * jnp.int32(stride)
+        if program.group_vexprs:
+            for vexpr, stride in zip(program.group_vexprs, program.group_strides):
+                v = _eval_value(vexpr, arrays, params)
+                gid = gid + v.astype(jnp.int32) * jnp.int32(stride)
+        else:
+            for slot, stride in zip(program.group_slots, program.group_strides):
+                gid = gid + arrays[slot].astype(jnp.int32) * jnp.int32(stride)
     else:
         gid = jnp.zeros((n,), dtype=jnp.int32)
     trash = jnp.int32(num_groups)

@@ -1,0 +1,258 @@
+"""Transform function library: civil-date math, device lowering, parity.
+
+Mirrors the reference's transform-function tests (pinot-core/src/test/.../
+operator/transform/) plus the BaseQueriesTest differential pattern: every
+query shape runs on both backends and must match. Device lowering is
+additionally asserted directly (SegmentPlanner must not fall back) so the
+differential test can't silently become host-vs-host.
+"""
+
+import datetime as dt
+import math
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.plan import SegmentPlanner
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.query.parser.sql import parse_sql
+from pinot_tpu.query.transforms import (
+    _np_datetrunc,
+    _np_day,
+    _np_dayofweek,
+    _np_dayofyear,
+    _np_month,
+    _np_timestampadd,
+    _np_timestampdiff,
+    _np_week,
+    _np_year,
+    eval_scalar,
+)
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+EPOCH = dt.datetime(1970, 1, 1)
+
+
+# ---------------------------------------------------------------------------
+# civil-date integer arithmetic vs python datetime (oracle)
+# ---------------------------------------------------------------------------
+
+
+def _random_millis(n=500, seed=7):
+    rng = np.random.default_rng(seed)
+    # 1902..2100, including pre-1970 to exercise floor-division semantics
+    return rng.integers(-2_145_916_800_000, 4_102_444_800_000, n, dtype=np.int64)
+
+
+def test_civil_extraction_matches_datetime():
+    ms = _random_millis()
+    for m, y_, mo_, d_, dow_, doy_, wk_ in zip(
+            ms, _np_year(ms), _np_month(ms), _np_day(ms), _np_dayofweek(ms),
+            _np_dayofyear(ms), _np_week(ms)):
+        t = EPOCH + dt.timedelta(milliseconds=int(m))
+        assert (y_, mo_, d_) == (t.year, t.month, t.day), int(m)
+        assert dow_ == t.isocalendar()[2]
+        assert doy_ == t.timetuple().tm_yday
+        assert wk_ == t.isocalendar()[1]
+
+
+@pytest.mark.parametrize("unit", ["SECOND", "MINUTE", "HOUR", "DAY", "WEEK",
+                                  "MONTH", "QUARTER", "YEAR"])
+def test_datetrunc_matches_datetime(unit):
+    for m in _random_millis(100, seed=unit.__hash__() % 1000):
+        t = EPOCH + dt.timedelta(milliseconds=int(m))
+        got = EPOCH + dt.timedelta(milliseconds=int(_np_datetrunc(unit, int(m))))
+        if unit == "SECOND":
+            want = t.replace(microsecond=0)
+        elif unit == "MINUTE":
+            want = t.replace(second=0, microsecond=0)
+        elif unit == "HOUR":
+            want = t.replace(minute=0, second=0, microsecond=0)
+        elif unit == "DAY":
+            want = t.replace(hour=0, minute=0, second=0, microsecond=0)
+        elif unit == "WEEK":
+            d0 = t.date() - dt.timedelta(days=t.isocalendar()[2] - 1)
+            want = dt.datetime(d0.year, d0.month, d0.day)
+        elif unit == "MONTH":
+            want = dt.datetime(t.year, t.month, 1)
+        elif unit == "QUARTER":
+            want = dt.datetime(t.year, ((t.month - 1) // 3) * 3 + 1, 1)
+        else:
+            want = dt.datetime(t.year, 1, 1)
+        assert got == want, (unit, t)
+
+
+def test_timestamp_add_diff():
+    base = int((dt.datetime(2020, 1, 31) - EPOCH).total_seconds() * 1000)
+    # month-end clamping: Jan 31 + 1 month = Feb 29 (2020 is a leap year)
+    got = EPOCH + dt.timedelta(milliseconds=int(_np_timestampadd("MONTH", 1, base)))
+    assert got == dt.datetime(2020, 2, 29)
+    assert int(_np_timestampdiff("DAY", base, base + 86_400_000 * 3)) == 3
+    a = int((dt.datetime(2020, 1, 15) - EPOCH).total_seconds() * 1000)
+    b = int((dt.datetime(2021, 3, 20) - EPOCH).total_seconds() * 1000)
+    assert int(_np_timestampdiff("MONTH", a, b)) == 14
+    assert int(_np_timestampdiff("YEAR", a, b)) == 1
+
+
+def test_scalar_forms():
+    assert eval_scalar("upper", ["boston"]) == "BOSTON"
+    assert eval_scalar("concat", ["a", "b", "-"]) == "a-b"
+    assert eval_scalar("length", ["hello"]) == 5
+    assert eval_scalar("sha256", ["x"]) == (
+        "2d711642b726b04401627ca9fbac32f5c8530fb1903cc4db02258717921a4881")
+    assert eval_scalar("regexpextract", ["ab123cd", r"(\d+)", 1, ""]) == "123"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end differential: tpu vs host over a time-series-ish table
+# ---------------------------------------------------------------------------
+
+N1, N2 = 800, 600
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    rng = np.random.default_rng(42)
+    tmp = tmp_path_factory.mktemp("tsegs")
+    schema = Schema.build(
+        "events",
+        dimensions=[("ts", "LONG"), ("name", "STRING"), ("city", "STRING")],
+        metrics=[("val", "DOUBLE"), ("qty", "INT")],
+    )
+    lo = int((dt.datetime(2019, 1, 1) - EPOCH).total_seconds() * 1000)
+    hi = int((dt.datetime(2023, 12, 31) - EPOCH).total_seconds() * 1000)
+    names = ["alpha", "Beta", "GAMMA", "delta_x", "Epsilon"]
+    cities = ["nyc", "sfo", "chi", "aus"]
+    segments = []
+    for si, n in enumerate([N1, N2]):
+        cols = {
+            "ts": rng.integers(lo, hi, n, dtype=np.int64),
+            "name": [names[int(rng.integers(len(names)))] for _ in range(n)],
+            "city": [cities[int(rng.integers(len(cities)))] for _ in range(n)],
+            "val": np.round(rng.random(n) * 1000, 3),
+            "qty": rng.integers(1, 100, n).astype(np.int32),
+        }
+        d = tmp / f"seg_{si}"
+        SegmentBuilder(schema, segment_name=f"seg_{si}").build(cols, d)
+        segments.append(load_segment(d))
+    return schema, segments
+
+
+def executors(table):
+    schema, segments = table
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, segments)
+    host = QueryExecutor(backend="host")
+    host.add_table(schema, segments)
+    return tpu, host
+
+
+def assert_same(tpu_resp, host_resp):
+    rt, rh = tpu_resp.result_table, host_resp.result_table
+    assert rt is not None, f"tpu failed: {tpu_resp.exceptions}"
+    assert rh is not None, f"host failed: {host_resp.exceptions}"
+    rows_t = sorted(rt.rows, key=repr)
+    rows_h = sorted(rh.rows, key=repr)
+    assert len(rows_t) == len(rows_h), f"{len(rows_t)} vs {len(rows_h)}"
+    for a, b in zip(rows_t, rows_h):
+        for x, y in zip(a, b):
+            if isinstance(x, float) and isinstance(y, float):
+                if math.isnan(x) and math.isnan(y):
+                    continue
+                assert x == pytest.approx(y, rel=1e-9), (a, b)
+            else:
+                assert x == y, (a, b)
+
+
+QUERIES = [
+    # datetime extraction as group key (device: civil-date arithmetic)
+    "SELECT year(ts), COUNT(*) FROM events GROUP BY year(ts) ORDER BY year(ts) LIMIT 10",
+    "SELECT year(ts), month(ts), SUM(val) FROM events GROUP BY year(ts), month(ts) LIMIT 100",
+    "SELECT dayOfWeek(ts), COUNT(*) FROM events GROUP BY dayOfWeek(ts) LIMIT 10",
+    "SELECT datetrunc('MONTH', ts), COUNT(*) FROM events GROUP BY datetrunc('MONTH', ts) LIMIT 100",
+    "SELECT toEpochDays(ts), COUNT(*) FROM events GROUP BY toEpochDays(ts) LIMIT 3000",
+    # datetime in filters
+    "SELECT COUNT(*) FROM events WHERE year(ts) = 2021",
+    "SELECT SUM(qty) FROM events WHERE month(ts) IN (1, 2, 12)",
+    "SELECT COUNT(*) FROM events WHERE hour(ts) BETWEEN 9 AND 17",
+    # string transforms in filters (dict-LUT path)
+    "SELECT COUNT(*) FROM events WHERE upper(name) = 'BETA'",
+    "SELECT COUNT(*) FROM events WHERE lower(name) IN ('alpha', 'gamma')",
+    "SELECT COUNT(*) FROM events WHERE startsWith(name, 'de') = true",
+    "SELECT COUNT(*) FROM events WHERE length(name) > 5",
+    "SELECT COUNT(*) FROM events WHERE substr(name, 0, 1) = 'B'",
+    # string transforms as group keys (derived dimension remap)
+    "SELECT upper(city), COUNT(*) FROM events GROUP BY upper(city) LIMIT 10",
+    "SELECT length(name), SUM(qty) FROM events GROUP BY length(name) LIMIT 10",
+    "SELECT concat(city, name, '_'), COUNT(*) FROM events GROUP BY concat(city, name, '_') LIMIT 100",
+    # numeric transforms in aggregation inputs
+    "SELECT SUM(round(val, 10)) FROM events",
+    "SELECT MAX(sqrt(val)), MIN(abs(val)) FROM events",
+    "SELECT year(ts), AVG(val) FROM events WHERE city = 'nyc' GROUP BY year(ts) LIMIT 10",
+    # timestamp arithmetic
+    "SELECT COUNT(*) FROM events WHERE timestampDiff('DAY', fromEpochDays(17897), ts) > 365",
+    # post-aggregation transforms
+    "SELECT city, concat(city, 'x', '-') FROM events GROUP BY city LIMIT 10",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_differential(table, sql):
+    tpu, host = executors(table)
+    assert_same(tpu.execute_sql(sql), host.execute_sql(sql))
+
+
+DEVICE_LOWERED = [
+    "SELECT year(ts), COUNT(*) FROM events GROUP BY year(ts) LIMIT 10",
+    "SELECT COUNT(*) FROM events WHERE upper(name) = 'BETA'",
+    "SELECT upper(city), COUNT(*) FROM events GROUP BY upper(city) LIMIT 10",
+    "SELECT SUM(round(val, 10)) FROM events",
+    "SELECT COUNT(*) FROM events WHERE hour(ts) BETWEEN 9 AND 17",
+    "SELECT datetrunc('MONTH', ts), COUNT(*) FROM events GROUP BY datetrunc('MONTH', ts) LIMIT 100",
+]
+
+
+@pytest.mark.parametrize("sql", DEVICE_LOWERED)
+def test_device_lowering_does_not_fall_back(table, sql):
+    _, segments = table
+    q = parse_sql(sql)
+    plan = SegmentPlanner(q, segments[0]).plan()  # raises on fallback
+    assert plan.program is not None
+
+
+def test_order_by_transform_not_in_select_list(table):
+    # hidden order-by column must be appended per segment then projected away
+    tpu, host = executors(table)
+    sql = "SELECT name FROM events WHERE city = 'nyc' ORDER BY upper(name), name LIMIT 15"
+    rt = tpu.execute_sql(sql)
+    rh = host.execute_sql(sql)
+    assert not rt.exceptions and not rh.exceptions, (rt.exceptions, rh.exceptions)
+    assert rt.result_table.schema.column_names == ["name"]
+    assert rt.result_table.rows == rh.result_table.rows
+
+
+def test_coalesce_inside_transform_falls_back_correctly(table):
+    # eval_expr_np must refuse coalesce (dict space has no per-doc nullness);
+    # the auto backend falls back to host and returns correct results
+    _, segments = table
+    schema = table[0]
+    ex = QueryExecutor(backend="auto")
+    ex.add_table(schema, segments)
+    r = ex.execute_sql("SELECT COUNT(*) FROM events WHERE upper(coalesce(name, 'zz')) = 'BETA'")
+    assert not r.exceptions, r.exceptions
+    host = QueryExecutor(backend="host")
+    host.add_table(schema, segments)
+    rh = host.execute_sql("SELECT COUNT(*) FROM events WHERE upper(name) = 'BETA'")
+    assert r.result_table.rows == rh.result_table.rows
+
+
+def test_selection_with_transforms(table):
+    tpu, host = executors(table)
+    sql = ("SELECT name, upper(name), length(name) FROM events "
+           "WHERE city = 'sfo' ORDER BY length(name), name LIMIT 20")
+    rt = tpu.execute_sql(sql).result_table
+    rh = host.execute_sql(sql).result_table
+    assert rt is not None and rh is not None
+    assert rt.rows == rh.rows
