@@ -103,6 +103,9 @@ class SegmentPlan:
 class SegmentPlanner(AggPlanContext):
     def __init__(self, query: QueryContext, segment: ImmutableSegment):
         super().__init__()
+        if getattr(segment, "is_mutable", False):
+            raise UnsupportedQueryError(
+                "consuming (mutable) segments execute on the host engine")
         self.query = query
         self.segment = segment
         self._slots: list[tuple[str, str]] = []

@@ -55,7 +55,12 @@ class QueryExecutor:
         self.use_star_tree = True  # reference: useStarTree query option default true
 
     def add_table(self, schema: Schema, segments: list[ImmutableSegment], name: Optional[str] = None):
-        self.tables[name or schema.schema_name] = Table(name or schema.schema_name, schema, list(segments))
+        """``segments`` is held BY REFERENCE when it is a list: realtime data
+        managers mutate it in place as segments commit/rotate and queries see
+        the live view (snapshotted per query)."""
+        self.tables[name or schema.schema_name] = Table(
+            name or schema.schema_name, schema,
+            segments if isinstance(segments, list) else list(segments))
 
     def execute_sql(self, sql: str) -> BrokerResponse:
         try:
@@ -77,8 +82,12 @@ class QueryExecutor:
         intermediates = []
         total_docs = 0
         try:
-            kept, num_pruned = self.pruner.prune(query, table.segments)
-            for segment in table.segments:
+            # snapshot: realtime tables mutate the live list concurrently;
+            # consuming segments pin a consistent row-count view per query
+            segments = [s.snapshot_view() if getattr(s, "is_mutable", False) else s
+                        for s in list(table.segments)]
+            kept, num_pruned = self.pruner.prune(query, segments)
+            for segment in segments:
                 total_docs += segment.num_docs
             for segment in kept:
                 intermediates.append(self._execute_segment(query, segment))
@@ -113,7 +122,9 @@ class QueryExecutor:
         run_query, run_segment = (
             (rewrite.query, rewrite.view) if rewrite is not None else (query, segment))
 
-        if self.backend == "host":
+        if self.backend == "host" or getattr(run_segment, "is_mutable", False):
+            # consuming segments execute on host (unsorted mutable
+            # dictionaries have no device predicate form until commit)
             result = self.host.execute(run_query, run_segment)
         elif self.backend == "tpu":
             result = self.tpu.execute(run_query, run_segment)

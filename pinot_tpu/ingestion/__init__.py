@@ -1,0 +1,1 @@
+from .transform import CompositeTransformer, build_transform_pipeline  # noqa: F401

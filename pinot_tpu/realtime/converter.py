@@ -1,0 +1,37 @@
+"""Mutable → immutable segment conversion on commit.
+
+Reference: RealtimeSegmentConverter (pinot-segment-local/.../realtime/
+converter/) — snapshot the consuming segment's rows, sort on the configured
+sorted column, and run the standard two-pass immutable build
+(SegmentBuilder), after which the segment is device-executable (sorted
+dictionaries, fixed-bit planes, persisted indexes).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from ..segment.builder import SegmentBuilder
+from ..segment.mutable import MutableSegment
+
+
+class RealtimeSegmentConverter:
+    def __init__(self, schema, table_config=None):
+        self.schema = schema
+        self.table_config = table_config
+
+    def convert(self, segment: MutableSegment, out_dir: str | Path) -> Path:
+        columns = segment.to_columns()
+        sort_col = None
+        if self.table_config is not None:
+            sort_col = self.table_config.indexing.sorted_column
+        if sort_col and sort_col in columns and segment.num_docs > 0:
+            keys = columns[sort_col]
+            order = sorted(range(len(keys)),
+                           key=lambda i: (keys[i] is None, keys[i]))
+            columns = {c: [v[i] for i in order] for c, v in columns.items()}
+        builder = SegmentBuilder(self.schema, segment_name=segment.segment_name,
+                                 table_config=self.table_config)
+        return builder.build(columns, out_dir)

@@ -1,0 +1,339 @@
+"""Realtime consumption: per-partition consumer threads + commit protocol.
+
+Reference call stack (SURVEY.md §3.3): RealtimeTableDataManager.
+doAddConsumingSegment → RealtimeSegmentDataManager (pinot-core/.../data/
+manager/realtime/RealtimeSegmentDataManager.java:123) whose PartitionConsumer
+thread (run:717-880) loops CONSUMING → (end criteria) → HOLDING → COMMITTING
+→ COMMITTED, then the table manager replaces the mutable segment with the
+committed immutable one and opens the next consuming segment from the end
+offset.
+
+Single-process simplifications vs the reference, kept behind the same
+interfaces so the cluster layer can swap them out:
+- the segment-completion FSM (controller SegmentCompletionManager) collapses
+  to an in-process ``commit()`` — one replica, always the winner;
+- ZK segment metadata collapses to a JSON checkpoint file per table holding
+  committed end offsets (crash → resume from last committed offset, the
+  reference's exactly-once guarantee via segments-as-checkpoints).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..ingestion.transform import build_transform_pipeline
+from ..segment.loader import ImmutableSegment, load_segment
+from ..segment.mutable import MutableSegment
+from ..spi.stream import (
+    LongMsgOffset,
+    StreamConfig,
+    get_decoder,
+    get_stream_consumer_factory,
+)
+from .converter import RealtimeSegmentConverter
+
+log = logging.getLogger(__name__)
+
+# consumption states (reference RealtimeSegmentDataManager.State)
+CONSUMING = "CONSUMING"
+HOLDING = "HOLDING"
+COMMITTING = "COMMITTING"
+COMMITTED = "COMMITTED"
+ERROR = "ERROR"
+
+
+def llc_segment_name(table: str, partition: int, seq: int,
+                     ts_ms: Optional[int] = None) -> str:
+    """LLC naming: {table}__{partition}__{seq}__{timestamp} (reference
+    LLCSegmentName)."""
+    ts = ts_ms if ts_ms is not None else int(time.time() * 1000)
+    return f"{table}__{partition}__{seq}__{ts}"
+
+
+class RealtimeSegmentDataManager:
+    """One consuming segment on one partition: consumer thread with the
+    consume → end-criteria → commit state machine."""
+
+    def __init__(self, schema, table_config, stream_config: StreamConfig,
+                 partition: int, seq: int, start_offset: LongMsgOffset,
+                 on_commit: Callable[["RealtimeSegmentDataManager"], None],
+                 poll_idle_s: float = 0.02):
+        self.schema = schema
+        self.table_config = table_config
+        self.stream_config = stream_config
+        self.partition = partition
+        self.seq = seq
+        self.start_offset = start_offset
+        self.current_offset = start_offset
+        self.on_commit = on_commit
+        self.poll_idle_s = poll_idle_s
+
+        self.segment = MutableSegment(
+            schema, llc_segment_name(table_config.table_name, partition, seq))
+        factory = get_stream_consumer_factory(stream_config)
+        self.consumer = factory.create_partition_consumer(partition)
+        self.decoder = get_decoder(stream_config)
+        self.pipeline = build_transform_pipeline(schema, table_config)
+
+        self.state = CONSUMING
+        self.consume_start_ms = int(time.time() * 1000)
+        self.last_consumed_ms = self.consume_start_ms  # IngestionDelayTracker
+        self.rows_indexed = 0
+        self.rows_filtered = 0
+        self.rows_errored = 0
+        self._stop = threading.Event()
+        self._force_commit = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"consumer-{self.segment.segment_name}", daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        self._thread.join(timeout)
+        self.consumer.close()
+
+    def force_commit(self):
+        """Seal now regardless of thresholds (reference forceCommit /
+        pauseless commit trigger; minion RealtimeToOfflineSegmentsTask uses
+        this to roll segments)."""
+        self._force_commit.set()
+
+    def join_committed(self, timeout: float = 30.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if self.state in (COMMITTED, ERROR):
+                return self.state == COMMITTED
+            time.sleep(0.01)
+        return False
+
+    # -- the consume loop (reference PartitionConsumer.run:717-880) --------
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                batch = self.consumer.fetch_messages(
+                    self.current_offset, self.stream_config.fetch_timeout_ms)
+                if batch.message_count:
+                    self._index_batch(batch)
+                    self.current_offset = batch.offset_of_next_batch
+                    self.last_consumed_ms = int(time.time() * 1000)
+                else:
+                    time.sleep(self.poll_idle_s)
+                if self._end_criteria_reached():
+                    self._commit()
+                    return
+            # stopped while consuming: leave segment mutable (HOLDING);
+            # offsets below the last commit re-consume on restart
+            self.state = HOLDING
+        except Exception:  # noqa: BLE001 — consumer thread must not die silently
+            log.exception("consumer %s failed", self.segment.segment_name)
+            self.state = ERROR
+
+    def _index_batch(self, batch):
+        for msg in batch.messages:
+            row = self.decoder.decode(msg)
+            if row is None:
+                self.rows_errored += 1
+                continue
+            row = self.pipeline.transform(dict(row))
+            if row is None:
+                self.rows_filtered += 1
+                continue
+            self.segment.index(row)
+            self.rows_indexed += 1
+
+    @property
+    def num_docs(self) -> int:
+        return self.segment.num_docs
+
+    def _end_criteria_reached(self) -> bool:
+        if self._force_commit.is_set() and self.segment.num_docs > 0:
+            return True
+        if self.segment.num_docs >= self.stream_config.flush_threshold_rows:
+            return True
+        age_ms = int(time.time() * 1000) - self.consume_start_ms
+        return (age_ms >= self.stream_config.flush_threshold_time_ms
+                and self.segment.num_docs > 0)
+
+    def _commit(self):
+        self.state = COMMITTING
+        try:
+            self.on_commit(self)
+            self.state = COMMITTED
+        except Exception:  # noqa: BLE001
+            log.exception("commit of %s failed", self.segment.segment_name)
+            self.state = ERROR
+
+
+class RealtimeTableDataManager:
+    """Per-table realtime lifecycle: one consuming segment per partition,
+    sealed segments on disk, committed-offset checkpointing.
+
+    ``segments`` is a live list (committed immutables + consuming mutables) —
+    the query executor snapshots it per query."""
+
+    def __init__(self, schema, table_config, data_dir: str | Path,
+                 segment_hook: Optional[Callable] = None):
+        self.schema = schema
+        self.table_config = table_config
+        self.stream_config = StreamConfig.from_table_config(
+            table_config.ingestion.stream_configs)
+        self.data_dir = Path(data_dir)
+        self.data_dir.mkdir(parents=True, exist_ok=True)
+        self.converter = RealtimeSegmentConverter(schema, table_config)
+        self.segment_hook = segment_hook  # cluster layer: upsert/dedup attach
+        self.segments: list = []  # live view: immutables + mutables
+        self._committed: list[ImmutableSegment] = []
+        self._consuming: dict[int, RealtimeSegmentDataManager] = {}
+        self._seq: dict[int, int] = {}
+        self._lock = threading.RLock()
+        self._shutdown = False
+        self._checkpoint_file = self.data_dir / "_checkpoints.json"
+        cp = self._load_checkpoints()
+        self._offsets: dict[str, str] = cp.get("partitions", {})
+        self._segment_names: list[str] = cp.get("segments", [])
+
+    # -- checkpoints (ZK segment-metadata equivalent) ----------------------
+    # The checkpoint file is the COMMIT POINT: it atomically records both the
+    # committed segment names and the advanced offsets, so a crash anywhere
+    # around conversion either (a) leaves the file untouched — the partial
+    # segment dir is ignored+removed on restart and its rows re-consume, or
+    # (b) records both — the segment loads and consumption resumes past it.
+    # Rows land in exactly one committed segment either way.
+    def _load_checkpoints(self) -> dict:
+        if self._checkpoint_file.exists():
+            try:
+                return json.loads(self._checkpoint_file.read_text())
+            except ValueError:
+                # torn write can only happen with the legacy non-atomic
+                # writer; treat as empty (segments re-consume)
+                return {}
+        return {}
+
+    def _save_checkpoints(self):
+        tmp = self._checkpoint_file.with_suffix(".tmp")
+        tmp.write_text(json.dumps(
+            {"partitions": self._offsets, "segments": self._segment_names}))
+        tmp.replace(self._checkpoint_file)  # atomic on POSIX
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Load committed segments from disk, resume consumption from the
+        last committed offsets (crash recovery — reference: servers replay
+        Helix transitions then resume from segment.realtime.startOffset)."""
+        with self._lock:
+            known = set(self._segment_names)
+            for d in sorted(self.data_dir.iterdir()):
+                if not d.is_dir():
+                    continue
+                if d.name in known:
+                    self._committed.append(load_segment(d))
+                else:
+                    # crash leftover: conversion finished (or half-finished)
+                    # but the checkpoint never recorded it — rows re-consume
+                    import shutil
+
+                    shutil.rmtree(d, ignore_errors=True)
+            factory = get_stream_consumer_factory(self.stream_config)
+            meta = factory.create_metadata_provider()
+            n = meta.partition_count()
+            meta.close()
+            for p in range(n):
+                self._start_partition(p)
+            self._refresh_view()
+
+    def _start_partition(self, partition: int):
+        seq = self._seq.get(partition, 0)
+        start = LongMsgOffset.parse(self._offsets.get(str(partition), "0"))
+        if self._offsets.get(str(partition)) is None \
+                and self.stream_config.offset_criteria == "largest":
+            factory = get_stream_consumer_factory(self.stream_config)
+            meta = factory.create_metadata_provider()
+            start = meta.fetch_latest_offset(partition)
+            meta.close()
+        mgr = RealtimeSegmentDataManager(
+            self.schema, self.table_config, self.stream_config, partition, seq,
+            start, self._handle_commit)
+        self._consuming[partition] = mgr
+        self._seq[partition] = seq + 1
+        mgr.start()
+
+    def stop(self):
+        # order matters: the shutdown flag first, so a commit racing with us
+        # cannot spawn a successor consumer after we snapshot; then drain
+        # until no live managers remain (a successor may have started just
+        # before the flag was set)
+        with self._lock:
+            self._shutdown = True
+        while True:
+            with self._lock:
+                managers = [m for m in self._consuming.values()
+                            if m._thread.is_alive() or not m._stop.is_set()]
+            if not managers:
+                break
+            for m in managers:
+                m.stop()
+
+    # -- commit (in-process completion FSM) --------------------------------
+    def _handle_commit(self, mgr: RealtimeSegmentDataManager):
+        out_dir = self.data_dir / mgr.segment.segment_name
+        self.converter.convert(mgr.segment, out_dir)
+        committed = load_segment(out_dir)
+        if self.segment_hook is not None:
+            self.segment_hook(committed)
+        with self._lock:
+            self._committed.append(committed)
+            self._offsets[str(mgr.partition)] = str(mgr.current_offset)
+            self._segment_names.append(mgr.segment.segment_name)
+            self._save_checkpoints()  # ← the commit point (see above)
+            self._consuming.pop(mgr.partition, None)
+            if not self._shutdown:
+                self._start_partition_from(mgr.partition, mgr.current_offset)
+            self._refresh_view()
+        # the mutable segment is NOT destroyed here: in-flight queries may
+        # hold snapshot views of it; it drops out of the live list above and
+        # the GC reclaims it once the last query releases its snapshot
+
+    def _start_partition_from(self, partition: int, offset: LongMsgOffset):
+        seq = self._seq.get(partition, 0)
+        nxt = RealtimeSegmentDataManager(
+            self.schema, self.table_config, self.stream_config, partition, seq,
+            offset, self._handle_commit)
+        self._consuming[partition] = nxt
+        self._seq[partition] = seq + 1
+        nxt.start()
+
+    def _refresh_view(self):
+        self.segments[:] = list(self._committed) + [
+            m.segment for m in self._consuming.values()]
+
+    # -- ops ---------------------------------------------------------------
+    def force_commit(self, timeout: float = 30.0) -> list[str]:
+        """Seal all non-empty consuming segments, wait for their commits, and
+        return the committed segment names (ops endpoint + minion rollover).
+        Empty partitions are skipped — there is nothing to seal."""
+        with self._lock:
+            managers = [m for m in self._consuming.values() if m.num_docs > 0]
+        for m in managers:
+            m.force_commit()
+        out = []
+        for m in managers:
+            if m.join_committed(timeout):
+                out.append(m.segment.segment_name)
+        return out
+
+    def ingestion_delay_ms(self) -> dict[int, int]:
+        now = int(time.time() * 1000)
+        with self._lock:
+            return {p: now - m.last_consumed_ms for p, m in self._consuming.items()}
+
+    def total_docs(self) -> int:
+        with self._lock:
+            return sum(s.num_docs for s in self.segments)

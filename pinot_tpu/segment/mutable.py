@@ -1,0 +1,340 @@
+"""Mutable (consuming) segment: in-memory columnar store built row-at-a-time.
+
+Reference: MutableSegmentImpl (pinot-segment-local/.../indexsegment/mutable/
+MutableSegmentImpl.java:126, index():515) + the realtime mutable dictionary /
+forward index impls (.../realtime/impl/). Design differences, TPU-first:
+
+- Columns are append-only python/numpy buffers on host. Consuming segments
+  execute on the HOST engine (duck-typing the ImmutableSegment read API);
+  the device executes committed (immutable, sorted-dictionary) segments —
+  mirroring how the reference's realtime segments are slower scan-heavy
+  segments until conversion.
+- Mutable dictionaries are insertion-ordered (no sorted invariant), so the
+  planner refuses mutable segments (``is_mutable``) and the auto backend
+  falls back to host; on commit RealtimeSegmentConverter re-encodes with
+  sorted dictionaries for full device execution.
+- Readers see a consistent prefix: ``index()`` appends then publishes the new
+  row count last (single-writer, many-reader snapshot isolation — same
+  guarantee MutableSegmentImpl gives via its volatile numDocsIndexed).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..spi.data_types import DataType, FieldSpec, Schema, coerce_value
+from .format import ColumnMetadata
+
+_NUMERIC_NP = {
+    DataType.INT: np.int32,
+    DataType.LONG: np.int64,
+    DataType.FLOAT: np.float32,
+    DataType.DOUBLE: np.float64,
+    DataType.BOOLEAN: np.int8,
+    DataType.TIMESTAMP: np.int64,
+}
+
+
+class MutableDictionary:
+    """Insertion-ordered value↔id map (reference realtime mutable
+    dictionaries). ``values`` materializes for host predicate evaluation."""
+
+    def __init__(self):
+        self._index: dict = {}
+        self._values: list = []
+
+    def index_of(self, value) -> int:
+        return self._index.get(value, -1)
+
+    def upsert(self, value) -> int:
+        did = self._index.get(value)
+        if did is None:
+            did = len(self._values)
+            self._index[value] = did
+            self._values.append(value)
+        return did
+
+    def get(self, dict_id: int):
+        return self._values[dict_id]
+
+    @property
+    def values(self) -> np.ndarray:
+        return np.asarray(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+
+class _MutableColumn:
+    def __init__(self, spec: FieldSpec):
+        self.spec = spec
+        self.single_value = spec.single_value
+        dt = DataType(spec.data_type)
+        self.data_type = dt
+        # dimensions dict-encode (strings MUST); metrics store raw
+        self.dict_encoded = spec.field_type.value != "METRIC" or not dt.is_numeric
+        self.dictionary = MutableDictionary() if self.dict_encoded else None
+        self.dict_ids: list = []      # SV dict ids | raw values
+        self.mv_ids: list = []        # MV rows: list[list]
+        self.null_docs: list[int] = []
+        self.min_value = None
+        self.max_value = None
+        self.total_values = 0
+        self.max_mv = 0
+
+    def _observe(self, v):
+        if self.min_value is None or v < self.min_value:
+            self.min_value = v
+        if self.max_value is None or v > self.max_value:
+            self.max_value = v
+
+    def add(self, value, doc_id: int):
+        if value is None:
+            self.null_docs.append(doc_id)
+            value = (list(self.spec.default_null_value)
+                     if not self.single_value and isinstance(
+                         self.spec.default_null_value, (list, tuple))
+                     else self.spec.default_null_value)
+            if not self.single_value and not isinstance(value, (list, tuple)):
+                value = [value]
+        if self.single_value:
+            value = self._coerce(value)
+            self._observe(value)
+            self.total_values += 1
+            if self.dict_encoded:
+                self.dict_ids.append(self.dictionary.upsert(value))
+            else:
+                self.dict_ids.append(value)
+        else:
+            vals = [self._coerce(v) for v in (value if isinstance(value, (list, tuple, np.ndarray)) else [value])]
+            for v in vals:
+                self._observe(v)
+            self.total_values += len(vals)
+            self.max_mv = max(self.max_mv, len(vals))
+            if self.dict_encoded:
+                self.mv_ids.append([self.dictionary.upsert(v) for v in vals])
+            else:
+                self.mv_ids.append(vals)
+
+    def _coerce(self, v):
+        return coerce_value(v, self.data_type)
+
+    def metadata(self, num_docs: int) -> ColumnMetadata:
+        card = len(self.dictionary) if self.dict_encoded else 0
+        return ColumnMetadata(
+            name=self.spec.name,
+            data_type=self.data_type.value,
+            field_type=self.spec.field_type.value,
+            encoding="DICT" if self.dict_encoded else "RAW",
+            single_value=self.single_value,
+            cardinality=card,
+            min_value=self.min_value,
+            max_value=self.max_value,
+            is_sorted=False,
+            has_nulls=bool(self.null_docs),
+            total_number_of_entries=self.total_values,
+            max_number_of_multi_values=self.max_mv,
+        )
+
+    def values_snapshot(self, n: int) -> np.ndarray:
+        if not self.single_value:
+            raise ValueError(f"{self.spec.name} is MV")
+        if self.dict_encoded:
+            vals = self.dictionary.values
+            ids = np.asarray(self.dict_ids[:n], dtype=np.int64)
+            if len(vals) == 0:
+                return np.empty(0, dtype=object)
+            return vals[ids]
+        dtype = _NUMERIC_NP.get(self.data_type, object)
+        return np.asarray(self.dict_ids[:n], dtype=dtype)
+
+    def mv_snapshot(self, n: int) -> list[np.ndarray]:
+        if self.dict_encoded:
+            vals = self.dictionary.values
+            return [np.asarray([vals[i] for i in row]) for row in self.mv_ids[:n]]
+        return [np.asarray(row) for row in self.mv_ids[:n]]
+
+
+class MutableSegment:
+    """Duck-types the ImmutableSegment read API (segment/loader.py) over
+    append-only buffers; queried by the host engine while consuming."""
+
+    is_mutable = True
+
+    def __init__(self, schema: Schema, segment_name: str):
+        self.schema = schema
+        self.segment_name = segment_name
+        self._columns: dict[str, _MutableColumn] = {
+            name: _MutableColumn(spec) for name, spec in schema.fields.items()}
+        self._num_docs = 0
+        self._lock = threading.Lock()
+        self.creation_time_ms = int(time.time() * 1000)
+
+    # -- write path --------------------------------------------------------
+    def index(self, row: dict) -> int:
+        """Add one transformed row; returns its doc id (reference
+        MutableSegmentImpl.index:515 — single consumer thread)."""
+        doc_id = self._num_docs
+        for name, col in self._columns.items():
+            col.add(row.get(name), doc_id)
+        # publish AFTER the row is fully written (reader snapshot isolation)
+        self._num_docs = doc_id + 1
+        return doc_id
+
+    # -- read API (ImmutableSegment duck type) -----------------------------
+    @property
+    def name(self) -> str:
+        return self.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self._num_docs
+
+    def columns(self) -> list[str]:
+        return list(self._columns)
+
+    def has_column(self, column: str) -> bool:
+        return column in self._columns
+
+    def column_metadata(self, column: str) -> ColumnMetadata:
+        return self._columns[column].metadata(self._num_docs)
+
+    def get_dictionary(self, column: str) -> MutableDictionary:
+        return self._columns[column].dictionary
+
+    def get_values(self, column: str) -> np.ndarray:
+        return self._columns[column].values_snapshot(self._num_docs)
+
+    def get_mv_values(self, column: str) -> list[np.ndarray]:
+        return self._columns[column].mv_snapshot(self._num_docs)
+
+    def get_null_bitmap(self, column: str) -> Optional[np.ndarray]:
+        col = self._columns[column]
+        if not col.null_docs:
+            return None
+        m = np.zeros(self._num_docs, dtype=bool)
+        docs = [d for d in col.null_docs if d < self._num_docs]
+        m[docs] = True
+        return m
+
+    # consuming segments carry no persisted indexes — host engine scans
+    def get_inverted_index(self, column: str):
+        return None
+
+    def get_sorted_index(self, column: str):
+        return None
+
+    def get_range_index(self, column: str):
+        return None
+
+    def get_bloom_filter(self, column: str):
+        return None
+
+    def get_json_index(self, column: str, or_build: bool = False):
+        return None
+
+    @property
+    def star_trees(self):
+        return []
+
+    # -- conversion support ------------------------------------------------
+    def to_columns(self) -> dict[str, list]:
+        """Column-major snapshot for RealtimeSegmentConverter → SegmentBuilder."""
+        n = self._num_docs
+        out: dict[str, Any] = {}
+        for name, col in self._columns.items():
+            if col.single_value:
+                vals: list = list(col.values_snapshot(n))
+            else:
+                vals = [list(r) for r in col.mv_snapshot(n)]
+            # restore None so the builder re-derives the null vector
+            for d in col.null_docs:
+                if d < n:
+                    vals[d] = None
+            out[name] = vals
+        return out
+
+    def null_docs(self) -> dict[str, list[int]]:
+        return {name: [d for d in col.null_docs if d < self._num_docs]
+                for name, col in self._columns.items() if col.null_docs}
+
+    def destroy(self) -> None:
+        self._columns.clear()
+        self._num_docs = 0
+
+    def snapshot_view(self) -> "MutableSegmentView":
+        """Pin the row count for one query: every column reads the same
+        prefix even while the consumer thread keeps appending (reference:
+        MutableSegmentImpl readers bound by numDocsIndexed at acquire)."""
+        return MutableSegmentView(self)
+
+
+class MutableSegmentView:
+    """Read-only consistent-prefix view over a MutableSegment."""
+
+    is_mutable = True
+
+    def __init__(self, segment: MutableSegment):
+        self._seg = segment
+        self._n = segment._num_docs
+
+    @property
+    def name(self) -> str:
+        return self._seg.segment_name
+
+    @property
+    def schema(self):
+        return self._seg.schema
+
+    @property
+    def num_docs(self) -> int:
+        return self._n
+
+    def columns(self) -> list[str]:
+        return self._seg.columns()
+
+    def has_column(self, column: str) -> bool:
+        return self._seg.has_column(column)
+
+    def column_metadata(self, column: str) -> ColumnMetadata:
+        return self._seg._columns[column].metadata(self._n)
+
+    def get_dictionary(self, column: str):
+        return self._seg._columns[column].dictionary
+
+    def get_values(self, column: str) -> np.ndarray:
+        return self._seg._columns[column].values_snapshot(self._n)
+
+    def get_mv_values(self, column: str) -> list[np.ndarray]:
+        return self._seg._columns[column].mv_snapshot(self._n)
+
+    def get_null_bitmap(self, column: str) -> Optional[np.ndarray]:
+        col = self._seg._columns[column]
+        if not col.null_docs:
+            return None
+        m = np.zeros(self._n, dtype=bool)
+        m[[d for d in col.null_docs if d < self._n]] = True
+        return m
+
+    def get_inverted_index(self, column: str):
+        return None
+
+    def get_sorted_index(self, column: str):
+        return None
+
+    def get_range_index(self, column: str):
+        return None
+
+    def get_bloom_filter(self, column: str):
+        return None
+
+    def get_json_index(self, column: str, or_build: bool = False):
+        return None
+
+    @property
+    def star_trees(self):
+        return []
