@@ -63,11 +63,33 @@ class QueryExecutor:
             segments if isinstance(segments, list) else list(segments))
 
     def execute_sql(self, sql: str) -> BrokerResponse:
+        """Engine selection mirrors the reference's
+        BrokerRequestHandlerDelegate: V1 for single-table queries, V2 (MSE)
+        for joins/subqueries/set-ops/windows or when the
+        ``useMultistageEngine`` query option is set."""
         try:
             query = parse_sql(sql)
-        except SqlParseError as e:
-            return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
-        return self.execute(query)
+        except SqlParseError:
+            return self.multistage.execute_sql(sql)
+        if query.query_options.get("useMultistageEngine") in (True, "true", 1):
+            return self.multistage.execute_sql(sql)
+        resp = self.execute(query)
+        if resp.exceptions and any("UnsupportedQueryError" in e for e in resp.exceptions):
+            # shapes V1 rejects (e.g. ORDER BY on unselected columns) that
+            # the MSE can plan — mirrors the reference's option to fall back
+            # across engines per query
+            mse = self.multistage.execute_sql(sql)
+            if not mse.exceptions:
+                return mse
+        return resp
+
+    @property
+    def multistage(self):
+        if not hasattr(self, "_multistage"):
+            from ..mse.executor import MultistageExecutor
+
+            self._multistage = MultistageExecutor(self)
+        return self._multistage
 
     def execute(self, query: QueryContext) -> BrokerResponse:
         t0 = time.perf_counter()

@@ -1,0 +1,19 @@
+"""Multi-stage query engine (MSE).
+
+Reference analogue: the V2 engine — Calcite front-end + logical planner
+(pinot-query-planner/, QueryEnvironment.planQuery:179), fragmenter
+(PlanFragmenter), and the worker runtime with mailbox shuffle
+(pinot-query-runtime/, QueryRunner.processQuery:210, MailboxService:40).
+
+TPU-first shape: leaf stages compile down to the single-stage device engine
+(the reference runs leaf stages on ServerQueryExecutorV1Impl the same way —
+ServerPlanRequestUtils); intermediate operators run vectorized columnar
+numpy on host, and the shuffle plane is an in-memory mailbox service whose
+hash/broadcast exchanges map 1:1 onto jax all-to-all / broadcast collectives
+when stages are placed on device meshes (parallel/mesh.py).
+"""
+
+from .executor import MultistageExecutor
+from .parser import parse_relational
+
+__all__ = ["MultistageExecutor", "parse_relational"]

@@ -1,0 +1,101 @@
+"""Plan fragmenter: logical plan with exchanges → stage DAG.
+
+Reference analogue: PlanFragmenter + MailboxAssignmentVisitor
+(pinot-query-planner/.../planner/PlanFragmenter.java, physical/
+MailboxAssignmentVisitor.java). Every ExchangeNode becomes a stage
+boundary: the subtree below it runs as its own stage whose output is sent
+through the mailbox service with the exchange's distribution; the parent
+stage reads it through a MailboxReceiveNode leaf. Stage 0 is the broker
+rendezvous (reference: the final MailboxReceive at the broker in
+QueryDispatcher.submitAndReduce).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .logical import ExchangeNode, PlanNode, TableScanNode
+
+
+@dataclass
+class MailboxReceiveNode(PlanNode):
+    from_stage: int = -1
+    dist: str = "singleton"
+    keys: list[str] = field(default_factory=list)
+
+    def describe(self) -> str:
+        return f"MailboxReceive(fromStage={self.from_stage}, dist={self.dist}, keys={self.keys})"
+
+
+@dataclass
+class Stage:
+    stage_id: int
+    root: PlanNode  # subtree with MailboxReceiveNode leaves
+    send_dist: str  # distribution of this stage's output
+    send_keys: list[str]
+    parent_stage: Optional[int]  # None for stage 0
+    # stages whose output this stage consumes, in receive order
+    child_stages: list[int] = field(default_factory=list)
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self.child_stages
+
+    def scans(self) -> list[TableScanNode]:
+        out: list[TableScanNode] = []
+
+        def walk(n: PlanNode):
+            if isinstance(n, TableScanNode):
+                out.append(n)
+            for i in n.inputs:
+                walk(i)
+
+        walk(self.root)
+        return out
+
+
+def fragment(root: ExchangeNode) -> list[Stage]:
+    """Split at exchanges. Returns stages indexed by stage_id; stage 0 is
+    the broker stage (a bare receive of the root exchange)."""
+    if not isinstance(root, ExchangeNode):
+        raise TypeError("plan root must be an ExchangeNode")
+    stages: list[Stage] = []
+
+    broker = Stage(0, None, send_dist="", send_keys=[], parent_stage=None)
+    stages.append(broker)
+
+    def make_stage(exchange: ExchangeNode, parent_id: int) -> int:
+        sid = len(stages)
+        stage = Stage(sid, None, send_dist=exchange.dist,
+                      send_keys=list(exchange.keys), parent_stage=parent_id)
+        stages.append(stage)
+        stage.root = rewrite(exchange.inputs[0], sid)
+        return sid
+
+    def rewrite(node: PlanNode, owner_stage: int) -> PlanNode:
+        if isinstance(node, ExchangeNode):
+            child_id = make_stage(node, owner_stage)
+            stages[owner_stage].child_stages.append(child_id)
+            return MailboxReceiveNode([], list(node.schema), from_stage=child_id,
+                                      dist=node.dist, keys=list(node.keys))
+        node.inputs = [rewrite(i, owner_stage) for i in node.inputs]
+        return node
+
+    root_child = make_stage(root, 0)
+    broker.child_stages.append(root_child)
+    broker.root = MailboxReceiveNode([], list(root.schema), from_stage=root_child,
+                                     dist=root.dist, keys=list(root.keys))
+    return stages
+
+
+def explain_stages(stages: list[Stage]) -> str:
+    lines = []
+    for s in stages:
+        head = f"[Stage {s.stage_id}]"
+        if s.parent_stage is not None:
+            head += f" → stage {s.parent_stage} ({s.send_dist}" + (
+                f" on {s.send_keys})" if s.send_keys else ")")
+        lines.append(head)
+        lines.extend("  " + ln for ln in s.root.tree_lines())
+    return "\n".join(lines)

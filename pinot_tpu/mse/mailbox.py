@@ -1,0 +1,104 @@
+"""Mailbox service: the MSE shuffle data plane.
+
+Reference analogue: pinot-query-runtime/.../mailbox/MailboxService.java:40 —
+getSendingMailbox:113 / getReceivingMailbox:125, with gRPC channels between
+hosts and InMemory mailboxes for same-host pairs, and the exchange
+strategies (hash/broadcast/singleton) in .../runtime/operator/exchange/.
+
+Here every mailbox is in-memory (one process); the addressing scheme
+(from_stage, to_stage, partition) matches the reference's mailbox id
+`{requestId}|{senderStage}|{senderWorker}|{receiverStage}|{receiverWorker}`.
+Payloads are columnar blocks (dict[str, np.ndarray]) — the analogue of
+TransferableBlock wrapping a columnar DataBlock. When stages are placed on
+TPU meshes, a hash exchange lowers to an all-to-all over ICI and broadcast
+to a replicated device_put (parallel/mesh.py holds the collectives).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+Block = dict  # column name → np.ndarray (equal lengths)
+
+
+def block_len(block: Block) -> int:
+    for v in block.values():
+        return len(v)
+    return 0
+
+
+def concat_blocks(blocks: list[Block], schema: Optional[list[str]] = None) -> Block:
+    blocks = [b for b in blocks if b and block_len(b)]
+    if not blocks:
+        return {c: np.empty(0) for c in (schema or [])}
+    cols = schema if schema is not None else list(blocks[0].keys())
+    out = {}
+    for c in cols:
+        parts = [b[c] for b in blocks if c in b]
+        if not parts:
+            continue
+        if len(parts) == 1:
+            out[c] = np.asarray(parts[0])
+        else:
+            arrs = [np.asarray(p) for p in parts]
+            if any(a.dtype.kind == "O" for a in arrs):
+                arrs = [a.astype(object) for a in arrs]
+            out[c] = np.concatenate(arrs)
+    return out
+
+
+def take_block(block: Block, idx) -> Block:
+    return {c: np.asarray(v)[idx] for c, v in block.items()}
+
+
+def hash_partition(block: Block, keys: list[str], num_partitions: int) -> list[Block]:
+    """Deterministic value-hash partitioning — every producer must route the
+    same key to the same consumer worker (reference: KeySelector hashCode %
+    partitions in HashExchange)."""
+    n = block_len(block)
+    if num_partitions == 1 or not keys:
+        return [block]
+    h = np.zeros(n, dtype=np.uint64)
+    for k in keys:
+        v = np.asarray(block[k])
+        if v.dtype.kind in "iub":
+            hv = v.astype(np.int64).view(np.uint64)
+        elif v.dtype.kind == "f":
+            f = v.astype(np.float64)
+            f = np.where(f == 0.0, 0.0, f)  # -0.0 == 0.0 must hash equal
+            hv = f.view(np.uint64)
+        else:
+            hv = np.fromiter((hash(str(x)) & 0xFFFFFFFFFFFFFFFF for x in v),
+                             dtype=np.uint64, count=n)
+        h = h * np.uint64(1000003) ^ hv
+    part = (h % np.uint64(num_partitions)).astype(np.int64)
+    return [take_block(block, part == p) for p in range(num_partitions)]
+
+
+class MailboxService:
+    """In-memory post office for one query execution."""
+
+    def __init__(self):
+        self._boxes: dict[tuple, list[Block]] = defaultdict(list)
+
+    def send(self, from_stage: int, to_stage: int, partition: int, block: Block) -> None:
+        self._boxes[(from_stage, to_stage, partition)].append(block)
+
+    def receive(self, from_stage: int, to_stage: int, partition: int,
+                schema: Optional[list[str]] = None) -> Block:
+        return concat_blocks(self._boxes.get((from_stage, to_stage, partition), []),
+                             schema)
+
+    def send_partitioned(self, from_stage: int, to_stage: int, block: Block,
+                         dist: str, keys: list[str], num_partitions: int) -> None:
+        if dist == "hash" and keys and num_partitions > 1:
+            for p, b in enumerate(hash_partition(block, keys, num_partitions)):
+                self.send(from_stage, to_stage, p, b)
+        elif dist == "broadcast":
+            for p in range(num_partitions):
+                self.send(from_stage, to_stage, p, block)
+        else:  # singleton
+            self.send(from_stage, to_stage, 0, block)

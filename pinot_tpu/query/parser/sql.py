@@ -373,12 +373,18 @@ class _Parser:
             # function call?
             if self.accept_op("("):
                 return self._parse_function_call(t.value)
-            # dotted identifier (table.column) — keep last part
-            name = t.value
+            parts = [t.value]
             while self.accept_op("."):
-                name = self.next().value
-            return ExpressionContext.for_identifier(name)
+                parts.append(self.next().value)
+            return ExpressionContext.for_identifier(self._make_identifier(parts))
         raise SqlParseError(f"unexpected token {t.value!r}")
+
+    def _make_identifier(self, parts: list[str]) -> str:
+        """Dotted identifier resolution: the single-stage engine is
+        single-table so qualifiers are dropped (reference does the same in
+        BaseSingleStageBrokerRequestHandler column resolution); the MSE
+        parser overrides this to keep qualifiers for join disambiguation."""
+        return parts[-1]
 
     def _parse_function_call(self, raw_name: str) -> ExpressionContext:
         name = canonical_function_name(raw_name)
@@ -438,6 +444,8 @@ _RESERVED = frozenset(
         "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE", "IS", "NULL", "SELECT",
         "DISTINCT", "ASC", "DESC", "CASE", "WHEN", "THEN", "ELSE", "END", "SET",
         "OPTION", "EXPLAIN", "PLAN", "FOR", "NULLS", "FIRST", "LAST", "JOIN", "ON",
+        "UNION", "INTERSECT", "EXCEPT", "ALL", "INNER", "LEFT", "RIGHT", "FULL",
+        "OUTER", "CROSS", "SEMI", "ANTI", "USING", "WITH", "OVER", "PARTITION",
     }
 )
 
