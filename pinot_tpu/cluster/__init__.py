@@ -1,0 +1,22 @@
+"""Cluster layer: controller / broker / server roles over a shared
+property store — the Helix-over-ZooKeeper analogue.
+
+Reference analogue: Apache Helix 1.3.1 + ZooKeeper control plane
+(SURVEY.md §2.10), PinotHelixResourceManager (pinot-controller/.../helix/
+core/PinotHelixResourceManager.java), broker routing
+(pinot-broker/.../routing/BrokerRoutingManager.java), server state model
+(pinot-server/.../helix/SegmentOnlineOfflineStateModelFactory.java:44).
+
+TPU-first stance: the control plane stays host-side and lightweight (an
+in-process/etcd-style store with watches); the data plane is a socket
+scatter/gather whose per-server execution path is the device engine. The
+hierarchy mirrors the reference exactly: ideal state (what should be) vs
+external view (what is), with servers converging one to the other.
+"""
+
+from .store import PropertyStore
+from .controller import ClusterController
+from .server import ServerInstance
+from .broker import Broker
+
+__all__ = ["PropertyStore", "ClusterController", "ServerInstance", "Broker"]

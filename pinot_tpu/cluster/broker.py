@@ -1,0 +1,279 @@
+"""Broker role: route, scatter, gather, reduce.
+
+Reference analogue: pinot-broker — BaseSingleStageBrokerRequestHandler
+.handleRequest:279 (parse → optimize → route → scatter → gather → reduce),
+BrokerRoutingManager (routing tables from external view), replica selection
+(BalancedInstanceSelector), ConnectionFailureDetector (exponential-backoff
+unhealthy marking), TimeBoundaryManager:56 (hybrid OFFLINE+REALTIME split),
+and BrokerReduceService.reduceOnDataTable:61.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..engine.combine import combine_aggregation, combine_group_by, combine_selection
+from ..engine.aggregation import semantics_for
+from ..engine.reduce import BrokerReducer
+from ..engine.results import (
+    AggIntermediate,
+    BrokerResponse,
+    GroupByIntermediate,
+    SelectionIntermediate,
+)
+from ..query.context import QueryContext
+from ..query.expressions import ExpressionContext
+from ..query.filter import FilterContext, Predicate, PredicateType
+from ..query.parser.sql import SqlParseError, parse_sql
+from ..spi.data_types import Schema
+from .controller import ONLINE, raw_table_name, table_name_with_type
+from .store import PropertyStore
+from .transport import RpcClient, TransportError
+
+
+class _FailureDetector:
+    """Unhealthy-server book-keeping with exponential backoff retry
+    (reference: ConnectionFailureDetector)."""
+
+    def __init__(self, base_backoff_s: float = 1.0, max_backoff_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._down: dict[str, tuple[float, float]] = {}  # inst → (until, backoff)
+        self.base = base_backoff_s
+        self.max = max_backoff_s
+
+    def mark_failed(self, instance: str) -> None:
+        with self._lock:
+            _, backoff = self._down.get(instance, (0.0, self.base / 2))
+            backoff = min(backoff * 2, self.max)
+            self._down[instance] = (time.monotonic() + backoff, backoff)
+
+    def mark_healthy(self, instance: str) -> None:
+        with self._lock:
+            self._down.pop(instance, None)
+
+    def is_healthy(self, instance: str) -> bool:
+        with self._lock:
+            entry = self._down.get(instance)
+            if entry is None:
+                return True
+            until, _ = entry
+            return time.monotonic() >= until  # retry window open
+
+
+class Broker:
+    def __init__(self, store: PropertyStore, num_scatter_threads: int = 8):
+        self.store = store
+        self.failure_detector = _FailureDetector()
+        self._clients: dict[str, RpcClient] = {}
+        self._rr = 0  # round-robin cursor for replica selection
+        self._pool = ThreadPoolExecutor(max_workers=num_scatter_threads,
+                                        thread_name_prefix="broker-scatter")
+        self._lock = threading.Lock()
+
+    # -- routing ------------------------------------------------------------
+    def routing_table(self, name_with_type: str) -> dict[str, list[str]]:
+        """segment → online instances, from the external view (reference:
+        BrokerRoutingManager watching ExternalView)."""
+        view = self.store.get(f"/EXTERNALVIEW/{name_with_type}") or {}
+        ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
+        live = set(self.store.children("/LIVEINSTANCES"))
+        out = {}
+        for seg in ideal:
+            insts = [i for i, st in (view.get(seg) or {}).items()
+                     if st == ONLINE and i in live]
+            out[seg] = sorted(insts)
+        return out
+
+    def _client(self, instance: str) -> RpcClient:
+        with self._lock:
+            c = self._clients.get(instance)
+            if c is None:
+                cfg = self.store.get(f"/LIVEINSTANCES/{instance}") or \
+                    self.store.get(f"/INSTANCECONFIGS/{instance}")
+                if cfg is None:
+                    raise TransportError(f"no address for {instance}")
+                c = RpcClient(cfg["host"], cfg["port"])
+                self._clients[instance] = c
+            return c
+
+    def _select_instances(self, routing: dict[str, list[str]]) -> dict[str, list[str]]:
+        """instance → segments, balanced round-robin over healthy replicas
+        (reference: BalancedInstanceSelector)."""
+        plan: dict[str, list[str]] = {}
+        unavailable = []
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        for seg, replicas in routing.items():
+            healthy = [i for i in replicas if self.failure_detector.is_healthy(i)]
+            candidates = healthy or replicas
+            if not candidates:
+                unavailable.append(seg)
+                continue
+            pick = candidates[rr % len(candidates)]
+            plan.setdefault(pick, []).append(seg)
+        if unavailable:
+            raise TransportError(f"no online replica for segments {unavailable}")
+        return plan
+
+    # -- query --------------------------------------------------------------
+    def execute_sql(self, sql: str) -> BrokerResponse:
+        t0 = time.perf_counter()
+        try:
+            query = parse_sql(sql)
+        except SqlParseError as e:
+            return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
+        try:
+            resp = self._execute(query)
+        except Exception as e:
+            return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
+        resp.time_used_ms = (time.perf_counter() - t0) * 1000
+        return resp
+
+    def _execute(self, query: QueryContext) -> BrokerResponse:
+        raw = raw_table_name(query.table_name)
+        offline = table_name_with_type(raw, "OFFLINE")
+        realtime = table_name_with_type(raw, "REALTIME")
+        has_offline = self.store.get(f"/CONFIGS/TABLE/{offline}") is not None
+        has_realtime = self.store.get(f"/CONFIGS/TABLE/{realtime}") is not None
+        if not has_offline and not has_realtime:
+            return BrokerResponse(exceptions=[f"table {raw} not found"])
+
+        halves: list[tuple[str, Optional[FilterContext]]] = []
+        if has_offline and has_realtime:
+            boundary = self._time_boundary(offline)
+            time_col = (self.store.get(f"/CONFIGS/TABLE/{offline}") or {}).get(
+                "timeColumn")
+            if boundary is not None and time_col:
+                # hybrid split (reference TimeBoundaryManager:56):
+                # offline ≤ boundary < realtime
+                halves.append((offline, _range_filter(time_col, None, boundary)))
+                halves.append((realtime, _range_filter(time_col, boundary, None)))
+            else:
+                halves.append((offline, None))
+                halves.append((realtime, None))
+        else:
+            halves.append((offline if has_offline else realtime, None))
+
+        schema_json = self.store.get(f"/SCHEMAS/{raw}")
+        schema = Schema.from_json(schema_json) if schema_json else None
+
+        all_results = []
+        stats_sum = {"total_docs": 0, "num_segments_processed": 0,
+                     "num_segments_pruned": 0, "num_segments_queried": 0}
+        for name_with_type, extra_filter in halves:
+            sub = _with_filter(query, name_with_type, extra_filter)
+            results = self._scatter_gather(name_with_type, sub, stats_sum)
+            all_results.extend(results)
+
+        combined = self._merge(query, all_results)
+        result = BrokerReducer(schema).reduce(query, combined)
+        return BrokerResponse(
+            result_table=result,
+            num_docs_scanned=getattr(combined, "num_docs_scanned", 0),
+            total_docs=stats_sum["total_docs"],
+            num_segments_queried=stats_sum["num_segments_queried"],
+            num_segments_processed=stats_sum["num_segments_processed"],
+            num_segments_pruned=stats_sum["num_segments_pruned"],
+        )
+
+    def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict):
+        routing = self.routing_table(table)
+        if not routing:
+            return []
+        stats_sum["num_segments_queried"] += len(routing)
+        plan = self._select_instances(routing)
+
+        def call(inst_segs):
+            inst, segs = inst_segs
+            request = {"type": "query", "table": table, "segments": segs,
+                       "query": query}
+            try:
+                out = self._client(inst).call(request)
+                self.failure_detector.mark_healthy(inst)
+                return inst, segs, out, None
+            except TransportError as e:
+                self.failure_detector.mark_failed(inst)
+                with self._lock:
+                    self._clients.pop(inst, None)
+                return inst, segs, None, e
+
+        results = []
+        retry: list[str] = []
+        for inst, segs, out, err in self._pool.map(call, plan.items()):
+            if err is not None:
+                retry.extend(segs)
+            else:
+                results.append(out)
+        if retry:
+            # failover: re-route failed segments to remaining replicas
+            # (reference: query-time replica failover via routing)
+            sub_routing = {s: routing[s] for s in retry}
+            sub_plan = self._select_instances(sub_routing)
+            for inst, segs, out, err in self._pool.map(call, sub_plan.items()):
+                if err is not None:
+                    raise TransportError(
+                        f"segments {segs} unreachable on all replicas")
+                results.append(out)
+        for r in results:
+            st = r["stats"]
+            stats_sum["total_docs"] += st["total_docs"]
+            stats_sum["num_segments_processed"] += st["num_segments_processed"]
+            stats_sum["num_segments_pruned"] += st["num_segments_pruned"]
+        return [r["combined"] for r in results]
+
+    def _merge(self, query: QueryContext, per_server: list):
+        semantics = [semantics_for(a) for a in query.aggregations]
+        groupish = [r for r in per_server if isinstance(r, GroupByIntermediate)]
+        aggish = [r for r in per_server if isinstance(r, AggIntermediate)]
+        selish = [r for r in per_server if isinstance(r, SelectionIntermediate)]
+        if groupish:
+            return combine_group_by(groupish, semantics)
+        if aggish:
+            return combine_aggregation(aggish, semantics)
+        if selish:
+            return combine_selection(selish)
+        if query.is_aggregation_query and not query.is_group_by and not query.distinct:
+            return AggIntermediate([])
+        if query.is_group_by or query.distinct or query.is_aggregation_query:
+            return GroupByIntermediate({})
+        return SelectionIntermediate(
+            [e.identifier for e in query.select_expressions if e.is_identifier], [])
+
+    # -- hybrid time boundary ----------------------------------------------
+    def _time_boundary(self, offline_table: str) -> Optional[int]:
+        """Max endTimeMs across offline segments (reference
+        TimeBoundaryManager; the reference subtracts 1 time unit — kept as
+        inclusive-≤ here with the realtime side strictly >)."""
+        best = None
+        for seg in self.store.children(f"/SEGMENTS/{offline_table}"):
+            meta = self.store.get(f"/SEGMENTS/{offline_table}/{seg}") or {}
+            end = meta.get("endTimeMs")
+            if end is not None:
+                best = end if best is None else max(best, end)
+        return best
+
+
+def _range_filter(column: str, gt: Optional[int], lte: Optional[int]) -> FilterContext:
+    """time > gt AND time <= lte (None = unbounded)."""
+    pred = Predicate(
+        PredicateType.RANGE, ExpressionContext.for_identifier(column),
+        lower=gt, lower_inclusive=False, upper=lte, upper_inclusive=True)
+    return FilterContext.pred(pred)
+
+
+def _with_filter(query: QueryContext, table: str,
+                 extra: Optional[FilterContext]) -> QueryContext:
+    import copy
+
+    if extra is None:
+        q = copy.copy(query)
+        q.table_name = table
+        return q
+    q = copy.deepcopy(query)
+    q.table_name = table
+    q.filter = extra if q.filter is None else FilterContext.and_(q.filter, extra)
+    return q

@@ -1,0 +1,209 @@
+"""Cluster controller: table/segment lifecycle + assignment + rebalance.
+
+Reference analogue: PinotHelixResourceManager (pinot-controller/.../helix/
+core/PinotHelixResourceManager.java, 4.6K LoC — create/delete tables, add
+segments, ideal-state updates, instance management), segment assignment
+strategies (.../helix/core/assignment/segment/BaseSegmentAssignment.java),
+TableRebalancer (.../helix/core/rebalance/TableRebalancer.java) and
+RetentionManager (.../helix/core/retention/).
+
+State layout in the property store (ZK-analogue paths):
+  /CONFIGS/TABLE/{tableNameWithType}   table config JSON
+  /SCHEMAS/{rawName}                   schema JSON
+  /IDEALSTATES/{tableNameWithType}     {segment: {instance: state}}
+  /EXTERNALVIEW/{tableNameWithType}    same shape, written by servers
+  /LIVEINSTANCES/{instanceId}          ephemeral {host, port}
+  /INSTANCECONFIGS/{instanceId}        {host, port, tags}
+  /SEGMENTS/{tableNameWithType}/{seg}  segment metadata (location, docs, time range)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .store import PropertyStore
+
+ONLINE = "ONLINE"
+OFFLINE = "OFFLINE"
+CONSUMING = "CONSUMING"
+
+
+def table_name_with_type(name: str, table_type: str = "OFFLINE") -> str:
+    if name.endswith("_OFFLINE") or name.endswith("_REALTIME"):
+        return name
+    return f"{name}_{table_type}"
+
+
+def raw_table_name(name_with_type: str) -> str:
+    for suffix in ("_OFFLINE", "_REALTIME"):
+        if name_with_type.endswith(suffix):
+            return name_with_type[: -len(suffix)]
+    return name_with_type
+
+
+class ClusterController:
+    def __init__(self, store: PropertyStore):
+        self.store = store
+
+    # -- instances ---------------------------------------------------------
+    def list_instances(self, tag: Optional[str] = None) -> list[str]:
+        out = []
+        for inst in self.store.children("/INSTANCECONFIGS"):
+            cfg = self.store.get(f"/INSTANCECONFIGS/{inst}") or {}
+            if tag is None or tag in cfg.get("tags", []):
+                out.append(inst)
+        return out
+
+    def live_instances(self) -> list[str]:
+        return self.store.children("/LIVEINSTANCES")
+
+    # -- schemas / tables ---------------------------------------------------
+    def add_schema(self, schema_json: dict) -> None:
+        self.store.set(f"/SCHEMAS/{schema_json['schemaName']}", schema_json)
+
+    def create_table(self, table_config: dict) -> str:
+        """table_config needs at least tableName; optional tableType
+        (OFFLINE default), replication (1), serverTag, timeColumn,
+        retentionDays."""
+        name = table_name_with_type(table_config["tableName"],
+                                    table_config.get("tableType", "OFFLINE"))
+        table_config = dict(table_config, tableNameWithType=name)
+        self.store.set(f"/CONFIGS/TABLE/{name}", table_config)
+        if self.store.get(f"/IDEALSTATES/{name}") is None:
+            self.store.set(f"/IDEALSTATES/{name}", {})
+        return name
+
+    def drop_table(self, name_with_type: str) -> None:
+        for seg in self.store.children(f"/SEGMENTS/{name_with_type}"):
+            self.store.delete(f"/SEGMENTS/{name_with_type}/{seg}")
+        self.store.delete(f"/IDEALSTATES/{name_with_type}")
+        self.store.delete(f"/CONFIGS/TABLE/{name_with_type}")
+
+    def table_config(self, name_with_type: str) -> Optional[dict]:
+        return self.store.get(f"/CONFIGS/TABLE/{name_with_type}")
+
+    # -- segments -----------------------------------------------------------
+    def add_segment(self, name_with_type: str, segment_name: str,
+                    metadata: dict) -> list[str]:
+        """metadata: {location: dir path (deep-store address), numDocs,
+        startTimeMs?, endTimeMs?, crc?}. Assigns replicas and updates the
+        ideal state; servers converge and load. Returns assigned instances."""
+        cfg = self.table_config(name_with_type)
+        if cfg is None:
+            raise KeyError(f"table {name_with_type} not found")
+        metadata = dict(metadata, segmentName=segment_name,
+                        pushTimeMs=int(time.time() * 1000))
+        self.store.set(f"/SEGMENTS/{name_with_type}/{segment_name}", metadata)
+        assigned = self._assign_segment(cfg)
+        state = CONSUMING if metadata.get("consuming") else ONLINE
+
+        def upd(ideal):
+            ideal = ideal or {}
+            ideal[segment_name] = {inst: state for inst in assigned}
+            return ideal
+
+        self.store.update(f"/IDEALSTATES/{name_with_type}", upd)
+        return assigned
+
+    def drop_segment(self, name_with_type: str, segment_name: str) -> None:
+        def upd(ideal):
+            ideal = ideal or {}
+            ideal.pop(segment_name, None)
+            return ideal
+
+        self.store.update(f"/IDEALSTATES/{name_with_type}", upd)
+        self.store.delete(f"/SEGMENTS/{name_with_type}/{segment_name}")
+
+    def segment_metadata(self, name_with_type: str, segment_name: str) -> Optional[dict]:
+        return self.store.get(f"/SEGMENTS/{name_with_type}/{segment_name}")
+
+    # -- assignment ---------------------------------------------------------
+    def _assign_segment(self, cfg: dict) -> list[str]:
+        """Balanced assignment: pick the `replication` least-loaded eligible
+        live instances (reference: BalancedNumSegmentAssignmentStrategy)."""
+        replication = int(cfg.get("replication", 1))
+        tag = cfg.get("serverTag")
+        candidates = [i for i in self.list_instances(tag)
+                      if i in set(self.live_instances())]
+        if len(candidates) < replication:
+            raise RuntimeError(
+                f"not enough live servers: need {replication}, have {candidates}")
+        load = {i: 0 for i in candidates}
+        name = cfg["tableNameWithType"]
+        ideal = self.store.get(f"/IDEALSTATES/{name}") or {}
+        for seg_map in ideal.values():
+            for inst in seg_map:
+                if inst in load:
+                    load[inst] += 1
+        return sorted(candidates, key=lambda i: (load[i], i))[:replication]
+
+    # -- rebalance ----------------------------------------------------------
+    def rebalance(self, name_with_type: str, dry_run: bool = False) -> dict:
+        """Recompute a balanced target assignment with minimal movement and
+        write it to the ideal state (reference: TableRebalancer — target
+        computed then applied; servers converge; min-available-replica
+        stepping is not needed since the store update is atomic)."""
+        cfg = self.table_config(name_with_type)
+        if cfg is None:
+            raise KeyError(name_with_type)
+        replication = int(cfg.get("replication", 1))
+        candidates = sorted(set(self.list_instances(cfg.get("serverTag")))
+                            & set(self.live_instances()))
+        if len(candidates) < replication:
+            raise RuntimeError("not enough live servers to rebalance")
+        ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
+        load = {i: 0 for i in candidates}
+        target: dict[str, dict] = {}
+        moves = 0
+        for seg in sorted(ideal):
+            keep = [i for i in ideal[seg] if i in candidates][:replication]
+            target[seg] = {i: ideal[seg][i] for i in keep}
+            for i in keep:
+                load[i] += 1
+        for seg in sorted(ideal):
+            while len(target[seg]) < replication:
+                pick = min((i for i in candidates if i not in target[seg]),
+                           key=lambda i: (load[i], i))
+                target[seg][pick] = ONLINE
+                load[pick] += 1
+                moves += 1
+        # level loads: move replicas from the most- to the least-loaded host
+        # until spread ≤ 1 (balanced target, minimal movement)
+        for _ in range(len(ideal) * replication):
+            hi = max(candidates, key=lambda i: (load[i], i))
+            lo = min(candidates, key=lambda i: (load[i], i))
+            if load[hi] - load[lo] <= 1:
+                break
+            movable = next((s for s in sorted(ideal)
+                            if hi in target[s] and lo not in target[s]), None)
+            if movable is None:
+                break
+            target[movable][lo] = target[movable].pop(hi)
+            load[hi] -= 1
+            load[lo] += 1
+            moves += 1
+        result = {"table": name_with_type, "moves": moves, "target": target}
+        if not dry_run:
+            self.store.set(f"/IDEALSTATES/{name_with_type}", target)
+        return result
+
+    # -- retention ----------------------------------------------------------
+    def run_retention(self, now_ms: Optional[int] = None) -> list[str]:
+        """Drop segments past the table's retentionDays (reference:
+        RetentionManager periodic task)."""
+        now_ms = now_ms or int(time.time() * 1000)
+        dropped = []
+        for table in self.store.children("/CONFIGS/TABLE"):
+            cfg = self.table_config(table) or {}
+            days = cfg.get("retentionDays")
+            if not days:
+                continue
+            cutoff = now_ms - int(days) * 86_400_000
+            for seg in self.store.children(f"/SEGMENTS/{table}"):
+                meta = self.segment_metadata(table, seg) or {}
+                end = meta.get("endTimeMs")
+                if end is not None and end < cutoff:
+                    self.drop_segment(table, seg)
+                    dropped.append(f"{table}/{seg}")
+        return dropped

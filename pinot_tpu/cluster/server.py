@@ -1,0 +1,133 @@
+"""Server role: converge to ideal state, host segments, serve queries.
+
+Reference analogue: pinot-server — BaseServerStarter.start:578 boots the
+instance data manager + query executor + Netty server and joins Helix; the
+state model SegmentOnlineOfflineStateModelFactory.java:44 handles
+OFFLINE→ONLINE (load segment), ONLINE→OFFLINE (release), →DROPPED
+transitions (:73-140). Here the transitions are driven by a watch on the
+ideal state; after each transition the server updates the external view,
+exactly Helix's contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..engine.query_executor import QueryExecutor
+from ..segment.loader import load_segment
+from ..spi.data_types import Schema
+from .controller import ONLINE, raw_table_name
+from .store import PropertyStore
+from .transport import RpcServer
+
+
+class ServerInstance:
+    def __init__(self, store: PropertyStore, instance_id: str,
+                 backend: str = "auto", tags: Optional[list[str]] = None):
+        self.store = store
+        self.instance_id = instance_id
+        self.tags = tags or ["DefaultTenant"]
+        self.executor = QueryExecutor(backend=backend)
+        # tableNameWithType → {segment_name: ImmutableSegment}
+        self.segments: dict[str, dict[str, object]] = {}
+        self._lock = threading.RLock()
+        self._rpc = RpcServer(self._handle)
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.store.set(f"/INSTANCECONFIGS/{self.instance_id}",
+                       {"host": self._rpc.host, "port": self._rpc.port,
+                        "tags": self.tags})
+        self.store.set(f"/LIVEINSTANCES/{self.instance_id}",
+                       {"host": self._rpc.host, "port": self._rpc.port},
+                       ephemeral_owner=self.instance_id)
+        self.store.watch("/IDEALSTATES/", self._on_ideal_state)
+        self._started = True
+        # replay current ideal states (Helix replays pending transitions on join)
+        for table in self.store.children("/IDEALSTATES"):
+            self._converge(table, self.store.get(f"/IDEALSTATES/{table}"))
+
+    def stop(self) -> None:
+        """Simulates process death: ephemeral live-instance entry expires.
+        Instance config stays (reference: ZK session expiry vs config)."""
+        self._started = False
+        self._rpc.close()
+        self.store.expire_session(self.instance_id)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self._rpc.host, self._rpc.port)
+
+    # -- state transitions --------------------------------------------------
+    def _on_ideal_state(self, path: str, value) -> None:
+        if not self._started:
+            return
+        table = path.rsplit("/", 1)[-1]
+        self._converge(table, value)
+
+    def _converge(self, table: str, ideal: Optional[dict]) -> None:
+        """Diff ideal vs hosted → load/drop (the OFFLINE→ONLINE / →DROPPED
+        transitions)."""
+        ideal = ideal or {}
+        want = {seg for seg, m in ideal.items()
+                if m.get(self.instance_id) == ONLINE}
+        with self._lock:
+            have = set(self.segments.get(table, {}))
+            to_load = want - have
+            to_drop = have - want
+            for seg in to_load:
+                meta = self.store.get(f"/SEGMENTS/{table}/{seg}")
+                if meta is None:
+                    continue
+                segment = load_segment(meta["location"])
+                self.segments.setdefault(table, {})[seg] = segment
+            for seg in to_drop:
+                self.segments.get(table, {}).pop(seg, None)
+            self._register_table(table)
+        self._update_external_view(table, want)
+
+    def _register_table(self, table: str) -> None:
+        raw = raw_table_name(table)
+        schema_json = self.store.get(f"/SCHEMAS/{raw}")
+        if schema_json is not None and table in self.segments:
+            self.executor.add_table(
+                Schema.from_json(schema_json),
+                list(self.segments[table].values()), name=table)
+
+    def _update_external_view(self, table: str, online: set) -> None:
+        def upd(view):
+            view = view or {}
+            for seg in list(view):
+                view[seg].pop(self.instance_id, None)
+                if not view[seg]:
+                    del view[seg]
+            for seg in online:
+                view.setdefault(seg, {})[self.instance_id] = ONLINE
+            return view
+
+        self.store.update(f"/EXTERNALVIEW/{table}", upd)
+
+    # -- query plane --------------------------------------------------------
+    def _handle(self, request):
+        kind = request.get("type")
+        if kind == "query":
+            return self._handle_query(request)
+        if kind == "ping":
+            return "pong"
+        raise ValueError(f"unknown request type {kind}")
+
+    def _handle_query(self, request):
+        """Execute a QueryContext over an explicit segment list (the broker
+        names segments per server, reference InstanceRequest.searchSegments)."""
+        table = request["table"]
+        names = request["segments"]
+        query = request["query"]
+        with self._lock:
+            hosted = self.segments.get(table, {})
+            segs = [hosted[n] for n in names if n in hosted]
+            missing = [n for n in names if n not in hosted]
+        combined, stats = self.executor.execute_segments(query, segs)
+        stats["missing_segments"] = missing
+        return {"combined": combined, "stats": stats}

@@ -1,0 +1,128 @@
+"""Property store: hierarchical versioned JSON store with watches.
+
+Reference analogue: ZooKeeper as used by Helix — the property store under
+`/PROPERTYSTORE`, ideal states under `/IDEALSTATES`, external views, live
+instances (SURVEY.md §2.10 control plane). Single-process implementation
+with the same semantics the cluster code needs: compare-and-set versioning,
+ephemeral entries tied to a session, and subtree watches delivered
+synchronously (tests) or via a notifier thread.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class StoreError(Exception):
+    pass
+
+
+class BadVersionError(StoreError):
+    """Compare-and-set failed (reference: ZK BadVersionException)."""
+
+
+@dataclass
+class _Entry:
+    value: Any
+    version: int = 0
+    ephemeral_owner: Optional[str] = None
+
+
+class PropertyStore:
+    """Path → JSON-value store. Paths are '/'-separated strings."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: dict[str, _Entry] = {}
+        self._watches: list[tuple[str, Callable[[str, Optional[Any]], None]]] = []
+
+    # -- basic ops ---------------------------------------------------------
+    def set(self, path: str, value: Any, expected_version: int = -1,
+            ephemeral_owner: Optional[str] = None) -> int:
+        """Set value; expected_version ≥ 0 makes it a compare-and-set.
+        Returns the new version."""
+        json.dumps(value)  # enforce JSON-serializable (ZK stores bytes)
+        with self._lock:
+            cur = self._data.get(path)
+            if expected_version >= 0:
+                curv = cur.version if cur is not None else -1
+                if curv != expected_version:
+                    raise BadVersionError(
+                        f"{path}: expected v{expected_version}, have v{curv}")
+            newv = (cur.version + 1) if cur is not None else 0
+            self._data[path] = _Entry(value, newv, ephemeral_owner)
+        self._notify(path, value)
+        return newv
+
+    def get(self, path: str) -> Optional[Any]:
+        with self._lock:
+            e = self._data.get(path)
+            return None if e is None else e.value
+
+    def get_with_version(self, path: str) -> tuple[Optional[Any], int]:
+        with self._lock:
+            e = self._data.get(path)
+            return (None, -1) if e is None else (e.value, e.version)
+
+    def delete(self, path: str) -> bool:
+        with self._lock:
+            existed = self._data.pop(path, None) is not None
+        if existed:
+            self._notify(path, None)
+        return existed
+
+    def children(self, prefix: str) -> list[str]:
+        """Direct child names under prefix (ZK getChildren)."""
+        prefix = prefix.rstrip("/") + "/"
+        with self._lock:
+            names = set()
+            for p in self._data:
+                if p.startswith(prefix):
+                    names.add(p[len(prefix):].split("/", 1)[0])
+            return sorted(names)
+
+    def list_paths(self, prefix: str) -> list[str]:
+        with self._lock:
+            return sorted(p for p in self._data if p.startswith(prefix))
+
+    # -- ephemerals / sessions ---------------------------------------------
+    def expire_session(self, owner: str) -> None:
+        """Drop all ephemeral entries owned by a session (instance death)."""
+        with self._lock:
+            dead = [p for p, e in self._data.items() if e.ephemeral_owner == owner]
+            for p in dead:
+                del self._data[p]
+        for p in dead:
+            self._notify(p, None)
+
+    # -- watches -----------------------------------------------------------
+    def watch(self, prefix: str, callback: Callable[[str, Optional[Any]], None]) -> None:
+        """callback(path, new_value_or_None) on every change under prefix.
+        Persistent (unlike raw ZK one-shot watches; Helix re-registers —
+        this is the post-re-registration behavior)."""
+        with self._lock:
+            self._watches.append((prefix, callback))
+
+    def _notify(self, path: str, value: Optional[Any]) -> None:
+        with self._lock:
+            targets = [cb for prefix, cb in self._watches if path.startswith(prefix)]
+        for cb in targets:
+            cb(path, value)
+
+    # -- transactional helpers ---------------------------------------------
+    def update(self, path: str, fn: Callable[[Optional[Any]], Any],
+               max_retries: int = 20) -> Any:
+        """Read-modify-write with CAS retry (Helix's ZkBaseDataAccessor
+        update pattern)."""
+        for _ in range(max_retries):
+            cur, version = self.get_with_version(path)
+            new = fn(json.loads(json.dumps(cur)) if cur is not None else None)
+            try:
+                self.set(path, new, expected_version=version)
+                return new
+            except BadVersionError:
+                continue
+        raise StoreError(f"update contention on {path}")

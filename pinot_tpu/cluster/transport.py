@@ -1,0 +1,148 @@
+"""Data-plane transport: broker↔server query RPC over TCP.
+
+Reference analogue: the Netty data plane (pinot-core/.../transport/ —
+QueryRouter.submitQuery:90 → ServerChannels → server QueryServer +
+InstanceRequestHandler.channelRead0:122 deserializing Thrift
+InstanceRequest). Here: length-prefixed pickled frames over TCP sockets
+with a thread-per-connection server — the host-side scatter/gather plane.
+Device-side data never crosses this wire; servers ship per-table combined
+intermediates (the DataTable analogue), brokers merge and reduce.
+
+Pickle is acceptable where Thrift serves in the reference because both ends
+are this same trusted process group (in-proc cluster / localhost tests);
+the framing keeps the transport swappable for a real codec later.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+_HDR = struct.Struct(">I")
+_MAX_FRAME = 1 << 30
+
+
+class TransportError(Exception):
+    pass
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if n > _MAX_FRAME:
+        raise TransportError(f"frame too large: {n}")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class RpcServer:
+    """Thread-per-connection request/response server.
+    handler(request_obj) → response_obj. Bind to port 0 for an ephemeral
+    port; .port reports the bound port."""
+
+    def __init__(self, handler: Callable, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self._closed = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"rpc-accept-{self.port}", daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._closed.is_set():
+                try:
+                    request = _recv_frame(conn)
+                except (TransportError, OSError, EOFError):
+                    return
+                try:
+                    response = ("ok", self.handler(request))
+                except Exception as e:  # surface handler errors to the caller
+                    response = ("error", f"{type(e).__name__}: {e}")
+                try:
+                    _send_frame(conn, response)
+                except OSError:
+                    return
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Pooled single connection per target with reconnect-on-failure."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def call(self, request):
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send_frame(self._sock, request)
+                    status, payload = _recv_frame(self._sock)
+                    break
+                except (TransportError, OSError, EOFError):
+                    self.close_nolock()
+                    if attempt == 1:
+                        raise TransportError(
+                            f"rpc to {self.host}:{self.port} failed")
+        if status == "error":
+            raise TransportError(payload)
+        return payload
+
+    def close_nolock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self.close_nolock()

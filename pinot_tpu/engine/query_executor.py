@@ -101,39 +101,46 @@ class QueryExecutor:
         if table is None:
             return BrokerResponse(exceptions=[f"table {query.table_name} not found"])
 
-        intermediates = []
-        total_docs = 0
         try:
-            # snapshot: realtime tables mutate the live list concurrently;
-            # consuming segments pin a consistent row-count view per query
-            segments = [s.snapshot_view() if getattr(s, "is_mutable", False) else s
-                        for s in list(table.segments)]
-            kept, num_pruned = self.pruner.prune(query, segments)
-            for segment in segments:
-                total_docs += segment.num_docs
-            for segment in kept:
-                intermediates.append(self._execute_segment(query, segment))
-
-            combined = self._combine(query, intermediates)
+            combined, stats = self.execute_segments(query, list(table.segments))
             reducer = BrokerReducer(table.schema)
             result = reducer.reduce(query, combined)
         except Exception as e:  # clean broker-style error (reference QueryException)
             return BrokerResponse(
                 exceptions=[f"{type(e).__name__}: {e}"],
-                total_docs=total_docs,
                 num_segments_queried=len(table.segments),
                 time_used_ms=(time.perf_counter() - t0) * 1000,
             )
         resp = BrokerResponse(
             result_table=result,
             num_docs_scanned=getattr(combined, "num_docs_scanned", 0),
-            total_docs=total_docs,
+            total_docs=stats["total_docs"],
             num_segments_queried=len(table.segments),
-            num_segments_processed=len(kept),
-            num_segments_pruned=num_pruned,
+            num_segments_processed=stats["num_segments_processed"],
+            num_segments_pruned=stats["num_segments_pruned"],
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
         return resp
+
+    def execute_segments(self, query: QueryContext, segments: list):
+        """Server-side half of a query: prune → per-segment execute →
+        combine. Returns (combined_intermediate, stats). This is what a
+        cluster server runs for its assigned segments (reference:
+        ServerQueryExecutorV1Impl.executeInternal without broker reduce);
+        the in-process path and the cluster data plane share it."""
+        # snapshot: realtime tables mutate the live list concurrently;
+        # consuming segments pin a consistent row-count view per query
+        segments = [s.snapshot_view() if getattr(s, "is_mutable", False) else s
+                    for s in segments]
+        kept, num_pruned = self.pruner.prune(query, segments)
+        total_docs = sum(s.num_docs for s in segments)
+        intermediates = [self._execute_segment(query, s) for s in kept]
+        combined = self._combine(query, intermediates)
+        return combined, {
+            "total_docs": total_docs,
+            "num_segments_processed": len(kept),
+            "num_segments_pruned": num_pruned,
+        }
 
     def _execute_segment(self, query: QueryContext, segment: ImmutableSegment):
         rewrite = None

@@ -1,0 +1,207 @@
+"""Cluster-layer tests: embedded controller + servers + broker in-process.
+
+Reference pattern: pinot-integration-test-base ClusterTest /
+BaseClusterIntegrationTest — multi-node simulated by launching multiple
+roles in one JVM/process, queries via broker, chaos by killing components
+(ChaosMonkeyIntegrationTest).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "stats",
+    dimensions=[("team", "STRING"), ("year", "INT")],
+    metrics=[("runs", "INT")])
+
+TEAMS = ["BOS", "NYA", "SFN", "LAN"]
+
+
+def _build_segment(tmp, name, seed, n=500, year_range=(2000, 2010)):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "team": np.asarray(TEAMS, dtype=object)[rng.integers(0, len(TEAMS), n)],
+        "year": rng.integers(*year_range, n).astype(np.int32),
+        "runs": rng.integers(0, 100, n).astype(np.int32),
+    }
+    path = str(tmp / name)
+    SegmentBuilder(SCHEMA, segment_name=name).build(cols, path)
+    return path, cols
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="host")
+               for i in range(3)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    yield store, controller, servers, broker
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _expected_team_sums(all_cols):
+    sums = {}
+    for cols in all_cols:
+        for t, r in zip(cols["team"], cols["runs"]):
+            sums[t] = sums.get(t, 0) + int(r)
+    return sums
+
+
+def test_create_assign_query(cluster, tmp_path):
+    store, controller, servers, broker = cluster
+    table = controller.create_table(
+        {"tableName": "stats", "replication": 2})
+    datasets = []
+    for i in range(4):
+        path, cols = _build_segment(tmp_path, f"stats_{i}", seed=i)
+        assigned = controller.add_segment(table, f"stats_{i}",
+                                          {"location": path, "numDocs": 500})
+        assert len(assigned) == 2
+        datasets.append(cols)
+
+    # every segment hosted on exactly 2 servers, external view converged
+    view = store.get(f"/EXTERNALVIEW/{table}")
+    assert len(view) == 4
+    for seg, m in view.items():
+        assert len(m) == 2
+
+    resp = broker.execute_sql(
+        "SELECT team, SUM(runs) FROM stats GROUP BY team ORDER BY team LIMIT 10")
+    assert not resp.exceptions, resp.exceptions
+    got = {r[0]: r[1] for r in resp.result_table.rows}
+    assert got == _expected_team_sums(datasets)
+    assert resp.total_docs == 2000
+
+
+def test_replica_failover(cluster, tmp_path):
+    store, controller, servers, broker = cluster
+    table = controller.create_table({"tableName": "stats", "replication": 2})
+    datasets = []
+    for i in range(3):
+        path, cols = _build_segment(tmp_path, f"s{i}", seed=10 + i)
+        controller.add_segment(table, f"s{i}", {"location": path, "numDocs": 500})
+        datasets.append(cols)
+    expected = _expected_team_sums(datasets)
+
+    # kill one server: its ephemeral entry expires, broker fails over
+    servers[0].stop()
+    resp = broker.execute_sql(
+        "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 10")
+    assert not resp.exceptions, resp.exceptions
+    got = {r[0]: r[1] for r in resp.result_table.rows}
+    assert got == expected
+
+
+def test_rebalance_after_server_join(cluster, tmp_path):
+    store, controller, servers, broker = cluster
+    # start with segments on 3 servers, then add a 4th and rebalance
+    table = controller.create_table({"tableName": "stats", "replication": 1})
+    datasets = []
+    for i in range(6):
+        path, cols = _build_segment(tmp_path, f"r{i}", seed=20 + i)
+        controller.add_segment(table, f"r{i}", {"location": path, "numDocs": 500})
+        datasets.append(cols)
+
+    s3 = ServerInstance(store, "Server_3", backend="host")
+    s3.start()
+    result = controller.rebalance(table)
+    assert result["moves"] >= 1
+    # new server hosts at least one segment after convergence
+    view = store.get(f"/EXTERNALVIEW/{table}")
+    hosted_by_new = [seg for seg, m in view.items() if "Server_3" in m]
+    assert hosted_by_new
+    resp = broker.execute_sql(
+        "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 10")
+    assert not resp.exceptions
+    assert {r[0]: r[1] for r in resp.result_table.rows} == \
+        _expected_team_sums(datasets)
+    s3.stop()
+
+
+def test_hybrid_time_boundary(cluster, tmp_path):
+    """OFFLINE holds years ≤ boundary, REALTIME overlaps: broker must not
+    double count (reference TimeBoundaryManager split)."""
+    store, controller, servers, broker = cluster
+    off = controller.create_table(
+        {"tableName": "stats", "tableType": "OFFLINE", "replication": 1,
+         "timeColumn": "year"})
+    rt = controller.create_table(
+        {"tableName": "stats", "tableType": "REALTIME", "replication": 1,
+         "timeColumn": "year"})
+    p_off, cols_off = _build_segment(tmp_path, "off0", seed=30,
+                                     year_range=(2000, 2005))
+    controller.add_segment(off, "off0", {
+        "location": p_off, "numDocs": 500,
+        "startTimeMs": 2000, "endTimeMs": 2004})
+    # realtime covers 2000-2010: rows ≤2004 duplicate offline rows
+    p_rt, cols_rt = _build_segment(tmp_path, "rt0", seed=30,
+                                   year_range=(2000, 2010))
+    controller.add_segment(rt, "rt0", {
+        "location": p_rt, "numDocs": 500,
+        "startTimeMs": 2000, "endTimeMs": 2009})
+
+    resp = broker.execute_sql("SELECT COUNT(*) FROM stats")
+    assert not resp.exceptions, resp.exceptions
+    expected = 500 + int(np.sum(cols_rt["year"] > 2004))
+    assert resp.result_table.rows[0][0] == expected
+
+
+def test_retention(cluster, tmp_path):
+    store, controller, servers, broker = cluster
+    table = controller.create_table(
+        {"tableName": "stats", "replication": 1, "retentionDays": 7})
+    now_ms = 1_800_000_000_000
+    old_end = now_ms - 10 * 86_400_000
+    fresh_end = now_ms - 1 * 86_400_000
+    p0, _ = _build_segment(tmp_path, "old", seed=40)
+    p1, cols1 = _build_segment(tmp_path, "fresh", seed=41)
+    controller.add_segment(table, "old", {"location": p0, "numDocs": 500,
+                                          "endTimeMs": old_end})
+    controller.add_segment(table, "fresh", {"location": p1, "numDocs": 500,
+                                            "endTimeMs": fresh_end})
+    dropped = controller.run_retention(now_ms=now_ms)
+    assert dropped == [f"{table}/old"]
+    resp = broker.execute_sql("SELECT COUNT(*) FROM stats")
+    assert not resp.exceptions
+    assert resp.result_table.rows[0][0] == 500
+
+
+def test_selection_and_filter_through_cluster(cluster, tmp_path):
+    store, controller, servers, broker = cluster
+    table = controller.create_table({"tableName": "stats", "replication": 1})
+    path, cols = _build_segment(tmp_path, "sel0", seed=50)
+    controller.add_segment(table, "sel0", {"location": path, "numDocs": 500})
+    resp = broker.execute_sql(
+        "SELECT team, runs FROM stats WHERE year >= 2005 AND team = 'BOS' "
+        "ORDER BY runs DESC LIMIT 5")
+    assert not resp.exceptions, resp.exceptions
+    mask = (cols["year"] >= 2005) & (cols["team"] == "BOS")
+    expected = sorted((int(r) for r in cols["runs"][mask]), reverse=True)[:5]
+    assert [r[1] for r in resp.result_table.rows] == expected
+
+
+def test_drop_table_and_unknown_table(cluster, tmp_path):
+    store, controller, servers, broker = cluster
+    table = controller.create_table({"tableName": "stats", "replication": 1})
+    path, _ = _build_segment(tmp_path, "d0", seed=60)
+    controller.add_segment(table, "d0", {"location": path, "numDocs": 500})
+    controller.drop_table(table)
+    resp = broker.execute_sql("SELECT COUNT(*) FROM stats")
+    assert resp.exceptions
+    # servers released the segments
+    for s in cluster[2]:
+        assert not s.segments.get(table)
