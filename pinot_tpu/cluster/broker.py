@@ -218,11 +218,17 @@ class Broker:
                     raise TransportError(
                         f"segments {segs} unreachable on all replicas")
                 results.append(out)
+        missing = []
         for r in results:
             st = r["stats"]
             stats_sum["total_docs"] += st["total_docs"]
             stats_sum["num_segments_processed"] += st["num_segments_processed"]
             stats_sum["num_segments_pruned"] += st["num_segments_pruned"]
+            missing.extend(st.get("missing_segments", []))
+        if missing:
+            # a routed segment the server no longer hosts → partial result;
+            # fail loudly rather than silently dropping rows
+            raise RuntimeError(f"servers missing routed segments: {missing}")
         return [r["combined"] for r in results]
 
     def _merge(self, query: QueryContext, per_server: list):

@@ -86,7 +86,10 @@ class ServerInstance:
             for seg in to_drop:
                 self.segments.get(table, {}).pop(seg, None)
             self._register_table(table)
-        self._update_external_view(table, want)
+            loaded = set(self.segments.get(table, {}))
+        # advertise only what actually loaded — a skipped/failed load must
+        # not appear ONLINE or the broker would silently lose its rows
+        self._update_external_view(table, want & loaded)
 
     def _register_table(self, table: str) -> None:
         raw = raw_table_name(table)

@@ -26,7 +26,14 @@ _MAX_FRAME = 1 << 30
 
 
 class TransportError(Exception):
-    pass
+    """Connection-level failure — the peer is unreachable or hung up."""
+
+
+class RemoteError(Exception):
+    """The peer was reached and its handler raised — a per-request error,
+    NOT a server-health signal (the broker must not mark the instance
+    unhealthy or fail over; reference: QueryException in the DataTable vs a
+    Netty channel error)."""
 
 
 def _send_frame(sock: socket.socket, obj) -> None:
@@ -132,7 +139,7 @@ class RpcClient:
                         raise TransportError(
                             f"rpc to {self.host}:{self.port} failed")
         if status == "error":
-            raise TransportError(payload)
+            raise RemoteError(payload)
         return payload
 
     def close_nolock(self) -> None:

@@ -41,9 +41,11 @@ class HostSegmentExecutor:
     # -- filter ------------------------------------------------------------
     def _filter_mask(self, f, segment: ImmutableSegment) -> np.ndarray:
         n = segment.num_docs
-        if f is None:
-            return np.ones(n, dtype=bool)
-        return self._eval_filter(f, segment)
+        mask = np.ones(n, dtype=bool) if f is None else self._eval_filter(f, segment)
+        vd = getattr(segment, "valid_doc_ids", None)
+        if vd is not None:  # upsert validity plane (see plan._and_valid_docs)
+            mask = mask & vd.mask(n)
+        return mask
 
     def _eval_filter(self, f: FilterContext, segment) -> np.ndarray:
         n = segment.num_docs

@@ -429,15 +429,30 @@ class SegmentPlanner(AggPlanContext):
     def _lower_host_mask(self, p: Predicate) -> ir.FilterNode:
         """Predicates without a vector form (JSON_MATCH) evaluate on host via
         their index into a doc mask shipped as a kernel param plane."""
-        from ..segment.device_cache import pad_bucket
         from .host_executor import eval_json_match
 
         if not p.lhs.is_identifier:
             raise UnsupportedQueryError(f"{p.type} needs a column lhs")
-        mask = eval_json_match(p, self.segment)
+        return self._mask_param(eval_json_match(p, self.segment))
+
+    def _mask_param(self, mask: np.ndarray) -> ir.MaskParam:
+        """Host-computed doc mask → padded boolean param plane."""
+        from ..segment.device_cache import pad_bucket
+
         padded = np.zeros(pad_bucket(max(1, self.segment.num_docs)), dtype=bool)
         padded[: len(mask)] = mask
         return ir.MaskParam(self.param(padded))
+
+    def _and_valid_docs(self, filt: Optional[ir.FilterNode]) -> Optional[ir.FilterNode]:
+        """Upsert tables AND the segment's validity plane into the fused
+        filter (reference: FilterPlanNode wraps the filter with the
+        validDocIds bitmap for upsert-enabled tables); shipped as a param
+        plane so the compiled program is reused as validity evolves."""
+        vd = getattr(self.segment, "valid_doc_ids", None)
+        if vd is None:
+            return filt
+        node = self._mask_param(vd.mask(self.segment.num_docs))
+        return node if filt is None else ir.FAnd((filt, node))
 
     def _id_interval(self, ids_slot, lo_id, hi_id, mv, card) -> ir.FilterNode:
         if mv:
@@ -476,6 +491,7 @@ class SegmentPlanner(AggPlanContext):
     def plan(self) -> SegmentPlan:
         q = self.query
         filt = self.lower_filter(q.filter)
+        filt = self._and_valid_docs(filt)
 
         if q.is_aggregation_query or q.distinct or q.is_group_by:
             group_dims: list[GroupDim] = []

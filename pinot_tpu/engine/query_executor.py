@@ -144,7 +144,8 @@ class QueryExecutor:
 
     def _execute_segment(self, query: QueryContext, segment: ImmutableSegment):
         rewrite = None
-        if self.use_star_tree:
+        # star-tree pre-aggregates ignore upsert validity → not applicable
+        if self.use_star_tree and getattr(segment, "valid_doc_ids", None) is None:
             from ..segment.startree import try_rewrite
 
             rewrite = try_rewrite(query, segment)

@@ -43,8 +43,11 @@ class MultistageExecutor:
         out: dict[str, list] = {c: [] for c in columns}
         for seg in list(t.segments):
             view = seg.snapshot_view() if getattr(seg, "is_mutable", False) else seg
+            vd = getattr(view, "valid_doc_ids", None)
+            keep = vd.mask(view.num_docs) if vd is not None else None
             for c in columns:
-                out[c].append(np.asarray(view.get_values(c)))
+                vals = np.asarray(view.get_values(c))
+                out[c].append(vals if keep is None else vals[keep])
         result = {}
         for c, parts in out.items():
             if not parts:

@@ -18,14 +18,18 @@ from ..segment.mutable import MutableSegment
 
 
 class RealtimeSegmentConverter:
-    def __init__(self, schema, table_config=None):
+    def __init__(self, schema, table_config=None, preserve_doc_order=False):
         self.schema = schema
         self.table_config = table_config
+        # upsert/dedup tables keep ingestion doc order so validity planes
+        # and record locations transfer 1:1 (reference: upsert tables
+        # cannot use a sorted column either)
+        self.preserve_doc_order = preserve_doc_order
 
     def convert(self, segment: MutableSegment, out_dir: str | Path) -> Path:
         columns = segment.to_columns()
         sort_col = None
-        if self.table_config is not None:
+        if self.table_config is not None and not self.preserve_doc_order:
             sort_col = self.table_config.indexing.sorted_column
         if sort_col and sort_col in columns and segment.num_docs > 0:
             keys = columns[sort_col]

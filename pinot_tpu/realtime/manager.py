@@ -62,7 +62,7 @@ class RealtimeSegmentDataManager:
     def __init__(self, schema, table_config, stream_config: StreamConfig,
                  partition: int, seq: int, start_offset: LongMsgOffset,
                  on_commit: Callable[["RealtimeSegmentDataManager"], None],
-                 poll_idle_s: float = 0.02):
+                 poll_idle_s: float = 0.02, pk_manager=None):
         self.schema = schema
         self.table_config = table_config
         self.stream_config = stream_config
@@ -72,6 +72,9 @@ class RealtimeSegmentDataManager:
         self.current_offset = start_offset
         self.on_commit = on_commit
         self.poll_idle_s = poll_idle_s
+        # upsert/dedup metadata manager (upsert/manager.py): process_row
+        # pre-index (partial merge / duplicate drop), add_record post-index
+        self.pk_manager = pk_manager
 
         self.segment = MutableSegment(
             schema, llc_segment_name(table_config.table_name, partition, seq))
@@ -146,7 +149,14 @@ class RealtimeSegmentDataManager:
             if row is None:
                 self.rows_filtered += 1
                 continue
-            self.segment.index(row)
+            if self.pk_manager is not None:
+                row = self.pk_manager.process_row(self.segment, row)
+                if row is None:  # dedup drop
+                    self.rows_filtered += 1
+                    continue
+            doc_id = self.segment.index(row)
+            if self.pk_manager is not None:
+                self.pk_manager.add_record(self.segment, doc_id, row)
             self.rows_indexed += 1
 
     @property
@@ -187,7 +197,19 @@ class RealtimeTableDataManager:
             table_config.ingestion.stream_configs)
         self.data_dir = Path(data_dir)
         self.data_dir.mkdir(parents=True, exist_ok=True)
-        self.converter = RealtimeSegmentConverter(schema, table_config)
+        self.pk_manager = None
+        if table_config.upsert.mode.upper() != "NONE":
+            from ..upsert import TableUpsertMetadataManager
+
+            self.pk_manager = TableUpsertMetadataManager(schema, table_config)
+        elif table_config.dedup.enabled:
+            from ..upsert import TableDedupManager
+
+            self.pk_manager = TableDedupManager(schema, table_config)
+        # upsert doc ids must survive conversion: never re-sort
+        self.converter = RealtimeSegmentConverter(
+            schema, table_config,
+            preserve_doc_order=self.pk_manager is not None)
         self.segment_hook = segment_hook  # cluster layer: upsert/dedup attach
         self.segments: list = []  # live view: immutables + mutables
         self._committed: list[ImmutableSegment] = []
@@ -230,17 +252,26 @@ class RealtimeTableDataManager:
         Helix transitions then resume from segment.realtime.startOffset)."""
         with self._lock:
             known = set(self._segment_names)
+            found: dict[str, object] = {}
             for d in sorted(self.data_dir.iterdir()):
                 if not d.is_dir():
                     continue
                 if d.name in known:
-                    self._committed.append(load_segment(d))
+                    found[d.name] = d
                 else:
                     # crash leftover: conversion finished (or half-finished)
                     # but the checkpoint never recorded it — rows re-consume
                     import shutil
 
                     shutil.rmtree(d, ignore_errors=True)
+            # load in COMMIT order (checkpoint list) so upsert bootstrap
+            # resolves pk conflicts the same way the live path did
+            for name in self._segment_names:
+                if name in found:
+                    seg = load_segment(found[name])
+                    if self.pk_manager is not None:
+                        self.pk_manager.add_segment(seg)
+                    self._committed.append(seg)
             factory = get_stream_consumer_factory(self.stream_config)
             meta = factory.create_metadata_provider()
             n = meta.partition_count()
@@ -260,7 +291,7 @@ class RealtimeTableDataManager:
             meta.close()
         mgr = RealtimeSegmentDataManager(
             self.schema, self.table_config, self.stream_config, partition, seq,
-            start, self._handle_commit)
+            start, self._handle_commit, pk_manager=self.pk_manager)
         self._consuming[partition] = mgr
         self._seq[partition] = seq + 1
         mgr.start()
@@ -286,6 +317,9 @@ class RealtimeTableDataManager:
         out_dir = self.data_dir / mgr.segment.segment_name
         self.converter.convert(mgr.segment, out_dir)
         committed = load_segment(out_dir)
+        if self.pk_manager is not None:
+            # transfer validity plane + record locations mutable → immutable
+            self.pk_manager.replace_segment(mgr.segment, committed)
         if self.segment_hook is not None:
             self.segment_hook(committed)
         with self._lock:
@@ -305,7 +339,7 @@ class RealtimeTableDataManager:
         seq = self._seq.get(partition, 0)
         nxt = RealtimeSegmentDataManager(
             self.schema, self.table_config, self.stream_config, partition, seq,
-            offset, self._handle_commit)
+            offset, self._handle_commit, pk_manager=self.pk_manager)
         self._consuming[partition] = nxt
         self._seq[partition] = seq + 1
         nxt.start()

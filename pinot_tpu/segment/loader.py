@@ -236,6 +236,21 @@ class ImmutableSegment:
         offsets = self.get_mv_offsets(column)
         return [d.take(ids[offsets[i] : offsets[i + 1]]) for i in range(self.num_docs)]
 
+    def read_cell(self, column: str, doc_id: int):
+        """Single-cell point read (partial upsert reads the previous row
+        version at ingestion rate; decoded id planes are cached, so this is
+        O(1) after the first read of a column)."""
+        m = self.column_metadata(column)
+        if m.encoding == "RAW":
+            v = self.get_raw(column)[doc_id]
+            return v.item() if isinstance(v, np.generic) else v
+        d = self.get_dictionary(column)
+        if m.single_value:
+            return d.get(int(self.get_dict_ids(column)[doc_id]))
+        offsets = self.get_mv_offsets(column)
+        ids = self.get_dict_ids(column)[offsets[doc_id]:offsets[doc_id + 1]]
+        return [d.get(int(i)) for i in ids]
+
     def destroy(self) -> None:
         """Release all decoded planes and the data.bin mapping.
 

@@ -164,6 +164,7 @@ class MutableSegment:
     append-only buffers; queried by the host engine while consuming."""
 
     is_mutable = True
+    valid_doc_ids = None  # upsert validity plane (upsert/manager.py)
 
     def __init__(self, schema: Schema, segment_name: str):
         self.schema = schema
@@ -211,6 +212,18 @@ class MutableSegment:
 
     def get_mv_values(self, column: str) -> list[np.ndarray]:
         return self._columns[column].mv_snapshot(self._num_docs)
+
+    def read_cell(self, column: str, doc_id: int):
+        """Single-cell point read without materializing the column (partial
+        upsert reads the previous row version at ingestion rate)."""
+        col = self._columns[column]
+        if not col.single_value:
+            row = col.mv_ids[doc_id]
+            if col.dict_encoded:
+                return [col.dictionary.get(i) for i in row]
+            return list(row)
+        v = col.dict_ids[doc_id]
+        return col.dictionary.get(v) if col.dict_encoded else v
 
     def get_null_bitmap(self, column: str) -> Optional[np.ndarray]:
         col = self._columns[column]
@@ -281,6 +294,13 @@ class MutableSegmentView:
     def __init__(self, segment: MutableSegment):
         self._seg = segment
         self._n = segment._num_docs
+
+    @property
+    def valid_doc_ids(self):
+        return self._seg.valid_doc_ids
+
+    def read_cell(self, column: str, doc_id: int):
+        return self._seg.read_cell(column, doc_id)
 
     @property
     def name(self) -> str:

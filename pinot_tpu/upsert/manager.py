@@ -1,0 +1,283 @@
+"""Upsert metadata manager: pk → latest doc, valid-doc bitmaps, partial merge.
+
+Reference analogue:
+- ConcurrentMapPartitionUpsertMetadataManager (pinot-segment-local/.../
+  upsert/ConcurrentMapPartitionUpsertMetadataManager.java:48): concurrent
+  pk→RecordLocation map, per-segment validDocIds bitmaps, comparison-column
+  conflict resolution (newer wins, ties go to the later arrival).
+- PartialUpsertHandler (.../upsert/PartialUpsertHandler.java): per-column
+  merge strategies applied against the previous version of the row.
+- ConcurrentMapPartitionDedupMetadataManager (.../dedup/): pk-presence map
+  that drops duplicate ingested rows.
+
+TPU-first shape: validity is a dense numpy bool plane per segment — the
+device engine ANDs it into the fused filter mask as a MaskParam plane
+(ops/kernels.py), so upserted tables query at full kernel speed; there is
+no RoaringBitmap in the hot path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ..spi.data_types import Schema
+from ..spi.table_config import TableConfig
+
+
+class ValidDocIds:
+    """Growable per-segment validity plane (reference: per-segment
+    ThreadSafeMutableRoaringBitmap validDocIds)."""
+
+    def __init__(self, n: int = 0):
+        self._mask = np.zeros(max(n, 64), dtype=bool)
+        self._n = n
+        self._lock = threading.Lock()
+
+    def ensure(self, n: int) -> None:
+        with self._lock:
+            if n > len(self._mask):
+                grown = np.zeros(max(n, 2 * len(self._mask)), dtype=bool)
+                grown[: len(self._mask)] = self._mask
+                self._mask = grown
+            self._n = max(self._n, n)
+
+    def set(self, doc_id: int, valid: bool) -> None:
+        self.ensure(doc_id + 1)
+        self._mask[doc_id] = valid
+
+    def mask(self, n: int) -> np.ndarray:
+        """Validity for the first n docs (query snapshot)."""
+        with self._lock:
+            out = np.zeros(n, dtype=bool)
+            m = min(n, len(self._mask))
+            out[:m] = self._mask[:m]
+            return out
+
+    def num_valid(self, n: Optional[int] = None) -> int:
+        with self._lock:
+            m = self._mask if n is None else self._mask[:n]
+            return int(m.sum())
+
+
+class PartialUpsertHandler:
+    """Column-merge strategies for PARTIAL mode. Unspecified columns
+    default to OVERWRITE (reference default); pk + comparison columns are
+    never merged."""
+
+    def __init__(self, strategies: dict[str, str], exclude: set):
+        self.strategies = {k: v.upper() for k, v in strategies.items()}
+        self.exclude = exclude
+
+    def merge(self, prev: dict, new: dict) -> dict:
+        out = dict(new)
+        for col, pv in prev.items():
+            if col in self.exclude:
+                continue
+            nv = out.get(col)
+            strat = self.strategies.get(col, "OVERWRITE")
+            if nv is None and strat != "FORCE_OVERWRITE":
+                out[col] = pv  # null new value keeps previous (reference)
+                continue
+            if strat in ("OVERWRITE", "FORCE_OVERWRITE"):
+                continue
+            if strat == "IGNORE":
+                out[col] = pv
+            elif strat == "INCREMENT":
+                out[col] = (pv or 0) + (nv or 0)
+            elif strat == "APPEND":
+                out[col] = _as_list(pv) + _as_list(nv)
+            elif strat == "UNION":
+                merged = _as_list(pv)
+                for v in _as_list(nv):
+                    if v not in merged:
+                        merged.append(v)
+                out[col] = merged
+            elif strat == "MAX":
+                out[col] = max(pv, nv)
+            elif strat == "MIN":
+                out[col] = min(pv, nv)
+            else:
+                raise ValueError(f"unknown partial-upsert strategy {strat}")
+        return out
+
+
+def _as_list(v) -> list:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return list(v)
+    return [v]
+
+
+class TableUpsertMetadataManager:
+    """Tracks the latest doc per primary key across a table's segments and
+    maintains each segment's validity plane."""
+
+    def __init__(self, schema: Schema, table_config: TableConfig):
+        cfg = table_config.upsert
+        self.mode = cfg.mode.upper()
+        self.pk_columns = list(schema.primary_key_columns)
+        if not self.pk_columns:
+            raise ValueError("upsert requires schema.primary_key_columns")
+        self.cmp_column = cfg.comparison_columns[0] if cfg.comparison_columns \
+            else table_config.validation.time_column_name
+        self._seq = itertools.count()  # arrival order, also the tie-breaker
+        self._lock = threading.RLock()
+        # pk tuple → (segment, doc_id, cmp_value, arrival_seq)
+        self._map: dict[tuple, tuple] = {}
+        self.partial_handler = None
+        if self.mode == "PARTIAL":
+            self.partial_handler = PartialUpsertHandler(
+                cfg.partial_upsert_strategies,
+                exclude=set(self.pk_columns) | ({self.cmp_column}
+                                                if self.cmp_column else set()))
+
+    # -- ingestion hooks ----------------------------------------------------
+    def process_row(self, segment, row: dict) -> Optional[dict]:
+        """Pre-index hook: PARTIAL mode merges with the previous version."""
+        if self.partial_handler is None:
+            return row
+        pk = self._pk(row)
+        with self._lock:
+            loc = self._map.get(pk)
+        if loc is None:
+            return row
+        prev = self._read_row(loc[0], loc[1])
+        return self.partial_handler.merge(prev, row)
+
+    def add_record(self, segment, doc_id: int, row: dict) -> None:
+        """Post-index hook: resolve the pk conflict (newer comparison value
+        wins; ties go to the later arrival — reference
+        ConcurrentMapPartitionUpsertMetadataManager.addOrReplaceRecord)."""
+        pk = self._pk(row)
+        cmp_val = row.get(self.cmp_column) if self.cmp_column else None
+        seq = next(self._seq)
+        valid = _validity_of(segment)
+        with self._lock:
+            loc = self._map.get(pk)
+            if loc is None or _newer(cmp_val, seq, loc):
+                if loc is not None:
+                    _validity_of(loc[0]).set(loc[1], False)
+                valid.set(doc_id, True)
+                self._map[pk] = (segment, doc_id, cmp_val, seq)
+            else:
+                valid.set(doc_id, False)
+
+    # -- segment lifecycle --------------------------------------------------
+    def replace_segment(self, old, new) -> None:
+        """Consuming segment committed → immutable with IDENTICAL doc order
+        (the converter must not re-sort upsert tables). Moves the validity
+        plane and remaps record locations (reference:
+        replaceSegment in the metadata manager)."""
+        with self._lock:
+            # mask copy + remap must be one atomic step: a concurrent
+            # add_record invalidating a doc in `old` between them would be
+            # lost, leaving a superseded row valid forever
+            old_valid = _validity_of(old)
+            new_valid = _validity_of(new)
+            n = new.num_docs
+            m = old_valid.mask(n)
+            for d in np.nonzero(m)[0]:
+                new_valid.set(int(d), True)
+            new_valid.ensure(n)
+            for pk, (seg, doc, cmp_val, seq) in list(self._map.items()):
+                if seg is old:
+                    self._map[pk] = (new, doc, cmp_val, seq)
+
+    def remove_segment(self, segment) -> None:
+        with self._lock:
+            for pk, loc in list(self._map.items()):
+                if loc[0] is segment:
+                    del self._map[pk]
+
+    def add_segment(self, segment) -> None:
+        """Bootstrap metadata from a committed segment (restart recovery —
+        reference: addSegment replays validDocIds from pk + comparison
+        columns). Call in commit order."""
+        n = segment.num_docs
+        cols = {c: segment.get_values(c) for c in self.pk_columns}
+        cmp_vals = segment.get_values(self.cmp_column) if self.cmp_column else None
+        for d in range(n):
+            row = {c: _item(cols[c][d]) for c in self.pk_columns}
+            if cmp_vals is not None:
+                row[self.cmp_column] = _item(cmp_vals[d])
+            self.add_record(segment, d, row)
+
+    # -- introspection ------------------------------------------------------
+    def num_primary_keys(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    # -- internals ----------------------------------------------------------
+    def _pk(self, row: dict) -> tuple:
+        return tuple(row.get(c) for c in self.pk_columns)
+
+    @staticmethod
+    def _read_row(segment, doc_id: int) -> dict:
+        return {c: segment.read_cell(c, doc_id) for c in segment.columns()}
+
+
+def _newer(cmp_val, seq: int, loc: tuple) -> bool:
+    old_cmp, old_seq = loc[2], loc[3]
+    if cmp_val is None or old_cmp is None:
+        return seq >= old_seq
+    if cmp_val != old_cmp:
+        return cmp_val > old_cmp
+    return seq >= old_seq
+
+
+def _validity_of(segment) -> ValidDocIds:
+    v = getattr(segment, "valid_doc_ids", None)
+    if v is None:
+        v = ValidDocIds(segment.num_docs)
+        segment.valid_doc_ids = v
+    return v
+
+
+def _item(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+class TableDedupManager:
+    """Drops rows whose primary key was already ingested (reference:
+    ConcurrentMapPartitionDedupMetadataManager — presence map, optional
+    TTL on the metadata)."""
+
+    def __init__(self, schema: Schema, table_config: TableConfig):
+        if not schema.primary_key_columns:
+            raise ValueError("dedup requires schema.primary_key_columns")
+        self.pk_columns = list(schema.primary_key_columns)
+        self._seen: set[tuple] = set()
+        self._lock = threading.Lock()
+
+    def process_row(self, segment, row: dict) -> Optional[dict]:
+        pk = tuple(row.get(c) for c in self.pk_columns)
+        with self._lock:
+            if pk in self._seen:
+                return None
+            self._seen.add(pk)
+        return row
+
+    def add_record(self, segment, doc_id: int, row: dict) -> None:
+        pass
+
+    def replace_segment(self, old, new) -> None:
+        pass
+
+    def remove_segment(self, segment) -> None:
+        pass
+
+    def add_segment(self, segment) -> None:
+        n = segment.num_docs
+        cols = {c: segment.get_values(c) for c in self.pk_columns}
+        with self._lock:
+            for d in range(n):
+                self._seen.add(tuple(_item(cols[c][d]) for c in self.pk_columns))
+
+    def num_primary_keys(self) -> int:
+        with self._lock:
+            return len(self._seen)
