@@ -81,7 +81,7 @@ class ServerInstance:
                 meta = self.store.get(f"/SEGMENTS/{table}/{seg}")
                 if meta is None:
                     continue
-                segment = load_segment(meta["location"])
+                segment = load_segment(self._fetch(meta["location"]))
                 self.segments.setdefault(table, {})[seg] = segment
             for seg in to_drop:
                 self.segments.get(table, {}).pop(seg, None)
@@ -90,6 +90,20 @@ class ServerInstance:
         # advertise only what actually loaded — a skipped/failed load must
         # not appear ONLINE or the broker would silently lose its rows
         self._update_external_view(table, want & loaded)
+
+    def _fetch(self, location: str) -> str:
+        """Deep-store fetch: tarred segments download + untar to a local
+        work dir (reference: SegmentFetcherFactory on OFFLINE→ONLINE);
+        plain directories load in place."""
+        if location.endswith((".tar.gz", ".tgz")):
+            import tempfile
+
+            from ..ingestion.batch import untar_segment
+
+            if not hasattr(self, "_untar_dir"):
+                self._untar_dir = tempfile.mkdtemp(prefix=f"{self.instance_id}_seg_")
+            return untar_segment(location, self._untar_dir)
+        return location
 
     def _register_table(self, table: str) -> None:
         raw = raw_table_name(table)

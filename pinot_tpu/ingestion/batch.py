@@ -1,0 +1,157 @@
+"""Batch ingestion: job spec → segments → push.
+
+Reference analogue: the batch ingestion spec model (pinot-spi/.../spi/
+ingestion/batch/spec/SegmentGenerationJobSpec.java — YAML job files), the
+standalone runner (pinot-plugins/pinot-batch-ingestion/
+pinot-batch-ingestion-standalone/ SegmentGenerationJobRunner), and
+IngestionJobLauncher + SegmentPushUtils (SURVEY.md §3.4): per input file,
+RecordReader → TransformPipeline → two-pass segment build → push (copy to
+deep store + controller metadata registration).
+"""
+
+from __future__ import annotations
+
+import tarfile
+import tempfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from ..plugins.inputformat import create_record_reader
+from ..segment.builder import SegmentBuilder
+from ..spi.data_types import Schema
+from ..spi.filesystem import get_fs
+from ..spi.table_config import TableConfig
+from .transform import build_transform_pipeline
+
+
+@dataclass
+class SegmentGenerationJobSpec:
+    """Reference: SegmentGenerationJobSpec.java (11 spec classes collapsed
+    to the fields the runner consumes)."""
+
+    input_dir_uri: str
+    output_dir_uri: str
+    schema: Schema
+    table_config: TableConfig
+    input_format: Optional[str] = None  # None → infer per file extension
+    record_reader_config: dict = field(default_factory=dict)
+    include_file_name_pattern: Optional[str] = None  # glob, e.g. "*.csv"
+    segment_name_prefix: Optional[str] = None
+    overwrite_output: bool = True
+    create_tar: bool = False  # reference pushes tar.gz; dirs are the default here
+
+    @classmethod
+    def from_yaml(cls, path: str, schema: Schema,
+                  table_config: TableConfig) -> "SegmentGenerationJobSpec":
+        import yaml
+
+        d = yaml.safe_load(Path(path).read_text())
+        rr = d.get("recordReaderSpec", {})
+        return cls(
+            input_dir_uri=d["inputDirURI"],
+            output_dir_uri=d["outputDirURI"],
+            schema=schema,
+            table_config=table_config,
+            input_format=rr.get("dataFormat"),
+            record_reader_config=rr.get("configs", {}) or {},
+            include_file_name_pattern=d.get("includeFileNamePattern"),
+            segment_name_prefix=(d.get("segmentNameGeneratorSpec", {}) or {})
+            .get("configs", {}).get("segment.name.prefix"),
+        )
+
+
+@dataclass
+class SegmentGenerationResult:
+    segment_name: str
+    output_uri: str
+    num_docs: int
+    rows_filtered: int
+
+
+class IngestionJobLauncher:
+    """Reference: IngestionJobLauncher.runIngestionJob — resolves input
+    files, runs one segment build per file, pushes outputs."""
+
+    def __init__(self, spec: SegmentGenerationJobSpec):
+        self.spec = spec
+
+    def list_input_files(self) -> list[str]:
+        fs = get_fs(self.spec.input_dir_uri)
+        files = fs.list_files(self.spec.input_dir_uri, recursive=True)
+        pat = self.spec.include_file_name_pattern
+        if pat:
+            from fnmatch import fnmatch
+
+            files = [f for f in files if fnmatch(Path(f).name, pat)]
+        return files
+
+    def run(self) -> list[SegmentGenerationResult]:
+        files = self.list_input_files()
+        if not files:
+            raise FileNotFoundError(
+                f"no input files under {self.spec.input_dir_uri}")
+        out_fs = get_fs(self.spec.output_dir_uri)
+        out_fs.mkdir(self.spec.output_dir_uri)
+        results = []
+        for seq, path in enumerate(files):
+            results.append(self._generate_one(path, seq))
+        return results
+
+    def _generate_one(self, path: str, seq: int) -> SegmentGenerationResult:
+        spec = self.spec
+        prefix = spec.segment_name_prefix or spec.table_config.table_name
+        segment_name = f"{prefix}_{seq}"
+        reader = create_record_reader(path, spec.input_format,
+                                      spec.record_reader_config)
+        pipeline = build_transform_pipeline(spec.schema, spec.table_config)
+        rows = []
+        filtered = 0
+        for raw in reader:
+            row = pipeline.transform(dict(raw))
+            if row is None:
+                filtered += 1
+                continue
+            rows.append(row)
+        with tempfile.TemporaryDirectory() as tmp:
+            local = Path(tmp) / segment_name
+            SegmentBuilder(spec.schema, spec.table_config, segment_name) \
+                .build_from_rows(rows, local)
+            out_uri = f"{spec.output_dir_uri.rstrip('/')}/{segment_name}"
+            fs = get_fs(spec.output_dir_uri)
+            if spec.create_tar:
+                tar_path = Path(tmp) / f"{segment_name}.tar.gz"
+                with tarfile.open(tar_path, "w:gz") as tf:
+                    tf.add(local, arcname=segment_name)
+                out_uri += ".tar.gz"
+                fs.copy_from_local(str(tar_path), out_uri)
+            else:
+                fs.copy_from_local(str(local), out_uri)
+        return SegmentGenerationResult(segment_name, out_uri, len(rows), filtered)
+
+
+def push_segments_to_cluster(results: list[SegmentGenerationResult],
+                             controller, table_name_with_type: str) -> None:
+    """Metadata push (reference: SegmentPushUtils → controller
+    /v2/segments): register each built segment's location + doc count with
+    the cluster controller, which assigns replicas and updates the ideal
+    state."""
+    for r in results:
+        controller.add_segment(table_name_with_type, r.segment_name,
+                               {"location": r.output_uri, "numDocs": r.num_docs})
+
+
+def untar_segment(tar_uri: str, dest_dir: str) -> str:
+    """Server-side fetch+untar (reference: SegmentFetcherFactory + untar on
+    OFFLINE→ONLINE)."""
+    fs = get_fs(tar_uri)
+    with tempfile.TemporaryDirectory() as tmp:
+        local = Path(tmp) / Path(tar_uri).name
+        fs.copy_to_local(tar_uri, str(local))
+        with tarfile.open(local, "r:gz") as tf:
+            tf.extractall(dest_dir, filter="data")
+    name = Path(tar_uri).name
+    for suffix in (".tar.gz", ".tgz"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    return str(Path(dest_dir) / name)

@@ -1,0 +1,3 @@
+"""Plugin families (reference: pinot-plugins/ — stream ingestion, file
+systems, input formats, batch runners, metrics). Stream plugins live in
+spi/stream.py; filesystem plugins in spi/filesystem.py; input formats here."""

@@ -1,0 +1,15 @@
+"""Input-format record readers.
+
+Reference analogue: pinot-plugins/pinot-input-format/ — RecordReader SPI
+(pinot-spi/.../spi/data/readers/RecordReader.java) with avro, csv, json,
+orc, parquet, protobuf, thrift impls. Here: csv/json native, parquet+orc via
+pyarrow, avro via a self-contained container-file decoder
+(plugins/inputformat/avro.py)."""
+
+from .readers import (
+    RecordReader,
+    create_record_reader,
+    register_record_reader,
+)
+
+__all__ = ["RecordReader", "create_record_reader", "register_record_reader"]

@@ -1,0 +1,218 @@
+"""Batch ingestion + input formats + PinotFS tests.
+
+Reference pattern: input-format plugin unit tests + the standalone batch
+runner integration path (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.ingestion.batch import (
+    IngestionJobLauncher,
+    SegmentGenerationJobSpec,
+    push_segments_to_cluster,
+)
+from pinot_tpu.plugins.inputformat import create_record_reader
+from pinot_tpu.plugins.inputformat.avro import read_avro_file, write_avro_file
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.filesystem import LocalPinotFS, get_fs
+from pinot_tpu.spi.table_config import TableConfig
+
+SCHEMA = Schema.build(
+    "trips",
+    dimensions=[("city", "STRING"), ("day", "INT")],
+    metrics=[("fare", "DOUBLE")])
+
+ROWS = [
+    {"city": "sf", "day": 1, "fare": 10.5},
+    {"city": "ny", "day": 1, "fare": 20.0},
+    {"city": "sf", "day": 2, "fare": 7.25},
+    {"city": "la", "day": 3, "fare": 15.0},
+]
+
+
+# -- record readers ----------------------------------------------------------
+
+
+def test_csv_reader(tmp_path):
+    p = tmp_path / "a.csv"
+    p.write_text("city,day,fare\nsf,1,10.5\nny,1,20.0\n,2,\n")
+    rows = list(create_record_reader(str(p)))
+    assert rows[0] == {"city": "sf", "day": 1, "fare": 10.5}
+    assert rows[2]["city"] is None and rows[2]["fare"] is None
+
+
+def test_csv_reader_mv_and_gzip(tmp_path):
+    p = tmp_path / "b.csv.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("name,tags\nx,a;b;c\ny,solo\n")
+    rows = list(create_record_reader(str(p), config={"multiValueDelimiter": ";"}))
+    assert rows[0]["tags"] == ["a", "b", "c"]
+    assert rows[1]["tags"] == "solo"
+
+
+def test_json_reader_lines_and_array(tmp_path):
+    p1 = tmp_path / "a.json"
+    p1.write_text("\n".join(json.dumps(r) for r in ROWS))
+    assert list(create_record_reader(str(p1))) == ROWS
+    p2 = tmp_path / "b.json"
+    p2.write_text(json.dumps(ROWS))
+    assert list(create_record_reader(str(p2))) == ROWS
+
+
+def test_parquet_reader(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    table = pa.Table.from_pylist(ROWS)
+    p = tmp_path / "a.parquet"
+    pq.write_table(table, p)
+    assert list(create_record_reader(str(p))) == ROWS
+
+
+def test_orc_reader(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    from pyarrow import orc
+
+    table = pa.Table.from_pylist(ROWS)
+    p = tmp_path / "a.orc"
+    orc.write_table(table, p)
+    assert list(create_record_reader(str(p))) == ROWS
+
+
+AVRO_SCHEMA = {
+    "type": "record", "name": "Trip",
+    "fields": [
+        {"name": "city", "type": ["null", "string"]},
+        {"name": "day", "type": "int"},
+        {"name": "fare", "type": "double"},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "props", "type": {"type": "map", "values": "long"}},
+    ]}
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_roundtrip(tmp_path, codec):
+    records = [
+        {"city": "sf", "day": 1, "fare": 10.5, "tags": ["a", "b"], "props": {"k": 1}},
+        {"city": None, "day": -2, "fare": -0.5, "tags": [], "props": {}},
+        {"city": "日本", "day": 12345678, "fare": 1e9, "tags": ["中"], "props": {"x": -9}},
+    ]
+    p = tmp_path / "a.avro"
+    with open(p, "wb") as f:
+        write_avro_file(f, AVRO_SCHEMA, records, codec=codec)
+    with open(p, "rb") as f:
+        back = list(read_avro_file(f))
+    assert back == records
+    assert list(create_record_reader(str(p))) == records
+
+
+# -- filesystem --------------------------------------------------------------
+
+
+def test_local_fs_ops(tmp_path):
+    fs = get_fs(str(tmp_path))
+    assert isinstance(fs, LocalPinotFS)
+    d = tmp_path / "x"
+    fs.mkdir(str(d))
+    (d / "f.txt").write_text("hi")
+    assert fs.exists(str(d / "f.txt"))
+    assert fs.length(str(d / "f.txt")) == 2
+    assert fs.list_files(str(d)) == [str(d / "f.txt")]
+    fs.copy(str(d / "f.txt"), str(d / "g.txt"))
+    fs.move(str(d / "g.txt"), str(tmp_path / "h.txt"))
+    assert fs.exists(str(tmp_path / "h.txt"))
+    assert not fs.exists(str(d / "g.txt"))
+    with pytest.raises(OSError):
+        fs.delete(str(d))
+    fs.delete(str(d), force=True)
+    assert not fs.exists(str(d))
+
+
+def test_fs_registry_unknown_scheme():
+    with pytest.raises(ValueError, match="no PinotFS"):
+        get_fs("s3://bucket/key")
+
+
+# -- batch job ---------------------------------------------------------------
+
+
+def _write_inputs(tmp_path):
+    ind = tmp_path / "in"
+    ind.mkdir()
+    (ind / "part1.csv").write_text(
+        "city,day,fare\nsf,1,10.5\nny,1,20.0\n")
+    (ind / "part2.csv").write_text(
+        "city,day,fare\nsf,2,7.25\nla,3,15.0\n")
+    return ind
+
+
+def test_batch_job_builds_segments(tmp_path):
+    ind = _write_inputs(tmp_path)
+    spec = SegmentGenerationJobSpec(
+        input_dir_uri=str(ind), output_dir_uri=str(tmp_path / "out"),
+        schema=SCHEMA, table_config=TableConfig(table_name="trips"),
+        include_file_name_pattern="*.csv")
+    results = IngestionJobLauncher(spec).run()
+    assert [r.num_docs for r in results] == [2, 2]
+    seg = load_segment(results[0].output_uri)
+    assert seg.num_docs == 2
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, [load_segment(r.output_uri) for r in results])
+    r = qe.execute_sql("SELECT city, SUM(fare) FROM trips GROUP BY city ORDER BY city")
+    assert [list(x) for x in r.result_table.rows] == \
+        [["la", 15.0], ["ny", 20.0], ["sf", 17.75]]
+
+
+def test_batch_job_yaml_spec(tmp_path):
+    ind = _write_inputs(tmp_path)
+    yml = tmp_path / "job.yaml"
+    yml.write_text(f"""
+inputDirURI: "{ind}"
+outputDirURI: "{tmp_path / 'out'}"
+includeFileNamePattern: "*.csv"
+recordReaderSpec:
+  dataFormat: csv
+segmentNameGeneratorSpec:
+  configs:
+    segment.name.prefix: "trips_batch"
+""")
+    spec = SegmentGenerationJobSpec.from_yaml(
+        str(yml), SCHEMA, TableConfig(table_name="trips"))
+    results = IngestionJobLauncher(spec).run()
+    assert results[0].segment_name == "trips_batch_0"
+
+
+def test_batch_push_to_cluster_with_tar(tmp_path):
+    """Full §3.4 path: build tarred segments → push metadata → servers
+    fetch+untar+load → query via broker."""
+    ind = _write_inputs(tmp_path)
+    spec = SegmentGenerationJobSpec(
+        input_dir_uri=str(ind), output_dir_uri=str(tmp_path / "deepstore"),
+        schema=SCHEMA, table_config=TableConfig(table_name="trips"),
+        create_tar=True)
+    results = IngestionJobLauncher(spec).run()
+    assert all(r.output_uri.endswith(".tar.gz") for r in results)
+
+    store = PropertyStore()
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="host")
+    server.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    table = controller.create_table({"tableName": "trips", "replication": 1})
+    push_segments_to_cluster(results, controller, table)
+    try:
+        r = broker.execute_sql("SELECT COUNT(*), SUM(fare) FROM trips")
+        assert not r.exceptions, r.exceptions
+        assert r.result_table.rows[0] == [4, 52.75]
+    finally:
+        server.stop()
