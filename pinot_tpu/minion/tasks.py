@@ -1,0 +1,330 @@
+"""Built-in minion tasks.
+
+Reference analogue: pinot-plugins/pinot-minion-tasks/
+pinot-minion-builtin-tasks/.../tasks/ — MergeRollupTask,
+RealtimeToOfflineSegmentsTask, PurgeTask, RefreshSegmentTask,
+UpsertCompactionTask, SegmentGenerationAndPushTask. Each is a
+(generator, executor) pair registered with the framework; generators run on
+the controller (PinotTaskManager), executors on minions.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ..cluster.controller import raw_table_name, table_name_with_type
+from ..query.parser.sql import parse_filter_expression
+from ..segment.builder import SegmentBuilder
+from ..segment.loader import load_segment
+from ..spi.data_types import Schema
+from ..spi.table_config import TableConfig
+from .framework import (
+    TaskContext,
+    TaskSpec,
+    register_task_executor,
+    register_task_generator,
+)
+
+
+# -- shared helpers ----------------------------------------------------------
+
+
+def _schema_of(ctx: TaskContext, table: str) -> Schema:
+    raw = raw_table_name(table)
+    d = ctx.store.get(f"/SCHEMAS/{raw}")
+    if d is None:
+        raise KeyError(f"schema {raw} not registered")
+    return Schema.from_json(d)
+
+
+def _table_config_of(ctx: TaskContext, table: str) -> TableConfig:
+    cfg = ctx.controller.table_config(table) or {}
+    return TableConfig(table_name=raw_table_name(table))if not cfg.get("pinotConfig") \
+        else TableConfig.from_json(cfg["pinotConfig"])
+
+
+def _load(ctx: TaskContext, table: str, segment_name: str):
+    meta = ctx.controller.segment_metadata(table, segment_name)
+    if meta is None:
+        raise KeyError(f"{table}/{segment_name} has no metadata")
+    location = meta["location"]
+    if location.endswith((".tar.gz", ".tgz")):
+        from ..ingestion.batch import untar_segment
+
+        location = untar_segment(location, str(Path(ctx.work_dir) / "untar"))
+    return load_segment(location)
+
+
+def segment_rows(segment) -> list[dict]:
+    """Materialize a segment as row dicts (minion rewrite path — the
+    reference's SegmentProcessorFramework mapper input)."""
+    cols = {}
+    for c in segment.columns():
+        md = segment.column_metadata(c)
+        if md.single_value:
+            cols[c] = segment.get_values(c)
+        else:
+            cols[c] = segment.get_mv_values(c)
+    n = segment.num_docs
+    nulls = {c: segment.get_null_bitmap(c) for c in segment.columns()}
+    out = []
+    for i in range(n):
+        row = {}
+        for c, vals in cols.items():
+            if nulls.get(c) is not None and nulls[c][i]:
+                row[c] = None
+            else:
+                v = vals[i]
+                row[c] = (v.item() if isinstance(v, np.generic)
+                          else list(v) if isinstance(v, np.ndarray) else v)
+        out.append(row)
+    return out
+
+
+def _build_and_add(ctx: TaskContext, table: str, segment_name: str,
+                   schema: Schema, rows: list[dict], extra_meta=None) -> str:
+    out_dir = Path(ctx.work_dir) / table / segment_name
+    SegmentBuilder(schema, segment_name=segment_name).build_from_rows(rows, out_dir)
+    meta = {"location": str(out_dir), "numDocs": len(rows)}
+    meta.update(extra_meta or {})
+    ctx.controller.add_segment(table, segment_name, meta)
+    return segment_name
+
+
+# -- MergeRollupTask ---------------------------------------------------------
+
+
+def merge_rollup_generator(controller, table: str, cfg: dict) -> list[TaskSpec]:
+    """Bundle small segments into one merge task (reference:
+    MergeRollupTaskGenerator buckets by time + merge level; here one bundle
+    per run capped by maxNumRecordsPerTask)."""
+    max_records = int(cfg.get("maxNumRecordsPerTask", 5_000_000))
+    segs = []
+    total = 0
+    for name in controller.store.children(f"/SEGMENTS/{table}"):
+        meta = controller.segment_metadata(table, name) or {}
+        if meta.get("mergedFrom"):
+            continue  # don't re-merge outputs
+        n = int(meta.get("numDocs", 0))
+        if total + n > max_records and segs:
+            break
+        segs.append(name)
+        total += n
+    if len(segs) < 2:
+        return []
+    return [TaskSpec("MergeRollupTask", table,
+                     {**cfg, "segments": segs})]
+
+
+def merge_rollup_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
+    """Concat or rollup N segments into one (reference:
+    MergeRollupTaskExecutor over SegmentProcessorFramework)."""
+    table = spec.table
+    schema = _schema_of(ctx, table)
+    names = spec.config["segments"]
+    merge_type = spec.config.get("mergeType", "concat").lower()
+    rows: list[dict] = []
+    for name in names:
+        rows.extend(segment_rows(_load(ctx, table, name)))
+    if merge_type == "rollup":
+        rows = _rollup(schema, rows, spec.config)
+    out_name = f"merged_{raw_table_name(table)}_{int(time.time() * 1000)}"
+    _build_and_add(ctx, table, out_name, schema, rows,
+                   {"mergedFrom": names})
+    for name in names:
+        ctx.controller.drop_segment(table, name)
+    return {"outputSegment": out_name, "numDocs": len(rows),
+            "merged": names}
+
+
+def _rollup(schema: Schema, rows: list[dict], cfg: dict) -> list[dict]:
+    """Group by every dimension/date-time column, aggregate metrics
+    (default SUM; cfg 'aggregationTypes': {metric: SUM|MIN|MAX})."""
+    key_cols = [c for c in schema.column_names()
+                if c not in schema.metric_names()]
+    metrics = schema.metric_names()
+    aggs = {m: (cfg.get("aggregationTypes", {}).get(m, "SUM")).upper()
+            for m in metrics}
+    grouped: dict[tuple, dict] = {}
+    for row in rows:
+        key = tuple(_hashable(row.get(c)) for c in key_cols)
+        cur = grouped.get(key)
+        if cur is None:
+            grouped[key] = dict(row)
+            continue
+        for m in metrics:
+            a, b = cur.get(m), row.get(m)
+            if a is None:
+                cur[m] = b
+            elif b is not None:
+                cur[m] = (a + b if aggs[m] == "SUM"
+                          else min(a, b) if aggs[m] == "MIN" else max(a, b))
+    return list(grouped.values())
+
+
+def _hashable(v):
+    return tuple(v) if isinstance(v, (list, np.ndarray)) else v
+
+
+# -- RealtimeToOfflineSegmentsTask -------------------------------------------
+
+
+def rt2off_generator(controller, table: str, cfg: dict) -> list[TaskSpec]:
+    """Move committed realtime segments into the offline twin (reference:
+    RealtimeToOfflineSegmentsTaskGenerator windows on the time column with
+    a watermark; here: all registered realtime segments not yet moved)."""
+    if not table.endswith("_REALTIME"):
+        return []
+    moved = set(controller.store.get(f"/MINION_WATERMARKS/{table}") or [])
+    segs = [s for s in controller.store.children(f"/SEGMENTS/{table}")
+            if s not in moved]
+    if not segs:
+        return []
+    return [TaskSpec("RealtimeToOfflineSegmentsTask", table,
+                     {**cfg, "segments": segs})]
+
+
+def rt2off_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
+    table = spec.table
+    offline = table_name_with_type(raw_table_name(table), "OFFLINE")
+    if ctx.controller.table_config(offline) is None:
+        raise KeyError(f"offline twin {offline} does not exist")
+    schema = _schema_of(ctx, table)
+    time_col = (ctx.controller.table_config(offline) or {}).get("timeColumn")
+    rows = []
+    for name in spec.config["segments"]:
+        rows.extend(segment_rows(_load(ctx, table, name)))
+    out_name = f"{raw_table_name(table)}_rt2off_{int(time.time() * 1000)}"
+    extra = {}
+    if time_col and rows:
+        tv = [r[time_col] for r in rows if r.get(time_col) is not None]
+        if tv:
+            extra = {"startTimeMs": min(tv), "endTimeMs": max(tv)}
+    _build_and_add(ctx, offline, out_name, schema, rows, extra)
+    ctx.store.update(f"/MINION_WATERMARKS/{table}", lambda cur: sorted(
+        set(cur or []) | set(spec.config["segments"])))
+    return {"outputSegment": out_name, "offlineTable": offline,
+            "numDocs": len(rows)}
+
+
+# -- PurgeTask ---------------------------------------------------------------
+
+
+def purge_generator(controller, table: str, cfg: dict) -> list[TaskSpec]:
+    segs = controller.store.children(f"/SEGMENTS/{table}")
+    return [TaskSpec("PurgeTask", table, {**cfg, "segments": segs})] if segs else []
+
+
+def purge_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
+    """Rewrite segments dropping rows that match purgeFilter (reference:
+    PurgeTaskExecutor with a RecordPurger; the filter here is a SQL boolean
+    expression over the row)."""
+    from ..engine.host_executor import HostSegmentExecutor
+
+    table = spec.table
+    schema = _schema_of(ctx, table)
+    fctx = parse_filter_expression(spec.config["purgeFilter"])
+    host = HostSegmentExecutor()
+    purged = {}
+    for name in spec.config["segments"]:
+        seg = _load(ctx, table, name)
+        mask = host._filter_mask(fctx, seg)  # rows to PURGE
+        if not mask.any():
+            continue
+        rows = [r for r, m in zip(segment_rows(seg), mask) if not m]
+        new_name = f"{name}_purged"
+        _build_and_add(ctx, table, new_name, schema, rows)
+        ctx.controller.drop_segment(table, name)
+        purged[name] = int(mask.sum())
+    return {"purged": purged}
+
+
+# -- UpsertCompactionTask ----------------------------------------------------
+
+
+def upsert_compaction_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
+    """Rewrite segments keeping only upsert-valid docs (reference:
+    UpsertCompactionTaskExecutor reads validDocIds from the server). The
+    validity snapshot rides in the task config as {segment: [valid doc
+    ids]} since minions don't share server memory."""
+    table = spec.table
+    schema = _schema_of(ctx, table)
+    compacted = {}
+    for name, valid_ids in spec.config["validDocIds"].items():
+        seg = _load(ctx, table, name)
+        keep = set(valid_ids)
+        rows = [r for i, r in enumerate(segment_rows(seg)) if i in keep]
+        if len(rows) == seg.num_docs:
+            continue
+        new_name = f"{name}_compacted"
+        _build_and_add(ctx, table, new_name, schema, rows)
+        ctx.controller.drop_segment(table, name)
+        compacted[name] = seg.num_docs - len(rows)
+    return {"compacted": compacted}
+
+
+# -- RefreshSegmentTask ------------------------------------------------------
+
+
+def refresh_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
+    """Rebuild segments under the CURRENT schema/config so new indexes and
+    schema evolution apply (reference: RefreshSegmentTaskExecutor)."""
+    table = spec.table
+    schema = _schema_of(ctx, table)
+    refreshed = []
+    for name in spec.config["segments"]:
+        seg = _load(ctx, table, name)
+        rows = segment_rows(seg)
+        out_dir = Path(ctx.work_dir) / table / f"{name}_refreshed"
+        SegmentBuilder(schema, segment_name=name).build_from_rows(rows, out_dir)
+        ctx.controller.add_segment(table, name, {
+            "location": str(out_dir), "numDocs": len(rows),
+            "refreshedAtMs": int(time.time() * 1000)})
+        refreshed.append(name)
+    return {"refreshed": refreshed}
+
+
+# -- SegmentGenerationAndPushTask --------------------------------------------
+
+
+def segment_gen_push_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
+    """Batch build + push as a minion task (reference:
+    SegmentGenerationAndPushTaskExecutor)."""
+    from ..ingestion.batch import (
+        IngestionJobLauncher,
+        SegmentGenerationJobSpec,
+        push_segments_to_cluster,
+    )
+
+    table = spec.table
+    schema = _schema_of(ctx, table)
+    job = SegmentGenerationJobSpec(
+        input_dir_uri=spec.config["inputDirURI"],
+        output_dir_uri=spec.config.get(
+            "outputDirURI", str(Path(ctx.work_dir) / table / "generated")),
+        schema=schema,
+        table_config=TableConfig(table_name=raw_table_name(table)),
+        input_format=spec.config.get("inputFormat"),
+        include_file_name_pattern=spec.config.get("includeFileNamePattern"),
+        segment_name_prefix=spec.config.get("segmentNamePrefix"),
+    )
+    results = IngestionJobLauncher(job).run()
+    push_segments_to_cluster(results, ctx.controller, table)
+    return {"segments": [r.segment_name for r in results],
+            "numDocs": sum(r.num_docs for r in results)}
+
+
+# -- registration ------------------------------------------------------------
+
+register_task_generator("MergeRollupTask", merge_rollup_generator)
+register_task_executor("MergeRollupTask", merge_rollup_executor)
+register_task_generator("RealtimeToOfflineSegmentsTask", rt2off_generator)
+register_task_executor("RealtimeToOfflineSegmentsTask", rt2off_executor)
+register_task_generator("PurgeTask", purge_generator)
+register_task_executor("PurgeTask", purge_executor)
+register_task_executor("UpsertCompactionTask", upsert_compaction_executor)
+register_task_executor("RefreshSegmentTask", refresh_executor)
+register_task_executor("SegmentGenerationAndPushTask", segment_gen_push_executor)

@@ -1,0 +1,163 @@
+"""Minion task framework + built-in task tests."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.minion import MinionInstance, PinotTaskManager
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "metrics",
+    dimensions=[("host", "STRING"), ("day", "INT")],
+    metrics=[("cpu", "DOUBLE")])
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    store = PropertyStore()
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="host")
+    server.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    task_mgr = PinotTaskManager(store, controller)
+    minion = MinionInstance(store, "Minion_0", controller,
+                            str(tmp_path / "minion_work"))
+    yield store, controller, server, broker, task_mgr, minion
+    server.stop()
+
+
+def _add_segments(controller, table, tmp_path, datasets):
+    for i, rows in enumerate(datasets):
+        name = f"seg_{i}"
+        path = tmp_path / name
+        SegmentBuilder(SCHEMA, segment_name=name).build_from_rows(rows, path)
+        controller.add_segment(table, name,
+                               {"location": str(path), "numDocs": len(rows)})
+
+
+def test_merge_rollup_concat(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"MergeRollupTask": {"mergeType": "concat"}}})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 0.5}, {"host": "b", "day": 1, "cpu": 0.7}],
+        [{"host": "a", "day": 2, "cpu": 0.9}],
+    ])
+    before = broker.execute_sql("SELECT COUNT(*), SUM(cpu) FROM metrics")
+    ids = task_mgr.schedule_tasks()
+    assert len(ids) == 1
+    assert minion.run_pending_once() == 1
+    state = task_mgr.task_state("MergeRollupTask", ids[0])
+    assert state["state"] == "COMPLETED", state
+    # one merged segment replaces the two inputs; results identical
+    assert store.children(f"/SEGMENTS/{table}") == [state["output"]["outputSegment"]]
+    after = broker.execute_sql("SELECT COUNT(*), SUM(cpu) FROM metrics")
+    assert after.result_table.rows == before.result_table.rows
+
+
+def test_merge_rollup_rollup(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"MergeRollupTask": {"mergeType": "rollup"}}})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 1.0}, {"host": "a", "day": 1, "cpu": 2.0}],
+        [{"host": "a", "day": 1, "cpu": 4.0}, {"host": "b", "day": 1, "cpu": 8.0}],
+    ])
+    task_mgr.schedule_tasks()
+    minion.run_pending_once()
+    r = broker.execute_sql(
+        "SELECT host, SUM(cpu), COUNT(*) FROM metrics GROUP BY host ORDER BY host")
+    assert [list(x) for x in r.result_table.rows] == \
+        [["a", 7.0, 1], ["b", 8.0, 1]]  # 3 'a' rows rolled into one
+
+
+def test_purge_task(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"PurgeTask": {"purgeFilter": "host = 'evil'"}}})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 1.0}, {"host": "evil", "day": 1, "cpu": 9.0},
+         {"host": "b", "day": 2, "cpu": 2.0}],
+    ])
+    task_mgr.schedule_tasks()
+    minion.run_pending_once()
+    r = broker.execute_sql("SELECT host FROM metrics ORDER BY host LIMIT 10")
+    assert [x[0] for x in r.result_table.rows] == ["a", "b"]
+
+
+def test_realtime_to_offline(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    rt = controller.create_table({
+        "tableName": "metrics", "tableType": "REALTIME", "replication": 1,
+        "timeColumn": "day",
+        "taskConfigs": {"RealtimeToOfflineSegmentsTask": {}}})
+    off = controller.create_table({
+        "tableName": "metrics", "tableType": "OFFLINE", "replication": 1,
+        "timeColumn": "day"})
+    _add_segments(controller, rt, tmp_path, [
+        [{"host": "a", "day": 5, "cpu": 1.0}, {"host": "b", "day": 6, "cpu": 2.0}],
+    ])
+    task_mgr.schedule_tasks()
+    minion.run_pending_once()
+    offline_segs = store.children(f"/SEGMENTS/{off}")
+    assert len(offline_segs) == 1
+    meta = controller.segment_metadata(off, offline_segs[0])
+    assert meta["startTimeMs"] == 5 and meta["endTimeMs"] == 6
+    # re-scheduling produces no duplicate task (watermark)
+    assert task_mgr.schedule_tasks(table=rt) == []
+
+
+def test_task_claim_exclusive(cluster, tmp_path):
+    """Two minions race for one task; exactly one runs it."""
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"MergeRollupTask": {}}})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 1.0}],
+        [{"host": "b", "day": 1, "cpu": 2.0}],
+    ])
+    minion2 = MinionInstance(store, "Minion_1", controller,
+                             str(tmp_path / "m2"))
+    task_mgr.schedule_tasks()
+    ran = minion.run_pending_once() + minion2.run_pending_once()
+    assert ran == 1
+
+
+def test_error_surfaces(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"PurgeTask": {"purgeFilter": "nonexistent_col = 1"}}})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 1.0}]])
+    ids = task_mgr.schedule_tasks()
+    minion.run_pending_once()
+    state = task_mgr.task_state("PurgeTask", ids[0])
+    assert state["state"] == "ERROR"
+    assert state["error"]
+
+
+def test_background_minion_polling(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"MergeRollupTask": {}}})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 1.0}],
+        [{"host": "b", "day": 1, "cpu": 2.0}],
+    ])
+    minion.start()
+    try:
+        task_mgr.schedule_tasks()
+        assert task_mgr.wait_all(timeout_s=10)
+    finally:
+        minion.stop()
