@@ -1,0 +1,14 @@
+"""Timeseries engine: time-bucketed series queries over OLAP tables.
+
+Reference analogue: pinot-timeseries/ (SPI + planner, SURVEY.md L10) with
+the m3ql language plugin (pinot-plugins/pinot-timeseries-lang/
+pinot-timeseries-m3ql/) and the broker's TimeSeriesRequestHandler. The
+leaf fetch compiles onto the single-stage engine as a time-bucketed
+group-by — i.e. it rides the same TPU kernel as SQL — and the series
+combinators run vectorized on host.
+"""
+
+from .series import TimeBuckets, TimeSeries, TimeSeriesBlock
+from .engine import TimeSeriesEngine
+
+__all__ = ["TimeSeries", "TimeSeriesBlock", "TimeBuckets", "TimeSeriesEngine"]

@@ -1,0 +1,136 @@
+"""Timeseries engine tests (reference: pinot-timeseries SPI + m3ql plugin)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.timeseries import TimeSeriesEngine
+from pinot_tpu.timeseries.engine import TimeSeriesQueryError, parse_m3ql
+
+SCHEMA = Schema.build(
+    "reqs",
+    dimensions=[("svc", "STRING"), ("dc", "STRING")],
+    metrics=[("lat", "DOUBLE")],
+    date_times=[("ts", "LONG")])
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ts")
+    rows = []
+    # 2 services × 2 dcs × buckets of 10 at ts 0..39
+    for t in range(0, 40):
+        for svc in ("api", "web"):
+            for dc in ("east", "west"):
+                rows.append({"svc": svc, "dc": dc, "ts": t,
+                             "lat": 1.0 if svc == "api" else 2.0})
+    SegmentBuilder(SCHEMA, segment_name="ts0").build_from_rows(rows, d / "s0")
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, [load_segment(d / "s0")])
+    return TimeSeriesEngine(qe)
+
+
+def test_parse_m3ql():
+    plan = parse_m3ql(
+        'fetch table=reqs value=lat time_col=ts filter="svc = \'api\'" '
+        "| sum svc,dc | rate | scale 2")
+    assert plan.fetch.table == "reqs"
+    assert plan.fetch.group_tags == ["svc", "dc"]
+    assert [s.name for s in plan.stages] == ["aggregate_tags", "rate", "scale"]
+
+
+def test_fetch_sum_by_tag(engine):
+    block = engine.execute("fetch table=reqs value=lat time_col=ts | sum svc",
+                           start=0, end=40, step=10)
+    assert block.buckets.num_buckets == 4
+    by_tag = {s.label(): s.values for s in block.series}
+    # api: 1.0 × 2 dcs × 10 ts per bucket = 20; web: 2.0 × 20 = 40
+    assert list(by_tag["svc=api"]) == [20.0] * 4
+    assert list(by_tag["svc=web"]) == [40.0] * 4
+
+
+def test_fetch_filter_and_global_sum(engine):
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts filter=\"dc = 'east'\" | sum",
+        start=0, end=40, step=10)
+    assert len(block.series) == 1
+    assert list(block.series[0].values) == [30.0] * 4  # (1+2) × 10 per bucket
+
+
+def test_avg_and_count(engine):
+    block = engine.execute("fetch table=reqs value=lat time_col=ts agg=avg | avg svc",
+                           start=0, end=40, step=10)
+    by_tag = {s.label(): s.values for s in block.series}
+    assert list(by_tag["svc=api"]) == [1.0] * 4
+    assert list(by_tag["svc=web"]) == [2.0] * 4
+
+
+def test_pipe_combinators(engine):
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts | sum | scale 0.5",
+        start=0, end=40, step=10)
+    assert list(block.series[0].values) == [30.0] * 4  # 60 × 0.5
+
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts | sum | rate",
+        start=0, end=40, step=10)
+    v = block.series[0].values
+    assert np.isnan(v[0]) and list(v[1:]) == [0.0, 0.0, 0.0]
+
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts | sum | shift 1",
+        start=0, end=40, step=10)
+    v = block.series[0].values
+    assert np.isnan(v[0]) and list(v[1:]) == [60.0] * 3
+
+
+def test_transform_null_and_sparse(engine):
+    # query beyond the data range: empty buckets are NaN then filled
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts | sum | transform_null 0",
+        start=0, end=80, step=10)
+    v = block.series[0].values
+    assert list(v) == [60.0] * 4 + [0.0] * 4
+
+
+def test_topk(engine):
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts | sum svc,dc | topk 2",
+        start=0, end=40, step=10)
+    assert len(block.series) == 2
+    assert all(s.tags["svc"] == "web" for s in block.series)
+
+
+def test_moving_avg_and_keep_last(engine):
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts | sum | moving_avg 2",
+        start=0, end=40, step=10)
+    assert list(block.series[0].values) == [60.0] * 4
+
+    block = engine.execute(
+        "fetch table=reqs value=lat time_col=ts | sum | keep_last_value",
+        start=0, end=80, step=10)
+    assert list(block.series[0].values) == [60.0] * 8
+
+
+def test_json_shape(engine):
+    block = engine.execute("fetch table=reqs value=lat time_col=ts | sum svc",
+                           start=0, end=40, step=10)
+    j = block.to_json()
+    assert j["timeBuckets"] == {"start": 0, "step": 10, "numBuckets": 4}
+    assert len(j["series"]) == 2
+
+
+def test_errors(engine):
+    with pytest.raises(TimeSeriesQueryError, match="must start with 'fetch'"):
+        engine.execute("sum svc", 0, 10, 1)
+    with pytest.raises(TimeSeriesQueryError, match="missing required"):
+        engine.execute("fetch table=reqs", 0, 10, 1)
+    with pytest.raises(TimeSeriesQueryError, match="unknown pipe stage"):
+        engine.execute("fetch table=reqs value=lat time_col=ts | frobnicate",
+                       0, 10, 1)
