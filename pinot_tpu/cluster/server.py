@@ -19,16 +19,21 @@ from ..segment.loader import load_segment
 from ..spi.data_types import Schema
 from .controller import ONLINE, raw_table_name
 from .store import PropertyStore
+from ..engine.scheduler import QueryScheduler
 from .transport import RpcServer
 
 
 class ServerInstance:
     def __init__(self, store: PropertyStore, instance_id: str,
-                 backend: str = "auto", tags: Optional[list[str]] = None):
+                 backend: str = "auto", tags: Optional[list[str]] = None,
+                 max_concurrent_queries: int = 8):
         self.store = store
         self.instance_id = instance_id
         self.tags = tags or ["DefaultTenant"]
         self.executor = QueryExecutor(backend=backend)
+        # admission control in front of execution (reference:
+        # QueryScheduler.submit, fcfs default policy)
+        self.scheduler = QueryScheduler(max_concurrent=max_concurrent_queries)
         # tableNameWithType → {segment_name: ImmutableSegment}
         self.segments: dict[str, dict[str, object]] = {}
         self._lock = threading.RLock()
@@ -137,7 +142,8 @@ class ServerInstance:
 
     def _handle_query(self, request):
         """Execute a QueryContext over an explicit segment list (the broker
-        names segments per server, reference InstanceRequest.searchSegments)."""
+        names segments per server, reference InstanceRequest.searchSegments)
+        under the scheduler's admission control."""
         table = request["table"]
         names = request["segments"]
         query = request["query"]
@@ -145,6 +151,10 @@ class ServerInstance:
             hosted = self.segments.get(table, {})
             segs = [hosted[n] for n in names if n in hosted]
             missing = [n for n in names if n not in hosted]
-        combined, stats = self.executor.execute_segments(query, segs)
+
+        def run(tracker):
+            return self.executor.execute_segments(query, segs, tracker=tracker)
+
+        combined, stats = self.scheduler.submit(run, group=table)
         stats["missing_segments"] = missing
         return {"combined": combined, "stats": stats}

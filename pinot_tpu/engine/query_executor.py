@@ -19,6 +19,9 @@ from typing import Optional
 
 from ..query.context import QueryContext
 from ..query.parser.sql import SqlParseError, parse_sql
+from ..spi.metrics import SERVER_METRICS, ServerMeter
+from ..spi.trace import TRACING, ServerQueryPhase
+from .scheduler import GLOBAL_ACCOUNTANT
 from ..segment.loader import ImmutableSegment
 from ..spi.data_types import Schema
 from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
@@ -33,6 +36,20 @@ from .results import (
     GroupByIntermediate,
     SelectionIntermediate,
 )
+
+
+def _estimate_bytes(inter) -> int:
+    """Rough intermediate footprint for the accountant (reference samples
+    real allocations via ThreadMXBean; here: container-size heuristics)."""
+    if isinstance(inter, GroupByIntermediate):
+        width = 1 + max((len(v) for v in inter.groups.values()), default=0)
+        return 64 * width * len(inter.groups)
+    if isinstance(inter, SelectionIntermediate):
+        width = max(1, len(inter.columns))
+        return 32 * width * len(inter.rows)
+    if isinstance(inter, AggIntermediate):
+        return 64 * max(1, len(inter.states))
+    return 64
 
 
 @dataclass
@@ -91,7 +108,7 @@ class QueryExecutor:
             self._multistage = MultistageExecutor(self)
         return self._multistage
 
-    def execute(self, query: QueryContext) -> BrokerResponse:
+    def execute(self, query: QueryContext, tracker=None) -> BrokerResponse:
         t0 = time.perf_counter()
         table = self.tables.get(query.table_name)
         if table is None:
@@ -101,11 +118,20 @@ class QueryExecutor:
         if table is None:
             return BrokerResponse(exceptions=[f"table {query.table_name} not found"])
 
+        trace = None
+        if query.query_options.get("trace") in (True, "true", 1):
+            trace = TRACING.start_trace(f"{query.table_name}:{id(query):x}")
         try:
-            combined, stats = self.execute_segments(query, list(table.segments))
+            with TRACING.scope(ServerQueryPhase.QUERY_PLAN_EXECUTION):
+                combined, stats = self.execute_segments(
+                    query, list(table.segments), tracker=tracker)
             reducer = BrokerReducer(table.schema)
-            result = reducer.reduce(query, combined)
+            with TRACING.scope("BROKER_REDUCE"):
+                result = reducer.reduce(query, combined)
         except Exception as e:  # clean broker-style error (reference QueryException)
+            SERVER_METRICS.add_meter(ServerMeter.QUERY_EXECUTION_EXCEPTIONS)
+            if trace is not None:
+                TRACING.end_trace()
             return BrokerResponse(
                 exceptions=[f"{type(e).__name__}: {e}"],
                 num_segments_queried=len(table.segments),
@@ -120,22 +146,52 @@ class QueryExecutor:
             num_segments_pruned=stats["num_segments_pruned"],
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
+        if trace is not None:
+            TRACING.end_trace()
+            resp.trace_info = trace.to_json()
         return resp
 
-    def execute_segments(self, query: QueryContext, segments: list):
+    def execute_segments(self, query: QueryContext, segments: list, tracker=None):
         """Server-side half of a query: prune → per-segment execute →
         combine. Returns (combined_intermediate, stats). This is what a
         cluster server runs for its assigned segments (reference:
         ServerQueryExecutorV1Impl.executeInternal without broker reduce);
-        the in-process path and the cluster data plane share it."""
+        the in-process path and the cluster data plane share it.
+
+        ``tracker`` (engine/scheduler.py QueryResourceTracker) enables
+        cooperative cancellation + allocation accounting; the per-query
+        deadline comes from the timeoutMs query option."""
         # snapshot: realtime tables mutate the live list concurrently;
         # consuming segments pin a consistent row-count view per query
         segments = [s.snapshot_view() if getattr(s, "is_mutable", False) else s
                     for s in segments]
         kept, num_pruned = self.pruner.prune(query, segments)
         total_docs = sum(s.num_docs for s in segments)
-        intermediates = [self._execute_segment(query, s) for s in kept]
+        deadline = None
+        timeout_ms = query.query_options.get("timeoutMs")
+        if timeout_ms is not None:
+            deadline = time.perf_counter() + float(timeout_ms) / 1000
+        intermediates = []
+        for segment in kept:
+            if tracker is not None:
+                tracker.check_cancel()
+            if deadline is not None and time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"query exceeded timeoutMs={timeout_ms} "
+                    f"({len(intermediates)}/{len(kept)} segments done)")
+            cpu0 = time.thread_time_ns()
+            with TRACING.scope(f"segment:{getattr(segment, 'name', '?')}"):
+                inter = self._execute_segment(query, segment)
+            if tracker is not None:
+                tracker.add_cpu_ns(time.thread_time_ns() - cpu0)
+                GLOBAL_ACCOUNTANT.on_allocation(tracker, _estimate_bytes(inter))
+            intermediates.append(inter)
         combined = self._combine(query, intermediates)
+        SERVER_METRICS.add_meter(ServerMeter.QUERIES)
+        SERVER_METRICS.add_meter(ServerMeter.NUM_DOCS_SCANNED,
+                                 getattr(combined, "num_docs_scanned", 0))
+        SERVER_METRICS.add_meter(ServerMeter.NUM_SEGMENTS_PROCESSED, len(kept))
+        SERVER_METRICS.add_meter(ServerMeter.NUM_SEGMENTS_PRUNED, num_pruned)
         return combined, {
             "total_docs": total_docs,
             "num_segments_processed": len(kept),

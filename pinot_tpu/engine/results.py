@@ -43,9 +43,10 @@ class BrokerResponse:
     num_segments_pruned: int = 0
     time_used_ms: float = 0.0
     exceptions: list = field(default_factory=list)
+    trace_info: Optional[list] = None  # set when the trace option is on
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "resultTable": self.result_table.to_json() if self.result_table else None,
             "numDocsScanned": self.num_docs_scanned,
             "totalDocs": self.total_docs,
@@ -55,6 +56,9 @@ class BrokerResponse:
             "timeUsedMs": self.time_used_ms,
             "exceptions": self.exceptions,
         }
+        if self.trace_info is not None:
+            out["traceInfo"] = self.trace_info
+        return out
 
 
 # -- per-segment intermediates ----------------------------------------------

@@ -1,0 +1,219 @@
+"""Query scheduler + resource accounting + query killing.
+
+Reference analogues:
+- QueryScheduler.submit (pinot-core/.../query/scheduler/QueryScheduler.java
+  :93) with FCFS and token-bucket priority policies
+  (MultiLevelPriorityQueue), picked by QuerySchedulerFactory.
+- PerQueryCPUMemResourceUsageAccountant (pinot-core/.../accounting/
+  PerQueryCPUMemAccountantFactory.java:70): samples per-query resource
+  usage and interrupts the most expensive query under pressure (:832-937).
+
+Cooperative cancellation: Python threads can't be interrupted, so queries
+check their kill flag between segments (`check_cancel` from
+QueryExecutor's segment loop) — the same effective granularity as the
+reference, which also only interrupts between operator blocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class QueryKilledError(Exception):
+    """Reference: QueryCancelledException from the accountant interrupt."""
+
+
+class QueryRejectedError(Exception):
+    """Admission control rejection (scheduler queue full)."""
+
+
+@dataclass
+class QueryResourceTracker:
+    query_id: str
+    scheduler_group: str = "default"
+    start_time: float = field(default_factory=time.perf_counter)
+    cpu_ns: int = 0
+    allocated_bytes: int = 0
+    _kill_reason: Optional[str] = None
+
+    def add_cpu_ns(self, ns: int) -> None:
+        self.cpu_ns += ns
+
+    def add_allocated_bytes(self, n: int) -> None:
+        self.allocated_bytes += n
+
+    def kill(self, reason: str) -> None:
+        self._kill_reason = reason
+
+    def check_cancel(self) -> None:
+        if self._kill_reason is not None:
+            raise QueryKilledError(self._kill_reason)
+
+    @property
+    def cost(self) -> int:
+        """Ranking for the kill heuristic (reference ranks by allocated
+        bytes, falling back to CPU time)."""
+        return self.allocated_bytes or self.cpu_ns
+
+
+class ResourceAccountant:
+    """Tracks in-flight queries; kills the most expensive one when the
+    memory budget is exceeded (reference: the watcher task heap-pressure
+    path). Budget is an explicit byte budget for query intermediates —
+    there is no JVM heap to watch."""
+
+    def __init__(self, memory_budget_bytes: Optional[int] = None):
+        self.memory_budget_bytes = memory_budget_bytes
+        self._lock = threading.Lock()
+        self._inflight: dict[str, QueryResourceTracker] = {}
+
+    def start_query(self, query_id: Optional[str] = None,
+                    group: str = "default") -> QueryResourceTracker:
+        t = QueryResourceTracker(query_id or uuid.uuid4().hex[:12], group)
+        with self._lock:
+            self._inflight[t.query_id] = t
+        return t
+
+    def end_query(self, tracker: QueryResourceTracker) -> None:
+        with self._lock:
+            self._inflight.pop(tracker.query_id, None)
+
+    def on_allocation(self, tracker: QueryResourceTracker, n_bytes: int) -> None:
+        tracker.add_allocated_bytes(n_bytes)
+        self.maybe_kill()
+
+    def total_allocated(self) -> int:
+        with self._lock:
+            return sum(t.allocated_bytes for t in self._inflight.values())
+
+    def maybe_kill(self) -> Optional[str]:
+        """If over budget, flag the most expensive in-flight query
+        (reference :832-937 interrupts the runner thread of the costliest
+        query)."""
+        if self.memory_budget_bytes is None:
+            return None
+        with self._lock:
+            total = sum(t.allocated_bytes for t in self._inflight.values())
+            if total <= self.memory_budget_bytes:
+                return None
+            victim = max(self._inflight.values(), key=lambda t: t.cost,
+                         default=None)
+        if victim is not None:
+            victim.kill(
+                f"query {victim.query_id} killed: intermediates "
+                f"{total} bytes exceed budget {self.memory_budget_bytes}")
+            return victim.query_id
+        return None
+
+    def kill_query(self, query_id: str, reason: str = "killed by admin") -> bool:
+        with self._lock:
+            t = self._inflight.get(query_id)
+        if t is None:
+            return False
+        t.kill(reason)
+        return True
+
+    def inflight(self) -> list[str]:
+        with self._lock:
+            return sorted(self._inflight)
+
+
+GLOBAL_ACCOUNTANT = ResourceAccountant()
+
+
+class QueryScheduler:
+    """Bounded-concurrency admission control (reference FCFS policy:
+    fcfs QuerySchedulerFactory default)."""
+
+    def __init__(self, max_concurrent: int = 8, max_pending: int = 64,
+                 accountant: Optional[ResourceAccountant] = None):
+        self.max_concurrent = max_concurrent
+        self.max_pending = max_pending
+        self.accountant = accountant or GLOBAL_ACCOUNTANT
+        self._sem = threading.Semaphore(max_concurrent)
+        self._pending = 0
+        self._lock = threading.Lock()
+        self.wait_ms_total = 0.0
+
+    def submit(self, fn: Callable, *args, group: str = "default",
+               timeout_s: float = 60.0, **kwargs):
+        """Run fn(tracker, *args) under admission control."""
+        with self._lock:
+            if self._pending >= self.max_pending:
+                raise QueryRejectedError(
+                    f"scheduler queue full ({self.max_pending} pending)")
+            self._pending += 1
+        t0 = time.perf_counter()
+        try:
+            if not self._sem.acquire(timeout=timeout_s):
+                raise QueryRejectedError("scheduler wait timeout")
+        finally:
+            with self._lock:
+                self._pending -= 1
+        self.wait_ms_total += (time.perf_counter() - t0) * 1000
+        tracker = self.accountant.start_query(group=group)
+        try:
+            return fn(tracker, *args, **kwargs)
+        finally:
+            self.accountant.end_query(tracker)
+            self._sem.release()
+
+
+class PriorityQueryScheduler(QueryScheduler):
+    """Token-bucket fairness across scheduler groups (reference:
+    MultiLevelPriorityQueue / TokenPriorityScheduler): a group that has
+    consumed more CPU-milliseconds waits behind lighter groups when the
+    cluster is saturated."""
+
+    def __init__(self, max_concurrent: int = 8, max_pending: int = 64,
+                 accountant: Optional[ResourceAccountant] = None):
+        super().__init__(max_concurrent, max_pending, accountant)
+        self._tokens_used: dict[str, float] = {}
+        self._waiting: dict[str, int] = {}
+        self._cv = threading.Condition()
+        self._running = 0
+
+    def submit(self, fn: Callable, *args, group: str = "default",
+               timeout_s: float = 60.0, **kwargs):
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            if self._pending >= self.max_pending:
+                raise QueryRejectedError("scheduler queue full")
+            self._pending += 1
+            self._waiting[group] = self._waiting.get(group, 0) + 1
+            try:
+                while self._running >= self.max_concurrent or not \
+                        self._my_turn(group):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise QueryRejectedError("scheduler wait timeout")
+                    self._cv.wait(min(remaining, 0.05))
+                self._running += 1
+            finally:
+                self._pending -= 1
+                self._waiting[group] -= 1
+                if not self._waiting[group]:
+                    del self._waiting[group]
+        tracker = self.accountant.start_query(group=group)
+        t0 = time.perf_counter()
+        try:
+            return fn(tracker, *args, **kwargs)
+        finally:
+            used = (time.perf_counter() - t0) * 1000
+            with self._cv:
+                self._tokens_used[group] = self._tokens_used.get(group, 0.0) + used
+                self._running -= 1
+                self._cv.notify_all()
+            self.accountant.end_query(tracker)
+
+    def _my_turn(self, group: str) -> bool:
+        """Contention resolves toward the group with the fewest consumed
+        tokens — but only among groups WAITING right now; a lone waiter
+        always proceeds (otherwise historical heavy groups would starve)."""
+        mine = self._tokens_used.get(group, 0.0)
+        return all(mine <= self._tokens_used.get(g, 0.0)
+                   for g in self._waiting if g != group)

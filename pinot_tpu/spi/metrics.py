@@ -1,0 +1,124 @@
+"""Metrics SPI: meters / gauges / timers with a pluggable factory.
+
+Reference analogue: pinot-spi/.../spi/metrics/ + AbstractMetrics
+(pinot-common/.../common/metrics/AbstractMetrics.java) with the typed
+per-role enums (ServerMeter/ServerGauge/ServerTimer, Broker*, Controller*)
+and swappable yammer/dropwizard backends
+(pinot-plugins/pinot-metrics/). The in-memory registry here is the default
+backend; `register_metrics_factory` swaps it (e.g. a Prometheus exporter).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Optional
+
+
+class ServerMeter:
+    QUERIES = "queries"
+    NUM_DOCS_SCANNED = "numDocsScanned"
+    NUM_SEGMENTS_PROCESSED = "numSegmentsProcessed"
+    NUM_SEGMENTS_PRUNED = "numSegmentsPruned"
+    QUERY_EXECUTION_EXCEPTIONS = "queryExecutionExceptions"
+    DELETED_SEGMENT_COUNT = "deletedSegmentCount"
+    REALTIME_ROWS_CONSUMED = "realtimeRowsConsumed"
+    QUERIES_KILLED = "queriesKilled"
+    QUERIES_REJECTED = "queriesRejected"
+
+
+class BrokerMeter:
+    QUERIES = "queries"
+    BROKER_RESPONSES_WITH_EXCEPTIONS = "brokerResponsesWithExceptions"
+    REQUEST_FAILURES = "requestFailures"
+    NO_SERVING_HOST_FOR_SEGMENT = "noServingHostForSegment"
+
+
+class ServerTimer:
+    QUERY_PROCESSING_TIME_MS = "queryProcessingTimeMs"
+    SCHEDULER_WAIT_MS = "schedulerWaitMs"
+
+
+class ServerGauge:
+    DOCUMENT_COUNT = "documentCount"
+    SEGMENT_COUNT = "segmentCount"
+    UPSERT_PRIMARY_KEYS_COUNT = "upsertPrimaryKeysCount"
+
+
+class MetricsRegistry:
+    """In-memory backend: thread-safe counters, gauges, timer stats."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._meters: dict[str, int] = defaultdict(int)
+        self._gauges: dict[str, Callable[[], float]] = {}
+        self._timers: dict[str, list] = defaultdict(lambda: [0, 0.0])  # n, total_ms
+
+    def add_meter(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._meters[name] += value
+
+    def meter_count(self, name: str) -> int:
+        with self._lock:
+            return self._meters.get(name, 0)
+
+    def set_gauge(self, name: str, supplier: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = supplier
+
+    def gauge_value(self, name: str) -> Optional[float]:
+        with self._lock:
+            g = self._gauges.get(name)
+        return None if g is None else float(g())
+
+    def update_timer(self, name: str, ms: float) -> None:
+        with self._lock:
+            t = self._timers[name]
+            t[0] += 1
+            t[1] += ms
+
+    def timer_stats(self, name: str) -> tuple[int, float]:
+        with self._lock:
+            n, total = self._timers.get(name, [0, 0.0])
+            return n, total
+
+    def timed(self, name: str):
+        registry = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.update_timer(name, (time.perf_counter() - self.t0) * 1000)
+
+        return _Ctx()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "meters": dict(self._meters),
+                "gauges": {k: float(v()) for k, v in self._gauges.items()},
+                "timers": {k: {"count": v[0], "totalMs": round(v[1], 3)}
+                           for k, v in self._timers.items()},
+            }
+
+
+_FACTORY: Callable[[], MetricsRegistry] = MetricsRegistry
+
+
+def register_metrics_factory(factory: Callable[[], MetricsRegistry]) -> None:
+    global _FACTORY
+    _FACTORY = factory
+
+
+def make_registry() -> MetricsRegistry:
+    return _FACTORY()
+
+
+# process-wide defaults per role (reference: ServerMetrics.get() singletons)
+SERVER_METRICS = MetricsRegistry()
+BROKER_METRICS = MetricsRegistry()
+CONTROLLER_METRICS = MetricsRegistry()

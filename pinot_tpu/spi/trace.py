@@ -1,0 +1,96 @@
+"""Tracing SPI: pluggable per-query tracers + phase timing.
+
+Reference analogue: pinot-spi/.../spi/trace/Tracing.java:45 (registerable
+Tracer, InvocationScope recordings, per-request registration in
+ServerQueryExecutorV1Impl.execute:143-156) and the phase timers
+(pinot-common/.../metrics/ServerQueryPhase.java:29-36). Traces attach to
+the broker response when the `trace` query option is set, exactly like the
+reference's `trace=true`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class ServerQueryPhase:
+    """Reference: ServerQueryPhase enum values."""
+
+    REQUEST_DESERIALIZATION = "REQUEST_DESERIALIZATION"
+    SCHEDULER_WAIT = "SCHEDULER_WAIT"
+    BUILD_QUERY_PLAN = "BUILD_QUERY_PLAN"
+    QUERY_PLAN_EXECUTION = "QUERY_PLAN_EXECUTION"
+    RESPONSE_SERIALIZATION = "RESPONSE_SERIALIZATION"
+    QUERY_PROCESSING = "QUERY_PROCESSING"
+
+
+@dataclass
+class Trace:
+    """One query's recorded scopes: [(name, start_ms_rel, duration_ms)]."""
+
+    query_id: str
+    scopes: list = field(default_factory=list)
+    _t0: float = field(default_factory=time.perf_counter)
+
+    def record(self, name: str, start: float, end: float) -> None:
+        self.scopes.append((name, round((start - self._t0) * 1000, 3),
+                            round((end - start) * 1000, 3)))
+
+    def to_json(self) -> list:
+        return [{"operator": n, "startMs": s, "durationMs": d}
+                for n, s, d in self.scopes]
+
+    def phase_ms(self, name: str) -> float:
+        return sum(d for n, _, d in self.scopes if n == name)
+
+
+class Tracer:
+    """Override to ship scopes elsewhere (reference: pluggable Tracer)."""
+
+    def new_trace(self, query_id: str) -> Trace:
+        return Trace(query_id)
+
+
+class _Tracing:
+    """Per-thread active trace registry (reference: Tracing.ThreadLocal)."""
+
+    def __init__(self):
+        self._tracer = Tracer()
+        self._local = threading.local()
+
+    def register_tracer(self, tracer: Tracer) -> None:
+        self._tracer = tracer
+
+    def start_trace(self, query_id: str) -> Trace:
+        trace = self._tracer.new_trace(query_id)
+        self._local.trace = trace
+        return trace
+
+    def active_trace(self) -> Optional[Trace]:
+        return getattr(self._local, "trace", None)
+
+    def end_trace(self) -> Optional[Trace]:
+        trace = self.active_trace()
+        self._local.trace = None
+        return trace
+
+    @contextmanager
+    def scope(self, name: str):
+        """Records into the active trace; no-op when tracing is off —
+        the hot path pays one thread-local read."""
+        trace = self.active_trace()
+        if trace is None:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            trace.record(name, start, time.perf_counter())
+
+
+TRACING = _Tracing()
