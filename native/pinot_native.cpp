@@ -1,0 +1,159 @@
+// Native host runtime: hot scalar loops the Python/numpy layer delegates to.
+//
+// Reference analogue (SURVEY.md §2.9): the effectively-native Java machinery
+// Pinot relies on — FixedBitIntReader's unrolled bit-unpacking
+// (pinot-segment-local/.../io/reader/impl/FixedBitIntReader.java:27,
+// readUnchecked:44, read32:50), PinotDataBitSet, and the dict-id hashing
+// inside DictionaryBasedGroupKeyGenerator. Compiled via g++ -O3 and loaded
+// with ctypes (segment/native_bridge.py); every entry point has a numpy
+// fallback, so the library is an accelerator, not a dependency.
+//
+// Format contract: LSB-first packed bitstream, identical to
+// segment/bitpack.py pack()/unpack() — round-trip tests enforce parity.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Unpack `count` values of `num_bits` (1..32) from an LSB-first bitstream.
+// `data` must have at least (count*num_bits+7)/8 + 8 readable bytes when
+// padded=1 (the loader over-allocates); with padded=0 a safe tail loop runs.
+void unpack_bits(const uint8_t* data, int num_bits, int64_t count,
+                 int32_t* out, int padded) {
+    if (num_bits == 8) {
+        for (int64_t i = 0; i < count; i++) out[i] = data[i];
+        return;
+    }
+    if (num_bits == 16) {
+        const uint16_t* p = (const uint16_t*)data;
+        for (int64_t i = 0; i < count; i++) out[i] = p[i];
+        return;
+    }
+    if (num_bits == 32) {
+        memcpy(out, data, (size_t)count * 4);
+        return;
+    }
+    const uint64_t mask = (num_bits == 64) ? ~0ULL : ((1ULL << num_bits) - 1);
+    int64_t fast = count;
+    if (!padded) {
+        // last values whose 8-byte window read would overrun run in the
+        // byte-exact tail loop below
+        int64_t total_bytes = ((count * num_bits) + 7) / 8;
+        int64_t safe_bits = (total_bytes - 8) * 8;  // window start must fit
+        fast = safe_bits > 0 ? safe_bits / num_bits : 0;
+        if (fast > count) fast = count;
+    }
+    for (int64_t i = 0; i < fast; i++) {
+        int64_t bit = i * (int64_t)num_bits;
+        uint64_t window;
+        memcpy(&window, data + (bit >> 3), 8);  // little-endian load
+        out[i] = (int32_t)((window >> (bit & 7)) & mask);
+    }
+    int64_t total_bytes = ((count * (int64_t)num_bits) + 7) / 8;
+    for (int64_t i = fast; i < count; i++) {
+        int64_t bit = i * (int64_t)num_bits;
+        uint64_t acc = 0;
+        int got = 0;
+        for (int64_t b = bit >> 3; got < num_bits + 8 && b < total_bytes;
+             b++, got += 8)
+            acc |= (uint64_t)data[b] << got;
+        out[i] = (int32_t)((acc >> (bit & 7)) & mask);
+    }
+}
+
+// Pack `n` non-negative values (< 2^num_bits) into an LSB-first bitstream.
+// `out` must hold (n*num_bits+7)/8 bytes, zero-initialized.
+void pack_bits(const uint32_t* values, int64_t n, int num_bits, uint8_t* out) {
+    if (num_bits == 8) {
+        for (int64_t i = 0; i < n; i++) out[i] = (uint8_t)values[i];
+        return;
+    }
+    if (num_bits == 16) {
+        uint16_t* p = (uint16_t*)out;
+        for (int64_t i = 0; i < n; i++) p[i] = (uint16_t)values[i];
+        return;
+    }
+    if (num_bits == 32) {
+        memcpy(out, values, (size_t)n * 4);
+        return;
+    }
+    for (int64_t i = 0; i < n; i++) {
+        int64_t bit = i * (int64_t)num_bits;
+        uint64_t v = (uint64_t)values[i] << (bit & 7);
+        uint8_t* p = out + (bit >> 3);
+        // write ≤ 5 bytes (num_bits<32 + shift<8 → ≤ 39 bits)
+        for (int b = 0; v; b++, v >>= 8) p[b] |= (uint8_t)(v & 0xFF);
+    }
+}
+
+// Dense bool (uint8 0/1) → packed LSB-first bitmap.
+void pack_bitmap(const uint8_t* bools, int64_t n, uint8_t* out) {
+    memset(out, 0, (size_t)((n + 7) / 8));
+    for (int64_t i = 0; i < n; i++)
+        out[i >> 3] |= (uint8_t)((bools[i] & 1) << (i & 7));
+}
+
+void unpack_bitmap(const uint8_t* data, int64_t count, uint8_t* out) {
+    for (int64_t i = 0; i < count; i++)
+        out[i] = (data[i >> 3] >> (i & 7)) & 1;
+}
+
+// Factorize int64 keys → dense codes in first-occurrence order.
+// Open-addressing hash table; returns the number of distinct keys.
+// uniques[] receives the distinct keys (caller sizes it to n).
+int64_t factorize_i64(const int64_t* keys, int64_t n, int64_t* codes,
+                      int64_t* uniques) {
+    if (n == 0) return 0;
+    // table size: next power of two ≥ 2n
+    uint64_t cap = 16;
+    while (cap < (uint64_t)n * 2) cap <<= 1;
+    std::vector<int64_t> slot_key(cap);
+    std::vector<int64_t> slot_code(cap, -1);
+    uint64_t hmask = cap - 1;
+    int64_t next = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t h = (uint64_t)keys[i] * 0x9E3779B97F4A7C15ULL;
+        uint64_t s = (h ^ (h >> 29)) & hmask;
+        while (true) {
+            if (slot_code[s] < 0) {
+                slot_key[s] = keys[i];
+                slot_code[s] = next;
+                uniques[next] = keys[i];
+                codes[i] = next++;
+                break;
+            }
+            if (slot_key[s] == keys[i]) {
+                codes[i] = slot_code[s];
+                break;
+            }
+            s = (s + 1) & hmask;
+        }
+    }
+    return next;
+}
+
+// Grouped aggregation over float64 values with precomputed dense codes:
+// one pass computing sum/count/min/max per group (the host fallback's
+// aggregateGroupBySV analogue).
+void group_agg_f64(const int64_t* codes, const double* vals, int64_t n,
+                   int64_t num_groups, double* sums, int64_t* counts,
+                   double* mins, double* maxs) {
+    for (int64_t g = 0; g < num_groups; g++) {
+        sums[g] = 0.0;
+        counts[g] = 0;
+        mins[g] = 1.0 / 0.0;
+        maxs[g] = -1.0 / 0.0;
+    }
+    for (int64_t i = 0; i < n; i++) {
+        int64_t g = codes[i];
+        double v = vals[i];
+        sums[g] += v;
+        counts[g]++;
+        if (v < mins[g]) mins[g] = v;
+        if (v > maxs[g]) maxs[g] = v;
+    }
+}
+
+}  // extern "C"

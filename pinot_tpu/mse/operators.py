@@ -152,20 +152,35 @@ def group_codes(cols: list[np.ndarray]):
     sorted order; callers use representative indices to recover values)."""
     n = len(cols[0]) if cols else 0
     codes = np.zeros(n, dtype=np.int64)
+    num = 1 if n else 0
     for j, c in enumerate(cols):
-        _, inv = np.unique(np.asarray(c), return_inverse=True)
+        inv, card = _factorize(np.asarray(c))
         if j == 0:
-            codes = inv.astype(np.int64)
+            codes, num = inv, card
         else:
-            combined = codes * np.int64(inv.max(initial=0) + 1) + inv
-            _, codes = np.unique(combined, return_inverse=True)
-            codes = codes.astype(np.int64)
-    num = int(codes.max(initial=-1)) + 1 if n else 0
+            codes, num = _factorize(codes * np.int64(card) + inv)
     # representative row per group (first occurrence in stable sort order)
     order = np.argsort(codes, kind="stable")
     starts = np.searchsorted(codes[order], np.arange(num), "left")
     first = order[starts] if n else starts
     return codes, num, first
+
+
+def _factorize(a: np.ndarray) -> tuple[np.ndarray, int]:
+    """Dense int64 codes + cardinality. Integer keys ride the native
+    open-addressing factorizer (native/pinot_native.cpp — the
+    DictionaryBasedGroupKeyGenerator analogue); everything else uses
+    np.unique. Code ORDER differs between the two (first-occurrence vs
+    sorted) — callers only rely on density."""
+    if a.dtype.kind in "iub":
+        from ..segment import native_bridge
+
+        r = native_bridge.factorize_i64(a.astype(np.int64, copy=False))
+        if r is not None:
+            codes, uniques = r
+            return codes, len(uniques)
+    _, inv = np.unique(a, return_inverse=True)
+    return inv.astype(np.int64), int(inv.max(initial=-1)) + 1
 
 
 # -- aggregate ---------------------------------------------------------------

@@ -27,6 +27,11 @@ def num_bits_for_cardinality(cardinality: int) -> int:
 def pack(values: np.ndarray, num_bits: int) -> np.ndarray:
     """Pack non-negative ints < 2**num_bits into an LSB-first uint8 bitstream."""
     assert 1 <= num_bits <= 32
+    from . import native_bridge
+
+    native = native_bridge.pack_bits(np.asarray(values), num_bits)
+    if native is not None:
+        return native
     values = np.ascontiguousarray(values, dtype=np.uint32)
     n = values.shape[0]
     if num_bits == 8:
@@ -53,6 +58,11 @@ def pack(values: np.ndarray, num_bits: int) -> np.ndarray:
 def unpack(data: np.ndarray, num_bits: int, count: int, dtype=np.int32) -> np.ndarray:
     """Unpack `count` values from an LSB-first bitstream produced by pack()."""
     assert 1 <= num_bits <= 32
+    from . import native_bridge
+
+    native = native_bridge.unpack_bits(np.asarray(data), num_bits, count, dtype)
+    if native is not None:
+        return native
     data = np.ascontiguousarray(data, dtype=np.uint8)
     if num_bits == 8:
         return data[:count].astype(dtype)
