@@ -72,8 +72,12 @@ class HostSegmentExecutor:
             nulls = segment.get_null_bitmap(col)
             m = np.zeros(n, dtype=bool) if nulls is None else nulls.copy()
             return ~m if p.type == PredicateType.IS_NOT_NULL else m
-        if p.type == PredicateType.JSON_MATCH:
-            return eval_json_match(p, segment)
+        if p.type in (PredicateType.JSON_MATCH, PredicateType.TEXT_MATCH,
+                      PredicateType.VECTOR_SIMILARITY):
+            return eval_host_mask(p, segment)
+        geo = self._eval_geo_range(p, segment)
+        if geo is not None:
+            return geo
 
         m = self._eval_predicate_with_index(p, segment)
         if m is not None:
@@ -108,6 +112,42 @@ class HostSegmentExecutor:
                      else re.compile(str(p.values[0])))
             return np.asarray([regex.search(str(x)) is not None for x in v], dtype=bool)
         raise UnsupportedQueryError(f"host predicate {p.type}")
+
+    def _eval_geo_range(self, p: Predicate, segment):
+        """ST_DISTANCE(latCol, lngCol, lat, lng) < r accelerates through the
+        geo grid index: candidate cells → exact haversine refine (reference:
+        H3IndexFilterOperator's two-phase cells+refine). Returns None when
+        the shape doesn't match — the generic transform path still answers
+        it exactly, just without pruning."""
+        if p.type != PredicateType.RANGE or p.upper is None:
+            return None
+        e = p.lhs
+        if not (e.is_function and e.function.name in ("stdistance", "distance")):
+            return None
+        args = e.function.arguments
+        if len(args) != 4 or not (args[0].is_identifier and args[1].is_identifier
+                                  and args[2].is_literal and args[3].is_literal):
+            return None
+        lat_col, lng_col = args[0].identifier, args[1].identifier
+        idx = segment.get_geo_index(lat_col, lng_col, or_build=True) \
+            if hasattr(segment, "get_geo_index") else None
+        if idx is None:
+            return None
+        from ..segment.indexes import haversine_m
+
+        lat0, lng0 = float(args[2].literal), float(args[3].literal)
+        cand = idx.candidate_docs(lat0, lng0, float(p.upper))
+        mask = np.zeros(segment.num_docs, dtype=bool)
+        if len(cand):
+            cand = cand[cand < segment.num_docs]
+            lat = np.asarray(segment.get_values(lat_col), dtype=np.float64)[cand]
+            lng = np.asarray(segment.get_values(lng_col), dtype=np.float64)[cand]
+            d = haversine_m(lat, lng, lat0, lng0)
+            ok = (d <= p.upper) if p.upper_inclusive else (d < p.upper)
+            if p.lower is not None:
+                ok &= (d >= p.lower) if p.lower_inclusive else (d > p.lower)
+            mask[cand[ok]] = True
+        return mask
 
     def _eval_predicate_with_index(self, p: Predicate, segment):
         """Index-backed predicate evaluation (reference: index-backed
@@ -337,6 +377,34 @@ def eval_json_match(p: Predicate, segment) -> np.ndarray:
         raise UnsupportedQueryError(f"JSON_MATCH needs a column: {p.lhs}")
     idx = segment.get_json_index(col, or_build=True)
     return idx.mask_match(str(p.values[0]), segment.num_docs)
+
+
+def eval_host_mask(p: Predicate, segment) -> np.ndarray:
+    """Index-backed predicates without a vector form → boolean doc plane.
+    Shared by the host engine and the device planner's MaskParam lowering
+    (reference: these run as index-backed filter operators —
+    TextMatchFilterOperator, VectorSimilarityFilterOperator,
+    JsonMatchFilterOperator)."""
+    if p.type == PredicateType.JSON_MATCH:
+        return eval_json_match(p, segment)
+    col = p.lhs.identifier
+    if col is None or not segment.has_column(col):
+        raise UnsupportedQueryError(f"{p.type.value} needs a column: {p.lhs}")
+    if p.type == PredicateType.TEXT_MATCH:
+        idx = segment.get_text_index(col, or_build=True)
+        if idx is None:
+            raise UnsupportedQueryError(
+                f"TEXT_MATCH on consuming segment column {col}")
+        return idx.mask_match(str(p.values[0]), segment.num_docs)
+    if p.type == PredicateType.VECTOR_SIMILARITY:
+        idx = segment.get_vector_index(col, or_build=True)
+        if idx is None:
+            raise UnsupportedQueryError(
+                f"VECTOR_SIMILARITY on consuming segment column {col}")
+        vec, k = p.values
+        return idx.mask_top_k(np.asarray(vec, dtype=np.float32), int(k),
+                              segment.num_docs)
+    raise UnsupportedQueryError(f"host mask predicate {p.type}")
 
 
 _NP_BIN = {

@@ -296,7 +296,8 @@ class SegmentPlanner(AggPlanContext):
 
     def _lower_predicate(self, p: Predicate) -> ir.FilterNode:
         lhs = p.lhs
-        if p.type == PredicateType.JSON_MATCH:
+        if p.type in (PredicateType.JSON_MATCH, PredicateType.TEXT_MATCH,
+                      PredicateType.VECTOR_SIMILARITY):
             return self._lower_host_mask(p)
         if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
             if not lhs.is_identifier:
@@ -427,13 +428,14 @@ class SegmentPlanner(AggPlanContext):
         raise UnsupportedQueryError(f"predicate {p.type} not lowered")
 
     def _lower_host_mask(self, p: Predicate) -> ir.FilterNode:
-        """Predicates without a vector form (JSON_MATCH) evaluate on host via
-        their index into a doc mask shipped as a kernel param plane."""
-        from .host_executor import eval_json_match
+        """Index-backed predicates without a vector form (JSON_MATCH /
+        TEXT_MATCH / VECTOR_SIMILARITY) evaluate on host via their index
+        into a doc mask shipped as a kernel param plane."""
+        from .host_executor import eval_host_mask
 
         if not p.lhs.is_identifier:
             raise UnsupportedQueryError(f"{p.type} needs a column lhs")
-        return self._mask_param(eval_json_match(p, self.segment))
+        return self._mask_param(eval_host_mask(p, self.segment))
 
     def _mask_param(self, mask: np.ndarray) -> ir.MaskParam:
         """Host-computed doc mask → padded boolean param plane."""

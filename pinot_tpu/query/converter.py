@@ -82,6 +82,16 @@ def filter_from_expression(expr: ExpressionContext) -> FilterContext:
     if name == "textmatch":
         return FilterContext.pred(
             Predicate(PredicateType.TEXT_MATCH, args[0], values=(_require_literal(args[1]),)))
+    if name in ("vectorsimilarity", "vector_similarity"):
+        # VECTOR_SIMILARITY(col, queryVector, topK) (reference:
+        # VectorSimilarityPredicate; topK default 10)
+        vec = _require_literal(args[1])
+        if not isinstance(vec, (list, tuple)):
+            raise FilterConversionError("VECTOR_SIMILARITY needs an ARRAY literal")
+        k = int(_require_literal(args[2])) if len(args) > 2 else 10
+        return FilterContext.pred(
+            Predicate(PredicateType.VECTOR_SIMILARITY, args[0],
+                      values=(list(vec), k)))
     if name == "jsonmatch":
         return FilterContext.pred(
             Predicate(PredicateType.JSON_MATCH, args[0], values=(_require_literal(args[1]),)))

@@ -31,7 +31,7 @@ _TOKEN_RE = re.compile(
     | (?P<string>'(?:[^']|'')*')
     | (?P<qident>"(?:[^"]|"")*")
     | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-    | (?P<op><>|!=|>=|<=|=|<|>|\(|\)|,|\+|-|\*|/|%|\.|;)
+    | (?P<op><>|!=|>=|<=|=|<|>|\(|\)|\[|\]|,|\+|-|\*|/|%|\.|;)
     )""",
     re.VERBOSE,
 )
@@ -369,6 +369,23 @@ class _Parser:
                 return self._parse_case()
             if t.upper == "CAST":
                 return self._parse_cast()
+            if t.upper == "ARRAY" and self.peek(1).kind == "op" \
+                    and self.peek(1).value == "[":
+                # ARRAY[1,2,3] literal (VECTOR_SIMILARITY query vectors,
+                # array scalar fns)
+                self.next()
+                self.next()
+                vals = []
+                if not self.accept_op("]"):
+                    while True:
+                        e = self.parse_expression()
+                        if not e.is_literal:
+                            raise SqlParseError("ARRAY[...] takes literals")
+                        vals.append(e.literal)
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op("]")
+                return ExpressionContext.for_literal(vals)
             self.next()
             # function call?
             if self.accept_op("("):

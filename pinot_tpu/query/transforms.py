@@ -668,7 +668,35 @@ def _hashfn(name):
     return rowfn(f)
 
 
+def _np_stdistance(lat1, lng1, lat2, lng2):
+    from ..segment.indexes import haversine_m
+
+    return haversine_m(lat1, lng1, lat2, lng2)
+
+
+def _np_arraylength(v):
+    return rowfn(lambda x: len(x) if isinstance(x, (list, tuple, np.ndarray)) else 1)(v)
+
+
+def _np_cosinedistance(a, b):
+    def f(x):
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(b, dtype=np.float64)
+        denom = np.linalg.norm(x) * np.linalg.norm(y)
+        return 1.0 - float(x @ y) / denom if denom else 1.0
+
+    return rowfn(f)(a)
+
+
 TRANSFORMS: dict[str, TransformDef] = {
+    # -- geo (reference: pinot-core/.../geospatial/transform/; the point
+    # type is a (lat, lng) column pair here, not WKB bytes) ----------------
+    "stdistance": TransformDef(_np_stdistance),
+    "distance": TransformDef(_np_stdistance),
+    # -- vector scalar fns (reference VectorFunctions) ----------------------
+    "cosinedistance": TransformDef(_np_cosinedistance),
+    "arraylength": TransformDef(_np_arraylength),
+    "vectordims": TransformDef(_np_arraylength),
     # -- math ---------------------------------------------------------------
     "round": TransformDef(_np_round, _lower_round),
     "rounddecimal": TransformDef(_np_rounddecimal, _lower_rounddecimal),

@@ -223,6 +223,34 @@ class SegmentBuilder:
                 continue
             add(col, serialize_json_index(JsonIndex.build(columns[col])))
 
+        for col in idx.text_index_columns:
+            if col not in columns:
+                continue
+            from .indexes import TextIndex, serialize_text_index
+
+            add(col, serialize_text_index(TextIndex.build(columns[col])))
+
+        for col in idx.vector_index_columns:
+            if col not in columns:
+                continue
+            from .indexes import VectorIndex, serialize_vector_index
+
+            vecs = np.stack([np.asarray(v, dtype=np.float32)
+                             for v in columns[col]])
+            add(col, serialize_vector_index(VectorIndex.build(vecs)))
+
+        for cfg in getattr(idx, "geo_index_configs", []):
+            lat_col, lng_col = cfg["latColumn"], cfg["lngColumn"]
+            if lat_col not in columns or lng_col not in columns:
+                continue
+            from .indexes import GeoGridIndex, serialize_geo_index
+
+            lat = np.asarray(columns[lat_col], dtype=np.float64)
+            lng = np.asarray(columns[lng_col], dtype=np.float64)
+            geo = GeoGridIndex.build(lat, lng,
+                                     float(cfg.get("resolutionDeg", 0.5)))
+            add(f"{lat_col}__{lng_col}", serialize_geo_index(geo))
+
     def _replace_nulls(self, values, spec) -> tuple[list, np.ndarray]:
         if isinstance(values, np.ndarray) and values.dtype != object:
             # numpy fast path: fixed-width arrays cannot hold None

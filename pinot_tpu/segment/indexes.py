@@ -354,3 +354,408 @@ def deserialize_json_index(bufs: dict[str, np.ndarray]) -> JsonIndex:
         docs = bufs[f"json.{field_name}.docs"].view(np.uint32)
         tables.append({k: docs[off[i]:off[i + 1]] for i, k in enumerate(names)})
     return JsonIndex(*tables)
+
+
+# ---------------------------------------------------------------------------
+# Text index: tokenized terms → postings with positions (TEXT_MATCH)
+# ---------------------------------------------------------------------------
+
+import re as _re
+
+_TOKEN_SPLIT = _re.compile(r"[^0-9a-z]+")
+
+
+def tokenize_text(s: str) -> list[str]:
+    """Lowercase alphanumeric tokenizer (reference: Lucene's
+    StandardAnalyzer as configured by the text index's default)."""
+    return [t for t in _TOKEN_SPLIT.split(str(s).lower()) if t]
+
+
+@dataclass
+class TextIndex:
+    """Term → (docs, positions) postings supporting Lucene-ish TEXT_MATCH
+    queries: `term`, `a AND b`, `a OR b`, `NOT a`, prefix `ab*`, and
+    `"exact phrase"` via positions.
+
+    Reference: the Lucene text index + native FST regex engine
+    (pinot-segment-local/.../readers/text/, .../utils/nativefst/). Postings
+    are dense numpy arrays; phrase matching intersects (doc, pos) pairs —
+    the same approach as Lucene's exact PhraseQuery."""
+
+    terms: list  # sorted term strings
+    doc_postings: list  # parallel: np.uint32 doc ids (deduped, sorted)
+    pos_postings: list  # parallel: (np.uint32 docs-with-dup, np.uint32 pos)
+
+    @staticmethod
+    def build(strings) -> "TextIndex":
+        acc: dict[str, list] = {}
+        for doc_id, s in enumerate(strings):
+            if s is None:
+                continue
+            for pos, term in enumerate(tokenize_text(s)):
+                acc.setdefault(term, []).append((doc_id, pos))
+        terms = sorted(acc)
+        doc_postings = []
+        pos_postings = []
+        for t in terms:
+            pairs = acc[t]
+            docs_dup = np.asarray([d for d, _ in pairs], dtype=np.uint32)
+            poss = np.asarray([p for _, p in pairs], dtype=np.uint32)
+            doc_postings.append(np.unique(docs_dup))
+            pos_postings.append((docs_dup, poss))
+        return TextIndex(terms, doc_postings, pos_postings)
+
+    # -- term lookups -------------------------------------------------------
+    def _term_index(self, term: str) -> int:
+        import bisect
+
+        i = bisect.bisect_left(self.terms, term)
+        return i if i < len(self.terms) and self.terms[i] == term else -1
+
+    def docs_for_term(self, term: str) -> np.ndarray:
+        i = self._term_index(term)
+        return self.doc_postings[i] if i >= 0 else np.empty(0, dtype=np.uint32)
+
+    def docs_for_prefix(self, prefix: str) -> np.ndarray:
+        import bisect
+
+        lo = bisect.bisect_left(self.terms, prefix)
+        hi = bisect.bisect_left(self.terms, prefix + "￿")
+        if lo >= hi:
+            return np.empty(0, dtype=np.uint32)
+        return np.unique(np.concatenate(self.doc_postings[lo:hi]))
+
+    def docs_for_phrase(self, phrase_terms: list) -> np.ndarray:
+        """Docs containing the terms at consecutive positions."""
+        if not phrase_terms:
+            return np.empty(0, dtype=np.uint32)
+        i = self._term_index(phrase_terms[0])
+        if i < 0:
+            return np.empty(0, dtype=np.uint32)
+        docs, pos = self.pos_postings[i]
+        cur = set(zip(docs.tolist(), pos.tolist()))
+        for k, term in enumerate(phrase_terms[1:], start=1):
+            j = self._term_index(term)
+            if j < 0:
+                return np.empty(0, dtype=np.uint32)
+            d2, p2 = self.pos_postings[j]
+            nxt = set(zip(d2.tolist(), (p2 - k).tolist()))
+            cur &= nxt
+            if not cur:
+                return np.empty(0, dtype=np.uint32)
+        return np.unique(np.asarray(sorted({d for d, _ in cur}), dtype=np.uint32))
+
+    # -- query --------------------------------------------------------------
+    def mask_match(self, query: str, num_docs: int) -> np.ndarray:
+        """Evaluate a TEXT_MATCH query into a doc mask."""
+        docs = self._eval_query(_parse_text_query(query))
+        mask = np.zeros(num_docs, dtype=bool)
+        if len(docs):
+            mask[docs[docs < num_docs]] = True
+        return mask
+
+    def _eval_query(self, node) -> np.ndarray:
+        kind = node[0]
+        if kind == "term":
+            return self.docs_for_term(node[1])
+        if kind == "prefix":
+            return self.docs_for_prefix(node[1])
+        if kind == "phrase":
+            return self.docs_for_phrase(node[1])
+        if kind == "and":
+            out = None
+            for child in node[1]:
+                d = self._eval_query(child)
+                out = d if out is None else np.intersect1d(out, d)
+            return out if out is not None else np.empty(0, dtype=np.uint32)
+        if kind == "or":
+            parts = [self._eval_query(c) for c in node[1]]
+            parts = [p for p in parts if len(p)]
+            return (np.unique(np.concatenate(parts)) if parts
+                    else np.empty(0, dtype=np.uint32))
+        if kind == "not":
+            raise ValueError("NOT requires an enclosing AND in TEXT_MATCH")
+        raise ValueError(f"bad text query node {node!r}")
+
+
+def _parse_text_query(q: str):
+    """Mini Lucene syntax: terms, quoted phrases, AND/OR (AND binds
+    tighter), prefix `foo*`, parentheses. Bare adjacency = OR (Lucene's
+    default operator)."""
+    tokens = _re.findall(r'"[^"]*"|\(|\)|[^\s()"]+', q)
+    pos = [0]
+
+    def peek():
+        return tokens[pos[0]] if pos[0] < len(tokens) else None
+
+    def next_tok():
+        t = peek()
+        pos[0] += 1
+        return t
+
+    def parse_or():
+        left = parse_and()
+        parts = [left]
+        while peek() is not None and peek() not in (")",):
+            if peek().upper() == "OR":
+                next_tok()
+                parts.append(parse_and())
+            elif peek().upper() == "AND":
+                break
+            else:
+                parts.append(parse_and())  # adjacency = OR
+        return parts[0] if len(parts) == 1 else ("or", parts)
+
+    def parse_and():
+        left = parse_primary()
+        parts = [left]
+        while peek() is not None and peek().upper() == "AND":
+            next_tok()
+            parts.append(parse_primary())
+        return parts[0] if len(parts) == 1 else ("and", parts)
+
+    def parse_primary():
+        t = next_tok()
+        if t is None:
+            raise ValueError("empty TEXT_MATCH query")
+        if t == "(":
+            inner = parse_or()
+            if next_tok() != ")":
+                raise ValueError("unbalanced parens in TEXT_MATCH")
+            return inner
+        if t.startswith('"'):
+            return ("phrase", tokenize_text(t.strip('"')))
+        if t.endswith("*"):
+            return ("prefix", t[:-1].lower())
+        toks = tokenize_text(t)
+        if len(toks) == 1:
+            return ("term", toks[0])
+        return ("phrase", toks)
+
+    out = parse_or()
+    if pos[0] != len(tokens):
+        raise ValueError(f"trailing input in TEXT_MATCH query {q!r}")
+    return out
+
+
+def serialize_text_index(idx: TextIndex) -> list[tuple[str, np.ndarray]]:
+    blob = "\x01".join(idx.terms).encode("utf-8")
+    off = np.zeros(len(idx.terms) + 1, dtype=np.uint64)
+    docs_parts, pos_parts = [], []
+    total = 0
+    for i, (docs, pos) in enumerate(idx.pos_postings):
+        total += len(docs)
+        off[i + 1] = total
+        docs_parts.append(docs)
+        pos_parts.append(pos)
+    cat = (np.concatenate(docs_parts).astype(np.uint32) if docs_parts
+           else np.empty(0, np.uint32))
+    pcat = (np.concatenate(pos_parts).astype(np.uint32) if pos_parts
+            else np.empty(0, np.uint32))
+    return [("text.terms", np.frombuffer(blob, dtype=np.uint8)),
+            ("text.off", off), ("text.docs", cat), ("text.pos", pcat)]
+
+
+def deserialize_text_index(bufs: dict[str, np.ndarray]) -> TextIndex:
+    blob = bufs["text.terms"].tobytes().decode("utf-8")
+    terms = blob.split("\x01") if blob else []
+    off = bufs["text.off"].view(np.uint64)
+    docs = bufs["text.docs"].view(np.uint32)
+    pos = bufs["text.pos"].view(np.uint32)
+    doc_postings, pos_postings = [], []
+    for i in range(len(terms)):
+        d = docs[off[i]:off[i + 1]]
+        p = pos[off[i]:off[i + 1]]
+        doc_postings.append(np.unique(d))
+        pos_postings.append((d, p))
+    return TextIndex(terms, doc_postings, pos_postings)
+
+
+# ---------------------------------------------------------------------------
+# Geo grid index (H3 analogue): lat/lng cells → postings
+# ---------------------------------------------------------------------------
+
+EARTH_RADIUS_M = 6371008.8
+
+
+def haversine_m(lat1, lng1, lat2, lng2):
+    """Great-circle distance in meters (vectorized)."""
+    lat1, lng1, lat2, lng2 = (np.radians(np.asarray(x, dtype=np.float64))
+                              for x in (lat1, lng1, lat2, lng2))
+    dlat = lat2 - lat1
+    dlng = lng2 - lng1
+    a = np.sin(dlat / 2) ** 2 + np.cos(lat1) * np.cos(lat2) * np.sin(dlng / 2) ** 2
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+@dataclass
+class GeoGridIndex:
+    """Fixed-resolution lat/lng grid cells → doc postings.
+
+    Reference: the H3 hexagon index (pinot-segment-local/.../readers/
+    geospatial/H3IndexReader + pinot-core/.../geospatial/). Uber's H3
+    library isn't in this image, so cells are a uniform lat/lng grid at
+    `res_deg` degrees — the same two-phase pattern as the reference's
+    H3InclusionIndexFilterOperator: candidate cells covering the query
+    circle, then exact haversine refinement on the candidates only."""
+
+    res_deg: float
+    cell_ids: np.ndarray  # sorted unique int64 cell ids
+    offsets: np.ndarray   # CSR into docs
+    docs: np.ndarray
+
+    @staticmethod
+    def cell_of(lat: np.ndarray, lng: np.ndarray, res_deg: float) -> np.ndarray:
+        r = np.int64(np.ceil(360.0 / res_deg))
+        la = np.floor((np.asarray(lat, dtype=np.float64) + 90.0) / res_deg).astype(np.int64)
+        lo = np.floor((np.asarray(lng, dtype=np.float64) + 180.0) / res_deg).astype(np.int64)
+        return la * r + lo
+
+    @staticmethod
+    def build(lat: np.ndarray, lng: np.ndarray, res_deg: float = 0.5) -> "GeoGridIndex":
+        cells = GeoGridIndex.cell_of(lat, lng, res_deg)
+        order = np.argsort(cells, kind="stable")
+        sorted_cells = cells[order]
+        uniq, starts = np.unique(sorted_cells, return_index=True)
+        offsets = np.append(starts, len(cells)).astype(np.uint64)
+        return GeoGridIndex(res_deg, uniq.astype(np.int64), offsets,
+                            order.astype(np.uint32))
+
+    def candidate_docs(self, lat: float, lng: float, radius_m: float) -> np.ndarray:
+        """Docs in cells intersecting the circle (superset of matches)."""
+        deg_lat = np.degrees(radius_m / EARTH_RADIUS_M)
+        cos = max(0.01, np.cos(np.radians(lat)))
+        deg_lng = deg_lat / cos
+        r = np.int64(np.ceil(360.0 / self.res_deg))
+        la_lo = int(np.floor((lat - deg_lat + 90.0) / self.res_deg))
+        la_hi = int(np.floor((lat + deg_lat + 90.0) / self.res_deg))
+        lo_lo = int(np.floor((lng - deg_lng + 180.0) / self.res_deg))
+        lo_hi = int(np.floor((lng + deg_lng + 180.0) / self.res_deg))
+        wanted = []
+        for la in range(la_lo, la_hi + 1):
+            base = np.int64(la) * r
+            wanted.append(np.arange(base + lo_lo, base + lo_hi + 1, dtype=np.int64))
+        wanted = np.concatenate(wanted)
+        idx = np.searchsorted(self.cell_ids, wanted)
+        idx = idx[(idx < len(self.cell_ids))]
+        hit = idx[np.isin(self.cell_ids[idx], wanted)]
+        if not len(hit):
+            return np.empty(0, dtype=np.uint32)
+        return np.concatenate([self.docs[self.offsets[i]:self.offsets[i + 1]]
+                               for i in np.unique(hit)])
+
+
+def serialize_geo_index(idx: GeoGridIndex) -> list[tuple[str, np.ndarray]]:
+    hdr = np.asarray([idx.res_deg], dtype=np.float64)
+    return [("geo.hdr", hdr), ("geo.cells", idx.cell_ids),
+            ("geo.off", idx.offsets), ("geo.docs", idx.docs)]
+
+
+def deserialize_geo_index(bufs: dict[str, np.ndarray]) -> GeoGridIndex:
+    return GeoGridIndex(float(bufs["geo.hdr"].view(np.float64)[0]),
+                        bufs["geo.cells"].view(np.int64),
+                        bufs["geo.off"].view(np.uint64),
+                        bufs["geo.docs"].view(np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# Vector index: exact cosine top-K (MXU matmul) + IVF pruning
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class VectorIndex:
+    """Top-K cosine similarity over a (n, dim) float32 matrix.
+
+    Reference: the Lucene HNSW vector index (pinot-segment-local/.../
+    creator/impl/vector/lucene99/, VectorSimilarityFilterOperator). The
+    TPU-first design inverts the approach: instead of a pointer-chasing
+    graph (hostile to the MXU), store L2-normalized vectors densely and
+    compute exact similarity as ONE (n,dim)x(dim,) matmul on device —
+    at OLAP segment sizes the matmul is faster than graph traversal on
+    accelerators. An IVF coarse quantizer (k-means centroids) optionally
+    prunes to nprobe clusters for very large segments."""
+
+    vectors: np.ndarray  # (n, dim) float32, L2-normalized rows
+    centroids: np.ndarray = None  # (nlist, dim) or None
+    assignments: np.ndarray = None  # (n,) int32 cluster of each row
+
+    @staticmethod
+    def build(vectors: np.ndarray, nlist: int = 0) -> "VectorIndex":
+        v = np.ascontiguousarray(vectors, dtype=np.float32)
+        norms = np.linalg.norm(v, axis=1, keepdims=True)
+        norms[norms == 0] = 1.0
+        v = v / norms
+        centroids = assignments = None
+        n = len(v)
+        if nlist == 0 and n >= 4096:
+            nlist = int(np.sqrt(n))
+        if nlist > 1 and n > nlist:
+            centroids, assignments = _kmeans(v, nlist)
+        return VectorIndex(v, centroids, assignments)
+
+    def top_k(self, query: np.ndarray, k: int, nprobe: int = 8):
+        """(doc_ids, similarities) of the k nearest by cosine."""
+        q = np.asarray(query, dtype=np.float32)
+        qn = np.linalg.norm(q)
+        if qn > 0:
+            q = q / qn
+        if self.centroids is not None and nprobe < len(self.centroids):
+            cscore = self.centroids @ q
+            probe = np.argpartition(cscore, -nprobe)[-nprobe:]
+            cand = np.nonzero(np.isin(self.assignments, probe))[0]
+            if len(cand) < k:  # under-probed: fall back to exact
+                cand = np.arange(len(self.vectors))
+        else:
+            cand = np.arange(len(self.vectors))
+        sims = self.vectors[cand] @ q
+        k = min(k, len(cand))
+        if k == 0:
+            return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.float32)
+        top = np.argpartition(sims, -k)[-k:]
+        order = np.argsort(-sims[top], kind="stable")
+        sel = top[order]
+        return cand[sel].astype(np.int64), sims[sel]
+
+    def mask_top_k(self, query: np.ndarray, k: int, num_docs: int) -> np.ndarray:
+        docs, _ = self.top_k(query, k)
+        mask = np.zeros(num_docs, dtype=bool)
+        mask[docs[docs < num_docs]] = True
+        return mask
+
+
+def _kmeans(v: np.ndarray, nlist: int, iters: int = 8):
+    """Small k-means on normalized vectors (IVF coarse quantizer)."""
+    rng = np.random.default_rng(0)
+    centroids = v[rng.choice(len(v), nlist, replace=False)].copy()
+    assign = np.zeros(len(v), dtype=np.int32)
+    for _ in range(iters):
+        assign = np.argmax(v @ centroids.T, axis=1).astype(np.int32)
+        for c in range(nlist):
+            members = v[assign == c]
+            if len(members):
+                m = members.mean(axis=0)
+                norm = np.linalg.norm(m)
+                centroids[c] = m / norm if norm > 0 else m
+    return centroids, assign
+
+
+def serialize_vector_index(idx: VectorIndex) -> list[tuple[str, np.ndarray]]:
+    n, dim = idx.vectors.shape
+    nlist = 0 if idx.centroids is None else len(idx.centroids)
+    hdr = np.asarray([n, dim, nlist], dtype=np.int64)
+    out = [("vec.hdr", hdr), ("vec.data", idx.vectors.reshape(-1))]
+    if nlist:
+        out.append(("vec.centroids", idx.centroids.reshape(-1)))
+        out.append(("vec.assign", idx.assignments))
+    return out
+
+
+def deserialize_vector_index(bufs: dict[str, np.ndarray]) -> VectorIndex:
+    n, dim, nlist = (int(x) for x in bufs["vec.hdr"].view(np.int64))
+    vecs = bufs["vec.data"].view(np.float32).reshape(n, dim)
+    if nlist:
+        return VectorIndex(vecs,
+                           bufs["vec.centroids"].view(np.float32).reshape(nlist, dim),
+                           bufs["vec.assign"].view(np.int32))
+    return VectorIndex(vecs)

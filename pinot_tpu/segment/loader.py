@@ -210,6 +210,69 @@ class ImmutableSegment:
             self._indexes[key] = JsonIndex.build(self.get_values(column))
         return self._indexes[key]
 
+    def get_text_index(self, column: str, or_build: bool = False):
+        key = ("text", column)
+        if key not in self._indexes:
+            if self._has_buffer(f"{column}.text.terms"):
+                from .indexes import deserialize_text_index
+
+                bufs = {s: np.frombuffer(self._buffer(f"{column}.text.{s2}"),
+                                         dtype=np.uint8)
+                        for s, s2 in (("text.terms", "terms"), ("text.off", "off"),
+                                      ("text.docs", "docs"), ("text.pos", "pos"))}
+                self._indexes[key] = deserialize_text_index(bufs)
+            else:
+                self._indexes[key] = None
+        if self._indexes[key] is None and or_build:
+            from .indexes import TextIndex
+
+            self._indexes[key] = TextIndex.build(self.get_values(column))
+        return self._indexes[key]
+
+    def get_vector_index(self, column: str, or_build: bool = False):
+        key = ("vector", column)
+        if key not in self._indexes:
+            if self._has_buffer(f"{column}.vec.hdr"):
+                from .indexes import deserialize_vector_index
+
+                bufs = {s: np.frombuffer(self._buffer(f"{column}.{s}"),
+                                         dtype=np.uint8)
+                        for s in ("vec.hdr", "vec.data")}
+                for opt in ("vec.centroids", "vec.assign"):
+                    if self._has_buffer(f"{column}.{opt}"):
+                        bufs[opt] = np.frombuffer(
+                            self._buffer(f"{column}.{opt}"), dtype=np.uint8)
+                self._indexes[key] = deserialize_vector_index(bufs)
+            else:
+                self._indexes[key] = None
+        if self._indexes[key] is None and or_build:
+            from .indexes import VectorIndex
+
+            vecs = np.stack([np.asarray(v, dtype=np.float32)
+                             for v in self.get_mv_values(column)])
+            self._indexes[key] = VectorIndex.build(vecs)
+        return self._indexes[key]
+
+    def get_geo_index(self, lat_col: str, lng_col: str, or_build: bool = False):
+        key = ("geo", lat_col, lng_col)
+        pair = f"{lat_col}__{lng_col}"
+        if key not in self._indexes:
+            if self._has_buffer(f"{pair}.geo.hdr"):
+                from .indexes import deserialize_geo_index
+
+                bufs = {s: np.frombuffer(self._buffer(f"{pair}.{s}"), dtype=np.uint8)
+                        for s in ("geo.hdr", "geo.cells", "geo.off", "geo.docs")}
+                self._indexes[key] = deserialize_geo_index(bufs)
+            else:
+                self._indexes[key] = None
+        if self._indexes[key] is None and or_build:
+            from .indexes import GeoGridIndex
+
+            self._indexes[key] = GeoGridIndex.build(
+                np.asarray(self.get_values(lat_col), dtype=np.float64),
+                np.asarray(self.get_values(lng_col), dtype=np.float64))
+        return self._indexes[key]
+
     def star_trees(self):
         """Loaded StarTreeViews (pre-aggregated pseudo-segments), cached."""
         key = ("startree", "*")

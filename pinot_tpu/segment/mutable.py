@@ -250,6 +250,15 @@ class MutableSegment:
     def get_json_index(self, column: str, or_build: bool = False):
         return None
 
+    def get_text_index(self, column: str, or_build: bool = False):
+        return None
+
+    def get_vector_index(self, column: str, or_build: bool = False):
+        return None
+
+    def get_geo_index(self, lat_col: str, lng_col: str, or_build: bool = False):
+        return None
+
     @property
     def star_trees(self):
         return []
@@ -353,6 +362,15 @@ class MutableSegmentView:
         return None
 
     def get_json_index(self, column: str, or_build: bool = False):
+        return None
+
+    def get_text_index(self, column: str, or_build: bool = False):
+        return None
+
+    def get_vector_index(self, column: str, or_build: bool = False):
+        return None
+
+    def get_geo_index(self, lat_col: str, lng_col: str, or_build: bool = False):
         return None
 
     @property

@@ -38,6 +38,9 @@ class IndexingConfig:
     json_index_columns: list[str] = field(default_factory=list)
     text_index_columns: list[str] = field(default_factory=list)
     vector_index_columns: list[str] = field(default_factory=list)
+    # geo grid index over a (lat, lng) column pair:
+    # {"latColumn": ..., "lngColumn": ..., "resolutionDeg": 0.5}
+    geo_index_configs: list[dict] = field(default_factory=list)
 
 
 @dataclass

@@ -1,0 +1,201 @@
+"""Text, geo, and vector index tests (SURVEY.md §2.2 index inventory)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.indexes import (
+    GeoGridIndex,
+    TextIndex,
+    VectorIndex,
+    haversine_m,
+)
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+DOCS = [
+    "Apache Pinot is a realtime distributed OLAP datastore",
+    "TPU kernels execute fused columnar query plans",
+    "the quick brown fox jumps over the lazy dog",
+    "realtime ingestion from streaming sources",
+    None,
+    "distributed query execution with columnar storage",
+]
+
+
+# -- text --------------------------------------------------------------------
+
+
+def test_text_index_terms_and_bool():
+    idx = TextIndex.build(DOCS)
+    assert list(idx.docs_for_term("realtime")) == [0, 3]
+    assert list(idx.docs_for_term("missing")) == []
+    m = idx.mask_match("realtime AND distributed", 6)
+    assert list(np.nonzero(m)[0]) == [0]
+    m = idx.mask_match("fox OR streaming", 6)
+    assert list(np.nonzero(m)[0]) == [2, 3]
+    # adjacency = OR (Lucene default)
+    m = idx.mask_match("fox streaming", 6)
+    assert list(np.nonzero(m)[0]) == [2, 3]
+
+
+def test_text_index_phrase_and_prefix():
+    idx = TextIndex.build(DOCS)
+    m = idx.mask_match('"columnar query plans"', 6)
+    assert list(np.nonzero(m)[0]) == [1]
+    m = idx.mask_match('"query columnar"', 6)  # wrong order: no match
+    assert not m.any()
+    m = idx.mask_match("stream*", 6)
+    assert list(np.nonzero(m)[0]) == [3]
+    m = idx.mask_match("(fox OR dog) AND quick", 6)
+    assert list(np.nonzero(m)[0]) == [2]
+
+
+def test_text_match_sql(tmp_path):
+    schema = Schema.build("docs", dimensions=[("id", "INT"), ("body", "STRING")])
+    cols = {"id": np.arange(len(DOCS), dtype=np.int32),
+            "body": np.asarray(["" if d is None else d for d in DOCS], dtype=object)}
+    cfg = TableConfig(table_name="docs", indexing=IndexingConfig(
+        text_index_columns=["body"]))
+    SegmentBuilder(schema, cfg, "d0").build(cols, tmp_path / "d0")
+    seg = load_segment(tmp_path / "d0")
+    assert seg.get_text_index("body") is not None  # persisted
+    for backend in ("host", "tpu"):
+        qe = QueryExecutor(backend=backend)
+        qe.add_table(schema, [seg])
+        r = qe.execute_sql(
+            "SELECT id FROM docs WHERE TEXT_MATCH(body, 'columnar AND query') "
+            "ORDER BY id LIMIT 10")
+        assert not r.exceptions, (backend, r.exceptions)
+        assert [x[0] for x in r.result_table.rows] == [1, 5]
+
+
+# -- geo ---------------------------------------------------------------------
+
+
+CITIES = {
+    "sf": (37.7749, -122.4194),
+    "oakland": (37.8044, -122.2712),
+    "san_jose": (37.3382, -121.8863),
+    "la": (34.0522, -118.2437),
+    "nyc": (40.7128, -74.0060),
+}
+
+
+def test_haversine():
+    d = haversine_m(*CITIES["sf"], *CITIES["la"])
+    assert 540_000 < d < 570_000  # ~559 km
+    assert haversine_m(*CITIES["sf"], *CITIES["sf"]) == 0
+
+
+def test_geo_grid_index():
+    names = list(CITIES)
+    lat = np.asarray([CITIES[c][0] for c in names])
+    lng = np.asarray([CITIES[c][1] for c in names])
+    idx = GeoGridIndex.build(lat, lng, res_deg=0.5)
+    cand = idx.candidate_docs(*CITIES["sf"], 30_000)
+    assert 0 in cand and 1 in cand  # sf + oakland
+    assert 4 not in cand  # nyc pruned at candidate stage
+
+
+def test_geo_sql_query(tmp_path):
+    names = list(CITIES)
+    schema = Schema.build("places", dimensions=[("name", "STRING")],
+                          metrics=[("lat", "DOUBLE"), ("lng", "DOUBLE")])
+    cols = {"name": np.asarray(names, dtype=object),
+            "lat": np.asarray([CITIES[c][0] for c in names]),
+            "lng": np.asarray([CITIES[c][1] for c in names])}
+    cfg = TableConfig(table_name="places", indexing=IndexingConfig(
+        geo_index_configs=[{"latColumn": "lat", "lngColumn": "lng"}]))
+    SegmentBuilder(schema, cfg, "g0").build(cols, tmp_path / "g0")
+    seg = load_segment(tmp_path / "g0")
+    assert seg.get_geo_index("lat", "lng") is not None
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [seg])
+    r = qe.execute_sql(
+        "SELECT name FROM places "
+        f"WHERE ST_DISTANCE(lat, lng, {CITIES['sf'][0]}, {CITIES['sf'][1]}) < 30000 "
+        "ORDER BY name LIMIT 10")
+    assert not r.exceptions, r.exceptions
+    assert [x[0] for x in r.result_table.rows] == ["oakland", "sf"]
+    # scalar distance in SELECT
+    r = qe.execute_sql(
+        "SELECT name, ST_DISTANCE(lat, lng, 37.7749, -122.4194) FROM places "
+        "WHERE name = 'la'")
+    assert 540_000 < r.result_table.rows[0][1] < 570_000
+
+
+# -- vector ------------------------------------------------------------------
+
+
+def test_vector_index_exact():
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(500, 16)).astype(np.float32)
+    idx = VectorIndex.build(vecs)
+    q = vecs[123]
+    docs, sims = idx.top_k(q, 5)
+    assert docs[0] == 123
+    assert sims[0] == pytest.approx(1.0, abs=1e-5)
+    # parity with brute force
+    norm = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    expected = np.argsort(-(norm @ (q / np.linalg.norm(q))))[:5]
+    assert set(docs) == set(expected)
+
+
+def test_vector_index_ivf_recall():
+    rng = np.random.default_rng(1)
+    # clustered data: IVF probes recover the true cluster
+    centers = rng.normal(size=(10, 32)) * 5
+    vecs = np.concatenate([c + rng.normal(size=(500, 32)) * 0.3 for c in centers])
+    idx = VectorIndex.build(vecs.astype(np.float32), nlist=10)
+    assert idx.centroids is not None
+    q = vecs[42]
+    docs, _ = idx.top_k(q, 10, nprobe=3)
+    assert 42 in docs
+
+
+def test_vector_similarity_sql(tmp_path):
+    rng = np.random.default_rng(2)
+    dim = 8
+    vecs = rng.normal(size=(50, dim)).astype(np.float32)
+    schema = Schema.build("emb", dimensions=[("id", "INT"),
+                                             ("v", "FLOAT", False)])
+    cols = {"id": np.arange(50, dtype=np.int32),
+            "v": [list(map(float, row)) for row in vecs]}
+    cfg = TableConfig(table_name="emb", indexing=IndexingConfig(
+        vector_index_columns=["v"]))
+    SegmentBuilder(schema, cfg, "v0").build(cols, tmp_path / "v0")
+    seg = load_segment(tmp_path / "v0")
+    assert seg.get_vector_index("v") is not None
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [seg])
+    target = ", ".join(f"{x:.6f}" for x in vecs[7])
+    r = qe.execute_sql(
+        f"SELECT id FROM emb WHERE VECTOR_SIMILARITY(v, ARRAY[{target}], 3) "
+        "LIMIT 10")
+    assert not r.exceptions, r.exceptions
+    ids = [x[0] for x in r.result_table.rows]
+    assert 7 in ids and len(ids) == 3
+
+
+def test_vector_index_survives_serialization(tmp_path):
+    rng = np.random.default_rng(3)
+    vecs = rng.normal(size=(5000, 8)).astype(np.float32)
+    idx = VectorIndex.build(vecs)  # n ≥ 4096 → IVF auto-enabled
+    assert idx.centroids is not None
+    from pinot_tpu.segment.indexes import (
+        deserialize_vector_index,
+        serialize_vector_index,
+    )
+
+    bufs = {name: np.ascontiguousarray(arr).view(np.uint8)
+            for name, arr in serialize_vector_index(idx)}
+    back = deserialize_vector_index(bufs)
+    q = vecs[99]
+    d1, _ = idx.top_k(q, 4)
+    d2, _ = back.top_k(q, 4)
+    np.testing.assert_array_equal(d1, d2)
