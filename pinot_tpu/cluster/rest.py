@@ -1,0 +1,201 @@
+"""HTTP REST layer for broker + controller roles.
+
+Reference analogue: the broker's Jersey resources
+(pinot-broker/.../api/resources/PinotClientRequest.java — POST /query/sql)
+and the controller's 62 JAX-RS resources (pinot-controller/.../api/
+resources/: tables, schemas, segments, rebalance). stdlib http.server keeps
+the surface dependency-free; handlers delegate to the same objects the
+in-proc tests drive.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .broker import Broker
+from .controller import ClusterController, table_name_with_type
+
+
+class _JsonHandler(BaseHTTPRequestHandler):
+    routes_get: list = []
+    routes_post: list = []
+    routes_delete: list = []
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _reply(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        if n == 0:
+            return {}
+        return json.loads(self.rfile.read(n).decode("utf-8"))
+
+    def _dispatch(self, routes) -> None:
+        parsed = urlparse(self.path)
+        for pattern, fn in routes:
+            m = re.fullmatch(pattern, parsed.path)
+            if m:
+                try:
+                    code, payload = fn(self, m, parse_qs(parsed.query))
+                except Exception as e:  # surface as HTTP 500 JSON
+                    code, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                self._reply(code, payload)
+                return
+        self._reply(404, {"error": f"no route for {parsed.path}"})
+
+    def do_GET(self):
+        self._dispatch(self.routes_get)
+
+    def do_POST(self):
+        self._dispatch(self.routes_post)
+
+    def do_DELETE(self):
+        self._dispatch(self.routes_delete)
+
+
+class _RestServer:
+    def __init__(self, handler_cls, host: str = "127.0.0.1", port: int = 0):
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class BrokerRestServer(_RestServer):
+    """POST /query/sql {"sql": ...} → BrokerResponse JSON;
+    POST /timeseries/api/v1/query_range for the timeseries engine;
+    GET /health."""
+
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
+                 timeseries_engine=None):
+        srv = self
+
+        class Handler(_JsonHandler):
+            routes_get = [
+                (r"/health", lambda h, m, q: (200, {"status": "OK"})),
+            ]
+            routes_post = [
+                (r"/query/sql", lambda h, m, q: srv._query(h._body())),
+                (r"/timeseries/api/v1/query_range",
+                 lambda h, m, q: srv._timeseries(h._body())),
+            ]
+
+        self.broker = broker
+        self.timeseries_engine = timeseries_engine
+        super().__init__(Handler, host, port)
+
+    def _query(self, body: dict):
+        sql = body.get("sql")
+        if not sql:
+            return 400, {"error": "missing 'sql'"}
+        resp = self.broker.execute_sql(sql)
+        return (200 if not resp.exceptions else 500), resp.to_json()
+
+    def _timeseries(self, body: dict):
+        if self.timeseries_engine is None:
+            return 501, {"error": "timeseries engine not configured"}
+        block = self.timeseries_engine.execute(
+            body["query"], int(body["start"]), int(body["end"]),
+            int(body["step"]), body.get("language", "m3ql"))
+        return 200, block.to_json()
+
+
+class ControllerRestServer(_RestServer):
+    """Table/schema/segment lifecycle endpoints (reference:
+    PinotTableRestletResource, PinotSchemaRestletResource,
+    PinotSegmentUploadDownloadRestletResource, rebalance endpoints)."""
+
+    def __init__(self, controller: ClusterController,
+                 host: str = "127.0.0.1", port: int = 0):
+        srv = self
+
+        class Handler(_JsonHandler):
+            routes_get = [
+                (r"/health", lambda h, m, q: (200, {"status": "OK"})),
+                (r"/tables", lambda h, m, q: srv._list_tables()),
+                (r"/tables/([^/]+)", lambda h, m, q: srv._get_table(m.group(1))),
+                (r"/schemas/([^/]+)", lambda h, m, q: srv._get_schema(m.group(1))),
+                (r"/segments/([^/]+)", lambda h, m, q: srv._list_segments(m.group(1))),
+                (r"/instances", lambda h, m, q: (200, {
+                    "instances": srv.controller.list_instances(),
+                    "live": srv.controller.live_instances()})),
+            ]
+            routes_post = [
+                (r"/schemas", lambda h, m, q: srv._add_schema(h._body())),
+                (r"/tables", lambda h, m, q: srv._create_table(h._body())),
+                (r"/segments/([^/]+)/([^/]+)",
+                 lambda h, m, q: srv._add_segment(m.group(1), m.group(2), h._body())),
+                (r"/tables/([^/]+)/rebalance",
+                 lambda h, m, q: (200, srv.controller.rebalance(
+                     m.group(1), dry_run=q.get("dryRun", ["false"])[0] == "true"))),
+            ]
+            routes_delete = [
+                (r"/tables/([^/]+)",
+                 lambda h, m, q: srv._drop_table(m.group(1))),
+                (r"/segments/([^/]+)/([^/]+)",
+                 lambda h, m, q: srv._drop_segment(m.group(1), m.group(2))),
+            ]
+
+        self.controller = controller
+        super().__init__(Handler, host, port)
+
+    def _list_tables(self):
+        return 200, {"tables": self.controller.store.children("/CONFIGS/TABLE")}
+
+    def _get_table(self, name: str):
+        cfg = self.controller.table_config(table_name_with_type(name))
+        if cfg is None:
+            cfg = self.controller.table_config(table_name_with_type(name, "REALTIME"))
+        return (200, cfg) if cfg else (404, {"error": f"table {name} not found"})
+
+    def _get_schema(self, name: str):
+        s = self.controller.store.get(f"/SCHEMAS/{name}")
+        return (200, s) if s else (404, {"error": f"schema {name} not found"})
+
+    def _add_schema(self, body: dict):
+        self.controller.add_schema(body)
+        return 200, {"status": f"schema {body.get('schemaName')} added"}
+
+    def _create_table(self, body: dict):
+        name = self.controller.create_table(body)
+        return 200, {"status": f"table {name} created", "tableName": name}
+
+    def _list_segments(self, table: str):
+        t = table_name_with_type(table)
+        return 200, {"segments": self.controller.store.children(f"/SEGMENTS/{t}")}
+
+    def _add_segment(self, table: str, segment: str, body: dict):
+        assigned = self.controller.add_segment(
+            table_name_with_type(table), segment, body)
+        return 200, {"status": "added", "assigned": assigned}
+
+    def _drop_table(self, table: str):
+        self.controller.drop_table(table_name_with_type(table))
+        return 200, {"status": f"table {table} dropped"}
+
+    def _drop_segment(self, table: str, segment: str):
+        self.controller.drop_segment(table_name_with_type(table), segment)
+        return 200, {"status": f"segment {segment} dropped"}

@@ -1,0 +1,2 @@
+"""CLI tools (reference: pinot-tools — PinotAdministrator + ~40 admin
+subcommands)."""

@@ -1,0 +1,185 @@
+"""pinot-admin CLI.
+
+Reference analogue: pinot-tools PinotAdministrator
+(pinot-tools/.../admin/PinotAdministrator.java:93) and its subcommands
+(StartController/StartBroker/StartServer/QuickStart/
+LaunchDataIngestionJob/PostQuery — .../admin/command/).
+
+Usage:
+    python -m pinot_tpu.tools.admin quickstart [--rows N] [--once]
+    python -m pinot_tpu.tools.admin query --broker URL --sql "SELECT ..."
+    python -m pinot_tpu.tools.admin ingest --spec job.yaml \\
+        --schema schema.json [--table-config table.json]
+    python -m pinot_tpu.tools.admin tables --controller URL
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def cmd_quickstart(args) -> int:
+    """Boot an in-process cluster with sample data and serve HTTP
+    (reference: the Quickstart command's batch flavor)."""
+    from ..cluster import Broker, ClusterController, PropertyStore, ServerInstance
+    from ..cluster.rest import BrokerRestServer, ControllerRestServer
+    from ..segment.builder import SegmentBuilder
+    from ..spi.data_types import Schema
+    from ..timeseries import TimeSeriesEngine
+
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}") for i in range(args.servers)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+
+    schema = Schema.build(
+        "baseballStats",
+        dimensions=[("playerName", "STRING"), ("teamID", "STRING"),
+                    ("yearID", "INT")],
+        metrics=[("runs", "INT"), ("hits", "INT"), ("homeRuns", "INT")])
+    controller.add_schema(schema.to_json())
+    table = controller.create_table(
+        {"tableName": "baseballStats",
+         "replication": min(args.servers, 2)})
+
+    rng = np.random.default_rng(0)
+    n = args.rows
+    teams = ["ANA", "BOS", "CHA", "DET", "LAN", "NYA", "SFN", "SLN"]
+    work = Path(tempfile.mkdtemp(prefix="pinot_tpu_quickstart_"))
+    per_seg = max(1, n // 4)
+    for i in range(4):
+        rows = min(per_seg, n - i * per_seg)
+        if rows <= 0:
+            break
+        cols = {
+            "playerName": np.asarray([f"player{j}" for j in
+                                      rng.integers(0, max(rows // 3, 1), rows)],
+                                     dtype=object),
+            "teamID": np.asarray(teams, dtype=object)[rng.integers(0, 8, rows)],
+            "yearID": rng.integers(1990, 2024, rows).astype(np.int32),
+            "runs": rng.integers(0, 150, rows).astype(np.int32),
+            "hits": rng.integers(0, 200, rows).astype(np.int32),
+            "homeRuns": rng.integers(0, 60, rows).astype(np.int32),
+        }
+        name = f"baseballStats_{i}"
+        SegmentBuilder(schema, segment_name=name).build(cols, work / name)
+        controller.add_segment(table, name,
+                               {"location": str(work / name), "numDocs": rows})
+
+    ts_engine = None
+    broker_rest = BrokerRestServer(broker, port=args.broker_port,
+                                   timeseries_engine=ts_engine)
+    controller_rest = ControllerRestServer(controller, port=args.controller_port)
+    print(f"broker:     {broker_rest.url}")
+    print(f"controller: {controller_rest.url}")
+
+    demo = [
+        "SELECT COUNT(*) FROM baseballStats",
+        "SELECT teamID, SUM(runs) FROM baseballStats GROUP BY teamID "
+        "ORDER BY SUM(runs) DESC LIMIT 5",
+        "SELECT yearID, MAX(homeRuns) FROM baseballStats "
+        "WHERE yearID >= 2015 GROUP BY yearID ORDER BY yearID LIMIT 10",
+    ]
+    from ..client import connect
+
+    conn = connect(broker_rest.url)
+    for sql in demo:
+        rs = conn.execute(sql)
+        print(f"\n> {sql}")
+        print(f"  columns: {rs.column_names}")
+        for row in list(rs)[:5]:
+            print(f"  {row}")
+    if args.once:
+        broker_rest.close()
+        controller_rest.close()
+        for s in servers:
+            s.stop()
+        return 0
+    print("\nserving — ^C to stop")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_query(args) -> int:
+    from ..client import connect
+
+    rs = connect(args.broker).execute(args.sql)
+    print(json.dumps({"columns": rs.column_names, "rows": rs.rows,
+                      "stats": rs.execution_stats}, indent=2, default=str))
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    """Reference: LaunchDataIngestionJobCommand."""
+    from ..ingestion.batch import IngestionJobLauncher, SegmentGenerationJobSpec
+    from ..spi.data_types import Schema
+    from ..spi.table_config import TableConfig
+
+    schema = Schema.from_json(json.loads(Path(args.schema).read_text()))
+    if args.table_config:
+        table_config = TableConfig.from_json(
+            json.loads(Path(args.table_config).read_text()))
+    else:
+        table_config = TableConfig(table_name=schema.schema_name)
+    spec = SegmentGenerationJobSpec.from_yaml(args.spec, schema, table_config)
+    results = IngestionJobLauncher(spec).run()
+    for r in results:
+        print(f"built {r.segment_name}: {r.num_docs} docs → {r.output_uri}")
+    return 0
+
+
+def cmd_tables(args) -> int:
+    import urllib.request
+
+    with urllib.request.urlopen(args.controller.rstrip("/") + "/tables") as r:
+        print(r.read().decode())
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pinot-admin",
+                                description="pinot_tpu administration")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    q = sub.add_parser("quickstart", help="boot an in-process demo cluster")
+    q.add_argument("--rows", type=int, default=100_000)
+    q.add_argument("--servers", type=int, default=2)
+    q.add_argument("--broker-port", type=int, default=0)
+    q.add_argument("--controller-port", type=int, default=0)
+    q.add_argument("--once", action="store_true",
+                   help="run the demo queries and exit")
+    q.set_defaults(fn=cmd_quickstart)
+
+    qq = sub.add_parser("query", help="POST sql to a broker")
+    qq.add_argument("--broker", required=True)
+    qq.add_argument("--sql", required=True)
+    qq.set_defaults(fn=cmd_query)
+
+    ing = sub.add_parser("ingest", help="run a batch ingestion job spec")
+    ing.add_argument("--spec", required=True, help="job spec YAML")
+    ing.add_argument("--schema", required=True, help="schema JSON file")
+    ing.add_argument("--table-config", help="table config JSON file")
+    ing.set_defaults(fn=cmd_ingest)
+
+    t = sub.add_parser("tables", help="list tables via controller REST")
+    t.add_argument("--controller", required=True)
+    t.set_defaults(fn=cmd_tables)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

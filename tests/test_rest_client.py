@@ -1,0 +1,129 @@
+"""REST API + Python client + CLI tests."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.client import PinotClientError, connect
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.cluster.rest import BrokerRestServer, ControllerRestServer
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "web", dimensions=[("path", "STRING")], metrics=[("hits", "INT")])
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    store = PropertyStore()
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="host")
+    server.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    table = controller.create_table({"tableName": "web", "replication": 1})
+    cols = {"path": np.asarray(["/a", "/b", "/a", "/c"], dtype=object),
+            "hits": np.asarray([1, 2, 3, 4], dtype=np.int32)}
+    SegmentBuilder(SCHEMA, segment_name="w0").build(cols, tmp_path / "w0")
+    controller.add_segment(table, "w0", {"location": str(tmp_path / "w0"),
+                                         "numDocs": 4})
+    brest = BrokerRestServer(broker)
+    crest = ControllerRestServer(controller)
+    yield brest, crest, controller
+    brest.close()
+    crest.close()
+    server.stop()
+
+
+def test_query_over_http(stack):
+    brest, _, _ = stack
+    conn = connect(brest.url)
+    rs = conn.execute("SELECT path, SUM(hits) FROM web GROUP BY path ORDER BY path")
+    assert rs.column_names[0] == "path"
+    assert rs.rows == [["/a", 4.0], ["/b", 2.0], ["/c", 4.0]]
+    assert rs.get(0, "path") == "/a"
+    assert rs.execution_stats["numDocsScanned"] == 4
+
+
+def test_query_error_surfaces(stack):
+    brest, _, _ = stack
+    conn = connect(brest.url)
+    with pytest.raises(PinotClientError, match="not found"):
+        conn.execute("SELECT * FROM nosuch")
+
+
+def test_controller_rest_endpoints(stack, tmp_path):
+    _, crest, controller = stack
+
+    def get(path):
+        with urllib.request.urlopen(crest.url + path) as r:
+            return json.loads(r.read())
+
+    def post(path, body):
+        req = urllib.request.Request(
+            crest.url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    assert get("/health") == {"status": "OK"}
+    assert "web_OFFLINE" in get("/tables")["tables"]
+    assert get("/tables/web")["tableNameWithType"] == "web_OFFLINE"
+    assert get("/schemas/web")["schemaName"] == "web"
+    assert get("/segments/web")["segments"] == ["w0"]
+    assert "Server_0" in get("/instances")["live"]
+
+    # create a second table + push a segment over HTTP
+    post("/schemas", Schema.build("t2", dimensions=[("x", "INT")]).to_json())
+    post("/tables", {"tableName": "t2", "replication": 1})
+    cols = {"x": np.arange(5, dtype=np.int32)}
+    SegmentBuilder(Schema.build("t2", dimensions=[("x", "INT")]),
+                   segment_name="t2_0").build(cols, tmp_path / "t2_0")
+    out = post("/segments/t2/t2_0",
+               {"location": str(tmp_path / "t2_0"), "numDocs": 5})
+    assert out["assigned"] == ["Server_0"]
+
+    req = urllib.request.Request(crest.url + "/tables/t2_OFFLINE",
+                                 method="DELETE")
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read())["status"].startswith("table")
+
+
+def test_http_404(stack):
+    brest, _, _ = stack
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(brest.url + "/nope")
+    assert e.value.code == 404
+
+
+def test_quickstart_cli_once(capsys):
+    from pinot_tpu.tools.admin import main
+
+    rc = main(["quickstart", "--rows", "2000", "--servers", "1", "--once"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SELECT COUNT(*) FROM baseballStats" in out
+    assert "broker:" in out
+
+
+def test_ingest_cli(tmp_path, capsys):
+    from pinot_tpu.tools.admin import main
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "d.csv").write_text("path,hits\n/x,5\n/y,6\n")
+    (tmp_path / "schema.json").write_text(json.dumps(SCHEMA.to_json()))
+    (tmp_path / "job.yaml").write_text(f"""
+inputDirURI: "{tmp_path / 'in'}"
+outputDirURI: "{tmp_path / 'out'}"
+recordReaderSpec:
+  dataFormat: csv
+""")
+    rc = main(["ingest", "--spec", str(tmp_path / "job.yaml"),
+               "--schema", str(tmp_path / "schema.json")])
+    assert rc == 0
+    assert "2 docs" in capsys.readouterr().out
