@@ -30,6 +30,7 @@ from ..query.filter import FilterContext, Predicate, PredicateType
 from ..query.parser.sql import SqlParseError, parse_sql
 from ..spi.data_types import Schema
 from .controller import ONLINE, raw_table_name, table_name_with_type
+from .quota import QueryQuotaExceededError, QueryQuotaManager, ResponseStore
 from .store import PropertyStore
 from .transport import RpcClient, TransportError
 
@@ -63,10 +64,34 @@ class _FailureDetector:
             return time.monotonic() >= until  # retry window open
 
 
+class _ServerStats:
+    """Per-server latency EWMA + in-flight count for adaptive selection
+    (reference: pinot-broker/.../routing/adaptiveserverselector/ —
+    NumInFlightReqSelector / LatencySelector hybrid)."""
+
+    __slots__ = ("ewma_ms", "inflight")
+
+    def __init__(self):
+        self.ewma_ms = 0.0
+        self.inflight = 0
+
+    def score(self) -> float:
+        return self.ewma_ms * (1.0 + self.inflight)
+
+    def record(self, latency_ms: float, alpha: float = 0.3) -> None:
+        self.ewma_ms = (alpha * latency_ms + (1 - alpha) * self.ewma_ms
+                        if self.ewma_ms else latency_ms)
+
+
 class Broker:
-    def __init__(self, store: PropertyStore, num_scatter_threads: int = 8):
+    def __init__(self, store: PropertyStore, num_scatter_threads: int = 8,
+                 adaptive_selection: bool = True):
         self.store = store
         self.failure_detector = _FailureDetector()
+        self.quota = QueryQuotaManager()
+        self.response_store = ResponseStore()
+        self.adaptive_selection = adaptive_selection
+        self._server_stats: dict[str, _ServerStats] = {}
         self._clients: dict[str, RpcClient] = {}
         self._rr = 0  # round-robin cursor for replica selection
         self._pool = ThreadPoolExecutor(max_workers=num_scatter_threads,
@@ -113,7 +138,13 @@ class Broker:
             if not candidates:
                 unavailable.append(seg)
                 continue
-            pick = candidates[rr % len(candidates)]
+            if self.adaptive_selection:
+                with self._lock:
+                    pick = min(candidates, key=lambda i: (
+                        self._server_stats.setdefault(i, _ServerStats()).score(),
+                        (hash(i) + rr) % 97))
+            else:
+                pick = candidates[rr % len(candidates)]
             plan.setdefault(pick, []).append(seg)
         if unavailable:
             raise TransportError(f"no online replica for segments {unavailable}")
@@ -127,11 +158,31 @@ class Broker:
         except SqlParseError as e:
             return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
         try:
+            self.quota.acquire(raw_table_name(query.table_name))
+        except QueryQuotaExceededError as e:
+            return BrokerResponse(exceptions=[f"QueryQuotaExceededError: {e}"])
+        try:
             resp = self._execute(query)
         except Exception as e:
             return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         return resp
+
+    def execute_sql_cursor(self, sql: str, num_rows: int = 1000) -> dict:
+        """Spool the full result and return the first page + cursor id
+        (reference: getCursor=true query option + /resultStore endpoints).
+        Subsequent pages via fetch_cursor()."""
+        resp = self.execute_sql(sql)
+        if resp.exceptions or resp.result_table is None:
+            return {"exceptions": resp.exceptions}
+        rt = resp.result_table
+        cursor_id = self.response_store.create_cursor(
+            rt.schema.column_names, rt.schema.column_types, rt.rows)
+        return self.response_store.fetch(cursor_id, 0, num_rows)
+
+    def fetch_cursor(self, cursor_id: str, offset: int,
+                     num_rows: int = 1000) -> dict:
+        return self.response_store.fetch(cursor_id, offset, num_rows)
 
     def _execute(self, query: QueryContext) -> BrokerResponse:
         raw = raw_table_name(query.table_name)
@@ -191,15 +242,24 @@ class Broker:
             inst, segs = inst_segs
             request = {"type": "query", "table": table, "segments": segs,
                        "query": query}
+            with self._lock:
+                stats = self._server_stats.setdefault(inst, _ServerStats())
+                stats.inflight += 1
+            t0 = time.perf_counter()
             try:
                 out = self._client(inst).call(request)
                 self.failure_detector.mark_healthy(inst)
+                with self._lock:
+                    stats.record((time.perf_counter() - t0) * 1000)
                 return inst, segs, out, None
             except TransportError as e:
                 self.failure_detector.mark_failed(inst)
                 with self._lock:
                     self._clients.pop(inst, None)
                 return inst, segs, None, e
+            finally:
+                with self._lock:
+                    stats.inflight -= 1
 
         results = []
         retry: list[str] = []

@@ -1,0 +1,106 @@
+"""Query quota + cursors (paginated results).
+
+Reference analogues:
+- HelixExternalViewBasedQueryQuotaManager (pinot-broker/.../queryquota/):
+  per-table QPS quotas from table config, enforced with a hit counter over
+  a sliding window.
+- Cursors/response store (pinot-broker/.../cursors/FsResponseStore.java +
+  pinot-spi/.../cursors/): a query's full result spools once, pages are
+  served by cursor id.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Optional
+
+
+class QueryQuotaExceededError(Exception):
+    pass
+
+
+class QueryQuotaManager:
+    """Sliding-window QPS enforcement per table (reference: HitCounter with
+    per-second buckets)."""
+
+    def __init__(self, window_s: float = 1.0):
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._limits: dict[str, float] = {}
+        self._hits: dict[str, deque] = {}
+
+    def set_qps_limit(self, table: str, qps: Optional[float]) -> None:
+        with self._lock:
+            if qps is None:
+                self._limits.pop(table, None)
+            else:
+                self._limits[table] = float(qps)
+
+    def acquire(self, table: str) -> None:
+        """Record a hit; raises when the table is over its QPS quota."""
+        with self._lock:
+            limit = self._limits.get(table)
+            if limit is None:
+                return
+            now = time.monotonic()
+            dq = self._hits.setdefault(table, deque())
+            while dq and now - dq[0] > self.window_s:
+                dq.popleft()
+            if len(dq) >= limit * self.window_s:
+                raise QueryQuotaExceededError(
+                    f"table {table} exceeded {limit} qps")
+            dq.append(now)
+
+
+class ResponseStore:
+    """Spooled query results served page-by-page (reference:
+    FsResponseStore + the broker's /resultStore cursor endpoints)."""
+
+    def __init__(self, ttl_s: float = 300.0, max_entries: int = 256):
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._store: dict[str, tuple[float, list, list, list]] = {}
+
+    def create_cursor(self, column_names: list, column_types: list,
+                      rows: list) -> str:
+        cursor_id = uuid.uuid4().hex
+        with self._lock:
+            self._evict_locked()
+            self._store[cursor_id] = (time.monotonic(), column_names,
+                                      column_types, rows)
+        return cursor_id
+
+    def fetch(self, cursor_id: str, offset: int, num_rows: int) -> dict:
+        with self._lock:
+            entry = self._store.get(cursor_id)
+        if entry is None:
+            raise KeyError(f"cursor {cursor_id} not found or expired")
+        _, names, types, rows = entry
+        page = rows[offset:offset + num_rows]
+        return {
+            "resultTable": {
+                "dataSchema": {"columnNames": names, "columnDataTypes": types},
+                "rows": page},
+            "offset": offset,
+            "numRows": len(page),
+            "totalRows": len(rows),
+            "cursorId": cursor_id,
+        }
+
+    def delete(self, cursor_id: str) -> bool:
+        with self._lock:
+            return self._store.pop(cursor_id, None) is not None
+
+    def _evict_locked(self) -> None:
+        now = time.monotonic()
+        dead = [k for k, (t, *_rest) in self._store.items()
+                if now - t > self.ttl_s]
+        for k in dead:
+            del self._store[k]
+        while len(self._store) >= self.max_entries:
+            oldest = min(self._store, key=lambda k: self._store[k][0])
+            del self._store[oldest]

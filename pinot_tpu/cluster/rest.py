@@ -96,11 +96,18 @@ class BrokerRestServer(_RestServer):
         class Handler(_JsonHandler):
             routes_get = [
                 (r"/health", lambda h, m, q: (200, {"status": "OK"})),
+                (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
+                    m.group(1), int(q.get("offset", ["0"])[0]),
+                    int(q.get("numRows", ["1000"])[0]))),
             ]
             routes_post = [
                 (r"/query/sql", lambda h, m, q: srv._query(h._body())),
                 (r"/timeseries/api/v1/query_range",
                  lambda h, m, q: srv._timeseries(h._body())),
+            ]
+            routes_delete = [
+                (r"/resultStore/([^/]+)", lambda h, m, q: (
+                    200, {"deleted": srv.broker.response_store.delete(m.group(1))})),
             ]
 
         self.broker = broker
@@ -111,8 +118,18 @@ class BrokerRestServer(_RestServer):
         sql = body.get("sql")
         if not sql:
             return 400, {"error": "missing 'sql'"}
+        if body.get("getCursor"):
+            out = self.broker.execute_sql_cursor(
+                sql, int(body.get("numRows", 1000)))
+            return (200 if not out.get("exceptions") else 500), out
         resp = self.broker.execute_sql(sql)
         return (200 if not resp.exceptions else 500), resp.to_json()
+
+    def _cursor_fetch(self, cursor_id: str, offset: int, num_rows: int):
+        try:
+            return 200, self.broker.fetch_cursor(cursor_id, offset, num_rows)
+        except KeyError as e:
+            return 404, {"error": str(e)}
 
     def _timeseries(self, body: dict):
         if self.timeseries_engine is None:

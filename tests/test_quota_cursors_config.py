@@ -1,0 +1,156 @@
+"""Query quota, cursors, adaptive selection, and config-system tests."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.cluster.quota import (
+    QueryQuotaExceededError,
+    QueryQuotaManager,
+    ResponseStore,
+)
+from pinot_tpu.cluster.rest import BrokerRestServer
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.env import PinotConfiguration
+
+SCHEMA = Schema.build("q", dimensions=[("k", "INT")], metrics=[("v", "INT")])
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    store = PropertyStore()
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="host")
+    server.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    table = controller.create_table({"tableName": "q", "replication": 1})
+    cols = {"k": np.arange(100, dtype=np.int32),
+            "v": np.arange(100, dtype=np.int32)}
+    SegmentBuilder(SCHEMA, segment_name="q0").build(cols, tmp_path / "q0")
+    controller.add_segment(table, "q0", {"location": str(tmp_path / "q0"),
+                                         "numDocs": 100})
+    yield broker, controller
+    server.stop()
+
+
+def test_qps_quota(stack):
+    broker, _ = stack
+    broker.quota.set_qps_limit("q", 3)
+    results = [broker.execute_sql("SELECT COUNT(*) FROM q") for _ in range(5)]
+    ok = [r for r in results if not r.exceptions]
+    rejected = [r for r in results if r.exceptions]
+    assert len(ok) == 3
+    assert all("QueryQuotaExceededError" in r.exceptions[0] for r in rejected)
+    broker.quota.set_qps_limit("q", None)
+    assert not broker.execute_sql("SELECT COUNT(*) FROM q").exceptions
+
+
+def test_quota_manager_window():
+    qm = QueryQuotaManager(window_s=0.05)
+    qm.set_qps_limit("t", 40)  # 2 hits per 50ms window
+    qm.acquire("t")
+    qm.acquire("t")
+    with pytest.raises(QueryQuotaExceededError):
+        qm.acquire("t")
+    import time
+
+    time.sleep(0.06)
+    qm.acquire("t")  # window slid
+
+
+def test_cursor_pagination(stack):
+    broker, _ = stack
+    page = broker.execute_sql_cursor(
+        "SELECT k FROM q ORDER BY k LIMIT 100", num_rows=30)
+    assert page["totalRows"] == 100
+    assert page["numRows"] == 30
+    assert page["resultTable"]["rows"][0] == [0]
+    cid = page["cursorId"]
+    page2 = broker.fetch_cursor(cid, 30, 30)
+    assert page2["resultTable"]["rows"][0] == [30]
+    last = broker.fetch_cursor(cid, 90, 30)
+    assert last["numRows"] == 10
+    assert broker.response_store.delete(cid)
+    with pytest.raises(KeyError):
+        broker.fetch_cursor(cid, 0, 10)
+
+
+def test_cursor_over_http(stack):
+    broker, _ = stack
+    rest = BrokerRestServer(broker)
+    try:
+        req = urllib.request.Request(
+            rest.url + "/query/sql",
+            data=json.dumps({"sql": "SELECT k FROM q ORDER BY k LIMIT 50",
+                             "getCursor": True, "numRows": 20}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            page = json.loads(r.read())
+        assert page["numRows"] == 20
+        cid = page["cursorId"]
+        with urllib.request.urlopen(
+                rest.url + f"/resultStore/{cid}?offset=20&numRows=20") as r:
+            page2 = json.loads(r.read())
+        assert page2["resultTable"]["rows"][0] == [20]
+        req = urllib.request.Request(rest.url + f"/resultStore/{cid}",
+                                     method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["deleted"]
+    finally:
+        rest.close()
+
+
+def test_response_store_eviction():
+    rs = ResponseStore(ttl_s=1000, max_entries=3)
+    ids = [rs.create_cursor(["a"], ["LONG"], [[i]]) for i in range(4)]
+    with pytest.raises(KeyError):
+        rs.fetch(ids[0], 0, 1)  # evicted (oldest)
+    assert rs.fetch(ids[3], 0, 1)["resultTable"]["rows"] == [[3]]
+
+
+def test_adaptive_selection_prefers_fast_server(stack):
+    broker, _ = stack
+    from pinot_tpu.cluster.broker import _ServerStats
+
+    slow = _ServerStats()
+    slow.record(500.0)
+    fast = _ServerStats()
+    fast.record(5.0)
+    broker._server_stats = {"Server_A": slow, "Server_B": fast}
+    plan = broker._select_instances({"seg1": ["Server_A", "Server_B"]})
+    assert list(plan) == ["Server_B"]
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_pinot_configuration_layering(tmp_path, monkeypatch):
+    f1 = tmp_path / "a.properties"
+    f1.write_text("server.port=1234\nshared.key=file1\n# comment\n")
+    f2 = tmp_path / "b.properties"
+    f2.write_text("shared.key=file2\n")
+    monkeypatch.setenv("PINOT_TPU_SERVER_TIMEOUT_MS", "9000")
+    cfg = PinotConfiguration(
+        properties={"override.key": True},
+        config_paths=[str(f1), str(f2)])
+    assert cfg.get_int("server.port") == 1234
+    assert cfg.get("shared.key") == "file2"  # later file wins
+    assert cfg.get_int("server.timeout.ms") == 9000  # env var
+    assert cfg.get_bool("override.key")
+    sub = cfg.subset("server")
+    assert sub.get_int("port") == 1234
+    assert sub.get("shared.key") is None
+
+
+def test_pinot_configuration_types():
+    cfg = PinotConfiguration({"a": "true", "b": "3.5", "c": "7"}, use_env=False)
+    assert cfg.get_bool("a") and cfg.get_float("b") == 3.5 and cfg.get_int("c") == 7
+    assert cfg.get_bool("missing", True)
+    assert cfg.keys() == ["a", "b", "c"]
