@@ -81,6 +81,21 @@ def eval_expr(e: EC, block: Block, n: Optional[int] = None):
                     break
                 out = np.where(mask, v, out)
             return out
+        # recurse through composite ops so the predicate forms above are
+        # reachable at ANY depth (e.g. `x > 5 OR y IN (...)`)
+        from ..query.transforms import NP_BIN, NP_UN
+
+        if name in NP_BIN:
+            return NP_BIN[name](eval_expr(args[0], block, n),
+                                eval_expr(args[1], block, n))
+        if name in NP_UN:
+            return NP_UN[name](eval_expr(args[0], block, n))
+        if name == "case":
+            out = eval_expr(args[-1], block, n)
+            for i in range(len(args) - 3, -1, -2):
+                cond = np.asarray(eval_expr(args[i], block, n)).astype(bool)
+                out = np.where(cond, eval_expr(args[i + 1], block, n), out)
+            return out
     return eval_expr_np(e, lambda name: _resolve_col(block, name))
 
 
@@ -179,8 +194,30 @@ def _factorize(a: np.ndarray) -> tuple[np.ndarray, int]:
         if r is not None:
             codes, uniques = r
             return codes, len(uniques)
+    if a.dtype.kind == "O":
+        # object columns may hold SQL NULLs (None / NaN from outer joins):
+        # np.unique cannot order mixed None/str — dict-encode instead.
+        # All NULLs land in one group (SQL GROUP BY null semantics).
+        table: dict = {}
+        codes = np.empty(len(a), dtype=np.int64)
+        null_code = -1
+        for i, v in enumerate(a):
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                if null_code < 0:
+                    null_code = len(table)
+                    table[_NULL_KEY] = null_code
+                codes[i] = null_code
+                continue
+            c = table.get(v)
+            if c is None:
+                c = table[v] = len(table)
+            codes[i] = c
+        return codes, len(table)
     _, inv = np.unique(a, return_inverse=True)
     return inv.astype(np.int64), int(inv.max(initial=-1)) + 1
+
+
+_NULL_KEY = object()  # sentinel: the NULL group in object factorize
 
 
 # -- aggregate ---------------------------------------------------------------

@@ -1,0 +1,296 @@
+"""Randomized SQL fuzzing against a sqlite oracle.
+
+Reference pattern: QueryGenerator (pinot-integration-test-base/.../
+QueryGenerator.java) produces randomized SQL executed against both the
+cluster and an H2 in-memory database via
+ClusterIntegrationTestUtils.testQueries. Here: both engines (V1
+single-stage and MSE) vs sqlite3, seeded for reproducibility.
+"""
+
+from __future__ import annotations
+
+import math
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+N = 800
+CITIES = ["sf", "ny", "la", "chi", "sea", "aus", "bos", "den"]
+STATUSES = ["open", "closed", "pending"]
+
+SCHEMA = Schema.build(
+    "fz",
+    dimensions=[("city", "STRING"), ("status", "STRING"), ("code", "INT")],
+    metrics=[("amount", "INT"), ("score", "DOUBLE")])
+
+DIM_SCHEMA = Schema.build(
+    "fzdim", dimensions=[("dcode", "INT"), ("region", "STRING")])
+
+
+def _gen_data(rng):
+    return {
+        "city": np.asarray(CITIES, dtype=object)[rng.integers(0, len(CITIES), N)],
+        "status": np.asarray(STATUSES, dtype=object)[
+            rng.integers(0, len(STATUSES), N)],
+        "code": rng.integers(0, 40, N).astype(np.int32),
+        "amount": rng.integers(-50, 1000, N).astype(np.int32),
+        "score": np.round(rng.random(N) * 100, 3),
+    }
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    rng = np.random.default_rng(20260729)
+    d = tmp_path_factory.mktemp("fuzz")
+    data = _gen_data(rng)
+    half = N // 2
+    for i, sl in enumerate([slice(0, half), slice(half, N)]):
+        SegmentBuilder(SCHEMA, segment_name=f"fz_{i}").build(
+            {k: v[sl] for k, v in data.items()}, d / f"s{i}")
+    dim = {"dcode": np.arange(0, 30, dtype=np.int32),
+           "region": np.asarray([["west", "east", "south"][i % 3]
+                                 for i in range(30)], dtype=object)}
+    SegmentBuilder(DIM_SCHEMA, segment_name="dim0").build(dim, d / "dim")
+
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, [load_segment(d / "s0"), load_segment(d / "s1")])
+    qe.add_table(DIM_SCHEMA, [load_segment(d / "dim")])
+
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE fz (city TEXT, status TEXT, code INT, "
+                 "amount INT, score REAL)")
+    conn.execute("CREATE TABLE fzdim (dcode INT, region TEXT)")
+    for i in range(N):
+        conn.execute("INSERT INTO fz VALUES (?,?,?,?,?)",
+                     (data["city"][i], data["status"][i], int(data["code"][i]),
+                      int(data["amount"][i]), float(data["score"][i])))
+    for i in range(30):
+        conn.execute("INSERT INTO fzdim VALUES (?,?)",
+                     (int(dim["dcode"][i]), dim["region"][i]))
+    return qe, conn
+
+
+# -- generator ---------------------------------------------------------------
+
+NUM_COLS = ["code", "amount", "score"]
+STR_COLS = ["city", "status"]
+AGGS = ["SUM", "COUNT", "MIN", "MAX", "AVG"]
+
+
+def _pred(rng, p: str = "") -> str:
+    kind = rng.integers(0, 6)
+    if kind == 0:
+        return f"{p}{rng.choice(STR_COLS)} = '{rng.choice(CITIES + STATUSES)}'"
+    if kind == 1:
+        return f"{p}{rng.choice(STR_COLS)} <> '{rng.choice(CITIES + STATUSES)}'"
+    if kind == 2:
+        col = rng.choice(NUM_COLS)
+        return f"{p}{col} {rng.choice(['<', '>', '<=', '>='])} {rng.integers(-20, 500)}"
+    if kind == 3:
+        col = rng.choice(NUM_COLS)
+        lo = int(rng.integers(-20, 200))
+        return f"{p}{col} BETWEEN {lo} AND {lo + int(rng.integers(1, 300))}"
+    if kind == 4:
+        vals = ", ".join(f"'{v}'" for v in
+                         rng.choice(CITIES, size=3, replace=False))
+        return f"{p}city IN ({vals})"
+    return f"{p}code = {rng.integers(0, 40)}"
+
+
+def _where(rng, prefix: str = "") -> str:
+    n = int(rng.integers(0, 3))
+    if n == 0:
+        return ""
+    parts = [_pred(rng, prefix) for _ in range(n)]
+    joiner = " AND " if rng.random() < 0.7 else " OR "
+    return " WHERE " + joiner.join(parts)
+
+
+def _agg_expr(rng) -> tuple[str, str]:
+    """(engine expr, oracle expr). The oracle side encodes the reference's
+    empty-group conventions (no null-handling mode): SUM()=0, MIN()=+inf,
+    MAX()=-inf — Pinot's documented defaults, unlike standard SQL NULL."""
+    fn = rng.choice(AGGS)
+    if fn == "COUNT":
+        return "COUNT(*)", "COUNT(*)"
+    col = rng.choice(NUM_COLS)
+    e = f"{fn}({col})"
+    if fn == "SUM":
+        return e, f"COALESCE(SUM({col}), 0.0)"
+    if fn == "MIN":
+        return e, f"COALESCE(MIN({col}), 9e999)"
+    if fn == "MAX":
+        return e, f"COALESCE(MAX({col}), -9e999)"
+    return e, e
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, float):
+        if math.isnan(v):
+            return None
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        return v
+    if isinstance(v, (int, np.integer)):
+        return float(v)
+    return v
+
+
+def _sort_key(row):
+    # coarse, type-ranked key so FP jitter at rounding boundaries cannot
+    # reorder rows and mixed None/str/float columns stay comparable
+    out = []
+    for v in row:
+        if v is None:
+            out.append((0, ""))
+        elif isinstance(v, float):
+            out.append((1, round(v, 2)))
+        else:
+            out.append((2, str(v)))
+    return tuple(out)
+
+
+def _rows_equal(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if not math.isclose(va, vb, rel_tol=1e-9, abs_tol=1e-6):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def _check(qe, oracle, sql, oracle_sql=None):
+    resp = qe.execute_sql(sql)
+    assert not resp.exceptions, f"{sql}\n→ {resp.exceptions}"
+    got = sorted([tuple(_norm(v) for v in row)
+                  for row in resp.result_table.rows], key=_sort_key)
+    want = sorted([tuple(_norm(v) for v in row)
+                   for row in oracle.execute(oracle_sql or sql).fetchall()],
+                  key=_sort_key)
+    assert _rows_equal(got, want), f"{sql}\ngot:  {got[:6]}…\nwant: {want[:6]}…"
+
+
+# -- fuzz classes ------------------------------------------------------------
+
+
+def test_fuzz_aggregations(env):
+    qe, oracle = env
+    rng = np.random.default_rng(1)
+    for _ in range(60):
+        pairs = [_agg_expr(rng) for _ in range(int(rng.integers(1, 4)))]
+        w = _where(rng)
+        sql = f"SELECT {', '.join(p[0] for p in pairs)} FROM fz{w}"
+        oracle_sql = f"SELECT {', '.join(p[1] for p in pairs)} FROM fz{w}"
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_group_by(env):
+    qe, oracle = env
+    rng = np.random.default_rng(2)
+    for _ in range(60):
+        n_dims = int(rng.integers(1, 3))
+        dims = list(rng.choice(STR_COLS + ["code"], size=n_dims, replace=False))
+        pairs = [_agg_expr(rng) for _ in range(int(rng.integers(1, 3)))]
+        w = _where(rng)
+        group = f" GROUP BY {', '.join(dims)}"
+        sql = (f"SELECT {', '.join(dims + [p[0] for p in pairs])} FROM fz{w}"
+               f"{group} LIMIT 5000")
+        oracle_sql = (f"SELECT {', '.join(dims + [p[1] for p in pairs])} "
+                      f"FROM fz{w}{group}")
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_selections(env):
+    qe, oracle = env
+    rng = np.random.default_rng(3)
+    for _ in range(40):
+        cols = list(rng.choice(STR_COLS + NUM_COLS,
+                               size=int(rng.integers(1, 4)), replace=False))
+        sql = f"SELECT {', '.join(cols)} FROM fz{_where(rng)} LIMIT 5000"
+        oracle_sql = sql.replace(" LIMIT 5000", "")
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_order_by_with_tiebreak(env):
+    qe, oracle = env
+    rng = np.random.default_rng(4)
+    for _ in range(30):
+        col = rng.choice(NUM_COLS)
+        direction = rng.choice(["ASC", "DESC"])
+        # score is (almost surely) unique → deterministic total order
+        sql = (f"SELECT score, {col} FROM fz{_where(rng)} "
+               f"ORDER BY score {direction} LIMIT 20")
+        resp = qe.execute_sql(sql)
+        assert not resp.exceptions, resp.exceptions
+        got = [tuple(_norm(v) for v in r) for r in resp.result_table.rows]
+        want = [tuple(_norm(v) for v in r)
+                for r in oracle.execute(sql).fetchall()]
+        assert got == want, sql
+
+
+def test_fuzz_having(env):
+    qe, oracle = env
+    rng = np.random.default_rng(5)
+    for _ in range(30):
+        dim = rng.choice(STR_COLS)
+        agg, oagg = _agg_expr(rng)
+        thresh = int(rng.integers(0, 50_000))
+        w = _where(rng)
+        sql = (f"SELECT {dim}, {agg} FROM fz{w} GROUP BY {dim} "
+               f"HAVING {agg} > {thresh} LIMIT 5000")
+        oracle_sql = (f"SELECT {dim}, {oagg} FROM fz{w} GROUP BY {dim} "
+                      f"HAVING {oagg} > {thresh}")
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_joins_mse(env):
+    qe, oracle = env
+    rng = np.random.default_rng(6)
+    for _ in range(30):
+        jt = rng.choice(["JOIN", "LEFT JOIN"])
+        agg = rng.random() < 0.5
+        where = _where(rng, prefix="a.")
+        if agg:
+            sql = (f"SELECT b.region, SUM(a.amount) FROM fz a {jt} fzdim b "
+                   f"ON a.code = b.dcode{where} GROUP BY b.region LIMIT 5000")
+        else:
+            sql = (f"SELECT a.city, b.region FROM fz a {jt} fzdim b "
+                   f"ON a.code = b.dcode{where} LIMIT 5000")
+        oracle_sql = sql.replace(" LIMIT 5000", "")
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_tpu_vs_host_parity(env, tmp_path_factory):
+    """The device engine must agree with the host engine query-for-query
+    (the CPU-vs-TPU differential harness, SURVEY.md §4.2)."""
+    qe_host, _ = env
+    qe_tpu = QueryExecutor(backend="auto")
+    for name, t in qe_host.tables.items():
+        qe_tpu.add_table(t.schema, t.segments, name=name)
+    rng = np.random.default_rng(7)
+    for _ in range(40):
+        dims = list(rng.choice(STR_COLS + ["code"],
+                               size=int(rng.integers(1, 3)), replace=False))
+        aggs = [_agg_expr(rng)[0] for _ in range(int(rng.integers(1, 3)))]
+        sql = (f"SELECT {', '.join(dims + aggs)} FROM fz{_where(rng)} "
+               f"GROUP BY {', '.join(dims)} LIMIT 5000")
+        a = qe_host.execute_sql(sql)
+        b = qe_tpu.execute_sql(sql)
+        assert not a.exceptions and not b.exceptions, (sql, a.exceptions, b.exceptions)
+        ga = sorted([tuple(_norm(v) for v in r) for r in a.result_table.rows],
+                    key=_sort_key)
+        gb = sorted([tuple(_norm(v) for v in r) for r in b.result_table.rows],
+                    key=_sort_key)
+        assert _rows_equal(ga, gb), sql
