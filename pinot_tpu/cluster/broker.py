@@ -105,8 +105,16 @@ class Broker:
         view = self.store.get(f"/EXTERNALVIEW/{name_with_type}") or {}
         ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
         live = set(self.store.children("/LIVEINSTANCES"))
+        # lineage: in-flight replacement targets are not routable yet
+        # (reference: lineage-based segment selection at the broker)
+        hidden = set()
+        for entry in (self.store.get(f"/LINEAGE/{name_with_type}") or {}).values():
+            if entry.get("state") == "IN_PROGRESS":
+                hidden |= set(entry.get("to", []))
         out = {}
         for seg in ideal:
+            if seg in hidden:
+                continue
             insts = [i for i, st in (view.get(seg) or {}).items()
                      if st == ONLINE and i in live]
             out[seg] = sorted(insts)

@@ -1,0 +1,291 @@
+"""Controller periodic tasks + segment lineage + tier relocation.
+
+Reference analogues (SURVEY.md §2.6):
+- ControllerPeriodicTask framework + the scheduled jobs wired in
+  BaseControllerStarter.java:865-896 (RetentionManager,
+  SegmentStatusChecker, RebalanceChecker, SegmentRelocator).
+- Segment lineage for atomic replacement
+  (pinot-controller/.../helix/core/lineage/ — startReplaceSegments/
+  endReplaceSegments; brokers exclude in-flight segments from routing).
+- Tier configs moving aged segments onto differently-tagged servers
+  (SegmentRelocator + TierConfig).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .controller import ONLINE, ClusterController
+from .store import PropertyStore
+
+
+# -- periodic task framework -------------------------------------------------
+
+
+@dataclass
+class PeriodicTask:
+    name: str
+    interval_s: float
+    fn: Callable[[], object]
+    last_run: float = 0.0
+    runs: int = 0
+    last_result: object = None
+    last_error: Optional[str] = None
+
+
+class ControllerPeriodicTaskScheduler:
+    """Fixed-interval controller jobs on one background thread (reference:
+    ControllerPeriodicTask + PeriodicTaskScheduler)."""
+
+    def __init__(self, tick_s: float = 0.05):
+        self.tick_s = tick_s
+        self.tasks: dict[str, PeriodicTask] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, name: str, interval_s: float, fn: Callable) -> None:
+        self.tasks[name] = PeriodicTask(name, interval_s, fn)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="controller-periodic")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(10)
+
+    def run_once(self, name: Optional[str] = None) -> dict:
+        """Synchronous trigger (tests + admin endpoint; reference:
+        /periodictask/run)."""
+        out = {}
+        for t in self.tasks.values():
+            if name is not None and t.name != name:
+                continue
+            self._run(t)
+            out[t.name] = t.last_result if t.last_error is None else t.last_error
+        return out
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            now = time.monotonic()
+            for t in self.tasks.values():
+                if now - t.last_run >= t.interval_s:
+                    self._run(t)
+
+    def _run(self, t: PeriodicTask) -> None:
+        t.last_run = time.monotonic()
+        t.runs += 1
+        try:
+            t.last_result = t.fn()
+            t.last_error = None
+        except Exception as e:  # periodic tasks must not kill the loop
+            t.last_error = f"{type(e).__name__}: {e}"
+
+
+# -- built-in controller jobs ------------------------------------------------
+
+
+class SegmentStatusChecker:
+    """Counts segments/replicas per table, flags ideal-vs-external drift;
+    writes /STATS/{table} (reference: SegmentStatusChecker metrics:
+    nonServingSegments, replicationFromConfig...)."""
+
+    def __init__(self, store: PropertyStore, controller: ClusterController):
+        self.store = store
+        self.controller = controller
+
+    def __call__(self) -> dict:
+        report = {}
+        for table in self.store.children("/IDEALSTATES"):
+            ideal = self.store.get(f"/IDEALSTATES/{table}") or {}
+            view = self.store.get(f"/EXTERNALVIEW/{table}") or {}
+            missing = []
+            under_replicated = []
+            for seg, want in ideal.items():
+                have = {i for i, st in (view.get(seg) or {}).items()
+                        if st == ONLINE}
+                if not have:
+                    missing.append(seg)
+                elif len(have) < len(want):
+                    under_replicated.append(seg)
+            stats = {
+                "numSegments": len(ideal),
+                "nonServingSegments": missing,
+                "underReplicatedSegments": under_replicated,
+                "checkedAtMs": int(time.time() * 1000),
+            }
+            self.store.set(f"/STATS/{table}", stats)
+            report[table] = stats
+        return report
+
+
+class RebalanceChecker:
+    """Re-runs rebalance for tables whose replication is not satisfiable
+    from the ideal state (reference: RebalanceChecker retrying stuck
+    rebalances)."""
+
+    def __init__(self, controller: ClusterController):
+        self.controller = controller
+
+    def __call__(self) -> dict:
+        fixed = {}
+        live = set(self.controller.live_instances())
+        for table in self.controller.store.children("/CONFIGS/TABLE"):
+            cfg = self.controller.table_config(table) or {}
+            replication = int(cfg.get("replication", 1))
+            ideal = self.controller.store.get(f"/IDEALSTATES/{table}") or {}
+            broken = any(
+                len([i for i in m if i in live]) < replication
+                for m in ideal.values())
+            if broken and len(live) >= replication:
+                fixed[table] = self.controller.rebalance(table)["moves"]
+        return fixed
+
+
+# -- segment lineage (atomic replacement) ------------------------------------
+
+
+class SegmentLineageManager:
+    """start/end/revert replace-segments protocol. While IN_PROGRESS the
+    broker must route the FROM set and ignore the TO set; on end the swap
+    commits atomically in the ideal state (reference:
+    SegmentLineageAccessHelper + PinotHelixResourceManager
+    startReplaceSegments/endReplaceSegments)."""
+
+    def __init__(self, store: PropertyStore, controller: ClusterController):
+        self.store = store
+        self.controller = controller
+
+    def start_replace(self, table: str, from_segments: list[str],
+                      to_segments: list[str]) -> str:
+        lineage_id = uuid.uuid4().hex[:12]
+        self.store.update(f"/LINEAGE/{table}", lambda cur: {
+            **(cur or {}),
+            lineage_id: {"state": "IN_PROGRESS", "from": from_segments,
+                         "to": to_segments,
+                         "tsMs": int(time.time() * 1000)}})
+        return lineage_id
+
+    def end_replace(self, table: str, lineage_id: str) -> None:
+        entry = (self.store.get(f"/LINEAGE/{table}") or {}).get(lineage_id)
+        if entry is None or entry["state"] != "IN_PROGRESS":
+            raise KeyError(f"lineage {lineage_id} not in progress")
+        # atomic swap: new segments live, old segments dropped
+        def upd(ideal):
+            ideal = ideal or {}
+            for seg in entry["from"]:
+                ideal.pop(seg, None)
+            return ideal
+
+        self.store.update(f"/IDEALSTATES/{table}", upd)
+        for seg in entry["from"]:
+            self.store.delete(f"/SEGMENTS/{table}/{seg}")
+        self.store.update(f"/LINEAGE/{table}", lambda cur: {
+            **(cur or {}), lineage_id: {**entry, "state": "COMPLETED"}})
+
+    def revert_replace(self, table: str, lineage_id: str) -> None:
+        entry = (self.store.get(f"/LINEAGE/{table}") or {}).get(lineage_id)
+        if entry is None or entry["state"] != "IN_PROGRESS":
+            raise KeyError(f"lineage {lineage_id} not in progress")
+        def upd(ideal):
+            ideal = ideal or {}
+            for seg in entry["to"]:
+                ideal.pop(seg, None)
+            return ideal
+
+        self.store.update(f"/IDEALSTATES/{table}", upd)
+        for seg in entry["to"]:
+            self.store.delete(f"/SEGMENTS/{table}/{seg}")
+        self.store.update(f"/LINEAGE/{table}", lambda cur: {
+            **(cur or {}), lineage_id: {**entry, "state": "REVERTED"}})
+
+    def routable_segments(self, table: str, all_segments: set) -> set:
+        """Filter by lineage: while IN_PROGRESS serve FROM, hide TO
+        (reference: the broker's lineage-based segment selection)."""
+        lineage = self.store.get(f"/LINEAGE/{table}") or {}
+        out = set(all_segments)
+        for entry in lineage.values():
+            if entry["state"] == "IN_PROGRESS":
+                out -= set(entry["to"])
+        return out
+
+
+# -- tier relocation ---------------------------------------------------------
+
+
+class SegmentRelocator:
+    """Moves aged segments onto their tier's servers (reference:
+    SegmentRelocator + TierConfig: segmentAge-based tier selection).
+    Table config: tierConfigs: [{"name", "segmentAgeMs", "serverTag"}],
+    most-specific (oldest threshold) tier wins."""
+
+    def __init__(self, controller: ClusterController):
+        self.controller = controller
+
+    def __call__(self) -> dict:
+        moves = {}
+        now = int(time.time() * 1000)
+        for table in self.controller.store.children("/CONFIGS/TABLE"):
+            cfg = self.controller.table_config(table) or {}
+            tiers = cfg.get("tierConfigs") or []
+            if not tiers:
+                continue
+            tiers = sorted(tiers, key=lambda t: -int(t["segmentAgeMs"]))
+            moved = self._relocate_table(table, cfg, tiers, now)
+            if moved:
+                moves[table] = moved
+        return moves
+
+    def _relocate_table(self, table: str, cfg: dict, tiers: list,
+                        now: int) -> list:
+        store = self.controller.store
+        live = set(self.controller.live_instances())
+        moved = []
+        for seg in store.children(f"/SEGMENTS/{table}"):
+            meta = store.get(f"/SEGMENTS/{table}/{seg}") or {}
+            end = meta.get("endTimeMs") or meta.get("pushTimeMs")
+            if end is None:
+                continue
+            age = now - int(end)
+            tier = next((t for t in tiers if age >= int(t["segmentAgeMs"])), None)
+            if tier is None:
+                continue
+            targets = [i for i in self.controller.list_instances(tier["serverTag"])
+                       if i in live]
+            if not targets:
+                continue
+            replication = int(cfg.get("replication", 1))
+            want = sorted(targets)[:replication]
+
+            def upd(ideal, _seg=seg, _want=want):
+                ideal = ideal or {}
+                cur = ideal.get(_seg, {})
+                if set(cur) != set(_want):
+                    ideal[_seg] = {i: ONLINE for i in _want}
+                return ideal
+
+            before = store.get(f"/IDEALSTATES/{table}") or {}
+            store.update(f"/IDEALSTATES/{table}", upd)
+            after = store.get(f"/IDEALSTATES/{table}") or {}
+            if before.get(seg) != after.get(seg):
+                moved.append((seg, tier["name"]))
+        return moved
+
+
+def build_default_scheduler(store: PropertyStore, controller: ClusterController,
+                            interval_s: float = 10.0) -> ControllerPeriodicTaskScheduler:
+    """The standard job set (reference BaseControllerStarter wiring)."""
+    sched = ControllerPeriodicTaskScheduler()
+    sched.register("RetentionManager", interval_s,
+                   lambda: controller.run_retention())
+    sched.register("SegmentStatusChecker", interval_s,
+                   SegmentStatusChecker(store, controller))
+    sched.register("RebalanceChecker", interval_s, RebalanceChecker(controller))
+    sched.register("SegmentRelocator", interval_s, SegmentRelocator(controller))
+    return sched
