@@ -38,9 +38,11 @@ class TpuSegmentExecutor:
 
     def execute_plan(self, query: QueryContext, segment: ImmutableSegment, plan: SegmentPlan):
         view = self.cache.view(segment)
-        arrays = plan.gather_arrays(view)
+        arrays, packed = plan.gather_arrays_packed(view)
         params = tuple(jnp.asarray(p) for p in plan.params)
-        outs = run_program(plan.program, arrays, params, jnp.int32(segment.num_docs), view.padded)
+        outs = run_program(plan.program, arrays, params,
+                           jnp.int32(segment.num_docs), view.padded,
+                           packed=packed)
         outs = [np.asarray(o) for o in outs]
         mode = plan.program.mode
         if mode == "selection":

@@ -83,10 +83,23 @@ class SegmentPlan:
     selection_exprs: dict = field(default_factory=dict)  # label → transform expr
 
     def gather_arrays(self, view: SegmentDeviceView) -> tuple:
+        return self.gather_arrays_packed(view, allow_packed=False)[0]
+
+    def gather_arrays_packed(self, view: SegmentDeviceView,
+                             allow_packed: bool = True):
+        """(arrays, packed) where packed lists (slot, bits) for id planes
+        kept packed in HBM — decoded in-kernel (ops/kernels._apply_packed)."""
         out = []
-        for column, kind in self.slots:
+        packed = []
+        for i, (column, kind) in enumerate(self.slots):
             if kind == "ids":
-                out.append(view.dict_ids(column))
+                if allow_packed:
+                    plane, bits = view.dict_ids_packed(column)
+                    out.append(plane)
+                    if bits:
+                        packed.append((i, bits))
+                else:
+                    out.append(view.dict_ids(column))
             elif kind == "mvids":
                 out.append(view.mv_dict_ids(column))
             elif kind == "raw":
@@ -97,7 +110,7 @@ class SegmentPlan:
                 out.append(view.null_plane(column))
             else:  # pragma: no cover
                 raise ValueError(kind)
-        return tuple(out)
+        return tuple(out), tuple(packed)
 
 
 class SegmentPlanner(AggPlanContext):

@@ -24,6 +24,16 @@ from .loader import ImmutableSegment
 _MIN_PAD = 1 << 13
 
 
+def packed_hbm_enabled() -> bool:
+    """Packed id planes default ON for the TPU backend (bandwidth-bound:
+    reading bits/32 of the bytes beats the in-register decode cost) and OFF
+    on CPU; PINOT_TPU_PACKED_HBM=0/1 overrides."""
+    env = os.environ.get("PINOT_TPU_PACKED_HBM")
+    if env is not None:
+        return env not in ("0", "false", "")
+    return jax.default_backend() not in ("cpu",)
+
+
 def pad_bucket(n: int) -> int:
     """Next power of two ≥ n (min 8192) — the kernel shape bucket."""
     b = _MIN_PAD
@@ -41,6 +51,8 @@ class SegmentDeviceView:
         self.device = device
         self.padded = pad_bucket(max(1, segment.num_docs))
         self._planes: dict[tuple[str, str], jnp.ndarray] = {}
+        # (column,"ids") → bits for planes kept packed/narrow in HBM
+        self.packed_bits: dict[tuple[str, str], int] = {}
 
     def _put(self, key: tuple[str, str], host: np.ndarray) -> jnp.ndarray:
         if key not in self._planes:
@@ -62,6 +74,35 @@ class SegmentDeviceView:
             out[: ids.shape[0]] = ids
             self._put(key, out)
         return self._planes[key]
+
+    def dict_ids_packed(self, column: str):
+        """(plane, bits) with the id plane kept packed/narrow in HBM —
+        bits/32 of the int32 residency AND read bandwidth; the kernel
+        decodes in-register (ops/kernels._unpack_ids_u32). Falls back to
+        the plain plane (bits=0) for MV columns / full-width ids."""
+        m = self.segment.column_metadata(column)
+        bits = getattr(m, "bits_per_value", 32) or 32
+        if not m.single_value or bits >= 32 or not packed_hbm_enabled():
+            return self.dict_ids(column), 0
+        key = (column, "ids_packed")  # distinct from the plain plane key
+        if key not in self._planes:
+            raw = np.frombuffer(self.segment._buffer(f"{column}.fwd"),
+                                dtype=np.uint8)
+            if bits == 8:
+                out = np.zeros(self.padded, dtype=np.uint8)
+                out[: self.segment.num_docs] = raw[: self.segment.num_docs]
+            elif bits == 16:
+                vals = raw.view(np.uint16)
+                out = np.zeros(self.padded, dtype=np.uint16)
+                out[: self.segment.num_docs] = vals[: self.segment.num_docs]
+            else:
+                nbytes = self.padded * bits // 8  # padded is a power of two ≥ 32
+                out8 = np.zeros(nbytes, dtype=np.uint8)
+                out8[: min(len(raw), nbytes)] = raw[: min(len(raw), nbytes)]
+                out = out8.view(np.uint32)
+            self._put(key, out)
+            self.packed_bits[key] = bits
+        return self._planes[key], self.packed_bits.get(key, 0)
 
     def mv_dict_ids(self, column: str) -> jnp.ndarray:
         key = (column, "mvids")
