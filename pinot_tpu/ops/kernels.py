@@ -148,38 +148,17 @@ def _eval_filter(node: ir.FilterNode, arrays, params, n: int):
     raise TypeError(f"unknown filter node {node}")
 
 
-def _unpack_ids_u32(words, bits: int, padded: int):
-    """Device-side fixed-bit decode: LSB-first bitstream (uint32 words) →
-    int32 id plane. 32 values consume exactly `bits` words, so the decode is
-    32 static shift/or/mask lanes over a (padded/32, bits) reshape — pure
-    VPU work that XLA fuses into the consuming program. Keeping planes
-    packed in HBM cuts id-plane residency AND read bandwidth by bits/32
-    (the †2.9-1 FixedBitIntReader equivalent, executed on device)."""
-    group = padded // 32
-    w = words.reshape(group, bits)
-    mask = jnp.uint32((1 << bits) - 1)
-    lanes = []
-    for j in range(32):
-        bit = j * bits
-        k, off = bit // 32, bit % 32
-        v = w[:, k] >> jnp.uint32(off)
-        if off + bits > 32:
-            v = v | (w[:, k + 1] << jnp.uint32(32 - off))
-        lanes.append(v & mask)
-    return jnp.stack(lanes, axis=1).reshape(padded).astype(jnp.int32)
-
-
 def _apply_packed(arrays: tuple, packed: tuple, padded: int) -> tuple:
-    """Decode packed slots: (slot, bits) with bits 8/16 = narrow planes
-    (plain widen), other widths = bitstream decode."""
+    """Widen narrow (uint8/uint16) id planes to int32 in-register. A
+    sub-byte bitstream decode was tried and measured ~1000x slower on TPU
+    than this astype (the 32-lane stack/reshape forces lane relayouts), so
+    byte-aligned narrow planes are the TPU-correct HBM packing — 4x/2x less
+    residency and read bandwidth, decode fused for free."""
     if not packed:
         return arrays
     out = list(arrays)
-    for slot, bits in packed:
-        if bits in (8, 16):
-            out[slot] = out[slot].astype(jnp.int32)
-        else:
-            out[slot] = _unpack_ids_u32(out[slot], bits, padded)
+    for slot, _width in packed:
+        out[slot] = out[slot].astype(jnp.int32)
     return tuple(out)
 
 

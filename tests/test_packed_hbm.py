@@ -1,8 +1,8 @@
-"""Packed-HBM id planes: device-side fixed-bit decode parity.
+"""Narrow-HBM id planes: uint8/uint16 residency with in-kernel widening.
 
-Reference analogue (§2.9-1): FixedBitIntReader's unrolled unpack — executed
-here ON DEVICE so id planes stay packed in HBM (bits/32 of the residency
-and read bandwidth)."""
+Reference analogue (§2.9-1): FixedBitIntReader — here the decode is a free
+fused astype because byte-aligned narrow planes are the TPU-correct packing
+(bitstream decode forces lane relayouts and measured ~1000x slower)."""
 
 from __future__ import annotations
 
@@ -21,23 +21,17 @@ def force_packed(monkeypatch):
     monkeypatch.setenv("PINOT_TPU_PACKED_HBM", "1")
 
 
-@pytest.mark.parametrize("bits", [1, 3, 7, 8, 11, 16, 17, 23, 31])
-def test_device_unpack_parity(bits):
+@pytest.mark.parametrize("width", [8, 16])
+def test_narrow_plane_widening(width):
     import jax.numpy as jnp
 
-    from pinot_tpu.ops.kernels import _unpack_ids_u32
+    from pinot_tpu.ops.kernels import _apply_packed
 
-    rng = np.random.default_rng(bits)
-    padded = 8192
-    vals = rng.integers(0, np.uint64(1) << bits, padded,
-                        dtype=np.uint64).astype(np.uint32)
-    packed = bitpack.pack(vals, bits)
-    nbytes = padded * bits // 8
-    buf = np.zeros(nbytes, dtype=np.uint8)
-    buf[: len(packed)] = packed[:nbytes]
-    out = np.asarray(_unpack_ids_u32(jnp.asarray(buf.view(np.uint32)),
-                                     bits, padded))
-    np.testing.assert_array_equal(out, vals.astype(np.int32))
+    rng = np.random.default_rng(width)
+    vals = rng.integers(0, 1 << width, 8192).astype(
+        np.uint8 if width == 8 else np.uint16)
+    out = _apply_packed((jnp.asarray(vals),), ((0, width),), 8192)[0]
+    np.testing.assert_array_equal(np.asarray(out), vals.astype(np.int32))
 
 
 @pytest.mark.parametrize("card", [2, 6, 200, 40_000, 70_000])
@@ -69,16 +63,15 @@ def test_query_parity_packed_vs_host(card, tmp_path):
 
 
 def test_hbm_residency_reduced(tmp_path):
-    """17-bit ids in packed form must occupy ~17/32 of the int32 plane."""
+    """Low-cardinality ids must occupy 1/4 (uint8) of the int32 plane."""
     from pinot_tpu.segment.device_cache import SegmentDeviceView
 
-    n = 70_000  # distinct values > 2^16 → 17-bit ids
+    n = 50_000
     schema = Schema.build("r", dimensions=[("d", "INT")])
     SegmentBuilder(schema, segment_name="r0").build(
-        {"d": np.arange(n, dtype=np.int64)}, tmp_path / "r0")
+        {"d": (np.arange(n) % 100).astype(np.int64)}, tmp_path / "r0")
     seg = load_segment(tmp_path / "r0")
     view = SegmentDeviceView(seg)
-    plane, bits = view.dict_ids_packed("d")
-    assert bits == 17
-    full = view.padded * 4  # int32 plane bytes
-    assert plane.nbytes <= full * 17 / 32 + 64
+    plane, width = view.dict_ids_packed("d")
+    assert width == 8  # 100 distinct values → 7 bits → uint8 plane
+    assert plane.nbytes == view.padded  # 1 byte/doc vs 4
