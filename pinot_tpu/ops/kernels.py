@@ -148,12 +148,13 @@ def _eval_filter(node: ir.FilterNode, arrays, params, n: int):
     raise TypeError(f"unknown filter node {node}")
 
 
-def _apply_packed(arrays: tuple, packed: tuple, padded: int) -> tuple:
+def _apply_packed(arrays: tuple, packed: tuple) -> tuple:
     """Widen narrow (uint8/uint16) id planes to int32 in-register. A
     sub-byte bitstream decode was tried and measured ~1000x slower on TPU
     than this astype (the 32-lane stack/reshape forces lane relayouts), so
     byte-aligned narrow planes are the TPU-correct HBM packing — 4x/2x less
-    residency and read bandwidth, decode fused for free."""
+    residency and read bandwidth, decode fused for free. `packed` entries
+    are (slot, width) with width ∈ {8, 16} (see dict_ids_packed)."""
     if not packed:
         return arrays
     out = list(arrays)
@@ -177,7 +178,7 @@ def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, pad
     [row_offset, row_offset+padded) of the global segment.
     `packed` marks id slots resident in HBM as packed/narrow planes.
     """
-    arrays = _apply_packed(arrays, packed, padded)
+    arrays = _apply_packed(arrays, packed)
     return _run_program_impl(program, arrays, params, num_docs, padded, row_offset)
 
 

@@ -51,7 +51,7 @@ class SegmentDeviceView:
         self.device = device
         self.padded = pad_bucket(max(1, segment.num_docs))
         self._planes: dict[tuple[str, str], jnp.ndarray] = {}
-        # (column,"ids") → bits for planes kept packed/narrow in HBM
+        # (column,"ids_packed") → dtype width (8|16) of narrow planes
         self.packed_bits: dict[tuple[str, str], int] = {}
 
     def _put(self, key: tuple[str, str], host: np.ndarray) -> jnp.ndarray:

@@ -10,7 +10,6 @@ import numpy as np
 import pytest
 
 from pinot_tpu.engine.query_executor import QueryExecutor
-from pinot_tpu.segment import bitpack
 from pinot_tpu.segment.builder import SegmentBuilder
 from pinot_tpu.segment.loader import load_segment
 from pinot_tpu.spi.data_types import Schema
@@ -30,7 +29,7 @@ def test_narrow_plane_widening(width):
     rng = np.random.default_rng(width)
     vals = rng.integers(0, 1 << width, 8192).astype(
         np.uint8 if width == 8 else np.uint16)
-    out = _apply_packed((jnp.asarray(vals),), ((0, width),), 8192)[0]
+    out = _apply_packed((jnp.asarray(vals),), ((0, width),))[0]
     np.testing.assert_array_equal(np.asarray(out), vals.astype(np.int32))
 
 
