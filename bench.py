@@ -65,11 +65,50 @@ def build_segment():
     return schema
 
 
-def main():
-    if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local runs; axon default
-        import jax
+def _init_backend():
+    """Initialize a jax backend with retry + CPU fallback.
 
+    Round 1 died here: one transient axon/TPU init error at jax.devices()
+    crashed the whole bench (BENCH_r01.json rc=1). Retry with backoff; if the
+    accelerator never comes up, fall back to CPU so the round still produces
+    a parseable (clearly-labelled) number.
+    """
+    import jax
+    from jax.extend import backend as jex_backend
+
+    if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local runs; axon default
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    last_err = None
+    attempts = 4
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(min(5 * 2 ** (attempt - 1), 20))
+        try:
+            devs = jax.devices()
+            print(f"[bench] devices: {devs}", file=sys.stderr)
+            return jax, devs[0].platform, None
+        except Exception as e:  # backend init is the flaky part
+            last_err = e
+            print(f"[bench] backend init attempt {attempt + 1} failed: {e}",
+                  file=sys.stderr)
+            try:
+                jex_backend.clear_backends()
+            except Exception:
+                pass
+    print("[bench] falling back to CPU platform", file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jex_backend.clear_backends()
+    except Exception:
+        pass
+    devs = jax.devices()
+    if devs[0].platform != "cpu":  # partial-cache left an accelerator backend
+        return jax, devs[0].platform, None
+    return jax, "cpu", f"accelerator init failed, ran on cpu: {last_err}"
+
+
+def main():
+    jax, platform, backend_note = _init_backend()
     from pinot_tpu.engine.query_executor import QueryExecutor
     from pinot_tpu.segment.loader import load_segment
     from pinot_tpu.spi.data_types import Schema
@@ -87,9 +126,6 @@ def main():
                         ("lo_discount", "INT"), ("lo_quantity", "INT")],
             metrics=[("lo_extendedprice", "INT"), ("lo_revenue", "INT")],
         )
-
-    import jax
-    print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
 
     tpu = QueryExecutor(backend="tpu")
     tpu.add_table(schema, [segment])
@@ -127,7 +163,7 @@ def main():
               f"speedup {host_s/p50:.1f}x, match={match}", file=sys.stderr)
 
     q2 = results["q2_groupby"]
-    print(json.dumps({
+    out = {
         "metric": "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip",
         "value": round(q2["rows_per_sec"]),
         "unit": "rows/s",
@@ -135,7 +171,11 @@ def main():
         "detail": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
                        for kk, vv in v.items()} for k, v in results.items()},
         "rows": ROWS,
-    }))
+        "platform": platform,
+    }
+    if backend_note:
+        out["warning"] = backend_note
+    print(json.dumps(out))
 
 
 def _rows_match(a, b) -> bool:
@@ -147,4 +187,17 @@ def _rows_match(a, b) -> bool:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # still emit ONE parseable JSON line for the driver
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip",
+            "value": 0,
+            "unit": "rows/s",
+            "vs_baseline": 0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(0)
