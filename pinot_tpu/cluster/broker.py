@@ -319,16 +319,16 @@ class Broker:
 
     # -- hybrid time boundary ----------------------------------------------
     def _time_boundary(self, offline_table: str) -> Optional[int]:
-        """Max endTimeMs across offline segments (reference
-        TimeBoundaryManager; the reference subtracts 1 time unit — kept as
-        inclusive-≤ here with the realtime side strictly >)."""
+        """max(endTimeMs) - 1 across offline segments (reference
+        TimeBoundaryManager subtracts one time unit so the boundary instant
+        itself is served from REALTIME: offline ≤ boundary, realtime >)."""
         best = None
         for seg in self.store.children(f"/SEGMENTS/{offline_table}"):
             meta = self.store.get(f"/SEGMENTS/{offline_table}/{seg}") or {}
             end = meta.get("endTimeMs")
             if end is not None:
                 best = end if best is None else max(best, end)
-        return best
+        return None if best is None else best - 1
 
 
 def _range_filter(column: str, gt: Optional[int], lte: Optional[int]) -> FilterContext:

@@ -127,9 +127,9 @@ class RpcClient:
     def call(self, request):
         with self._lock:
             for attempt in (0, 1):
-                if self._sock is None:
-                    self._sock = self._connect()
                 try:
+                    if self._sock is None:
+                        self._sock = self._connect()
                     _send_frame(self._sock, request)
                     status, payload = _recv_frame(self._sock)
                     break

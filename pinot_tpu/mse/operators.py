@@ -14,6 +14,7 @@ they're pushed into the single-stage device engine by the leaf compiler.
 from __future__ import annotations
 
 import re
+from collections import Counter
 from typing import Optional
 
 import numpy as np
@@ -599,15 +600,28 @@ def op_setop(kind: str, all_: bool, left: Block, right: Block,
     if kind == "UNION":
         rows = lrows + rrows if all_ else list(dict.fromkeys(lrows + rrows))
     elif kind == "INTERSECT":
-        rset = set(rrows)
-        rows = [r for r in lrows if r in rset]
-        if not all_:
-            rows = list(dict.fromkeys(rows))
+        if all_:  # bag semantics: emit min(countL, countR) copies per row
+            rcount = Counter(rrows)
+            rows = []
+            for r in lrows:
+                if rcount.get(r, 0) > 0:
+                    rcount[r] -= 1
+                    rows.append(r)
+        else:
+            rset = set(rrows)
+            rows = list(dict.fromkeys(r for r in lrows if r in rset))
     else:  # EXCEPT
-        rset = set(rrows)
-        rows = [r for r in lrows if r not in rset]
-        if not all_:
-            rows = list(dict.fromkeys(rows))
+        if all_:  # bag semantics: subtract counts, max(0, countL - countR)
+            rcount = Counter(rrows)
+            rows = []
+            for r in lrows:
+                if rcount.get(r, 0) > 0:
+                    rcount[r] -= 1
+                else:
+                    rows.append(r)
+        else:
+            rset = set(rrows)
+            rows = list(dict.fromkeys(r for r in lrows if r not in rset))
     return _rows_to_block(rows, schema)
 
 

@@ -607,8 +607,11 @@ class GeoGridIndex:
     @staticmethod
     def cell_of(lat: np.ndarray, lng: np.ndarray, res_deg: float) -> np.ndarray:
         r = np.int64(np.ceil(360.0 / res_deg))
+        n_lat = np.int64(np.ceil(180.0 / res_deg))
         la = np.floor((np.asarray(lat, dtype=np.float64) + 90.0) / res_deg).astype(np.int64)
+        la = np.minimum(la, n_lat - 1)  # lat=+90 lands in the top row
         lo = np.floor((np.asarray(lng, dtype=np.float64) + 180.0) / res_deg).astype(np.int64)
+        lo = lo % r  # lng=+180 is the same meridian as -180
         return la * r + lo
 
     @staticmethod
@@ -627,14 +630,23 @@ class GeoGridIndex:
         cos = max(0.01, np.cos(np.radians(lat)))
         deg_lng = deg_lat / cos
         r = np.int64(np.ceil(360.0 / self.res_deg))
+        n_lat = int(np.ceil(180.0 / self.res_deg))
         la_lo = int(np.floor((lat - deg_lat + 90.0) / self.res_deg))
         la_hi = int(np.floor((lat + deg_lat + 90.0) / self.res_deg))
+        pole_clip = la_lo < 0 or la_hi >= n_lat  # circle reaches a pole
+        la_lo, la_hi = max(la_lo, 0), min(la_hi, n_lat - 1)
         lo_lo = int(np.floor((lng - deg_lng + 180.0) / self.res_deg))
         lo_hi = int(np.floor((lng + deg_lng + 180.0) / self.res_deg))
+        if pole_clip or lo_hi - lo_lo + 1 >= int(r):
+            lo_cols = np.arange(r, dtype=np.int64)  # all longitudes
+        else:
+            # wrap modulo grid width so circles crossing ±180° keep their
+            # candidate cells instead of walking off the linear range
+            lo_cols = np.arange(lo_lo, lo_hi + 1, dtype=np.int64) % r
         wanted = []
         for la in range(la_lo, la_hi + 1):
             base = np.int64(la) * r
-            wanted.append(np.arange(base + lo_lo, base + lo_hi + 1, dtype=np.int64))
+            wanted.append(base + lo_cols)
         wanted = np.concatenate(wanted)
         idx = np.searchsorted(self.cell_ids, wanted)
         idx = idx[(idx < len(self.cell_ids))]

@@ -39,15 +39,21 @@ class ValidDocIds:
 
     def ensure(self, n: int) -> None:
         with self._lock:
-            if n > len(self._mask):
-                grown = np.zeros(max(n, 2 * len(self._mask)), dtype=bool)
-                grown[: len(self._mask)] = self._mask
-                self._mask = grown
-            self._n = max(self._n, n)
+            self._ensure_nolock(n)
+
+    def _ensure_nolock(self, n: int) -> None:
+        if n > len(self._mask):
+            grown = np.zeros(max(n, 2 * len(self._mask)), dtype=bool)
+            grown[: len(self._mask)] = self._mask
+            self._mask = grown
+        self._n = max(self._n, n)
 
     def set(self, doc_id: int, valid: bool) -> None:
-        self.ensure(doc_id + 1)
-        self._mask[doc_id] = valid
+        # grow-and-write under one lock so a concurrent ensure() can't swap
+        # the array out between the two steps and drop this write
+        with self._lock:
+            self._ensure_nolock(doc_id + 1)
+            self._mask[doc_id] = valid
 
     def mask(self, n: int) -> np.ndarray:
         """Validity for the first n docs (query snapshot)."""

@@ -156,7 +156,10 @@ def test_hybrid_time_boundary(cluster, tmp_path):
 
     resp = broker.execute_sql("SELECT COUNT(*) FROM stats")
     assert not resp.exceptions, resp.exceptions
-    expected = 500 + int(np.sum(cols_rt["year"] > 2004))
+    # boundary = max(endTimeMs) - 1 = 2003: the boundary instant (2004) is
+    # served from REALTIME (reference TimeBoundaryManager semantics)
+    expected = int(np.sum(cols_off["year"] <= 2003)) + \
+        int(np.sum(cols_rt["year"] > 2003))
     assert resp.result_table.rows[0][0] == expected
 
 
@@ -205,3 +208,13 @@ def test_drop_table_and_unknown_table(cluster, tmp_path):
     # servers released the segments
     for s in cluster[2]:
         assert not s.segments.get(table)
+
+
+def test_rpc_connect_refused_is_transport_error():
+    """A down server must surface as TransportError so the broker's
+    failover/failure-detector path catches it (not a raw OSError)."""
+    from pinot_tpu.cluster.transport import RpcClient, TransportError
+
+    client = RpcClient("127.0.0.1", 1, timeout=2.0)  # nothing listens on :1
+    with pytest.raises(TransportError):
+        client.call({"op": "ping"})

@@ -401,3 +401,21 @@ def test_leaf_pushdown_happens(engine):
     runner = StageRunner(stages, 2, engine.execute, engine.multistage._read_table)
     runner.run()
     assert runner.stats["leaf_ssqe_pushdowns"] >= 1
+
+
+def test_setop_all_bag_semantics():
+    """INTERSECT ALL = min(countL,countR) copies; EXCEPT ALL subtracts counts
+    (sqlite lacks INTERSECT/EXCEPT ALL, so assert the bags directly)."""
+    from pinot_tpu.mse.operators import op_setop
+
+    left = {"v": np.array([1, 1, 1, 2, 2, 3], dtype=np.int64)}
+    right = {"v": np.array([1, 1, 2, 4], dtype=np.int64)}
+    out = op_setop("INTERSECT", True, left, right, ["v"])
+    assert sorted(np.asarray(out["v"]).tolist()) == [1, 1, 2]
+    out = op_setop("EXCEPT", True, left, right, ["v"])
+    assert sorted(np.asarray(out["v"]).tolist()) == [1, 2, 3]
+    # non-ALL variants unchanged: distinct set semantics
+    out = op_setop("INTERSECT", False, left, right, ["v"])
+    assert sorted(np.asarray(out["v"]).tolist()) == [1, 2]
+    out = op_setop("EXCEPT", False, left, right, ["v"])
+    assert sorted(np.asarray(out["v"]).tolist()) == [3]

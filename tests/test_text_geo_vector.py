@@ -199,3 +199,35 @@ def test_vector_index_survives_serialization(tmp_path):
     d1, _ = idx.top_k(q, 4)
     d2, _ = back.top_k(q, 4)
     np.testing.assert_array_equal(d1, d2)
+
+
+def test_geo_antimeridian_wrap():
+    """A radius circle crossing ±180° must keep candidates on both sides."""
+    lat = np.asarray([0.0, 0.0, 0.0])
+    lng = np.asarray([179.9, -179.9, 10.0])
+    idx = GeoGridIndex.build(lat, lng, res_deg=0.5)
+    cand = idx.candidate_docs(0.0, 179.95, 50_000)  # ~0.45° radius
+    assert 0 in cand and 1 in cand
+    assert 2 not in cand
+    cand = idx.candidate_docs(0.0, -179.95, 50_000)
+    assert 0 in cand and 1 in cand
+
+
+def test_geo_pole_clamp():
+    """A circle covering a pole must include all longitudes at that latitude."""
+    lat = np.asarray([89.8, 89.8])
+    lng = np.asarray([10.0, -170.0])
+    idx = GeoGridIndex.build(lat, lng, res_deg=0.5)
+    cand = idx.candidate_docs(89.9, 0.0, 60_000)
+    assert 0 in cand and 1 in cand
+
+
+def test_geo_boundary_coordinates():
+    """lat=+90 and lng=+180 are storable and findable (grid-edge canon)."""
+    lat = np.asarray([90.0, 89.8, 0.0])
+    lng = np.asarray([10.0, 10.0, 180.0])
+    idx = GeoGridIndex.build(lat, lng, res_deg=0.5)
+    cand = idx.candidate_docs(89.9, 10.0, 60_000)
+    assert 0 in cand and 1 in cand
+    cand = idx.candidate_docs(0.0, -179.95, 50_000)  # 180.0 ≡ -180.0
+    assert 2 in cand
