@@ -56,18 +56,29 @@ class TpuSegmentExecutor:
         num_groups = plan.program.num_groups
         counts = outs[0][:num_groups]
         gids = np.nonzero(counts)[0]
-        # decompose linear gid → per-dim dict ids → values
+        if plan.program.mode == "group_by_sparse":
+            # sparse kernels emit the surviving composite keys as the last
+            # output; gids are table slots, keys carry the dict-id composite
+            composite = outs[-1][gids].astype(np.int64)
+        else:
+            composite = gids
+        # decompose composite key → per-dim dict ids → values
         # (inverse of DictionaryBasedGroupKeyGenerator's cartesian key,
         # pinot-core/.../groupby/DictionaryBasedGroupKeyGenerator.java:119-137)
         key_cols = []
         for dim, stride in zip(plan.group_dims, plan.program.group_strides):
-            ids = (gids // stride) % dim.cardinality
+            ids = (composite // stride) % dim.cardinality
             key_cols.append(dim.dictionary.values[ids])
         groups = {}
         for row, g in enumerate(gids):
             key = tuple(_to_python(col[row]) for col in key_cols)
             groups[key] = [la.extract(outs, g) for la in plan.lowered_aggs]
-        return GroupByIntermediate(groups, num_docs_scanned=int(counts.sum()))
+        scanned = int(counts.sum())
+        if plan.program.mode == "group_by_sparse":
+            # sparse trash slot = valid rows whose group was trimmed; they
+            # were still scanned (reference reports all post-filter docs)
+            scanned += int(outs[0][num_groups])
+        return GroupByIntermediate(groups, num_docs_scanned=scanned)
 
     def _selection_result(self, query, segment, plan, mask) -> SelectionIntermediate:
         evaluator = None

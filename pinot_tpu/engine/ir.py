@@ -20,6 +20,13 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+# Sparse group-by composite keys must stay strictly below this value: the
+# kernel uses it as the masked-row sort sentinel (rows with key >= sentinel
+# are treated as filtered out), and the planner rejects cardinality products
+# reaching it. One constant, imported by both sides, so the invariant can't
+# drift (ops/kernels._run_sparse_group_by, engine/plan.SegmentPlanner.plan).
+SPARSE_KEY_SPACE = 1 << 62
+
 # ---------------------------------------------------------------------------
 # Value expressions (→ reference TransformFunction,
 # pinot-core/.../operator/transform/function/TransformFunction.java:35)
@@ -208,12 +215,18 @@ class AggOp:
 
 @dataclass(frozen=True)
 class Program:
-    mode: str  # "group_by" | "aggregation" | "selection"
+    mode: str  # "group_by" | "group_by_sparse" | "aggregation" | "selection"
     filter: Optional[FilterNode]
     aggs: tuple[AggOp, ...] = ()
     # group-by: per-dim dict-id plane slots + cartesian strides
     # (reference DictionaryBasedGroupKeyGenerator cartesian-product int keys,
-    # pinot-core/.../groupby/DictionaryBasedGroupKeyGenerator.java:119-137)
+    # pinot-core/.../groupby/DictionaryBasedGroupKeyGenerator.java:119-137).
+    # Dense mode materializes a (num_groups+1,) table per agg; sparse mode
+    # (cardinality product beyond the dense HBM limit) sorts 64-bit composite
+    # keys on device and emits at most num_groups = numGroupsLimit groups —
+    # the device analogue of the reference's hash-map key generators with
+    # numGroupsLimit trim (InstancePlanMakerImplV2.java:245-270). Sparse
+    # kernels append a (num_groups,) int64 key plane as the LAST output.
     group_slots: tuple[int, ...] = ()
     group_strides: tuple[int, ...] = ()
     num_groups: int = 1

@@ -32,6 +32,9 @@ from . import ir
 from .aggregation import AggPlanContext, LoweredAgg, UnsupportedQueryError, lower_aggregation
 
 DENSE_GROUP_LIMIT = 1 << 21  # beyond this the dense segment_sum table blows HBM
+SPARSE_KEY_LIMIT = ir.SPARSE_KEY_SPACE  # keys stay below the kernel sentinel
+DEFAULT_NUM_GROUPS_LIMIT = 100_000  # reference InstancePlanMakerImplV2 default
+_SPARSE_AGG_KINDS = {"count", "sum", "sumsq", "min", "max"}
 
 
 @dataclass
@@ -542,9 +545,11 @@ class SegmentPlanner(AggPlanContext):
             num_groups = 1
             for c in cards:
                 num_groups *= c
-            if num_groups > DENSE_GROUP_LIMIT:
+            sparse = num_groups > DENSE_GROUP_LIMIT
+            if sparse and num_groups >= SPARSE_KEY_LIMIT:
                 raise UnsupportedQueryError(
-                    f"group cardinality product {num_groups} exceeds dense limit")
+                    f"group cardinality product {num_groups} exceeds the "
+                    "int64 composite-key space")
             # row-major strides (reference DictionaryBasedGroupKeyGenerator:119-137)
             strides = [1] * len(cards)
             for i in range(len(cards) - 2, -1, -1):
@@ -552,6 +557,14 @@ class SegmentPlanner(AggPlanContext):
 
             lowered = [lower_aggregation(self, a) for a in q.aggregations]
             for op in self.ops:
+                if sparse:
+                    # sort-based path carries scalar reductions only; matrix
+                    # aggs (distinct/value-hist/histogram) fall back to host
+                    if op.kind not in _SPARSE_AGG_KINDS:
+                        raise UnsupportedQueryError(
+                            f"{op.kind} unsupported in sparse (sort-based) "
+                            "group-by")
+                    continue
                 # matrix-shaped reductions materialize (num_groups, card|bins)
                 # and address it with int32 — bound the product
                 width = op.card if op.kind in ("distinct_bitmap", "value_hist") else (
@@ -559,13 +572,23 @@ class SegmentPlanner(AggPlanContext):
                 if width is not None and num_groups * width > DENSE_GROUP_LIMIT:
                     raise UnsupportedQueryError(
                         f"{op.kind} occupancy {num_groups}x{width} exceeds dense limit")
+            if sparse and group_exprs:
+                # output capacity = numGroupsLimit: groups beyond it are
+                # trimmed on device (reference InstancePlanMakerImplV2:245-270)
+                limit = int(q.query_options.get(
+                    "numGroupsLimit", DEFAULT_NUM_GROUPS_LIMIT))
+                mode = "group_by_sparse"
+                out_groups = min(num_groups, max(1, limit))
+            else:
+                mode = "group_by" if group_exprs else "aggregation"
+                out_groups = num_groups
             program = ir.Program(
-                mode="group_by" if group_exprs else "aggregation",
+                mode=mode,
                 filter=filt,
                 aggs=tuple(self.ops),
                 group_slots=() if any_derived else tuple(group_slots),
                 group_strides=tuple(strides),
-                num_groups=num_groups,
+                num_groups=out_groups,
                 group_vexprs=tuple(group_vexprs) if any_derived else (),
             )
             return SegmentPlan(program, self._slots, self._params, lowered, group_dims)

@@ -194,6 +194,9 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
     if program.mode == "selection":
         return (mask,)
 
+    if program.mode == "group_by_sparse":
+        return _run_sparse_group_by(program, arrays, params, mask, n)
+
     num_groups = program.num_groups
     if program.mode == "group_by":
         gid = jnp.zeros((n,), dtype=jnp.int32)
@@ -213,6 +216,94 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
     outputs = [jax.ops.segment_sum(jnp.ones((n,), dtype=jnp.int64), gid, num_segments=num_segments)]
     for agg in program.aggs:
         outputs.append(_run_agg(agg, arrays, params, mask, gid, num_segments, n))
+    return tuple(outputs)
+
+
+def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
+    """High-cardinality group-by: sort-based aggregation on device.
+
+    When the cardinality product exceeds the dense segment_sum table limit,
+    the reference switches DictionaryBasedGroupKeyGenerator to hash maps
+    with a numGroupsLimit trim (DictionaryBasedGroupKeyGenerator.java:119-137,
+    InstancePlanMakerImplV2.java:245-270). Hash maps are hostile to the TPU's
+    vector units, but a bitonic sort of 64-bit composite keys is not:
+
+        key   = Σ dict_ids[d] * stride[d]          (int64; masked → sentinel)
+        sort  (key, agg inputs...) together        (lax.sort, one fused pass)
+        first = key[i] != key[i-1]                 (segment boundaries)
+        gidx  = cumsum(first) - 1                  (dense 0-based group index)
+        out_k = segment_sum/min/max by gidx        (K+1 slots, K = groups limit)
+
+    Groups past numGroupsLimit (in key sort order) route to the trash slot —
+    the same "stop creating new groups" trim semantics as the reference. The
+    composite keys of the surviving groups are emitted as the LAST output so
+    the host can decode per-dim dict ids with the usual stride arithmetic.
+    """
+    key = jnp.zeros((n,), dtype=jnp.int64)
+    if program.group_vexprs:
+        for vexpr, stride in zip(program.group_vexprs, program.group_strides):
+            key = key + _eval_value(vexpr, arrays, params).astype(jnp.int64) * stride
+    else:
+        for slot, stride in zip(program.group_slots, program.group_strides):
+            key = key + arrays[slot].astype(jnp.int64) * stride
+    sentinel = jnp.int64(ir.SPARSE_KEY_SPACE)
+    key = jnp.where(mask, key, sentinel)
+
+    # agg inputs with mask-neutral elements, computed BEFORE the sort so one
+    # lax.sort carries key + all values into group-contiguous order
+    operands = [key]
+    specs = []  # per agg: (reduce_kind, operand index | None)
+    for agg in program.aggs:
+        if agg.kind == "count":
+            specs.append(("count", None))
+            continue
+        v = _eval_value(agg.vexpr, arrays, params)
+        if agg.kind in ("sum", "sumsq"):
+            v = jnp.where(mask, v, 0).astype(jnp.float64)
+            if agg.kind == "sumsq":
+                v = v * v
+            specs.append(("sum", len(operands)))
+        elif agg.kind == "min":
+            v = jnp.where(mask, v, jnp.inf).astype(jnp.float64)
+            specs.append(("min", len(operands)))
+        elif agg.kind == "max":
+            v = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
+            specs.append(("max", len(operands)))
+        else:  # matrix-shaped aggs are planner-rejected in sparse mode
+            raise ValueError(f"agg kind {agg.kind} unsupported in sparse group-by")
+        operands.append(v)
+
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=1)
+    skey = sorted_ops[0]
+    valid = skey < sentinel
+    first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), skey[1:] != skey[:-1]]) & valid
+    gidx = jnp.cumsum(first.astype(jnp.int32)) - 1
+    k = program.num_groups
+    inlimit = valid & (gidx < k)
+    gid = jnp.where(inlimit, gidx, jnp.int32(k))
+
+    # trash slot counts valid-but-trimmed rows (invalid rows contribute 0),
+    # so the host can report every post-filter doc as scanned even when the
+    # numGroupsLimit trim drops groups
+    counts = jax.ops.segment_sum(
+        valid.astype(jnp.int64), gid, num_segments=k + 1)
+    outputs = [counts]
+    for kind, oi in specs:
+        if kind == "count":
+            outputs.append(counts)
+        elif kind == "sum":
+            outputs.append(jax.ops.segment_sum(
+                sorted_ops[oi], gid, num_segments=k + 1))
+        elif kind == "min":
+            outputs.append(jax.ops.segment_min(
+                sorted_ops[oi], gid, num_segments=k + 1))
+        else:
+            outputs.append(jax.ops.segment_max(
+                sorted_ops[oi], gid, num_segments=k + 1))
+    keys_out = jax.ops.segment_max(
+        jnp.where(inlimit, skey, jnp.int64(-1)), gid, num_segments=k + 1)[:k]
+    outputs.append(keys_out)
     return tuple(outputs)
 
 

@@ -125,6 +125,10 @@ def run_program_row_sharded(program: ir.Program, arrays: tuple, params: tuple,
     executable is cached on (program, padded, mesh, slot kinds) so repeated
     queries over resident shards skip tracing entirely.
     """
+    if program.mode == "group_by_sparse":
+        # keyed (sorted) outputs can't psum-merge across shards; the caller
+        # runs sparse programs whole-segment and merges at combine instead
+        raise ValueError("sparse group-by does not row-shard; run unsharded")
     n_shards = mesh.shape[ROW_AXIS]
     assert padded % n_shards == 0, (padded, n_shards)
     kinds = tuple(kind for _col, kind in slots) if slots else tuple(
