@@ -1,16 +1,24 @@
-"""Benchmark: SSB-style filter + group-by on one chip.
+"""Benchmark: BASELINE.json configs on one chip.
 
-Reproduces BASELINE.json configs #2/#3 (SSB 100M rows, 1 segment): Q1.1-style
-range-filter + SUM, and Q2-style dictionary filter + GROUP BY 2 dims. The CPU
-baseline is this repo's host (numpy) engine — the reference publishes no
-absolute numbers (BASELINE.md), so the ratio is measured against the
-vectorized CPU path on this machine, per BASELINE.md's instruction to
-generate our own CPU reference numbers.
+Configs (BASELINE.md, scaled to BENCH_ROWS total rows each):
+  q1  SSB Q1.1-style range filter + SUM           (1 segment)
+  q2  SSB Q2-style dict filter + GROUP BY 2 dims  (1 segment)   ← headline
+  q3  high-cardinality GROUP BY (sparse sort-based device path)
+  q4  16-segment combine of q2 (batched async dispatch)
+  q5  NYC-Taxi-style COUNT DISTINCT + PERCENTILE_TDIGEST GROUP BY day
+
+The CPU baseline is this repo's host (numpy) engine running segments on a
+worker pool sized to the machine's cores (the reference publishes no
+absolute numbers — BASELINE.md — so the ratio is measured against the
+parallel vectorized CPU path on the same machine). Roofline: bytes/s is
+the column-plane bytes each query must read from HBM divided by p50,
+reported against the v5e peak of ~819 GB/s.
 
 Prints ONE JSON line:
   {"metric": ..., "value": rows/sec/chip, "unit": "rows/s", "vs_baseline": x}
 
-Env knobs: BENCH_ROWS (default 100M), BENCH_ITERS (default 10).
+Env knobs: BENCH_ROWS (default 100M), BENCH_ITERS (default 10),
+BENCH_PLATFORM (e.g. cpu for local runs), BENCH_CONFIGS (csv, default all).
 """
 
 from __future__ import annotations
@@ -25,44 +33,121 @@ import numpy as np
 
 ROWS = int(os.environ.get("BENCH_ROWS", 100_000_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 10))
-CACHE_DIR = Path(__file__).parent / ".bench_cache" / f"ssb_{ROWS}"
+CONFIGS = os.environ.get("BENCH_CONFIGS", "q1,q2,q3,q4,q5").split(",")
+CACHE = Path(__file__).parent / ".bench_cache"
+V5E_HBM_PEAK = 819e9  # bytes/s
 
-Q1 = ("SELECT SUM(lo_extendedprice) FROM ssb WHERE d_year = 1993 "
+Q1 = ("SELECT SUM(lo_extendedprice) FROM {t} WHERE d_year = 1993 "
       "AND lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25")
-Q2 = ("SELECT d_year, p_brand, SUM(lo_revenue) FROM ssb "
+Q2 = ("SELECT d_year, p_brand, SUM(lo_revenue) FROM {t} "
       "WHERE s_region = 'ASIA' GROUP BY d_year, p_brand LIMIT 10000")
+Q3 = ("SET numGroupsLimit = 20000000; "
+      "SELECT lo_orderkey, SUM(lo_revenue), COUNT(*) FROM {t} "
+      "GROUP BY lo_orderkey ORDER BY lo_orderkey LIMIT 100000")
+Q5 = ("SELECT pickup_day, DISTINCTCOUNT(passenger_count), "
+      "PERCENTILETDIGEST(fare, 95) FROM taxi GROUP BY pickup_day LIMIT 1000")
 
 
-def build_segment():
-    from pinot_tpu.segment.builder import SegmentBuilder
-    from pinot_tpu.spi.data_types import Schema
-    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
-
-    rng = np.random.default_rng(2024)
-    print(f"[bench] generating {ROWS:,} rows", file=sys.stderr)
-    cols = {
-        "d_year": rng.integers(1992, 1999, ROWS).astype(np.int32),
-        "p_brand": (rng.integers(0, 1000, ROWS)).astype(np.int32),
+def _gen_ssb(rows: int, seed: int = 2024):
+    rng = np.random.default_rng(seed)
+    return {
+        "d_year": rng.integers(1992, 1999, rows).astype(np.int32),
+        "p_brand": (rng.integers(0, 1000, rows)).astype(np.int32),
         "s_region": np.asarray(["AMERICA", "ASIA", "EUROPE", "AFRICA", "MIDDLE EAST"],
-                               dtype=object)[rng.integers(0, 5, ROWS)],
-        "lo_discount": rng.integers(0, 11, ROWS).astype(np.int32),
-        "lo_quantity": rng.integers(1, 51, ROWS).astype(np.int32),
-        "lo_extendedprice": rng.integers(1, 55_001, ROWS).astype(np.int32),
-        "lo_revenue": rng.integers(1, 600_000, ROWS).astype(np.int32),
+                               dtype=object)[rng.integers(0, 5, rows)],
+        "lo_discount": rng.integers(0, 11, rows).astype(np.int32),
+        "lo_quantity": rng.integers(1, 51, rows).astype(np.int32),
+        "lo_extendedprice": rng.integers(1, 55_001, rows).astype(np.int32),
+        "lo_revenue": rng.integers(1, 600_000, rows).astype(np.int32),
+        # high-card key for the sparse group-by config (~rows/10 distinct)
+        "lo_orderkey": rng.integers(0, max(1 << 22, rows // 10), rows).astype(np.int32),
     }
-    schema = Schema.build(
-        "ssb",
+
+
+def _ssb_schema(name: str):
+    from pinot_tpu.spi.data_types import Schema
+
+    return Schema.build(
+        name,
         dimensions=[("d_year", "INT"), ("p_brand", "INT"), ("s_region", "STRING"),
-                    ("lo_discount", "INT"), ("lo_quantity", "INT")],
+                    ("lo_discount", "INT"), ("lo_quantity", "INT"),
+                    ("lo_orderkey", "INT")],
         metrics=[("lo_extendedprice", "INT"), ("lo_revenue", "INT")],
     )
-    cfg = TableConfig(table_name="ssb", indexing=IndexingConfig(
-        no_dictionary_columns=["lo_extendedprice", "lo_revenue"]))
-    print("[bench] building segment", file=sys.stderr)
+
+
+def _taxi_schema():
+    from pinot_tpu.spi.data_types import Schema
+
+    return Schema.build(
+        "taxi",
+        dimensions=[("pickup_day", "INT"), ("passenger_count", "INT")],
+        metrics=[("fare", "DOUBLE")],
+    )
+
+
+def _build(schema, cols, out_dir, seg_name, no_dict=()):
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+    cfg = TableConfig(table_name=schema.schema_name, indexing=IndexingConfig(
+        no_dictionary_columns=list(no_dict)))
     t0 = time.perf_counter()
-    SegmentBuilder(schema, cfg, "ssb_0").build(cols, CACHE_DIR)
-    print(f"[bench] built in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
-    return schema
+    SegmentBuilder(schema, cfg, seg_name).build(cols, out_dir)
+    print(f"[bench] built {seg_name} ({len(next(iter(cols.values()))):,} rows) "
+          f"in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+
+def _load_table(qe_list, schema, seg_dirs):
+    from pinot_tpu.segment.loader import load_segment
+
+    segs = [load_segment(d) for d in seg_dirs]
+    for qe in qe_list:
+        qe.add_table(schema, segs)
+    return segs
+
+
+def prepare_tables(need_ssb, need_ssb16, need_taxi):
+    """Build (once, cached on disk) and return {table: (schema, seg_dirs)}."""
+    out = {}
+    ssb_cols = None
+    if need_ssb or need_ssb16:
+        schema = _ssb_schema("ssb")
+        d = CACHE / f"ssb_{ROWS}_v2"
+        if not (d / "metadata.json").exists():
+            ssb_cols = _gen_ssb(ROWS)
+            print(f"[bench] generating ssb {ROWS:,} rows", file=sys.stderr)
+            _build(schema, ssb_cols, d, "ssb_0",
+                   no_dict=["lo_extendedprice", "lo_revenue"])
+        out["ssb"] = (schema, [d])
+    if need_ssb16:
+        schema16 = _ssb_schema("ssb16")
+        dirs = [CACHE / f"ssb16_{ROWS}" / f"s{i}" for i in range(16)]
+        if not (dirs[-1] / "metadata.json").exists():
+            if ssb_cols is None:
+                ssb_cols = _gen_ssb(ROWS)
+            bounds = np.linspace(0, ROWS, 17, dtype=np.int64)
+            for i in range(16):
+                sl = slice(int(bounds[i]), int(bounds[i + 1]))
+                _build(schema16, {k: v[sl] for k, v in ssb_cols.items()},
+                       dirs[i], f"ssb16_{i}",
+                       no_dict=["lo_extendedprice", "lo_revenue"])
+        out["ssb16"] = (schema16, dirs)
+    del ssb_cols
+    if need_taxi:
+        schema = _taxi_schema()
+        d = CACHE / f"taxi_{ROWS}"
+        if not (d / "metadata.json").exists():
+            rng = np.random.default_rng(7)
+            print(f"[bench] generating taxi {ROWS:,} rows", file=sys.stderr)
+            cols = {
+                "pickup_day": rng.integers(0, 730, ROWS).astype(np.int32),
+                "passenger_count": rng.integers(1, 9, ROWS).astype(np.int32),
+                "fare": np.round(rng.gamma(3.0, 9.0, ROWS), 2),
+            }
+            _build(schema, cols, d, "taxi_0", no_dict=["fare"])
+        out["taxi"] = (schema, [d])
+    return out
 
 
 def _init_backend():
@@ -107,83 +192,137 @@ def _init_backend():
     return jax, "cpu", f"accelerator init failed, ran on cpu: {last_err}"
 
 
+def _plan_bytes(qe, sql, segments):
+    """Column-plane bytes one execution must read (device roofline input)."""
+    from pinot_tpu.query.parser.sql import parse_sql
+
+    try:
+        query = parse_sql(sql)
+        total = 0
+        for seg in segments:
+            plan = qe.tpu.plan(query, seg)
+            view = qe.tpu.cache.view(seg)
+            arrays, _ = plan.gather_arrays_packed(view)
+            total += sum(int(np.asarray(a).nbytes) if not hasattr(a, "nbytes")
+                         else int(a.nbytes) for a in arrays)
+        return total
+    except Exception:
+        return None
+
+
+def _time_query(qe, sql, iters):
+    r = qe.execute_sql(sql)  # warmup / compile / HBM residency
+    if r.exceptions:
+        raise RuntimeError(f"{sql}: {r.exceptions}")
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = qe.execute_sql(sql)
+        times.append(time.perf_counter() - t0)
+    if r.exceptions:
+        raise RuntimeError(f"{sql}: {r.exceptions}")
+    return float(np.median(times)), r
+
+
+def _rows_match(a, b, rel_tol=0.0) -> bool:
+    if len(a) != len(b):
+        return False
+    if rel_tol == 0.0:
+        return sorted(map(repr, a)) == sorted(map(repr, b))
+
+    def key(row):
+        return tuple(x for x in row if not isinstance(x, float))
+
+    bm = {key(r): r for r in b}
+    for r in a:
+        other = bm.get(key(r))
+        if other is None:
+            return False
+        for x, y in zip(r, other):
+            if isinstance(x, float) and isinstance(y, float):
+                if abs(x - y) > rel_tol * max(1.0, abs(x), abs(y)):
+                    return False
+    return True
+
+
 def main():
     jax, platform, backend_note = _init_backend()
     from pinot_tpu.engine.query_executor import QueryExecutor
-    from pinot_tpu.segment.loader import load_segment
-    from pinot_tpu.spi.data_types import Schema
 
-    if not (CACHE_DIR / "metadata.json").exists():
-        schema = build_segment()
-    else:
-        print("[bench] using cached segment", file=sys.stderr)
-        schema = None
-    segment = load_segment(CACHE_DIR)
-    if schema is None:
-        schema = Schema.build(
-            "ssb",
-            dimensions=[("d_year", "INT"), ("p_brand", "INT"), ("s_region", "STRING"),
-                        ("lo_discount", "INT"), ("lo_quantity", "INT")],
-            metrics=[("lo_extendedprice", "INT"), ("lo_revenue", "INT")],
-        )
+    need_ssb = any(c in CONFIGS for c in ("q1", "q2", "q3"))
+    need_ssb16 = "q4" in CONFIGS
+    need_taxi = "q5" in CONFIGS
+    tables = prepare_tables(need_ssb, need_ssb16, need_taxi)
 
+    ncpu = os.cpu_count() or 1
     tpu = QueryExecutor(backend="tpu")
-    tpu.add_table(schema, [segment])
-    host = QueryExecutor(backend="host")
-    host.add_table(schema, [segment])
+    host = QueryExecutor(backend="host", num_threads=ncpu)
+    loaded = {}
+    for name, (schema, dirs) in tables.items():
+        loaded[name] = _load_table([tpu, host], schema, dirs)
+
+    runs = {
+        "q1_filter_sum": ("q1", Q1.format(t="ssb"), "ssb", ITERS, 0.0),
+        "q2_groupby": ("q2", Q2.format(t="ssb"), "ssb", ITERS, 0.0),
+        "q3_highcard_groupby": ("q3", Q3.format(t="ssb"), "ssb",
+                                max(3, ITERS // 3), 0.0),
+        "q4_combine16": ("q4", Q2.format(t="ssb16"), "ssb16", ITERS, 0.0),
+        # device tdigest is a fixed-bin histogram approximation; compare the
+        # host exact percentile within 1%
+        "q5_distinct_tdigest": ("q5", Q5, "taxi", max(3, ITERS // 3), 0.01),
+    }
 
     results = {}
-    for name, sql in [("q1_filter_sum", Q1), ("q2_groupby", Q2)]:
-        # warmup / compile (also pushes planes to HBM once)
-        r = tpu.execute_sql(sql)
-        if r.exceptions:
-            raise RuntimeError(f"{name}: {r.exceptions}")
-        times = []
-        for _ in range(ITERS):
-            t0 = time.perf_counter()
-            r = tpu.execute_sql(sql)
-            times.append(time.perf_counter() - t0)
-        p50 = float(np.median(times))
-        t0 = time.perf_counter()
-        rh = host.execute_sql(sql)
-        host_s = time.perf_counter() - t0
-        if rh.exceptions:
-            raise RuntimeError(f"host {name}: {rh.exceptions}")
-        assert r.result_table.rows is not None
-        match = _rows_match(r.result_table.rows, rh.result_table.rows)
+    for name, (cfg, sql, tname, iters, tol) in runs.items():
+        if cfg not in CONFIGS:
+            continue
+        segs = loaded[tname]
+        p50, r = _time_query(tpu, sql, iters)
+        host_p50, rh = _time_query(host, sql, max(1, min(3, iters)))
+        match = _rows_match(r.result_table.rows, rh.result_table.rows, tol)
+        nbytes = _plan_bytes(tpu, sql, segs)
         results[name] = {
             "tpu_p50_s": p50,
             "rows_per_sec": ROWS / p50,
-            "host_s": host_s,
-            "speedup": host_s / p50,
+            "host_parallel_s": host_p50,
+            "speedup": host_p50 / p50,
             "match": match,
         }
+        if nbytes:
+            results[name]["hbm_bytes"] = nbytes
+            results[name]["hbm_bytes_per_sec"] = nbytes / p50
+            results[name]["hbm_peak_frac"] = (nbytes / p50) / V5E_HBM_PEAK
         print(f"[bench] {name}: p50 {p50*1000:.1f}ms "
-              f"({ROWS/p50/1e9:.2f}B rows/s), host {host_s*1000:.0f}ms, "
-              f"speedup {host_s/p50:.1f}x, match={match}", file=sys.stderr)
+              f"({ROWS/p50/1e9:.2f}B rows/s), host({ncpu}thr) "
+              f"{host_p50*1000:.0f}ms, speedup {host_p50/p50:.1f}x, "
+              f"match={match}"
+              + (f", {nbytes/p50/1e9:.0f} GB/s "
+                 f"({100*(nbytes/p50)/V5E_HBM_PEAK:.0f}% v5e peak)"
+                 if nbytes else ""),
+              file=sys.stderr)
 
-    q2 = results["q2_groupby"]
+    if not results:
+        raise RuntimeError(f"no benchmark configs ran (BENCH_CONFIGS={CONFIGS})")
+    if "q2_groupby" in results:
+        hname, metric = "q2_groupby", "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip"
+    else:
+        hname = next(iter(results))
+        metric = f"{hname}_rows_per_sec_per_chip"
+    headline = results[hname]
     out = {
-        "metric": "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip",
-        "value": round(q2["rows_per_sec"]),
+        "metric": metric,
+        "value": round(headline["rows_per_sec"]),
         "unit": "rows/s",
-        "vs_baseline": round(q2["speedup"], 2),
+        "vs_baseline": round(headline["speedup"], 2),
         "detail": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
                        for kk, vv in v.items()} for k, v in results.items()},
         "rows": ROWS,
+        "host_threads": ncpu,
         "platform": platform,
     }
     if backend_note:
         out["warning"] = backend_note
     print(json.dumps(out))
-
-
-def _rows_match(a, b) -> bool:
-    if len(a) != len(b):
-        return False
-    sa = sorted(map(repr, a))
-    sb = sorted(map(repr, b))
-    return sa == sb
 
 
 if __name__ == "__main__":

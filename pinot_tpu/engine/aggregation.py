@@ -57,17 +57,45 @@ class AggSemantics:
     empty_value: object  # result when zero rows matched (aggregation query)
 
 
+# One table of (merge spec, finalize tag) per scalar aggregation, shared by
+# the device lowering (VecAgg below) and the host vectorized group-by
+# (host_executor._group_by_vectorized) so their GroupArrays stay mergeable.
+VEC_RECIPES = {
+    "count": (("add",), ("id", 0)),
+    "sum": (("add",), ("id", 0)),
+    "min": (("min",), ("id", 0)),
+    "max": (("max",), ("id", 0)),
+    "avg": (("add", "add"), ("div", 0, 1)),
+    "minmaxrange": (("min", "max"), ("sub", 1, 0)),
+}
+
+
+@dataclass
+class VecAgg:
+    """Columnar (vectorized) form of one aggregation for the GroupArrays
+    fast path: extract pulls per-component numpy columns for ALL groups at
+    once; spec gives each component's cross-segment merge op; fin_tag is a
+    picklable finalize recipe evaluated by the broker reducer
+    (("id", c) | ("div", a, b) | ("sub", a, b) over component indices)."""
+
+    spec: tuple  # per component: "add" | "min" | "max"
+    extract: Callable  # (outs, gids) -> tuple[np.ndarray, ...]
+    fin_tag: tuple
+
+
 @dataclass
 class LoweredAgg:
     """Device lowering of one SQL aggregation: how to read kernel outputs.
 
     extract(outs, g) builds the per-group intermediate state from the kernel
-    output tuple (outs[0] is always the per-group row count).
+    output tuple (outs[0] is always the per-group row count). vec, when set,
+    is the whole-table columnar form (GroupArrays fast path).
     """
 
     name: str
     semantics: AggSemantics
     extract: Callable  # (outs, g) -> state
+    vec: "VecAgg | None" = None
 
 
 # ---------------------------------------------------------------------------
@@ -331,21 +359,42 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
     sem = get_semantics(name, extra)
 
     if name == "count":
-        return LoweredAgg(label, sem, lambda outs, g: int(outs[0][g]))
+        spec, tag = VEC_RECIPES["count"]
+        return LoweredAgg(
+            label, sem, lambda outs, g: int(outs[0][g]),
+            vec=VecAgg(spec, lambda outs, gids: (outs[0][gids],), tag))
 
     if name in ("sum", "min", "max"):
         i = ctx.add_op(ir.AggOp(name, vexpr=ctx.value_expr(data[0])))
-        return LoweredAgg(label, sem, lambda outs, g: float(outs[i][g]))
+        spec, tag = VEC_RECIPES[name]
+        return LoweredAgg(
+            label, sem, lambda outs, g: float(outs[i][g]),
+            vec=VecAgg(spec,
+                       lambda outs, gids, _i=i: (outs[_i][gids].astype(float),),
+                       tag))
 
     if name == "minmaxrange":
         i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.value_expr(data[0])))
         i_max = ctx.add_op(ir.AggOp("max", vexpr=ctx.value_expr(data[0])))
-        return LoweredAgg(label, sem,
-                          lambda outs, g: (float(outs[i_min][g]), float(outs[i_max][g])))
+        spec, tag = VEC_RECIPES["minmaxrange"]
+        return LoweredAgg(
+            label, sem,
+            lambda outs, g: (float(outs[i_min][g]), float(outs[i_max][g])),
+            vec=VecAgg(spec,
+                       lambda outs, gids: (outs[i_min][gids].astype(float),
+                                           outs[i_max][gids].astype(float)),
+                       tag))
 
     if name == "avg":
         i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(data[0])))
-        return LoweredAgg(label, sem, lambda outs, g: (float(outs[i][g]), int(outs[0][g])))
+        spec, tag = VEC_RECIPES["avg"]
+        return LoweredAgg(
+            label, sem,
+            lambda outs, g: (float(outs[i][g]), int(outs[0][g])),
+            vec=VecAgg(spec,
+                       lambda outs, gids, _i=i: (outs[_i][gids].astype(float),
+                                                 outs[0][gids]),
+                       tag))
 
     if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
                 "distinctsum", "distinctavg"):

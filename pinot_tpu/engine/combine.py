@@ -9,10 +9,79 @@ AggSemantics.merge.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
+import numpy as np
+
+from . import ir
 from .aggregation import AggSemantics
-from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
+from .results import (
+    AggIntermediate,
+    GroupArrays,
+    GroupByIntermediate,
+    SelectionIntermediate,
+)
+
+_MERGE_INIT = {"add": 0.0, "min": np.inf, "max": -np.inf}
+_MERGE_AT = {"add": np.add.at, "min": np.minimum.at, "max": np.maximum.at}
+
+
+def combine_group_arrays(
+    intermediates: Sequence[GroupArrays],
+) -> Optional[GroupArrays]:
+    """Vectorized cross-segment merge of columnar group tables: factorize
+    each key dimension over the concatenated columns, build a composite
+    group id, and scatter-merge every state component with np.{add,min,max}.at
+    — no per-group Python. Returns None when the composite id would overflow
+    (caller falls back to the dict merge)."""
+    first = intermediates[0]
+    scanned = sum(im.num_docs_scanned for im in intermediates)
+    if len(intermediates) == 1:
+        first.num_docs_scanned = scanned
+        return first
+    ndim = len(first.key_cols)
+    cat_keys = [np.concatenate([im.key_cols[d] for im in intermediates])
+                for d in range(ndim)]
+    total = len(cat_keys[0]) if ndim else 0
+    if total == 0:
+        return GroupArrays([np.empty(0, object)] * ndim,
+                           [tuple(np.empty(0) for _ in s)
+                            for s in first.vec_specs],
+                           first.vec_specs, first.fin_tags, scanned)
+    uniqs, composite, stride = [], np.zeros(total, dtype=np.int64), 1
+    for col in reversed(cat_keys):
+        uniq, inv = np.unique(col, return_inverse=True)
+        if stride * len(uniq) >= ir.SPARSE_KEY_SPACE:
+            return None  # composite id overflow; dict merge handles it
+        composite += inv.astype(np.int64) * stride
+        stride *= max(1, len(uniq))
+        uniqs.append(uniq)
+    uniqs.reverse()
+    uniq_comp, inv = np.unique(composite, return_inverse=True)
+    g = len(uniq_comp)
+    # decode merged composite ids back to per-dim values
+    out_keys = []
+    rem = uniq_comp
+    strides = [1] * ndim
+    for d in range(ndim - 2, -1, -1):
+        strides[d] = strides[d + 1] * max(1, len(uniqs[d + 1]))
+    for d in range(ndim):
+        out_keys.append(uniqs[d][(rem // strides[d]) % max(1, len(uniqs[d]))])
+    out_states = []
+    for ai, spec in enumerate(first.vec_specs):
+        comps = []
+        for ci, op in enumerate(spec):
+            cat = np.concatenate(
+                [im.state_cols[ai][ci] for im in intermediates])
+            if op == "add":
+                out = np.zeros(g, dtype=cat.dtype)
+            else:
+                out = np.full(g, _MERGE_INIT[op], dtype=np.float64)
+            _MERGE_AT[op](out, inv, cat)
+            comps.append(out)
+        out_states.append(tuple(comps))
+    return GroupArrays(out_keys, out_states, first.vec_specs,
+                       first.fin_tags, scanned)
 
 
 def combine_group_by(

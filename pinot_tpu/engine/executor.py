@@ -19,7 +19,12 @@ from ..segment.device_cache import GLOBAL_DEVICE_CACHE, DeviceSegmentCache
 from ..segment.loader import ImmutableSegment
 from .aggregation import UnsupportedQueryError
 from .plan import SegmentPlan, SegmentPlanner
-from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
+from .results import (
+    AggIntermediate,
+    GroupArrays,
+    GroupByIntermediate,
+    SelectionIntermediate,
+)
 from .selection import selection_from_mask
 
 
@@ -37,12 +42,28 @@ class TpuSegmentExecutor:
         return self.execute_plan(query, segment, plan)
 
     def execute_plan(self, query: QueryContext, segment: ImmutableSegment, plan: SegmentPlan):
+        outs = self.dispatch_plan(segment, plan)
+        return self.collect(query, segment, plan, outs)
+
+    def dispatch_plan(self, segment: ImmutableSegment, plan: SegmentPlan):
+        """Launch the kernel and return UN-materialized device outputs.
+
+        JAX dispatch is asynchronous: the caller can dispatch every
+        segment's kernel back-to-back so the device queue stays full, then
+        collect() each — host planning/decoding overlaps device compute
+        (replaces the reference's per-segment worker-pool combine,
+        pinot-core/.../operator/combine/GroupByCombineOperator.java:54, with
+        async device queueing instead of threads)."""
         view = self.cache.view(segment)
         arrays, packed = plan.gather_arrays_packed(view)
         params = tuple(jnp.asarray(p) for p in plan.params)
-        outs = run_program(plan.program, arrays, params,
+        return run_program(plan.program, arrays, params,
                            jnp.int32(segment.num_docs), view.padded,
                            packed=packed)
+
+    def collect(self, query: QueryContext, segment: ImmutableSegment,
+                plan: SegmentPlan, outs):
+        """Materialize device outputs (blocks) and decode the intermediate."""
         outs = [np.asarray(o) for o in outs]
         mode = plan.program.mode
         if mode == "selection":
@@ -69,15 +90,24 @@ class TpuSegmentExecutor:
         for dim, stride in zip(plan.group_dims, plan.program.group_strides):
             ids = (composite // stride) % dim.cardinality
             key_cols.append(dim.dictionary.values[ids])
-        groups = {}
-        for row, g in enumerate(gids):
-            key = tuple(_to_python(col[row]) for col in key_cols)
-            groups[key] = [la.extract(outs, g) for la in plan.lowered_aggs]
         scanned = int(counts.sum())
         if plan.program.mode == "group_by_sparse":
             # sparse trash slot = valid rows whose group was trimmed; they
             # were still scanned (reference reports all post-filter docs)
             scanned += int(outs[0][num_groups])
+        if all(la.vec is not None for la in plan.lowered_aggs):
+            # columnar fast path: states stay numpy end-to-end (dict form
+            # costs ~µs/group in Python — fatal at numGroupsLimit scale)
+            return GroupArrays(
+                [np.asarray(col) for col in key_cols],
+                [la.vec.extract(outs, gids) for la in plan.lowered_aggs],
+                [la.vec.spec for la in plan.lowered_aggs],
+                [la.vec.fin_tag for la in plan.lowered_aggs],
+                num_docs_scanned=scanned)
+        groups = {}
+        for row, g in enumerate(gids):
+            key = tuple(_to_python(col[row]) for col in key_cols)
+            groups[key] = [la.extract(outs, g) for la in plan.lowered_aggs]
         return GroupByIntermediate(groups, num_docs_scanned=scanned)
 
     def _selection_result(self, query, segment, plan, mask) -> SelectionIntermediate:

@@ -20,7 +20,13 @@ from ..query.expressions import ExpressionContext
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
 from ..segment.loader import ImmutableSegment
 from ..query.transforms import get_transform
-from .aggregation import UnsupportedQueryError, host_state, host_state_full, split_args
+from .aggregation import (
+    VEC_RECIPES,
+    UnsupportedQueryError,
+    host_state,
+    host_state_full,
+    split_args,
+)
 from .plan import like_to_regex
 from .results import AggIntermediate, GroupByIntermediate, SelectionIntermediate
 from .selection import selection_from_mask
@@ -308,6 +314,9 @@ class HostSegmentExecutor:
     def _group_by(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
         key_cols = [np.asarray(self.eval_value(e, segment)) for e in group_exprs]
         sel = np.nonzero(mask)[0]
+        fast = self._group_by_vectorized(query, segment, sel, key_cols, mask)
+        if fast is not None:
+            return fast
         groups: dict[tuple, list] = {}
         # factorize each key col then group by linear code
         codes = np.zeros(len(sel), dtype=np.int64)
@@ -344,6 +353,82 @@ class HostSegmentExecutor:
                         host_state_full(agg.function.name, [c[rows] for c in cols], extra))
             groups[key] = states
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
+
+    # scalar aggs with a columnar (GroupArrays) host form: same set the
+    # device fast path supports, so host and device baselines are comparable
+    _VEC_AGGS = frozenset(VEC_RECIPES)
+
+    def _group_by_vectorized(self, query, segment, sel, key_cols, mask):
+        """np.unique + scatter-reduce group-by → GroupArrays, no per-group
+        Python. Returns None when any aggregation lacks a columnar form
+        (the general host_state_full loop handles it)."""
+        from .results import GroupArrays
+
+        agg_vals = []
+        for agg in query.aggregations:
+            name = agg.function.name
+            if name not in self._VEC_AGGS:
+                return None
+            if name == "count":
+                agg_vals.append(None)
+                continue
+            data, extra = split_args(agg.function)
+            if len(data) != 1 or extra:
+                return None
+            try:
+                v = np.asarray(self.eval_value(data[0], segment))
+            except Exception:
+                return None
+            if v.dtype.kind not in "ifb" or v.shape != mask.shape:
+                return None
+            agg_vals.append(v[sel].astype(np.float64))
+
+        codes = np.zeros(len(sel), dtype=np.int64)
+        for col in key_cols:
+            u, inv = np.unique(col[sel], return_inverse=True)
+            codes = codes * max(1, len(u)) + inv
+        ucodes, first_idx, inv2 = np.unique(
+            codes, return_index=True, return_inverse=True)
+        g = len(ucodes)
+        rep = sel[first_idx]  # representative row per group
+        out_keys = [col[rep] for col in key_cols]
+        counts = np.bincount(inv2, minlength=g).astype(np.int64)
+
+        def scatter_sum(vals):
+            out = np.zeros(g)
+            np.add.at(out, inv2, vals)
+            return out
+
+        def scatter_min(vals):
+            out = np.full(g, np.inf)
+            np.minimum.at(out, inv2, vals)
+            return out
+
+        def scatter_max(vals):
+            out = np.full(g, -np.inf)
+            np.maximum.at(out, inv2, vals)
+            return out
+
+        states, specs, tags = [], [], []
+        for agg, vals in zip(query.aggregations, agg_vals):
+            name = agg.function.name
+            spec, tag = VEC_RECIPES[name]  # shared with the device lowering
+            if name == "count":
+                states.append((counts,))
+            elif name == "sum":
+                states.append((scatter_sum(vals),))
+            elif name == "min":
+                states.append((scatter_min(vals),))
+            elif name == "max":
+                states.append((scatter_max(vals),))
+            elif name == "avg":
+                states.append((scatter_sum(vals), counts))
+            else:  # minmaxrange
+                states.append((scatter_min(vals), scatter_max(vals)))
+            specs.append(spec)
+            tags.append(tag)
+        return GroupArrays(out_keys, states, specs, tags,
+                           num_docs_scanned=int(mask.sum()))
 
     def _selection(self, query, segment, mask) -> SelectionIntermediate:
         from .selection import selection_columns_for

@@ -33,6 +33,7 @@ from .aggregation import AggPlanContext, LoweredAgg, UnsupportedQueryError, lowe
 
 DENSE_GROUP_LIMIT = 1 << 21  # beyond this the dense segment_sum table blows HBM
 SPARSE_KEY_LIMIT = ir.SPARSE_KEY_SPACE  # keys stay below the kernel sentinel
+SPARSE_GROUPS_LIMIT = 1 << 25  # cap on sparse output table slots (~256MB/agg)
 DEFAULT_NUM_GROUPS_LIMIT = 100_000  # reference InstancePlanMakerImplV2 default
 _SPARSE_AGG_KINDS = {"count", "sum", "sumsq", "min", "max"}
 
@@ -579,6 +580,12 @@ class SegmentPlanner(AggPlanContext):
                     "numGroupsLimit", DEFAULT_NUM_GROUPS_LIMIT))
                 mode = "group_by_sparse"
                 out_groups = min(num_groups, max(1, limit))
+                if out_groups > SPARSE_GROUPS_LIMIT:
+                    # bound device output allocation the same way the dense
+                    # path bounds its table
+                    raise UnsupportedQueryError(
+                        f"numGroupsLimit {out_groups} exceeds sparse output "
+                        f"cap {SPARSE_GROUPS_LIMIT}")
             else:
                 mode = "group_by" if group_exprs else "aggregation"
                 out_groups = num_groups

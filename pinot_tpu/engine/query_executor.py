@@ -41,6 +41,14 @@ from .results import (
 def _estimate_bytes(inter) -> int:
     """Rough intermediate footprint for the accountant (reference samples
     real allocations via ThreadMXBean; here: container-size heuristics)."""
+    from .results import GroupArrays
+
+    if isinstance(inter, GroupArrays):
+        # size from the columns; do NOT touch .groups (materializing the
+        # dict is exactly the per-group cost the columnar path avoids)
+        return (sum(k.nbytes for k in inter.key_cols)
+                + sum(c.nbytes for comps in inter.state_cols for c in comps)
+                + 64)
     if isinstance(inter, GroupByIntermediate):
         width = 1 + max((len(v) for v in inter.groups.values()), default=0)
         return 64 * width * len(inter.groups)
@@ -63,13 +71,25 @@ class QueryExecutor:
     """Executes SQL over registered tables. backend: "tpu" | "host" | "auto"
     (auto = tpu with host fallback per query shape)."""
 
-    def __init__(self, backend: str = "auto"):
+    def __init__(self, backend: str = "auto", num_threads: int = 1):
         self.backend = backend
         self.tables: dict[str, Table] = {}
         self.tpu = TpuSegmentExecutor()
         self.host = HostSegmentExecutor()
         self.pruner = SegmentPrunerService()
         self.use_star_tree = True  # reference: useStarTree query option default true
+        # >1: host-path segments run on a worker pool, the reference's
+        # combine-operator fan-out (GroupByCombineOperator.java:54 runs one
+        # task per segment on a shared executor)
+        self.num_threads = max(1, int(num_threads))
+        self._pool = None
+
+    def _host_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=self.num_threads)
+        return self._pool
 
     def add_table(self, schema: Schema, segments: list[ImmutableSegment], name: Optional[str] = None):
         """``segments`` is held BY REFERENCE when it is a list: realtime data
@@ -171,21 +191,8 @@ class QueryExecutor:
         timeout_ms = query.query_options.get("timeoutMs")
         if timeout_ms is not None:
             deadline = time.perf_counter() + float(timeout_ms) / 1000
-        intermediates = []
-        for segment in kept:
-            if tracker is not None:
-                tracker.check_cancel()
-            if deadline is not None and time.perf_counter() > deadline:
-                raise TimeoutError(
-                    f"query exceeded timeoutMs={timeout_ms} "
-                    f"({len(intermediates)}/{len(kept)} segments done)")
-            cpu0 = time.thread_time_ns()
-            with TRACING.scope(f"segment:{getattr(segment, 'name', '?')}"):
-                inter = self._execute_segment(query, segment)
-            if tracker is not None:
-                tracker.add_cpu_ns(time.thread_time_ns() - cpu0)
-                GLOBAL_ACCOUNTANT.on_allocation(tracker, _estimate_bytes(inter))
-            intermediates.append(inter)
+        intermediates = self._run_segments(query, kept, tracker, deadline,
+                                           timeout_ms)
         combined = self._combine(query, intermediates)
         SERVER_METRICS.add_meter(ServerMeter.QUERIES)
         SERVER_METRICS.add_meter(ServerMeter.NUM_DOCS_SCANNED,
@@ -198,30 +205,109 @@ class QueryExecutor:
             "num_segments_pruned": num_pruned,
         }
 
-    def _execute_segment(self, query: QueryContext, segment: ImmutableSegment):
+    def _run_segments(self, query: QueryContext, kept: list, tracker,
+                      deadline, timeout_ms) -> list:
+        """Two-phase multi-segment execution: dispatch every device kernel
+        first (async — the device queue fills and runs back-to-back), run
+        host-fallback segments while the device works, then collect. This
+        replaces the serial plan→dispatch→block loop the reference handles
+        with a worker pool (GroupByCombineOperator.java:54); here the
+        pipeline overlap comes from XLA's async dispatch instead of threads."""
+
+        def check(done: int):
+            if tracker is not None:
+                tracker.check_cancel()
+            if deadline is not None and time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"query exceeded timeoutMs={timeout_ms} "
+                    f"({done}/{len(kept)} segments done)")
+
+        pending: list = []  # (idx, run_query, segment, rewrite, plan, outs)
+        host_work: list = []  # (idx, run_query, run_segment, rewrite)
+        intermediates: list = [None] * len(kept)
+        for idx, segment in enumerate(kept):
+            check(idx)
+            run_query, run_segment, rewrite = self._segment_route(query, segment)
+            if self.backend == "host" or getattr(run_segment, "is_mutable", False):
+                # consuming segments execute on host (unsorted mutable
+                # dictionaries have no device predicate form until commit)
+                host_work.append((idx, run_query, run_segment, rewrite))
+                continue
+            try:
+                plan = self.tpu.plan(run_query, run_segment)
+                outs = self.tpu.dispatch_plan(run_segment, plan)
+            except UnsupportedQueryError:
+                if self.backend == "tpu":
+                    raise
+                host_work.append((idx, run_query, run_segment, rewrite))
+                continue
+            pending.append((idx, run_query, run_segment, rewrite, plan, outs))
+
+        done = 0
+        if self.num_threads > 1 and len(host_work) > 1:
+            caller_trace = TRACING.active_trace()
+
+            def run_one(run_query, run_segment):
+                TRACING.adopt(caller_trace)  # traces are thread-local
+                try:
+                    cpu0 = time.thread_time_ns()
+                    with TRACING.scope(
+                            f"segment:{getattr(run_segment, 'name', '?')}"):
+                        inter = self.host.execute(run_query, run_segment)
+                    return inter, time.thread_time_ns() - cpu0
+                finally:
+                    TRACING.adopt(None)
+
+            futs = [
+                (idx, rewrite, self._host_pool().submit(
+                    run_one, run_query, run_segment))
+                for idx, run_query, run_segment, rewrite in host_work]
+            for idx, rewrite, fut in futs:
+                check(done)
+                inter, cpu_ns = fut.result()
+                if tracker is not None:
+                    tracker.add_cpu_ns(cpu_ns)
+                    GLOBAL_ACCOUNTANT.on_allocation(
+                        tracker, _estimate_bytes(inter))
+                intermediates[idx] = (
+                    self._remap_star_tree(rewrite, inter) if rewrite else inter)
+                done += 1
+            host_work = []
+        for idx, run_query, run_segment, rewrite in host_work:
+            check(done)
+            inter = self._account(tracker, lambda: self.host.execute(
+                run_query, run_segment), run_segment)
+            intermediates[idx] = (
+                self._remap_star_tree(rewrite, inter) if rewrite else inter)
+            done += 1
+        for idx, run_query, run_segment, rewrite, plan, outs in pending:
+            check(done)
+            inter = self._account(tracker, lambda: self.tpu.collect(
+                run_query, run_segment, plan, outs), run_segment)
+            intermediates[idx] = (
+                self._remap_star_tree(rewrite, inter) if rewrite else inter)
+            done += 1
+        return intermediates
+
+    def _segment_route(self, query: QueryContext, segment):
         rewrite = None
         # star-tree pre-aggregates ignore upsert validity → not applicable
         if self.use_star_tree and getattr(segment, "valid_doc_ids", None) is None:
             from ..segment.startree import try_rewrite
 
             rewrite = try_rewrite(query, segment)
-        run_query, run_segment = (
-            (rewrite.query, rewrite.view) if rewrite is not None else (query, segment))
-
-        if self.backend == "host" or getattr(run_segment, "is_mutable", False):
-            # consuming segments execute on host (unsorted mutable
-            # dictionaries have no device predicate form until commit)
-            result = self.host.execute(run_query, run_segment)
-        elif self.backend == "tpu":
-            result = self.tpu.execute(run_query, run_segment)
-        else:
-            try:
-                result = self.tpu.execute(run_query, run_segment)
-            except UnsupportedQueryError:
-                result = self.host.execute(run_query, run_segment)
         if rewrite is not None:
-            result = self._remap_star_tree(rewrite, result)
-        return result
+            return rewrite.query, rewrite.view, rewrite
+        return query, segment, None
+
+    def _account(self, tracker, fn, segment):
+        cpu0 = time.thread_time_ns()
+        with TRACING.scope(f"segment:{getattr(segment, 'name', '?')}"):
+            inter = fn()
+        if tracker is not None:
+            tracker.add_cpu_ns(time.thread_time_ns() - cpu0)
+            GLOBAL_ACCOUNTANT.on_allocation(tracker, _estimate_bytes(inter))
+        return inter
 
     @staticmethod
     def _remap_star_tree(rewrite, result):
@@ -241,8 +327,16 @@ class QueryExecutor:
         return result
 
     def _combine(self, query: QueryContext, intermediates):
+        from .combine import combine_group_arrays
+        from .results import GroupArrays
+
         semantics = [semantics_for(a) for a in query.aggregations]
         first = intermediates[0] if intermediates else None
+        if (isinstance(first, GroupArrays)
+                and all(isinstance(im, GroupArrays) for im in intermediates)):
+            merged = combine_group_arrays(intermediates)
+            if merged is not None:
+                return merged
         if isinstance(first, GroupByIntermediate):
             return combine_group_by(intermediates, semantics)
         if isinstance(first, AggIntermediate):

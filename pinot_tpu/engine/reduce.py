@@ -22,10 +22,13 @@ from .results import (
     AggIntermediate,
     BrokerResponse,
     DataSchema,
+    GroupArrays,
     GroupByIntermediate,
     ResultTable,
     SelectionIntermediate,
 )
+
+import numpy as np
 
 
 class BrokerReducer:
@@ -49,6 +52,12 @@ class BrokerReducer:
             group_exprs = list(query.select_expressions)
         agg_exprs = query.aggregations
         semantics = [semantics_for(a) for a in agg_exprs]
+
+        if isinstance(combined, GroupArrays):
+            fast = self._fast_group_reduce(query, combined, group_exprs,
+                                           agg_exprs)
+            if fast is not None:
+                return fast
 
         # env rows: expression-string → value (+ select aliases, so ORDER BY
         # and HAVING can reference them like the reference's alias handling)
@@ -79,6 +88,47 @@ class BrokerReducer:
         for env in env_rows[query.offset : query.offset + query.limit]:
             rows.append([_round_type(_eval_post(e, env), t)
                          for e, t in zip(query.select_expressions, types)])
+        return ResultTable(DataSchema(names, types), rows)
+
+    def _fast_group_reduce(self, query: QueryContext, ga: GroupArrays,
+                           group_exprs, agg_exprs) -> Optional[ResultTable]:
+        """Vectorized reduce for the standard SELECT keys..., aggs... shape:
+        finalize as numpy columns, argsort for ORDER BY, materialize only the
+        LIMIT window. Returns None (→ general env-dict path) for HAVING,
+        post-aggregation expressions, or anything else off the fast shape."""
+        if query.having_filter is not None:
+            return None
+        colmap: dict[str, np.ndarray] = {}
+        for ge, col in zip(group_exprs, ga.key_cols):
+            colmap[str(ge)] = col
+        for ae, tag, comps in zip(agg_exprs, ga.fin_tags, ga.state_cols):
+            colmap[str(ae)] = _apply_fin_tag(tag, comps)
+        for se, alias in zip(query.select_expressions, query.aliases):
+            if alias and str(se) in colmap:
+                colmap.setdefault(alias, colmap[str(se)])
+        if any(str(e) not in colmap for e in query.select_expressions):
+            return None
+        order = []
+        for ob in query.order_by_expressions or []:
+            col = colmap.get(str(ob.expression))
+            if col is None:
+                return None
+            if not ob.ascending and col.dtype == object:
+                return None  # descending strings: let the general path sort
+            order.append((col, ob.ascending))
+
+        perm = np.arange(ga.num_groups)
+        for col, asc in reversed(order):
+            vals = col[perm]
+            k = (np.argsort(vals, kind="stable") if asc
+                 else np.argsort(-vals, kind="stable"))
+            perm = perm[k]
+        sel = perm[query.offset: query.offset + query.limit]
+        names, types = self._select_schema(query, group_exprs)
+        out_cols = [colmap[str(e)][sel].tolist()
+                    for e in query.select_expressions]
+        rows = [[_round_type(v, t) for v, t in zip(r, types)]
+                for r in zip(*out_cols)]
         return ResultTable(DataSchema(names, types), rows)
 
     def _reduce_aggregation(self, query: QueryContext, combined: AggIntermediate) -> ResultTable:
@@ -304,6 +354,19 @@ def _sort_key(v):
     if isinstance(v, bool):
         return (0, int(v))
     return (0, v)
+
+
+def _apply_fin_tag(tag: tuple, comps: tuple) -> np.ndarray:
+    """Evaluate a picklable finalize recipe over state component columns."""
+    if tag[0] == "id":
+        return comps[tag[1]]
+    if tag[0] == "sub":
+        return comps[tag[1]] - comps[tag[2]]
+    if tag[0] == "div":
+        num, den = comps[tag[1]].astype(float), comps[tag[2]]
+        return np.divide(num, den, out=np.full(len(num), math.nan),
+                         where=den != 0)
+    raise ValueError(f"unknown finalize tag {tag}")
 
 
 def _round_type(v, t: str):

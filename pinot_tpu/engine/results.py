@@ -72,6 +72,58 @@ class GroupByIntermediate:
     num_docs_scanned: int = 0
 
 
+class GroupArrays(GroupByIntermediate):
+    """Columnar group-by intermediate — the vectorized fast path.
+
+    The dict-of-tuples form costs microseconds per group in Python; at the
+    reference's numGroupsLimit (100K groups/segment) that dominates query
+    time. Scalar reductions (COUNT/SUM/MIN/MAX/AVG/RANGE) instead travel as
+    numpy columns: ``key_cols`` hold decoded group VALUES per dimension and
+    ``state_cols[i]`` is a tuple of per-component arrays for aggregation i
+    (avg → (sum, count)). ``vec_specs[i]`` gives each component's merge op
+    ("add"|"min"|"max"); ``fin_tags[i]`` a picklable finalize recipe
+    (("id",c) | ("div",a,b) | ("sub",a,b)) so the broker can finalize
+    without callables crossing the wire.
+
+    ``groups`` materializes the per-group dict lazily, so every general-path
+    consumer (cluster broker merge, MSE, HAVING/post-agg reduce) keeps
+    working unchanged.
+    """
+
+    def __init__(self, key_cols, state_cols, vec_specs, fin_tags,
+                 num_docs_scanned: int = 0):
+        self.key_cols = list(key_cols)
+        self.state_cols = [tuple(c) for c in state_cols]
+        self.vec_specs = [tuple(s) for s in vec_specs]
+        self.fin_tags = list(fin_tags)
+        self.num_docs_scanned = num_docs_scanned
+        self._groups: Optional[dict] = None
+
+    @property
+    def num_groups(self) -> int:
+        if self.key_cols:
+            return len(self.key_cols[0])
+        if self.state_cols:
+            return len(self.state_cols[0][0])
+        return 0
+
+    @property
+    def groups(self) -> dict:
+        if self._groups is None:
+            keys = list(zip(*(c.tolist() for c in self.key_cols)))
+            per_agg = []
+            for comps in self.state_cols:
+                lists = [c.tolist() for c in comps]
+                per_agg.append(lists[0] if len(lists) == 1 else list(zip(*lists)))
+            self._groups = {
+                k: [pa[j] for pa in per_agg] for j, k in enumerate(keys)}
+        return self._groups
+
+    @groups.setter
+    def groups(self, value):  # general-path consumers may assign
+        self._groups = value
+
+
 @dataclass
 class AggIntermediate:
     states: list  # one state per aggregation

@@ -73,6 +73,11 @@ class _Tracing:
     def active_trace(self) -> Optional[Trace]:
         return getattr(self._local, "trace", None)
 
+    def adopt(self, trace: Optional[Trace]) -> None:
+        """Make another thread's trace active here (worker-pool fan-out:
+        the reference's per-thread registration in combine workers)."""
+        self._local.trace = trace
+
     def end_trace(self) -> Optional[Trace]:
         trace = self.active_trace()
         self._local.trace = None
