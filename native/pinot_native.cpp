@@ -157,3 +157,311 @@ void group_agg_f64(const int64_t* codes, const double* vals, int64_t n,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Chunk compression codecs (reference: ChunkCompressionType —
+// pinot-segment-spi/.../compression/ChunkCompressionType.java:22 — backed
+// there by JNI lz4/snappy/zstd libraries). Clean-room implementations of the
+// public LZ4 block format and Snappy format specs; ZSTD/GZIP ride Python's
+// zstandard/zlib on the host side (segment/compression.py).
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// ---- LZ4 block format -----------------------------------------------------
+// Layout per sequence: token (hi nibble literal len, lo nibble match len-4,
+// 15 = continued in 255-run bytes), literals, 2-byte LE offset, ext match
+// len. Final sequence is literals-only. Spec constraints honored: last 5
+// bytes are literals, no match starts within the last 12 bytes.
+
+int64_t lz4_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                       int64_t dst_cap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + src_len;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dst_cap;
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        int64_t lit = token >> 4;
+        if (lit == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                lit += b;
+            } while (b == 255);
+        }
+        if (ip + lit > iend || op + lit > oend) return -1;
+        memcpy(op, ip, (size_t)lit);
+        ip += lit;
+        op += lit;
+        if (ip >= iend) break;  // final literals-only sequence
+        if (ip + 2 > iend) return -1;
+        int64_t offset = (int64_t)ip[0] | ((int64_t)ip[1] << 8);
+        ip += 2;
+        if (offset == 0 || op - dst < offset) return -1;
+        int64_t mlen = token & 15;
+        if (mlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                mlen += b;
+            } while (b == 255);
+        }
+        mlen += 4;
+        if (op + mlen > oend) return -1;
+        const uint8_t* match = op - offset;
+        for (int64_t i = 0; i < mlen; i++) op[i] = match[i];  // overlap-safe
+        op += mlen;
+    }
+    return op - dst;
+}
+
+static bool lz4_emit(uint8_t*& op, uint8_t* oend, const uint8_t* src,
+                     int64_t lit_start, int64_t lit_len, int64_t offset,
+                     int64_t mlen) {
+    uint8_t* token = op;
+    if (op >= oend) return false;
+    op++;
+    int64_t l = lit_len;
+    *token = (uint8_t)((l >= 15 ? 15 : l) << 4);
+    if (l >= 15) {
+        l -= 15;
+        while (l >= 255) {
+            if (op >= oend) return false;
+            *op++ = 255;
+            l -= 255;
+        }
+        if (op >= oend) return false;
+        *op++ = (uint8_t)l;
+    }
+    if (op + lit_len > oend) return false;
+    memcpy(op, src + lit_start, (size_t)lit_len);
+    op += lit_len;
+    if (offset) {
+        int64_t ml = mlen - 4;
+        if (op + 2 > oend) return false;
+        *op++ = (uint8_t)(offset & 0xFF);
+        *op++ = (uint8_t)(offset >> 8);
+        if (ml >= 15) {
+            *token |= 15;
+            ml -= 15;
+            while (ml >= 255) {
+                if (op >= oend) return false;
+                *op++ = 255;
+                ml -= 255;
+            }
+            if (op >= oend) return false;
+            *op++ = (uint8_t)ml;
+        } else {
+            *token |= (uint8_t)ml;
+        }
+    }
+    return true;
+}
+
+// Greedy hash-chain-free LZ4 compressor (single-probe table, the classic
+// fast-mode design). dst_cap must be >= n + n/255 + 16.
+int64_t lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                     int64_t dst_cap) {
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dst_cap;
+    const int HASH_LOG = 16;
+    std::vector<int64_t> table((size_t)1 << HASH_LOG, -1);
+    int64_t anchor = 0;
+    const int64_t mflimit = n - 12;
+    int64_t i = 0;
+    while (i < mflimit) {
+        uint32_t v;
+        memcpy(&v, src + i, 4);
+        uint32_t h = (v * 2654435761u) >> (32 - HASH_LOG);
+        int64_t cand = table[h];
+        table[h] = i;
+        uint32_t w;
+        if (cand >= 0 && i - cand <= 65535) {
+            memcpy(&w, src + cand, 4);
+            if (v == w) {
+                int64_t maxm = (n - 5) - i;  // keep last 5 bytes literal
+                int64_t mlen = 4;
+                while (mlen < maxm && src[cand + mlen] == src[i + mlen]) mlen++;
+                if (!lz4_emit(op, oend, src, anchor, i - anchor, i - cand, mlen))
+                    return -1;
+                i += mlen;
+                anchor = i;
+                continue;
+            }
+        }
+        i++;
+    }
+    if (!lz4_emit(op, oend, src, anchor, n - anchor, 0, 0)) return -1;
+    return op - dst;
+}
+
+// ---- Snappy format --------------------------------------------------------
+// Preamble: uncompressed length as varint. Elements: tag low 2 bits —
+// 00 literal (len-1 in tag>>2, 60..63 → that many extra LE length bytes),
+// 01 copy len 4..11 / 11-bit offset, 10 copy len 1..64 / 16-bit LE offset,
+// 11 copy with 32-bit offset.
+
+int64_t snappy_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                          int64_t dst_cap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + src_len;
+    // varint preamble
+    uint64_t expect = 0;
+    int shift = 0;
+    while (true) {
+        if (ip >= iend || shift > 63) return -1;
+        uint8_t b = *ip++;
+        expect |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    if ((int64_t)expect > dst_cap) return -1;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dst_cap;
+    while (ip < iend) {
+        uint8_t tag = *ip++;
+        int kind = tag & 3;
+        if (kind == 0) {  // literal
+            int64_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                int extra = (int)len - 60;
+                if (ip + extra > iend) return -1;
+                len = 0;
+                for (int b = 0; b < extra; b++)
+                    len |= (int64_t)ip[b] << (8 * b);
+                len += 1;
+                ip += extra;
+            }
+            if (ip + len > iend || op + len > oend) return -1;
+            memcpy(op, ip, (size_t)len);
+            ip += len;
+            op += len;
+            continue;
+        }
+        int64_t len, offset;
+        if (kind == 1) {
+            len = ((tag >> 2) & 0x7) + 4;
+            if (ip >= iend) return -1;
+            offset = ((int64_t)(tag >> 5) << 8) | *ip++;
+        } else if (kind == 2) {
+            len = (tag >> 2) + 1;
+            if (ip + 2 > iend) return -1;
+            offset = (int64_t)ip[0] | ((int64_t)ip[1] << 8);
+            ip += 2;
+        } else {
+            len = (tag >> 2) + 1;
+            if (ip + 4 > iend) return -1;
+            offset = (int64_t)ip[0] | ((int64_t)ip[1] << 8) |
+                     ((int64_t)ip[2] << 16) | ((int64_t)ip[3] << 24);
+            ip += 4;
+        }
+        if (offset == 0 || op - dst < offset || op + len > oend) return -1;
+        const uint8_t* match = op - offset;
+        for (int64_t b = 0; b < len; b++) op[b] = match[b];
+        op += len;
+    }
+    return (op - dst) == (int64_t)expect ? (op - dst) : -1;
+}
+
+static bool snappy_emit_literal(uint8_t*& op, uint8_t* oend,
+                                const uint8_t* src, int64_t start,
+                                int64_t len) {
+    while (len > 0) {
+        int64_t chunk = len;  // literal lengths are unbounded via extra bytes
+        int64_t l = chunk - 1;
+        if (l < 60) {
+            if (op + 1 + chunk > oend) return false;
+            *op++ = (uint8_t)(l << 2);
+        } else if (l < (1 << 8)) {
+            if (op + 2 + chunk > oend) return false;
+            *op++ = (uint8_t)(60 << 2);
+            *op++ = (uint8_t)l;
+        } else if (l < (1 << 16)) {
+            if (op + 3 + chunk > oend) return false;
+            *op++ = (uint8_t)(61 << 2);
+            *op++ = (uint8_t)(l & 0xFF);
+            *op++ = (uint8_t)(l >> 8);
+        } else if (l < (1LL << 24)) {
+            if (op + 4 + chunk > oend) return false;
+            *op++ = (uint8_t)(62 << 2);
+            *op++ = (uint8_t)(l & 0xFF);
+            *op++ = (uint8_t)((l >> 8) & 0xFF);
+            *op++ = (uint8_t)(l >> 16);
+        } else {
+            if (op + 5 + chunk > oend) return false;
+            *op++ = (uint8_t)(63 << 2);
+            *op++ = (uint8_t)(l & 0xFF);
+            *op++ = (uint8_t)((l >> 8) & 0xFF);
+            *op++ = (uint8_t)((l >> 16) & 0xFF);
+            *op++ = (uint8_t)((l >> 24) & 0xFF);
+        }
+        memcpy(op, src + start, (size_t)chunk);
+        op += chunk;
+        start += chunk;
+        len -= chunk;
+    }
+    return true;
+}
+
+static bool snappy_emit_copy(uint8_t*& op, uint8_t* oend, int64_t offset,
+                             int64_t len) {
+    // 16-bit-offset copies, 1..64 bytes each
+    while (len > 0) {
+        int64_t chunk = len > 64 ? 64 : len;
+        if (len - chunk > 0 && len - chunk < 4) chunk = len - 4;  // keep ≥4 tail
+        if (op + 3 > oend) return false;
+        *op++ = (uint8_t)(((chunk - 1) << 2) | 2);
+        *op++ = (uint8_t)(offset & 0xFF);
+        *op++ = (uint8_t)(offset >> 8);
+        len -= chunk;
+    }
+    return true;
+}
+
+// Greedy snappy compressor (16-bit offsets only; matches within 65535).
+// dst_cap must be >= 32 + n + n/6.
+int64_t snappy_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                        int64_t dst_cap) {
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dst_cap;
+    // varint preamble
+    uint64_t v = (uint64_t)n;
+    do {
+        if (op >= oend) return -1;
+        uint8_t b = (uint8_t)(v & 0x7F);
+        v >>= 7;
+        *op++ = v ? (b | 0x80) : b;
+    } while (v);
+    const int HASH_LOG = 16;
+    std::vector<int64_t> table((size_t)1 << HASH_LOG, -1);
+    int64_t anchor = 0, i = 0;
+    while (i + 4 <= n) {
+        uint32_t x;
+        memcpy(&x, src + i, 4);
+        uint32_t h = (x * 2654435761u) >> (32 - HASH_LOG);
+        int64_t cand = table[h];
+        table[h] = i;
+        uint32_t y;
+        if (cand >= 0 && i - cand <= 65535) {
+            memcpy(&y, src + cand, 4);
+            if (x == y) {
+                int64_t mlen = 4;
+                while (i + mlen < n && src[cand + mlen] == src[i + mlen]) mlen++;
+                if (!snappy_emit_literal(op, oend, src, anchor, i - anchor))
+                    return -1;
+                if (!snappy_emit_copy(op, oend, i - cand, mlen)) return -1;
+                i += mlen;
+                anchor = i;
+                continue;
+            }
+        }
+        i++;
+    }
+    if (!snappy_emit_literal(op, oend, src, anchor, n - anchor)) return -1;
+    return op - dst;
+}
+
+}  // extern "C"

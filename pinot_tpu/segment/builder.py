@@ -264,9 +264,10 @@ class SegmentBuilder:
     def _build_sv_column(self, writer, name, spec, values, num_docs, raw: bool) -> ColumnMetadata:
         values, nulls = self._replace_nulls(values, spec)
         dt = spec.data_type
+        codec = self.table_config.indexing.compression_configs.get(name)
         if raw and dt.is_fixed_width:
             arr = np.ascontiguousarray(values, dtype=dt.numpy_dtype)
-            writer.add_buffer(f"{name}.fwd", arr)
+            writer.add_buffer(f"{name}.fwd", arr, codec=codec)
             meta = ColumnMetadata(
                 name=name, data_type=dt.value, field_type=spec.field_type.value,
                 encoding="RAW", cardinality=0, bits_per_value=arr.dtype.itemsize * 8,
@@ -275,10 +276,17 @@ class SegmentBuilder:
                 is_sorted=bool(num_docs == 0 or np.all(np.diff(arr) >= 0)),
                 total_number_of_entries=num_docs,
             )
+        elif raw:
+            # var-byte raw (STRING/BYTES/JSON): utf-8 stream + u64 offsets,
+            # no dictionary required for selection (reference
+            # VarByteChunkForwardIndexWriterV4)
+            meta = self._build_var_byte_column(
+                writer, name, spec, values, num_docs, codec)
         else:
             dictionary, dict_ids = build_dictionary(values, dt)
             bits = bitpack.num_bits_for_cardinality(dictionary.cardinality)
-            writer.add_buffer(f"{name}.fwd", bitpack.pack(dict_ids, bits))
+            writer.add_buffer(f"{name}.fwd", bitpack.pack(dict_ids, bits),
+                              codec=codec)
             writer.add_buffer(f"{name}.dict", serialize_dictionary(dictionary))
             meta = ColumnMetadata(
                 name=name, data_type=dt.value, field_type=spec.field_type.value,
@@ -291,6 +299,43 @@ class SegmentBuilder:
             writer.add_buffer(f"{name}.nulls", bitpack.pack_bitmap(nulls))
             meta.has_nulls = True
         return meta
+
+    def _build_var_byte_column(self, writer, name, spec, values, num_docs,
+                               codec) -> ColumnMetadata:
+        dt = spec.data_type
+        is_bytes = dt.value == "BYTES"
+        offsets = np.zeros(num_docs + 1, dtype=np.uint64)
+        parts = []
+        total = 0
+        mn = mx = None
+        is_sorted = True
+        prev = None
+        for i, v in enumerate(values):
+            if is_bytes:
+                b = bytes(v)
+            else:
+                v = str(v)
+                b = v.encode("utf-8")
+                v_cmp = v
+            v_cmp = b if is_bytes else v
+            parts.append(b)
+            total += len(b)
+            offsets[i + 1] = total
+            if mn is None or v_cmp < mn:
+                mn = v_cmp
+            if mx is None or v_cmp > mx:
+                mx = v_cmp
+            if prev is not None and v_cmp < prev:
+                is_sorted = False
+            prev = v_cmp
+        writer.add_buffer(f"{name}.fwd", b"".join(parts), codec=codec)
+        writer.add_buffer(f"{name}.voff", offsets, codec=codec)
+        return ColumnMetadata(
+            name=name, data_type=dt.value, field_type=spec.field_type.value,
+            encoding="RAW", cardinality=0, bits_per_value=0,
+            min_value=mn, max_value=mx, is_sorted=is_sorted,
+            total_number_of_entries=num_docs,
+        )
 
     def _build_mv_column(self, writer, name, spec, values, num_docs) -> ColumnMetadata:
         """MV column: flatten value lists, dict-encode the stream, store u32 offsets.

@@ -126,11 +126,17 @@ class SegmentWriter:
     def __init__(self, directory: str | Path):
         self.directory = Path(directory)
         self._buffers: list[tuple[str, bytes]] = []
+        # buffer name -> codec; applied at write() so peek_buffer and the
+        # index builders always see uncompressed bytes
+        self.compress_on_write: dict[str, str] = {}
 
-    def add_buffer(self, name: str, data: bytes | np.ndarray) -> None:
+    def add_buffer(self, name: str, data: bytes | np.ndarray,
+                   codec: Optional[str] = None) -> None:
         if isinstance(data, np.ndarray):
             data = data.tobytes()
         self._buffers.append((name, data))
+        if codec and codec.upper() != "PASS_THROUGH":
+            self.compress_on_write[name] = codec.upper()
 
     def buffer_names(self) -> set[str]:
         return {name for name, _ in self._buffers}
@@ -146,12 +152,20 @@ class SegmentWriter:
     def write(self, metadata: SegmentMetadata) -> None:
         import zlib
 
+        from .compression import compress_buffer
+
         self.directory.mkdir(parents=True, exist_ok=True)
         offset = 0
         crc = 0
         with open(self.directory / DATA_FILE, "wb") as f:
             for name, data in self._buffers:
-                metadata.buffers[name] = [offset, len(data)]
+                codec = self.compress_on_write.get(name)
+                if codec:
+                    data = compress_buffer(data, codec)
+                    # third element marks the buffer as a PTCC container
+                    metadata.buffers[name] = [offset, len(data), codec]
+                else:
+                    metadata.buffers[name] = [offset, len(data)]
                 f.write(data)
                 crc = zlib.crc32(data, crc)
                 offset += len(data)

@@ -29,6 +29,7 @@ class ImmutableSegment:
         self.metadata: SegmentMetadata = read_metadata(self.directory)
         self._data = np.memmap(self.directory / DATA_FILE, dtype=np.uint8, mode="r")
         self._dictionaries: dict[str, Dictionary] = {}
+        self._decompressed: dict[str, np.ndarray] = {}
         self._dict_ids: dict[str, np.ndarray] = {}
         self._raw: dict[str, np.ndarray] = {}
         self._nulls: dict[str, Optional[np.ndarray]] = {}
@@ -55,7 +56,17 @@ class ImmutableSegment:
 
     # -- buffers -----------------------------------------------------------
     def _buffer(self, name: str) -> np.ndarray:
-        off, size = self.metadata.buffers[name]
+        entry = self.metadata.buffers[name]
+        if len(entry) == 3:  # [offset, size, codec]: PTCC-compressed buffer
+            if name not in self._decompressed:
+                from .compression import decompress_buffer
+
+                off, size, _codec = entry
+                self._decompressed[name] = np.frombuffer(
+                    decompress_buffer(self._data[off:off + size]),
+                    dtype=np.uint8)
+            return self._decompressed[name]
+        off, size = entry
         return self._data[off : off + size]
 
     def get_dictionary(self, column: str) -> Dictionary:
@@ -104,8 +115,23 @@ class ImmutableSegment:
         if column not in self._raw:
             m = self.column_metadata(column)
             assert m.encoding == "RAW"
-            dt = DataType(m.data_type).numpy_dtype
-            self._raw[column] = np.frombuffer(self._buffer(f"{column}.fwd"), dtype=dt, count=self.num_docs)
+            dtype = DataType(m.data_type)
+            if not dtype.is_fixed_width:
+                # var-byte raw column: value stream + u64 offsets
+                # (reference VarByteChunkForwardIndexReaderV4)
+                blob = self._buffer(f"{column}.fwd").tobytes()
+                offs = np.frombuffer(self._buffer(f"{column}.voff"),
+                                     dtype=np.uint64, count=self.num_docs + 1)
+                out = np.empty(self.num_docs, dtype=object)
+                decode = dtype.value != "BYTES"
+                for i in range(self.num_docs):
+                    piece = blob[int(offs[i]):int(offs[i + 1])]
+                    out[i] = piece.decode("utf-8") if decode else piece
+                self._raw[column] = out
+            else:
+                self._raw[column] = np.frombuffer(
+                    self._buffer(f"{column}.fwd"),
+                    dtype=dtype.numpy_dtype, count=self.num_docs)
         return self._raw[column]
 
     def get_null_bitmap(self, column: str) -> Optional[np.ndarray]:
@@ -322,6 +348,7 @@ class ImmutableSegment:
         self._dict_ids.clear()
         self._raw.clear()
         self._dictionaries.clear()
+        self._decompressed.clear()
         self._nulls.clear()
         self._mv_offsets.clear()
         self._data = None

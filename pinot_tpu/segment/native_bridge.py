@@ -71,6 +71,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.factorize_i64.restype = ctypes.c_int64
         lib.group_agg_f64.argtypes = [i64, f64, ctypes.c_int64,
                                       ctypes.c_int64, f64, i64, f64, f64]
+        for fn in ("lz4_compress", "lz4_decompress",
+                   "snappy_compress", "snappy_decompress"):
+            f = getattr(lib, fn)
+            f.argtypes = [u8, ctypes.c_int64, u8, ctypes.c_int64]
+            f.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -154,3 +159,33 @@ def group_agg_f64(codes: np.ndarray, vals: np.ndarray, num_groups: int):
                       _ptr(counts, ctypes.c_int64), _ptr(mins, ctypes.c_double),
                       _ptr(maxs, ctypes.c_double))
     return sums, counts, mins, maxs
+
+
+def _codec_call(fn_name: str, src: bytes, dst_cap: int) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    src_arr = np.frombuffer(src, dtype=np.uint8) if src else np.empty(0, np.uint8)
+    src_arr = np.ascontiguousarray(src_arr)
+    dst = np.empty(max(1, dst_cap), dtype=np.uint8)
+    n = getattr(lib, fn_name)(_ptr(src_arr, ctypes.c_uint8), len(src),
+                              _ptr(dst, ctypes.c_uint8), dst_cap)
+    if n < 0:
+        raise ValueError(f"{fn_name}: corrupt or oversized stream")
+    return dst[:n].tobytes()
+
+
+def lz4_compress(data: bytes) -> Optional[bytes]:
+    return _codec_call("lz4_compress", data, len(data) + len(data) // 255 + 16)
+
+
+def lz4_decompress(blob: bytes, raw_size: int) -> Optional[bytes]:
+    return _codec_call("lz4_decompress", blob, raw_size)
+
+
+def snappy_compress(data: bytes) -> Optional[bytes]:
+    return _codec_call("snappy_compress", data, 32 + len(data) + len(data) // 6)
+
+
+def snappy_decompress(blob: bytes, raw_size: int) -> Optional[bytes]:
+    return _codec_call("snappy_decompress", blob, raw_size)

@@ -41,6 +41,10 @@ class IndexingConfig:
     # geo grid index over a (lat, lng) column pair:
     # {"latColumn": ..., "lngColumn": ..., "resolutionDeg": 0.5}
     geo_index_configs: list[dict] = field(default_factory=list)
+    # column -> chunk compression codec for its forward buffers
+    # (reference FieldConfig.compressionCodec / ChunkCompressionType:
+    # PASS_THROUGH | LZ4 | ZSTANDARD | GZIP | SNAPPY)
+    compression_configs: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -104,6 +108,7 @@ class TableConfig:
                 "noDictionaryColumns": self.indexing.no_dictionary_columns,
                 "sortedColumn": self.indexing.sorted_column,
                 "starTreeIndexConfigs": self.indexing.star_tree_index_configs,
+                "compressionConfigs": self.indexing.compression_configs,
             },
             "segmentsConfig": {
                 "timeColumnName": self.validation.time_column_name,
@@ -132,6 +137,7 @@ class TableConfig:
                 no_dictionary_columns=idx.get("noDictionaryColumns") or [],
                 sorted_column=idx.get("sortedColumn"),
                 star_tree_index_configs=idx.get("starTreeIndexConfigs") or [],
+                compression_configs=idx.get("compressionConfigs") or {},
             ),
             validation=SegmentsValidationConfig(
                 time_column_name=seg.get("timeColumnName"),
