@@ -164,7 +164,15 @@ class Broker:
         try:
             query = parse_sql(sql)
         except SqlParseError as e:
+            # shapes the single-stage grammar rejects (joins, subqueries,
+            # set ops) route to the multi-stage dispatcher — the reference's
+            # cross-engine fallback at the broker request handler
+            resp = self.execute_sql_mse(sql)
+            if not resp.exceptions:
+                return resp
             return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
+        if query.query_options.get("useMultistageEngine") in (True, "true", 1):
+            return self.execute_sql_mse(sql)
         try:
             self.quota.acquire(raw_table_name(query.table_name))
         except QueryQuotaExceededError as e:
@@ -175,6 +183,21 @@ class Broker:
             return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         return resp
+
+    def execute_sql_mse(self, sql: str) -> BrokerResponse:
+        """Multi-stage execution across server processes: plan fragments are
+        serialized and dispatched to workers, shuffle blocks cross the TCP
+        transport (reference: MultiStageBrokerRequestHandler →
+        QueryDispatcher.submitAndReduce)."""
+        return self.mse_dispatcher.execute_sql(sql)
+
+    @property
+    def mse_dispatcher(self):
+        if not hasattr(self, "_mse_dispatcher"):
+            from ..mse.distributed import DistributedMseDispatcher
+
+            self._mse_dispatcher = DistributedMseDispatcher(self)
+        return self._mse_dispatcher
 
     def execute_sql_cursor(self, sql: str, num_rows: int = 1000) -> dict:
         """Spool the full result and return the first page + cursor id

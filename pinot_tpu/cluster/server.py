@@ -138,7 +138,19 @@ class ServerInstance:
             return self._handle_query(request)
         if kind == "ping":
             return "pong"
+        if isinstance(kind, str) and kind.startswith("mse_"):
+            return self.mse_worker.handle(request)
         raise ValueError(f"unknown request type {kind}")
+
+    @property
+    def mse_worker(self):
+        """Multi-stage worker endpoint (mse/distributed.py) — lazily built
+        so the MSE runtime only loads when a stage is dispatched here."""
+        if not hasattr(self, "_mse_worker"):
+            from ..mse.distributed import MseWorkerService
+
+            self._mse_worker = MseWorkerService(self)
+        return self._mse_worker
 
     def _handle_query(self, request):
         """Execute a QueryContext over an explicit segment list (the broker

@@ -16,6 +16,7 @@ to a replicated device_put (parallel/mesh.py holds the collectives).
 
 from __future__ import annotations
 
+import zlib
 from collections import defaultdict
 from typing import Optional
 
@@ -71,8 +72,12 @@ def hash_partition(block: Block, keys: list[str], num_partitions: int) -> list[B
             f = np.where(f == 0.0, 0.0, f)  # -0.0 == 0.0 must hash equal
             hv = f.view(np.uint64)
         else:
-            hv = np.fromiter((hash(str(x)) & 0xFFFFFFFFFFFFFFFF for x in v),
-                             dtype=np.uint64, count=n)
+            # deterministic across OS processes — Python's str hash is
+            # randomized per process (PYTHONHASHSEED) and would route the
+            # same key to different workers on different hosts
+            hv = np.fromiter(
+                (zlib.crc32(str(x).encode("utf-8")) for x in v),
+                dtype=np.uint64, count=n)
         h = h * np.uint64(1000003) ^ hv
     part = (h % np.uint64(num_partitions)).astype(np.int64)
     return [take_block(block, part == p) for p in range(num_partitions)]

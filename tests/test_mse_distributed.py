@@ -1,0 +1,283 @@
+"""Cross-process MSE: plan serde, TCP mailbox shuffle, multi-process join.
+
+Reference pattern: pinot-query-runtime's QueryDispatcher/QueryRunner tests
+plus the integration tests that span server processes. The final test runs a
+join whose build and probe sides are hosted by two different OS processes,
+joined through serialized plan fragments and mailbox blocks over TCP, with
+the cluster metadata plane served by PropertyStoreServer (the ZK analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.cluster.remote_store import PropertyStoreServer, RemoteStore
+from pinot_tpu.mse.fragmenter import explain_stages, fragment
+from pinot_tpu.mse.logical import LogicalPlanner, prune_columns
+from pinot_tpu.mse.parser import parse_relational
+from pinot_tpu.mse.plan_serde import stage_from_json, stage_to_json
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+
+ORDERS = Schema.build(
+    "orders",
+    dimensions=[("cust", "STRING"), ("item", "STRING")],
+    metrics=[("amount", "INT")])
+CUSTOMERS = Schema.build(
+    "customers",
+    dimensions=[("name", "STRING"), ("region", "STRING")],
+    metrics=[("credit", "INT")])
+
+CUSTS = ["alice", "bob", "carol", "dan", "erin", "frank"]
+REGIONS = ["east", "west", "north"]
+
+
+def _orders_cols(rng, n=400):
+    return {
+        "cust": np.asarray(CUSTS, dtype=object)[rng.integers(0, len(CUSTS), n)],
+        "item": np.asarray([f"item_{i}" for i in range(20)], dtype=object)[
+            rng.integers(0, 20, n)],
+        "amount": rng.integers(1, 100, n).astype(np.int32),
+    }
+
+
+def _customers_cols():
+    return {
+        "name": np.asarray(CUSTS, dtype=object),
+        "region": np.asarray([REGIONS[i % len(REGIONS)] for i in range(len(CUSTS))],
+                             dtype=object),
+        "credit": np.arange(100, 100 + len(CUSTS), dtype=np.int32),
+    }
+
+
+JOIN_SQL = ("SELECT customers.region, SUM(orders.amount) "
+            "FROM orders JOIN customers ON orders.cust = customers.name "
+            "GROUP BY customers.region ORDER BY customers.region")
+
+
+def _expected_region_sums(orders_cols_list):
+    cust_region = {c: REGIONS[i % len(REGIONS)] for i, c in enumerate(CUSTS)}
+    sums: dict[str, int] = {}
+    for cols in orders_cols_list:
+        for c, a in zip(cols["cust"], cols["amount"]):
+            r = cust_region[c]
+            sums[r] = sums.get(r, 0) + int(a)
+    return sums
+
+
+# -- plan serde ---------------------------------------------------------------
+
+
+def test_stage_serde_roundtrip():
+    catalog = {"orders": ORDERS.column_names(),
+               "customers": CUSTOMERS.column_names()}
+    for sql in [
+        JOIN_SQL,
+        "SELECT cust, COUNT(*) FROM orders WHERE amount > 10 GROUP BY cust",
+        "SELECT name FROM customers UNION SELECT cust FROM orders",
+        ("SELECT cust, amount, RANK() OVER (PARTITION BY cust ORDER BY amount DESC)"
+         " FROM orders LIMIT 5"),
+    ]:
+        query = parse_relational(sql)
+        plan = LogicalPlanner(query, catalog).plan()
+        prune_columns(plan)
+        stages = fragment(plan)
+        rebuilt = []
+        for s in stages:
+            wire = json.dumps(stage_to_json(s))  # must be pure JSON
+            rebuilt.append(stage_from_json(json.loads(wire)))
+        assert explain_stages(rebuilt) == explain_stages(stages)
+
+
+# -- single-process cluster, TCP between roles --------------------------------
+
+
+@pytest.fixture()
+def join_cluster(tmp_path):
+    rng = np.random.default_rng(7)
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="host",
+                              tags=[f"tenant{i}", "DefaultTenant"])
+               for i in range(2)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(ORDERS.to_json())
+    controller.add_schema(CUSTOMERS.to_json())
+
+    # pin each table to a different server: the join's probe and build sides
+    # never share a process-local executor
+    controller.create_table({"tableName": "orders", "replication": 1,
+                             "serverTag": "tenant0"})
+    controller.create_table({"tableName": "customers", "replication": 1,
+                             "serverTag": "tenant1"})
+    orders_sets = []
+    for i in range(2):
+        cols = _orders_cols(rng)
+        path = str(tmp_path / f"orders_{i}")
+        SegmentBuilder(ORDERS, segment_name=f"orders_{i}").build(cols, path)
+        controller.add_segment("orders_OFFLINE", f"orders_{i}",
+                               {"location": path, "numDocs": len(cols["amount"])})
+        orders_sets.append(cols)
+    ccols = _customers_cols()
+    cpath = str(tmp_path / "customers_0")
+    SegmentBuilder(CUSTOMERS, segment_name="customers_0").build(ccols, cpath)
+    controller.add_segment("customers_OFFLINE", "customers_0",
+                           {"location": cpath, "numDocs": len(CUSTS)})
+
+    yield store, controller, servers, broker, orders_sets
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    if hasattr(broker, "_mse_dispatcher"):
+        broker._mse_dispatcher.close()
+
+
+def test_distributed_join_across_servers(join_cluster):
+    store, controller, servers, broker, orders_sets = join_cluster
+    # tables really live on different server endpoints
+    assert "Server_0" in (store.get("/EXTERNALVIEW/orders_OFFLINE") or {}).get(
+        "orders_0", {})
+    assert "Server_1" in (store.get("/EXTERNALVIEW/customers_OFFLINE") or {}).get(
+        "customers_0", {})
+
+    resp = broker.execute_sql_mse(JOIN_SQL)
+    assert not resp.exceptions, resp.exceptions
+    got = {r[0]: r[1] for r in resp.result_table.rows}
+    assert got == _expected_region_sums(orders_sets)
+
+
+def test_broker_auto_routes_join_to_mse(join_cluster):
+    _, _, _, broker, orders_sets = join_cluster
+    resp = broker.execute_sql(JOIN_SQL)  # V1 grammar rejects joins → MSE
+    assert not resp.exceptions, resp.exceptions
+    got = {r[0]: r[1] for r in resp.result_table.rows}
+    assert got == _expected_region_sums(orders_sets)
+
+
+def test_distributed_agg_no_double_count_with_replication(tmp_path):
+    """Leaf stages follow the broker's replica selector: replication=2 must
+    not double-count rows."""
+    rng = np.random.default_rng(11)
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"S{i}", backend="host") for i in range(2)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(ORDERS.to_json())
+    controller.create_table({"tableName": "orders", "replication": 2})
+    cols = _orders_cols(rng)
+    path = str(tmp_path / "o0")
+    SegmentBuilder(ORDERS, segment_name="o0").build(cols, path)
+    controller.add_segment("orders_OFFLINE", "o0",
+                           {"location": path, "numDocs": len(cols["amount"])})
+    try:
+        resp = broker.execute_sql_mse(
+            "SELECT COUNT(*), SUM(amount) FROM orders")
+        assert not resp.exceptions, resp.exceptions
+        assert resp.result_table.rows[0][0] == len(cols["amount"])
+        assert resp.result_table.rows[0][1] == int(cols["amount"].sum())
+    finally:
+        for s in servers:
+            s.stop()
+        if hasattr(broker, "_mse_dispatcher"):
+            broker._mse_dispatcher.close()
+
+
+# -- true two-OS-process join -------------------------------------------------
+
+
+def _child_server_main(store_host: str, store_port: int, instance_id: str):
+    """Entry point for the worker OS process: joins the cluster through the
+    networked property store and serves until /TEST/STOP appears."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pinot_tpu.cluster.remote_store import RemoteStore
+    from pinot_tpu.cluster.server import ServerInstance
+
+    store = RemoteStore(store_host, store_port)
+    server = ServerInstance(store, instance_id, backend="host",
+                            tags=["tenantB", "DefaultTenant"])
+    server.start()
+    try:
+        while store.get("/TEST/STOP") is None:
+            time.sleep(0.05)
+    finally:
+        server.stop()
+        store.close()
+
+
+def _wait_for(predicate, timeout_s=20.0, what="condition"):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_join_across_two_os_processes(tmp_path):
+    rng = np.random.default_rng(23)
+    server_store = PropertyStoreServer()
+    store = server_store.store
+    controller = ClusterController(store)
+    local = ServerInstance(store, "Local_0", backend="host",
+                           tags=["tenantA", "DefaultTenant"])
+    local.start()
+    broker = Broker(store)
+
+    ctx = multiprocessing.get_context("spawn")
+    host, port = server_store.address
+    child = ctx.Process(target=_child_server_main,
+                        args=(host, port, "Remote_0"), daemon=True)
+    child.start()
+    try:
+        _wait_for(lambda: "Remote_0" in store.children("/LIVEINSTANCES"),
+                  what="remote server liveness")
+
+        controller.add_schema(ORDERS.to_json())
+        controller.add_schema(CUSTOMERS.to_json())
+        controller.create_table({"tableName": "orders", "replication": 1,
+                                 "serverTag": "tenantA"})
+        controller.create_table({"tableName": "customers", "replication": 1,
+                                 "serverTag": "tenantB"})
+        cols = _orders_cols(rng)
+        path = str(tmp_path / "orders_0")
+        SegmentBuilder(ORDERS, segment_name="orders_0").build(cols, path)
+        controller.add_segment("orders_OFFLINE", "orders_0",
+                               {"location": path, "numDocs": len(cols["amount"])})
+        ccols = _customers_cols()
+        cpath = str(tmp_path / "customers_0")
+        SegmentBuilder(CUSTOMERS, segment_name="customers_0").build(ccols, cpath)
+        controller.add_segment("customers_OFFLINE", "customers_0",
+                               {"location": cpath, "numDocs": len(CUSTS)})
+
+        # the child process must converge customers_0 ONLINE via its watch
+        _wait_for(lambda: "Remote_0" in (
+            store.get("/EXTERNALVIEW/customers_OFFLINE") or {}).get(
+                "customers_0", {}),
+            what="remote segment convergence")
+
+        resp = broker.execute_sql_mse(JOIN_SQL)
+        assert not resp.exceptions, resp.exceptions
+        got = {r[0]: r[1] for r in resp.result_table.rows}
+        assert got == _expected_region_sums([cols])
+    finally:
+        store.set("/TEST/STOP", True)
+        child.join(timeout=10)
+        if child.is_alive():
+            child.terminate()
+        local.stop()
+        if hasattr(broker, "_mse_dispatcher"):
+            broker._mse_dispatcher.close()
+        server_store.close()
