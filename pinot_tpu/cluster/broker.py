@@ -168,9 +168,12 @@ class Broker:
             # set ops) route to the multi-stage dispatcher — the reference's
             # cross-engine fallback at the broker request handler
             resp = self.execute_sql_mse(sql)
-            if not resp.exceptions:
-                return resp
-            return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
+            if resp.exceptions and any(
+                    "ParseError" in x for x in resp.exceptions):
+                # neither grammar accepts it: the V1 error names the query's
+                # syntax problem; an MSE *execution* failure passes through
+                return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
+            return resp
         if query.query_options.get("useMultistageEngine") in (True, "true", 1):
             return self.execute_sql_mse(sql)
         try:

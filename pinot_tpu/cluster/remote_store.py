@@ -78,9 +78,11 @@ class PropertyStoreServer:
         if op == "poll":
             (since,) = args
             with self._lock:
+                first = self._events[0][0] if self._events else self._seq + 1
                 if since is None:
-                    return self._seq, []
-                return self._seq, [e for e in self._events if e[0] > since]
+                    return self._seq, [], first
+                return (self._seq,
+                        [e for e in self._events if e[0] > since], first)
         raise ValueError(f"unknown store op {op!r}")
 
 
@@ -146,11 +148,19 @@ class RemoteStore:
     def _poll_loop(self) -> None:
         while not self._closed.is_set():
             try:
-                seq, events = self._call("poll", self._last_seq)
+                seq, events, first = self._call("poll", self._last_seq)
             except Exception:
                 if self._closed.is_set():
                     return
                 time.sleep(0.2)
+                continue
+            if self._last_seq is not None and self._last_seq + 1 < first \
+                    and seq > self._last_seq:
+                # the server trimmed events we never saw: resync every
+                # watched prefix from current state instead of silently
+                # missing transitions (ZK watchers re-read after gaps too)
+                self._last_seq = seq
+                self._resync()
                 continue
             self._last_seq = seq
             for _, path, value in events:
@@ -163,6 +173,16 @@ class RemoteStore:
                     except Exception:
                         pass
             self._closed.wait(self.POLL_INTERVAL_S)
+
+    def _resync(self) -> None:
+        with self._lock:
+            watches = list(self._watches)
+        for prefix, cb in watches:
+            try:
+                for path in self._call("list_paths", prefix):
+                    cb(path, self._call("get", path))
+            except Exception:
+                pass
 
     # -- transactional helpers ---------------------------------------------
     def update(self, path: str, fn: Callable[[Optional[Any]], Any],

@@ -382,9 +382,9 @@ class DistributedMseDispatcher:
                 for inst, segs in plan.items():
                     per_instance.setdefault(inst, {}).setdefault(raw, []) \
                         .append([nwt, sorted(segs), extra])
-        if not per_instance:
-            raise UnsupportedQueryError(
-                f"no online segments for stage {stage.stage_id}")
+        # an existing-but-empty table yields zero workers: the stage is
+        # skipped and its parent receives an empty block — matching the
+        # in-process StageRunner's scan over zero segments
         return per_instance
 
     # -- execution ---------------------------------------------------------
@@ -412,6 +412,15 @@ class DistributedMseDispatcher:
             return BrokerResponse(result_table=ResultTable(
                 DataSchema(["plan"], ["STRING"]),
                 [[line] for line in text.split("\n")]))
+
+        # per-table QPS quota applies to every engine at the broker
+        # (reference: quota check in BrokerRequestHandler before dispatch)
+        quota_tables = set()
+        for stage in stages:
+            if stage.stage_id != 0:
+                quota_tables.update(s.table for s in stage.scans())
+        for t in sorted(quota_tables):
+            self.broker.quota.acquire(t)
 
         topo = StageRunner(stages, self.parallelism, None, None)
         servers = self._server_instances()

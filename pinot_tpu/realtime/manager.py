@@ -62,7 +62,12 @@ class RealtimeSegmentDataManager:
     def __init__(self, schema, table_config, stream_config: StreamConfig,
                  partition: int, seq: int, start_offset: LongMsgOffset,
                  on_commit: Callable[["RealtimeSegmentDataManager"], None],
-                 poll_idle_s: float = 0.02, pk_manager=None):
+                 poll_idle_s: float = 0.02, pk_manager=None,
+                 completion=None, instance_id: str = "server_0",
+                 on_build: Optional[Callable] = None,
+                 on_commit_success: Optional[Callable] = None,
+                 on_discard: Optional[Callable] = None,
+                 test_hooks: Optional[dict] = None):
         self.schema = schema
         self.table_config = table_config
         self.stream_config = stream_config
@@ -72,12 +77,26 @@ class RealtimeSegmentDataManager:
         self.current_offset = start_offset
         self.on_commit = on_commit
         self.poll_idle_s = poll_idle_s
+        # multi-replica completion protocol (realtime/completion.py); None →
+        # in-process commit, the single-replica fast path
+        self.completion = completion
+        self.instance_id = instance_id
+        self.on_build = on_build
+        self.on_commit_success = on_commit_success
+        self.on_discard = on_discard
+        self.test_hooks = test_hooks or {}
         # upsert/dedup metadata manager (upsert/manager.py): process_row
         # pre-index (partial merge / duplicate drop), add_record post-index
         self.pk_manager = pk_manager
 
+        # under the replica completion protocol every replica must mint the
+        # IDENTICAL segment name for (table, partition, seq) — the reference
+        # has the controller assign it; here the name's timestamp field is
+        # derived from the start offset so it is deterministic across hosts
+        ts_ms = start_offset.offset if completion is not None else None
         self.segment = MutableSegment(
-            schema, llc_segment_name(table_config.table_name, partition, seq))
+            schema, llc_segment_name(table_config.table_name, partition, seq,
+                                     ts_ms=ts_ms))
         factory = get_stream_consumer_factory(stream_config)
         self.consumer = factory.create_partition_consumer(partition)
         self.decoder = get_decoder(stream_config)
@@ -175,11 +194,81 @@ class RealtimeSegmentDataManager:
     def _commit(self):
         self.state = COMMITTING
         try:
-            self.on_commit(self)
-            self.state = COMMITTED
+            if self.completion is None:
+                self.on_commit(self)
+                self.state = COMMITTED
+                return
+            self._commit_via_protocol()
         except Exception:  # noqa: BLE001
             log.exception("commit of %s failed", self.segment.segment_name)
             self.state = ERROR
+
+    def _commit_via_protocol(self):
+        """Replica-aware commit: segmentConsumed → HOLD/CATCHUP until the
+        controller elects a committer; the winner builds + commits, losers
+        DISCARD and download (reference PartitionConsumer commit loop,
+        RealtimeSegmentDataManager.java:880-960)."""
+        from .completion import CATCHUP, COMMIT, COMMIT_SUCCESS, CONTINUE, DISCARD
+
+        table = self.table_config.table_name
+        name = self.segment.segment_name
+        while not self._stop.is_set():
+            resp = self.completion.segment_consumed(
+                table, name, self.instance_id, self.current_offset.offset)
+            if resp.status == CATCHUP:
+                self._catchup(resp.offset)
+                continue
+            if resp.status == COMMIT:
+                start = self.completion.segment_commit_start(
+                    table, name, self.instance_id, self.current_offset.offset)
+                if start.status != CONTINUE:
+                    continue
+                location = self.on_build(self)
+                die = self.test_hooks.get("die_before_commit_end")
+                if die is not None and die(self):
+                    # simulated process death between build and commit —
+                    # the lease expires and another replica is re-elected
+                    return
+                end = self.completion.segment_commit_end(
+                    table, name, self.instance_id,
+                    self.current_offset.offset, location)
+                if end.status == COMMIT_SUCCESS:
+                    self.on_commit_success(self, location)
+                    self.state = COMMITTED
+                    return
+                # lost a late race: re-poll (likely DISCARD next); never
+                # hot-spin on repeated FAILED responses
+                time.sleep(self.poll_idle_s)
+                continue
+            if resp.status == DISCARD:
+                self.on_discard(self, resp.location, resp.offset)
+                # downloaded the winner's build; done with this segment
+                self.state = COMMITTED
+                return
+            # HOLD
+            time.sleep(self.poll_idle_s)
+        self.state = HOLDING
+
+    def _catchup(self, target_offset: int):
+        """Consume up to the elected committer's end offset so every replica
+        commits the identical row set (reference: CatchingUp state)."""
+        while (not self._stop.is_set()
+               and self.current_offset.offset < target_offset):
+            batch = self.consumer.fetch_messages(
+                self.current_offset, self.stream_config.fetch_timeout_ms)
+            if not batch.message_count:
+                time.sleep(self.poll_idle_s)
+                continue
+            take = target_offset - self.current_offset.offset
+            if batch.message_count > take:
+                # never index past the elected end offset
+                from ..spi.stream import MessageBatch
+
+                batch = MessageBatch(list(batch.messages)[:take],
+                                     LongMsgOffset(target_offset))
+            self._index_batch(batch)
+            self.current_offset = batch.offset_of_next_batch
+            self.last_consumed_ms = int(time.time() * 1000)
 
 
 class RealtimeTableDataManager:
@@ -190,7 +279,9 @@ class RealtimeTableDataManager:
     the query executor snapshots it per query."""
 
     def __init__(self, schema, table_config, data_dir: str | Path,
-                 segment_hook: Optional[Callable] = None):
+                 segment_hook: Optional[Callable] = None,
+                 completion=None, instance_id: str = "server_0",
+                 test_hooks: Optional[dict] = None):
         self.schema = schema
         self.table_config = table_config
         self.stream_config = StreamConfig.from_table_config(
@@ -211,6 +302,12 @@ class RealtimeTableDataManager:
             schema, table_config,
             preserve_doc_order=self.pk_manager is not None)
         self.segment_hook = segment_hook  # cluster layer: upsert/dedup attach
+        # replica completion protocol (realtime/completion.py). Upsert/dedup
+        # tables keep the single-replica fast path: their pk metadata is
+        # partition-pinned and cannot be rebuilt from a downloaded build.
+        self.completion = completion if self.pk_manager is None else None
+        self.instance_id = instance_id
+        self.test_hooks = test_hooks or {}
         self.segments: list = []  # live view: immutables + mutables
         self._committed: list[ImmutableSegment] = []
         self._consuming: dict[int, RealtimeSegmentDataManager] = {}
@@ -289,12 +386,21 @@ class RealtimeTableDataManager:
             meta = factory.create_metadata_provider()
             start = meta.fetch_latest_offset(partition)
             meta.close()
-        mgr = RealtimeSegmentDataManager(
-            self.schema, self.table_config, self.stream_config, partition, seq,
-            start, self._handle_commit, pk_manager=self.pk_manager)
+        mgr = self._make_manager(partition, seq, start)
         self._consuming[partition] = mgr
         self._seq[partition] = seq + 1
         mgr.start()
+
+    def _make_manager(self, partition: int, seq: int,
+                      start: LongMsgOffset) -> RealtimeSegmentDataManager:
+        return RealtimeSegmentDataManager(
+            self.schema, self.table_config, self.stream_config, partition, seq,
+            start, self._handle_commit, pk_manager=self.pk_manager,
+            completion=self.completion, instance_id=self.instance_id,
+            on_build=self._handle_build,
+            on_commit_success=self._handle_commit_success,
+            on_discard=self._handle_discard,
+            test_hooks=self.test_hooks)
 
     def stop(self):
         # order matters: the shutdown flag first, so a commit racing with us
@@ -335,11 +441,59 @@ class RealtimeTableDataManager:
         # hold snapshot views of it; it drops out of the live list above and
         # the GC reclaims it once the last query releases its snapshot
 
+    # -- replica completion protocol callbacks ------------------------------
+    def _handle_build(self, mgr: RealtimeSegmentDataManager) -> str:
+        """Build-only half of the commit (reference: buildSegmentInternal);
+        registration waits for segmentCommitEnd success."""
+        out_dir = self.data_dir / mgr.segment.segment_name
+        self.converter.convert(mgr.segment, out_dir)
+        return str(out_dir)
+
+    def _handle_commit_success(self, mgr: RealtimeSegmentDataManager,
+                               location: str) -> None:
+        committed = load_segment(location)
+        if self.segment_hook is not None:
+            self.segment_hook(committed)
+        with self._lock:
+            self._committed.append(committed)
+            self._offsets[str(mgr.partition)] = str(mgr.current_offset)
+            self._segment_names.append(mgr.segment.segment_name)
+            self._save_checkpoints()
+            self._consuming.pop(mgr.partition, None)
+            if not self._shutdown:
+                self._start_partition_from(mgr.partition, mgr.current_offset)
+            self._refresh_view()
+
+    def _handle_discard(self, mgr: RealtimeSegmentDataManager,
+                        location: str, end_offset: int) -> None:
+        """This replica lost the election: drop the local build and download
+        the committer's (reference: non-winner replicas download from deep
+        store on SegmentCompletionProtocol DISCARD/KEEP)."""
+        import shutil
+
+        name = mgr.segment.segment_name
+        local = self.data_dir / name
+        if Path(location).resolve() != local.resolve():
+            if local.exists():
+                shutil.rmtree(local, ignore_errors=True)
+            shutil.copytree(location, local)
+        committed = load_segment(local)
+        if self.segment_hook is not None:
+            self.segment_hook(committed)
+        with self._lock:
+            self._committed.append(committed)
+            self._offsets[str(mgr.partition)] = str(end_offset)
+            self._segment_names.append(name)
+            self._save_checkpoints()
+            self._consuming.pop(mgr.partition, None)
+            if not self._shutdown:
+                self._start_partition_from(mgr.partition,
+                                           LongMsgOffset(end_offset))
+            self._refresh_view()
+
     def _start_partition_from(self, partition: int, offset: LongMsgOffset):
         seq = self._seq.get(partition, 0)
-        nxt = RealtimeSegmentDataManager(
-            self.schema, self.table_config, self.stream_config, partition, seq,
-            offset, self._handle_commit, pk_manager=self.pk_manager)
+        nxt = self._make_manager(partition, seq, offset)
         self._consuming[partition] = nxt
         self._seq[partition] = seq + 1
         nxt.start()

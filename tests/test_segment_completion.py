@@ -1,0 +1,225 @@
+"""Segment completion FSM: committer election, discard/download, crash
+re-election.
+
+Reference: SegmentCompletionManager/FSM tests (pinot-controller/src/test/
+.../realtime/SegmentCompletionTest.java) — multiple replica consumers reach
+end criteria, the controller elects one committer, losers download, and a
+committer that dies between build and commit is replaced after its lease
+expires.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pinot_tpu.cluster.store import PropertyStore
+from pinot_tpu.realtime.completion import (
+    CATCHUP,
+    COMMIT,
+    COMMIT_SUCCESS,
+    COMMITTED,
+    CONTINUE,
+    DISCARD,
+    FAILED,
+    HOLD,
+    SegmentCompletionManager,
+)
+from pinot_tpu.realtime.manager import RealtimeTableDataManager
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.stream import InMemoryStreamRegistry, StreamConfig
+from pinot_tpu.spi.table_config import (
+    IngestionConfig,
+    SegmentsValidationConfig,
+    TableConfig,
+    TableType,
+)
+
+SCHEMA = Schema.build(
+    "events",
+    dimensions=[("user", "STRING"), ("ts", "LONG")],
+    metrics=[("n", "INT")])
+
+
+def table_config(topic, flush_rows=40):
+    return TableConfig(
+        table_name="events",
+        table_type=TableType.REALTIME,
+        validation=SegmentsValidationConfig(time_column_name="ts"),
+        ingestion=IngestionConfig(stream_configs={
+            "streamType": "inmemory",
+            "stream.inmemory.topic.name": topic,
+            "realtime.segment.flush.threshold.rows": flush_rows,
+        }))
+
+
+def rows(n, start=0):
+    return [{"user": f"u{(start + i) % 5}", "ts": 1_600_000_000_000 + i,
+             "n": 1} for i in range(n)]
+
+
+def wait_until(pred, timeout=20.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# -- protocol-level FSM tests -------------------------------------------------
+
+
+def test_fsm_elects_largest_offset_and_catchup():
+    store = PropertyStore()
+    mgr = SegmentCompletionManager(store, num_replicas=2, commit_lease_s=10)
+    # replica B is behind replica A
+    r1 = mgr.segment_consumed("t", "seg__0__0", "B", 80)
+    assert r1.status == HOLD  # quorum not reached
+    r2 = mgr.segment_consumed("t", "seg__0__0", "A", 100)
+    assert r2.status == COMMIT and r2.offset == 100  # A has the max → wins
+    r3 = mgr.segment_consumed("t", "seg__0__0", "B", 80)
+    assert r3.status == CATCHUP and r3.offset == 100
+    r4 = mgr.segment_consumed("t", "seg__0__0", "B", 100)
+    assert r4.status == HOLD  # caught up, waiting for the committer
+
+    assert mgr.segment_commit_start("t", "seg__0__0", "A", 100).status == CONTINUE
+    # wrong instance / wrong offset cannot commit
+    assert mgr.segment_commit_end("t", "seg__0__0", "B", 100, "/x").status == FAILED
+    assert mgr.segment_commit_end("t", "seg__0__0", "A", 99, "/x").status == FAILED
+    end = mgr.segment_commit_end("t", "seg__0__0", "A", 100, "/deep/seg__0__0")
+    assert end.status == COMMIT_SUCCESS
+    assert mgr.fsm_state("t", "seg__0__0") == COMMITTED
+    rec = store.get("/SEGMENTS/t/seg__0__0")
+    assert rec["status"] == "DONE" and rec["committer"] == "A"
+    assert rec["endOffset"] == "100"
+    # late replica is told to discard + download
+    r5 = mgr.segment_consumed("t", "seg__0__0", "B", 100)
+    assert r5.status == DISCARD and r5.location == "/deep/seg__0__0"
+
+
+def test_fsm_reelects_after_lease_expiry():
+    store = PropertyStore()
+    mgr = SegmentCompletionManager(store, num_replicas=2, commit_lease_s=0.2)
+    assert mgr.segment_consumed("t", "s", "A", 50).status == HOLD
+    # quorum: tie at 50 breaks on report order → A is the committer, B holds
+    assert mgr.segment_consumed("t", "s", "B", 50).status == HOLD
+    assert mgr.segment_consumed("t", "s", "A", 50).status == COMMIT
+    elected, other = "A", "B"
+    time.sleep(0.3)  # committer "dies": lease expires
+    r = mgr.segment_consumed("t", "s", other, 50)
+    assert r.status == COMMIT  # re-elected
+    assert mgr.segment_commit_start("t", "s", other, 50).status == CONTINUE
+    # the dead committer coming back late cannot steal the commit
+    assert mgr.segment_commit_end("t", "s", elected, 50, "/x").status == FAILED
+    assert mgr.segment_commit_end("t", "s", other, 50, "/y").status == COMMIT_SUCCESS
+
+
+def test_single_replica_decides_after_wait():
+    store = PropertyStore()
+    mgr = SegmentCompletionManager(store, num_replicas=2, commit_lease_s=5,
+                                   decision_wait_s=0.1)
+    assert mgr.segment_consumed("t", "s", "A", 10).status == HOLD
+    time.sleep(0.15)
+    assert mgr.segment_consumed("t", "s", "A", 10).status == COMMIT
+
+
+# -- integration: replica table managers over one stream ----------------------
+
+
+@pytest.fixture()
+def registry(monkeypatch):
+    reg = InMemoryStreamRegistry()
+    import pinot_tpu.spi.stream as stream_mod
+
+    monkeypatch.setattr(stream_mod, "GLOBAL_STREAM_REGISTRY", reg)
+    return reg
+
+
+def _total_rows(mgr) -> int:
+    return sum(s.num_docs for s in mgr.segments)
+
+
+def test_two_replicas_one_commit(registry, tmp_path):
+    registry.create_topic("ev", num_partitions=1)
+    store = PropertyStore()
+    completion = SegmentCompletionManager(store, num_replicas=2,
+                                          commit_lease_s=5, decision_wait_s=3)
+    cfg = table_config("ev")
+    a = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
+                                 completion=completion, instance_id="A")
+    b = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "b",
+                                 completion=completion, instance_id="B")
+    a.start()
+    b.start()
+    try:
+        registry.publish("ev", rows(60))
+        assert wait_until(lambda: any(
+            n.startswith("events__0__0") for n in a._segment_names)
+            and any(n.startswith("events__0__0") for n in b._segment_names)), \
+            (a._segment_names, b._segment_names)
+        name_a, name_b = a._segment_names[0], b._segment_names[0]
+        assert name_a == name_b  # identical LLC segment both sides
+        rec = store.get(f"/SEGMENTS/events/{name_a}")
+        assert rec is not None and rec["status"] == "DONE"
+        assert rec["committer"] in ("A", "B")
+        # both replicas serve the same committed rows (40 = flush threshold)
+        assert wait_until(lambda: _total_rows(a) == 60 and _total_rows(b) == 60)
+        committed_a = a._committed[0]
+        committed_b = b._committed[0]
+        assert committed_a.num_docs == committed_b.num_docs
+        assert list(committed_a.get_values("user")) == \
+            list(committed_b.get_values("user"))
+        # loser downloaded into its OWN data dir
+        assert (tmp_path / "a" / name_a).exists()
+        assert (tmp_path / "b" / name_a).exists()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_committer_crash_reelection_end_to_end(registry, tmp_path):
+    registry.create_topic("ev2", num_partitions=1)
+    store = PropertyStore()
+    completion = SegmentCompletionManager(store, num_replicas=2,
+                                          commit_lease_s=0.4,
+                                          decision_wait_s=3)
+    cfg = table_config("ev2")
+    killed = {"done": False}
+
+    def die_once(mgr):
+        # the FIRST elected committer (seq 0) dies between build and commit
+        if mgr.seq == 0 and not killed["done"]:
+            killed["done"] = True
+            return True
+        return False
+
+    hooks = {"die_before_commit_end": die_once}
+    a = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
+                                 completion=completion, instance_id="A",
+                                 test_hooks=hooks)
+    b = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "b",
+                                 completion=completion, instance_id="B",
+                                 test_hooks=hooks)
+    a.start()
+    b.start()
+    try:
+        registry.publish("ev2", rows(50))
+        # exactly one replica's consumer died; the OTHER must be re-elected
+        # after the lease expires and commit the segment
+        assert wait_until(lambda: store.children("/SEGMENTS/events"),
+                          timeout=25)
+        seg_name = store.children("/SEGMENTS/events")[0]
+        rec = store.get(f"/SEGMENTS/events/{seg_name}")
+        assert rec["status"] == "DONE"
+        assert killed["done"]
+        survivor = rec["committer"]
+        surv_mgr = a if survivor == "A" else b
+        assert wait_until(lambda: _total_rows(surv_mgr) >= 40)
+        committed = surv_mgr._committed[0]
+        # all 50 published rows: end criteria is checked after the batch
+        assert committed.num_docs == 50
+    finally:
+        a.stop()
+        b.stop()
