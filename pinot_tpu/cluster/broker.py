@@ -169,7 +169,8 @@ class Broker:
             # cross-engine fallback at the broker request handler
             resp = self.execute_sql_mse(sql)
             if resp.exceptions and any(
-                    "ParseError" in x for x in resp.exceptions):
+                    x.startswith(("SqlParseError", "PlanError", "ParseError"))
+                    for x in resp.exceptions):
                 # neither grammar accepts it: the V1 error names the query's
                 # syntax problem; an MSE *execution* failure passes through
                 return BrokerResponse(exceptions=[f"SqlParseError: {e}"])

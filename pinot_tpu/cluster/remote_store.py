@@ -98,6 +98,9 @@ class RemoteStore:
         self._closed = threading.Event()
         self._poller: Optional[threading.Thread] = None
         self._last_seq: Optional[int] = None
+        # paths this client has observed via events — lets a gap resync
+        # deliver deletions that happened inside the trimmed window
+        self._known_paths: set[str] = set()
 
     # -- basic ops ---------------------------------------------------------
     def _call(self, *request):
@@ -167,6 +170,10 @@ class RemoteStore:
                 with self._lock:
                     targets = [cb for prefix, cb in self._watches
                                if path.startswith(prefix)]
+                    if value is None:
+                        self._known_paths.discard(path)
+                    else:
+                        self._known_paths.add(path)
                 for cb in targets:
                     try:
                         cb(path, value)
@@ -175,14 +182,32 @@ class RemoteStore:
             self._closed.wait(self.POLL_INTERVAL_S)
 
     def _resync(self) -> None:
+        """Re-deliver current state for every watched prefix after an event
+        gap — including deletions: paths this client has seen that no longer
+        exist fire cb(path, None)."""
         with self._lock:
             watches = list(self._watches)
+            known = set(self._known_paths)
         for prefix, cb in watches:
             try:
-                for path in self._call("list_paths", prefix):
-                    cb(path, self._call("get", path))
+                live = set(self._call("list_paths", prefix))
             except Exception:
-                pass
+                continue
+            for path in sorted(known):
+                if path.startswith(prefix) and path not in live:
+                    with self._lock:
+                        self._known_paths.discard(path)
+                    try:
+                        cb(path, None)
+                    except Exception:
+                        pass
+            for path in sorted(live):
+                with self._lock:
+                    self._known_paths.add(path)
+                try:
+                    cb(path, self._call("get", path))
+                except Exception:
+                    pass
 
     # -- transactional helpers ---------------------------------------------
     def update(self, path: str, fn: Callable[[Optional[Any]], Any],

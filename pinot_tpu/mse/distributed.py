@@ -377,6 +377,12 @@ class DistributedMseDispatcher:
             for nwt, extra in halves:
                 routing = self.broker.routing_table(nwt)
                 if not routing:
+                    # distinguish an empty table (no segments → empty scan)
+                    # from segments hidden/unroutable — the latter must be
+                    # an availability error, not silent zero rows
+                    if self.store.get(f"/IDEALSTATES/{nwt}"):
+                        raise UnsupportedQueryError(
+                            f"no routable segments for {nwt}")
                     continue
                 plan = self.broker._select_instances(routing)
                 for inst, segs in plan.items():

@@ -1,0 +1,7 @@
+"""Stream connector plugins (reference: pinot-plugins/pinot-stream-ingestion).
+
+Importing a connector module registers its streamType with the SPI registry
+(spi/stream.py); `get_stream_consumer_factory` auto-imports
+``pinot_tpu.plugins.stream.<streamType>`` on first use, so a table config
+naming ``streamType: kafka`` resolves without explicit imports.
+"""

@@ -59,7 +59,6 @@ class _Fsm:
     committer: Optional[str] = None
     target_offset: Optional[int] = None
     lease_deadline: float = 0.0
-    location: Optional[str] = None
     first_vote_at: float = 0.0
 
 
@@ -95,10 +94,6 @@ class SegmentCompletionManager:
             if not fsm.votes:
                 fsm.first_vote_at = now
             fsm.votes[instance] = max(offset, fsm.votes.get(instance, offset))
-
-            if fsm.state == COMMITTED:
-                return CompletionResponse(DISCARD, offset=fsm.target_offset,
-                                          location=fsm.location)
 
             if fsm.state == HOLDING:
                 quorum = len(fsm.votes) >= self.num_replicas
@@ -173,11 +168,9 @@ class SegmentCompletionManager:
                           status="DONE", committer=instance,
                           commitTimeMs=int(time.time() * 1000))
             self.store.set(f"/SEGMENTS/{table}/{segment}", record)
-            fsm.state = COMMITTED
-            fsm.location = location
-            # prune: the store record now answers late segment_consumed
-            # polls; keeping every finished FSM would leak for the life of
-            # the controller
+            # prune: the store DONE record (checked first in
+            # segment_consumed/fsm_state) answers late polls; keeping every
+            # finished FSM would leak for the life of the controller
             self._fsms.pop((table, segment), None)
             return CompletionResponse(COMMIT_SUCCESS, offset=fsm.target_offset,
                                       location=location)

@@ -259,13 +259,22 @@ class RealtimeSegmentDataManager:
             if not batch.message_count:
                 time.sleep(self.poll_idle_s)
                 continue
-            take = target_offset - self.current_offset.offset
-            if batch.message_count > take:
-                # never index past the elected end offset
-                from ..spi.stream import MessageBatch
+            # never index past the elected end offset. Record offsets may be
+            # sparse (Kafka log compaction / txn markers), so truncate by
+            # OFFSET when records carry one, by count only as a fallback
+            from ..spi.stream import MessageBatch
 
-                batch = MessageBatch(list(batch.messages)[:take],
-                                     LongMsgOffset(target_offset))
+            if all(m.offset is not None for m in batch.messages):
+                msgs = [m for m in batch.messages
+                        if m.offset.offset < target_offset]
+                if (len(msgs) < batch.message_count
+                        or batch.offset_of_next_batch.offset > target_offset):
+                    batch = MessageBatch(msgs, LongMsgOffset(target_offset))
+            else:
+                take = target_offset - self.current_offset.offset
+                if batch.message_count > take:
+                    batch = MessageBatch(list(batch.messages)[:take],
+                                         LongMsgOffset(target_offset))
             self._index_batch(batch)
             self.current_offset = batch.offset_of_next_batch
             self.last_consumed_ms = int(time.time() * 1000)

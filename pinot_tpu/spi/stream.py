@@ -178,6 +178,19 @@ def register_decoder(name: str, decoder: Callable[[], StreamDataDecoder]):
 
 def get_stream_consumer_factory(config: StreamConfig) -> StreamConsumerFactory:
     if config.stream_type not in _FACTORIES:
+        # plugin discovery: a connector module registers itself on import
+        # (reference: PluginManager resolving the stream factory class name)
+        import importlib
+
+        plugin_module = f"pinot_tpu.plugins.stream.{config.stream_type}"
+        try:
+            importlib.import_module(plugin_module)
+        except ModuleNotFoundError as e:
+            if e.name != plugin_module:
+                # the plugin exists but its own imports are broken — that
+                # is a real failure, not an unknown stream type
+                raise
+    if config.stream_type not in _FACTORIES:
         raise ValueError(f"unknown streamType {config.stream_type!r}; "
                          f"registered: {sorted(_FACTORIES)}")
     return _FACTORIES[config.stream_type](config)
