@@ -181,6 +181,13 @@ class QueryExecutor:
         ``tracker`` (engine/scheduler.py QueryResourceTracker) enables
         cooperative cancellation + allocation accounting; the per-query
         deadline comes from the timeoutMs query option."""
+        # filter canonicalization (query/optimizer.py — reference
+        # QueryOptimizer runs once at the broker; here once per query on the
+        # server path so every engine entry benefits). Idempotent, so a
+        # re-dispatched QueryContext is safe to re-optimize.
+        from ..query.optimizer import optimize_filter
+
+        query.filter = optimize_filter(query.filter)
         # snapshot: realtime tables mutate the live list concurrently;
         # consuming segments pin a consistent row-count view per query
         segments = [s.snapshot_view() if getattr(s, "is_mutable", False) else s

@@ -38,6 +38,21 @@ class BrokerReducer:
     # -- entry -------------------------------------------------------------
     def reduce(self, query: QueryContext, combined) -> ResultTable:
         if isinstance(combined, GroupByIntermediate):
+            from .gapfill import apply_gapfill, extract_gapfill
+
+            spec = extract_gapfill(query)
+            if spec is not None:
+                # fill before pagination (reference: GapfillProcessor runs
+                # on the full reduced result, then limit applies)
+                import copy
+
+                q2 = copy.copy(query)
+                q2.offset = 0
+                q2.limit = 1 << 40
+                full = self._reduce_group_by(q2, combined)
+                filled = apply_gapfill(full, spec)
+                rows = filled.rows[query.offset: query.offset + query.limit]
+                return ResultTable(filled.schema, rows)
             return self._reduce_group_by(query, combined)
         if isinstance(combined, AggIntermediate):
             return self._reduce_aggregation(query, combined)

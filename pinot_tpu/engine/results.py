@@ -44,6 +44,8 @@ class BrokerResponse:
     time_used_ms: float = 0.0
     exceptions: list = field(default_factory=list)
     trace_info: Optional[list] = None  # set when the trace option is on
+    # a size guard truncated the result (reference: maxRowsInJoinReached)
+    partial_result: bool = False
 
     def to_json(self) -> dict:
         out = {
@@ -58,6 +60,8 @@ class BrokerResponse:
         }
         if self.trace_info is not None:
             out["traceInfo"] = self.trace_info
+        if self.partial_result:
+            out["partialResult"] = True
         return out
 
 

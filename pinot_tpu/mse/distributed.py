@@ -187,6 +187,9 @@ class MseWorkerService:
                              self._make_read_table(halves))
         runner.mailbox = mailbox
 
+        from .operators import pop_join_overflow
+
+        pop_join_overflow()  # clear any stale flag on this handler thread
         pushed = runner._try_ssqe(stage) if stage.is_leaf else None
         if pushed is not None:
             runner.stats["leaf_ssqe_pushdowns"] += 1
@@ -196,6 +199,7 @@ class MseWorkerService:
         mailbox.send_partitioned(stage.stage_id, stage.parent_stage, block,
                                  stage.send_dist, stage.send_keys,
                                  parent_workers)
+        runner.stats["join_overflow"] = pop_join_overflow()
         return runner.stats
 
     def _halves_for(self, halves: dict, table: str):
@@ -461,7 +465,8 @@ class DistributedMseDispatcher:
         # dispatch bottom-up; a stage's workers run in parallel, stages run
         # strictly after their children so mailboxes are always populated
         stats_agg = {"num_docs_scanned": 0, "total_docs": 0,
-                     "leaf_ssqe_pushdowns": 0, "stages": len(stages)}
+                     "leaf_ssqe_pushdowns": 0, "stages": len(stages),
+                     "join_overflow": False}
         touched: set[str] = set()
         try:
             for stage in sorted(stages, key=lambda s: -s.stage_id):
@@ -490,6 +495,8 @@ class DistributedMseDispatcher:
                     for k in ("num_docs_scanned", "total_docs",
                               "leaf_ssqe_pushdowns"):
                         stats_agg[k] += st.get(k, 0)
+                    stats_agg["join_overflow"] |= bool(
+                        st.get("join_overflow"))
 
             final_sid = stages[0].child_stages[0]
             block = concat_blocks(
@@ -499,7 +506,8 @@ class DistributedMseDispatcher:
             return BrokerResponse(
                 result_table=result,
                 num_docs_scanned=stats_agg["num_docs_scanned"],
-                total_docs=stats_agg["total_docs"])
+                total_docs=stats_agg["total_docs"],
+                partial_result=stats_agg["join_overflow"])
         finally:
             self.boxes.cleanup(query_id)
             for inst in touched:

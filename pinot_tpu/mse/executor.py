@@ -76,6 +76,9 @@ class MultistageExecutor:
                         DataSchema(["plan"], ["STRING"]),
                         [[line] for line in text.split("\n")]),
                     time_used_ms=(time.perf_counter() - t0) * 1000)
+            from .operators import pop_join_overflow
+
+            pop_join_overflow()  # clear any stale flag on this thread
             runner = StageRunner(stages, self.parallelism,
                                  self.qe.execute, self._read_table)
             block = runner.run()
@@ -85,6 +88,7 @@ class MultistageExecutor:
                 result_table=result,
                 num_docs_scanned=runner.stats["num_docs_scanned"],
                 total_docs=runner.stats["total_docs"],
+                partial_result=pop_join_overflow(),
                 time_used_ms=(time.perf_counter() - t0) * 1000)
         except Exception as e:
             return BrokerResponse(

@@ -13,7 +13,9 @@ they're pushed into the single-stage device engine by the leaf compiler.
 
 from __future__ import annotations
 
+import os
 import re
+import threading
 from collections import Counter
 from typing import Optional
 
@@ -344,6 +346,53 @@ def _tighten_col(v: np.ndarray) -> np.ndarray:
 # -- hash join ---------------------------------------------------------------
 
 
+class JoinRowLimitExceeded(Exception):
+    """The join would materialize more rows than maxRowsInJoin (reference:
+    HashJoinOperator's join-overflow THROW mode)."""
+
+
+# reference defaults: maxRowsInJoin (InstancePlanMakerImplV2 /
+# HashJoinOperator); override per deployment via PINOT_TPU_MAX_ROWS_IN_JOIN
+MAX_ROWS_IN_JOIN = int(os.environ.get("PINOT_TPU_MAX_ROWS_IN_JOIN",
+                                      5_000_000))
+# THROW (fail the query) or BREAK (truncate and mark partial)
+JOIN_OVERFLOW_MODE = os.environ.get("PINOT_TPU_JOIN_OVERFLOW_MODE",
+                                    "THROW").upper()
+
+
+_overflow = threading.local()
+
+
+def pop_join_overflow() -> bool:
+    """True if a BREAK-mode truncation happened since the last call on this
+    thread — the runtime surfaces it as a partial-result marker (reference:
+    HashJoinOperator sets maxRowsInJoinReached in the stats)."""
+    hit = getattr(_overflow, "hit", False)
+    _overflow.hit = False
+    return hit
+
+
+def _guard_join_rows(total: int, ln: int, rn: int,
+                     join_type: str) -> Optional[int]:
+    """Returns a truncation bound in BREAK mode, raises in THROW mode, None
+    when under the limit — checked BEFORE materializing index arrays so an
+    accidental many-to-many cross blowup cannot OOM the host silently.
+    ANTI/RIGHT/FULL joins always raise: truncating their inputs would emit
+    WRONG rows (false anti-matches, false null-padded right rows), not a
+    partial subset."""
+    if total <= MAX_ROWS_IN_JOIN:
+        return None
+    if JOIN_OVERFLOW_MODE == "BREAK" and join_type in ("INNER", "LEFT",
+                                                       "SEMI", "CROSS"):
+        _overflow.hit = True
+        return MAX_ROWS_IN_JOIN
+    raise JoinRowLimitExceeded(
+        f"{join_type} join would produce {total} rows ({ln}x{rn} inputs), "
+        f"over maxRowsInJoin={MAX_ROWS_IN_JOIN}"
+        + ("" if JOIN_OVERFLOW_MODE == "BREAK" else
+           "; set PINOT_TPU_JOIN_OVERFLOW_MODE=BREAK to truncate instead"))
+
+
 def op_join(left: Block, right: Block, join_type: str,
             left_keys: list[str], right_keys: list[str],
             residual: Optional[EC], schema: list[str]) -> Block:
@@ -351,6 +400,15 @@ def op_join(left: Block, right: Block, join_type: str,
     rn = block_len(right)
 
     if join_type == "CROSS" or not left_keys:
+        kind = join_type if join_type in ("SEMI", "ANTI") else "CROSS"
+        cap = _guard_join_rows(ln * rn, ln, rn, kind)
+        if cap is not None:
+            # truncate BOTH sides so ln*rn ≤ cap even when one side alone
+            # exceeds it
+            rn = min(rn, max(1, cap // max(ln, 1)))
+            ln = min(ln, max(1, cap // rn))
+            left = take_block(left, np.arange(ln))
+            right = take_block(right, np.arange(rn))
         lidx = np.repeat(np.arange(ln), rn)
         ridx = np.tile(np.arange(rn), ln)
         combined = _combine(left, right, lidx, ridx)
@@ -375,6 +433,15 @@ def op_join(left: Block, right: Block, join_type: str,
     ends = np.searchsorted(sorted_r, lcodes, "right")
     counts = ends - starts
     total = int(counts.sum())
+    cap = _guard_join_rows(total, ln, rn, join_type)
+    if cap is not None:
+        # BREAK: keep whole left rows up to the cap (partial result)
+        keep = np.searchsorted(np.cumsum(counts), cap, "right")
+        counts = counts[:keep]
+        starts = starts[:keep]
+        ln = keep
+        left = take_block(left, np.arange(keep))
+        total = int(counts.sum())
     lidx = np.repeat(np.arange(ln), counts)
     offs = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
     ridx = rs[np.repeat(starts, counts) + offs]

@@ -799,6 +799,10 @@ TRANSFORMS: dict[str, TransformDef] = {
         // MILLIS[str(b).upper()],
         _lower_timeconvert),
     "datetimeconvert": TransformDef(_np_datetimeconvert, _lower_datetimeconvert),
+    # gapfill markers (engine/gapfill.py): identity of arg0 during
+    # execution; the broker reducer reads the remaining literal args
+    "gapfill": TransformDef(lambda x, *rest: x, lambda B, a: B.v(a[0])),
+    "fill": TransformDef(lambda x, *rest: x, lambda B, a: B.v(a[0])),
     "timestampadd": TransformDef(_np_timestampadd, _lower_timestampadd),
     "dateadd": TransformDef(_np_timestampadd, _lower_timestampadd),
     "timestampdiff": TransformDef(_np_timestampdiff, _lower_timestampdiff),

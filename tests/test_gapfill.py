@@ -1,0 +1,98 @@
+"""Gapfill reducer tests (reference: GapfillProcessor tests in
+pinot-core/src/test/.../query/reduce/)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+T0 = 1_600_002_000_000  # multiple of HOUR so round(ts, HOUR) lands on the grid
+HOUR = 3_600_000
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    """Two devices; device A has data in hours 0,1,3; device B in hours 0,2.
+    Hours 0..4 requested → gaps at A:2,4 and B:1,3,4."""
+    schema = Schema.build(
+        "metrics",
+        dimensions=[("device", "STRING"), ("ts", "LONG")],
+        metrics=[("v", "INT")])
+    rows = []
+    for h, v in [(0, 10), (1, 11), (3, 13)]:
+        rows.append({"device": "A", "ts": T0 + h * HOUR + 60_000, "v": v})
+    for h, v in [(0, 20), (2, 22)]:
+        rows.append({"device": "B", "ts": T0 + h * HOUR + 120_000, "v": v})
+    cols = {k: np.asarray([r[k] for r in rows],
+                          dtype=object if k == "device" else np.int64)
+            for k in ("device", "ts", "v")}
+    d = tmp_path_factory.mktemp("gf") / "s0"
+    SegmentBuilder(schema, segment_name="s0").build(cols, d)
+    ex = QueryExecutor(backend="host")
+    ex.add_table(schema, [load_segment(d)])
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, [load_segment(d)])
+    return ex, tpu
+
+
+BUCKET = f"round(ts, {HOUR})"
+SQL = (f"SELECT gapfill({BUCKET}, '{T0}', '{T0 + 5 * HOUR}', '{HOUR}'), "
+       f"device, fill(SUM(v), 'FILL_PREVIOUS_VALUE') "
+       f"FROM metrics GROUP BY gapfill({BUCKET}, '{T0}', '{T0 + 5 * HOUR}', "
+       f"'{HOUR}'), device LIMIT 100")
+
+
+def test_gapfill_previous_value(table):
+    host, tpu = table
+    for ex in (host, tpu):
+        resp = ex.execute_sql(SQL)
+        assert not resp.exceptions, resp.exceptions
+        rows = resp.result_table.rows
+        # 2 series × 5 buckets
+        assert len(rows) == 10
+        got = {(r[1], int(r[0])): r[2] for r in rows}
+        # A: observed 10, 11, gap→11, 13, gap→13
+        assert [got[("A", T0 + h * HOUR)] for h in range(5)] == \
+            [10, 11, 11, 13, 13]
+        # B: observed 20, gap→20, 22, gap→22, gap→22
+        assert [got[("B", T0 + h * HOUR)] for h in range(5)] == \
+            [20, 20, 22, 22, 22]
+        # time-major ordering: buckets ascend, pairs adjacent
+        times = [int(r[0]) for r in rows]
+        assert times == sorted(times)
+
+
+def test_gapfill_default_and_null_fill(table):
+    host, _ = table
+    sql = (f"SELECT gapfill({BUCKET}, '{T0}', '{T0 + 3 * HOUR}', '{HOUR}'), "
+           f"device, fill(SUM(v), 'FILL_DEFAULT_VALUE'), COUNT(*) "
+           f"FROM metrics GROUP BY gapfill({BUCKET}, '{T0}', '{T0 + 3 * HOUR}',"
+           f" '{HOUR}'), device LIMIT 100")
+    resp = host.execute_sql(sql)
+    assert not resp.exceptions, resp.exceptions
+    rows = resp.result_table.rows
+    assert len(rows) == 6  # 2 series × 3 buckets
+    got = {(r[1], int(r[0])): (r[2], r[3]) for r in rows}
+    assert got[("A", T0 + 2 * HOUR)][0] == 0      # default-filled SUM
+    assert got[("A", T0 + 2 * HOUR)][1] is None   # unwrapped COUNT → null
+    assert got[("B", T0 + 1 * HOUR)][0] == 0
+
+
+def test_gapfill_respects_limit_after_filling(table):
+    host, _ = table
+    sql = SQL.replace("LIMIT 100", "LIMIT 4")
+    rows = host.execute_sql(sql).result_table.rows
+    assert len(rows) == 4
+    # first two buckets, both series
+    assert [int(r[0]) for r in rows] == [T0, T0, T0 + HOUR, T0 + HOUR]
+
+
+def test_no_gapfill_function_is_untouched(table):
+    host, _ = table
+    sql = (f"SELECT {BUCKET}, device, SUM(v) FROM metrics "
+           f"GROUP BY {BUCKET}, device LIMIT 100")
+    rows = host.execute_sql(sql).result_table.rows
+    assert len(rows) == 5  # only observed buckets

@@ -419,3 +419,36 @@ def test_setop_all_bag_semantics():
     assert sorted(np.asarray(out["v"]).tolist()) == [1, 2]
     out = op_setop("EXCEPT", False, left, right, ["v"])
     assert sorted(np.asarray(out["v"]).tolist()) == [3]
+
+
+# -- join row-limit guard (reference HashJoinOperator maxRowsInJoin) ----------
+
+
+def test_join_row_limit_throw_and_break(monkeypatch):
+    import numpy as np
+
+    from pinot_tpu.mse import operators as ops
+
+    left = {"k": np.zeros(3000, dtype=np.int64),
+            "l": np.arange(3000, dtype=np.int64)}
+    right = {"k": np.zeros(3000, dtype=np.int64),
+             "r": np.arange(3000, dtype=np.int64)}
+    monkeypatch.setattr(ops, "MAX_ROWS_IN_JOIN", 10_000)
+    monkeypatch.setattr(ops, "JOIN_OVERFLOW_MODE", "THROW")
+    with pytest.raises(ops.JoinRowLimitExceeded):
+        ops.op_join(left, right, "INNER", ["k"], ["k"], None,
+                    ["k", "l", "k0", "r"])
+    # cross joins hit the same guard before materializing anything
+    with pytest.raises(ops.JoinRowLimitExceeded):
+        ops.op_join(left, right, "CROSS", [], [], None, [])
+
+    monkeypatch.setattr(ops, "JOIN_OVERFLOW_MODE", "BREAK")
+    out = ops.op_join(left, right, "INNER", ["k"], ["k"], None,
+                      ["k", "l", "k0", "r"])
+    from pinot_tpu.mse.mailbox import block_len
+
+    assert 0 < block_len(out) <= 10_000
+    # under the limit: untouched
+    small = {"k": np.arange(10, dtype=np.int64)}
+    out = ops.op_join(small, dict(small), "INNER", ["k"], ["k"], None, [])
+    assert block_len(out) == 10

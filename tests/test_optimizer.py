@@ -1,0 +1,172 @@
+"""Filter optimizer unit + end-to-end equivalence tests.
+
+Reference: pinot-core/src/test/.../query/optimizer/ (MergeEqInFilter,
+MergeRangeFilter, FlattenAndOr test suites).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.query.expressions import ExpressionContext as EC
+from pinot_tpu.query.filter import FilterContext as FC
+from pinot_tpu.query.filter import FilterNodeType, Predicate, PredicateType
+from pinot_tpu.query.optimizer import optimize_filter
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+P = PredicateType
+col = EC.for_identifier
+
+
+def eq(c, v):
+    return FC.pred(Predicate(P.EQ, col(c), values=(v,)))
+
+
+def isin(c, *vs):
+    return FC.pred(Predicate(P.IN, col(c), values=tuple(vs)))
+
+
+def rng_(c, lo=None, hi=None, lo_inc=True, hi_inc=True):
+    return FC.pred(Predicate(P.RANGE, col(c), lower=lo, upper=hi,
+                             lower_inclusive=lo_inc, upper_inclusive=hi_inc))
+
+
+def test_or_merges_eq_in_on_same_column():
+    f = optimize_filter(FC.or_(eq("a", 1), eq("a", 2), isin("a", 2, 3)))
+    assert f.type == FilterNodeType.PREDICATE
+    assert f.predicate.type == P.IN
+    assert f.predicate.values == (1, 2, 3)
+
+
+def test_and_intersects_eq_in_to_false():
+    f = optimize_filter(FC.and_(eq("a", 1), eq("a", 2)))
+    assert f.type == FilterNodeType.CONSTANT and f.constant_value is False
+    f = optimize_filter(FC.and_(isin("a", 1, 2, 3), isin("a", 2, 3, 4)))
+    assert f.predicate.type == P.IN and f.predicate.values == (2, 3)
+
+
+def test_and_merges_ranges():
+    f = optimize_filter(FC.and_(rng_("x", lo=5), rng_("x", hi=10),
+                                rng_("x", lo=7, hi=20)))
+    p = f.predicate
+    assert p.type == P.RANGE and p.lower == 7 and p.upper == 10
+    # disjoint ranges → FALSE
+    f = optimize_filter(FC.and_(rng_("x", hi=5), rng_("x", lo=6)))
+    assert f.type == FilterNodeType.CONSTANT and f.constant_value is False
+    # touching open bounds → FALSE
+    f = optimize_filter(FC.and_(rng_("x", hi=5, hi_inc=False), rng_("x", lo=5)))
+    assert f.type == FilterNodeType.CONSTANT and f.constant_value is False
+
+
+def test_eq_filtered_through_range():
+    f = optimize_filter(FC.and_(isin("x", 1, 7, 12), rng_("x", lo=5, hi=10)))
+    assert f.predicate.type == P.EQ and f.predicate.values == (7,)
+    f = optimize_filter(FC.and_(eq("x", 1), rng_("x", lo=5)))
+    assert f.type == FilterNodeType.CONSTANT and f.constant_value is False
+
+
+def test_not_pushdown_de_morgan():
+    f = optimize_filter(FC.not_(FC.or_(eq("a", 1), eq("b", 2))))
+    # NOT(a=1 OR b=2) → a!=1 AND b!=2
+    assert f.type == FilterNodeType.AND
+    types = sorted(c.predicate.type.value for c in f.children)
+    assert types == ["NOT_EQ", "NOT_EQ"]
+    # double negation
+    f = optimize_filter(FC.not_(FC.not_(eq("a", 1))))
+    assert f.predicate.type == P.EQ
+    # NOT over a range has no natural inverse: survives as NOT
+    f = optimize_filter(FC.not_(rng_("x", lo=1, hi=2)))
+    assert f.type == FilterNodeType.NOT
+
+
+def test_not_in_union_and_eq_subtraction():
+    f = optimize_filter(FC.and_(
+        FC.pred(Predicate(P.NOT_EQ, col("a"), values=(1,))),
+        FC.pred(Predicate(P.NOT_IN, col("a"), values=(2, 3)))))
+    assert f.predicate.type == P.NOT_IN and f.predicate.values == (1, 2, 3)
+    f = optimize_filter(FC.and_(
+        isin("a", 1, 2, 3),
+        FC.pred(Predicate(P.NOT_IN, col("a"), values=(2,)))))
+    assert f.predicate.type == P.IN and f.predicate.values == (1, 3)
+
+
+def test_constant_folding():
+    f = optimize_filter(FC.and_(FC.constant(True), eq("a", 1)))
+    assert f.predicate.type == P.EQ
+    f = optimize_filter(FC.or_(FC.constant(True), eq("a", 1)))
+    assert f.type == FilterNodeType.CONSTANT and f.constant_value is True
+    f = optimize_filter(FC.and_(FC.constant(False), eq("a", 1)))
+    assert f.constant_value is False
+
+
+def test_incomparable_types_keep_both_constraints():
+    f = optimize_filter(FC.and_(rng_("x", lo=1), rng_("x", lo="a")))
+    # both ranges survive — no constraint silently dropped
+    assert f.type == FilterNodeType.AND and len(f.children) == 2
+
+
+def test_idempotent():
+    f0 = FC.and_(isin("a", 1, 2), rng_("x", lo=0, hi=9), eq("b", 5))
+    f1 = optimize_filter(f0)
+    assert str(optimize_filter(f1)) == str(f1)
+
+
+def test_end_to_end_equivalence(tmp_path, rng):
+    """Optimized queries return identical rows on both engines."""
+    schema = Schema.build(
+        "t", dimensions=[("d", "STRING"), ("x", "INT")], metrics=[("m", "INT")])
+    n = 600
+    cols = {
+        "d": np.asarray(["a", "b", "c", "dd"], dtype=object)[
+            rng.integers(0, 4, n)],
+        "x": rng.integers(0, 50, n).astype(np.int32),
+        "m": rng.integers(0, 100, n).astype(np.int32),
+    }
+    d = tmp_path / "s0"
+    SegmentBuilder(schema, segment_name="s0").build(cols, d)
+    seg = load_segment(d)
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, [seg])
+    host = QueryExecutor(backend="host")
+    host.add_table(schema, [seg])
+    queries = [
+        "SELECT COUNT(*) FROM t WHERE NOT (d = 'a' OR d = 'b')",
+        "SELECT COUNT(*) FROM t WHERE x >= 5 AND x >= 8 AND x < 30 AND x <= 28",
+        "SELECT COUNT(*) FROM t WHERE d IN ('a','b') AND d IN ('b','c')",
+        "SELECT COUNT(*) FROM t WHERE x IN (1, 7, 12, 49) AND x > 6",
+        "SELECT COUNT(*) FROM t WHERE x != 3 AND x NOT IN (4, 5) AND x < 40",
+        "SELECT SUM(m) FROM t WHERE NOT (x > 10 AND d = 'a')",
+        "SELECT COUNT(*) FROM t WHERE x > 10 AND x < 5",
+    ]
+    for q in queries:
+        rt = tpu.execute_sql(q).result_table
+        rh = host.execute_sql(q).result_table
+        assert rt is not None and rh is not None, q
+        assert rt.rows == rh.rows, q
+        # oracle: straight numpy
+        mask = _numpy_mask(q, cols)
+        if "COUNT" in q:
+            assert rt.rows[0][0] == int(mask.sum()), q
+        else:
+            assert rt.rows[0][0] == int(cols["m"][mask].sum()), q
+
+
+def _numpy_mask(q, cols):
+    d, x = cols["d"], cols["x"]
+    if "NOT (d = 'a' OR d = 'b')" in q:
+        return ~((d == "a") | (d == "b"))
+    if "x >= 5 AND x >= 8" in q:
+        return (x >= 8) & (x <= 28)
+    if "d IN ('a','b') AND" in q:
+        return d == "b"
+    if "x IN (1, 7, 12, 49)" in q:
+        return np.isin(x, [7, 12, 49])
+    if "x != 3" in q:
+        return (x != 3) & ~np.isin(x, [4, 5]) & (x < 40)
+    if "NOT (x > 10 AND d = 'a')" in q:
+        return ~((x > 10) & (d == "a"))
+    if "x > 10 AND x < 5" in q:
+        return np.zeros(len(x), dtype=bool)
+    raise AssertionError(q)

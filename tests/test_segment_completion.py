@@ -223,3 +223,49 @@ def test_committer_crash_reelection_end_to_end(registry, tmp_path):
     finally:
         a.stop()
         b.stop()
+
+
+def test_chaos_replica_killed_mid_ingestion_recovers(registry, tmp_path):
+    """ChaosMonkey analogue: one replica dies mid-consumption, ingestion
+    continues on the survivor; the dead replica restarts from its checkpoint
+    and converges (downloading segments committed while it was down)."""
+    registry.create_topic("ev3", num_partitions=1)
+    store = PropertyStore()
+    completion = SegmentCompletionManager(store, num_replicas=2,
+                                          commit_lease_s=0.4,
+                                          decision_wait_s=0.2)
+    cfg = table_config("ev3", flush_rows=20)
+    a = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
+                                 completion=completion, instance_id="A")
+    b = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "b",
+                                 completion=completion, instance_id="B")
+    a.start()
+    b.start()
+    registry.publish("ev3", rows(20))
+    assert wait_until(lambda: _total_rows(a) == 20 and _total_rows(b) == 20)
+
+    # chaos: replica A dies mid-stream
+    a.stop()
+    registry.publish("ev3", rows(40, start=20))
+    # B alone keeps committing (decision_wait elapses with a single voter)
+    assert wait_until(lambda: _total_rows(b) == 60
+                      and len(b._segment_names) >= 2, timeout=25), \
+        (_total_rows(b), b._segment_names)
+
+    # A restarts from its checkpoint and converges to the same row count,
+    # downloading the segments B committed while A was down
+    a2 = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
+                                  completion=completion, instance_id="A")
+    a2.start()
+    try:
+        assert wait_until(lambda: _total_rows(a2) == 60
+                          and a2._segment_names == b._segment_names,
+                          timeout=25), \
+            (_total_rows(a2), a2._segment_names, b._segment_names)
+        # every committed segment now exists in BOTH data dirs
+        for name in b._segment_names:
+            assert (tmp_path / "a" / name).exists()
+            assert (tmp_path / "b" / name).exists()
+    finally:
+        a2.stop()
+        b.stop()
