@@ -313,9 +313,13 @@ class Broker:
                     raise TransportError(
                         f"segments {segs} unreachable on all replicas")
                 results.append(out)
+        from .datatable import decode
+
         missing = []
+        combineds = []
         for r in results:
-            st = r["stats"]
+            combined, st = decode(r["datatable"])
+            combineds.append(combined)
             stats_sum["total_docs"] += st["total_docs"]
             stats_sum["num_segments_processed"] += st["num_segments_processed"]
             stats_sum["num_segments_pruned"] += st["num_segments_pruned"]
@@ -324,7 +328,7 @@ class Broker:
             # a routed segment the server no longer hosts → partial result;
             # fail loudly rather than silently dropping rows
             raise RuntimeError(f"servers missing routed segments: {missing}")
-        return [r["combined"] for r in results]
+        return combineds
 
     def _merge(self, query: QueryContext, per_server: list):
         semantics = [semantics_for(a) for a in query.aggregations]

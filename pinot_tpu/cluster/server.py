@@ -169,4 +169,8 @@ class ServerInstance:
 
         combined, stats = self.scheduler.submit(run, group=table)
         stats["missing_segments"] = missing
-        return {"combined": combined, "stats": stats}
+        # intermediates travel as the versioned binary DataTable, not as
+        # pickled Python objects (reference: DataTableImplV4 on the wire)
+        from .datatable import encode
+
+        return {"datatable": encode(combined, stats)}

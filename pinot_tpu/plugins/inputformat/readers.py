@@ -166,3 +166,8 @@ def create_record_reader(path: str, fmt: Optional[str] = None,
         raise ValueError(f"no record reader for format {fmt!r} "
                          f"(known: {sorted(_READERS)})")
     return factory(path, config)
+
+
+from ...spi.plugins import register_kind as _register_kind  # noqa: E402
+
+_register_kind("inputformat", lambda fmt: _READERS.get(fmt.lower()))

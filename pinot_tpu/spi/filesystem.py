@@ -136,10 +136,31 @@ def register_fs(scheme: str, factory: Callable[[], PinotFS]) -> None:
     _FS_REGISTRY[scheme] = factory
 
 
+# schemes whose plugin module name differs from the scheme itself
+_SCHEME_MODULES = {"gs": "gcs", "abfs": "adls", "abfss": "adls",
+                   "adl2": "adls"}
+
+
 def get_fs(uri: str) -> PinotFS:
     scheme = urlparse(uri).scheme
     factory = _FS_REGISTRY.get(scheme)
     if factory is None:
-        raise ValueError(f"no PinotFS registered for scheme {scheme!r} "
-                         f"(register via spi.filesystem.register_fs)")
+        # plugin discovery: pinot_tpu.plugins.filesystem.<module> registers
+        # its scheme(s) on import (reference: PinotFSFactory + PluginManager)
+        from .plugins import resolve
+
+        try:
+            resolve("filesystem", _SCHEME_MODULES.get(scheme, scheme))
+        except ValueError:
+            pass
+        factory = _FS_REGISTRY.get(scheme)
+        if factory is None:
+            raise ValueError(
+                f"no PinotFS registered for scheme {scheme!r} "
+                f"(register via spi.filesystem.register_fs)") from None
     return factory()
+
+
+from .plugins import register_kind as _register_kind  # noqa: E402
+
+_register_kind("filesystem", _FS_REGISTRY.get)

@@ -178,21 +178,16 @@ def register_decoder(name: str, decoder: Callable[[], StreamDataDecoder]):
 
 def get_stream_consumer_factory(config: StreamConfig) -> StreamConsumerFactory:
     if config.stream_type not in _FACTORIES:
-        # plugin discovery: a connector module registers itself on import
-        # (reference: PluginManager resolving the stream factory class name)
-        import importlib
+        # plugin discovery via the shared loader (reference: PluginManager
+        # resolving the stream factory class name)
+        from .plugins import resolve
 
-        plugin_module = f"pinot_tpu.plugins.stream.{config.stream_type}"
         try:
-            importlib.import_module(plugin_module)
-        except ModuleNotFoundError as e:
-            if e.name != plugin_module:
-                # the plugin exists but its own imports are broken — that
-                # is a real failure, not an unknown stream type
-                raise
-    if config.stream_type not in _FACTORIES:
-        raise ValueError(f"unknown streamType {config.stream_type!r}; "
-                         f"registered: {sorted(_FACTORIES)}")
+            resolve("stream", config.stream_type)
+        except ValueError:
+            raise ValueError(
+                f"unknown streamType {config.stream_type!r}; "
+                f"registered: {sorted(_FACTORIES)}") from None
     return _FACTORIES[config.stream_type](config)
 
 
@@ -303,3 +298,8 @@ class InMemoryStreamConsumerFactory(StreamConsumerFactory):
 
 
 register_stream_type("inmemory", InMemoryStreamConsumerFactory)
+
+
+from .plugins import register_kind as _register_kind  # noqa: E402
+
+_register_kind("stream", _FACTORIES.get)

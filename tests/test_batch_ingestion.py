@@ -137,8 +137,19 @@ def test_local_fs_ops(tmp_path):
     assert not fs.exists(str(d))
 
 
-def test_fs_registry_unknown_scheme():
+def test_fs_registry_unknown_scheme(monkeypatch):
     with pytest.raises(ValueError, match="no PinotFS"):
+        get_fs("zz9://bucket/key")
+    # s3 resolves via the plugin loader; when its client library gate fires,
+    # the error is a clear ImportError, not "unknown scheme". Forced so the
+    # test is deterministic whether or not boto3 is installed.
+    from pinot_tpu.plugins.filesystem.s3 import S3PinotFS
+
+    def gate():
+        raise ImportError("scheme 's3' needs the boto3 package")
+
+    monkeypatch.setattr(S3PinotFS, "client_factory", staticmethod(gate))
+    with pytest.raises(ImportError, match="boto3"):
         get_fs("s3://bucket/key")
 
 

@@ -1,0 +1,115 @@
+"""Binary DataTable wire format round trips (reference: DataTableSerDeTest
+for DataTableImplV4)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import datatable as dt
+from pinot_tpu.engine.results import (
+    AggIntermediate,
+    GroupArrays,
+    GroupByIntermediate,
+    SelectionIntermediate,
+)
+from pinot_tpu.utils import sketches
+
+
+def _roundtrip(combined, stats=None):
+    blob = dt.encode(combined, stats or {"total_docs": 7})
+    assert blob[:4] == dt.MAGIC
+    out, st = dt.decode(blob)
+    return out, st
+
+
+def test_group_arrays_roundtrip():
+    ga = GroupArrays(
+        key_cols=[np.asarray(["a", "b", "c"], dtype=object),
+                  np.asarray([1, 2, 3], dtype=np.int64)],
+        state_cols=[(np.asarray([1.5, 2.5, 3.5]),),
+                    (np.asarray([1.0, 2.0, 3.0]), np.asarray([1, 1, 2],
+                                                             dtype=np.int64))],
+        vec_specs=[("add",), ("add", "add")],
+        fin_tags=[("id", 0), ("div", 0, 1)],
+        num_docs_scanned=42)
+    out, st = _roundtrip(ga, {"total_docs": 100, "num_segments_processed": 2,
+                              "num_segments_pruned": 0})
+    assert isinstance(out, GroupArrays)
+    assert st["total_docs"] == 100
+    assert out.num_docs_scanned == 42
+    np.testing.assert_array_equal(out.key_cols[0], ga.key_cols[0])
+    np.testing.assert_array_equal(out.key_cols[1], ga.key_cols[1])
+    np.testing.assert_array_equal(out.state_cols[1][1], ga.state_cols[1][1])
+    assert out.fin_tags == [("id", 0), ("div", 0, 1)]
+    assert out.vec_specs == [("add",), ("add", "add")]
+
+
+def test_group_dict_with_sketches_roundtrip():
+    hll = sketches.HyperLogLog().add_values(np.arange(1000))
+    td = sketches.TDigest().add_values(np.random.default_rng(0).random(500))
+    theta = sketches.ThetaSketch().add_values(np.arange(300))
+    smart = sketches.SmartDistinctSet(threshold=10).add_values(np.arange(50))
+    vh = sketches.ValueHist.from_values(np.asarray([1, 1, 2, 3, 3, 3]))
+    gb = GroupByIntermediate(
+        groups={("x", 1): [3, hll, td],
+                ("y", 2): [7, theta, smart],
+                ("z", 3): [1, vh, (2.5, 4)]},
+        num_docs_scanned=9)
+    out, _ = _roundtrip(gb)
+    assert isinstance(out, GroupByIntermediate)
+    assert set(out.groups) == {("x", 1), ("y", 2), ("z", 3)}
+    o_hll = out.groups[("x", 1)][1]
+    assert isinstance(o_hll, sketches.HyperLogLog)
+    assert o_hll.cardinality() == hll.cardinality()
+    o_td = out.groups[("x", 1)][2]
+    assert o_td.quantile(0.5) == pytest.approx(td.quantile(0.5))
+    o_theta = out.groups[("y", 2)][1]
+    assert o_theta.cardinality() == theta.cardinality()
+    o_smart = out.groups[("y", 2)][2]
+    assert o_smart.cardinality() == smart.cardinality()
+    o_vh = out.groups[("z", 3)][1]
+    assert o_vh.percentile(50) == vh.percentile(50)
+    # merge still works on decoded objects (frozenset/dict fields intact)
+    assert o_smart.merge(smart).cardinality() == smart.cardinality()
+    assert o_vh.merge(vh).total == 2 * vh.total
+
+
+def test_agg_and_selection_roundtrip():
+    agg = AggIntermediate(states=[5, 2.5, {"a", "b"}, None, [1, 2]],
+                          num_docs_scanned=3)
+    out, _ = _roundtrip(agg)
+    assert out.states == [5, 2.5, {"a", "b"}, None, [1, 2]]
+
+    sel = SelectionIntermediate(
+        columns=["c1", "c2"],
+        rows=[("x", 1), ("y", 2 ** 70), ("z", -3.5)],  # big int survives
+        num_docs_scanned=3)
+    out, _ = _roundtrip(sel)
+    assert out.rows == [("x", 1), ("y", 2 ** 70), ("z", -3.5)]
+    assert out.columns == ["c1", "c2"]
+
+
+def test_rejects_unregistered_and_corrupt():
+    class Foo:
+        pass
+
+    with pytest.raises(dt.DataTableError, match="no wire encoding"):
+        dt.encode(AggIntermediate(states=[Foo()]), {})
+    with pytest.raises(dt.DataTableError):
+        dt.decode(b"NOPE" + b"\x00" * 10)
+    blob = dt.encode(AggIntermediate(states=[1]), {})
+    with pytest.raises(dt.DataTableError):
+        dt.decode(blob[:10])  # truncated
+    bad = bytearray(blob)
+    bad[4] = 99  # version
+    with pytest.raises(dt.DataTableError, match="version"):
+        dt.decode(bytes(bad))
+
+
+def test_no_pickle_on_the_wire():
+    """The encoder must never fall back to pickle for arbitrary objects."""
+    import pickle
+
+    gb = GroupByIntermediate(groups={("k",): [sketches.HyperLogLog()]})
+    blob = dt.encode(gb, {})
+    with pytest.raises(Exception):
+        pickle.loads(blob)  # not a pickle stream
