@@ -45,9 +45,20 @@ class ResultSet:
 
 
 class Connection:
-    def __init__(self, broker_url: str, timeout_s: float = 60.0):
+    def __init__(self, broker_url: str, timeout_s: float = 60.0,
+                 auth=None, token: str = None):
+        """``auth=(user, password)`` sends Basic auth; ``token`` sends a
+        Bearer token (cluster/auth.py providers)."""
         self.broker_url = broker_url.rstrip("/")
         self.timeout_s = timeout_s
+        self._auth_header = None
+        if auth is not None:
+            import base64
+
+            cred = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
+            self._auth_header = f"Basic {cred}"
+        elif token is not None:
+            self._auth_header = f"Bearer {token}"
 
     def execute(self, sql: str) -> ResultSet:
         resp = self._post("/query/sql", {"sql": sql})
@@ -62,14 +73,19 @@ class Connection:
             "language": language})
 
     def _post(self, path: str, body: dict) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self._auth_header:
+            headers["Authorization"] = self._auth_header
         req = urllib.request.Request(
             self.broker_url + path,
-            data=json.dumps(body).encode("utf-8"),
-            headers={"Content-Type": "application/json"})
+            data=json.dumps(body).encode("utf-8"), headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return json.loads(r.read().decode("utf-8"))
         except urllib.error.HTTPError as e:
+            if e.code in (401, 403):
+                raise PinotClientError(
+                    f"HTTP {e.code}: access denied for {path}") from e
             try:
                 return json.loads(e.read().decode("utf-8"))
             except ValueError:
@@ -78,6 +94,7 @@ class Connection:
             raise PinotClientError(f"cannot reach broker: {e}") from e
 
 
-def connect(broker_url: str, timeout_s: float = 60.0) -> Connection:
+def connect(broker_url: str, timeout_s: float = 60.0, auth=None,
+            token: str = None) -> Connection:
     """Reference: ConnectionFactory.fromHostList."""
-    return Connection(broker_url, timeout_s)
+    return Connection(broker_url, timeout_s, auth=auth, token=token)

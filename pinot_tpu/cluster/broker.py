@@ -188,6 +188,67 @@ class Broker:
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         return resp
 
+    def execute_sql_stream(self, sql: str):
+        """Streaming query: a generator of ResultTable pages (reference:
+        the gRPC streaming broker path). Selection queries WITHOUT order-by
+        stream one page per server segment as it completes, stopping early
+        once LIMIT rows have been emitted; non-streamable shapes
+        (aggregation, group-by, order-by) buffer and yield one final page."""
+        from ..engine.reduce import BrokerReducer
+        from ..engine.results import SelectionIntermediate
+        from .controller import raw_table_name as _raw
+        from .controller import table_name_with_type as _nwt
+        from .datatable import decode
+
+        try:
+            query = parse_sql(sql)
+        except SqlParseError as e:
+            raise ValueError(f"SqlParseError: {e}") from None
+        streamable = (not query.is_aggregation_query and not query.is_group_by
+                      and not query.distinct
+                      and not query.order_by_expressions
+                      and not query.offset)  # offset is a global cut, not
+        # a per-page one — buffer it
+        if not streamable:
+            resp = self.execute_sql(sql)
+            if resp.exceptions:
+                raise RuntimeError("; ".join(resp.exceptions))
+            yield resp.result_table
+            return
+
+        raw = _raw(query.table_name)
+        schema_json = self.store.get(f"/SCHEMAS/{raw}")
+        schema = Schema.from_json(schema_json) if schema_json else None
+        reducer = BrokerReducer(schema)
+        remaining = query.limit
+        for ttype in ("OFFLINE", "REALTIME"):
+            nwt = _nwt(raw, ttype)
+            if self.store.get(f"/CONFIGS/TABLE/{nwt}") is None:
+                continue
+            routing = self.routing_table(nwt)
+            if not routing:
+                continue
+            plan = self._select_instances(routing)
+            sub = _with_filter(query, nwt, None)
+            for inst, segs in plan.items():
+                stream = self._client(inst).call_stream(
+                    {"type": "query_stream", "table": nwt,
+                     "segments": segs, "query": sub})
+                for blob in stream:
+                    combined, _st = decode(blob)
+                    if isinstance(combined, SelectionIntermediate) and \
+                            not combined.rows:
+                        continue
+                    page = reducer.reduce(sub, combined)
+                    if remaining is not None:
+                        page.rows = page.rows[:remaining]
+                        remaining -= len(page.rows)
+                    if page.rows:
+                        yield page
+                    if remaining is not None and remaining <= 0:
+                        stream.close()  # early termination
+                        return
+
     def execute_sql_mse(self, sql: str) -> BrokerResponse:
         """Multi-stage execution across server processes: plan fragments are
         serialized and dispatched to workers, shuffle blocks cross the TCP

@@ -21,6 +21,46 @@ from .broker import Broker
 from .controller import ClusterController, table_name_with_type
 
 
+def _referenced_tables(sql: str):
+    """Raw table names a query reads, via the real parsers; None when the
+    SQL cannot be parsed (callers deny for table-scoped principals)."""
+    from ..query.parser.sql import SqlParseError, parse_sql
+    from .controller import raw_table_name
+
+    try:
+        return {raw_table_name(parse_sql(sql).table_name)}
+    except SqlParseError:
+        pass
+    try:
+        from ..mse.ast import JoinRel, SetOpStmt, SubqueryRef, TableRef
+        from ..mse.parser import parse_relational
+
+        tables = set()
+
+        def walk_rel(rel):
+            if rel is None:
+                return
+            if isinstance(rel, TableRef):
+                tables.add(rel.name)
+            elif isinstance(rel, SubqueryRef):
+                walk_stmt(rel.query)
+            elif isinstance(rel, JoinRel):
+                walk_rel(rel.left)
+                walk_rel(rel.right)
+
+        def walk_stmt(stmt):
+            if isinstance(stmt, SetOpStmt):
+                walk_stmt(stmt.left)
+                walk_stmt(stmt.right)
+                return
+            walk_rel(getattr(stmt, "from_rel", None))
+
+        walk_stmt(parse_relational(sql).statement)
+        return tables
+    except Exception:
+        return None
+
+
 class _JsonHandler(BaseHTTPRequestHandler):
     routes_get: list = []
     routes_post: list = []
@@ -43,9 +83,44 @@ class _JsonHandler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(n).decode("utf-8"))
 
-    def _dispatch(self, routes) -> None:
+    # set by the owning _RestServer; None → AllowAll (no auth layer)
+    access_control = None
+
+    def _dispatch(self, routes, access_type: str = "READ") -> None:
+        from .auth import AllowAllAccessControl
+
         parsed = urlparse(self.path)
-        for pattern, fn in routes:
+        ac = self.access_control
+        self.principal = None
+        routes = [r if len(r) == 3 else (r[0], r[1], access_type)
+                  for r in routes]
+        if ac is not None and not isinstance(ac, AllowAllAccessControl) \
+                and parsed.path != "/health":
+            self.principal = ac.authenticate(self.headers)
+            if self.principal is None:
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", "Basic realm=\"pinot\"")
+                self.end_headers()
+                return
+            # per-table refinement happens in the endpoints; here the
+            # principal must hold the access TYPE at all (reference:
+            # AccessControlUtils.validatePermission)
+            for pattern, _fn, atype in routes:
+                m = re.fullmatch(pattern, parsed.path)
+                if m:
+                    if atype not in self.principal.permissions:
+                        self._reply(403,
+                                    {"error": f"{atype} not permitted"})
+                        return
+                    # table-resource routes: first group is the table name
+                    table = m.group(1) if m.groups() and pattern.startswith(
+                        (r"/tables/", r"/segments/", r"/schemas/")) else None
+                    if table and not self.principal.allows(table, atype):
+                        self._reply(403, {
+                            "error": f"{atype} on {table} not permitted"})
+                        return
+                    break
+        for pattern, fn, _atype in routes:
             m = re.fullmatch(pattern, parsed.path)
             if m:
                 try:
@@ -57,13 +132,13 @@ class _JsonHandler(BaseHTTPRequestHandler):
         self._reply(404, {"error": f"no route for {parsed.path}"})
 
     def do_GET(self):
-        self._dispatch(self.routes_get)
+        self._dispatch(self.routes_get, "READ")
 
     def do_POST(self):
-        self._dispatch(self.routes_post)
+        self._dispatch(self.routes_post, "WRITE")
 
     def do_DELETE(self):
-        self._dispatch(self.routes_delete)
+        self._dispatch(self.routes_delete, "WRITE")
 
 
 class _RestServer:
@@ -90,50 +165,103 @@ class BrokerRestServer(_RestServer):
     GET /health."""
 
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
-                 timeseries_engine=None):
+                 timeseries_engine=None, access_control=None):
         srv = self
 
         class Handler(_JsonHandler):
             routes_get = [
                 (r"/health", lambda h, m, q: (200, {"status": "OK"})),
+                # cursor ids are not table names: no group-based table check
                 (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
                     m.group(1), int(q.get("offset", ["0"])[0]),
-                    int(q.get("numRows", ["1000"])[0]))),
+                    int(q.get("numRows", ["1000"])[0]), h.principal), "READ"),
             ]
             routes_post = [
-                (r"/query/sql", lambda h, m, q: srv._query(h._body())),
+                # queries are READs even though they POST
+                (r"/query/sql",
+                 lambda h, m, q: srv._query(h._body(), h.principal), "READ"),
                 (r"/timeseries/api/v1/query_range",
-                 lambda h, m, q: srv._timeseries(h._body())),
+                 lambda h, m, q: srv._timeseries(h._body(), h.principal),
+                 "READ"),
             ]
             routes_delete = [
-                (r"/resultStore/([^/]+)", lambda h, m, q: (
-                    200, {"deleted": srv.broker.response_store.delete(m.group(1))})),
+                (r"/resultStore/([^/]+)",
+                 lambda h, m, q: srv._cursor_delete(m.group(1), h.principal),
+                 "READ"),
             ]
 
+        Handler.access_control = access_control
         self.broker = broker
         self.timeseries_engine = timeseries_engine
+        # cursor id → owning principal name (reference: response store
+        # entries are owner-scoped); only the creator may fetch/delete
+        self._cursor_owners = {}
         super().__init__(Handler, host, port)
 
-    def _query(self, body: dict):
+    def _query(self, body: dict, principal=None):
         sql = body.get("sql")
         if not sql:
             return 400, {"error": "missing 'sql'"}
+        if principal is not None:
+            # table-level READ authorization on every referenced table,
+            # resolved by the real parsers — a regex grammar would miss
+            # quoted identifiers (reference:
+            # BasicAuthBrokerRequestHandler table checks)
+            from .auth import READ
+
+            tables = _referenced_tables(sql)
+            if tables is None and "*" not in principal.tables:
+                return 403, {"error": "cannot resolve tables for "
+                                      "table-scoped principal"}
+            for t in tables or ():
+                if not principal.allows(t, READ):
+                    return 403, {"error": f"READ on {t} not permitted"}
         if body.get("getCursor"):
             out = self.broker.execute_sql_cursor(
                 sql, int(body.get("numRows", 1000)))
+            if principal is not None and out.get("cursorId"):
+                self._cursor_owners[out["cursorId"]] = principal.name
             return (200 if not out.get("exceptions") else 500), out
         resp = self.broker.execute_sql(sql)
         return (200 if not resp.exceptions else 500), resp.to_json()
 
-    def _cursor_fetch(self, cursor_id: str, offset: int, num_rows: int):
+    def _cursor_owned(self, cursor_id: str, principal) -> bool:
+        if principal is None:
+            return True  # no auth layer configured
+        owner = self._cursor_owners.get(cursor_id)
+        return owner is None or owner == principal.name
+
+    def _cursor_fetch(self, cursor_id: str, offset: int, num_rows: int,
+                      principal=None):
+        if not self._cursor_owned(cursor_id, principal):
+            return 403, {"error": "cursor belongs to another principal"}
         try:
             return 200, self.broker.fetch_cursor(cursor_id, offset, num_rows)
         except KeyError as e:
             return 404, {"error": str(e)}
 
-    def _timeseries(self, body: dict):
+    def _cursor_delete(self, cursor_id: str, principal=None):
+        if not self._cursor_owned(cursor_id, principal):
+            return 403, {"error": "cursor belongs to another principal"}
+        self._cursor_owners.pop(cursor_id, None)
+        return 200, {"deleted": self.broker.response_store.delete(cursor_id)}
+
+    def _timeseries(self, body: dict, principal=None):
         if self.timeseries_engine is None:
             return 501, {"error": "timeseries engine not configured"}
+        if principal is not None:
+            from ..timeseries.engine import parse_m3ql
+            from .auth import READ
+
+            try:
+                table = parse_m3ql(body.get("query", "")).fetch.table
+            except Exception:
+                table = None
+            if table is None and "*" not in principal.tables:
+                return 403, {"error": "cannot resolve table for "
+                                      "table-scoped principal"}
+            if table and not principal.allows(table, READ):
+                return 403, {"error": f"READ on {table} not permitted"}
         block = self.timeseries_engine.execute(
             body["query"], int(body["start"]), int(body["end"]),
             int(body["step"]), body.get("language", "m3ql"))
@@ -146,7 +274,8 @@ class ControllerRestServer(_RestServer):
     PinotSegmentUploadDownloadRestletResource, rebalance endpoints)."""
 
     def __init__(self, controller: ClusterController,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 access_control=None):
         srv = self
 
         class Handler(_JsonHandler):
@@ -176,6 +305,7 @@ class ControllerRestServer(_RestServer):
                  lambda h, m, q: srv._drop_segment(m.group(1), m.group(2))),
             ]
 
+        Handler.access_control = access_control
         self.controller = controller
         super().__init__(Handler, host, port)
 

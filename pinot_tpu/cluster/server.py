@@ -136,6 +136,8 @@ class ServerInstance:
         kind = request.get("type")
         if kind == "query":
             return self._handle_query(request)
+        if kind == "query_stream":
+            return self._handle_query_stream(request)
         if kind == "ping":
             return "pong"
         if isinstance(kind, str) and kind.startswith("mse_"):
@@ -174,3 +176,27 @@ class ServerInstance:
         from .datatable import encode
 
         return {"datatable": encode(combined, stats)}
+
+    def _handle_query_stream(self, request):
+        """Server-streaming query: one DataTable chunk per segment as each
+        finishes (reference: GrpcQueryServer.submit streaming per-segment
+        blocks for streamable operators, GrpcQueryServer.java:65)."""
+        from .datatable import encode
+
+        table = request["table"]
+        names = request["segments"]
+        query = request["query"]
+        with self._lock:
+            hosted = self.segments.get(table, {})
+            segs = [(n, hosted[n]) for n in names if n in hosted]
+            missing = [n for n in names if n not in hosted]
+
+        def stream():
+            if missing:
+                raise RuntimeError(f"missing routed segments: {missing}")
+            for name, seg in segs:
+                combined, stats = self.executor.execute_segments(query, [seg])
+                stats["segment"] = name
+                yield encode(combined, stats)
+
+        return stream()

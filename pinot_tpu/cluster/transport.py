@@ -86,6 +86,8 @@ class RpcServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        import types
+
         with conn:
             while not self._closed.is_set():
                 try:
@@ -93,7 +95,19 @@ class RpcServer:
                 except (TransportError, OSError, EOFError):
                     return
                 try:
-                    response = ("ok", self.handler(request))
+                    result = self.handler(request)
+                    if isinstance(result, types.GeneratorType):
+                        # streaming response: one ("chunk", x) frame per
+                        # yielded item, then ("ok", None) — the gRPC
+                        # server-streaming analogue over the framed plane
+                        try:
+                            for chunk in result:
+                                _send_frame(conn, ("chunk", chunk))
+                            response = ("ok", None)
+                        except Exception as e:  # mid-stream failure
+                            response = ("error", f"{type(e).__name__}: {e}")
+                    else:
+                        response = ("ok", result)
                 except Exception as e:  # surface handler errors to the caller
                     response = ("error", f"{type(e).__name__}: {e}")
                 try:
@@ -141,6 +155,37 @@ class RpcClient:
         if status == "error":
             raise RemoteError(payload)
         return payload
+
+    def call_stream(self, request):
+        """Generator over a streaming response: yields each chunk; raises
+        RemoteError on a server-side failure (also mid-stream). Uses a
+        DEDICATED connection (not the pooled one) so an abandoned or
+        long-lived stream never blocks concurrent unary calls — the
+        per-stream-channel behavior of the gRPC analogue."""
+        try:
+            sock = self._connect()
+        except OSError:
+            raise TransportError(
+                f"rpc to {self.host}:{self.port} failed") from None
+        try:
+            _send_frame(sock, request)
+            while True:
+                try:
+                    status, payload = _recv_frame(sock)
+                except (TransportError, OSError, EOFError):
+                    raise TransportError(
+                        f"stream from {self.host}:{self.port} broke") from None
+                if status == "chunk":
+                    yield payload
+                elif status == "ok":
+                    return
+                else:
+                    raise RemoteError(payload)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def close_nolock(self) -> None:
         if self._sock is not None:

@@ -218,3 +218,49 @@ def test_rpc_connect_refused_is_transport_error():
     client = RpcClient("127.0.0.1", 1, timeout=2.0)  # nothing listens on :1
     with pytest.raises(TransportError):
         client.call({"op": "ping"})
+
+
+# -- streaming query path (gRPC-analogue over the framed transport) ----------
+
+
+def test_streaming_selection_query(cluster, tmp_path):
+    store, controller, servers, broker = cluster
+    table = controller.create_table({"tableName": "stats", "replication": 1})
+    datasets = []
+    for i in range(4):
+        path, cols = _build_segment(tmp_path, f"st{i}", seed=40 + i)
+        controller.add_segment(table, f"st{i}", {"location": path, "numDocs": 500})
+        datasets.append(cols)
+
+    pages = list(broker.execute_sql_stream(
+        "SELECT team, runs FROM stats WHERE runs >= 50 LIMIT 100000"))
+    assert len(pages) >= 4  # at least one page per segment
+    rows = [r for p in pages for r in p.rows]
+    expected = sum(int((c["runs"] >= 50).sum()) for c in datasets)
+    assert len(rows) == expected
+    assert all(r[1] >= 50 for r in rows)
+
+    # early termination: LIMIT stops the stream after enough rows
+    pages = list(broker.execute_sql_stream(
+        "SELECT team, runs FROM stats LIMIT 42"))
+    assert sum(len(p.rows) for p in pages) == 42
+
+    # non-streamable shape buffers into one final page
+    pages = list(broker.execute_sql_stream(
+        "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 10"))
+    assert len(pages) == 1
+    got = {r[0]: r[1] for r in pages[0].rows}
+    assert got == _expected_team_sums(datasets)
+
+
+def test_streaming_offset_buffers(cluster, tmp_path):
+    """OFFSET is a global cut: streaming must not drop it per page."""
+    store, controller, servers, broker = cluster
+    table = controller.create_table({"tableName": "stats", "replication": 1})
+    for i in range(3):
+        path, _ = _build_segment(tmp_path, f"of{i}", seed=60 + i, n=100)
+        controller.add_segment(table, f"of{i}",
+                               {"location": path, "numDocs": 100})
+    pages = list(broker.execute_sql_stream(
+        "SELECT team, runs FROM stats LIMIT 1000 OFFSET 10"))
+    assert sum(len(p.rows) for p in pages) == 290
