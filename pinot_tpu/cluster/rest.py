@@ -61,6 +61,10 @@ def _referenced_tables(sql: str):
         return None
 
 
+class RawHtml(str):
+    """Marker: a handler returning this gets text/html instead of JSON."""
+
+
 class _JsonHandler(BaseHTTPRequestHandler):
     routes_get: list = []
     routes_post: list = []
@@ -70,9 +74,14 @@ class _JsonHandler(BaseHTTPRequestHandler):
         pass
 
     def _reply(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode("utf-8")
+        if isinstance(payload, RawHtml):
+            body = str(payload).encode("utf-8")
+            ctype = "text/html; charset=utf-8"
+        else:
+            body = json.dumps(payload).encode("utf-8")
+            ctype = "application/json"
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -288,9 +297,13 @@ class ControllerRestServer(_RestServer):
                 (r"/instances", lambda h, m, q: (200, {
                     "instances": srv.controller.list_instances(),
                     "live": srv.controller.live_instances()})),
+                (r"/cluster/summary", lambda h, m, q: srv._summary()),
+                (r"/", lambda h, m, q: srv._home_page()),
             ]
             routes_post = [
                 (r"/schemas", lambda h, m, q: srv._add_schema(h._body())),
+                (r"/recommender", lambda h, m, q: srv._recommend(h._body()),
+                 "READ"),
                 (r"/tables", lambda h, m, q: srv._create_table(h._body())),
                 (r"/segments/([^/]+)/([^/]+)",
                  lambda h, m, q: srv._add_segment(m.group(1), m.group(2), h._body())),
@@ -346,3 +359,63 @@ class ControllerRestServer(_RestServer):
     def _drop_segment(self, table: str, segment: str):
         self.controller.drop_segment(table_name_with_type(table), segment)
         return 200, {"status": f"segment {segment} dropped"}
+
+    # -- cluster summary / minimal UI (reference: controller UI's cluster
+    # manager pages, served as data here) ----------------------------------
+    def _summary(self):
+        store = self.controller.store
+        tables = {}
+        for nwt in store.children("/CONFIGS/TABLE"):
+            segs = store.children(f"/SEGMENTS/{nwt}")
+            view = store.get(f"/EXTERNALVIEW/{nwt}") or {}
+            online = sum(1 for s in segs if view.get(s))
+            tables[nwt] = {"segments": len(segs), "online": online,
+                           "totalDocs": sum(
+                               (store.get(f"/SEGMENTS/{nwt}/{s}") or {})
+                               .get("numDocs", 0) for s in segs)}
+        return 200, {
+            "tables": tables,
+            "instances": self.controller.list_instances(),
+            "liveInstances": self.controller.live_instances(),
+            "schemas": store.children("/SCHEMAS"),
+        }
+
+    def _home_page(self):
+        _code, s = self._summary()
+        rows = "".join(
+            f"<tr><td>{t}</td><td>{v['segments']}</td><td>{v['online']}</td>"
+            f"<td>{v['totalDocs']}</td></tr>" for t, v in s["tables"].items())
+        live = set(s["liveInstances"])
+        insts = "".join(
+            f"<li>{i} {'&#9679; live' if i in live else '&#9675; down'}</li>"
+            for i in s["instances"])
+        html = (
+            "<html><head><title>pinot-tpu cluster</title></head><body>"
+            "<h1>Cluster</h1>"
+            f"<h2>Tables ({len(s['tables'])})</h2>"
+            "<table border=1><tr><th>table</th><th>segments</th>"
+            f"<th>online</th><th>docs</th></tr>{rows}</table>"
+            f"<h2>Instances</h2><ul>{insts}</ul>"
+            "</body></html>")
+        return 200, RawHtml(html)
+
+    def _recommend(self, body: dict):
+        """POST /recommender {schema, queries|queryStats, cardinalities,
+        numRows, qps} (reference: PinotConfigRecommenderRestletResource)."""
+        from ..spi.data_types import Schema
+        from .recommender import recommend
+
+        schema_json = body.get("schema")
+        if schema_json is None:
+            name = body.get("schemaName")
+            schema_json = self.controller.store.get(f"/SCHEMAS/{name}")
+            if schema_json is None:
+                return 400, {"error": "missing 'schema' or known 'schemaName'"}
+        rec = recommend(
+            Schema.from_json(schema_json),
+            queries=body.get("queries"),
+            query_stats=body.get("queryStats"),
+            cardinalities=body.get("cardinalities"),
+            num_rows=int(body.get("numRows", 1_000_000)),
+            qps=float(body.get("qps", 10.0)))
+        return 200, rec.to_json()

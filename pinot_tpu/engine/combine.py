@@ -126,3 +126,122 @@ def combine_selection(
         scanned += im.num_docs_scanned
         rows.extend(im.rows)
     return SelectionIntermediate(first.columns, rows, scanned)
+
+
+# -- server-side group trim (reference: TableResizer in the IndexedTable) ----
+
+DEFAULT_MIN_TRIM_SIZE = 5_000
+DEFAULT_TRIM_THRESHOLD = 1_000_000
+
+
+def trim_group_by(combined, query, semantics):
+    """Trim an ordered group-by intermediate to max(5*limit, minTrimSize)
+    groups when the group count exceeds the trim threshold (reference:
+    TableResizer.resize — servers keep only the groups that can matter for
+    the final ORDER BY ... LIMIT, ordered on the intermediate results).
+
+    Trims ONLY when every ORDER BY expression is a group key or a finalized
+    aggregation — anything else (post-aggregation arithmetic, HAVING) keeps
+    the full set, correctness over memory.
+    """
+    if not query.is_group_by or not query.order_by_expressions:
+        return combined
+    opts = query.query_options
+    min_trim = int(opts.get("minServerGroupTrimSize", DEFAULT_MIN_TRIM_SIZE))
+    threshold = int(opts.get("groupTrimThreshold", DEFAULT_TRIM_THRESHOLD))
+    if min_trim <= 0 or threshold <= 0 or query.having_filter is not None:
+        return combined
+    trim_size = max((query.limit or 0) * 5, min_trim)
+    num_groups = combined.num_groups if isinstance(combined, GroupArrays) \
+        else len(combined.groups)
+    if num_groups <= max(trim_size, 0) or num_groups <= threshold:
+        return combined
+
+    group_strs = [str(g) for g in query.group_by_expressions]
+    agg_strs = [str(a) for a in query.aggregations]
+    alias_map = {a: str(se) for se, a in
+                 zip(query.select_expressions, query.aliases) if a}
+
+    if isinstance(combined, GroupArrays):
+        colmap = {s: c for s, c in zip(group_strs, combined.key_cols)}
+        from .reduce import _apply_fin_tag
+
+        for s, tag, comps in zip(agg_strs, combined.fin_tags,
+                                 combined.state_cols):
+            colmap[s] = _apply_fin_tag(tag, comps)
+        order = []
+        for ob in query.order_by_expressions:
+            key = str(ob.expression)
+            key = alias_map.get(key, key)
+            col = colmap.get(key)
+            if col is None or (not ob.ascending and col.dtype == object):
+                return combined  # unsupported order expr: no trim
+            order.append((col, ob.ascending))
+        perm = np.arange(num_groups)
+        for col, asc in reversed(order):
+            vals = col[perm]
+            k = (np.argsort(vals, kind="stable") if asc
+                 else np.argsort(-vals, kind="stable"))
+            perm = perm[k]
+        sel = np.sort(perm[:trim_size])
+        return GroupArrays(
+            [c[sel] for c in combined.key_cols],
+            [tuple(comp[sel] for comp in comps)
+             for comps in combined.state_cols],
+            combined.vec_specs, combined.fin_tags,
+            num_docs_scanned=combined.num_docs_scanned)
+
+    # dict-form intermediate: build sort keys from key values / finalized
+    # aggregation states
+    def sort_value(key, states, expr_str):
+        if expr_str in group_strs:
+            return key[group_strs.index(expr_str)]
+        if expr_str in agg_strs:
+            i = agg_strs.index(expr_str)
+            return semantics[i].finalize(states[i])
+        return None
+
+    order_exprs = []
+    for ob in query.order_by_expressions:
+        key = str(ob.expression)
+        key = alias_map.get(key, key)
+        if key not in group_strs and key not in agg_strs:
+            return combined
+        order_exprs.append((key, ob.ascending))
+
+    def rank(item):
+        key, states = item
+        out = []
+        for expr_str, asc in order_exprs:
+            v = sort_value(key, states, expr_str)
+            out.append(_TrimKey(v, asc))
+        return tuple(out)
+
+    import heapq
+
+    kept = heapq.nsmallest(trim_size, combined.groups.items(), key=rank)
+    return GroupByIntermediate(dict(kept), combined.num_docs_scanned)
+
+
+class _TrimKey:
+    """Orderable wrapper honoring per-key direction + cross-type safety."""
+
+    __slots__ = ("v", "asc")
+
+    def __init__(self, v, asc):
+        self.v = v
+        self.asc = asc
+
+    def __lt__(self, other):
+        a, b = self.v, other.v
+        if a is None:
+            return False
+        if b is None:
+            return True
+        try:
+            return a < b if self.asc else b < a
+        except TypeError:
+            return str(a) < str(b) if self.asc else str(b) < str(a)
+
+    def __eq__(self, other):
+        return self.v == other.v

@@ -25,7 +25,8 @@ from .scheduler import GLOBAL_ACCOUNTANT
 from ..segment.loader import ImmutableSegment
 from ..spi.data_types import Schema
 from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
-from .combine import combine_aggregation, combine_group_by, combine_selection
+from .combine import (combine_aggregation, combine_group_by,
+                      combine_selection, trim_group_by)
 from .executor import TpuSegmentExecutor
 from .host_executor import HostSegmentExecutor
 from .pruner import SegmentPrunerService
@@ -343,9 +344,10 @@ class QueryExecutor:
                 and all(isinstance(im, GroupArrays) for im in intermediates)):
             merged = combine_group_arrays(intermediates)
             if merged is not None:
-                return merged
+                return trim_group_by(merged, query, semantics)
         if isinstance(first, GroupByIntermediate):
-            return combine_group_by(intermediates, semantics)
+            return trim_group_by(combine_group_by(intermediates, semantics),
+                                 query, semantics)
         if isinstance(first, AggIntermediate):
             return combine_aggregation(intermediates, semantics)
         if isinstance(first, SelectionIntermediate):

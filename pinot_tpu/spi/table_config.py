@@ -61,6 +61,16 @@ class UpsertConfig:
     mode: str = "NONE"  # NONE | FULL | PARTIAL
     partial_upsert_strategies: dict[str, str] = field(default_factory=dict)
     comparison_columns: list[str] = field(default_factory=list)
+    # reference UpsertConfig.metadataTTL: pk entries whose comparison value
+    # falls behind the high-watermark by more than this stop being tracked
+    metadata_ttl: float = 0.0  # 0 → disabled; units of the comparison column
+    # reference UpsertConfig.deleteRecordColumn: a truthy value tombstones
+    # the key; deleted_keys_ttl bounds how long the tombstone is remembered
+    delete_record_column: str = ""
+    deleted_keys_ttl: float = 0.0
+    # reference UpsertConfig.ConsistencyMode: NONE | SYNC — SYNC makes the
+    # invalidate-old/validate-new pair atomic against query mask snapshots
+    consistency_mode: str = "NONE"
 
 
 @dataclass
@@ -114,7 +124,15 @@ class TableConfig:
                 "timeColumnName": self.validation.time_column_name,
                 "replication": self.validation.replication,
             },
-            "upsertConfig": {"mode": self.upsert.mode},
+            "upsertConfig": {
+                "mode": self.upsert.mode,
+                "partialUpsertStrategies": self.upsert.partial_upsert_strategies,
+                "comparisonColumns": self.upsert.comparison_columns,
+                "metadataTTL": self.upsert.metadata_ttl,
+                "deleteRecordColumn": self.upsert.delete_record_column,
+                "deletedKeysTTL": self.upsert.deleted_keys_ttl,
+                "consistencyMode": self.upsert.consistency_mode,
+            },
             "ingestionConfig": {
                 "streamConfigs": self.ingestion.stream_configs,
                 "transformConfigs": self.ingestion.transform_configs,
@@ -127,6 +145,7 @@ class TableConfig:
         idx = d.get("tableIndexConfig", {})
         seg = d.get("segmentsConfig", {})
         ing = d.get("ingestionConfig", {})
+        up = d.get("upsertConfig") or {}
         return cls(
             table_name=d["tableName"],
             table_type=TableType(d.get("tableType", "OFFLINE")),
@@ -143,7 +162,20 @@ class TableConfig:
                 time_column_name=seg.get("timeColumnName"),
                 replication=int(seg.get("replication", 1)),
             ),
-            upsert=UpsertConfig(mode=(d.get("upsertConfig") or {}).get("mode", "NONE")),
+            upsert=UpsertConfig(
+                mode=up.get("mode", "NONE"),
+                partial_upsert_strategies=up.get(
+                    "partialUpsertStrategies") or {},
+                comparison_columns=up.get(
+                    "comparisonColumns") or [],
+                metadata_ttl=float(up.get(
+                    "metadataTTL", 0.0)),
+                delete_record_column=up.get(
+                    "deleteRecordColumn", ""),
+                deleted_keys_ttl=float(up.get(
+                    "deletedKeysTTL", 0.0)),
+                consistency_mode=up.get(
+                    "consistencyMode", "NONE")),
             ingestion=IngestionConfig(
                 stream_configs=ing.get("streamConfigs") or {},
                 transform_configs=ing.get("transformConfigs") or [],

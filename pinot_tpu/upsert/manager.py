@@ -135,6 +135,20 @@ class TableUpsertMetadataManager:
         self._lock = threading.RLock()
         # pk tuple → (segment, doc_id, cmp_value, arrival_seq)
         self._map: dict[tuple, tuple] = {}
+        # TTL + deletes (reference: UpsertConfig.metadataTTL /
+        # deleteRecordColumn / deletedKeysTTL)
+        self.metadata_ttl = float(cfg.metadata_ttl or 0.0)
+        self.delete_column = cfg.delete_record_column or None
+        self.deleted_keys_ttl = float(cfg.deleted_keys_ttl or 0.0)
+        self.consistency_mode = (cfg.consistency_mode or "NONE").upper()
+        # SYNC: every validity plane is CREATED with the manager's lock so
+        # mask() snapshots serialize against invalidate+validate pairs — no
+        # after-the-fact lock swap (which would race in-flight readers)
+        self._shared_lock = self._lock if self.consistency_mode == "SYNC" \
+            else None
+        self._watermark = None  # max comparison value observed
+        # pk → (cmp_value at delete time); tombstones suppress older rows
+        self._deleted: dict[tuple, object] = {}
         self.partial_handler = None
         if self.mode == "PARTIAL":
             self.partial_handler = PartialUpsertHandler(
@@ -158,20 +172,70 @@ class TableUpsertMetadataManager:
     def add_record(self, segment, doc_id: int, row: dict) -> None:
         """Post-index hook: resolve the pk conflict (newer comparison value
         wins; ties go to the later arrival — reference
-        ConcurrentMapPartitionUpsertMetadataManager.addOrReplaceRecord)."""
+        ConcurrentMapPartitionUpsertMetadataManager.addOrReplaceRecord).
+        A truthy delete column tombstones the key instead."""
         pk = self._pk(row)
         cmp_val = row.get(self.cmp_column) if self.cmp_column else None
         seq = next(self._seq)
-        valid = _validity_of(segment)
+        valid = _validity_of(segment, self._shared_lock)
         with self._lock:
+            if cmp_val is not None and (
+                    self._watermark is None or cmp_val > self._watermark):
+                self._watermark = cmp_val
+            if self.delete_column and row.get(self.delete_column):
+                # delete record: resolved through the SAME comparison order
+                # as upserts — a late out-of-order delete must not clobber a
+                # newer live row or a newer tombstone
+                valid.set(doc_id, False)  # the delete row itself never serves
+                loc = self._map.get(pk)
+                if loc is not None and not _newer(cmp_val, seq, loc):
+                    return  # older than the live row: delete loses
+                tomb = self._deleted.get(pk, _MISSING)
+                if tomb is not _MISSING and not _cmp_newer(cmp_val, tomb):
+                    return  # older than the existing tombstone
+                if loc is not None:
+                    del self._map[pk]
+                    _validity_of(loc[0], self._shared_lock).set(loc[1], False)
+                self._deleted[pk] = cmp_val
+                return
+            tomb = self._deleted.get(pk, _MISSING)
+            if tomb is not _MISSING and not _cmp_newer(cmp_val, tomb):
+                valid.set(doc_id, False)  # older than its delete
+                return
+            if tomb is not _MISSING:
+                del self._deleted[pk]  # resurrected by a newer row
             loc = self._map.get(pk)
             if loc is None or _newer(cmp_val, seq, loc):
                 if loc is not None:
-                    _validity_of(loc[0]).set(loc[1], False)
+                    _validity_of(loc[0], self._shared_lock).set(loc[1], False)
                 valid.set(doc_id, True)
                 self._map[pk] = (segment, doc_id, cmp_val, seq)
             else:
                 valid.set(doc_id, False)
+
+    def remove_expired_metadata(self) -> int:
+        """Drop pk entries (and delete tombstones) whose comparison value
+        trails the high-watermark by more than the TTL — the reference's
+        removeExpiredPrimaryKeys periodic task. Validity planes keep their
+        current state; the keys simply stop being tracked (and so stop
+        costing memory). Returns the number of entries dropped."""
+        dropped = 0
+        with self._lock:
+            if self._watermark is None:
+                return 0
+            if self.metadata_ttl > 0:
+                floor = self._watermark - self.metadata_ttl
+                for pk, loc in list(self._map.items()):
+                    if loc[2] is not None and loc[2] < floor:
+                        del self._map[pk]
+                        dropped += 1
+            if self.deleted_keys_ttl > 0:
+                floor = self._watermark - self.deleted_keys_ttl
+                for pk, cmp_val in list(self._deleted.items()):
+                    if cmp_val is not None and cmp_val < floor:
+                        del self._deleted[pk]
+                        dropped += 1
+        return dropped
 
     # -- segment lifecycle --------------------------------------------------
     def replace_segment(self, old, new) -> None:
@@ -183,8 +247,8 @@ class TableUpsertMetadataManager:
             # mask copy + remap must be one atomic step: a concurrent
             # add_record invalidating a doc in `old` between them would be
             # lost, leaving a superseded row valid forever
-            old_valid = _validity_of(old)
-            new_valid = _validity_of(new)
+            old_valid = _validity_of(old, self._shared_lock)
+            new_valid = _validity_of(new, self._shared_lock)
             n = new.num_docs
             m = old_valid.mask(n)
             for d in np.nonzero(m)[0]:
@@ -227,6 +291,9 @@ class TableUpsertMetadataManager:
         return {c: segment.read_cell(c, doc_id) for c in segment.columns()}
 
 
+_MISSING = object()
+
+
 def _newer(cmp_val, seq: int, loc: tuple) -> bool:
     old_cmp, old_seq = loc[2], loc[3]
     if cmp_val is None or old_cmp is None:
@@ -236,10 +303,25 @@ def _newer(cmp_val, seq: int, loc: tuple) -> bool:
     return seq >= old_seq
 
 
-def _validity_of(segment) -> ValidDocIds:
+def _cmp_newer(cmp_val, tomb_cmp) -> bool:
+    """Is a row at cmp_val newer than (or concurrent with) its tombstone?"""
+    if cmp_val is None or tomb_cmp is None:
+        return True  # no comparison values: arrival order → row is later
+    return cmp_val >= tomb_cmp
+
+
+
+
+def _validity_of(segment, shared_lock=None) -> ValidDocIds:
+    """The segment's validity plane, created on first touch. ``shared_lock``
+    (SYNC consistency) becomes the plane's lock AT CREATION — the reference
+    ConsistencyMode SYNC's read-write lock; swapping a live plane's lock
+    would race in-flight readers, so planes created elsewhere keep theirs."""
     v = getattr(segment, "valid_doc_ids", None)
     if v is None:
         v = ValidDocIds(segment.num_docs)
+        if shared_lock is not None:
+            v._lock = shared_lock
         segment.valid_doc_ids = v
     return v
 

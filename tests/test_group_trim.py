@@ -1,0 +1,83 @@
+"""Server-side group trim (reference: TableResizer / minServerGroupTrimSize).
+
+The trim keeps max(5*limit, minTrimSize) groups ordered by the query's
+ORDER BY, only above the trim threshold, and never changes the final result
+of the ordered-limited query.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    rng = np.random.default_rng(77)
+    schema = Schema.build(
+        "t", dimensions=[("k", "INT")], metrics=[("v", "INT")])
+    n = 20_000
+    cols = {"k": rng.integers(0, 5000, n).astype(np.int32),
+            "v": rng.integers(0, 100, n).astype(np.int32)}
+    d = tmp_path_factory.mktemp("trim") / "s0"
+    SegmentBuilder(schema, segment_name="s0").build(cols, d)
+    return schema, load_segment(d), cols
+
+
+def _executor(schema, seg, backend):
+    ex = QueryExecutor(backend=backend)
+    ex.add_table(schema, [seg])
+    return ex
+
+
+@pytest.mark.parametrize("backend", ["host", "tpu"])
+def test_trim_preserves_ordered_limit(table, backend):
+    schema, seg, cols = table
+    ex = _executor(schema, seg, backend)
+    # force trimming: threshold 1, minTrimSize 50 → trim to max(5*10, 50)
+    sql = ("SET groupTrimThreshold=1; SET minServerGroupTrimSize=50; "
+           "SELECT k, SUM(v) FROM t GROUP BY k ORDER BY SUM(v) DESC LIMIT 10")
+    trimmed = ex.execute_sql(sql).result_table
+    full = ex.execute_sql(
+        "SELECT k, SUM(v) FROM t GROUP BY k ORDER BY SUM(v) DESC LIMIT 10"
+    ).result_table
+    assert trimmed is not None and full is not None
+    # same top-10 sums (key ties may reorder within equal sums)
+    assert [r[1] for r in trimmed.rows] == [r[1] for r in full.rows]
+
+    # order by group key ascending
+    sql = ("SET groupTrimThreshold=1; SET minServerGroupTrimSize=50; "
+           "SELECT k, COUNT(*) FROM t GROUP BY k ORDER BY k LIMIT 20")
+    trimmed = ex.execute_sql(sql).result_table
+    full = ex.execute_sql(
+        "SELECT k, COUNT(*) FROM t GROUP BY k ORDER BY k LIMIT 20"
+    ).result_table
+    assert trimmed.rows == full.rows
+
+
+def test_no_trim_without_order_or_below_threshold(table):
+    schema, seg, cols = table
+    ex = _executor(schema, seg, "host")
+    # no ORDER BY → trim must not apply (any-group subset would be wrong)
+    sql = ("SET groupTrimThreshold=1; SET minServerGroupTrimSize=5; "
+           "SELECT k, COUNT(*) FROM t GROUP BY k LIMIT 100000")
+    rows = ex.execute_sql(sql).result_table.rows
+    assert len(rows) == len(np.unique(cols["k"]))
+    # below threshold (default 1M): untouched
+    rows = ex.execute_sql(
+        "SELECT k, COUNT(*) FROM t GROUP BY k ORDER BY k LIMIT 100000"
+    ).result_table.rows
+    assert len(rows) == len(np.unique(cols["k"]))
+
+
+def test_having_disables_trim(table):
+    schema, seg, cols = table
+    ex = _executor(schema, seg, "host")
+    sql = ("SET groupTrimThreshold=1; SET minServerGroupTrimSize=5; "
+           "SELECT k, COUNT(*) FROM t GROUP BY k HAVING COUNT(*) >= 1 "
+           "ORDER BY k LIMIT 100000")
+    rows = ex.execute_sql(sql).result_table.rows
+    assert len(rows) == len(np.unique(cols["k"]))

@@ -1,0 +1,93 @@
+"""Config recommender + cluster summary endpoint tests (reference:
+pinot-controller recommender rule tests)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pinot_tpu.cluster import ClusterController, PropertyStore
+from pinot_tpu.cluster.recommender import analyze_queries, recommend
+from pinot_tpu.cluster.rest import ControllerRestServer
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "clicks",
+    dimensions=[("country", "STRING"), ("userId", "STRING"),
+                ("url", "STRING"), ("device", "STRING")],
+    metrics=[("views", "LONG"), ("cost", "DOUBLE")],
+    date_times=[("ts", "TIMESTAMP")])
+
+QUERIES = [
+    {"sql": "SELECT COUNT(*) FROM clicks WHERE country = 'us'", "freq": 5},
+    {"sql": "SELECT SUM(views) FROM clicks WHERE country = 'uk' AND "
+            "ts > 1000", "freq": 2},
+    {"sql": "SELECT device, SUM(views), SUM(cost) FROM clicks "
+            "GROUP BY device", "freq": 4},
+    {"sql": "SELECT COUNT(*) FROM clicks WHERE userId = 'u1'", "freq": 1},
+]
+
+CARDS = {"country": 200, "userId": 5_000_000, "url": 9_000_000,
+         "device": 12, "ts": 8_000_000}
+
+
+def test_analyze_queries():
+    stats = analyze_queries(QUERIES)
+    assert stats["eq_filters"]["country"] == pytest.approx(7 / 12)
+    assert stats["range_filters"]["ts"] == pytest.approx(2 / 12)
+    assert stats["group_by"]["device"] == pytest.approx(4 / 12)
+    assert "sum(views)" in stats["aggregations"]
+
+
+def test_recommendations():
+    rec = recommend(SCHEMA, queries=QUERIES, cardinalities=CARDS,
+                    num_rows=10_000_000, qps=50)
+    idx = rec.indexing
+    # country dominates equality filters → sorted column
+    assert idx["sortedColumn"] == "country"
+    # userId: equality-filtered + high cardinality → bloom
+    assert "userId" in idx.get("bloomFilterColumns", [])
+    # userId is too high-cardinality for postings: bloom only, no inverted
+    assert "userId" not in idx.get("invertedIndexColumns", [])
+    # ts range-filtered → range index
+    assert "ts" in idx.get("rangeIndexColumns", [])
+    # url: near-unique, never filtered → raw + LZ4
+    assert idx.get("noDictionaryColumns") == ["url"]
+    assert idx.get("compressionConfigs", {}).get("url") == "LZ4"
+    # device group-by + aggs → star tree
+    st = idx.get("starTreeIndexConfigs")
+    assert st and st[0]["dimensionsSplitOrder"] == ["device"]
+    assert rec.partition_column == "country"
+    assert len(rec.rationale) >= 5
+
+
+def test_recommender_and_summary_endpoints(tmp_path):
+    store = PropertyStore()
+    controller = ClusterController(store)
+    controller.add_schema(SCHEMA.to_json())
+    controller.create_table({"tableName": "clicks", "replication": 1})
+    rest = ControllerRestServer(controller)
+    try:
+        body = json.dumps({"schemaName": "clicks", "queries": QUERIES,
+                           "cardinalities": CARDS,
+                           "numRows": 10_000_000}).encode()
+        req = urllib.request.Request(
+            rest.url + "/recommender", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["tableIndexConfig"]["sortedColumn"] == "country"
+        assert out["rationale"]
+
+        with urllib.request.urlopen(rest.url + "/cluster/summary") as r:
+            summary = json.loads(r.read())
+        assert "clicks_OFFLINE" in summary["tables"]
+        assert summary["schemas"] == ["clicks"]
+
+        with urllib.request.urlopen(rest.url + "/") as r:
+            assert r.headers.get("Content-Type", "").startswith("text/html")
+            page = r.read().decode()
+        assert "<h1>Cluster</h1>" in page
+        assert "clicks_OFFLINE" in page
+    finally:
+        rest.close()

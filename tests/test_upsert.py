@@ -226,3 +226,117 @@ def test_realtime_upsert_end_to_end(tmp_path):
         assert mgr2.pk_manager.num_primary_keys() == 2
     finally:
         mgr2.stop()
+
+
+# -- TTL, delete column, consistency mode (reference UpsertConfig additions) --
+
+
+def _cfg_ext(**kw):
+    return TableConfig(
+        table_name="events",
+        upsert=UpsertConfig(mode="FULL", comparison_columns=["ts"], **kw))
+
+
+def test_delete_record_column_tombstones():
+    schema = Schema.build(
+        "events",
+        dimensions=[("pk", "STRING"), ("city", "STRING")],
+        metrics=[("clicks", "INT"), ("deleted", "INT")],
+        date_times=[("ts", "LONG")],
+        primary_key_columns=["pk"])
+    mgr = TableUpsertMetadataManager(
+        schema, _cfg_ext(delete_record_column="deleted"))
+    seg = MutableSegment(schema, "s0")
+    rows = [
+        {"pk": "a", "city": "sf", "clicks": 1, "deleted": 0, "ts": 100},
+        {"pk": "a", "city": "", "clicks": 0, "deleted": 1, "ts": 200},  # delete
+        {"pk": "a", "city": "la", "clicks": 2, "deleted": 0, "ts": 150},  # older than delete
+        {"pk": "a", "city": "ch", "clicks": 3, "deleted": 0, "ts": 300},  # resurrects
+    ]
+    for r in rows:
+        d = seg.index(dict(r))
+        mgr.add_record(seg, d, r)
+    mask = list(seg.valid_doc_ids.mask(seg.num_docs))
+    assert mask == [False, False, False, True]
+    assert mgr.num_primary_keys() == 1
+
+
+def test_metadata_ttl_drops_old_keys():
+    mgr = TableUpsertMetadataManager(SCHEMA, _cfg_ext(metadata_ttl=100))
+    seg = _mk_segment()
+    for r in [{"pk": "old", "city": "sf", "clicks": 1, "ts": 100},
+              {"pk": "mid", "city": "ny", "clicks": 2, "ts": 240},
+              {"pk": "new", "city": "la", "clicks": 3, "ts": 300}]:
+        d = seg.index(dict(r))
+        mgr.add_record(seg, d, r)
+    assert mgr.num_primary_keys() == 3
+    dropped = mgr.remove_expired_metadata()
+    # watermark 300, ttl 100 → floor 200: "old" (100) expires
+    assert dropped == 1
+    assert mgr.num_primary_keys() == 2
+    # validity is untouched — expired keys stay queryable
+    assert int(seg.valid_doc_ids.mask(seg.num_docs).sum()) == 3
+
+
+def test_deleted_keys_ttl():
+    schema = Schema.build(
+        "events",
+        dimensions=[("pk", "STRING")],
+        metrics=[("deleted", "INT")],
+        date_times=[("ts", "LONG")],
+        primary_key_columns=["pk"])
+    mgr = TableUpsertMetadataManager(
+        schema, _cfg_ext(delete_record_column="deleted",
+                         deleted_keys_ttl=50))
+    seg = MutableSegment(schema, "s0")
+    for r in [{"pk": "a", "deleted": 0, "ts": 100},
+              {"pk": "a", "deleted": 1, "ts": 110},
+              {"pk": "b", "deleted": 0, "ts": 200}]:
+        d = seg.index(dict(r))
+        mgr.add_record(seg, d, r)
+    assert len(mgr._deleted) == 1
+    assert mgr.remove_expired_metadata() == 1  # tombstone (110) < 200-50
+    assert len(mgr._deleted) == 0
+
+
+def test_sync_consistency_shares_locks():
+    mgr = TableUpsertMetadataManager(SCHEMA, _cfg_ext(consistency_mode="SYNC"))
+    seg_a, seg_b = _mk_segment("a"), _mk_segment("b")
+    r1 = {"pk": "k", "city": "sf", "clicks": 1, "ts": 100}
+    d = seg_a.index(dict(r1))
+    mgr.add_record(seg_a, d, r1)
+    r2 = {"pk": "k", "city": "ny", "clicks": 2, "ts": 200}
+    d = seg_b.index(dict(r2))
+    mgr.add_record(seg_b, d, r2)
+    # both planes share the manager's lock: a mask snapshot taken while an
+    # update holds the lock cannot observe the half-applied state
+    assert seg_a.valid_doc_ids._lock is mgr._lock
+    assert seg_b.valid_doc_ids._lock is mgr._lock
+    assert list(seg_a.valid_doc_ids.mask(1)) == [False]
+    assert list(seg_b.valid_doc_ids.mask(1)) == [True]
+
+
+def test_out_of_order_delete_does_not_clobber_newer_row():
+    """A late delete row older than the live row must lose (reference:
+    deleteRecordColumn resolves through the comparison column)."""
+    schema = Schema.build(
+        "events",
+        dimensions=[("pk", "STRING")],
+        metrics=[("v", "INT"), ("deleted", "INT")],
+        date_times=[("ts", "LONG")],
+        primary_key_columns=["pk"])
+    mgr = TableUpsertMetadataManager(
+        schema, _cfg_ext(delete_record_column="deleted"))
+    seg = MutableSegment(schema, "s0")
+    for r in [{"pk": "a", "v": 1, "deleted": 0, "ts": 300},
+              {"pk": "a", "v": 0, "deleted": 1, "ts": 200}]:  # late delete
+        d = seg.index(dict(r))
+        mgr.add_record(seg, d, r)
+    assert list(seg.valid_doc_ids.mask(2)) == [True, False]
+    assert mgr.num_primary_keys() == 1
+    # and a late delete can't replace a NEWER tombstone
+    for r in [{"pk": "b", "v": 0, "deleted": 1, "ts": 500},
+              {"pk": "b", "v": 0, "deleted": 1, "ts": 400}]:
+        d = seg.index(dict(r))
+        mgr.add_record(seg, d, r)
+    assert mgr._deleted[("b",)] == 500
