@@ -41,11 +41,15 @@ class ControllerPeriodicTaskScheduler:
     """Fixed-interval controller jobs on one background thread (reference:
     ControllerPeriodicTask + PeriodicTaskScheduler)."""
 
-    def __init__(self, tick_s: float = 0.05):
+    def __init__(self, tick_s: float = 0.05, leader=None):
         self.tick_s = tick_s
         self.tasks: dict[str, PeriodicTask] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # cluster/leader.py LeadControllerManager: with multiple controllers
+        # only the elected leader runs periodic jobs (reference: controller
+        # periodic tasks run on the lead controller only)
+        self.leader = leader
 
     def register(self, name: str, interval_s: float, fn: Callable) -> None:
         self.tasks[name] = PeriodicTask(name, interval_s, fn)
@@ -73,6 +77,8 @@ class ControllerPeriodicTaskScheduler:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.tick_s):
+            if self.leader is not None and not self.leader.is_leader:
+                continue  # standby controller: the leader runs the jobs
             now = time.monotonic()
             for t in self.tasks.values():
                 if now - t.last_run >= t.interval_s:
@@ -279,9 +285,12 @@ class SegmentRelocator:
 
 
 def build_default_scheduler(store: PropertyStore, controller: ClusterController,
-                            interval_s: float = 10.0) -> ControllerPeriodicTaskScheduler:
-    """The standard job set (reference BaseControllerStarter wiring)."""
-    sched = ControllerPeriodicTaskScheduler()
+                            interval_s: float = 10.0,
+                            leader=None) -> ControllerPeriodicTaskScheduler:
+    """The standard job set (reference BaseControllerStarter wiring). Pass
+    a LeadControllerManager so only the elected controller runs the jobs
+    when several controllers share a cluster."""
+    sched = ControllerPeriodicTaskScheduler(leader=leader)
     sched.register("RetentionManager", interval_s,
                    lambda: controller.run_retention())
     sched.register("SegmentStatusChecker", interval_s,

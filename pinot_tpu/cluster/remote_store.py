@@ -69,6 +69,8 @@ class PropertyStoreServer:
             return self.store.set(path, value, expected_version, ephemeral_owner)
         if op == "delete":
             return self.store.delete(*args)
+        if op == "create_if_absent":
+            return self.store.create_if_absent(*args)
         if op == "children":
             return self.store.children(*args)
         if op == "list_paths":
@@ -128,6 +130,10 @@ class RemoteStore:
 
     def delete(self, path: str) -> bool:
         return self._call("delete", path)
+
+    def create_if_absent(self, path: str, value: Any,
+                         ephemeral_owner: Optional[str] = None) -> bool:
+        return self._call("create_if_absent", path, value, ephemeral_owner)
 
     def children(self, prefix: str) -> list[str]:
         return self._call("children", prefix)

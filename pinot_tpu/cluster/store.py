@@ -57,6 +57,18 @@ class PropertyStore:
         self._notify(path, value)
         return newv
 
+    def create_if_absent(self, path: str, value: Any,
+                         ephemeral_owner: Optional[str] = None) -> bool:
+        """Atomic exclusive create (ZK create with EPHEMERAL flag): True if
+        this call created the entry, False if it already existed."""
+        json.dumps(value)
+        with self._lock:
+            if path in self._data:
+                return False
+            self._data[path] = _Entry(value, 0, ephemeral_owner)
+        self._notify(path, value)
+        return True
+
     def get(self, path: str) -> Optional[Any]:
         with self._lock:
             e = self._data.get(path)

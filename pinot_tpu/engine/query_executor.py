@@ -95,10 +95,16 @@ class QueryExecutor:
     def add_table(self, schema: Schema, segments: list[ImmutableSegment], name: Optional[str] = None):
         """``segments`` is held BY REFERENCE when it is a list: realtime data
         managers mutate it in place as segments commit/rotate and queries see
-        the live view (snapshotted per query)."""
+        the live view (snapshotted per query). Segments predating schema
+        columns are backfilled with virtual default columns on registration
+        (reference: on-load default-column update — schema evolution)."""
+        if not isinstance(segments, list):
+            segments = list(segments)  # before iterating: may be a generator
+        for seg in segments:
+            if hasattr(seg, "apply_schema"):
+                seg.apply_schema(schema)
         self.tables[name or schema.schema_name] = Table(
-            name or schema.schema_name, schema,
-            segments if isinstance(segments, list) else list(segments))
+            name or schema.schema_name, schema, segments)
 
     def execute_sql(self, sql: str) -> BrokerResponse:
         """Engine selection mirrors the reference's

@@ -36,6 +36,40 @@ class ImmutableSegment:
         self._mv_offsets: dict[str, np.ndarray] = {}
         self._indexes: dict[tuple, object] = {}
 
+    # -- schema evolution ---------------------------------------------------
+    def apply_schema(self, schema) -> None:
+        """Backfill columns the schema has but this segment predates as
+        virtual default-value columns (reference:
+        SegmentPreProcessor.updateDefaultColumns on load,
+        ImmutableSegmentLoader.java:67-101 — schema evolution without
+        rewriting old segments). Virtual columns are dict-encoded with one
+        value (the field's default), so every engine path — predicates,
+        group keys, projections — works unchanged."""
+        from .dictionary import Dictionary
+
+        for name in schema.column_names():
+            if name in self.metadata.columns:
+                continue
+            spec = schema.field_spec(name)
+            if not spec.single_value:
+                continue  # MV virtual columns: not needed yet
+            default = spec.default_null_value
+            dt = spec.data_type
+            n = self.num_docs
+            meta = ColumnMetadata(
+                name=name, data_type=dt.value, field_type=spec.field_type.value,
+                encoding="DICT", cardinality=1, bits_per_value=1,
+                min_value=default, max_value=default, is_sorted=True,
+                total_number_of_entries=n)
+            self.metadata.columns[name] = meta
+            if dt.value in ("STRING", "JSON", "BYTES"):
+                values = np.asarray([default], dtype=object)
+            else:
+                values = np.asarray([default], dtype=dt.numpy_dtype)
+            self._dictionaries[name] = Dictionary(dt, values)
+            self._dict_ids[name] = np.zeros(n, dtype=np.int32)
+            self._nulls[name] = None
+
     # -- identity ----------------------------------------------------------
     @property
     def name(self) -> str:
