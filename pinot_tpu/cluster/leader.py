@@ -28,10 +28,15 @@ class LeadControllerManager:
         self._is_leader = False
         self._lock = threading.Lock()
         self._started = False
+        self._watched = False
 
     def start(self) -> None:
         self._started = True
-        self.store.watch(LEADER_PATH, self._on_event)
+        if not self._watched:
+            # watches are persistent: register ONCE even across
+            # disconnect/rejoin cycles (re-registering would leak callbacks)
+            self.store.watch(LEADER_PATH, self._on_event)
+            self._watched = True
         self._try_claim()
 
     def disconnect(self) -> None:

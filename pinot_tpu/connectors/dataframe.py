@@ -66,6 +66,9 @@ def _result_set(sql, broker_url, connection, auth, token):
             raise ValueError("pass broker_url or connection")
         from ..client import connect
 
+        # client connections are stateless (one HTTP request per execute,
+        # nothing held open) so the throwaway connection costs one object;
+        # pass `connection=` to reuse credentials across many reads
         connection = connect(broker_url, auth=auth, token=token)
     return connection.execute(sql)
 

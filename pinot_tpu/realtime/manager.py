@@ -67,6 +67,7 @@ class RealtimeSegmentDataManager:
                  on_build: Optional[Callable] = None,
                  on_commit_success: Optional[Callable] = None,
                  on_discard: Optional[Callable] = None,
+                 on_elected: Optional[Callable] = None,
                  test_hooks: Optional[dict] = None):
         self.schema = schema
         self.table_config = table_config
@@ -84,6 +85,7 @@ class RealtimeSegmentDataManager:
         self.on_build = on_build
         self.on_commit_success = on_commit_success
         self.on_discard = on_discard
+        self.on_elected = on_elected  # pauseless successor start
         self.test_hooks = test_hooks or {}
         # upsert/dedup metadata manager (upsert/manager.py): process_row
         # pre-index (partial merge / duplicate drop), add_record post-index
@@ -223,6 +225,12 @@ class RealtimeSegmentDataManager:
                     table, name, self.instance_id, self.current_offset.offset)
                 if start.status != CONTINUE:
                     continue
+                if self.on_elected is not None:
+                    # pauseless: the successor consumer starts at the
+                    # elected end offset BEFORE the build/upload completes
+                    # (reference: PauselessSegmentCompletionFSM — ingestion
+                    # never pauses for the commit)
+                    self.on_elected(self, self.current_offset.offset)
                 location = self.on_build(self)
                 die = self.test_hooks.get("die_before_commit_end")
                 if die is not None and die(self):
@@ -290,6 +298,7 @@ class RealtimeTableDataManager:
     def __init__(self, schema, table_config, data_dir: str | Path,
                  segment_hook: Optional[Callable] = None,
                  completion=None, instance_id: str = "server_0",
+                 pauseless: bool = False,
                  test_hooks: Optional[dict] = None):
         self.schema = schema
         self.table_config = table_config
@@ -316,6 +325,13 @@ class RealtimeTableDataManager:
         # partition-pinned and cannot be rebuilt from a downloaded build.
         self.completion = completion if self.pk_manager is None else None
         self.instance_id = instance_id
+        # pauseless (reference PauselessSegmentCompletionFSM): the successor
+        # consumer starts at election time, while the elected committer is
+        # still building — requires the completion protocol
+        self.pauseless = bool(pauseless and self.completion is not None)
+        # segments sealed-but-not-yet-committed, still serving queries:
+        # segment name → (mutable segment, its manager — still mid-commit)
+        self._committing: dict[str, tuple] = {}
         self.test_hooks = test_hooks or {}
         self.segments: list = []  # live view: immutables + mutables
         self._committed: list[ImmutableSegment] = []
@@ -409,7 +425,23 @@ class RealtimeTableDataManager:
             on_build=self._handle_build,
             on_commit_success=self._handle_commit_success,
             on_discard=self._handle_discard,
+            on_elected=self._handle_elected if self.pauseless else None,
             test_hooks=self.test_hooks)
+
+    def _handle_elected(self, mgr: RealtimeSegmentDataManager,
+                        end_offset: int) -> None:
+        """Pauseless: the sealed segment moves to a committing-holding list
+        (still queryable) and the successor consumer starts NOW from the
+        elected end offset — ingestion never waits for build/upload."""
+        with self._lock:
+            if self._consuming.get(mgr.partition) is not mgr:
+                return  # successor already started (re-elected committer)
+            self._committing[mgr.segment.segment_name] = (mgr.segment, mgr)
+            self._consuming.pop(mgr.partition, None)
+            if not self._shutdown:
+                self._start_partition_from(mgr.partition,
+                                           LongMsgOffset(end_offset))
+            self._refresh_view()
 
     def stop(self):
         # order matters: the shutdown flag first, so a commit racing with us
@@ -422,6 +454,11 @@ class RealtimeTableDataManager:
             with self._lock:
                 managers = [m for m in self._consuming.values()
                             if m._thread.is_alive() or not m._stop.is_set()]
+                # pauseless: elected committers left _consuming but their
+                # threads are still building/committing — drain them too, or
+                # they'd keep writing checkpoints after "shutdown"
+                managers += [m for _seg, m in self._committing.values()
+                             if m._thread.is_alive() or not m._stop.is_set()]
             if not managers:
                 break
             for m in managers:
@@ -465,12 +502,22 @@ class RealtimeTableDataManager:
             self.segment_hook(committed)
         with self._lock:
             self._committed.append(committed)
-            self._offsets[str(mgr.partition)] = str(mgr.current_offset)
+            # pauseless: the successor may have committed a LATER offset
+            # already — never move the checkpoint backwards (restart would
+            # re-ingest the successor's rows)
+            cur = int(self._offsets.get(str(mgr.partition), "0") or 0)
+            self._offsets[str(mgr.partition)] = str(
+                max(cur, mgr.current_offset.offset))
             self._segment_names.append(mgr.segment.segment_name)
             self._save_checkpoints()
-            self._consuming.pop(mgr.partition, None)
-            if not self._shutdown:
-                self._start_partition_from(mgr.partition, mgr.current_offset)
+            was_pauseless = self._committing.pop(
+                mgr.segment.segment_name, None) is not None
+            if not was_pauseless:
+                self._consuming.pop(mgr.partition, None)
+                if not self._shutdown:
+                    self._start_partition_from(mgr.partition,
+                                               mgr.current_offset)
+            # pauseless: the successor is already consuming
             self._refresh_view()
 
     def _handle_discard(self, mgr: RealtimeSegmentDataManager,
@@ -491,13 +538,16 @@ class RealtimeTableDataManager:
             self.segment_hook(committed)
         with self._lock:
             self._committed.append(committed)
-            self._offsets[str(mgr.partition)] = str(end_offset)
+            cur = int(self._offsets.get(str(mgr.partition), "0") or 0)
+            self._offsets[str(mgr.partition)] = str(max(cur, int(end_offset)))
             self._segment_names.append(name)
             self._save_checkpoints()
-            self._consuming.pop(mgr.partition, None)
-            if not self._shutdown:
-                self._start_partition_from(mgr.partition,
-                                           LongMsgOffset(end_offset))
+            was_pauseless = self._committing.pop(name, None) is not None
+            if not was_pauseless:
+                self._consuming.pop(mgr.partition, None)
+                if not self._shutdown:
+                    self._start_partition_from(mgr.partition,
+                                               LongMsgOffset(end_offset))
             self._refresh_view()
 
     def _start_partition_from(self, partition: int, offset: LongMsgOffset):
@@ -508,8 +558,11 @@ class RealtimeTableDataManager:
         nxt.start()
 
     def _refresh_view(self):
-        self.segments[:] = list(self._committed) + [
-            m.segment for m in self._consuming.values()]
+        # committing-holding segments (pauseless) stay queryable until their
+        # immutable replacement lands
+        self.segments[:] = (list(self._committed)
+                            + [seg for seg, _m in self._committing.values()]
+                            + [m.segment for m in self._consuming.values()])
 
     # -- ops ---------------------------------------------------------------
     def force_commit(self, timeout: float = 30.0) -> list[str]:

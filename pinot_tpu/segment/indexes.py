@@ -416,14 +416,99 @@ class TextIndex:
         i = self._term_index(term)
         return self.doc_postings[i] if i >= 0 else np.empty(0, dtype=np.uint32)
 
-    def docs_for_prefix(self, prefix: str) -> np.ndarray:
+    def _prefix_range(self, prefix: str) -> tuple[int, int]:
+        """[lo, hi) slice of sorted terms starting with prefix."""
         import bisect
 
         lo = bisect.bisect_left(self.terms, prefix)
         hi = bisect.bisect_left(self.terms, prefix + "￿")
+        return lo, hi
+
+    def docs_for_prefix(self, prefix: str) -> np.ndarray:
+        lo, hi = self._prefix_range(prefix)
         if lo >= hi:
             return np.empty(0, dtype=np.uint32)
         return np.unique(np.concatenate(self.doc_postings[lo:hi]))
+
+    def docs_for_regex(self, pattern: str) -> np.ndarray:
+        """Docs containing any term matching the regex (reference: the
+        native FST regex engine walks the term automaton —
+        .../utils/nativefst/RegexpMatcher.java). The sorted term list plays
+        the FST's role: a literal prefix extracted from the pattern narrows
+        the scan to one bisect range before the full-match test."""
+        import bisect
+
+        # terms are lowercased by the analyzer: match case-insensitively so
+        # /Error.*/ behaves like every other (lowercased) query form
+        pat = _re.compile(pattern, _re.IGNORECASE)
+        # literal prefix → restrict the candidate range (the FST descent).
+        # A char is only a REQUIRED literal if it is alphanumeric AND not
+        # made optional/repeated by a following quantifier (errors? has the
+        # literal prefix "error", not "errors"); top-level alternation
+        # (foo|bar) voids any prefix
+        prefix = []
+        if "|" not in pattern:
+            for i, ch in enumerate(pattern):
+                nxt = pattern[i + 1] if i + 1 < len(pattern) else ""
+                if ch.isalnum() and nxt not in "?*{":
+                    prefix.append(ch.lower())
+                else:
+                    break
+        lo, hi = (self._prefix_range("".join(prefix)) if prefix
+                  else (0, len(self.terms)))
+        parts = [self.doc_postings[i] for i in range(lo, hi)
+                 if pat.fullmatch(self.terms[i])]
+        if not parts:
+            return np.empty(0, dtype=np.uint32)
+        return np.unique(np.concatenate(parts))
+
+    # -- relevance (reference: Lucene BM25Similarity backing the text
+    # index's match scores) -------------------------------------------------
+    def bm25_scores(self, query: str, num_docs: int,
+                    k1: float = 1.2, b: float = 0.75) -> np.ndarray:
+        """BM25 score per doc for the flat terms of a TEXT_MATCH query
+        (phrases/prefixes score by their expanded terms)."""
+        terms = self._score_terms(_parse_text_query(query))
+        doc_len = np.zeros(num_docs, dtype=np.float64)
+        for docs_dup, _pos in self.pos_postings:
+            np.add.at(doc_len, docs_dup[docs_dup < num_docs], 1.0)
+        avg_len = doc_len.mean() if num_docs else 1.0
+        avg_len = avg_len or 1.0
+        scores = np.zeros(num_docs, dtype=np.float64)
+        for term in terms:
+            i = self._term_index(term)
+            if i < 0:
+                continue
+            docs_dup, _ = self.pos_postings[i]
+            docs_dup = docs_dup[docs_dup < num_docs]
+            tf = np.zeros(num_docs, dtype=np.float64)
+            np.add.at(tf, docs_dup, 1.0)
+            df = len(self.doc_postings[i])
+            idf = np.log1p((num_docs - df + 0.5) / (df + 0.5))
+            denom = tf + k1 * (1 - b + b * doc_len / avg_len)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                contrib = idf * tf * (k1 + 1) / np.where(denom == 0, 1, denom)
+            scores += np.where(tf > 0, contrib, 0.0)
+        return scores
+
+    def _score_terms(self, node) -> list:
+        kind = node[0]
+        if kind == "term":
+            return [node[1]]
+        if kind == "phrase":
+            return list(node[1])
+        if kind == "prefix":
+            lo, hi = self._prefix_range(node[1])
+            return self.terms[lo:hi]
+        if kind == "regex":
+            pat = _re.compile(node[1], _re.IGNORECASE)
+            return [t for t in self.terms if pat.fullmatch(t)]
+        if kind in ("and", "or"):
+            out = []
+            for c in node[1]:
+                out.extend(self._score_terms(c))
+            return out
+        return []
 
     def docs_for_phrase(self, phrase_terms: list) -> np.ndarray:
         """Docs containing the terms at consecutive positions."""
@@ -460,6 +545,8 @@ class TextIndex:
             return self.docs_for_term(node[1])
         if kind == "prefix":
             return self.docs_for_prefix(node[1])
+        if kind == "regex":
+            return self.docs_for_regex(node[1])
         if kind == "phrase":
             return self.docs_for_phrase(node[1])
         if kind == "and":
@@ -482,7 +569,9 @@ def _parse_text_query(q: str):
     """Mini Lucene syntax: terms, quoted phrases, AND/OR (AND binds
     tighter), prefix `foo*`, parentheses. Bare adjacency = OR (Lucene's
     default operator)."""
-    tokens = _re.findall(r'"[^"]*"|\(|\)|[^\s()"]+', q)
+    # regex terms /.../ lex as ONE token — their parens/operators are part
+    # of the pattern, not the boolean query
+    tokens = _re.findall(r'"[^"]*"|/(?:[^/\\]|\\.)+/|\(|\)|[^\s()"]+', q)
     pos = [0]
 
     def peek():
@@ -525,6 +614,10 @@ def _parse_text_query(q: str):
             return inner
         if t.startswith('"'):
             return ("phrase", tokenize_text(t.strip('"')))
+        if t.startswith("/") and t.endswith("/") and len(t) > 1:
+            # Lucene regex term syntax /pattern/ (reference: the native FST
+            # regex engine matches terms against the automaton)
+            return ("regex", t[1:-1])
         if t.endswith("*"):
             return ("prefix", t[:-1].lower())
         toks = tokenize_text(t)

@@ -242,14 +242,15 @@ def test_chaos_replica_killed_mid_ingestion_recovers(registry, tmp_path):
     a.start()
     b.start()
     registry.publish("ev3", rows(20))
-    assert wait_until(lambda: _total_rows(a) == 20 and _total_rows(b) == 20)
+    assert wait_until(lambda: _total_rows(a) == 20 and _total_rows(b) == 20,
+                      timeout=60)
 
     # chaos: replica A dies mid-stream
     a.stop()
     registry.publish("ev3", rows(40, start=20))
     # B alone keeps committing (decision_wait elapses with a single voter)
     assert wait_until(lambda: _total_rows(b) == 60
-                      and len(b._segment_names) >= 2, timeout=25), \
+                      and len(b._segment_names) >= 2, timeout=60), \
         (_total_rows(b), b._segment_names)
 
     # A restarts from its checkpoint and converges to the same row count,
@@ -260,7 +261,7 @@ def test_chaos_replica_killed_mid_ingestion_recovers(registry, tmp_path):
     try:
         assert wait_until(lambda: _total_rows(a2) == 60
                           and a2._segment_names == b._segment_names,
-                          timeout=25), \
+                          timeout=60), \
             (_total_rows(a2), a2._segment_names, b._segment_names)
         # every committed segment now exists in BOTH data dirs
         for name in b._segment_names:
