@@ -325,6 +325,8 @@ class Broker:
             num_segments_queried=stats_sum["num_segments_queried"],
             num_segments_processed=stats_sum["num_segments_processed"],
             num_segments_pruned=stats_sum["num_segments_pruned"],
+            num_groups_limit_reached=getattr(combined, "groups_trimmed",
+                                             False),
         )
 
     def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict):

@@ -12,7 +12,7 @@ No pickle anywhere — every byte on the query data plane is accounted for.
 Layout (little-endian):
 
     magic  b"PTDT"
-    u16    version (=1)
+    u16    version (=2)
     u8     kind    (GroupArrays | GroupByDict | Agg | Selection)
     u32    metadata JSON length, then the JSON (stats map)
     ...    kind-specific payload built from the tagged value encoding
@@ -36,7 +36,7 @@ from ..engine.results import (
 from ..utils import sketches
 
 MAGIC = b"PTDT"
-VERSION = 1
+VERSION = 2  # v2: groups_trimmed flag on group intermediates
 
 KIND_GROUP_ARRAYS = 0
 KIND_GROUP_DICT = 1
@@ -255,9 +255,11 @@ def encode(combined, stats: dict) -> bytes:
         _w_value(out, [list(s) for s in combined.vec_specs])
         _w_value(out, list(combined.fin_tags))
         _w_value(out, combined.num_docs_scanned)
+        _w_value(out, bool(combined.groups_trimmed))
     elif kind == KIND_GROUP_DICT:
         _w_value(out, combined.groups)
         _w_value(out, combined.num_docs_scanned)
+        _w_value(out, bool(combined.groups_trimmed))
     elif kind == KIND_AGG:
         _w_value(out, list(combined.states))
         _w_value(out, combined.num_docs_scanned)
@@ -286,13 +288,17 @@ def decode(blob: bytes):
         vec_specs = _r_value(r)
         fin_tags = [_to_tag(t) for t in _r_value(r)]
         nds = _r_value(r)
+        trimmed = _r_value(r)
         return GroupArrays(key_cols, [tuple(c) for c in state_cols],
                            [tuple(s) for s in vec_specs], fin_tags,
-                           num_docs_scanned=nds), stats
+                           num_docs_scanned=nds,
+                           groups_trimmed=trimmed), stats
     if kind == KIND_GROUP_DICT:
         groups = _r_value(r)
         nds = _r_value(r)
-        return GroupByIntermediate(groups, num_docs_scanned=nds), stats
+        trimmed = _r_value(r)
+        return GroupByIntermediate(groups, num_docs_scanned=nds,
+                                   groups_trimmed=trimmed), stats
     if kind == KIND_AGG:
         states = _r_value(r)
         nds = _r_value(r)

@@ -96,6 +96,10 @@ class LoweredAgg:
     semantics: AggSemantics
     extract: Callable  # (outs, g) -> state
     vec: "VecAgg | None" = None
+    # optional batch form: prepare(outs) -> (g -> state). The executor uses
+    # it on the dict path so per-output work (e.g. decoding the sparse
+    # distinct pair list) runs ONCE, vectorized, instead of per group.
+    prepare: "Callable | None" = None
 
 
 # ---------------------------------------------------------------------------
@@ -398,44 +402,57 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
 
     if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
                 "distinctsum", "distinctavg"):
-        i, dictionary = _occupancy_op(ctx, data[0], name)
+        i, dictionary, card = _occupancy_op(ctx, data[0], name)
         numeric = name in ("distinctsum", "distinctavg")
 
-        def extract(outs, g, _i=i, _d=dictionary, _numeric=numeric):
-            sel = _d.values[np.nonzero(outs[_i][g])[0]]
+        def state(ids, _d=dictionary, _numeric=numeric):
+            sel = _d.values[ids]
             if _numeric:
                 return frozenset(float(v) for v in sel)
             return frozenset(sel.tolist())
 
-        return LoweredAgg(label, sem, extract)
+        def extract(outs, g, _i=i, _c=card, _state=state):
+            return _state(_occ_ids(outs, _i, g, _c))
+
+        return LoweredAgg(label, sem, extract,
+                          prepare=_occ_prepare(i, card, state))
 
     if name in _HLL_FNS and not name.endswith("mv"):
-        i, dictionary = _occupancy_op(ctx, data[0], name)
+        i, dictionary, card = _occupancy_op(ctx, data[0], name)
         log2m = int(extra[0]) if extra else 12
 
-        def extract(outs, g, _i=i, _d=dictionary, _m=log2m):
-            sel = _d.values[np.nonzero(outs[_i][g])[0]]
-            return HyperLogLog(_m).add_values(sel)
+        def state(ids, _d=dictionary, _m=log2m):
+            return HyperLogLog(_m).add_values(_d.values[ids])
 
-        return LoweredAgg(label, sem, extract)
+        def extract(outs, g, _i=i, _c=card, _state=state):
+            return _state(_occ_ids(outs, _i, g, _c))
+
+        return LoweredAgg(label, sem, extract,
+                          prepare=_occ_prepare(i, card, state))
 
     if name in _THETA_FNS:
-        i, dictionary = _occupancy_op(ctx, data[0], name)
+        i, dictionary, card = _occupancy_op(ctx, data[0], name)
 
-        def extract(outs, g, _i=i, _d=dictionary):
-            sel = _d.values[np.nonzero(outs[_i][g])[0]]
-            return ThetaSketch().add_values(sel)
+        def state(ids, _d=dictionary):
+            return ThetaSketch().add_values(_d.values[ids])
 
-        return LoweredAgg(label, sem, extract)
+        def extract(outs, g, _i=i, _c=card, _state=state):
+            return _state(_occ_ids(outs, _i, g, _c))
+
+        return LoweredAgg(label, sem, extract,
+                          prepare=_occ_prepare(i, card, state))
 
     if name in ("distinctcountsmart", "distinctcountsmarthll"):
-        i, dictionary = _occupancy_op(ctx, data[0], name)
+        i, dictionary, card = _occupancy_op(ctx, data[0], name)
 
-        def extract(outs, g, _i=i, _d=dictionary):
-            sel = _d.values[np.nonzero(outs[_i][g])[0]]
-            return SmartDistinctSet().add_values(sel)
+        def state(ids, _d=dictionary):
+            return SmartDistinctSet().add_values(_d.values[ids])
 
-        return LoweredAgg(label, sem, extract)
+        def extract(outs, g, _i=i, _c=card, _state=state):
+            return _state(_occ_ids(outs, _i, g, _c))
+
+        return LoweredAgg(label, sem, extract,
+                          prepare=_occ_prepare(i, card, state))
 
     if name in ("percentile", "mode"):
         i, dictionary = _value_hist_op(ctx, data[0], name)
@@ -537,7 +554,50 @@ def _occupancy_op(ctx: AggPlanContext, arg: ExpressionContext, name: str):
             f"{name} needs a dict-encoded SV column: {arg}")
     ids_slot, card, dictionary = info
     i = ctx.add_op(ir.AggOp("distinct_bitmap", ids_slot=ids_slot, card=card))
-    return i, dictionary
+    return i, dictionary, card
+
+
+def _occ_ids(outs, i, g, card) -> np.ndarray:
+    """Dict ids present in group g, from either occupancy form:
+    - dense: (groups, card) boolean matrix → nonzero of row g
+    - sparse: sorted unique pair keys (groupKey*card + id, sentinel-padded);
+      the group's composite key is the last kernel output (keys_out) and
+      its id range is one binary search"""
+    o = outs[i]
+    if o.ndim == 2:
+        return np.nonzero(o[g])[0]
+    valid = o[o < ir.SPARSE_KEY_SPACE]  # ascending unique pairs
+    composite = int(outs[-1][g])
+    lo = np.searchsorted(valid, composite * card)
+    hi = np.searchsorted(valid, (composite + 1) * card)
+    return (valid[lo:hi] % card).astype(np.int64)
+
+
+def _occ_prepare(i: int, card: int, state_fn):
+    """Batch extractor for occupancy aggs: one vectorized pass decodes the
+    sparse pair list into per-group dict-id slices; dense stays row-wise.
+    state_fn(ids: np.ndarray) builds the per-group state."""
+
+    def prepare(outs):
+        o = outs[i]
+        if o.ndim == 2:
+            return lambda g: state_fn(np.nonzero(o[g])[0])
+        # filter ONCE (the kernel leaves unique pairs ascending with
+        # sentinel holes); per-group lookup is two binary searches over the
+        # compacted array — cost scales with SURVIVING groups, never the
+        # pre-trim group count
+        valid = o[o < ir.SPARSE_KEY_SPACE]
+        keys_out = outs[-1]
+
+        def extract(g):
+            base = int(keys_out[g]) * card
+            lo = np.searchsorted(valid, base)
+            hi = np.searchsorted(valid, base + card)
+            return state_fn((valid[lo:hi] % card).astype(np.int64))
+
+        return extract
+
+    return prepare
 
 
 def _value_hist_op(ctx: AggPlanContext, arg: ExpressionContext, name: str):

@@ -36,6 +36,7 @@ def combine_group_arrays(
     (caller falls back to the dict merge)."""
     first = intermediates[0]
     scanned = sum(im.num_docs_scanned for im in intermediates)
+    trimmed = any(getattr(im, "groups_trimmed", False) for im in intermediates)
     if len(intermediates) == 1:
         first.num_docs_scanned = scanned
         return first
@@ -47,7 +48,8 @@ def combine_group_arrays(
         return GroupArrays([np.empty(0, object)] * ndim,
                            [tuple(np.empty(0) for _ in s)
                             for s in first.vec_specs],
-                           first.vec_specs, first.fin_tags, scanned)
+                           first.vec_specs, first.fin_tags, scanned,
+                           groups_trimmed=trimmed)
     uniqs, composite, stride = [], np.zeros(total, dtype=np.int64), 1
     for col in reversed(cat_keys):
         uniq, inv = np.unique(col, return_inverse=True)
@@ -81,7 +83,7 @@ def combine_group_arrays(
             comps.append(out)
         out_states.append(tuple(comps))
     return GroupArrays(out_keys, out_states, first.vec_specs,
-                       first.fin_tags, scanned)
+                       first.fin_tags, scanned, groups_trimmed=trimmed)
 
 
 def combine_group_by(
@@ -89,8 +91,10 @@ def combine_group_by(
 ) -> GroupByIntermediate:
     merged: dict[tuple, list] = {}
     scanned = 0
+    trimmed = False
     for im in intermediates:
         scanned += im.num_docs_scanned
+        trimmed |= getattr(im, "groups_trimmed", False)
         for key, states in im.groups.items():
             cur = merged.get(key)
             if cur is None:
@@ -98,7 +102,7 @@ def combine_group_by(
             else:
                 for i, sem in enumerate(semantics):
                     cur[i] = sem.merge(cur[i], states[i])
-    return GroupByIntermediate(merged, scanned)
+    return GroupByIntermediate(merged, scanned, groups_trimmed=trimmed)
 
 
 def combine_aggregation(
@@ -189,7 +193,8 @@ def trim_group_by(combined, query, semantics):
             [tuple(comp[sel] for comp in comps)
              for comps in combined.state_cols],
             combined.vec_specs, combined.fin_tags,
-            num_docs_scanned=combined.num_docs_scanned)
+            num_docs_scanned=combined.num_docs_scanned,
+            groups_trimmed=True)
 
     # dict-form intermediate: build sort keys from key values / finalized
     # aggregation states
@@ -220,7 +225,8 @@ def trim_group_by(combined, query, semantics):
     import heapq
 
     kept = heapq.nsmallest(trim_size, combined.groups.items(), key=rank)
-    return GroupByIntermediate(dict(kept), combined.num_docs_scanned)
+    return GroupByIntermediate(dict(kept), combined.num_docs_scanned,
+                               groups_trimmed=True)
 
 
 class _TrimKey:

@@ -91,10 +91,13 @@ class TpuSegmentExecutor:
             ids = (composite // stride) % dim.cardinality
             key_cols.append(dim.dictionary.values[ids])
         scanned = int(counts.sum())
+        trimmed = False
         if plan.program.mode == "group_by_sparse":
             # sparse trash slot = valid rows whose group was trimmed; they
             # were still scanned (reference reports all post-filter docs)
-            scanned += int(outs[0][num_groups])
+            trash = int(outs[0][num_groups])
+            scanned += trash
+            trimmed = trash > 0  # numGroupsLimitReached
         if all(la.vec is not None for la in plan.lowered_aggs):
             # columnar fast path: states stay numpy end-to-end (dict form
             # costs ~µs/group in Python — fatal at numGroupsLimit scale)
@@ -103,12 +106,19 @@ class TpuSegmentExecutor:
                 [la.vec.extract(outs, gids) for la in plan.lowered_aggs],
                 [la.vec.spec for la in plan.lowered_aggs],
                 [la.vec.fin_tag for la in plan.lowered_aggs],
-                num_docs_scanned=scanned)
+                num_docs_scanned=scanned, groups_trimmed=trimmed)
+        # per-agg batch extractors: prepare() runs once per output (e.g.
+        # decoding the sparse distinct pair list in one vectorized pass)
+        extractors = [
+            la.prepare(outs) if la.prepare is not None
+            else (lambda g, _la=la: _la.extract(outs, g))
+            for la in plan.lowered_aggs]
         groups = {}
         for row, g in enumerate(gids):
             key = tuple(_to_python(col[row]) for col in key_cols)
-            groups[key] = [la.extract(outs, g) for la in plan.lowered_aggs]
-        return GroupByIntermediate(groups, num_docs_scanned=scanned)
+            groups[key] = [ex(g) for ex in extractors]
+        return GroupByIntermediate(groups, num_docs_scanned=scanned,
+                                   groups_trimmed=trimmed)
 
     def _selection_result(self, query, segment, plan, mask) -> SelectionIntermediate:
         evaluator = None

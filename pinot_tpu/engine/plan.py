@@ -546,8 +546,7 @@ class SegmentPlanner(AggPlanContext):
             num_groups = 1
             for c in cards:
                 num_groups *= c
-            sparse = num_groups > DENSE_GROUP_LIMIT
-            if sparse and num_groups >= SPARSE_KEY_LIMIT:
+            if num_groups >= SPARSE_KEY_LIMIT:
                 raise UnsupportedQueryError(
                     f"group cardinality product {num_groups} exceeds the "
                     "int64 composite-key space")
@@ -557,22 +556,40 @@ class SegmentPlanner(AggPlanContext):
                 strides[i] = strides[i + 1] * cards[i + 1]
 
             lowered = [lower_aggregation(self, a) for a in q.aggregations]
+            # mode selection: dense when the key product AND every matrix
+            # occupancy fit the segment_sum table; otherwise the sort-based
+            # sparse path when every op supports it (scalar reductions +
+            # distinct via pair dedup); otherwise host
+            dense_ok = num_groups <= DENSE_GROUP_LIMIT
+            dense_reason = f"group cardinality product {num_groups}"
             for op in self.ops:
-                if sparse:
-                    # sort-based path carries scalar reductions only; matrix
-                    # aggs (distinct/value-hist/histogram) fall back to host
-                    if op.kind not in _SPARSE_AGG_KINDS:
-                        raise UnsupportedQueryError(
-                            f"{op.kind} unsupported in sparse (sort-based) "
-                            "group-by")
-                    continue
-                # matrix-shaped reductions materialize (num_groups, card|bins)
-                # and address it with int32 — bound the product
                 width = op.card if op.kind in ("distinct_bitmap", "value_hist") else (
                     op.bins if op.kind == "hist_fixed" else None)
                 if width is not None and num_groups * width > DENSE_GROUP_LIMIT:
-                    raise UnsupportedQueryError(
-                        f"{op.kind} occupancy {num_groups}x{width} exceeds dense limit")
+                    dense_ok = False
+                    dense_reason = f"{op.kind} occupancy {num_groups}x{width}"
+            sparse = not dense_ok
+            if sparse:
+                for op in self.ops:
+                    if op.kind == "distinct_bitmap":
+                        # pair composite must stay below the kernel sentinel
+                        if num_groups * op.card >= SPARSE_KEY_LIMIT:
+                            raise UnsupportedQueryError(
+                                f"distinct pair space {num_groups}x{op.card} "
+                                "exceeds the int64 composite-key space")
+                        continue
+                    if op.kind not in _SPARSE_AGG_KINDS:
+                        raise UnsupportedQueryError(
+                            f"{dense_reason} exceeds the dense limit and "
+                            f"{op.kind} is unsupported in sparse "
+                            "(sort-based) group-by")
+            if sparse and not group_exprs:
+                # un-grouped aggregation with an oversized occupancy matrix
+                # (e.g. DISTINCTCOUNT of a multi-million-card column): the
+                # sort kernel needs group keys; host handles this shape
+                raise UnsupportedQueryError(
+                    f"{dense_reason} exceeds the dense limit for an "
+                    "un-grouped aggregation")
             if sparse and group_exprs:
                 # output capacity = numGroupsLimit: groups beyond it are
                 # trimmed on device (reference InstancePlanMakerImplV2:245-270)

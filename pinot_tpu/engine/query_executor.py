@@ -171,6 +171,8 @@ class QueryExecutor:
             num_segments_queried=len(table.segments),
             num_segments_processed=stats["num_segments_processed"],
             num_segments_pruned=stats["num_segments_pruned"],
+            num_groups_limit_reached=getattr(combined, "groups_trimmed",
+                                             False),
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
         if trace is not None:

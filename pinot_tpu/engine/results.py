@@ -46,6 +46,9 @@ class BrokerResponse:
     trace_info: Optional[list] = None  # set when the trace option is on
     # a size guard truncated the result (reference: maxRowsInJoinReached)
     partial_result: bool = False
+    # the numGroupsLimit trim dropped groups (reference:
+    # numGroupsLimitReached) — surviving groups stay exact
+    num_groups_limit_reached: bool = False
 
     def to_json(self) -> dict:
         out = {
@@ -62,6 +65,8 @@ class BrokerResponse:
             out["traceInfo"] = self.trace_info
         if self.partial_result:
             out["partialResult"] = True
+        if self.num_groups_limit_reached:
+            out["numGroupsLimitReached"] = True
         return out
 
 
@@ -74,6 +79,9 @@ class GroupByIntermediate:
 
     groups: dict[tuple, list]
     num_docs_scanned: int = 0
+    # the numGroupsLimit trim dropped groups somewhere below (reference:
+    # numGroupsLimitReached in the broker response metadata)
+    groups_trimmed: bool = False
 
 
 class GroupArrays(GroupByIntermediate):
@@ -95,12 +103,13 @@ class GroupArrays(GroupByIntermediate):
     """
 
     def __init__(self, key_cols, state_cols, vec_specs, fin_tags,
-                 num_docs_scanned: int = 0):
+                 num_docs_scanned: int = 0, groups_trimmed: bool = False):
         self.key_cols = list(key_cols)
         self.state_cols = [tuple(c) for c in state_cols]
         self.vec_specs = [tuple(s) for s in vec_specs]
         self.fin_tags = list(fin_tags)
         self.num_docs_scanned = num_docs_scanned
+        self.groups_trimmed = groups_trimmed
         self._groups: Optional[dict] = None
 
     @property

@@ -298,12 +298,23 @@ class LogicalPlanner:
             visible = list(proj.schema)
             proj = ProjectNode([pre_proj], visible + [h for h, _ in hidden],
                                exprs=list(proj.exprs) + [e for _, e in hidden])
-            sort = SortNode([proj], proj.schema, sort_items=sort_items,
+            sort = SortNode([self._gather(proj)], proj.schema,
+                            sort_items=sort_items,
                             limit=stmt.limit, offset=stmt.offset)
             return ProjectNode([sort], visible,
                                exprs=[EC.for_identifier(c) for c in visible])
-        return SortNode([proj], proj.schema, sort_items=sort_items,
+        return SortNode([self._gather(proj)], proj.schema,
+                        sort_items=sort_items,
                         limit=stmt.limit, offset=stmt.offset)
+
+    @staticmethod
+    def _gather(node: PlanNode) -> PlanNode:
+        """Singleton exchange under a global Sort: its input may be
+        hash-partitioned (e.g. a parallel aggregate), and a per-partition
+        sort+LIMIT would emit workers×LIMIT rows in partition order
+        (reference: Calcite plans a SortExchange gathering to one worker
+        before the final Sort)."""
+        return ExchangeNode([node], list(node.schema), dist="singleton")
 
     # -- relations ---------------------------------------------------------
     def plan_relation(self, rel: Relation) -> PlanNode:

