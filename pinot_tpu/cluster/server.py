@@ -82,11 +82,22 @@ class ServerInstance:
             have = set(self.segments.get(table, {}))
             to_load = want - have
             to_drop = have - want
+            indexing = None
+            if to_load:
+                cfg_json = self.store.get(f"/CONFIGS/TABLE/{table}")
+                if cfg_json and "tableName" in cfg_json:
+                    from ..spi.table_config import TableConfig
+
+                    indexing = TableConfig.from_json(cfg_json).indexing
             for seg in to_load:
                 meta = self.store.get(f"/SEGMENTS/{table}/{seg}")
                 if meta is None:
                     continue
                 segment = load_segment(self._fetch(meta["location"]))
+                if indexing is not None:
+                    # config-requested indexes the segment was written
+                    # without get built at load (SegmentPreProcessor)
+                    segment.backfill_indexes(indexing)
                 self.segments.setdefault(table, {})[seg] = segment
             for seg in to_drop:
                 self.segments.get(table, {}).pop(seg, None)

@@ -564,13 +564,11 @@ def _occ_ids(outs, i, g, card) -> np.ndarray:
       the group's composite key is the last kernel output (keys_out) and
       its id range is one binary search"""
     o = outs[i]
-    if o.ndim == 2:
-        return np.nonzero(o[g])[0]
-    valid = o[o < ir.SPARSE_KEY_SPACE]  # ascending unique pairs
-    composite = int(outs[-1][g])
-    lo = np.searchsorted(valid, composite * card)
-    hi = np.searchsorted(valid, (composite + 1) * card)
-    return (valid[lo:hi] % card).astype(np.int64)
+    # the sparse (1-D pair list) form only flows through the batch
+    # extractors (_occ_prepare via LoweredAgg.prepare); keeping a second
+    # decode here would duplicate that logic and drift
+    assert o.ndim == 2, "sparse occupancy must decode via prepare()"
+    return np.nonzero(o[g])[0]
 
 
 def _occ_prepare(i: int, card: int, state_fn):

@@ -194,7 +194,9 @@ def trim_group_by(combined, query, semantics):
              for comps in combined.state_cols],
             combined.vec_specs, combined.fin_tags,
             num_docs_scanned=combined.num_docs_scanned,
-            groups_trimmed=True)
+            # the ordered trim is LOSSLESS for the final ORDER BY/LIMIT —
+            # it must not read as numGroupsLimitReached
+            groups_trimmed=combined.groups_trimmed)
 
     # dict-form intermediate: build sort keys from key values / finalized
     # aggregation states
@@ -226,7 +228,7 @@ def trim_group_by(combined, query, semantics):
 
     kept = heapq.nsmallest(trim_size, combined.groups.items(), key=rank)
     return GroupByIntermediate(dict(kept), combined.num_docs_scanned,
-                               groups_trimmed=True)
+                               groups_trimmed=combined.groups_trimmed)
 
 
 class _TrimKey:

@@ -298,22 +298,28 @@ class LogicalPlanner:
             visible = list(proj.schema)
             proj = ProjectNode([pre_proj], visible + [h for h, _ in hidden],
                                exprs=list(proj.exprs) + [e for _, e in hidden])
-            sort = SortNode([self._gather(proj)], proj.schema,
-                            sort_items=sort_items,
+            sort = SortNode([self._gather(proj, sort_items, stmt)],
+                            proj.schema, sort_items=sort_items,
                             limit=stmt.limit, offset=stmt.offset)
             return ProjectNode([sort], visible,
                                exprs=[EC.for_identifier(c) for c in visible])
-        return SortNode([self._gather(proj)], proj.schema,
+        return SortNode([self._gather(proj, sort_items, stmt)], proj.schema,
                         sort_items=sort_items,
                         limit=stmt.limit, offset=stmt.offset)
 
     @staticmethod
-    def _gather(node: PlanNode) -> PlanNode:
+    def _gather(node: PlanNode, sort_items: list, stmt) -> PlanNode:
         """Singleton exchange under a global Sort: its input may be
         hash-partitioned (e.g. a parallel aggregate), and a per-partition
         sort+LIMIT would emit workers×LIMIT rows in partition order
         (reference: Calcite plans a SortExchange gathering to one worker
-        before the final Sort)."""
+        before the final Sort). With a LIMIT, each partition pre-sorts and
+        keeps only its top offset+limit rows first, bounding the shuffle to
+        workers×(offset+limit) instead of the full result."""
+        if stmt.limit is not None and sort_items:
+            node = SortNode([node], list(node.schema),
+                            sort_items=list(sort_items),
+                            limit=stmt.limit + (stmt.offset or 0), offset=0)
         return ExchangeNode([node], list(node.schema), dist="singleton")
 
     # -- relations ---------------------------------------------------------

@@ -70,6 +70,74 @@ class ImmutableSegment:
             self._dict_ids[name] = np.zeros(n, dtype=np.int32)
             self._nulls[name] = None
 
+    def backfill_indexes(self, indexing) -> list[str]:
+        """Build indexes the table config requests but this segment was
+        written without (reference: SegmentPreProcessor's index backfill on
+        load, ImmutableSegmentLoader.java:67-101 — adding an index to the
+        config takes effect on old segments without a rewrite). Built
+        in-memory and cached; returns the list of indexes created."""
+        from . import indexes as ix
+
+        built = []
+
+        def have(key):
+            return self._indexes.get(key) is not None
+
+        for col in indexing.inverted_index_columns:
+            if not self.has_column(col) or have(("inv", col)):
+                continue
+            if self.get_inverted_index(col) is None:
+                m = self.column_metadata(col)
+                if m.encoding == "DICT" and m.single_value:
+                    self._indexes[("inv", col)] = ix.InvertedIndex.build(
+                        self.get_dict_ids(col), m.cardinality)
+                    built.append(f"inverted:{col}")
+        for col in indexing.range_index_columns:
+            if not self.has_column(col):
+                continue
+            m = self.column_metadata(col)
+            if m.encoding == "DICT":
+                # dict range queries ride the CSR inverted index (same
+                # choice the builder makes for rangeIndexColumns)
+                if (m.single_value and not have(("inv", col))
+                        and self.get_inverted_index(col) is None):
+                    self._indexes[("inv", col)] = ix.InvertedIndex.build(
+                        self.get_dict_ids(col), m.cardinality)
+                    built.append(f"range(inv):{col}")
+            elif not have(("rng", col)) and self.get_range_index(col) is None:
+                if m.encoding == "RAW" and DataType(m.data_type).is_fixed_width:
+                    self._indexes[("rng", col)] = ix.RawRangeIndex.build(
+                        self.get_raw(col))
+                    built.append(f"range:{col}")
+        for col in indexing.bloom_filter_columns:
+            if not self.has_column(col) or have(("bloom", col)):
+                continue
+            if self.get_bloom_filter(col) is None:
+                m = self.column_metadata(col)
+                values = (self.get_dictionary(col).values
+                          if m.encoding == "DICT" else self.get_raw(col))
+                self._indexes[("bloom", col)] = ix.BloomFilter.build(values)
+                built.append(f"bloom:{col}")
+        for col in indexing.json_index_columns:
+            if self.has_column(col) and self.get_json_index(col) is None:
+                self.get_json_index(col, or_build=True)
+                built.append(f"json:{col}")
+        for col in indexing.text_index_columns:
+            if self.has_column(col) and self.get_text_index(col) is None:
+                self.get_text_index(col, or_build=True)
+                built.append(f"text:{col}")
+        for col in indexing.vector_index_columns:
+            if self.has_column(col) and self.get_vector_index(col) is None:
+                self.get_vector_index(col, or_build=True)
+                built.append(f"vector:{col}")
+        for cfg in indexing.geo_index_configs:
+            lat, lng = cfg.get("latColumn"), cfg.get("lngColumn")
+            if lat and lng and self.has_column(lat) and self.has_column(lng) \
+                    and self.get_geo_index(lat, lng) is None:
+                self.get_geo_index(lat, lng, or_build=True)
+                built.append(f"geo:{lat},{lng}")
+        return built
+
     # -- identity ----------------------------------------------------------
     @property
     def name(self) -> str:

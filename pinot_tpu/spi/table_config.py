@@ -119,6 +119,10 @@ class TableConfig:
                 "sortedColumn": self.indexing.sorted_column,
                 "starTreeIndexConfigs": self.indexing.star_tree_index_configs,
                 "compressionConfigs": self.indexing.compression_configs,
+                "jsonIndexColumns": self.indexing.json_index_columns,
+                "textIndexColumns": self.indexing.text_index_columns,
+                "vectorIndexColumns": self.indexing.vector_index_columns,
+                "geoIndexConfigs": self.indexing.geo_index_configs,
             },
             "segmentsConfig": {
                 "timeColumnName": self.validation.time_column_name,
@@ -157,6 +161,10 @@ class TableConfig:
                 sorted_column=idx.get("sortedColumn"),
                 star_tree_index_configs=idx.get("starTreeIndexConfigs") or [],
                 compression_configs=idx.get("compressionConfigs") or {},
+                json_index_columns=idx.get("jsonIndexColumns") or [],
+                text_index_columns=idx.get("textIndexColumns") or [],
+                vector_index_columns=idx.get("vectorIndexColumns") or [],
+                geo_index_configs=idx.get("geoIndexConfigs") or [],
             ),
             validation=SegmentsValidationConfig(
                 time_column_name=seg.get("timeColumnName"),

@@ -130,3 +130,32 @@ def test_time_column_range(tmp_path, schema, rng):
     ts = [r["ts"] for r in rows]
     assert seg.metadata.start_time == min(ts)
     assert seg.metadata.end_time == max(ts)
+
+
+def test_backfill_indexes_on_load(tmp_path, schema, rng):
+    """Indexes added to the table config AFTER a segment was built are
+    backfilled at load (reference: SegmentPreProcessor on-load backfill)."""
+    rows = make_rows(300, rng)
+    SegmentBuilder(schema, segment_name="bf").build_from_rows(rows, tmp_path / "bf")
+    seg = load_segment(tmp_path / "bf")
+    assert seg.get_inverted_index("teamID") is None
+    assert seg.get_bloom_filter("league") is None
+
+    cfg = IndexingConfig(inverted_index_columns=["teamID"],
+                         bloom_filter_columns=["league"])
+    built = seg.backfill_indexes(cfg)
+    assert set(built) == {"inverted:teamID", "bloom:league"}
+    inv = seg.get_inverted_index("teamID")
+    assert inv is not None
+    # the backfilled inverted index agrees with the forward index
+    ids = seg.get_dict_ids("teamID")
+    import numpy as np
+
+    for dict_id in range(seg.column_metadata("teamID").cardinality):
+        np.testing.assert_array_equal(
+            inv.postings(dict_id), np.nonzero(ids == dict_id)[0])
+    bloom = seg.get_bloom_filter("league")
+    assert bloom is not None
+    assert bloom.might_contain("AL") or bloom.might_contain("NL")
+    # idempotent: a second call builds nothing
+    assert seg.backfill_indexes(cfg) == []
