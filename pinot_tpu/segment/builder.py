@@ -265,6 +265,12 @@ class SegmentBuilder:
         values, nulls = self._replace_nulls(values, spec)
         dt = spec.data_type
         codec = self.table_config.indexing.compression_configs.get(name)
+        if codec == "CLP" and not (raw and dt.value == "STRING"):
+            # validate at the misconfiguration, not as a KeyError deep in
+            # the chunk-codec table at write time
+            raise ValueError(
+                f"column {name!r}: CLP encoding requires a STRING column "
+                "listed in noDictionaryColumns")
         if raw and dt.is_fixed_width:
             arr = np.ascontiguousarray(values, dtype=dt.numpy_dtype)
             writer.add_buffer(f"{name}.fwd", arr, codec=codec)
@@ -276,6 +282,18 @@ class SegmentBuilder:
                 is_sorted=bool(num_docs == 0 or np.all(np.diff(arr) >= 0)),
                 total_number_of_entries=num_docs,
             )
+        elif raw and codec == "CLP" and dt.value == "STRING":
+            # log-structured encoding: template dictionary + variable
+            # streams (reference CLPForwardIndexCreatorV1)
+            from .clp import encode_column, serialize_clp
+
+            col = encode_column(values)
+            writer.add_buffer(f"{name}.fwd", serialize_clp(col))
+            meta = ColumnMetadata(
+                name=name, data_type=dt.value, field_type=spec.field_type.value,
+                encoding="CLP", cardinality=0, bits_per_value=0,
+                min_value=None, max_value=None, is_sorted=False,
+                total_number_of_entries=num_docs)
         elif raw:
             # var-byte raw (STRING/BYTES/JSON): utf-8 stream + u64 offsets,
             # no dictionary required for selection (reference

@@ -1,0 +1,210 @@
+"""CLP-style log-message encoding.
+
+Reference: the CLP forward index (pinot-segment-local/.../creator/impl/fwd/
+CLPForwardIndexCreatorV1.java, built on the CLP paper's insight): machine
+logs are a small set of TEMPLATES with variable tokens spliced in. A message
+splits into
+
+    logtype   — the template with placeholders (\\x11 dict var, \\x12 int,
+                \\x13 float); template cardinality is tiny → dictionary id
+    dictVars  — variable tokens containing letters (task_12, /api/v2/users)
+    encVars   — pure numeric tokens, stored as their binary value
+
+so "Task task_12 failed after 3.50s" becomes
+logtype "Task \\x11 failed after \\x13s", dictVars [task_12], encVars [3.50].
+Selected with ``compressionConfigs: {col: "CLP"}`` on a no-dictionary
+STRING column; decoding reconstructs the exact original strings.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+
+import numpy as np
+
+ESC = "\x10"
+DICT_VAR = "\x11"
+INT_VAR = "\x12"
+FLOAT_VAR = "\x13"
+_SPECIALS = (ESC, DICT_VAR, INT_VAR, FLOAT_VAR)
+
+
+def _esc(text: str) -> str:
+    """Escape placeholder bytes occurring LITERALLY in log text (real CLP
+    escapes them too) so decode can't mistake them for variable slots."""
+    if not any(ch in text for ch in _SPECIALS):
+        return text
+    return "".join(ESC + ch if ch in _SPECIALS else ch for ch in text)
+
+# a variable token: contains at least one digit; split on whitespace-ish
+# boundaries the same way CLP's tokenizer does
+_TOKEN_RE = re.compile(r"[^\s=:,;()\[\]{}\"']+")
+_INT_RE = re.compile(r"[-+]?\d+\Z")
+_FLOAT_RE = re.compile(r"[-+]?\d*\.\d+\Z")
+_HAS_DIGIT_RE = re.compile(r"\d")
+
+
+def encode_message(msg: str) -> tuple[str, list[str], list[tuple[str, str]]]:
+    """→ (logtype, dict_vars, enc_vars as (kind, literal))."""
+    out = []
+    dict_vars: list[str] = []
+    enc_vars: list[tuple[str, str]] = []
+    pos = 0
+    for m in _TOKEN_RE.finditer(msg):
+        tok = m.group(0)
+        if not _HAS_DIGIT_RE.search(tok):
+            continue
+        if _INT_RE.match(tok):
+            kind, ph = "i", INT_VAR
+        elif _FLOAT_RE.match(tok):
+            kind, ph = "f", FLOAT_VAR
+        else:
+            kind, ph = None, DICT_VAR
+        out.append(_esc(msg[pos:m.start()]))
+        out.append(ph)
+        pos = m.end()
+        if kind is None:
+            dict_vars.append(tok)
+        else:
+            enc_vars.append((kind, tok))
+    out.append(_esc(msg[pos:]))
+    return "".join(out), dict_vars, enc_vars
+
+
+def decode_message(logtype: str, dict_vars: list[str],
+                   enc_vars: list[tuple[str, str]]) -> str:
+    out = []
+    di = ei = 0
+    i, n = 0, len(logtype)
+    while i < n:
+        ch = logtype[i]
+        if ch == ESC and i + 1 < n:
+            out.append(logtype[i + 1])  # escaped literal placeholder byte
+            i += 2
+            continue
+        if ch == DICT_VAR:
+            out.append(dict_vars[di])
+            di += 1
+        elif ch in (INT_VAR, FLOAT_VAR):
+            out.append(enc_vars[ei][1])
+            ei += 1
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+class ClpColumn:
+    """Encoded form of one string column."""
+
+    def __init__(self, logtypes, type_ids, var_dict, var_ids, var_offsets,
+                 enc_blob, enc_offsets):
+        self.logtypes = logtypes        # list[str] templates (sorted unique)
+        self.type_ids = type_ids        # (n,) int32 template id per doc
+        self.var_dict = var_dict        # list[str] unique dict vars
+        self.var_ids = var_ids          # flat int32 dict-var ids
+        self.var_offsets = var_offsets  # (n+1,) int64 into var_ids
+        self.enc_blob = enc_blob        # utf-8 literal stream of numeric vars
+        self.enc_offsets = enc_offsets  # flat byte offsets, one list per doc
+        # enc_offsets layout: (n+1,) int64 into a per-doc count prefix over
+        # the token table below
+        self.num_docs = len(type_ids)
+
+    def decode_all(self) -> np.ndarray:
+        out = np.empty(self.num_docs, dtype=object)
+        tokens = self.enc_blob.split("\x00") if self.enc_blob else []
+        for d in range(self.num_docs):
+            lt = self.logtypes[self.type_ids[d]]
+            dvars = [self.var_dict[self.var_ids[j]]
+                     for j in range(self.var_offsets[d], self.var_offsets[d + 1])]
+            evars = [("x", tokens[j])
+                     for j in range(self.enc_offsets[d], self.enc_offsets[d + 1])]
+            out[d] = decode_message(lt, dvars, evars)
+        return out
+
+
+def encode_column(values) -> ClpColumn:
+    lt_index: dict[str, int] = {}
+    vd_index: dict[str, int] = {}
+    type_ids = np.empty(len(values), dtype=np.int32)
+    var_ids: list[int] = []
+    var_offsets = np.zeros(len(values) + 1, dtype=np.int64)
+    enc_tokens: list[str] = []
+    enc_offsets = np.zeros(len(values) + 1, dtype=np.int64)
+    for d, v in enumerate(values):
+        lt, dvars, evars = encode_message("" if v is None else str(v))
+        tid = lt_index.setdefault(lt, len(lt_index))
+        type_ids[d] = tid
+        for t in dvars:
+            var_ids.append(vd_index.setdefault(t, len(vd_index)))
+        var_offsets[d + 1] = len(var_ids)
+        for _kind, literal in evars:
+            enc_tokens.append(literal)
+        enc_offsets[d + 1] = len(enc_tokens)
+    return ClpColumn(
+        list(lt_index), type_ids, list(vd_index),
+        np.asarray(var_ids, dtype=np.int32), var_offsets,
+        "\x00".join(enc_tokens), enc_offsets)
+
+
+# -- buffer (de)serialization -------------------------------------------------
+
+
+def _pack_strs(strs: list[str]) -> bytes:
+    """Length-prefixed strings — tokens may contain ANY byte (including
+    NUL), so a delimiter-based join would corrupt them."""
+    out = bytearray(struct.pack("<I", len(strs)))
+    for s in strs:
+        b = s.encode("utf-8")
+        out += struct.pack("<I", len(b)) + b
+    return bytes(out)
+
+
+class _Rd:
+    def __init__(self, b):
+        self.b = b
+        self.p = 0
+
+    def take(self, n):
+        out = self.b[self.p:self.p + n]
+        self.p += n
+        return out
+
+
+def _unpack_strs(r: _Rd) -> list[str]:
+    (count,) = struct.unpack("<I", r.take(4))
+    out = []
+    for _ in range(count):
+        (n,) = struct.unpack("<I", r.take(4))
+        out.append(bytes(r.take(n)).decode("utf-8"))
+    return out
+
+
+def serialize_clp(col: ClpColumn) -> bytes:
+    out = bytearray()
+    out += _pack_strs(col.logtypes)
+    out += _pack_strs(col.var_dict)
+    enc = col.enc_blob.encode("utf-8")
+    out += struct.pack("<Q", len(enc)) + enc
+    for arr, dtype in ((col.type_ids, np.int32), (col.var_ids, np.int32),
+                       (col.var_offsets, np.int64), (col.enc_offsets, np.int64)):
+        a = np.ascontiguousarray(arr, dtype=dtype)
+        out += struct.pack("<Q", a.size) + a.tobytes()
+    return bytes(out)
+
+
+def deserialize_clp(blob: bytes) -> ClpColumn:
+    r = _Rd(memoryview(blob))
+    logtypes = _unpack_strs(r)
+    var_dict = _unpack_strs(r)
+    (elen,) = struct.unpack("<Q", r.take(8))
+    enc_blob = bytes(r.take(elen)).decode("utf-8")
+    arrays = []
+    for dtype in (np.int32, np.int32, np.int64, np.int64):
+        (n,) = struct.unpack("<Q", r.take(8))
+        arrays.append(np.frombuffer(r.take(n * np.dtype(dtype).itemsize),
+                                    dtype=dtype))
+    type_ids, var_ids, var_offsets, enc_offsets = arrays
+    return ClpColumn(logtypes, type_ids, var_dict, var_ids, var_offsets,
+                     enc_blob, enc_offsets)

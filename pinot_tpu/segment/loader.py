@@ -216,6 +216,15 @@ class ImmutableSegment:
     def get_raw(self, column: str) -> np.ndarray:
         if column not in self._raw:
             m = self.column_metadata(column)
+            if m.encoding == "CLP":
+                # log-structured column: decode templates + variables back
+                # to the exact original strings (reference CLP forward
+                # index reader), cached like any other raw plane
+                from .clp import deserialize_clp
+
+                col = deserialize_clp(bytes(self._buffer(f"{column}.fwd")))
+                self._raw[column] = col.decode_all()
+                return self._raw[column]
             assert m.encoding == "RAW"
             dtype = DataType(m.data_type)
             if not dtype.is_fixed_width:
@@ -415,7 +424,7 @@ class ImmutableSegment:
     def get_values(self, column: str) -> np.ndarray:
         """Fully materialized value array (SV) — used by the CPU oracle path."""
         m = self.column_metadata(column)
-        if m.encoding == "RAW":
+        if m.encoding in ("RAW", "CLP"):
             return self.get_raw(column)
         if not m.single_value:
             raise ValueError(f"{column} is MV; use get_mv_values")
@@ -432,7 +441,7 @@ class ImmutableSegment:
         version at ingestion rate; decoded id planes are cached, so this is
         O(1) after the first read of a column)."""
         m = self.column_metadata(column)
-        if m.encoding == "RAW":
+        if m.encoding in ("RAW", "CLP"):
             v = self.get_raw(column)[doc_id]
             return v.item() if isinstance(v, np.generic) else v
         d = self.get_dictionary(column)
