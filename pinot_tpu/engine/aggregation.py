@@ -369,7 +369,8 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
             vec=VecAgg(spec, lambda outs, gids: (outs[0][gids],), tag))
 
     if name in ("sum", "min", "max"):
-        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.value_expr(data[0])))
+        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.value_expr(data[0]),
+                                **_int_bounds(ctx, data[0])))
         spec, tag = VEC_RECIPES[name]
         return LoweredAgg(
             label, sem, lambda outs, g: float(outs[i][g]),
@@ -390,7 +391,8 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
                        tag))
 
     if name == "avg":
-        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(data[0])))
+        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(data[0]),
+                                **_int_bounds(ctx, data[0])))
         spec, tag = VEC_RECIPES["avg"]
         return LoweredAgg(
             label, sem,
@@ -545,6 +547,24 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
         return LoweredAgg(label, sem, lambda outs, g: bool(outs[i][g] > 0.5))
 
     raise UnsupportedQueryError(f"aggregation {name} not yet lowered to device")
+
+
+def _int_bounds(ctx, arg) -> dict:
+    """Static integer bounds for the 32-bit kernel fast paths (see
+    kernels._fits_i32/_segment_sum_exact_i64); {} when unknown or
+    non-integer. QUANTIZED to power-of-two envelopes — the bounds are static
+    jit args, and per-segment exact min/max would compile a fresh kernel
+    per segment."""
+    mm = ctx.col_minmax(arg)
+    if mm is None:
+        return {}
+    lo, hi = mm
+    if isinstance(lo, (int, np.integer)) and isinstance(hi, (int, np.integer)):
+        lo, hi = int(lo), int(hi)
+        qhi = (1 << max(hi, 1).bit_length()) - 1 if hi >= 0 else 0
+        qlo = 0 if lo >= 0 else -(1 << max(-lo, 1).bit_length())
+        return {"vmin": qlo, "vmax": qhi}
+    return {}
 
 
 def _occupancy_op(ctx: AggPlanContext, arg: ExpressionContext, name: str):

@@ -206,6 +206,11 @@ class AggOp:
     bins: Optional[int] = None
     lo_param: Optional[int] = None
     hi_param: Optional[int] = None
+    # static integer value bounds when the planner knows them (column
+    # metadata / dictionary min-max) — lets integer sums skip limbs and the
+    # negative-count pass in the exact i32-scatter decomposition
+    vmin: Optional[int] = None
+    vmax: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -235,3 +240,7 @@ class Program:
     # (ids remapped through a host-computed LUT — ParamGather). When set,
     # group_slots is empty.
     group_vexprs: tuple[ValueExpr, ...] = ()
+    # sparse mode: the FULL composite key space (cardinality product before
+    # the numGroupsLimit cap). Static, so the kernel can sort 32-bit keys
+    # when they fit — 64-bit sorts and scatters are emulated on TPU
+    key_space: int = 0

@@ -614,6 +614,7 @@ class SegmentPlanner(AggPlanContext):
                 group_strides=tuple(strides),
                 num_groups=out_groups,
                 group_vexprs=tuple(group_vexprs) if any_derived else (),
+                key_space=num_groups if mode == "group_by_sparse" else 0,
             )
             return SegmentPlan(program, self._slots, self._params, lowered, group_dims)
 

@@ -208,14 +208,60 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
             for slot, stride in zip(program.group_slots, program.group_strides):
                 gid = gid + arrays[slot].astype(jnp.int32) * jnp.int32(stride)
     else:
-        gid = jnp.zeros((n,), dtype=jnp.int32)
+        # un-grouped aggregation: NO scatter at all — plain masked
+        # reductions shaped (value, trash) to keep the output contract.
+        # Scatters to a 2-slot table were pure overhead (and 64-bit
+        # scatters are emulated on TPU)
+        return _run_ungrouped(program, arrays, params, mask, n)
     trash = jnp.int32(num_groups)
     gid = jnp.where(mask, gid, trash)
     num_segments = num_groups + 1
 
-    outputs = [jax.ops.segment_sum(jnp.ones((n,), dtype=jnp.int64), gid, num_segments=num_segments)]
+    # counts scatter at 32 bits (rows < 2^31 per segment) and widen after
+    counts = jax.ops.segment_sum(
+        mask.astype(jnp.int32), gid,
+        num_segments=num_segments).astype(jnp.int64)
+    outputs = [counts]
     for agg in program.aggs:
-        outputs.append(_run_agg(agg, arrays, params, mask, gid, num_segments, n))
+        outputs.append(_run_agg(agg, arrays, params, mask, gid,
+                                num_segments, n, counts=counts))
+    return tuple(outputs)
+
+
+def _run_ungrouped(program: ir.Program, arrays, params, mask, n):
+    count = mask.astype(jnp.int32).sum().astype(jnp.int64)
+    zero_i = jnp.int64(0)
+    outputs = [jnp.stack([count, zero_i])]
+    for agg in program.aggs:
+        if agg.kind == "count":
+            outputs.append(jnp.stack([count, zero_i]))
+            continue
+        if agg.kind in ("distinct_bitmap", "value_hist", "hist_fixed"):
+            # matrix shapes keep the (1 group + trash) scatter layout
+            outputs.append(_run_agg(agg, arrays, params, mask,
+                                    jnp.where(mask, 0, 1).astype(jnp.int32),
+                                    2, n, counts=None))
+            continue
+        v = _eval_value(agg.vexpr, arrays, params)
+        is_int = jnp.issubdtype(v.dtype, jnp.integer)
+        if agg.kind == "sum":
+            if is_int:
+                s = jnp.where(mask, v, 0).astype(jnp.int64).sum() \
+                    .astype(jnp.float64)
+            else:
+                s = jnp.where(mask, v, 0).astype(jnp.float64).sum()
+            outputs.append(jnp.stack([s, jnp.float64(0)]))
+        elif agg.kind == "sumsq":
+            vf = jnp.where(mask, v, 0).astype(jnp.float64)
+            outputs.append(jnp.stack([(vf * vf).sum(), jnp.float64(0)]))
+        elif agg.kind == "min":
+            vf = jnp.where(mask, v, jnp.inf).astype(jnp.float64)
+            outputs.append(jnp.stack([vf.min(), jnp.float64(jnp.inf)]))
+        elif agg.kind == "max":
+            vf = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
+            outputs.append(jnp.stack([vf.max(), jnp.float64(-jnp.inf)]))
+        else:
+            raise ValueError(f"unknown agg kind {agg.kind}")
     return tuple(outputs)
 
 
@@ -239,14 +285,19 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     composite keys of the surviving groups are emitted as the LAST output so
     the host can decode per-dim dict ids with the usual stride arithmetic.
     """
-    key = jnp.zeros((n,), dtype=jnp.int64)
+    # 64-bit sorts/scatters are emulated on TPU: sort 32-bit keys whenever
+    # the composite key space fits (key_space is static on the Program)
+    key32 = 0 < program.key_space < (1 << 31) - 1
+    kdtype = jnp.int32 if key32 else jnp.int64
+    key = jnp.zeros((n,), dtype=kdtype)
     if program.group_vexprs:
         for vexpr, stride in zip(program.group_vexprs, program.group_strides):
-            key = key + _eval_value(vexpr, arrays, params).astype(jnp.int64) * stride
+            key = key + _eval_value(vexpr, arrays, params).astype(kdtype) * stride
     else:
         for slot, stride in zip(program.group_slots, program.group_strides):
-            key = key + arrays[slot].astype(jnp.int64) * stride
-    sentinel = jnp.int64(ir.SPARSE_KEY_SPACE)
+            key = key + arrays[slot].astype(kdtype) * stride
+    sentinel = (jnp.int32((1 << 31) - 1) if key32
+                else jnp.int64(ir.SPARSE_KEY_SPACE))
     key = jnp.where(mask, key, sentinel)
 
     # agg inputs with mask-neutral elements, computed BEFORE the sort so one
@@ -265,29 +316,50 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
             # (groups × card) occupancy matrix this replaces is the HBM
             # blowup VERDICT weak #5 called out. Decoded on host by
             # binary-searching each surviving group's pair range.
-            ids = arrays[agg.ids_slot].astype(jnp.int64)
-            pair = jnp.where(mask, key * jnp.int64(agg.card) + ids, sentinel)
+            pair32 = 0 < program.key_space * agg.card < (1 << 31) - 1
+            pdtype = jnp.int32 if pair32 else jnp.int64
+            psent = (jnp.int32((1 << 31) - 1) if pair32
+                     else jnp.int64(ir.SPARSE_KEY_SPACE))
+            ids = arrays[agg.ids_slot].astype(pdtype)
+            pair = jnp.where(mask,
+                             key.astype(pdtype) * pdtype(agg.card) + ids,
+                             psent)
             sp = jax.lax.sort(pair)
             uniq = jnp.concatenate(
                 [jnp.ones((1,), dtype=bool), sp[1:] != sp[:-1]]) \
-                & (sp < sentinel)
+                & (sp < psent)
             # duplicates masked to the sentinel; the SURVIVING values keep
             # ascending order, so the host filters + binary-searches without
             # a second device sort
-            specs.append(("distinct", jnp.where(uniq, sp, sentinel)))
+            specs.append(("distinct", jnp.where(uniq, sp, psent)))
             continue
         v = _eval_value(agg.vexpr, arrays, params)
+        fast32 = jnp.issubdtype(v.dtype, jnp.integer) and _fits_i32(v, agg)
         if agg.kind in ("sum", "sumsq"):
-            v = jnp.where(mask, v, 0).astype(jnp.float64)
             if agg.kind == "sumsq":
+                v = jnp.where(mask, v, 0).astype(jnp.float64)
                 v = v * v
-            specs.append(("sum", len(operands)))
+                specs.append(("sum_f", len(operands), agg))
+            elif fast32:
+                v = jnp.where(mask, v, 0).astype(jnp.int32)
+                specs.append(("sum_i", len(operands), agg))
+            else:
+                v = jnp.where(mask, v, 0).astype(jnp.float64)
+                specs.append(("sum_f", len(operands), agg))
         elif agg.kind == "min":
-            v = jnp.where(mask, v, jnp.inf).astype(jnp.float64)
-            specs.append(("min", len(operands)))
+            if fast32:
+                v = jnp.where(mask, v.astype(jnp.int32), _I32_MAX)
+                specs.append(("min_i", len(operands), agg))
+            else:
+                v = jnp.where(mask, v, jnp.inf).astype(jnp.float64)
+                specs.append(("min_f", len(operands), agg))
         elif agg.kind == "max":
-            v = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
-            specs.append(("max", len(operands)))
+            if fast32:
+                v = jnp.where(mask, v.astype(jnp.int32), _I32_MIN)
+                specs.append(("max_i", len(operands), agg))
+            else:
+                v = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
+                specs.append(("max_f", len(operands), agg))
         else:  # matrix-shaped aggs are planner-rejected in sparse mode
             raise ValueError(f"agg kind {agg.kind} unsupported in sparse group-by")
         operands.append(v)
@@ -306,29 +378,106 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     # so the host can report every post-filter doc as scanned even when the
     # numGroupsLimit trim drops groups
     counts = jax.ops.segment_sum(
-        valid.astype(jnp.int64), gid, num_segments=k + 1)
+        valid.astype(jnp.int32), gid, num_segments=k + 1,
+        indices_are_sorted=True).astype(jnp.int64)
     outputs = [counts]
-    for kind, oi in specs:
+    for spec in specs:
+        kind, oi = spec[0], spec[1]
+        agg = spec[2] if len(spec) > 2 else None
         if kind == "count":
             outputs.append(counts)
         elif kind == "distinct":
             outputs.append(oi)  # sorted unique pair keys, sentinel-padded
-        elif kind == "sum":
+        elif kind == "sum_i":
+            outputs.append(_segment_sum_exact_i64(
+                sorted_ops[oi], gid, k + 1, n, agg.vmin, agg.vmax,
+                indices_are_sorted=True).astype(jnp.float64))
+        elif kind == "sum_f":
             outputs.append(jax.ops.segment_sum(
-                sorted_ops[oi], gid, num_segments=k + 1))
-        elif kind == "min":
+                sorted_ops[oi], gid, num_segments=k + 1,
+                indices_are_sorted=True))
+        elif kind == "min_i":
+            out = jax.ops.segment_min(sorted_ops[oi], gid,
+                                      num_segments=k + 1,
+                                      indices_are_sorted=True)
+            outputs.append(jnp.where(counts == 0, jnp.inf,
+                                     out.astype(jnp.float64)))
+        elif kind == "min_f":
             outputs.append(jax.ops.segment_min(
-                sorted_ops[oi], gid, num_segments=k + 1))
-        else:
+                sorted_ops[oi], gid, num_segments=k + 1,
+                indices_are_sorted=True))
+        elif kind == "max_i":
+            out = jax.ops.segment_max(sorted_ops[oi], gid,
+                                      num_segments=k + 1,
+                                      indices_are_sorted=True)
+            outputs.append(jnp.where(counts == 0, -jnp.inf,
+                                     out.astype(jnp.float64)))
+        else:  # max_f
             outputs.append(jax.ops.segment_max(
-                sorted_ops[oi], gid, num_segments=k + 1))
-    keys_out = jax.ops.segment_max(
-        jnp.where(inlimit, skey, jnp.int64(-1)), gid, num_segments=k + 1)[:k]
+                sorted_ops[oi], gid, num_segments=k + 1,
+                indices_are_sorted=True))
+    # surviving composite key per slot via FIRST-OCCURRENCE index (an i32
+    # scatter-min + gather — never a 64-bit scatter)
+    idx = jnp.where(first & inlimit, jnp.arange(n, dtype=jnp.int32),
+                    jnp.int32(n))
+    fi = jax.ops.segment_min(idx, gid, num_segments=k + 1,
+                             indices_are_sorted=True)[:k]
+    keys_out = jnp.where(fi < n,
+                         skey[jnp.clip(fi, 0, n - 1)].astype(jnp.int64),
+                         jnp.int64(-1))
     outputs.append(keys_out)
     return tuple(outputs)
 
 
-def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n):
+def _segment_sum_exact_i64(v, gid, num_segments, n, vmin=None, vmax=None,
+                           indices_are_sorted=False):
+    """Exact int64 per-segment sums built from int32 scatters.
+
+    64-bit scatters are SOFTWARE-EMULATED on TPU (measured ~10x slower than
+    the same scatter at 32 bits — the difference between 1.9s and 0.18s for
+    16M rows), so the sum decomposes into b-bit limbs with b chosen so a
+    per-group limb sum cannot overflow int32: rows * (2^b - 1) < 2^31.
+    Negative values ride two's complement: sum(v) = sum(uint32(v)) - 2^32 *
+    count(v < 0); the planner's static value bounds skip unreachable limbs
+    and the negative-count pass entirely for non-negative columns."""
+    v = v.astype(jnp.int32)
+    u = v.astype(jnp.uint32)  # two's-complement reinterpretation
+    b = max(1, min(16, 31 - max(1, n - 1).bit_length()))
+    nonneg = vmin is not None and vmin >= 0
+    nbits = 32
+    if nonneg and vmax is not None:
+        nbits = max(1, int(vmax).bit_length())
+    total = jnp.zeros(num_segments, dtype=jnp.int64)
+    for shift in range(0, nbits, b):
+        limb = ((u >> shift) & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
+        s = jax.ops.segment_sum(limb, gid, num_segments=num_segments,
+                                indices_are_sorted=indices_are_sorted)
+        total = total + (s.astype(jnp.int64) << shift)
+    if not nonneg:
+        negs = jax.ops.segment_sum((v < 0).astype(jnp.int32), gid,
+                                   num_segments=num_segments,
+                                   indices_are_sorted=indices_are_sorted)
+        total = total - (negs.astype(jnp.int64) << 32)
+    return total
+
+
+_I32_MAX = (1 << 31) - 1
+_I32_MIN = -(1 << 31)
+
+
+def _fits_i32(v, agg: ir.AggOp) -> bool:
+    """The 32-bit fast paths are only sound when every value fits int32:
+    either the plane is int32 already, or the planner proved bounds.
+    LONG/TIMESTAMP columns are int64 planes — without bounds they take the
+    float64 path (exact to 2^53, the pre-optimization behavior)."""
+    if v.dtype == jnp.int32:
+        return True
+    return (agg.vmin is not None and agg.vmax is not None
+            and agg.vmin >= _I32_MIN and agg.vmax <= _I32_MAX)
+
+
+def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n,
+             counts=None):
     if agg.kind == "count":
         return jax.ops.segment_sum(mask.astype(jnp.int64), gid, num_segments=num_segments)
     if agg.kind in ("distinct_bitmap", "value_hist"):
@@ -340,12 +489,14 @@ def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n):
         ids = arrays[agg.ids_slot].astype(jnp.int32)
         sid = gid * jnp.int32(card) + ids
         sid = jnp.where(mask, sid, jnp.int32(num_groups * card))
-        dtype = jnp.int32 if agg.kind == "distinct_bitmap" else jnp.int64
         occ = jax.ops.segment_sum(
-            mask.astype(dtype), sid, num_segments=num_groups * card + 1
+            mask.astype(jnp.int32), sid, num_segments=num_groups * card + 1
         )
         occ = occ[: num_groups * card].reshape(num_groups, card)
-        return occ > 0 if agg.kind == "distinct_bitmap" else occ
+        # counts stay < 2^31 (rows per segment): scatter at 32 bits, widen
+        # after — 64-bit scatters are emulated on TPU
+        return occ > 0 if agg.kind == "distinct_bitmap" else \
+            occ.astype(jnp.int64)
     if agg.kind == "hist_fixed":
         # equal-width bins over [lo, hi]; out-of-range rows are dropped
         # (reference HistogramAggregationFunction semantics)
@@ -360,20 +511,45 @@ def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n):
         sid = gid * jnp.int32(bins) + b
         sid = jnp.where(inside, sid, jnp.int32(num_groups * bins))
         counts = jax.ops.segment_sum(
-            inside.astype(jnp.int64), sid, num_segments=num_groups * bins + 1
-        )
+            inside.astype(jnp.int32), sid, num_segments=num_groups * bins + 1
+        ).astype(jnp.int64)
         return counts[: num_groups * bins].reshape(num_groups, bins)
     v = _eval_value(agg.vexpr, arrays, params)
+    fast32 = jnp.issubdtype(v.dtype, jnp.integer) and _fits_i32(v, agg)
     if agg.kind == "sum":
+        if fast32:
+            vm = jnp.where(mask, v, 0)
+            return _segment_sum_exact_i64(
+                vm, gid, num_segments, n, agg.vmin, agg.vmax
+            ).astype(jnp.float64)
         v = jnp.where(mask, v, 0).astype(jnp.float64)
         return jax.ops.segment_sum(v, gid, num_segments=num_segments)
     if agg.kind == "sumsq":
         v = jnp.where(mask, v, 0).astype(jnp.float64)
         return jax.ops.segment_sum(v * v, gid, num_segments=num_segments)
     if agg.kind == "min":
+        if fast32 and counts is not None:
+            # masked rows route to the trash slot, so each group's scatter
+            # sees only real values; EMPTY groups are detected by the count
+            # column (never by a sentinel a real value could collide with)
+            vm = jnp.where(mask, v.astype(jnp.int32), _I32_MAX)
+            out = jax.ops.segment_min(vm, gid, num_segments=num_segments)
+            return jnp.where(counts == 0, jnp.inf, out.astype(jnp.float64))
+        if v.dtype == jnp.float32:
+            vm = jnp.where(mask, v, jnp.float32(jnp.inf))
+            return jax.ops.segment_min(
+                vm, gid, num_segments=num_segments).astype(jnp.float64)
         v = jnp.where(mask, v, jnp.inf).astype(jnp.float64)
         return jax.ops.segment_min(v, gid, num_segments=num_segments)
     if agg.kind == "max":
+        if fast32 and counts is not None:
+            vm = jnp.where(mask, v.astype(jnp.int32), _I32_MIN)
+            out = jax.ops.segment_max(vm, gid, num_segments=num_segments)
+            return jnp.where(counts == 0, -jnp.inf, out.astype(jnp.float64))
+        if v.dtype == jnp.float32:
+            vm = jnp.where(mask, v, jnp.float32(-jnp.inf))
+            return jax.ops.segment_max(
+                vm, gid, num_segments=num_segments).astype(jnp.float64)
         v = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
         return jax.ops.segment_max(v, gid, num_segments=num_segments)
     raise ValueError(f"unknown agg kind {agg.kind}")

@@ -202,3 +202,47 @@ def test_value_hist_percentile_semantics():
     assert vh.mode() == 3.0
     merged = vh.merge(ValueHist.from_values(np.asarray([1, 1, 1, 1])))
     assert merged.mode() == 1.0
+
+
+def test_long_timestamp_aggregates_exact(tmp_path, rng):
+    """SUM/MIN/MAX/AVG over LONG columns holding values beyond int32 must
+    stay exact — the 32-bit kernel fast paths have to step aside (found by
+    review: unconditional int32 downcast wrapped epoch-millis sums)."""
+    import numpy as np
+
+    from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.spi.data_types import Schema
+
+    schema = Schema.build(
+        "tl", dimensions=[("d", "STRING")],
+        metrics=[("big", "LONG"), ("neg", "LONG")],
+        date_times=[("ts", "TIMESTAMP")])
+    n = 500
+    base = 1_722_300_000_000
+    cols = {
+        "d": np.asarray(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "big": (base + rng.integers(0, 10_000, n)).astype(np.int64),
+        "neg": (-base - rng.integers(0, 10_000, n)).astype(np.int64),
+        "ts": (base + np.arange(n)).astype(np.int64),
+    }
+    d = tmp_path / "s0"
+    SegmentBuilder(schema, segment_name="s0").build(cols, d)
+    for backend in ("tpu", "host"):
+        qe = QueryExecutor(backend=backend)
+        qe.add_table(schema, [load_segment(d)])
+        r = qe.execute_sql(
+            "SELECT d, SUM(big), MIN(ts), MAX(ts), SUM(neg) FROM tl "
+            "GROUP BY d ORDER BY d LIMIT 10")
+        assert not r.exceptions, (backend, r.exceptions)
+        for row in r.result_table.rows:
+            sel = cols["d"] == row[0]
+            assert row[1] == float(cols["big"][sel].sum()), backend
+            assert row[2] == float(cols["ts"][sel].min()), backend
+            assert row[3] == float(cols["ts"][sel].max()), backend
+            assert row[4] == float(cols["neg"][sel].sum()), backend
+        # int32 extremes are legitimate values, not empty-group sentinels
+        r = qe.execute_sql("SELECT MIN(big), MAX(neg) FROM tl")
+        assert r.result_table.rows[0][0] == float(cols["big"].min())
+        assert r.result_table.rows[0][1] == float(cols["neg"].max())
