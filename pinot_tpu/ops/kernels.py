@@ -217,15 +217,144 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
     gid = jnp.where(mask, gid, trash)
     num_segments = num_groups + 1
 
-    # counts scatter at 32 bits (rows < 2^31 per segment) and widen after
-    counts = jax.ops.segment_sum(
-        mask.astype(jnp.int32), gid,
-        num_segments=num_segments).astype(jnp.int64)
-    outputs = [counts]
+    # one VECTOR-payload scatter per reduce op: an (n, C) segment_sum costs
+    # the same as an (n,) one on TPU (measured 194ms vs 178ms at 16M rows;
+    # C separate scatters cost C×) — counts, every integer sum's limbs and
+    # every f64 sum ride together, likewise all mins and all maxes
+    batch = _ScatterBatch(mask)
+    count_ref = batch.add_sum_i32(mask.astype(jnp.int32))
+    recipes = []
     for agg in program.aggs:
-        outputs.append(_run_agg(agg, arrays, params, mask, gid,
-                                num_segments, n, counts=counts))
+        recipes.append(_batch_agg(agg, arrays, params, mask, batch))
+    results = batch.run(gid, num_segments)
+    counts = results.resolve(count_ref).astype(jnp.int64)
+    outputs = [counts]
+    for agg, recipe in zip(program.aggs, recipes):
+        if recipe is None:  # matrix-shaped op: its own scatter space
+            outputs.append(_run_agg(agg, arrays, params, mask, gid,
+                                    num_segments, n, counts=counts))
+        else:
+            outputs.append(recipe(results, counts))
     return tuple(outputs)
+
+
+class _ScatterBatch:
+    """Collects per-row payload columns so the dense group-by issues at
+    most one scatter per reduce kind (sum-i32, sum-f64, min-i32, min-f64,
+    max-i32, max-f64) regardless of aggregation count."""
+
+    KINDS = ("sum_i32", "sum_f64", "min_i32", "min_f64", "max_i32",
+             "max_f64")
+
+    def __init__(self, mask):
+        self.mask = mask
+        self.cols = {k: [] for k in self.KINDS}
+
+    def _add(self, kind, col):
+        self.cols[kind].append(col)
+        return (kind, len(self.cols[kind]) - 1)
+
+    def add_sum_i32(self, col):
+        return self._add("sum_i32", col)
+
+    def add_sum_f64(self, col):
+        return self._add("sum_f64", col)
+
+    def add_min(self, col, is_i32):
+        return self._add("min_i32" if is_i32 else "min_f64", col)
+
+    def add_max(self, col, is_i32):
+        return self._add("max_i32" if is_i32 else "max_f64", col)
+
+    def run(self, gid, num_segments, indices_are_sorted=False):
+        ops = {"sum_i32": jax.ops.segment_sum,
+               "sum_f64": jax.ops.segment_sum,
+               "min_i32": jax.ops.segment_min,
+               "min_f64": jax.ops.segment_min,
+               "max_i32": jax.ops.segment_max,
+               "max_f64": jax.ops.segment_max}
+        out = {}
+        for kind, cols in self.cols.items():
+            if not cols:
+                continue
+            stacked = jnp.stack(cols, axis=1)
+            out[kind] = ops[kind](stacked, gid, num_segments=num_segments,
+                                  indices_are_sorted=indices_are_sorted)
+        return _BatchResults(out)
+
+
+class _BatchResults:
+    def __init__(self, out):
+        self.out = out
+
+    def resolve(self, ref):
+        kind, idx = ref
+        return self.out[kind][:, idx]
+
+
+def _batch_agg(agg: ir.AggOp, arrays, params, mask, batch):
+    """Register one aggregation's payload columns; returns a recipe
+    (results, counts) → output column, or None for matrix-shaped ops."""
+    if agg.kind in ("distinct_bitmap", "value_hist", "hist_fixed"):
+        return None
+    if agg.kind == "count":
+        return lambda results, counts: counts
+    v = _eval_value(agg.vexpr, arrays, params)
+    fast32 = jnp.issubdtype(v.dtype, jnp.integer) and _fits_i32(v, agg)
+    if agg.kind == "sum":
+        if fast32:
+            vm = jnp.where(mask, v, 0).astype(jnp.int32)
+            u = vm.astype(jnp.uint32)
+            b = max(1, min(16, 31 - max(1, vm.shape[0] - 1).bit_length()))
+            nonneg = agg.vmin is not None and agg.vmin >= 0
+            nbits = 32
+            if nonneg and agg.vmax is not None:
+                nbits = max(1, int(agg.vmax).bit_length())
+            refs = [(batch.add_sum_i32(
+                        ((u >> s) & jnp.uint32((1 << b) - 1))
+                        .astype(jnp.int32)), s)
+                    for s in range(0, nbits, b)]
+            neg_ref = None if nonneg else batch.add_sum_i32(
+                (vm < 0).astype(jnp.int32))
+
+            def recipe(results, counts, _refs=refs, _neg=neg_ref):
+                total = jnp.zeros(counts.shape[0], dtype=jnp.int64)
+                for ref, shift in _refs:
+                    total = total + (results.resolve(ref)
+                                     .astype(jnp.int64) << shift)
+                if _neg is not None:
+                    total = total - (results.resolve(_neg)
+                                     .astype(jnp.int64) << 32)
+                return total.astype(jnp.float64)
+
+            return recipe
+        ref = batch.add_sum_f64(jnp.where(mask, v, 0).astype(jnp.float64))
+        return lambda results, counts, _r=ref: results.resolve(_r)
+    if agg.kind == "sumsq":
+        vf = jnp.where(mask, v, 0).astype(jnp.float64)
+        ref = batch.add_sum_f64(vf * vf)
+        return lambda results, counts, _r=ref: results.resolve(_r)
+    if agg.kind == "min":
+        if fast32:
+            ref = batch.add_min(
+                jnp.where(mask, v.astype(jnp.int32), _I32_MAX), True)
+            return lambda results, counts, _r=ref: jnp.where(
+                counts == 0, jnp.inf,
+                results.resolve(_r).astype(jnp.float64))
+        ref = batch.add_min(
+            jnp.where(mask, v, jnp.inf).astype(jnp.float64), False)
+        return lambda results, counts, _r=ref: results.resolve(_r)
+    if agg.kind == "max":
+        if fast32:
+            ref = batch.add_max(
+                jnp.where(mask, v.astype(jnp.int32), _I32_MIN), True)
+            return lambda results, counts, _r=ref: jnp.where(
+                counts == 0, -jnp.inf,
+                results.resolve(_r).astype(jnp.float64))
+        ref = batch.add_max(
+            jnp.where(mask, v, -jnp.inf).astype(jnp.float64), False)
+        return lambda results, counts, _r=ref: results.resolve(_r)
+    raise ValueError(f"unknown agg kind {agg.kind}")
 
 
 def _run_ungrouped(program: ir.Program, arrays, params, mask, n):
