@@ -41,6 +41,7 @@ from ..query.expressions import ExpressionContext
 from .executor import _block_to_result
 from .fragmenter import Stage, explain_stages, fragment
 from .logical import LogicalPlanner, prune_columns
+from .optimizer import push_filters
 from .mailbox import Block, concat_blocks, hash_partition
 from .operators import op_filter
 from .parser import parse_relational
@@ -415,6 +416,7 @@ class DistributedMseDispatcher:
         query = parse_relational(sql)
         planner = LogicalPlanner(query, self._catalog())
         plan = planner.plan()
+        plan = push_filters(plan)
         prune_columns(plan)
         stages = fragment(plan)
         if query.explain:

@@ -18,6 +18,7 @@ import numpy as np
 from ..engine.results import BrokerResponse, DataSchema, ResultTable
 from .fragmenter import explain_stages, fragment
 from .logical import LogicalPlanner, prune_columns
+from .optimizer import push_filters
 from .mailbox import Block, block_len
 from .parser import parse_relational
 from .runtime import StageRunner
@@ -67,6 +68,7 @@ class MultistageExecutor:
             query = parse_relational(sql)
             planner = LogicalPlanner(query, self._catalog())
             plan = planner.plan()
+            plan = push_filters(plan)
             prune_columns(plan)
             stages = fragment(plan)
             if query.explain:
