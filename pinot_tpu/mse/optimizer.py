@@ -182,7 +182,16 @@ def _sink(child: PlanNode, conjs: list[EC]) -> tuple[PlanNode, list[EC]]:
         return child, []
 
     if isinstance(child, WindowNode):
-        pkeys = {p.identifier for p in child.partition_keys if p.is_identifier}
+        # a predicate may only sink below the window if it is constant
+        # within EVERY call's partitions — node.partition_keys reflects just
+        # calls[0], so intersect the per-call PARTITION BY key sets
+        pkeys = None
+        for call in child.calls:
+            spec = call.spec
+            ck = {p.identifier for p in (spec.partition_by if spec else [])
+                  if p.is_identifier}
+            pkeys = ck if pkeys is None else (pkeys & ck)
+        pkeys = pkeys or set()
         moved, kept = [], []
         for c in conjs:
             cols = c.columns()

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..engine import ir
+from . import mxu_groupby
 
 jax.config.update("jax_enable_x64", True)
 
@@ -216,145 +217,81 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
     trash = jnp.int32(num_groups)
     gid = jnp.where(mask, gid, trash)
     num_segments = num_groups + 1
+    return _run_dense_group_by(program, arrays, params, mask, gid,
+                               num_segments, n)
 
-    # one VECTOR-payload scatter per reduce op: an (n, C) segment_sum costs
-    # the same as an (n,) one on TPU (measured 194ms vs 178ms at 16M rows;
-    # C separate scatters cost C×) — counts, every integer sum's limbs and
-    # every f64 sum ride together, likewise all mins and all maxes
-    batch = _ScatterBatch(mask)
-    count_ref = batch.add_sum_i32(mask.astype(jnp.int32))
-    recipes = []
+
+def _run_dense_group_by(program: ir.Program, arrays, params, mask, gid,
+                        num_segments, n):
+    """COUNT and every int32-safe SUM ride ONE MXU pass (8-bit limb planes
+    through the kron-factored one-hot matmul — ops/mxu_groupby.py); scatters
+    only remain for what the MXU cannot reduce (min/max, float sums, matrix
+    ops). Replaces the batched (n, C) vector-payload scatter, whose minor
+    dim was padded 6→128 lanes by TPU tiling (a 21x HBM blowup that OOMed
+    real 100M-row segments)."""
+    planes = [mask.astype(jnp.bfloat16)]  # count plane
+    recipes: list = []  # per agg: callable(sums, counts) | None → _run_agg
     for agg in program.aggs:
-        recipes.append(_batch_agg(agg, arrays, params, mask, batch))
-    results = batch.run(gid, num_segments)
-    counts = results.resolve(count_ref).astype(jnp.int64)
+        recipes.append(_mxu_agg(agg, arrays, params, mask, planes))
+    if not mxu_groupby.supports(num_segments, len(planes)):
+        # too many groups/planes for the VMEM-resident accumulator: sums
+        # drop back to per-plane 32-bit scatters; COUNTs still answer from
+        # the shared counts column (their recipe reads no limb sums)
+        planes = []
+        recipes = [r if agg.kind == "count" else None
+                   for agg, r in zip(program.aggs, recipes)]
+    if planes:
+        sums = mxu_groupby.limb_sums(planes, gid, num_segments)
+        counts = sums[0]
+    else:
+        sums = None
+        counts = jax.ops.segment_sum(
+            mask.astype(jnp.int32), gid,
+            num_segments=num_segments).astype(jnp.int64)
     outputs = [counts]
     for agg, recipe in zip(program.aggs, recipes):
-        if recipe is None:  # matrix-shaped op: its own scatter space
+        if recipe is None:
             outputs.append(_run_agg(agg, arrays, params, mask, gid,
                                     num_segments, n, counts=counts))
         else:
-            outputs.append(recipe(results, counts))
+            outputs.append(recipe(sums, counts))
     return tuple(outputs)
 
 
-class _ScatterBatch:
-    """Collects per-row payload columns so the dense group-by issues at
-    most one scatter per reduce kind (sum-i32, sum-f64, min-i32, min-f64,
-    max-i32, max-f64) regardless of aggregation count."""
-
-    KINDS = ("sum_i32", "sum_f64", "min_i32", "min_f64", "max_i32",
-             "max_f64")
-
-    def __init__(self, mask):
-        self.mask = mask
-        self.cols = {k: [] for k in self.KINDS}
-
-    def _add(self, kind, col):
-        self.cols[kind].append(col)
-        return (kind, len(self.cols[kind]) - 1)
-
-    def add_sum_i32(self, col):
-        return self._add("sum_i32", col)
-
-    def add_sum_f64(self, col):
-        return self._add("sum_f64", col)
-
-    def add_min(self, col, is_i32):
-        return self._add("min_i32" if is_i32 else "min_f64", col)
-
-    def add_max(self, col, is_i32):
-        return self._add("max_i32" if is_i32 else "max_f64", col)
-
-    def run(self, gid, num_segments, indices_are_sorted=False):
-        ops = {"sum_i32": jax.ops.segment_sum,
-               "sum_f64": jax.ops.segment_sum,
-               "min_i32": jax.ops.segment_min,
-               "min_f64": jax.ops.segment_min,
-               "max_i32": jax.ops.segment_max,
-               "max_f64": jax.ops.segment_max}
-        out = {}
-        for kind, cols in self.cols.items():
-            if not cols:
-                continue
-            stacked = jnp.stack(cols, axis=1)
-            out[kind] = ops[kind](stacked, gid, num_segments=num_segments,
-                                  indices_are_sorted=indices_are_sorted)
-        return _BatchResults(out)
-
-
-class _BatchResults:
-    def __init__(self, out):
-        self.out = out
-
-    def resolve(self, ref):
-        kind, idx = ref
-        return self.out[kind][:, idx]
-
-
-def _batch_agg(agg: ir.AggOp, arrays, params, mask, batch):
-    """Register one aggregation's payload columns; returns a recipe
-    (results, counts) → output column, or None for matrix-shaped ops."""
-    if agg.kind in ("distinct_bitmap", "value_hist", "hist_fixed"):
-        return None
+def _mxu_agg(agg: ir.AggOp, arrays, params, mask, planes):
+    """Register an aggregation's 8-bit limb planes for the MXU pass;
+    returns a recipe (sums, counts) → output column, or None if this agg
+    kind must run through its own scatter (_run_agg)."""
     if agg.kind == "count":
-        return lambda results, counts: counts
+        return lambda sums, counts: counts
+    if agg.kind != "sum":
+        return None
     v = _eval_value(agg.vexpr, arrays, params)
-    fast32 = jnp.issubdtype(v.dtype, jnp.integer) and _fits_i32(v, agg)
-    if agg.kind == "sum":
-        if fast32:
-            vm = jnp.where(mask, v, 0).astype(jnp.int32)
-            u = vm.astype(jnp.uint32)
-            b = max(1, min(16, 31 - max(1, vm.shape[0] - 1).bit_length()))
-            nonneg = agg.vmin is not None and agg.vmin >= 0
-            nbits = 32
-            if nonneg and agg.vmax is not None:
-                nbits = max(1, int(agg.vmax).bit_length())
-            refs = [(batch.add_sum_i32(
-                        ((u >> s) & jnp.uint32((1 << b) - 1))
-                        .astype(jnp.int32)), s)
-                    for s in range(0, nbits, b)]
-            neg_ref = None if nonneg else batch.add_sum_i32(
-                (vm < 0).astype(jnp.int32))
+    if not (jnp.issubdtype(v.dtype, jnp.integer) and _fits_i32(v, agg)):
+        return None
+    vm = jnp.where(mask, v, 0).astype(jnp.int32)
+    u = vm.astype(jnp.uint32)
+    shifts, nonneg = _limb_shifts(agg.vmin, agg.vmax, 8)
+    if len(planes) + len(shifts) + (0 if nonneg else 1) > mxu_groupby.MAX_PLANES:
+        return None
+    refs = []
+    for s in shifts:
+        refs.append((len(planes), s))
+        planes.append(((u >> s) & jnp.uint32(0xFF)).astype(jnp.bfloat16))
+    neg_ref = None
+    if not nonneg:
+        neg_ref = len(planes)
+        planes.append((vm < 0).astype(jnp.bfloat16))
 
-            def recipe(results, counts, _refs=refs, _neg=neg_ref):
-                total = jnp.zeros(counts.shape[0], dtype=jnp.int64)
-                for ref, shift in _refs:
-                    total = total + (results.resolve(ref)
-                                     .astype(jnp.int64) << shift)
-                if _neg is not None:
-                    total = total - (results.resolve(_neg)
-                                     .astype(jnp.int64) << 32)
-                return total.astype(jnp.float64)
+    def recipe(sums, counts, _refs=refs, _neg=neg_ref):
+        total = jnp.zeros(counts.shape[0], dtype=jnp.int64)
+        for idx, shift in _refs:
+            total = total + (sums[idx] << shift)
+        if _neg is not None:
+            total = total - (sums[_neg] << 32)
+        return total.astype(jnp.float64)
 
-            return recipe
-        ref = batch.add_sum_f64(jnp.where(mask, v, 0).astype(jnp.float64))
-        return lambda results, counts, _r=ref: results.resolve(_r)
-    if agg.kind == "sumsq":
-        vf = jnp.where(mask, v, 0).astype(jnp.float64)
-        ref = batch.add_sum_f64(vf * vf)
-        return lambda results, counts, _r=ref: results.resolve(_r)
-    if agg.kind == "min":
-        if fast32:
-            ref = batch.add_min(
-                jnp.where(mask, v.astype(jnp.int32), _I32_MAX), True)
-            return lambda results, counts, _r=ref: jnp.where(
-                counts == 0, jnp.inf,
-                results.resolve(_r).astype(jnp.float64))
-        ref = batch.add_min(
-            jnp.where(mask, v, jnp.inf).astype(jnp.float64), False)
-        return lambda results, counts, _r=ref: results.resolve(_r)
-    if agg.kind == "max":
-        if fast32:
-            ref = batch.add_max(
-                jnp.where(mask, v.astype(jnp.int32), _I32_MIN), True)
-            return lambda results, counts, _r=ref: jnp.where(
-                counts == 0, -jnp.inf,
-                results.resolve(_r).astype(jnp.float64))
-        ref = batch.add_max(
-            jnp.where(mask, v, -jnp.inf).astype(jnp.float64), False)
-        return lambda results, counts, _r=ref: results.resolve(_r)
-    raise ValueError(f"unknown agg kind {agg.kind}")
+    return recipe
 
 
 def _run_ungrouped(program: ir.Program, arrays, params, mask, n):
@@ -572,12 +509,9 @@ def _segment_sum_exact_i64(v, gid, num_segments, n, vmin=None, vmax=None,
     v = v.astype(jnp.int32)
     u = v.astype(jnp.uint32)  # two's-complement reinterpretation
     b = max(1, min(16, 31 - max(1, n - 1).bit_length()))
-    nonneg = vmin is not None and vmin >= 0
-    nbits = 32
-    if nonneg and vmax is not None:
-        nbits = max(1, int(vmax).bit_length())
+    shifts, nonneg = _limb_shifts(vmin, vmax, b)
     total = jnp.zeros(num_segments, dtype=jnp.int64)
-    for shift in range(0, nbits, b):
+    for shift in shifts:
         limb = ((u >> shift) & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
         s = jax.ops.segment_sum(limb, gid, num_segments=num_segments,
                                 indices_are_sorted=indices_are_sorted)
@@ -592,6 +526,17 @@ def _segment_sum_exact_i64(v, gid, num_segments, n, vmin=None, vmax=None,
 
 _I32_MAX = (1 << 31) - 1
 _I32_MIN = -(1 << 31)
+
+
+def _limb_shifts(vmin, vmax, b):
+    """Limb starting bits for an exact two's-complement int32 sum split
+    into b-bit limbs, and whether the negative-count correction pass can be
+    skipped (planner-proved non-negative columns)."""
+    nonneg = vmin is not None and vmin >= 0
+    nbits = 32
+    if nonneg and vmax is not None:
+        nbits = max(1, int(vmax).bit_length())
+    return list(range(0, nbits, b)), nonneg
 
 
 def _fits_i32(v, agg: ir.AggOp) -> bool:

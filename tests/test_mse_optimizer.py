@@ -102,8 +102,7 @@ def test_push_below_aggregate_group_key_only():
 
 
 def test_push_into_union_branches():
-    p = plan("SELECT oid AS k FROM orders UNION ALL SELECT cid AS k FROM customers")
-    # pushdown applies when an outer query filters the union via a subquery
+    # the outer query filters the union through a subquery
     p = plan("SELECT k FROM (SELECT oid AS k FROM orders UNION ALL "
              "SELECT cid AS k FROM customers) u WHERE k > 5")
     setop = find(p, SetOpNode)[0]
@@ -236,3 +235,31 @@ def test_constant_having_not_pushed(engine):
     assert not resp.exceptions, resp.exceptions
     assert resp.result_table.rows == conn.execute(
         "SELECT COUNT(*) FROM orders HAVING 1 = 0").fetchall() == []
+
+
+def test_window_mixed_partitions_not_pushed():
+    """A filter on calls[0]'s partition key must NOT sink below a window
+    whose other calls partition differently (their frames would shrink)."""
+    from pinot_tpu.mse.logical import WindowNode
+
+    sql = ("SELECT k, r1 FROM (SELECT oid AS k, "
+           "RANK() OVER (PARTITION BY oid ORDER BY amount) AS r1, "
+           "RANK() OVER (PARTITION BY cust_id ORDER BY amount) AS r2 "
+           "FROM orders) s WHERE k > 5")
+    p = plan(sql)
+    win = find(p, WindowNode)[0]
+    assert not find(win, FilterNode), "filter leaked below mixed-partition window"
+    assert find_above(p, win)
+
+
+def test_window_shared_partition_pushes():
+    from pinot_tpu.mse.logical import WindowNode
+
+    sql = ("SELECT k, r1 FROM (SELECT oid AS k, "
+           "RANK() OVER (PARTITION BY oid ORDER BY amount) AS r1, "
+           "SUM(amount) OVER (PARTITION BY oid) AS s1 "
+           "FROM orders) s WHERE k > 5")
+    p = plan(sql)
+    win = find(p, WindowNode)[0]
+    assert filter_directly_above_scan(win, "orders")
+    assert not find_above(p, win)
