@@ -17,12 +17,36 @@ from __future__ import annotations
 
 import pickle
 import socket
+import ssl
 import struct
 import threading
 from typing import Callable, Optional
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
+
+
+def make_server_ssl_context(certfile: str, keyfile: str) -> ssl.SSLContext:
+    """TLS for the data plane (reference: Netty channel TLS,
+    pinot-core/.../transport/ChannelHandlerFactory with TlsConfig)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    return ctx
+
+
+def make_client_ssl_context(cafile: Optional[str] = None,
+                            verify: bool = True) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if cafile:
+        ctx.load_verify_locations(cafile)
+    else:
+        # PROTOCOL_TLS_CLIENT starts with zero trust anchors (unlike
+        # create_default_context) — CA-signed server certs need system CAs
+        ctx.load_default_certs()
+    if not verify:  # self-signed dev certs (reference tls "skip server" mode)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx
 
 
 class TransportError(Exception):
@@ -63,8 +87,17 @@ class RpcServer:
     handler(request_obj) → response_obj. Bind to port 0 for an ephemeral
     port; .port reports the bound port."""
 
-    def __init__(self, handler: Callable, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, handler: Callable, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context: Optional[ssl.SSLContext] = None,
+                 max_inflight_bytes: Optional[int] = None):
         self.handler = handler
+        self._ssl = ssl_context
+        # request-memory guard (reference: DirectOOMHandler — shed load
+        # instead of dying when request buffers exceed the direct-memory
+        # budget): frames beyond the budget are drained and refused
+        self._budget = max_inflight_bytes
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -85,13 +118,71 @@ class RpcServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
+    def _handshake(self, conn: socket.socket) -> Optional[socket.socket]:
+        """TLS handshake off the accept loop (a stalled ClientHello must
+        not block other connections) and under a timeout."""
+        if self._ssl is None:
+            return conn
+        conn.settimeout(10.0)
+        try:
+            conn = self._ssl.wrap_socket(conn, server_side=True)
+            conn.settimeout(None)
+            return conn
+        except (ssl.SSLError, OSError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return None
+
+    def _reserve(self, n: int) -> bool:
+        if self._budget is None:
+            return True
+        with self._inflight_lock:
+            if self._inflight + n > self._budget:
+                return False
+            self._inflight += n
+            return True
+
+    def _release(self, n: int) -> None:
+        if self._budget is not None:
+            with self._inflight_lock:
+                self._inflight -= n
+
     def _serve_conn(self, conn: socket.socket) -> None:
         import types
 
+        handshaken = self._handshake(conn)
+        if handshaken is None:
+            return
+        conn = handshaken
         with conn:
             while not self._closed.is_set():
                 try:
-                    request = _recv_frame(conn)
+                    (n,) = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                    if n > _MAX_FRAME:
+                        return
+                    if not self._reserve(n):
+                        # drain through a fixed scratch to keep the stream
+                        # in sync WITHOUT buffering the frame (the guard
+                        # must not itself allocate what it refuses)
+                        left = n
+                        while left:
+                            chunk = conn.recv(min(left, 1 << 16))
+                            if not chunk:
+                                return
+                            left -= len(chunk)
+                        try:
+                            _send_frame(conn, (
+                                "error", "ServerOutOfMemory: request "
+                                "buffers exceed the transport memory budget"))
+                        except OSError:
+                            return
+                        continue
+                    try:
+                        request = pickle.loads(_recv_exact(conn, n))
+                    finally:
+                        self._release(n)
                 except (TransportError, OSError, EOFError):
                     return
                 try:
@@ -126,16 +217,20 @@ class RpcServer:
 class RpcClient:
     """Pooled single connection per target with reconnect-on-failure."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 ssl_context: Optional[ssl.SSLContext] = None):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self._ssl = ssl_context
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection((self.host, self.port), timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._ssl is not None:
+            s = self._ssl.wrap_socket(s, server_hostname=self.host)
         return s
 
     def call(self, request):

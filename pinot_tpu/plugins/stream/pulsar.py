@@ -1,0 +1,207 @@
+"""Pulsar stream connector on the stream SPI.
+
+Reference: PulsarPartitionLevelConsumer / PulsarStreamMetadataProvider
+(pinot-plugins/pinot-stream-ingestion/pinot-pulsar/src/main/java/org/
+apache/pinot/plugin/stream/pulsar/PulsarPartitionLevelConsumer.java) —
+reader-based (not subscription) partition consumption seeded at a
+MessageId, checkpointed per segment.
+
+Offset model (rides the SPI's ``LongMsgOffset``): Pulsar MessageIds are
+(ledgerId, entryId, batchIndex) triples packed as
+
+    ((ledgerId + 1) << 36) | (entryId << 8) | batchIndex
+
+monotone within a partition because ledger and entry ids are assigned in
+order. The +1 ledger bias keeps every real packed id above the sentinels:
+
+    0  EARLIEST  (MessageId.earliest)
+    1  LATEST    (MessageId.latest — only new messages)
+
+entryId is bounded to 28 bits and batchIndex to 8; overflow raises rather
+than silently wrapping the checkpoint stream backwards. The pulsar-client
+library is OPTIONAL behind ``client_factory``; tests inject a fake with
+the adapter surface:
+
+    partition_count(topic) -> int      (0 → non-partitioned, treated as 1)
+    read(topic, partition, from_packed:int, timeout_ms)
+        -> [(packed:int, key:bytes|None, value:bytes, ts_ms:int|None), ...]
+           (from_packed follows the sentinel model above; inclusive start)
+    latest(topic, partition) -> int    (1 when idle)
+    close()
+
+Config keys (reference-compatible):
+    streamType: pulsar
+    stream.pulsar.topic.name
+    stream.pulsar.consumer.prop.serviceUrl    (pulsar://host:6650)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ...spi.stream import (
+    LongMsgOffset,
+    MessageBatch,
+    PartitionGroupConsumer,
+    StreamConsumerFactory,
+    StreamMessage,
+    StreamMetadataProvider,
+    register_stream_type,
+)
+
+_PROP = "stream.pulsar.consumer.prop."
+EARLIEST = 0
+LATEST = 1
+_ENTRY_BITS = 28
+_BATCH_BITS = 8
+
+
+def pack_message_id(ledger: int, entry: int, batch: int = 0) -> int:
+    """(ledger, entry, batch) → flat monotone int offset (> all sentinels)."""
+    if not (0 <= entry < (1 << _ENTRY_BITS)):
+        raise ValueError(f"entryId {entry} out of the {_ENTRY_BITS}-bit "
+                         "packable range — checkpoint would wrap")
+    if not (0 <= batch < (1 << _BATCH_BITS)):
+        raise ValueError(f"batchIndex {batch} out of the {_BATCH_BITS}-bit "
+                         "packable range — checkpoint would wrap")
+    return ((ledger + 1) << (_ENTRY_BITS + _BATCH_BITS)) \
+        | (entry << _BATCH_BITS) | batch
+
+
+def unpack_message_id(packed: int) -> tuple[int, int, int]:
+    return ((packed >> (_ENTRY_BITS + _BATCH_BITS)) - 1,
+            (packed >> _BATCH_BITS) & ((1 << _ENTRY_BITS) - 1),
+            packed & ((1 << _BATCH_BITS) - 1))
+
+
+class _PulsarClientAdapter:
+    """Adapts the pulsar-client library to the adapter surface above."""
+
+    def __init__(self, service_url: str):
+        import pulsar  # type: ignore[import-not-found]
+
+        self._pulsar = pulsar
+        self._client = pulsar.Client(service_url)
+
+    def partition_count(self, topic) -> int:
+        parts = self._client.get_topic_partitions(topic)
+        # a non-partitioned topic reports itself as its only "partition"
+        return len(parts) if len(parts) > 1 or (
+            parts and parts[0] != topic) else 0
+
+    def _reader_topic(self, topic, partition):
+        # partition -1 = non-partitioned: read the topic itself
+        return topic if partition < 0 else f"{topic}-partition-{partition}"
+
+    def _start_id(self, partition, from_packed):
+        if from_packed <= EARLIEST:
+            return self._pulsar.MessageId.earliest, True
+        if from_packed == LATEST:
+            return self._pulsar.MessageId.latest, False
+        ledger, entry, batch = unpack_message_id(from_packed)
+        return self._pulsar.MessageId(max(partition, -1), ledger, entry,
+                                      batch), True
+
+    def read(self, topic, partition, from_packed, timeout_ms):
+        start, inclusive = self._start_id(partition, from_packed)
+        reader = self._client.create_reader(
+            self._reader_topic(topic, partition), start_message_id=start,
+            start_message_id_inclusive=inclusive)
+        out = []
+        try:
+            while reader.has_message_available():
+                msg = reader.read_next(timeout_millis=timeout_ms)
+                mid = msg.message_id()
+                packed = pack_message_id(mid.ledger_id(), mid.entry_id(),
+                                         max(0, mid.batch_index()))
+                if inclusive and packed < from_packed:
+                    continue  # replayed prefix of a batch
+                out.append((packed,
+                            (msg.partition_key() or "").encode() or None,
+                            msg.data(), msg.publish_timestamp()))
+        finally:
+            reader.close()
+        return out
+
+    def latest(self, topic, partition) -> int:
+        # a reader seeded at MessageId.latest sees only the tail; an idle
+        # partition therefore reports the LATEST sentinel — never a replay
+        # of retained history
+        recs = self.read(topic, partition, LATEST, 100)
+        return recs[-1][0] + 1 if recs else LATEST
+
+    def close(self):
+        self._client.close()
+
+
+def _default_client_factory(config):
+    try:
+        import pulsar  # noqa: F401  type: ignore[import-not-found]
+    except ImportError as e:
+        raise ImportError(
+            "streamType 'pulsar' needs the pulsar-client package (or inject "
+            "PulsarStreamConsumerFactory.client_factory)") from e
+    url = config.props.get(_PROP + "serviceUrl", "pulsar://localhost:6650")
+    return _PulsarClientAdapter(url)
+
+
+class PulsarPartitionConsumer(PartitionGroupConsumer):
+    def __init__(self, client, topic: str, partition: int):
+        self._client = client
+        self._topic = topic
+        self._partition = partition
+
+    def fetch_messages(self, start_offset: LongMsgOffset,
+                       timeout_ms: int) -> MessageBatch:
+        recs = self._client.read(self._topic, self._partition,
+                                 start_offset.offset, timeout_ms)
+        messages = [
+            StreamMessage(value=value, key=key,
+                          offset=LongMsgOffset(packed), timestamp_ms=ts)
+            for packed, key, value, ts in recs]
+        next_off = recs[-1][0] + 1 if recs else start_offset.offset
+        return MessageBatch(messages, LongMsgOffset(next_off))
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class PulsarMetadataProvider(StreamMetadataProvider):
+    def __init__(self, client, topic: str):
+        self._client = client
+        self._topic = topic
+
+    def partition_count(self) -> int:
+        return max(1, self._client.partition_count(self._topic))
+
+    def fetch_earliest_offset(self, partition: int) -> LongMsgOffset:
+        return LongMsgOffset(EARLIEST)
+
+    def fetch_latest_offset(self, partition: int) -> LongMsgOffset:
+        return LongMsgOffset(self._client.latest(
+            self._topic, self._effective_partition(partition)))
+
+    def _effective_partition(self, partition: int) -> int:
+        return -1 if self._client.partition_count(self._topic) == 0 \
+            else partition
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class PulsarStreamConsumerFactory(StreamConsumerFactory):
+    client_factory: Callable = staticmethod(_default_client_factory)
+
+    def create_partition_consumer(self, partition: int) -> PulsarPartitionConsumer:
+        client = type(self).client_factory(self.config)
+        if client.partition_count(self.config.topic_name) == 0:
+            partition = -1  # non-partitioned: read the topic itself
+        return PulsarPartitionConsumer(client, self.config.topic_name,
+                                       partition)
+
+    def create_metadata_provider(self) -> PulsarMetadataProvider:
+        return PulsarMetadataProvider(
+            type(self).client_factory(self.config), self.config.topic_name)
+
+
+register_stream_type("pulsar", PulsarStreamConsumerFactory)
