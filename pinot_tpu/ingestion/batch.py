@@ -40,6 +40,20 @@ class SegmentGenerationJobSpec:
     segment_name_prefix: Optional[str] = None
     overwrite_output: bool = True
     create_tar: bool = False  # reference pushes tar.gz; dirs are the default here
+    # standalone = in-process sequential; multiprocess = one build per
+    # worker process (the Spark/Hadoop runner analogue — the reference
+    # distributes file→segment tasks over executors,
+    # pinot-plugins/pinot-batch-ingestion/pinot-batch-ingestion-spark-3/
+    # SparkSegmentGenerationJobRunner; here the unit of distribution is a
+    # local process pool, and the FS abstraction keeps inputs/outputs on
+    # shared/object storage exactly as the cluster runners do)
+    execution_framework: str = "standalone"
+    parallelism: Optional[int] = None  # multiprocess worker count
+    # module imported in each worker before building — re-registers
+    # process-global state (custom index types, stream decoders) that a
+    # spawned worker would not inherit (reference: plugin jars shipped to
+    # Spark executors via --jars)
+    worker_setup_module: Optional[str] = None
 
     @classmethod
     def from_yaml(cls, path: str, schema: Schema,
@@ -93,41 +107,77 @@ class IngestionJobLauncher:
                 f"no input files under {self.spec.input_dir_uri}")
         out_fs = get_fs(self.spec.output_dir_uri)
         out_fs.mkdir(self.spec.output_dir_uri)
-        results = []
-        for seq, path in enumerate(files):
-            results.append(self._generate_one(path, seq))
-        return results
+        fw = self.spec.execution_framework
+        if fw == "multiprocess" and len(files) > 1:
+            import concurrent.futures as cf
+            import multiprocessing
+            import os
+
+            workers = self.spec.parallelism or min(len(files),
+                                                   os.cpu_count() or 1)
+            # spawn, explicitly: fork from a threaded parent can deadlock,
+            # and spawn makes worker state deterministic everywhere — any
+            # process-global registrations come back via worker_setup_module
+            with cf.ProcessPoolExecutor(
+                    max_workers=workers,
+                    mp_context=multiprocessing.get_context("spawn"),
+                    initializer=_worker_init,
+                    initargs=(self.spec.worker_setup_module,)) as pool:
+                futs = [pool.submit(_generate_one_job, self.spec, path, seq)
+                        for seq, path in enumerate(files)]
+                # fail-fast like the reference runners: one failed file
+                # task fails the job (results keep input order)
+                return [f.result() for f in futs]
+        if fw not in ("standalone", "multiprocess"):
+            raise ValueError(
+                f"unknown executionFrameworkSpec {fw!r} "
+                "(standalone | multiprocess)")
+        return [self._generate_one(path, seq)
+                for seq, path in enumerate(files)]
 
     def _generate_one(self, path: str, seq: int) -> SegmentGenerationResult:
-        spec = self.spec
-        prefix = spec.segment_name_prefix or spec.table_config.table_name
-        segment_name = f"{prefix}_{seq}"
-        reader = create_record_reader(path, spec.input_format,
-                                      spec.record_reader_config)
-        pipeline = build_transform_pipeline(spec.schema, spec.table_config)
-        rows = []
-        filtered = 0
-        for raw in reader:
-            row = pipeline.transform(dict(raw))
-            if row is None:
-                filtered += 1
-                continue
-            rows.append(row)
-        with tempfile.TemporaryDirectory() as tmp:
-            local = Path(tmp) / segment_name
-            SegmentBuilder(spec.schema, spec.table_config, segment_name) \
-                .build_from_rows(rows, local)
-            out_uri = f"{spec.output_dir_uri.rstrip('/')}/{segment_name}"
-            fs = get_fs(spec.output_dir_uri)
-            if spec.create_tar:
-                tar_path = Path(tmp) / f"{segment_name}.tar.gz"
-                with tarfile.open(tar_path, "w:gz") as tf:
-                    tf.add(local, arcname=segment_name)
-                out_uri += ".tar.gz"
-                fs.copy_from_local(str(tar_path), out_uri)
-            else:
-                fs.copy_from_local(str(local), out_uri)
-        return SegmentGenerationResult(segment_name, out_uri, len(rows), filtered)
+        return _generate_one_job(self.spec, path, seq)
+
+
+def _worker_init(setup_module: Optional[str]) -> None:
+    if setup_module:
+        import importlib
+
+        importlib.import_module(setup_module)
+
+
+def _generate_one_job(spec: SegmentGenerationJobSpec, path: str,
+                      seq: int) -> SegmentGenerationResult:
+    """File → segment → push, self-contained so worker processes can run it
+    (reference: SegmentGenerationTaskRunner inside each Spark executor)."""
+    prefix = spec.segment_name_prefix or spec.table_config.table_name
+    segment_name = f"{prefix}_{seq}"
+    reader = create_record_reader(path, spec.input_format,
+                                  spec.record_reader_config)
+    pipeline = build_transform_pipeline(spec.schema, spec.table_config)
+    rows = []
+    filtered = 0
+    for raw in reader:
+        row = pipeline.transform(dict(raw))
+        if row is None:
+            filtered += 1
+            continue
+        rows.append(row)
+    with tempfile.TemporaryDirectory() as tmp:
+        local = Path(tmp) / segment_name
+        SegmentBuilder(spec.schema, spec.table_config, segment_name) \
+            .build_from_rows(rows, local)
+        out_uri = f"{spec.output_dir_uri.rstrip('/')}/{segment_name}"
+        fs = get_fs(spec.output_dir_uri)
+        if spec.create_tar:
+            tar_path = Path(tmp) / f"{segment_name}.tar.gz"
+            with tarfile.open(tar_path, "w:gz") as tf:
+                tf.add(local, arcname=segment_name)
+            out_uri += ".tar.gz"
+            fs.copy_from_local(str(tar_path), out_uri)
+        else:
+            fs.copy_from_local(str(local), out_uri)
+    return SegmentGenerationResult(segment_name, out_uri, len(rows), filtered)
 
 
 def push_segments_to_cluster(results: list[SegmentGenerationResult],

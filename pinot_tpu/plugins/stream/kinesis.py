@@ -89,8 +89,18 @@ class _Boto3Adapter:
 
     def get_records(self, stream, shard_id, checkpoint, limit):
         it = self._iterator(stream, shard_id, checkpoint)
-        resp = self._c.get_records(ShardIterator=it,
-                                   Limit=min(limit, self._max))
+        try:
+            resp = self._c.get_records(ShardIterator=it,
+                                       Limit=min(limit, self._max))
+        except Exception:
+            # shard iterators expire after ~5 minutes: a consumer idle (or
+            # slow) between polls must re-mint from its checkpoint, not
+            # kill the partition. One retry with a fresh iterator; a
+            # second failure is a real error.
+            self._iters.pop((stream, shard_id), None)
+            it = self._iterator(stream, shard_id, checkpoint)
+            resp = self._c.get_records(ShardIterator=it,
+                                       Limit=min(limit, self._max))
         out = []
         for r in resp.get("Records", []):
             ts = r.get("ApproximateArrivalTimestamp")

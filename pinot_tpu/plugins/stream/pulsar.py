@@ -23,9 +23,14 @@ library is OPTIONAL behind ``client_factory``; tests inject a fake with
 the adapter surface:
 
     partition_count(topic) -> int      (0 → non-partitioned, treated as 1)
-    read(topic, partition, from_packed:int, timeout_ms)
+    open_reader(topic, partition, from_packed:int) -> handle
+        (from_packed follows the sentinel model above; inclusive start.
+         The handle PERSISTS across polls — a reader opened at LATEST must
+         see messages published between polls, which a fresh per-poll
+         reader at MessageId.latest would silently skip forever)
+    read_batch(handle, max_records, timeout_ms)
         -> [(packed:int, key:bytes|None, value:bytes, ts_ms:int|None), ...]
-           (from_packed follows the sentinel model above; inclusive start)
+    close_reader(handle)
     latest(topic, partition) -> int    (1 when idle)
     close()
 
@@ -77,11 +82,12 @@ def unpack_message_id(packed: int) -> tuple[int, int, int]:
 class _PulsarClientAdapter:
     """Adapts the pulsar-client library to the adapter surface above."""
 
-    def __init__(self, service_url: str):
+    def __init__(self, service_url: str, max_records: int = 1000):
         import pulsar  # type: ignore[import-not-found]
 
         self._pulsar = pulsar
         self._client = pulsar.Client(service_url)
+        self.max_records = max_records
 
     def partition_count(self, topic) -> int:
         parts = self._client.get_topic_partitions(topic)
@@ -102,32 +108,43 @@ class _PulsarClientAdapter:
         return self._pulsar.MessageId(max(partition, -1), ledger, entry,
                                       batch), True
 
-    def read(self, topic, partition, from_packed, timeout_ms):
+    def open_reader(self, topic, partition, from_packed):
         start, inclusive = self._start_id(partition, from_packed)
         reader = self._client.create_reader(
             self._reader_topic(topic, partition), start_message_id=start,
             start_message_id_inclusive=inclusive)
+        return {"reader": reader, "skip_below": from_packed if inclusive
+                else None}
+
+    def read_batch(self, handle, max_records, timeout_ms):
+        reader = handle["reader"]
         out = []
-        try:
-            while reader.has_message_available():
-                msg = reader.read_next(timeout_millis=timeout_ms)
-                mid = msg.message_id()
-                packed = pack_message_id(mid.ledger_id(), mid.entry_id(),
-                                         max(0, mid.batch_index()))
-                if inclusive and packed < from_packed:
-                    continue  # replayed prefix of a batch
-                out.append((packed,
-                            (msg.partition_key() or "").encode() or None,
-                            msg.data(), msg.publish_timestamp()))
-        finally:
-            reader.close()
+        while len(out) < min(max_records, self.max_records) \
+                and reader.has_message_available():
+            msg = reader.read_next(timeout_millis=timeout_ms)
+            mid = msg.message_id()
+            packed = pack_message_id(mid.ledger_id(), mid.entry_id(),
+                                     max(0, mid.batch_index()))
+            skip = handle["skip_below"]
+            if skip is not None and packed < skip:
+                continue  # replayed prefix of a batch
+            out.append((packed,
+                        (msg.partition_key() or "").encode() or None,
+                        msg.data(), msg.publish_timestamp()))
         return out
+
+    def close_reader(self, handle):
+        handle["reader"].close()
 
     def latest(self, topic, partition) -> int:
         # a reader seeded at MessageId.latest sees only the tail; an idle
         # partition therefore reports the LATEST sentinel — never a replay
         # of retained history
-        recs = self.read(topic, partition, LATEST, 100)
+        handle = self.open_reader(topic, partition, LATEST)
+        try:
+            recs = self.read_batch(handle, 100, 1000)
+        finally:
+            self.close_reader(handle)
         return recs[-1][0] + 1 if recs else LATEST
 
     def close(self):
@@ -142,27 +159,47 @@ def _default_client_factory(config):
             "streamType 'pulsar' needs the pulsar-client package (or inject "
             "PulsarStreamConsumerFactory.client_factory)") from e
     url = config.props.get(_PROP + "serviceUrl", "pulsar://localhost:6650")
-    return _PulsarClientAdapter(url)
+    max_records = int(config.props.get(_PROP + "maxRecordsToFetch", 1000))
+    return _PulsarClientAdapter(url, max_records)
 
 
 class PulsarPartitionConsumer(PartitionGroupConsumer):
-    def __init__(self, client, topic: str, partition: int):
+    """Holds ONE persistent reader across polls: required for LATEST
+    starts (a fresh per-poll reader at MessageId.latest would lose every
+    message published between polls) and avoids a create-reader broker
+    round trip per poll. Reopens only when the engine rewinds/seeks."""
+
+    def __init__(self, client, topic: str, partition: int,
+                 max_records: int = 1000):
         self._client = client
         self._topic = topic
         self._partition = partition
+        self._max_records = max_records
+        self._handle = None
+        self._position: int | None = None  # checkpoint the reader sits at
 
     def fetch_messages(self, start_offset: LongMsgOffset,
                        timeout_ms: int) -> MessageBatch:
-        recs = self._client.read(self._topic, self._partition,
-                                 start_offset.offset, timeout_ms)
+        start = start_offset.offset
+        if self._handle is None or self._position != start:
+            if self._handle is not None:
+                self._client.close_reader(self._handle)
+            self._handle = self._client.open_reader(
+                self._topic, self._partition, start)
+        recs = self._client.read_batch(self._handle, self._max_records,
+                                       timeout_ms)
         messages = [
             StreamMessage(value=value, key=key,
                           offset=LongMsgOffset(packed), timestamp_ms=ts)
             for packed, key, value, ts in recs]
-        next_off = recs[-1][0] + 1 if recs else start_offset.offset
+        next_off = recs[-1][0] + 1 if recs else start
+        self._position = next_off
         return MessageBatch(messages, LongMsgOffset(next_off))
 
     def close(self) -> None:
+        if self._handle is not None:
+            self._client.close_reader(self._handle)
+            self._handle = None
         self._client.close()
 
 
@@ -170,20 +207,18 @@ class PulsarMetadataProvider(StreamMetadataProvider):
     def __init__(self, client, topic: str):
         self._client = client
         self._topic = topic
+        # partitioned-ness is immutable: resolve once, not per probe
+        self._raw_count = client.partition_count(topic)
 
     def partition_count(self) -> int:
-        return max(1, self._client.partition_count(self._topic))
+        return max(1, self._raw_count)
 
     def fetch_earliest_offset(self, partition: int) -> LongMsgOffset:
         return LongMsgOffset(EARLIEST)
 
     def fetch_latest_offset(self, partition: int) -> LongMsgOffset:
-        return LongMsgOffset(self._client.latest(
-            self._topic, self._effective_partition(partition)))
-
-    def _effective_partition(self, partition: int) -> int:
-        return -1 if self._client.partition_count(self._topic) == 0 \
-            else partition
+        eff = -1 if self._raw_count == 0 else partition
+        return LongMsgOffset(self._client.latest(self._topic, eff))
 
     def close(self) -> None:
         self._client.close()

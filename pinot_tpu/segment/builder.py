@@ -251,6 +251,13 @@ class SegmentBuilder:
                                      float(cfg.get("resolutionDeg", 0.5)))
             add(f"{lat_col}__{lng_col}", serialize_geo_index(geo))
 
+        if getattr(idx, "custom_index_configs", None):
+            from .index_spi import build_custom_indexes
+
+            for name, arr in build_custom_indexes(columns,
+                                                  idx.custom_index_configs):
+                writer.add_buffer(name, np.ascontiguousarray(arr))
+
     def _replace_nulls(self, values, spec) -> tuple[list, np.ndarray]:
         if isinstance(values, np.ndarray) and values.dtype != object:
             # numpy fast path: fixed-width arrays cannot hold None

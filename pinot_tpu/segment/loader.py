@@ -171,6 +171,20 @@ class ImmutableSegment:
         off, size = entry
         return self._data[off : off + size]
 
+    def buffer_array(self, name: str) -> np.ndarray:
+        """Raw uint8 view of a stored buffer (custom index SPI surface)."""
+        return self._buffer(name)
+
+    def get_custom_index(self, column: str, type_name: str):
+        """Lazily deserialize a custom index built through the index SPI
+        (segment/index_spi.py); None if this segment carries none."""
+        key = ("custom", column, type_name)
+        if key not in self._indexes:
+            from .index_spi import load_custom_index
+
+            self._indexes[key] = load_custom_index(self, column, type_name)
+        return self._indexes[key]
+
     def get_dictionary(self, column: str) -> Dictionary:
         if column not in self._dictionaries:
             m = self.column_metadata(column)

@@ -45,6 +45,10 @@ class IndexingConfig:
     # (reference FieldConfig.compressionCodec / ChunkCompressionType:
     # PASS_THROUGH | LZ4 | ZSTANDARD | GZIP | SNAPPY)
     compression_configs: dict[str, str] = field(default_factory=dict)
+    # column -> {"type": <registered index type name>, ...config} for
+    # custom index types registered through segment/index_spi.py
+    # (reference: IndexType registration in StandardIndexes/IndexService)
+    custom_index_configs: dict[str, dict] = field(default_factory=dict)
 
 
 @dataclass

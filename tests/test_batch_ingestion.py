@@ -227,3 +227,38 @@ def test_batch_push_to_cluster_with_tar(tmp_path):
         assert r.result_table.rows[0] == [4, 52.75]
     finally:
         server.stop()
+
+
+def test_multiprocess_runner_matches_standalone(tmp_path):
+    """The Spark/Hadoop-runner analogue: same outputs as standalone, built
+    by worker processes (spec must survive pickling into the pool)."""
+    import csv
+
+    indir = tmp_path / "in"
+    indir.mkdir()
+    for i in range(3):
+        with open(indir / f"part{i}.csv", "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=["city", "day", "fare"])
+            w.writeheader()
+            for r in ROWS:
+                w.writerow({**r, "day": r["day"] + i})
+    spec = SegmentGenerationJobSpec(
+        input_dir_uri=str(indir), output_dir_uri=str(tmp_path / "out"),
+        schema=SCHEMA, table_config=TableConfig(table_name="trips"),
+        execution_framework="multiprocess", parallelism=2)
+    results = IngestionJobLauncher(spec).run()
+    assert len(results) == 3
+    assert [r.num_docs for r in results] == [4, 4, 4]
+    for r in results:
+        seg = load_segment(r.output_uri)
+        assert seg.num_docs == 4
+
+
+def test_unknown_execution_framework_rejected(tmp_path):
+    (tmp_path / "a.csv").write_text("city,day,fare\nsf,1,2.0\n")
+    spec = SegmentGenerationJobSpec(
+        input_dir_uri=str(tmp_path), output_dir_uri=str(tmp_path / "out"),
+        schema=SCHEMA, table_config=TableConfig(table_name="trips"),
+        execution_framework="flink")
+    with pytest.raises(ValueError, match="executionFramework"):
+        IngestionJobLauncher(spec).run()

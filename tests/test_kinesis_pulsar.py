@@ -106,7 +106,9 @@ def test_kinesis_latest_sentinel_skips_history(kinesis):
 
 class FakePulsar:
     """Partitioned topic 'events' (2 partitions) and non-partitioned topic
-    'solo' (partition_count 0, read with partition=-1)."""
+    'solo' (partition_count 0, read with partition=-1). Readers are
+    persistent handles with a cursor, like real Pulsar readers: a handle
+    opened at LATEST sits at the tail and sees later publishes."""
 
     def __init__(self):
         ids = [pack_message_id(5, 0), pack_message_id(5, 1),
@@ -118,16 +120,33 @@ class FakePulsar:
             ("events", 1): [],
             ("solo", -1): [(ids[0], None, b"s", 1)],
         }
+        self.open_handles = 0
+
+    def publish(self, topic, partition, packed, value):
+        self.topics[(topic, partition)].append((packed, None, value, 99))
 
     def partition_count(self, topic):
         parts = [p for (t, p) in self.topics if t == topic and p >= 0]
         return len(parts)
 
-    def read(self, topic, partition, from_packed, timeout_ms):
+    def open_reader(self, topic, partition, from_packed):
         recs = self.topics[(topic, partition)]
         if from_packed == P_LATEST:
-            return []
-        return [r for r in recs if r[0] >= from_packed]
+            cursor = recs[-1][0] + 1 if recs else 0  # tail: only new msgs
+        else:
+            cursor = from_packed
+        self.open_handles += 1
+        return {"key": (topic, partition), "cursor": cursor}
+
+    def read_batch(self, handle, max_records, timeout_ms):
+        recs = [r for r in self.topics[handle["key"]]
+                if r[0] >= handle["cursor"]][:max_records]
+        if recs:
+            handle["cursor"] = recs[-1][0] + 1
+        return recs
+
+    def close_reader(self, handle):
+        self.open_handles -= 1
 
     def latest(self, topic, partition):
         recs = self.topics[(topic, partition)]
@@ -175,6 +194,23 @@ def test_pulsar_resolves_and_fetches(pulsar):
     assert meta.fetch_latest_offset(1) == LongMsgOffset(P_LATEST)
 
 
+def test_pulsar_latest_start_sees_later_publishes(pulsar):
+    """A consumer seeded at LATEST must receive messages published AFTER
+    it starts — the persistent-reader property a fresh per-poll reader at
+    MessageId.latest silently loses."""
+    cfg = StreamConfig(stream_type="pulsar", topic_name="events")
+    consumer = get_stream_consumer_factory(cfg).create_partition_consumer(0)
+    b0 = consumer.fetch_messages(LongMsgOffset(P_LATEST), timeout_ms=10)
+    assert b0.messages == []
+    late_id = pack_message_id(7, 0)
+    pulsar.publish("events", 0, late_id, b"late")
+    b1 = consumer.fetch_messages(b0.offset_of_next_batch, timeout_ms=10)
+    assert [m.value for m in b1.messages] == [b"late"]
+    assert b1.offset_of_next_batch == LongMsgOffset(late_id + 1)
+    # the reader persisted across both polls (no reopen churn)
+    assert pulsar.open_handles == 1
+
+
 def test_pulsar_non_partitioned_topic(pulsar):
     cfg = StreamConfig(stream_type="pulsar", topic_name="solo")
     factory = get_stream_consumer_factory(cfg)
@@ -191,3 +227,33 @@ def test_missing_client_libraries_error_clearly():
         factory = get_stream_consumer_factory(cfg)
         with pytest.raises(ImportError, match=err):
             factory.create_metadata_provider()
+
+
+def test_kinesis_boto3_adapter_recovers_expired_iterator():
+    """An expired cached shard iterator re-mints from the checkpoint
+    instead of killing the consuming partition."""
+    from pinot_tpu.plugins.stream.kinesis import _Boto3Adapter
+
+    class FakeBoto:
+        def __init__(self):
+            self.minted = 0
+
+        def get_shard_iterator(self, **kw):
+            self.minted += 1
+            assert kw["ShardIteratorType"] == "AFTER_SEQUENCE_NUMBER"
+            assert kw["StartingSequenceNumber"] == "41"
+            return {"ShardIterator": f"it{self.minted}"}
+
+        def get_records(self, ShardIterator, Limit):
+            if ShardIterator == "stale":
+                raise RuntimeError("ExpiredIteratorException")
+            return {"Records": [{"SequenceNumber": "42", "Data": b"v",
+                                 "PartitionKey": "k"}],
+                    "NextShardIterator": "it-next"}
+
+    adapter = _Boto3Adapter(FakeBoto(), 1000)
+    adapter._iters[("s", "sh")] = (42, "stale")  # checkpoint 42 → stale iter
+    recs = adapter.get_records("s", "sh", 42, 10)
+    assert [r[0] for r in recs] == [42]
+    # cache advanced to the fresh NextShardIterator for checkpoint 43
+    assert adapter._iters[("s", "sh")] == (43, "it-next")
