@@ -216,6 +216,40 @@ def test_sparse_unsupported_agg_falls_back(env):
     assert _rows(resp) == _rows(host_resp)
 
 
+def test_sparse_float_sum_error_stays_local_to_group(tmp_path):
+    """SUM(DOUBLE) rounding must scale with the GROUP's magnitude, not the
+    segment's running total: at values ~1e12 over 20K rows the global
+    prefix reaches ~2e16 (ulp ≈ 4.0) — a prefix-diff implementation leaks
+    that ulp into every small group, while the segmented tree scan keeps
+    error near ulp(group sum) ≈ 1e-3."""
+    rng = np.random.default_rng(5)
+    n = 20000
+    data = {
+        "uid": rng.integers(0, HIGH_CARD, n).astype(np.int32),
+        "code": rng.integers(0, 2000, n).astype(np.int32),
+        "tag": np.asarray(["a"] * n, dtype=object),
+        "amount": np.zeros(n, np.int32),
+        "score": 1e12 + np.round(rng.random(n), 3),
+    }
+    SegmentBuilder(SCHEMA, segment_name="prec").build(data, tmp_path / "p")
+    seg = load_segment(tmp_path / "p")
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(SCHEMA, [seg])
+    q = parse_sql("SELECT uid, code, SUM(score) FROM hc "
+                  "GROUP BY uid, code LIMIT 100000")
+    assert SegmentPlanner(q, seg).plan().program.mode == "group_by_sparse"
+    resp = tpu.execute_sql(
+        "SELECT uid, code, SUM(score) FROM hc GROUP BY uid, code LIMIT 100000")
+    assert not resp.exceptions, resp.exceptions
+    want = {}
+    for u, c, s in zip(data["uid"], data["code"], data["score"]):
+        want[(int(u), int(c))] = want.get((int(u), int(c)), 0.0) + s
+    got = {(int(r[0]), int(r[1])): float(r[2]) for r in resp.result_table.rows}
+    assert got.keys() == want.keys()
+    worst = max(abs(got[k] - want[k]) for k in want)
+    assert worst < 1e-2, f"group sum error {worst} ~ global-total ulp leak"
+
+
 def test_trim_still_counts_scanned_docs(env):
     tpu, host, conn, segs = env
     full = tpu.execute_sql(
