@@ -18,6 +18,7 @@ from typing import Optional
 from ..query.context import QueryContext
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
 from ..segment.loader import ImmutableSegment
+from ..spi.partition import get_partition_function
 
 
 class SegmentPrunerService:
@@ -51,6 +52,8 @@ class SegmentPrunerService:
             v = p.values[0]
             if _outside(v, lo, hi):
                 return False
+            if _partition_excludes(m, v):
+                return False
             bf = seg.get_bloom_filter(col)
             if bf is not None and not bf.might_contain(v):
                 return False
@@ -59,6 +62,8 @@ class SegmentPrunerService:
             bf = seg.get_bloom_filter(col)
             for v in p.values:
                 if _outside(v, lo, hi):
+                    continue
+                if _partition_excludes(m, v):
                     continue
                 if bf is not None and not bf.might_contain(v):
                     continue
@@ -78,6 +83,19 @@ class SegmentPrunerService:
                 return True  # incomparable types: keep
             return True
         return True
+
+
+def _partition_excludes(m, v) -> bool:
+    """True when stamped partition metadata PROVES the value's partition is
+    absent from this segment (reference ColumnValueSegmentPruner's
+    partition-metadata branch)."""
+    if not m.partition_function or m.partitions is None or m.num_partitions is None:
+        return False
+    try:
+        fn = get_partition_function(m.partition_function, m.num_partitions)
+        return fn.partition(v) not in m.partitions
+    except (ValueError, TypeError):
+        return False  # unknown function / unpartitionable value: keep
 
 
 def _outside(v, lo, hi) -> bool:

@@ -42,7 +42,7 @@ from .executor import _block_to_result
 from .fragmenter import Stage, explain_stages, fragment
 from .logical import LogicalPlanner, prune_columns
 from .optimizer import push_filters
-from .mailbox import Block, concat_blocks, hash_partition
+from .mailbox import Block, concat_blocks, hash_partition, table_partition
 from .operators import op_filter
 from .parser import parse_relational
 from .plan_serde import expr_from_json, expr_to_json, stage_from_json, stage_to_json
@@ -112,8 +112,15 @@ class RoutedMailbox:
             "partition": partition, "block": block})
 
     def send_partitioned(self, from_stage: int, to_stage: int, block: Block,
-                         dist: str, keys: list[str], num_partitions: int) -> None:
-        if dist == "hash" and keys and num_partitions > 1:
+                         dist: str, keys: list[str], num_partitions: int,
+                         pfunc: Optional[str] = None) -> None:
+        if dist == "partitioned" and keys and num_partitions > 1:
+            # colocated join: route by the TABLE partition function — a leaf
+            # whose segments are all one partition sends one non-empty box
+            for p, b in enumerate(table_partition(
+                    block, keys[0], pfunc, num_partitions)):
+                self.send(from_stage, to_stage, p, b)
+        elif dist == "hash" and keys and num_partitions > 1:
             for p, b in enumerate(hash_partition(block, keys, num_partitions)):
                 self.send(from_stage, to_stage, p, b)
         elif dist == "broadcast":
@@ -199,7 +206,7 @@ class MseWorkerService:
             block = runner._exec(stage.root, stage, worker)
         mailbox.send_partitioned(stage.stage_id, stage.parent_stage, block,
                                  stage.send_dist, stage.send_keys,
-                                 parent_workers)
+                                 parent_workers, pfunc=stage.send_pfunc)
         runner.stats["join_overflow"] = pop_join_overflow()
         return runner.stats
 
@@ -334,6 +341,41 @@ class DistributedMseDispatcher:
                 out[raw] = Schema.from_json(sj).column_names()
         return out
 
+    def _partition_catalog(self) -> dict[str, dict]:
+        """table → {column: (pfunc, n_partitions)} from the DECLARED
+        segmentPartitionConfig of the stored table configs (reference:
+        the broker's TablePartitionInfo). A hybrid table only qualifies
+        when both halves declare identical partitioning."""
+        from ..cluster.controller import table_name_with_type
+
+        def column_partition_map(cfg: dict) -> dict:
+            # canonical location is tableIndexConfig.segmentPartitionConfig
+            # (TableConfig.to_json / from_json); accept the top level too for
+            # hand-rolled cluster configs
+            spc = (cfg.get("tableIndexConfig") or {}).get(
+                "segmentPartitionConfig") or cfg.get(
+                "segmentPartitionConfig") or {}
+            return spc.get("columnPartitionMap") or {}
+
+        out: dict[str, dict] = {}
+        for raw in self.store.children("/SCHEMAS"):
+            maps = []
+            for ttype in ("OFFLINE", "REALTIME"):
+                cfg = self.store.get(
+                    f"/CONFIGS/TABLE/{table_name_with_type(raw, ttype)}")
+                if cfg is not None:
+                    maps.append(column_partition_map(cfg))
+            if not maps or (len(maps) == 2 and maps[0] != maps[1]):
+                continue
+            per_col = {}
+            for col, v in maps[0].items():
+                if v.get("functionName") and v.get("numPartitions"):
+                    per_col[col] = (str(v["functionName"]).lower(),
+                                    int(v["numPartitions"]))
+            if per_col:
+                out[raw] = per_col
+        return out
+
     def _server_instances(self) -> list[str]:
         out = []
         for inst in sorted(self.store.children("/LIVEINSTANCES")):
@@ -414,7 +456,8 @@ class DistributedMseDispatcher:
         from ..engine.results import DataSchema, ResultTable
 
         query = parse_relational(sql)
-        planner = LogicalPlanner(query, self._catalog())
+        planner = LogicalPlanner(query, self._catalog(),
+                                 partition_catalog=self._partition_catalog)
         plan = planner.plan()
         plan = push_filters(plan)
         prune_columns(plan)

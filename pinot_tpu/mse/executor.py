@@ -37,6 +37,33 @@ class MultistageExecutor:
         return {name: t.schema.column_names()
                 for name, t in self.qe.tables.items()}
 
+    def _partition_catalog(self) -> dict[str, dict]:
+        """table → {column: (pfunc, n_partitions)} where EVERY segment is
+        stamped with the same function/count (reference: the broker's
+        TablePartitionInfo is computed the same way — from per-segment
+        ColumnPartitionMetadata, invalidated on any inconsistent segment)."""
+        out: dict[str, dict] = {}
+        for name, t in self.qe.tables.items():
+            segs = list(t.segments)
+            if not segs:
+                continue
+            per_col: dict[str, tuple] = {}
+            for col in t.schema.column_names():
+                infos = set()
+                for seg in segs:
+                    meta = getattr(seg, "metadata", None)
+                    m = meta.columns.get(col) if meta is not None else None
+                    if m is None or not getattr(m, "partition_function", None) \
+                            or not getattr(m, "num_partitions", None):
+                        infos = None
+                        break
+                    infos.add((m.partition_function, m.num_partitions))
+                if infos and len(infos) == 1:
+                    per_col[col] = next(iter(infos))
+            if per_col:
+                out[name] = per_col
+        return out
+
     def _read_table(self, table: str, columns: list[str]) -> dict[str, np.ndarray]:
         t = self.qe.tables.get(table)
         if t is None:
@@ -66,7 +93,8 @@ class MultistageExecutor:
         t0 = time.perf_counter()
         try:
             query = parse_relational(sql)
-            planner = LogicalPlanner(query, self._catalog())
+            planner = LogicalPlanner(query, self._catalog(),
+                                     partition_catalog=self._partition_catalog)
             plan = planner.plan()
             plan = push_filters(plan)
             prune_columns(plan)

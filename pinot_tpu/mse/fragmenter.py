@@ -23,6 +23,8 @@ class MailboxReceiveNode(PlanNode):
     from_stage: int = -1
     dist: str = "singleton"
     keys: list[str] = field(default_factory=list)
+    pfunc: Optional[str] = None       # partitioned dist only
+    n_partitions: Optional[int] = None
 
     def describe(self) -> str:
         return f"MailboxReceive(fromStage={self.from_stage}, dist={self.dist}, keys={self.keys})"
@@ -37,6 +39,9 @@ class Stage:
     parent_stage: Optional[int]  # None for stage 0
     # stages whose output this stage consumes, in receive order
     child_stages: list[int] = field(default_factory=list)
+    # partitioned send only; the fan-out COUNT comes from the receive side
+    # (MailboxReceiveNode.n_partitions → parent worker count)
+    send_pfunc: Optional[str] = None
 
     @property
     def is_leaf(self) -> bool:
@@ -68,7 +73,8 @@ def fragment(root: ExchangeNode) -> list[Stage]:
     def make_stage(exchange: ExchangeNode, parent_id: int) -> int:
         sid = len(stages)
         stage = Stage(sid, None, send_dist=exchange.dist,
-                      send_keys=list(exchange.keys), parent_stage=parent_id)
+                      send_keys=list(exchange.keys), parent_stage=parent_id,
+                      send_pfunc=exchange.pfunc)
         stages.append(stage)
         stage.root = rewrite(exchange.inputs[0], sid)
         return sid
@@ -78,7 +84,9 @@ def fragment(root: ExchangeNode) -> list[Stage]:
             child_id = make_stage(node, owner_stage)
             stages[owner_stage].child_stages.append(child_id)
             return MailboxReceiveNode([], list(node.schema), from_stage=child_id,
-                                      dist=node.dist, keys=list(node.keys))
+                                      dist=node.dist, keys=list(node.keys),
+                                      pfunc=node.pfunc,
+                                      n_partitions=node.n_partitions)
         node.inputs = [rewrite(i, owner_stage) for i in node.inputs]
         return node
 

@@ -158,12 +158,26 @@ class SetOpNode(PlanNode):
 @dataclass
 class ExchangeNode(PlanNode):
     """Distribution boundary → becomes MailboxSend/Receive at fragmenting
-    (reference: PinotLogicalExchange → MailboxSendNode/MailboxReceiveNode)."""
+    (reference: PinotLogicalExchange → MailboxSendNode/MailboxReceiveNode).
 
-    dist: str = "singleton"  # hash | singleton | broadcast
+    dist="partitioned" is the colocated-join exchange: both join sides are
+    table-partitioned on a join key by the SAME function/count, so rows
+    route by that table partition function instead of a generic row hash —
+    worker p of the join joins table partition p from each side, and a
+    distributed leaf serves partition p from its stamped segments without
+    re-hashing (reference: partition-aware exchange elision behind the
+    is_colocated_by_join_keys hint, PinotJoinToDynamicBroadcastRule's
+    sibling rule in pinot-query-planner/.../rules/)."""
+
+    dist: str = "singleton"  # hash | singleton | broadcast | partitioned
     keys: list[str] = field(default_factory=list)
+    pfunc: Optional[str] = None       # partitioned: table partition function
+    n_partitions: Optional[int] = None
 
     def describe(self) -> str:
+        if self.dist == "partitioned":
+            return (f"Exchange(dist=partitioned, keys={self.keys}, "
+                    f"fn={self.pfunc}, n={self.n_partitions})")
         return f"Exchange(dist={self.dist}, keys={self.keys})"
 
 
@@ -195,9 +209,15 @@ class LogicalPlanner:
     ``catalog`` maps table name → list of physical column names (the
     reference binds against ZK table schemas in Calcite's validator)."""
 
-    def __init__(self, query: RelationalQuery, catalog: dict[str, list[str]]):
+    def __init__(self, query: RelationalQuery, catalog: dict[str, list[str]],
+                 partition_catalog=None):
         self.query = query
         self.catalog = catalog
+        # table → {column: (partition function name, num_partitions)} — or a
+        # zero-arg callable producing it, resolved only when a join asks
+        # (metadata sweeps shouldn't tax joinless queries); drives
+        # colocated joins
+        self._partition_catalog = partition_catalog
         self._counter = 0
 
     def plan(self) -> PlanNode:
@@ -368,14 +388,61 @@ class LogicalPlanner:
         else:
             schema = combined
         if lkeys:
-            lx = ExchangeNode([left], left.schema, dist="hash", keys=lkeys)
-            rx = ExchangeNode([right], right.schema, dist="hash", keys=rkeys)
+            colo = self._colocation(left, right, lkeys, rkeys)
+            if colo:
+                lk, rk, fn, nparts = colo
+                lx = ExchangeNode([left], left.schema, dist="partitioned",
+                                  keys=[lk], pfunc=fn, n_partitions=nparts)
+                rx = ExchangeNode([right], right.schema, dist="partitioned",
+                                  keys=[rk], pfunc=fn, n_partitions=nparts)
+            else:
+                lx = ExchangeNode([left], left.schema, dist="hash", keys=lkeys)
+                rx = ExchangeNode([right], right.schema, dist="hash", keys=rkeys)
         else:
             # non-equi / cross join: broadcast the right side
             lx = ExchangeNode([left], left.schema, dist="singleton")
             rx = ExchangeNode([right], right.schema, dist="broadcast")
         return JoinNode([lx, rx], schema, join_type=join_type,
                         left_keys=lkeys, right_keys=rkeys, residual=residual)
+
+    # -- colocated join detection ------------------------------------------
+    def _colocation(self, left: PlanNode, right: PlanNode,
+                    lkeys: list[str], rkeys: list[str]):
+        """If some equi-key pair is the partition column of BOTH sides'
+        tables with the same function + count, route by that partition
+        function: rows equal on ALL join keys are equal on the partition
+        key, so matching rows meet in the same partition-indexed worker."""
+        if self._partition_catalog is None:
+            return None
+        if callable(self._partition_catalog):
+            self._partition_catalog = self._partition_catalog() or {}
+        linfo = self._partition_info(left)
+        rinfo = self._partition_info(right)
+        for lk, rk in zip(lkeys, rkeys):
+            li, ri = linfo.get(lk), rinfo.get(rk)
+            if li is not None and li == ri:
+                return lk, rk, li[0], li[1]
+        return None
+
+    def _partition_info(self, node: PlanNode) -> dict[str, tuple]:
+        """qualified column name → (pfunc, n_partitions) for columns whose
+        table partitioning SURVIVES to this node's output: propagates
+        through Filter (row subset) and identifier Projects (rename); any
+        other node breaks the guarantee."""
+        if isinstance(node, TableScanNode):
+            per_col = self._partition_catalog.get(node.table) or {}
+            return {q: per_col[s] for q, s in
+                    zip(node.schema, node.source_columns) if s in per_col}
+        if isinstance(node, FilterNode):
+            return self._partition_info(node.inputs[0])
+        if isinstance(node, ProjectNode):
+            inner = self._partition_info(node.inputs[0])
+            out = {}
+            for q, e in zip(node.schema, node.exprs):
+                if e.is_identifier and e.identifier in inner:
+                    out[q] = inner[e.identifier]
+            return out
+        return {}
 
     def _equi_pair(self, conj: EC, lschema: list[str], rschema: list[str]):
         """a.x = b.y with sides living in different inputs → (lcol, rcol)."""

@@ -22,6 +22,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..spi.partition import get_partition_function
+
 Block = dict  # column name → np.ndarray (equal lengths)
 
 
@@ -83,6 +85,16 @@ def hash_partition(block: Block, keys: list[str], num_partitions: int) -> list[B
     return [take_block(block, part == p) for p in range(num_partitions)]
 
 
+def table_partition(block: Block, key: str, pfunc: str,
+                    num_partitions: int) -> list[Block]:
+    """Colocated-join routing: split by the TABLE's partition function on
+    the partition key, so worker p sees exactly table partition p — the
+    same assignment the segments were stamped with at build time."""
+    fn = get_partition_function(pfunc, num_partitions)
+    part = fn.partitions_of(np.asarray(block[key]))
+    return [take_block(block, part == p) for p in range(num_partitions)]
+
+
 class MailboxService:
     """In-memory post office for one query execution."""
 
@@ -98,8 +110,13 @@ class MailboxService:
                              schema)
 
     def send_partitioned(self, from_stage: int, to_stage: int, block: Block,
-                         dist: str, keys: list[str], num_partitions: int) -> None:
-        if dist == "hash" and keys and num_partitions > 1:
+                         dist: str, keys: list[str], num_partitions: int,
+                         pfunc: Optional[str] = None) -> None:
+        if dist == "partitioned" and keys and num_partitions > 1:
+            for p, b in enumerate(table_partition(
+                    block, keys[0], pfunc, num_partitions)):
+                self.send(from_stage, to_stage, p, b)
+        elif dist == "hash" and keys and num_partitions > 1:
             for p, b in enumerate(hash_partition(block, keys, num_partitions)):
                 self.send(from_stage, to_stage, p, b)
         elif dist == "broadcast":

@@ -127,9 +127,11 @@ def node_to_json(node: PlanNode) -> dict:
     elif isinstance(node, SetOpNode):
         d.update(kind=node.kind, all=node.all)
     elif isinstance(node, ExchangeNode):
-        d.update(dist=node.dist, keys=list(node.keys))
+        d.update(dist=node.dist, keys=list(node.keys), pfunc=node.pfunc,
+                 n_partitions=node.n_partitions)
     elif isinstance(node, MailboxReceiveNode):
-        d.update(from_stage=node.from_stage, dist=node.dist, keys=list(node.keys))
+        d.update(from_stage=node.from_stage, dist=node.dist, keys=list(node.keys),
+                 pfunc=node.pfunc, n_partitions=node.n_partitions)
     else:
         raise TypeError(f"unserializable plan node {type(node).__name__}")
     return d
@@ -172,10 +174,14 @@ def node_from_json(d: dict) -> PlanNode:
     if kind == "SetOpNode":
         return SetOpNode(inputs, schema, kind=d["kind"], all=d["all"])
     if kind == "ExchangeNode":
-        return ExchangeNode(inputs, schema, dist=d["dist"], keys=list(d["keys"]))
+        return ExchangeNode(inputs, schema, dist=d["dist"], keys=list(d["keys"]),
+                            pfunc=d.get("pfunc"),
+                            n_partitions=d.get("n_partitions"))
     if kind == "MailboxReceiveNode":
         return MailboxReceiveNode(inputs, schema, from_stage=d["from_stage"],
-                                  dist=d["dist"], keys=list(d["keys"]))
+                                  dist=d["dist"], keys=list(d["keys"]),
+                                  pfunc=d.get("pfunc"),
+                                  n_partitions=d.get("n_partitions"))
     raise ValueError(f"unknown plan node tag {kind!r}")
 
 
@@ -187,7 +193,8 @@ def stage_to_json(stage: Stage) -> dict:
             "root": node_to_json(stage.root), "send_dist": stage.send_dist,
             "send_keys": list(stage.send_keys),
             "parent_stage": stage.parent_stage,
-            "child_stages": list(stage.child_stages)}
+            "child_stages": list(stage.child_stages),
+            "send_pfunc": stage.send_pfunc}
 
 
 def stage_from_json(d: dict) -> Stage:
@@ -195,4 +202,5 @@ def stage_from_json(d: dict) -> Stage:
         raise ValueError(f"unsupported plan serde version {d.get('v')}")
     return Stage(d["stage_id"], node_from_json(d["root"]), d["send_dist"],
                  list(d["send_keys"]), d["parent_stage"],
-                 list(d["child_stages"]))
+                 list(d["child_stages"]),
+                 send_pfunc=d.get("send_pfunc"))

@@ -67,15 +67,20 @@ class StageRunner:
 
     # -- topology ----------------------------------------------------------
     def workers_of(self, stage: Stage) -> int:
-        dists = self._receive_dists(stage.root)
-        return self.parallelism if "hash" in dists else 1
+        nodes = self._receives(stage.root)
+        nparts = [n.n_partitions for n in nodes
+                  if n.dist == "partitioned" and n.n_partitions]
+        if nparts:
+            # colocated join: one worker per table partition
+            return max(nparts)
+        return self.parallelism if any(n.dist == "hash" for n in nodes) else 1
 
-    def _receive_dists(self, node: PlanNode) -> set:
-        out = set()
+    def _receives(self, node: PlanNode) -> list:
+        out = []
         if isinstance(node, MailboxReceiveNode):
-            out.add(node.dist)
+            out.append(node)
         for i in node.inputs:
-            out |= self._receive_dists(i)
+            out.extend(self._receives(i))
         return out
 
     # -- run ---------------------------------------------------------------
@@ -99,13 +104,15 @@ class StageRunner:
             self.stats["leaf_ssqe_pushdowns"] += 1
             self.mailbox.send_partitioned(
                 stage.stage_id, parent.stage_id, pushed,
-                stage.send_dist, stage.send_keys, parent_workers)
+                stage.send_dist, stage.send_keys, parent_workers,
+                pfunc=stage.send_pfunc)
             return
         for w in range(self.workers_of(stage)):
             block = self._exec(stage.root, stage, w)
             self.mailbox.send_partitioned(
                 stage.stage_id, parent.stage_id, block,
-                stage.send_dist, stage.send_keys, parent_workers)
+                stage.send_dist, stage.send_keys, parent_workers,
+                pfunc=stage.send_pfunc)
 
     # -- node execution ----------------------------------------------------
     def _exec(self, node: PlanNode, stage: Stage, worker: int) -> Block:

@@ -16,6 +16,7 @@ from typing import Iterable, Mapping, Optional, Sequence
 import numpy as np
 
 from ..spi.data_types import DataType, FieldType, Schema
+from ..spi.partition import get_partition_function
 from ..spi.table_config import TableConfig
 from . import bitpack
 from .dictionary import build_dictionary, serialize_dictionary
@@ -79,6 +80,9 @@ class SegmentBuilder:
                 meta = self._build_mv_column(writer, name, spec, values, num_docs)
             else:
                 meta = self._build_sv_column(writer, name, spec, values, num_docs, raw=name in no_dict)
+                pconf = self.table_config.indexing.segment_partition_config.get(name)
+                if pconf:
+                    self._stamp_partition(meta, pconf, values)
             col_metas[name] = meta
 
         self._build_indexes(writer, columns, col_metas)
@@ -257,6 +261,24 @@ class SegmentBuilder:
             for name, arr in build_custom_indexes(columns,
                                                   idx.custom_index_configs):
                 writer.add_buffer(name, np.ascontiguousarray(arr))
+
+    def _stamp_partition(self, meta: ColumnMetadata, pconf: dict, values) -> None:
+        """Record which partitions this segment's values fall in
+        (reference SegmentColumnarIndexCreator stamps ColumnPartitionMetadata
+        from the column's partition config). Ids are computed over the
+        DISTINCT values — a column plane's partition set equals the
+        partition set of its unique values."""
+        fn = get_partition_function(
+            pconf["functionName"], int(pconf["numPartitions"]))
+        if isinstance(values, np.ndarray) and values.dtype != object:
+            uniq = np.unique(values)
+        else:
+            uniq = sorted({v for v in values if v is not None}, key=repr)
+        parts = sorted({int(p) for p in fn.partitions_of(uniq)}) if len(uniq) else []
+        meta.partition_function = fn.name
+        meta.num_partitions = fn.num_partitions
+        meta.partitions = parts
+        meta.partition_id = parts[0] if len(parts) == 1 else None
 
     def _replace_nulls(self, values, spec) -> tuple[list, np.ndarray]:
         if isinstance(values, np.ndarray) and values.dtype != object:

@@ -45,8 +45,12 @@ class ColumnMetadata:
     has_nulls: bool = False
     total_number_of_entries: int = 0   # == num_docs for SV; total MV values for MV
     max_number_of_multi_values: int = 0
+    # partition stamping (reference ColumnPartitionMetadata: function name,
+    # numPartitions, and the SET of partition ids observed in this segment)
     partition_function: Optional[str] = None
-    partition_id: Optional[int] = None
+    partition_id: Optional[int] = None  # singleton convenience when len(partitions)==1
+    num_partitions: Optional[int] = None
+    partitions: Optional[list] = None
 
     def to_json(self) -> dict:
         d = dict(self.__dict__)

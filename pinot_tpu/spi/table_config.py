@@ -49,6 +49,10 @@ class IndexingConfig:
     # custom index types registered through segment/index_spi.py
     # (reference: IndexType registration in StandardIndexes/IndexService)
     custom_index_configs: dict[str, dict] = field(default_factory=dict)
+    # column -> {"functionName": "murmur|modulo|hashcode", "numPartitions": N}
+    # (reference SegmentPartitionConfig.columnPartitionMap) — drives builder
+    # partition stamping, partition pruning, and the MSE colocated join
+    segment_partition_config: dict[str, dict] = field(default_factory=dict)
 
 
 @dataclass
@@ -127,6 +131,8 @@ class TableConfig:
                 "textIndexColumns": self.indexing.text_index_columns,
                 "vectorIndexColumns": self.indexing.vector_index_columns,
                 "geoIndexConfigs": self.indexing.geo_index_configs,
+                "segmentPartitionConfig": {
+                    "columnPartitionMap": self.indexing.segment_partition_config},
             },
             "segmentsConfig": {
                 "timeColumnName": self.validation.time_column_name,
@@ -169,6 +175,8 @@ class TableConfig:
                 text_index_columns=idx.get("textIndexColumns") or [],
                 vector_index_columns=idx.get("vectorIndexColumns") or [],
                 geo_index_configs=idx.get("geoIndexConfigs") or [],
+                segment_partition_config=(idx.get("segmentPartitionConfig")
+                                          or {}).get("columnPartitionMap") or {},
             ),
             validation=SegmentsValidationConfig(
                 time_column_name=seg.get("timeColumnName"),

@@ -1,0 +1,154 @@
+"""Colocated join: both sides table-partitioned on the join key by the same
+function/count → the planner swaps the generic hash shuffle for a
+"partitioned" exchange routed by the TABLE's partition function, one join
+worker per table partition.
+
+Reference: partition-aware colocated joins in the MSE
+(pinot-query-planner worker assignment honoring TablePartitionInfo; the
+is_colocated_by_join_keys path), with TablePartitionInfo derived from
+per-segment ColumnPartitionMetadata.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.mse.executor import MultistageExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.partition import get_partition_function
+from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+ORDERS = Schema.build(
+    "orders", dimensions=[("cust", "INT"), ("item", "STRING")],
+    metrics=[("qty", "INT")])
+CUSTS = Schema.build(
+    "custs", dimensions=[("cid", "INT"), ("city", "STRING")], metrics=[])
+
+N_PARTS = 4
+
+
+def _pconf(col, fn="murmur", n=N_PARTS):
+    return TableConfig(table_name="t", indexing=IndexingConfig(
+        segment_partition_config={col: {"functionName": fn,
+                                        "numPartitions": n}}))
+
+
+def _build_partitioned(tmp_path, tag, schema, cols, pcol, fn="murmur",
+                       nparts=N_PARTS):
+    """One segment per partition, rows routed by the partition function —
+    the layout a partition-aware ingestion job produces."""
+    fobj = get_partition_function(fn, nparts)
+    key = np.asarray(cols[pcol])
+    part = fobj.partitions_of(key)
+    segs = []
+    for p in range(nparts):
+        idx = np.nonzero(part == p)[0]
+        sub = {c: np.asarray(v, object)[idx] if np.asarray(v).dtype.kind == "O"
+               else np.asarray(v)[idx] for c, v in cols.items()}
+        SegmentBuilder(schema, table_config=_pconf(pcol, fn, nparts),
+                       segment_name=f"{tag}_{p}").build(
+            sub, tmp_path / f"{tag}_{p}")
+        segs.append(load_segment(tmp_path / f"{tag}_{p}"))
+    return segs
+
+
+def _data(rng, n=400):
+    orders = {"cust": rng.integers(0, 60, n).astype(np.int32),
+              "item": np.asarray([f"i{x}" for x in rng.integers(0, 9, n)],
+                                 object),
+              "qty": rng.integers(1, 10, n).astype(np.int32)}
+    custs = {"cid": np.arange(50, dtype=np.int32),
+             "city": np.asarray([f"c{x % 7}" for x in range(50)], object)}
+    return orders, custs
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    d = tmp_path_factory.mktemp("colo")
+    rng = np.random.default_rng(9)
+    orders, custs = _data(rng)
+    qe = QueryExecutor(backend="host")
+    qe.add_table(ORDERS, _build_partitioned(d, "o", ORDERS, orders, "cust"))
+    qe.add_table(CUSTS, _build_partitioned(d, "c", CUSTS, custs, "cid"))
+    mse = MultistageExecutor(qe, parallelism=2)
+
+    plain = QueryExecutor(backend="host")
+    SegmentBuilder(ORDERS, segment_name="op").build(orders, d / "op")
+    SegmentBuilder(CUSTS, segment_name="cp").build(custs, d / "cp")
+    plain.add_table(ORDERS, [load_segment(d / "op")])
+    plain.add_table(CUSTS, [load_segment(d / "cp")])
+    ref = MultistageExecutor(plain, parallelism=2)
+    return mse, ref
+
+
+JOIN = ("SELECT o.item, c.city, SUM(o.qty) FROM orders o "
+        "JOIN custs c ON o.cust = c.cid GROUP BY o.item, c.city")
+
+
+def _rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return sorted(map(repr, resp.result_table.rows))
+
+
+def test_planner_picks_partitioned_exchange(env):
+    mse, ref = env
+    plan = mse.execute_sql("EXPLAIN PLAN FOR " + JOIN)
+    text = "\n".join(r[0] for r in plan.result_table.rows)
+    assert "partitioned" in text, text
+    # the unpartitioned reference tables still hash-shuffle
+    rplan = ref.execute_sql("EXPLAIN PLAN FOR " + JOIN)
+    rtext = "\n".join(r[0] for r in rplan.result_table.rows)
+    assert "partitioned" not in rtext and "hash" in rtext
+
+
+def test_colocated_join_parity(env):
+    mse, ref = env
+    assert _rows(mse.execute_sql(JOIN)) == _rows(ref.execute_sql(JOIN))
+
+
+def test_colocated_join_with_filter_and_residual(env):
+    mse, ref = env
+    sql = ("SELECT o.cust, c.city, o.qty FROM orders o "
+           "JOIN custs c ON o.cust = c.cid AND o.qty > 5 "
+           "WHERE c.city <> 'c3' ORDER BY o.cust, o.qty LIMIT 50")
+    assert _rows(mse.execute_sql(sql)) == _rows(ref.execute_sql(sql))
+
+
+def test_left_and_semi_join_parity(env):
+    mse, ref = env
+    for sql in [
+        "SELECT o.cust, c.city FROM orders o LEFT JOIN custs c ON o.cust = c.cid",
+        "SELECT o.cust, o.qty FROM orders o WHERE o.cust IN (SELECT c.cid FROM custs c)",
+    ]:
+        assert _rows(mse.execute_sql(sql)) == _rows(ref.execute_sql(sql))
+
+
+def test_mismatched_partitioning_falls_back_to_hash(tmp_path):
+    rng = np.random.default_rng(4)
+    orders, custs = _data(rng, 120)
+    qe = QueryExecutor(backend="host")
+    # orders on murmur/4, custs on murmur/8 → counts differ → hash shuffle
+    qe.add_table(ORDERS, _build_partitioned(tmp_path, "o", ORDERS, orders,
+                                            "cust", nparts=4))
+    qe.add_table(CUSTS, _build_partitioned(tmp_path, "c", CUSTS, custs,
+                                           "cid", nparts=8))
+    mse = MultistageExecutor(qe, parallelism=2)
+    plan = mse.execute_sql("EXPLAIN PLAN FOR " + JOIN)
+    text = "\n".join(r[0] for r in plan.result_table.rows)
+    assert "partitioned" not in text
+    r = mse.execute_sql(JOIN)
+    assert not r.exceptions and len(r.result_table.rows) > 0
+
+
+def test_join_on_non_partition_column_uses_hash(env):
+    mse, ref = env
+    sql = ("SELECT o.item, c.city FROM orders o "
+           "JOIN custs c ON o.item = c.city")
+    plan = mse.execute_sql("EXPLAIN PLAN FOR " + sql)
+    text = "\n".join(r[0] for r in plan.result_table.rows)
+    assert "partitioned" not in text
+    assert _rows(mse.execute_sql(sql)) == _rows(ref.execute_sql(sql))

@@ -281,3 +281,79 @@ def test_join_across_two_os_processes(tmp_path):
         if hasattr(broker, "_mse_dispatcher"):
             broker._mse_dispatcher.close()
         server_store.close()
+
+
+# -- colocated join over the distributed runtime ------------------------------
+
+
+def test_distributed_colocated_join(tmp_path):
+    """Both tables declare segmentPartitionConfig on the join key: the
+    dispatcher plans a partitioned exchange (no generic row-hash shuffle)
+    and the join still matches the expected sums across two servers."""
+    from pinot_tpu.spi.partition import get_partition_function
+
+    rng = np.random.default_rng(31)
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="host",
+                              tags=[f"tenant{i}", "DefaultTenant"])
+               for i in range(2)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(ORDERS.to_json())
+    controller.add_schema(CUSTOMERS.to_json())
+    nparts = 2
+    pconf_o = {"columnPartitionMap": {
+        "cust": {"functionName": "murmur", "numPartitions": nparts}}}
+    pconf_c = {"columnPartitionMap": {
+        "name": {"functionName": "murmur", "numPartitions": nparts}}}
+    # one table declares partitioning at the canonical nested location,
+    # the other at the lenient top level — both must be honored
+    controller.create_table({"tableName": "orders", "replication": 1,
+                             "serverTag": "tenant0",
+                             "tableIndexConfig": {
+                                 "segmentPartitionConfig": pconf_o}})
+    controller.create_table({"tableName": "customers", "replication": 1,
+                             "serverTag": "tenant1",
+                             "segmentPartitionConfig": pconf_c})
+
+    fn = get_partition_function("murmur", nparts)
+    cols = _orders_cols(rng)
+    part = fn.partitions_of(cols["cust"])
+    orders_sets = []
+    for p in range(nparts):
+        idx = np.nonzero(part == p)[0]
+        sub = {c: np.asarray(v, object)[idx] if np.asarray(v).dtype.kind == "O"
+               else np.asarray(v)[idx] for c, v in cols.items()}
+        from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+        tc = TableConfig(table_name="orders", indexing=IndexingConfig(
+            segment_partition_config=pconf_o["columnPartitionMap"]))
+        path = str(tmp_path / f"orders_{p}")
+        SegmentBuilder(ORDERS, table_config=tc,
+                       segment_name=f"orders_{p}").build(sub, path)
+        controller.add_segment("orders_OFFLINE", f"orders_{p}",
+                               {"location": path, "numDocs": len(sub["amount"])})
+        orders_sets.append(sub)
+    ccols = _customers_cols()
+    cpath = str(tmp_path / "customers_0")
+    SegmentBuilder(CUSTOMERS, segment_name="customers_0").build(ccols, cpath)
+    controller.add_segment("customers_OFFLINE", "customers_0",
+                           {"location": cpath, "numDocs": len(CUSTS)})
+    try:
+        plan = broker.execute_sql_mse("EXPLAIN PLAN FOR " + JOIN_SQL)
+        text = "\n".join(r[0] for r in plan.result_table.rows)
+        assert "partitioned" in text, text
+
+        resp = broker.execute_sql_mse(JOIN_SQL)
+        assert not resp.exceptions, resp.exceptions
+        got = {r[0]: r[1] for r in resp.result_table.rows}
+        assert got == _expected_region_sums(orders_sets)
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        if hasattr(broker, "_mse_dispatcher"):
+            broker._mse_dispatcher.close()

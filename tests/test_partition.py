@@ -1,0 +1,167 @@
+"""Partition functions, builder partition stamping, and partition pruning.
+
+Reference: pinot-segment-spi/.../spi/partition/ (PartitionFunctionFactory,
+ModuloPartitionFunction, MurmurPartitionFunction, HashCodePartitionFunction),
+ColumnPartitionMetadata stamping in SegmentColumnarIndexCreator, and the
+partition-metadata branch of ColumnValueSegmentPruner.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.partition import (
+    get_partition_function,
+    partition_function_names,
+)
+from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+
+# -- functions ---------------------------------------------------------------
+
+
+def test_factory_names_case_insensitive():
+    assert partition_function_names() == ["hashcode", "modulo", "murmur"]
+    assert get_partition_function("Murmur", 4).name == "murmur"
+    with pytest.raises(ValueError):
+        get_partition_function("nope", 4)
+    with pytest.raises(ValueError):
+        get_partition_function("modulo", 0)
+
+
+def test_modulo_always_in_range():
+    fn = get_partition_function("modulo", 5)
+    assert fn.partition(12) == 2
+    assert fn.partition(-3) == 2  # normalized non-negative
+    assert list(fn.partitions_of(np.array([-5, -1, 0, 1, 7]))) == [0, 4, 0, 1, 2]
+    assert list(fn.partitions_of(["10", "11"])) == [0, 1]  # string ints
+
+
+def test_hashcode_matches_java_semantics():
+    fn = get_partition_function("hashcode", 1 << 30)
+    # Java String.hashCode("abc") == 96354; Integer.hashCode(v) == v
+    assert fn.partition("abc") == 96354
+    assert fn.partition(7) == 7
+    fn4 = get_partition_function("hashcode", 4)
+    for v in ["", "abc", -17, 2**40, 3.5, True]:
+        assert 0 <= fn4.partition(v) < 4
+
+
+def test_murmur_stable_and_type_canonical():
+    fn = get_partition_function("murmur", 8)
+    for v in ["a", "hello", 123, b"raw", 4.0]:
+        p = fn.partition(v)
+        assert 0 <= p < 8
+        assert fn.partition(v) == p  # deterministic
+    # canonical string forms: int 5, "5", and 5.0 agree (stream keys arrive
+    # as strings; stamped columns are typed)
+    assert fn.partition(5) == fn.partition("5") == fn.partition(5.0)
+    # spread: 1000 keys should touch every partition
+    seen = {fn.partition(f"key-{i}") for i in range(1000)}
+    assert seen == set(range(8))
+
+
+def test_config_json_round_trip():
+    tc = TableConfig(
+        table_name="t",
+        indexing=IndexingConfig(segment_partition_config={
+            "uid": {"functionName": "murmur", "numPartitions": 8}}))
+    rt = TableConfig.from_json(tc.to_json())
+    assert rt.indexing.segment_partition_config == {
+        "uid": {"functionName": "murmur", "numPartitions": 8}}
+
+
+# -- builder stamping + pruning ----------------------------------------------
+
+SCHEMA = Schema.build(
+    "pt", dimensions=[("uid", "INT"), ("name", "STRING")],
+    metrics=[("amt", "INT")])
+
+
+def _config():
+    return TableConfig(
+        table_name="pt",
+        indexing=IndexingConfig(segment_partition_config={
+            "uid": {"functionName": "modulo", "numPartitions": 4}}))
+
+
+def _build(tmp_path, tag, uids):
+    n = len(uids)
+    cols = {"uid": np.asarray(uids, np.int32),
+            "name": np.asarray([f"n{u}" for u in uids], object),
+            "amt": np.arange(n).astype(np.int32)}
+    SegmentBuilder(SCHEMA, table_config=_config(),
+                   segment_name=f"pt_{tag}").build(cols, tmp_path / tag)
+    return load_segment(tmp_path / tag)
+
+
+def test_builder_stamps_partition_metadata(tmp_path):
+    seg = _build(tmp_path, "s0", [2, 6, 10, 14])  # all ≡ 2 (mod 4)
+    m = seg.metadata.columns["uid"]
+    assert m.partition_function == "modulo"
+    assert m.num_partitions == 4
+    assert m.partitions == [2]
+    assert m.partition_id == 2
+    # unpartitioned column untouched
+    assert seg.metadata.columns["name"].partition_function is None
+    mixed = _build(tmp_path, "s1", [0, 1, 2])
+    mm = mixed.metadata.columns["uid"]
+    assert mm.partitions == [0, 1, 2] and mm.partition_id is None
+
+
+def test_partition_metadata_survives_disk_round_trip(tmp_path):
+    _build(tmp_path, "s0", [3, 7, 11])
+    again = load_segment(tmp_path / "s0")
+    m = again.metadata.columns["uid"]
+    assert (m.partition_function, m.num_partitions, m.partitions) == \
+        ("modulo", 4, [3])
+
+
+def test_eq_query_prunes_other_partitions(tmp_path):
+    segs = [_build(tmp_path, f"p{p}", [p, p + 4, p + 8]) for p in range(4)]
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, segs)
+    r = qe.execute_sql("SELECT COUNT(*) FROM pt WHERE uid = 6")
+    assert not r.exceptions, r.exceptions
+    assert r.result_table.rows[0][0] == 1
+    assert r.num_segments_pruned == 3  # partition metadata alone proves it
+    # IN across two partitions keeps exactly those two segments
+    r2 = qe.execute_sql("SELECT COUNT(*) FROM pt WHERE uid IN (1, 7)")
+    assert r2.result_table.rows[0][0] == 2
+    assert r2.num_segments_pruned == 2
+    # range predicates don't consult partition metadata: nothing wrongly pruned
+    r3 = qe.execute_sql("SELECT COUNT(*) FROM pt WHERE uid >= 0")
+    assert r3.result_table.rows[0][0] == 12
+
+
+def test_partition_pruning_parity_with_full_scan(tmp_path):
+    rng = np.random.default_rng(3)
+    uids = rng.integers(0, 100, 400)
+    segs = []
+    for p in range(4):
+        sel = uids[uids % 4 == p]
+        segs.append(_build(tmp_path, f"q{p}", sel))
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, segs)
+    unpart = QueryExecutor(backend="host")
+    # same data, no partition stamps → no partition pruning
+    cols_segs = []
+    for p in range(4):
+        sel = uids[uids % 4 == p]
+        n = len(sel)
+        cols = {"uid": np.asarray(sel, np.int32),
+                "name": np.asarray([f"n{u}" for u in sel], object),
+                "amt": np.arange(n).astype(np.int32)}
+        SegmentBuilder(SCHEMA, segment_name=f"u{p}").build(
+            cols, tmp_path / f"u{p}")
+        cols_segs.append(load_segment(tmp_path / f"u{p}"))
+    unpart.add_table(SCHEMA, cols_segs)
+    for v in [0, 17, 42, 99, 123]:
+        a = qe.execute_sql(f"SELECT COUNT(*), SUM(amt) FROM pt WHERE uid = {v}")
+        b = unpart.execute_sql(f"SELECT COUNT(*), SUM(amt) FROM pt WHERE uid = {v}")
+        assert a.result_table.rows == b.result_table.rows, v
