@@ -601,17 +601,22 @@ def _occ_prepare(i: int, card: int, state_fn):
         if o.ndim == 2:
             return lambda g: state_fn(np.nonzero(o[g])[0])
         # filter ONCE (the kernel leaves unique pairs ascending with
-        # sentinel holes); per-group lookup is two binary searches over the
-        # compacted array — cost scales with SURVIVING groups, never the
-        # pre-trim group count
-        valid = o[o < ir.SPARSE_KEY_SPACE]
-        keys_out = outs[-1]
+        # sentinel holes); the per-group ranges come from TWO vectorized
+        # binary searches over the compacted array (one scalar searchsorted
+        # per group re-promotes the operand array every call — measured
+        # 0.37ms/call, 74s at numGroupsLimit scale). The sentinel is
+        # dtype-sized: int32 pair kernels pad with 2^31-1, int64 with
+        # SPARSE_KEY_SPACE — filtering with the WRONG one leaves
+        # pad/duplicate holes inline and the array is no longer sorted
+        sent = (1 << 31) - 1 if o.dtype == np.int32 else ir.SPARSE_KEY_SPACE
+        valid = o[o < sent].astype(np.int64, copy=False)
+        bases = outs[-1].astype(np.int64) * card
+        los = np.searchsorted(valid, bases)
+        his = np.searchsorted(valid, bases + card)
 
         def extract(g):
-            base = int(keys_out[g]) * card
-            lo = np.searchsorted(valid, base)
-            hi = np.searchsorted(valid, base + card)
-            return state_fn((valid[lo:hi] % card).astype(np.int64))
+            ids = valid[los[g]:his[g]] % card
+            return state_fn(ids)
 
         return extract
 

@@ -26,7 +26,15 @@ class FunctionContext:
     arguments: tuple["ExpressionContext", ...] = ()
 
     def __str__(self) -> str:
-        return f"{self.name}({','.join(map(str, self.arguments))})"
+        # cached: reduce paths key env dicts by expression string per group
+        # row — recomputing the recursive form is O(tree) per call and
+        # dominated broker reduce at numGroupsLimit scale. Instances are
+        # frozen, so the cache can never go stale.
+        s = self.__dict__.get("_str")
+        if s is None:
+            s = f"{self.name}({','.join(map(str, self.arguments))})"
+            object.__setattr__(self, "_str", s)
+        return s
 
 
 @dataclass(frozen=True)
@@ -82,7 +90,7 @@ class ExpressionContext:
             if isinstance(self.literal, str):
                 return f"'{self.literal}'"
             return str(self.literal)
-        return str(self.function)
+        return str(self.function)  # FunctionContext.__str__ caches
 
 
 # Aggregation function names recognized by the engine. Mirrors the reference's
