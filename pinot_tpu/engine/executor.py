@@ -13,7 +13,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from ..ops.kernels import run_program
+from ..ops.kernels import PackedOuts, pack_outputs, run_program, unpack_outputs
 from ..query.context import QueryContext
 from ..segment.device_cache import GLOBAL_DEVICE_CACHE, DeviceSegmentCache
 from ..segment.loader import ImmutableSegment
@@ -57,14 +57,18 @@ class TpuSegmentExecutor:
         view = self.cache.view(segment)
         arrays, packed = plan.gather_arrays_packed(view)
         params = tuple(jnp.asarray(p) for p in plan.params)
-        return run_program(plan.program, arrays, params,
+        outs = run_program(plan.program, arrays, params,
                            jnp.int32(segment.num_docs), view.padded,
                            packed=packed)
+        # one flat buffer per query → one D2H transfer at collect() (a
+        # tunneled device pays a fixed round trip PER materialized array)
+        return pack_outputs(outs)
 
     def collect(self, query: QueryContext, segment: ImmutableSegment,
                 plan: SegmentPlan, outs):
         """Materialize device outputs (blocks) and decode the intermediate."""
-        outs = [np.asarray(o) for o in outs]
+        outs = unpack_outputs(outs) if isinstance(outs, PackedOuts) \
+            else [np.asarray(o) for o in outs]
         mode = plan.program.mode
         if mode == "selection":
             return self._selection_result(query, segment, plan, outs[0])

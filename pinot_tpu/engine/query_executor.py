@@ -27,6 +27,7 @@ from ..spi.data_types import Schema
 from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
 from .combine import (combine_aggregation, combine_group_by,
                       combine_selection, trim_group_by)
+from ..ops.kernels import PackedOuts, fetch_packed_batch
 from .executor import TpuSegmentExecutor
 from .host_executor import HostSegmentExecutor
 from .pruner import SegmentPrunerService
@@ -296,6 +297,12 @@ class QueryExecutor:
             intermediates[idx] = (
                 self._remap_star_tree(rewrite, inter) if rewrite else inter)
             done += 1
+        if len(pending) > 1 and all(
+                isinstance(p[5], PackedOuts) for p in pending):
+            # ONE device→host transfer for the whole multi-segment batch
+            # (a tunneled device pays a fixed round trip per fetch)
+            fetched = fetch_packed_batch([p[5] for p in pending])
+            pending = [p[:5] + (raw,) for p, raw in zip(pending, fetched)]
         for idx, run_query, run_segment, rewrite, plan, outs in pending:
             check(done)
             inter = self._account(tracker, lambda: self.tpu.collect(

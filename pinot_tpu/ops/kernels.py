@@ -25,6 +25,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..engine import ir
 from . import mxu_groupby
@@ -162,6 +163,85 @@ def _apply_packed(arrays: tuple, packed: tuple) -> tuple:
     for slot, _width in packed:
         out[slot] = out[slot].astype(jnp.int32)
     return tuple(out)
+
+
+class PackedOuts:
+    """Kernel outputs flattened into ONE device buffer + host-side metas.
+
+    Tunneled devices (axon) pay a fixed round trip per materialized array
+    (~60ms measured) — a query with k outputs costs k round trips if each
+    is fetched separately. Packing on device makes the whole query ONE
+    D2H transfer; shapes/dtypes are host-known attributes of the device
+    arrays, so unpacking never touches the wire."""
+
+    __slots__ = ("flat", "metas")
+
+    def __init__(self, flat, metas):
+        self.flat = flat
+        self.metas = metas  # [(np.dtype, shape), ...]
+
+
+@jax.jit
+def _pack_u8(outs: tuple):
+    chunks = []
+    for o in outs:
+        if o.dtype == jnp.bool_:
+            o = o.astype(jnp.uint8)
+        chunks.append(jax.lax.bitcast_convert_type(o, jnp.uint8).reshape(-1))
+    return jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+
+
+def pack_outputs(outs: tuple) -> PackedOuts:
+    metas = [(np.dtype(str(o.dtype)), tuple(o.shape)) for o in outs]
+    return PackedOuts(_pack_u8(outs), metas)
+
+
+def unpack_outputs(p: PackedOuts) -> list:
+    flat = np.asarray(p.flat)  # the query's single device→host transfer
+    return _split_flat(flat, p.metas)
+
+
+def _split_flat(flat: np.ndarray, metas) -> list:
+    out, off = [], 0
+    for dt, shape in metas:
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        out.append(flat[off:off + nbytes].view(dt).reshape(shape))
+        off += nbytes
+    return out
+
+
+@jax.jit
+def _concat_flats(flats: tuple):
+    return jnp.concatenate(flats)
+
+
+# batch-fetch only round-trip-DOMINATED transfers: above this total the
+# wire time dwarfs the per-fetch latency, and the on-device concat copy +
+# whole-batch host buffer would only raise peak memory for no win
+_BATCH_FETCH_CAP = 128 << 20
+
+
+def fetch_packed_batch(packs: list) -> list:
+    """Materialize many segments' packed outputs in as few device→host
+    transfers as possible: EQUAL-LENGTH flat buffers (same segment bucket ×
+    same program — the multi-segment combine case) concatenate on device
+    and fetch once, so a 16-segment combine costs one tunnel round trip
+    instead of 16. Unequal lengths fetch individually — batching them
+    would compile a fresh concat executable per length combination."""
+    out = [None] * len(packs)
+    by_len: dict[int, list[int]] = {}
+    for i, p in enumerate(packs):
+        by_len.setdefault(int(p.flat.shape[0]), []).append(i)
+    for n, idxs in by_len.items():
+        group_ok = len(idxs) > 1 and n * len(idxs) <= _BATCH_FETCH_CAP
+        if not group_ok:
+            for i in idxs:
+                out[i] = unpack_outputs(packs[i])
+            continue
+        flat = np.asarray(_concat_flats(tuple(packs[i].flat for i in idxs)))
+        for j, i in enumerate(idxs):
+            out[i] = _split_flat(flat[j * n:(j + 1) * n], packs[i].metas)
+    return out
 
 
 @partial(jax.jit, static_argnames=("program", "padded", "packed"))
