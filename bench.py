@@ -160,6 +160,44 @@ def prepare_tables(need_ssb, need_ssb16, need_taxi):
     return out
 
 
+def _probe_accelerator(probe_s: float) -> bool:
+    """True iff a throwaway subprocess can run one device op within
+    probe_s. Transient init ERRORS get a second attempt (round-1 failure
+    mode); a TIMEOUT doesn't — a held lease won't heal in seconds. stderr
+    goes to a temp FILE, not a pipe: a wedged tunnel's helper process can
+    inherit a pipe fd and keep it open, which would block the parent in
+    communicate() past the timeout. The probe runs in its own session so
+    the timeout kill takes the whole process group with it."""
+    import signal
+    import subprocess
+    import tempfile
+
+    for attempt in range(2):
+        with tempfile.TemporaryFile() as ef:
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; jax.numpy.zeros(8).block_until_ready()"],
+                stdout=subprocess.DEVNULL, stderr=ef,
+                start_new_session=True)
+            try:
+                if proc.wait(timeout=probe_s) == 0:
+                    return True
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except Exception:
+                    proc.kill()
+                proc.wait()
+                print(f"[bench] accelerator probe hung (> {probe_s:.0f}s)",
+                      file=sys.stderr)
+                return False
+            ef.seek(0)
+            tail = ef.read()[-2000:].decode(errors="replace").strip()
+            print(f"[bench] probe attempt {attempt + 1} failed:\n{tail}",
+                  file=sys.stderr)
+    return False
+
+
 def _init_backend():
     """Initialize a jax backend with retry + CPU fallback.
 
@@ -168,6 +206,22 @@ def _init_backend():
     accelerator never comes up, fall back to CPU so the round still produces
     a parseable (clearly-labelled) number.
     """
+    # a wedged accelerator tunnel HANGS at first device use rather than
+    # erroring (observed: axon lease held by a killed process) — probe in a
+    # disposable subprocess with a hard timeout BEFORE importing jax here,
+    # so a hang costs probe_s (per attempt), not the whole bench budget.
+    # Cost on a healthy accelerator: one extra backend init (~10-20s of the
+    # 2400s budget). BENCH_INIT_PROBE_S=0 disables the probe.
+    probe_note = None
+    probe_s = float(os.environ.get("BENCH_INIT_PROBE_S", 180))
+    if not os.environ.get("BENCH_PLATFORM") and probe_s > 0:
+        if not _probe_accelerator(probe_s):
+            print(f"[bench] accelerator probe failed/hung; forcing CPU",
+                  file=sys.stderr)
+            probe_note = "accelerator probe failed or hung, ran on cpu"
+            os.environ["BENCH_PLATFORM"] = "cpu"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
     from jax.extend import backend as jex_backend
 
@@ -181,7 +235,7 @@ def _init_backend():
         try:
             devs = jax.devices()
             print(f"[bench] devices: {devs}", file=sys.stderr)
-            return jax, devs[0].platform, None
+            return jax, devs[0].platform, probe_note
         except Exception as e:  # backend init is the flaky part
             last_err = e
             print(f"[bench] backend init attempt {attempt + 1} failed: {e}",
