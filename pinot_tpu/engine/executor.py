@@ -101,7 +101,8 @@ class TpuSegmentExecutor:
             # were still scanned (reference reports all post-filter docs)
             trash = int(outs[0][num_groups])
             scanned += trash
-            trimmed = trash > 0  # numGroupsLimitReached
+            # an ORDER-BY-pushdown trim is exact — not a groups-limit event
+            trimmed = trash > 0 and not plan.program.exact_trim
         if all(la.vec is not None for la in plan.lowered_aggs):
             # columnar fast path: states stay numpy end-to-end (dict form
             # costs ~µs/group in Python — fatal at numGroupsLimit scale)

@@ -244,3 +244,6 @@ class Program:
     # the numGroupsLimit cap). Static, so the kernel can sort 32-bit keys
     # when they fit — 64-bit sorts and scatters are emulated on TPU
     key_space: int = 0
+    # sparse mode: the device trim is an ORDER BY pushdown (ASC group-key
+    # prefix + LIMIT) — result is exact, so don't flag numGroupsLimitReached
+    exact_trim: bool = False

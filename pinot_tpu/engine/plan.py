@@ -38,6 +38,29 @@ DEFAULT_NUM_GROUPS_LIMIT = 100_000  # reference InstancePlanMakerImplV2 default
 _SPARSE_AGG_KINDS = {"count", "sum", "sumsq", "min", "max"}
 
 
+def _orderby_prefix_trim(q) -> "int | None":
+    """offset+limit when ORDER BY is ALL the group-by keys, in stride
+    order, all ASC with default null ordering and no HAVING — the shape
+    where a per-segment keep-smallest-L composite trim cannot change the
+    final result. The cover must be FULL: with a shorter prefix, a group
+    trimmed in one segment but kept in another could be selected on a
+    prefix tie with an incomplete aggregate unless the broker reduce
+    tie-broke on the remaining keys (it doesn't on the dict-merge path)."""
+    if q.having_filter is not None or not q.order_by_expressions:
+        return None
+    gb = q.group_by_expressions
+    if q.distinct and not q.is_aggregation_query:
+        gb = q.select_expressions
+    obs = q.order_by_expressions
+    if not gb or len(obs) != len(gb):
+        return None
+    for ob, ge in zip(obs, gb):
+        if not ob.ascending or ob.nulls_last is not None \
+                or str(ob.expression) != str(ge):
+            return None
+    return int(q.offset) + int(q.limit)
+
+
 @dataclass
 class GroupDim:
     column: str
@@ -590,11 +613,23 @@ class SegmentPlanner(AggPlanContext):
                 raise UnsupportedQueryError(
                     f"{dense_reason} exceeds the dense limit for an "
                     "un-grouped aggregation")
+            exact_trim = False
             if sparse and group_exprs:
                 # output capacity = numGroupsLimit: groups beyond it are
                 # trimmed on device (reference InstancePlanMakerImplV2:245-270)
                 limit = int(q.query_options.get(
                     "numGroupsLimit", DEFAULT_NUM_GROUPS_LIMIT))
+                # ORDER-BY pushdown: when the query orders by an ASC prefix
+                # of the group keys, the kernel's keep-smallest-L trim is
+                # EXACT (sorted dictionaries make composite order =
+                # lexicographic value order, and a segment's L smallest keys
+                # contain every globally-L-smallest key it holds) — the
+                # device then ships L slots instead of millions (reference:
+                # ordering-aware server trim, TableResizer/minServerGroupTrimSize)
+                trim = None if any_derived else _orderby_prefix_trim(q)
+                if trim is not None and trim <= limit:
+                    limit = trim
+                    exact_trim = True
                 mode = "group_by_sparse"
                 out_groups = min(num_groups, max(1, limit))
                 if out_groups > SPARSE_GROUPS_LIMIT:
@@ -615,6 +650,7 @@ class SegmentPlanner(AggPlanContext):
                 num_groups=out_groups,
                 group_vexprs=tuple(group_vexprs) if any_derived else (),
                 key_space=num_groups if mode == "group_by_sparse" else 0,
+                exact_trim=exact_trim,
             )
             return SegmentPlan(program, self._slots, self._params, lowered, group_dims)
 

@@ -216,6 +216,68 @@ def test_sparse_unsupported_agg_falls_back(env):
     assert _rows(resp) == _rows(host_resp)
 
 
+def test_orderby_prefix_trim_pushdown(env):
+    """ORDER BY = ASC prefix of the group keys + LIMIT → the kernel only
+    allocates offset+limit output slots (the exact-trim pushdown), the
+    result still matches sqlite, and the trim is NOT reported as a
+    numGroupsLimit event (it cannot change the answer)."""
+    tpu, host, conn, segs = env
+    sql = ("SELECT uid, code, SUM(amount) FROM hc GROUP BY uid, code "
+           "ORDER BY uid, code LIMIT 40")
+    q = parse_sql(sql)
+    plan = SegmentPlanner(q, segs[0]).plan()
+    assert plan.program.mode == "group_by_sparse"
+    assert plan.program.num_groups == 40  # not DEFAULT_NUM_GROUPS_LIMIT
+    assert plan.program.exact_trim
+    resp = tpu.execute_sql(sql)
+    assert not resp.exceptions, resp.exceptions
+    assert not resp.num_groups_limit_reached
+    want = conn.execute(
+        "SELECT uid, code, SUM(amount) FROM hc GROUP BY uid, code "
+        "ORDER BY uid, code LIMIT 40").fetchall()
+    got = [(int(r[0]), int(r[1]), int(r[2])) for r in resp.result_table.rows]
+    assert got == [(int(a), int(b), int(c)) for a, b, c in want]
+    # a DISTINCTCOUNT (dict-merge path) under the pushdown also stays exact
+    sql2 = ("SELECT uid, code, DISTINCTCOUNT(tag), SUM(amount) FROM hc "
+            "GROUP BY uid, code ORDER BY uid, code LIMIT 30")
+    assert SegmentPlanner(parse_sql(sql2), segs[0]).plan().program.num_groups == 30
+    r2 = tpu.execute_sql(sql2)
+    assert not r2.exceptions, r2.exceptions
+    want2 = conn.execute(
+        "SELECT uid, code, COUNT(DISTINCT tag), SUM(amount) FROM hc "
+        "GROUP BY uid, code ORDER BY uid, code LIMIT 30").fetchall()
+    got2 = [tuple(int(v) for v in r) for r in r2.result_table.rows]
+    assert got2 == [tuple(int(v) for v in r) for r in want2]
+
+
+def test_orderby_trim_not_pushed_when_unsafe(env):
+    tpu, host, conn, segs = env
+    from pinot_tpu.engine.plan import DEFAULT_NUM_GROUPS_LIMIT
+
+    for sql in [
+        # DESC: keep-smallest would be wrong
+        "SELECT uid, code, SUM(amount) FROM hc GROUP BY uid, code "
+        "ORDER BY uid DESC LIMIT 40",
+        # ordered by an aggregate, not a key prefix
+        "SELECT uid, code, SUM(amount) FROM hc GROUP BY uid, code "
+        "ORDER BY SUM(amount) LIMIT 40",
+        # key order swapped: not a prefix in stride order
+        "SELECT uid, code, SUM(amount) FROM hc GROUP BY uid, code "
+        "ORDER BY code, uid LIMIT 40",
+        # partial prefix: exactness would need full-key tie-breaks in the
+        # dict-path reduce — not pushed down
+        "SELECT uid, code, SUM(amount) FROM hc GROUP BY uid, code "
+        "ORDER BY uid LIMIT 40",
+        # HAVING may drop groups after trim
+        "SELECT uid, code, SUM(amount) FROM hc GROUP BY uid, code "
+        "HAVING SUM(amount) > 10 ORDER BY uid, code LIMIT 40",
+    ]:
+        plan = SegmentPlanner(parse_sql(sql), segs[0]).plan()
+        assert plan.program.num_groups == DEFAULT_NUM_GROUPS_LIMIT, sql
+        assert not plan.program.exact_trim, sql
+        _check(tpu, host, sql)
+
+
 def test_sparse_float_sum_error_stays_local_to_group(tmp_path):
     """SUM(DOUBLE) rounding must scale with the GROUP's magnitude, not the
     segment's running total: at values ~1e12 over 20K rows the global
