@@ -319,11 +319,19 @@ def get_semantics(name: str, extra: tuple = ()) -> AggSemantics:
 # ---------------------------------------------------------------------------
 
 
+# dense occupancy ceiling shared with the planner's mode selection
+# (plan.DENSE_GROUP_LIMIT aliases this)
+DENSE_GROUP_LIMIT = 1 << 21
+
+
 class AggPlanContext:
     """Planner callback surface used by lowerings to register device ops."""
 
     def __init__(self):
         self.ops: list[ir.AggOp] = []
+        # group cardinality product, set by the planner before lowering —
+        # approximate aggs use it to bound their occupancy matrices
+        self.group_card_hint = 1
 
     def add_op(self, op: ir.AggOp) -> int:
         """Register a primitive op, dedup'd; returns its kernel output index
@@ -470,7 +478,15 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
 
     if name in _PCT_DIGEST and not name.endswith("mv"):
         info = ctx.dict_info(data[0], sv_only=True)
-        if info is not None and _numeric_dictionary(info[2]):
+        # exact value-hist only while groups × dict-card fits the dense
+        # table; beyond it a high-card column (e.g. cent-rounded fares)
+        # would otherwise reject the device path entirely. These are
+        # APPROXIMATE functions by contract — the fixed-bin histogram's
+        # quantile error ≤ (max-min)/bins stays inside the family's
+        # tolerance (reference PercentileTDigestAggregationFunction is
+        # itself a bounded-error sketch).
+        if info is not None and _numeric_dictionary(info[2]) \
+                and ctx.group_card_hint * info[1] <= DENSE_GROUP_LIMIT:
             i, dictionary = _value_hist_op(ctx, data[0], name)
 
             def extract(outs, g, _i=i, _d=dictionary):
@@ -479,7 +495,8 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
                 return ValueHist.from_arrays(_d.values[nz], row[nz]).to_tdigest()
 
             return LoweredAgg(label, sem, extract)
-        # raw numeric column: fixed-bin device histogram → weighted t-digest
+        # raw numeric column (or an occupancy-capped dict column):
+        # fixed-bin device histogram → weighted t-digest
         mm = ctx.col_minmax(data[0])
         if mm is None:
             raise UnsupportedQueryError(f"{name} needs numeric column stats")

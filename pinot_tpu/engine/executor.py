@@ -56,9 +56,15 @@ class TpuSegmentExecutor:
         async device queueing instead of threads)."""
         view = self.cache.view(segment)
         arrays, packed = plan.gather_arrays_packed(view)
-        params = tuple(jnp.asarray(p) for p in plan.params)
+        # params pass as host numpy: jit converts arguments itself — an
+        # eager jnp.asarray per param costs a device dispatch each (~1ms ×
+        # params × segments of pure host overhead per multi-segment query).
+        # Python ints still pin to int64 (the dtype the old jnp.asarray
+        # produced under x64).
+        params = tuple(p if isinstance(p, (np.ndarray, np.generic))
+                       else np.asarray(p) for p in plan.params)
         outs = run_program(plan.program, arrays, params,
-                           jnp.int32(segment.num_docs), view.padded,
+                           np.int32(segment.num_docs), view.padded,
                            packed=packed)
         # one flat buffer per query → one D2H transfer at collect() (a
         # tunneled device pays a fixed round trip PER materialized array)

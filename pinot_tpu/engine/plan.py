@@ -29,9 +29,11 @@ from ..segment.device_cache import SegmentDeviceView
 from ..segment.loader import ImmutableSegment
 from ..spi.data_types import DataType
 from . import ir
-from .aggregation import AggPlanContext, LoweredAgg, UnsupportedQueryError, lower_aggregation
+from .aggregation import (DENSE_GROUP_LIMIT, AggPlanContext, LoweredAgg,
+                          UnsupportedQueryError, lower_aggregation)
 
-DENSE_GROUP_LIMIT = 1 << 21  # beyond this the dense segment_sum table blows HBM
+# DENSE_GROUP_LIMIT (re-exported from .aggregation): dense segment_sum
+# HBM ceiling shared with the approximate-agg occupancy gate
 SPARSE_KEY_LIMIT = ir.SPARSE_KEY_SPACE  # keys stay below the kernel sentinel
 SPARSE_GROUPS_LIMIT = 1 << 25  # cap on sparse output table slots (~256MB/agg)
 DEFAULT_NUM_GROUPS_LIMIT = 100_000  # reference InstancePlanMakerImplV2 default
@@ -578,6 +580,10 @@ class SegmentPlanner(AggPlanContext):
             for i in range(len(cards) - 2, -1, -1):
                 strides[i] = strides[i + 1] * cards[i + 1]
 
+            # lets approximate aggs size their occupancy matrices: e.g. the
+            # tdigest family picks exact value-hist vs fixed-bin by whether
+            # groups × dict-card fits the dense table
+            self.group_card_hint = num_groups
             lowered = [lower_aggregation(self, a) for a in q.aggregations]
             # mode selection: dense when the key product AND every matrix
             # occupancy fit the segment_sum table; otherwise the sort-based

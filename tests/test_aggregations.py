@@ -135,6 +135,47 @@ def test_percentile_tdigest_close_to_exact(table):
     assert h == pytest.approx(exact, abs=2.0)
 
 
+def test_tdigest_high_card_dict_column_stays_on_device(tmp_path):
+    """PERCENTILETDIGEST over a high-cardinality dict column inside a
+    group-by: groups x dict-card exceeds the dense occupancy table, so the
+    lowering must fall back to the fixed-bin device histogram (the
+    approximate family's contract allows it) instead of rejecting the
+    device path to host/MSE."""
+    from pinot_tpu.engine.plan import DENSE_GROUP_LIMIT, SegmentPlanner
+    from pinot_tpu.query.parser.sql import parse_sql
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.segment.loader import load_segment
+
+    rng = np.random.default_rng(12)
+    n = 200_000
+    schema = Schema.build(
+        "hc", dimensions=[("day", "INT")], metrics=[("fare", "DOUBLE")])
+    cols = {"day": rng.integers(0, 365, n).astype(np.int32),
+            "fare": np.round(rng.gamma(3.0, 8.0, n), 2)}
+    SegmentBuilder(schema, segment_name="hc0").build(cols, tmp_path / "hc0")
+    seg = load_segment(tmp_path / "hc0")
+    card = seg.column_metadata("fare").cardinality
+    assert 365 * card > DENSE_GROUP_LIMIT  # the shape that used to reject
+
+    sql = ("SELECT day, PERCENTILETDIGEST(fare, 95) FROM hc "
+           "GROUP BY day LIMIT 1000")
+    plan = SegmentPlanner(parse_sql(sql), seg).plan()
+    kinds = {op.kind for op in plan.program.aggs}
+    assert "hist_fixed" in kinds and "value_hist" not in kinds
+
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, [seg])
+    r = tpu.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    got = {int(row[0]): float(row[1]) for row in r.result_table.rows}
+    assert len(got) == 365
+    for day in (0, 100, 364):
+        vals = np.sort(cols["fare"][cols["day"] == day])
+        exact = float(vals[int(len(vals) * 0.95)])
+        # fixed-bin quantile error ≤ (max-min)/2048 ≈ 0.1; allow slack
+        assert abs(got[day] - exact) <= max(0.5, exact * 0.01), (day, got[day], exact)
+
+
 def test_exprmin_exprmax_firstlast(table):
     # host-path functions — "auto" backend falls back per query shape
     schema, segments = table
