@@ -345,6 +345,11 @@ class AggPlanContext:
     def value_expr(self, e: ExpressionContext) -> ir.ValueExpr:  # pragma: no cover
         raise NotImplementedError
 
+    def mv_reduce_expr(self, e: ExpressionContext, op: str):  # pragma: no cover
+        """(vexpr, vmin, vmax) or None — planners without MV support fall
+        back to host."""
+        return None
+
     def dict_info(self, e: ExpressionContext, sv_only: bool = False):  # pragma: no cover
         raise NotImplementedError
 
@@ -360,6 +365,55 @@ _HIST_BINS = 2048  # fixed-bin device histogram resolution for raw columns
 
 def _mul(a: ir.ValueExpr, b: ir.ValueExpr) -> ir.ValueExpr:
     return ir.Bin("mul", a, b)
+
+
+def _lower_mv_value_agg(ctx: AggPlanContext, name: str, label: str,
+                        sem: AggSemantics, arg: ExpressionContext) -> LoweredAgg:
+    """SUMMV-family: the MV column row-reduces to one value per doc
+    (ir.MvLutReduce), then rides the standard scalar agg kernels. Host
+    semantics flatten all entries of matched docs — identical totals."""
+
+    def op(kind: str) -> int:
+        r = ctx.mv_reduce_expr(arg, kind)
+        if r is None:
+            raise UnsupportedQueryError(
+                f"{name} on {arg} has no device MV form (host path)")
+        ve, vmin, vmax = r
+        agg_kind = "sum" if kind in ("sum", "count") else kind
+        return ctx.add_op(ir.AggOp(agg_kind, vexpr=ve, vmin=vmin, vmax=vmax))
+
+    if name == "countmv":
+        i = op("count")
+        spec, tag = VEC_RECIPES["count"]
+        return LoweredAgg(
+            label, sem, lambda outs, g: int(outs[i][g]),
+            vec=VecAgg(spec, lambda outs, gids: (outs[i][gids],), tag))
+    if name in ("summv", "minmv", "maxmv"):
+        i = op(name[:-2])
+        spec, tag = VEC_RECIPES[name[:-2]]
+        return LoweredAgg(
+            label, sem, lambda outs, g: float(outs[i][g]),
+            vec=VecAgg(spec,
+                       lambda outs, gids: (outs[i][gids].astype(float),), tag))
+    if name == "minmaxrangemv":
+        i_min, i_max = op("min"), op("max")
+        spec, tag = VEC_RECIPES["minmaxrange"]
+        return LoweredAgg(
+            label, sem,
+            lambda outs, g: (float(outs[i_min][g]), float(outs[i_max][g])),
+            vec=VecAgg(spec,
+                       lambda outs, gids: (outs[i_min][gids].astype(float),
+                                           outs[i_max][gids].astype(float)),
+                       tag))
+    # avgmv: (sum of entries, COUNT OF ENTRIES — not docs)
+    i_s, i_c = op("sum"), op("count")
+    spec, tag = VEC_RECIPES["avg"]
+    return LoweredAgg(
+        label, sem,
+        lambda outs, g: (float(outs[i_s][g]), int(outs[i_c][g])),
+        vec=VecAgg(spec,
+                   lambda outs, gids: (outs[i_s][gids].astype(float),
+                                       outs[i_c][gids]), tag))
 
 
 def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAgg:
@@ -385,6 +439,9 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
             vec=VecAgg(spec,
                        lambda outs, gids, _i=i: (outs[_i][gids].astype(float),),
                        tag))
+
+    if name in ("countmv", "summv", "minmv", "maxmv", "avgmv", "minmaxrangemv"):
+        return _lower_mv_value_agg(ctx, name, label, sem, data[0])
 
     if name == "minmaxrange":
         i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.value_expr(data[0])))

@@ -332,22 +332,40 @@ class HostSegmentExecutor:
         starts = np.concatenate([[0], boundaries])
         ends = np.concatenate([boundaries, [len(sel_sorted)]])
         agg_args = []
+        mv_cache: dict[str, object] = {}  # column → decoded rows, once
         for agg in query.aggregations:
             if agg.function.name == "count":
-                agg_args.append((None, ()))
+                agg_args.append(("count", None, ()))
             else:
                 data, extra = split_args(agg.function)
-                agg_args.append(
-                    ([np.asarray(self.eval_value(a, segment)) for a in data], extra))
+                if (len(data) == 1 and data[0].is_identifier
+                        and segment.has_column(data[0].identifier)
+                        and not segment.column_metadata(
+                            data[0].identifier).single_value):
+                    # MV argument: per group, aggregate over ALL entries of
+                    # the group's rows (same flattening as the ungrouped
+                    # _agg_state MV branch)
+                    col = data[0].identifier
+                    if col not in mv_cache:
+                        mv_cache[col] = segment.get_mv_values(col)
+                    agg_args.append(("mv", mv_cache[col], extra))
+                else:
+                    agg_args.append(
+                        ("sv", [np.asarray(self.eval_value(a, segment))
+                                for a in data], extra))
         for s, e in zip(starts, ends):
             if s == e:
                 continue
             rows = sel_sorted[s:e]
             key = tuple(_to_python(col[rows[0]]) for col in key_cols)
             states = []
-            for agg, (cols, extra) in zip(query.aggregations, agg_args):
-                if cols is None:
+            for agg, (kind, cols, extra) in zip(query.aggregations, agg_args):
+                if kind == "count":
                     states.append(len(rows))
+                elif kind == "mv":
+                    flat = [v for i in rows for v in cols[i]]
+                    states.append(
+                        host_state(agg.function.name, np.asarray(flat), extra))
                 else:
                     states.append(
                         host_state_full(agg.function.name, [c[rows] for c in cols], extra))

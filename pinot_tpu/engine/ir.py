@@ -106,6 +106,23 @@ class Where(ValueExpr):
     b: ValueExpr
 
 
+@dataclass(frozen=True)
+class MvLutReduce(ValueExpr):
+    """Per-doc reduce of an MV column: params[lut_param][mv_ids] is a
+    (docs, max_mv) value matrix whose pad-sentinel slot (index card) holds
+    the op identity, row-reduced to one value per doc. op="count" needs no
+    LUT at all — it counts non-sentinel slots (lut_param None, card set).
+    Lowers SUMMV / COUNTMV / MINMV / MAXMV / AVGMV onto the standard
+    scalar agg kernels (reference SumMVAggregationFunction et al., which
+    loop per-doc value arrays — here the ragged column is a rectangular
+    matrix and the reduce is one fused device op)."""
+
+    ids_slot: int
+    lut_param: Optional[int]
+    op: str  # sum | min | max | count
+    card: Optional[int] = None  # count: the pad sentinel id
+
+
 # ---------------------------------------------------------------------------
 # Filter nodes (→ reference BaseFilterOperator tree,
 # pinot-core/.../operator/filter/; predicates become vector compares)

@@ -183,6 +183,38 @@ class SegmentPlanner(AggPlanContext):
         kind = "ids" if m.single_value else "mvids"
         return self.slot(e.identifier, kind), m.cardinality, self.segment.get_dictionary(e.identifier)
 
+    def mv_reduce_expr(self, e: ExpressionContext, op: str):
+        """(vexpr, vmin, vmax) per-doc reduce of a numeric MV dict column
+        (for SUMMV-family aggs): lut[id] over the (docs, max_mv) id matrix
+        with the pad sentinel's lut slot holding the op identity, so
+        row-reduces need no mask. op="count" is a param-free non-sentinel
+        count. vmin/vmax bound the per-doc result when known (lets integer
+        sums take the exact kernel paths). None → host fallback
+        (raw/var-width/non-numeric MV)."""
+        if not e.is_identifier:
+            return None
+        m = self._meta(e.identifier)
+        if m.single_value or m.encoding != "DICT":
+            return None
+        slot, card, d = self.dict_info(e)
+        max_mv = max(1, m.max_number_of_multi_values)
+        if op == "count":
+            return ir.MvLutReduce(slot, None, "count", card=card), 0, max_mv
+        vals = np.asarray(d.values)
+        if vals.dtype.kind not in "iuf":
+            return None
+        if op == "sum" and vals.dtype.kind in "iu":
+            # int64 entries and int64 row-sums: exact, like the host's
+            # np.sum over the flattened int column
+            lut = np.concatenate([vals.astype(np.int64),
+                                  np.zeros(1, np.int64)])
+            vmin = min(0, max_mv * int(vals[0]))
+            vmax = max(0, max_mv * int(vals[-1]))
+            return ir.MvLutReduce(slot, self.param(lut), "sum"), vmin, vmax
+        ident = {"sum": 0.0, "min": np.inf, "max": -np.inf}[op]
+        lut = np.concatenate([vals.astype(np.float64), [ident]])
+        return ir.MvLutReduce(slot, self.param(lut), op), None, None
+
     def col_minmax(self, e: ExpressionContext):
         """(min, max) stats for a plain numeric column, else None — feeds
         fixed-bin device histograms (percentile approx on raw columns)."""

@@ -59,6 +59,16 @@ def _eval_value(node: ir.ValueExpr, arrays, params):
             _eval_value(node.a, arrays, params),
             _eval_value(node.b, arrays, params),
         )
+    if isinstance(node, ir.MvLutReduce):
+        if node.op == "count":  # non-pad slots per doc; no LUT gather
+            return (arrays[node.ids_slot] != node.card).sum(
+                axis=1).astype(jnp.int32)
+        vals = params[node.lut_param][arrays[node.ids_slot]]  # (n, max_mv)
+        if node.op == "sum":
+            return vals.sum(axis=1)
+        if node.op == "min":
+            return vals.min(axis=1)
+        return vals.max(axis=1)
     raise TypeError(f"unknown value node {node}")
 
 

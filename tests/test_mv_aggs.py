@@ -1,0 +1,125 @@
+"""Device MV value aggregations: SUMMV / COUNTMV / MINMV / MAXMV / AVGMV /
+MINMAXRANGEMV lower to per-doc row-reduces of the rectangular MV id matrix
+(ir.MvLutReduce) and ride the standard scalar agg kernels.
+
+Reference: SumMVAggregationFunction / CountMVAggregationFunction et al.
+(pinot-core/.../function/), which loop per-doc value arrays; host oracle =
+engine/host_executor.py flattening matched docs' entries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.plan import SegmentPlanner
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.query.parser.sql import parse_sql
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "mvt",
+    dimensions=[("g", "INT"), ("vals", "INT", False), ("tags", "STRING", False)],
+    metrics=[("m", "INT")])
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    rng = np.random.default_rng(17)
+    d = tmp_path_factory.mktemp("mv")
+    n = 4000
+    segs = []
+    for si in range(2):
+        vals, tags = [], []
+        for _ in range(n):
+            k = int(rng.integers(0, 4))  # 0..3 entries (empty rows included)
+            vals.append([int(x) for x in rng.integers(-50, 200, k)])
+            tags.append([f"t{int(x)}" for x in rng.integers(0, 6, k)])
+        cols = {"g": rng.integers(0, 12, n).astype(np.int32),
+                "vals": vals, "tags": tags,
+                "m": rng.integers(0, 100, n).astype(np.int32)}
+        SegmentBuilder(SCHEMA, segment_name=f"s{si}").build(cols, d / f"s{si}")
+        segs.append(load_segment(d / f"s{si}"))
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(SCHEMA, segs)
+    host = QueryExecutor(backend="host")
+    host.add_table(SCHEMA, segs)
+    return tpu, host, segs
+
+
+def _rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return [[round(v, 6) if isinstance(v, float) else v for v in r]
+            for r in resp.result_table.rows]
+
+
+QUERIES = [
+    "SELECT SUMMV(vals), COUNTMV(vals) FROM mvt",
+    "SELECT MINMV(vals), MAXMV(vals), AVGMV(vals) FROM mvt",
+    "SELECT MINMAXRANGEMV(vals) FROM mvt",
+    "SELECT SUMMV(vals), COUNTMV(vals) FROM mvt WHERE m > 50",
+    "SELECT g, SUMMV(vals), COUNTMV(vals), AVGMV(vals) FROM mvt "
+    "GROUP BY g ORDER BY g LIMIT 20",
+    "SELECT g, MINMV(vals), MAXMV(vals) FROM mvt WHERE m < 80 "
+    "GROUP BY g ORDER BY g LIMIT 20",
+    # MV filter + MV agg together
+    "SELECT g, COUNTMV(vals) FROM mvt WHERE tags = 't3' "
+    "GROUP BY g ORDER BY g LIMIT 20",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_device_host_parity(env, sql):
+    tpu, host, _ = env
+    assert _rows(tpu.execute_sql(sql)) == _rows(host.execute_sql(sql))
+
+
+def test_plans_on_device_without_fallback(env):
+    _, _, segs = env
+    q = parse_sql("SELECT g, SUMMV(vals), COUNTMV(vals) FROM mvt GROUP BY g")
+    plan = SegmentPlanner(q, segs[0]).plan()  # raises if not device-plannable
+    kinds = [op.kind for op in plan.program.aggs]
+    assert kinds.count("sum") == 2
+
+
+def test_countmv_counts_entries_not_docs(env):
+    tpu, _, segs = env
+    r = tpu.execute_sql("SELECT COUNTMV(vals), COUNT(*) FROM mvt")
+    entries, docs = r.result_table.rows[0]
+    total = sum(len(row) for s in segs for row in s.get_mv_values("vals"))
+    assert int(entries) == total
+    assert int(docs) == sum(s.num_docs for s in segs)
+    assert int(entries) != int(docs)
+
+
+def test_summv_big_int64_exact(tmp_path):
+    """SUMMV over LONG entries ~1e15 must be integer-exact on device: the
+    LUT stays int64 and per-doc row-sums accumulate in int64 (a float64
+    LUT would round each entry by ~0.125 at this magnitude)."""
+    schema = Schema.build(
+        "big", dimensions=[("g", "INT"), ("v", "LONG", False)], metrics=[])
+    base = 10**15
+    vals = [[base + 1, base + 3], [base + 7], [], [base + 1, base + 9, base + 11]]
+    cols = {"g": np.asarray([0, 0, 1, 1], np.int32), "v": vals}
+    SegmentBuilder(schema, segment_name="b").build(cols, tmp_path / "b")
+    seg = load_segment(tmp_path / "b")
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, [seg])
+    r = tpu.execute_sql("SELECT g, SUMMV(v), COUNTMV(v) FROM big "
+                        "GROUP BY g ORDER BY g")
+    assert not r.exceptions, r.exceptions
+    got = [(int(a), int(b), int(c)) for a, b, c in r.result_table.rows]
+    assert got == [(0, 3 * base + 11, 3), (1, 3 * base + 21, 3)]
+
+
+def test_string_mv_value_agg_falls_back(env):
+    """SUMMV over a STRING MV column has no device form; auto backend must
+    still answer (host), strict tpu must raise cleanly."""
+    _, _, segs = env
+    q = parse_sql("SELECT MINMV(tags) FROM mvt")
+    from pinot_tpu.engine.aggregation import UnsupportedQueryError
+
+    with pytest.raises(UnsupportedQueryError):
+        SegmentPlanner(q, segs[0]).plan()

@@ -183,7 +183,7 @@ def test_committer_crash_reelection_end_to_end(registry, tmp_path):
     registry.create_topic("ev2", num_partitions=1)
     store = PropertyStore()
     completion = SegmentCompletionManager(store, num_replicas=2,
-                                          commit_lease_s=0.4,
+                                          commit_lease_s=1.5,
                                           decision_wait_s=3)
     cfg = table_config("ev2")
     killed = {"done": False}
@@ -232,8 +232,8 @@ def test_chaos_replica_killed_mid_ingestion_recovers(registry, tmp_path):
     registry.create_topic("ev3", num_partitions=1)
     store = PropertyStore()
     completion = SegmentCompletionManager(store, num_replicas=2,
-                                          commit_lease_s=0.4,
-                                          decision_wait_s=0.2)
+                                          commit_lease_s=1.5,
+                                          decision_wait_s=0.5)
     cfg = table_config("ev3", flush_rows=20)
     a = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
                                  completion=completion, instance_id="A")
