@@ -85,6 +85,12 @@ class TpuSegmentExecutor:
 
     def _group_by_result(self, plan: SegmentPlan, outs) -> GroupByIntermediate:
         num_groups = plan.program.num_groups
+        mv_docs = None
+        if plan.program.mv_group_slot is not None:
+            # MV expansion: pair counts ≠ docs; the kernel appends the
+            # matched DOC count as one extra trailing output
+            mv_docs = int(outs[-1][0])
+            outs = outs[:-1]
         counts = outs[0][:num_groups]
         gids = np.nonzero(counts)[0]
         if plan.program.mode == "group_by_sparse":
@@ -109,6 +115,8 @@ class TpuSegmentExecutor:
             scanned += trash
             # an ORDER-BY-pushdown trim is exact — not a groups-limit event
             trimmed = trash > 0 and not plan.program.exact_trim
+        if mv_docs is not None:
+            scanned = mv_docs  # docs matched, not (doc × entry) pairs
         if all(la.vec is not None for la in plan.lowered_aggs):
             # columnar fast path: states stay numpy end-to-end (dict form
             # costs ~µs/group in Python — fatal at numGroupsLimit scale)

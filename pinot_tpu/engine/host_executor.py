@@ -312,6 +312,10 @@ class HostSegmentExecutor:
         return host_state_full(name, cols, extra)
 
     def _group_by(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
+        if any(e.is_identifier and segment.has_column(e.identifier)
+               and not segment.column_metadata(e.identifier).single_value
+               for e in group_exprs):
+            return self._group_by_mv(query, segment, mask, group_exprs)
         key_cols = [np.asarray(self.eval_value(e, segment)) for e in group_exprs]
         sel = np.nonzero(mask)[0]
         fast = self._group_by_vectorized(query, segment, sel, key_cols, mask)
@@ -331,28 +335,7 @@ class HostSegmentExecutor:
         boundaries = np.nonzero(np.diff(codes_sorted))[0] + 1
         starts = np.concatenate([[0], boundaries])
         ends = np.concatenate([boundaries, [len(sel_sorted)]])
-        agg_args = []
-        mv_cache: dict[str, object] = {}  # column → decoded rows, once
-        for agg in query.aggregations:
-            if agg.function.name == "count":
-                agg_args.append(("count", None, ()))
-            else:
-                data, extra = split_args(agg.function)
-                if (len(data) == 1 and data[0].is_identifier
-                        and segment.has_column(data[0].identifier)
-                        and not segment.column_metadata(
-                            data[0].identifier).single_value):
-                    # MV argument: per group, aggregate over ALL entries of
-                    # the group's rows (same flattening as the ungrouped
-                    # _agg_state MV branch)
-                    col = data[0].identifier
-                    if col not in mv_cache:
-                        mv_cache[col] = segment.get_mv_values(col)
-                    agg_args.append(("mv", mv_cache[col], extra))
-                else:
-                    agg_args.append(
-                        ("sv", [np.asarray(self.eval_value(a, segment))
-                                for a in data], extra))
+        agg_args = self._classify_agg_args(query, segment)
         for s, e in zip(starts, ends):
             if s == e:
                 continue
@@ -370,6 +353,91 @@ class HostSegmentExecutor:
                     states.append(
                         host_state_full(agg.function.name, [c[rows] for c in cols], extra))
             groups[key] = states
+        return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
+
+    def _classify_agg_args(self, query, segment) -> list:
+        """Per aggregation: ("count", None, ()) | ("mv", decoded rows,
+        extra) — the MV column decoded ONCE per query — | ("sv", eval'd
+        value arrays, extra). Shared by the SV and MV group-by paths."""
+        agg_args = []
+        mv_cache: dict[str, object] = {}
+        for agg in query.aggregations:
+            if agg.function.name == "count":
+                agg_args.append(("count", None, ()))
+                continue
+            data, extra = split_args(agg.function)
+            if (len(data) == 1 and data[0].is_identifier
+                    and segment.has_column(data[0].identifier)
+                    and not segment.column_metadata(
+                        data[0].identifier).single_value):
+                # MV argument: per group, aggregate over ALL entries of the
+                # group's rows (same flattening as the ungrouped _agg_state
+                # MV branch)
+                col = data[0].identifier
+                if col not in mv_cache:
+                    mv_cache[col] = segment.get_mv_values(col)
+                agg_args.append(("mv", mv_cache[col], extra))
+            else:
+                agg_args.append(
+                    ("sv", [np.asarray(self.eval_value(a, segment))
+                            for a in data], extra))
+        return agg_args
+
+    def _group_by_mv(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
+        """MV group key(s): one expanded row per (doc × entry) combination
+        per MV dim (cross product when several) — a doc contributes to the
+        group of EACH of its values, and docs with empty arrays drop out
+        (reference MVGroupKeyGenerator). Docs scanned counts matched DOCS,
+        not expanded rows."""
+        sel = np.nonzero(mask)[0]
+        docs = sel
+        expanded: dict[int, np.ndarray] = {}
+        for di, e in enumerate(group_exprs):
+            if not (e.is_identifier and segment.has_column(e.identifier)
+                    and not segment.column_metadata(e.identifier).single_value):
+                continue
+            rows = segment.get_mv_values(e.identifier)
+            lens = np.fromiter((len(rows[d]) for d in docs),
+                               dtype=np.int64, count=len(docs))
+            vals = [v for d in docs for v in rows[d]]
+            for k in expanded:
+                expanded[k] = np.repeat(expanded[k], lens)
+            docs = np.repeat(docs, lens)
+            expanded[di] = np.asarray(vals, dtype=object)
+        key_cols = []
+        for di, e in enumerate(group_exprs):
+            if di in expanded:
+                key_cols.append(expanded[di])
+            else:
+                key_cols.append(np.asarray(self.eval_value(e, segment))[docs])
+
+        agg_args = self._classify_agg_args(query, segment)
+
+        groups: dict[tuple, list] = {}
+        order = np.lexsort([np.asarray([repr(v) for v in c], dtype=object)
+                            for c in reversed(key_cols)]) \
+            if key_cols and len(docs) else np.arange(len(docs))
+        # group contiguity via sorted tuples
+        keys_sorted = [tuple(_to_python(c[i]) for c in key_cols) for i in order]
+        i = 0
+        while i < len(order):
+            j = i
+            while j < len(order) and keys_sorted[j] == keys_sorted[i]:
+                j += 1
+            rows_idx = docs[order[i:j]]
+            states = []
+            for agg, (kind, cols, extra) in zip(query.aggregations, agg_args):
+                if kind == "count":
+                    states.append(j - i)
+                elif kind == "mv":
+                    flat = [v for d in rows_idx for v in cols[d]]
+                    states.append(
+                        host_state(agg.function.name, np.asarray(flat), extra))
+                else:
+                    states.append(host_state_full(
+                        agg.function.name, [c[rows_idx] for c in cols], extra))
+            groups[keys_sorted[i]] = states
+            i = j
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
 
     # scalar aggs with a columnar (GroupArrays) host form: same set the

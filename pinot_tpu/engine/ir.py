@@ -264,3 +264,16 @@ class Program:
     # sparse mode: the device trim is an ORDER BY pushdown (ASC group-key
     # prefix + LIMIT) — result is exact, so don't flag numGroupsLimitReached
     exact_trim: bool = False
+    # MV group-by: ONE group dim may be a multi-value column. The kernel
+    # expands (doc × mv-slot) pairs up front — every 1-D plane broadcasts
+    # across the MV width, the MV id matrix flattens, non-entries mask off
+    # — then the dense/sparse machinery runs unchanged on the pairs
+    # (reference MVGroupKeyGenerator emits one group key per MV entry).
+    # Group-by outputs gain ONE extra trailing (1,) int64: matched DOC
+    # count (pair counts no longer equal docs scanned).
+    mv_group_slot: Optional[int] = None
+    mv_group_card: Optional[int] = None
+    # slots holding per-DOC 1-D planes (ids/raw/null) that the expansion
+    # must broadcast across the MV width — dictionary planes are
+    # cardinality-sized and must pass through untouched
+    mv_doc_slots: tuple = ()

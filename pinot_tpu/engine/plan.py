@@ -40,6 +40,31 @@ DEFAULT_NUM_GROUPS_LIMIT = 100_000  # reference InstancePlanMakerImplV2 default
 _SPARSE_AGG_KINDS = {"count", "sum", "sumsq", "min", "max"}
 
 
+def _vexpr_uses_slots(ve, slots: set) -> bool:
+    """True when a value expression reads any of the given array slots."""
+    if ve is None:
+        return False
+    if isinstance(ve, (ir.Col, ir.IdsCol)):
+        return ve.slot in slots
+    if isinstance(ve, ir.DictGather):
+        return ve.ids_slot in slots or ve.dict_slot in slots
+    if isinstance(ve, ir.MvLutReduce):
+        return True  # always reads an MV matrix
+    if isinstance(ve, ir.ParamGather):
+        return _vexpr_uses_slots(ve.ids, slots)
+    if isinstance(ve, ir.Bin):
+        return _vexpr_uses_slots(ve.a, slots) or _vexpr_uses_slots(ve.b, slots)
+    if isinstance(ve, ir.Un):
+        return _vexpr_uses_slots(ve.a, slots)
+    if isinstance(ve, ir.Cast):
+        return _vexpr_uses_slots(ve.a, slots)
+    if isinstance(ve, ir.Where):
+        return (_vexpr_uses_slots(ve.cond, slots)
+                or _vexpr_uses_slots(ve.a, slots)
+                or _vexpr_uses_slots(ve.b, slots))
+    return False
+
+
 def _orderby_prefix_trim(q) -> "int | None":
     """offset+limit when ORDER BY is ALL the group-by keys, in stride
     order, all ASC with default null ordering and no HAVING — the shape
@@ -201,8 +226,8 @@ class SegmentPlanner(AggPlanContext):
         if op == "count":
             return ir.MvLutReduce(slot, None, "count", card=card), 0, max_mv
         vals = np.asarray(d.values)
-        if vals.dtype.kind not in "iuf":
-            return None
+        if vals.dtype.kind not in "iuf" or not len(vals):
+            return None  # non-numeric, or every row empty (no dictionary)
         if op == "sum" and vals.dtype.kind in "iu":
             # int64 entries and int64 row-sums: exact, like the host's
             # np.sum over the flattened int column
@@ -578,15 +603,22 @@ class SegmentPlanner(AggPlanContext):
             group_vexprs = []
             cards = []
             any_derived = False
+            mv_group_slot = mv_group_card = None
             for ge in group_exprs:
                 if ge.is_identifier:
                     info = self.dict_info(ge)
                     if info is None:
                         raise UnsupportedQueryError(f"group-by on non-dict column {ge}")
                     m = self._meta(ge.identifier)
-                    if not m.single_value:
-                        raise UnsupportedQueryError("group-by on MV column needs host path")
                     slot, card, d = info
+                    if not m.single_value:
+                        # ONE MV dim: the kernel expands (doc × mv-slot)
+                        # pairs; a second would need a per-doc cross
+                        # product (host path handles it)
+                        if mv_group_slot is not None:
+                            raise UnsupportedQueryError(
+                                "group-by on two MV columns needs host path")
+                        mv_group_slot, mv_group_card = slot, card
                     group_slots.append(slot)
                     group_vexprs.append(ir.IdsCol(slot))
                     cards.append(card)
@@ -617,6 +649,20 @@ class SegmentPlanner(AggPlanContext):
             # groups × dict-card fits the dense table
             self.group_card_hint = num_groups
             lowered = [lower_aggregation(self, a) for a in q.aggregations]
+            if mv_group_slot is not None:
+                if any_derived:
+                    raise UnsupportedQueryError(
+                        "MV group-by with expression keys needs host path")
+                # expansion rewires every 1-D plane: aggs referencing MV
+                # matrices (another MV column, or MvLutReduce of this one)
+                # would see the wrong shape — host path handles the combo
+                mv_slots = {i for i, (_c, k) in enumerate(self._slots)
+                            if k == "mvids"}
+                for op in self.ops:
+                    if (op.ids_slot in mv_slots
+                            or _vexpr_uses_slots(op.vexpr, mv_slots)):
+                        raise UnsupportedQueryError(
+                            "MV aggregation with MV group-by needs host path")
             # mode selection: dense when the key product AND every matrix
             # occupancy fit the segment_sum table; otherwise the sort-based
             # sparse path when every op supports it (scalar reductions +
@@ -689,6 +735,12 @@ class SegmentPlanner(AggPlanContext):
                 group_vexprs=tuple(group_vexprs) if any_derived else (),
                 key_space=num_groups if mode == "group_by_sparse" else 0,
                 exact_trim=exact_trim,
+                mv_group_slot=mv_group_slot if mode != "aggregation" else None,
+                mv_group_card=mv_group_card if mode != "aggregation" else None,
+                mv_doc_slots=tuple(
+                    i for i, (_c, k) in enumerate(self._slots)
+                    if k in ("ids", "raw", "null"))
+                if mv_group_slot is not None else (),
             )
             return SegmentPlan(program, self._slots, self._params, lowered, group_dims)
 

@@ -285,30 +285,58 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
     if program.mode == "selection":
         return (mask,)
 
+    if program.mv_group_slot is not None and program.mode in (
+            "group_by", "group_by_sparse"):
+        # MV group dim: expand to (doc × mv-slot) pairs — broadcast every
+        # 1-D plane across the MV width, flatten the MV id matrix, mask
+        # off pad slots — and let the dense/sparse paths run unchanged.
+        # Matched DOCS are counted pre-expansion (pair counts ≠ docs).
+        scanned_docs = mask.astype(jnp.int32).sum().astype(jnp.int64)[None]
+        mv = arrays[program.mv_group_slot]  # (n, max_mv) int32
+        width = mv.shape[1]
+        doc_slots = set(program.mv_doc_slots)
+        arrays = tuple(
+            mv.reshape(-1) if i == program.mv_group_slot
+            else (jnp.broadcast_to(a[:, None], (n, width)).reshape(-1)
+                  if i in doc_slots else a)  # dict planes / filter-only MV
+            for i, a in enumerate(arrays))  # matrices pass through
+        mask = (mask[:, None] & (mv != program.mv_group_card)).reshape(-1)
+        n = n * width
+        if program.mode == "group_by_sparse":
+            outs = _run_sparse_group_by(program, arrays, params, mask, n)
+        else:
+            outs = _dense_group_by_entry(program, arrays, params, mask, n)
+        return outs + (scanned_docs,)
+
     if program.mode == "group_by_sparse":
         return _run_sparse_group_by(program, arrays, params, mask, n)
 
-    num_groups = program.num_groups
-    if program.mode == "group_by":
-        gid = jnp.zeros((n,), dtype=jnp.int32)
-        if program.group_vexprs:
-            for vexpr, stride in zip(program.group_vexprs, program.group_strides):
-                v = _eval_value(vexpr, arrays, params)
-                gid = gid + v.astype(jnp.int32) * jnp.int32(stride)
-        else:
-            for slot, stride in zip(program.group_slots, program.group_strides):
-                gid = gid + arrays[slot].astype(jnp.int32) * jnp.int32(stride)
-    else:
+    if program.mode != "group_by":
         # un-grouped aggregation: NO scatter at all — plain masked
         # reductions shaped (value, trash) to keep the output contract.
         # Scatters to a 2-slot table were pure overhead (and 64-bit
         # scatters are emulated on TPU)
         return _run_ungrouped(program, arrays, params, mask, n)
-    trash = jnp.int32(num_groups)
+    return _dense_group_by_entry(program, arrays, params, mask, n)
+
+
+def _dense_group_by_entry(program: ir.Program, arrays, params, mask, n):
+    """Dense group-by gid assembly + dispatch, shared by the SV path and
+    the MV (doc × mv-slot) pre-expanded path — after expansion the MV
+    dim's flattened ids are just another id plane; pad slots are already
+    masked → trash."""
+    gid = jnp.zeros((n,), dtype=jnp.int32)
+    if program.group_vexprs:
+        for vexpr, stride in zip(program.group_vexprs, program.group_strides):
+            v = _eval_value(vexpr, arrays, params)
+            gid = gid + v.astype(jnp.int32) * jnp.int32(stride)
+    else:
+        for slot, stride in zip(program.group_slots, program.group_strides):
+            gid = gid + arrays[slot].astype(jnp.int32) * jnp.int32(stride)
+    trash = jnp.int32(program.num_groups)
     gid = jnp.where(mask, gid, trash)
-    num_segments = num_groups + 1
     return _run_dense_group_by(program, arrays, params, mask, gid,
-                               num_segments, n)
+                               program.num_groups + 1, n)
 
 
 def _run_dense_group_by(program: ir.Program, arrays, params, mask, gid,

@@ -129,6 +129,11 @@ def run_program_row_sharded(program: ir.Program, arrays: tuple, params: tuple,
         # keyed (sorted) outputs can't psum-merge across shards; the caller
         # runs sparse programs whole-segment and merges at combine instead
         raise ValueError("sparse group-by does not row-shard; run unsharded")
+    if program.mv_group_slot is not None:
+        # the MV expansion's trailing scanned-docs output has no psum merge
+        # wired; run whole-segment (matrix planes also shard per-doc rows
+        # only, which _combine_collectives does not model)
+        raise ValueError("MV group-by does not row-shard; run unsharded")
     n_shards = mesh.shape[ROW_AXIS]
     assert padded % n_shards == 0, (padded, n_shards)
     kinds = tuple(kind for _col, kind in slots) if slots else tuple(
