@@ -350,6 +350,19 @@ class AggPlanContext:
         back to host."""
         return None
 
+    # advanced null handling hooks (SegmentPlanner overrides; the defaults
+    # are the basic-mode behavior)
+    null_handling = False
+
+    def agg_operand(self, e: ExpressionContext, identity):
+        return self.value_expr(e)
+
+    def nonnull_count_op(self, e: ExpressionContext) -> int:
+        return 0
+
+    def _null_cond_for(self, e: ExpressionContext):
+        return None
+
     def dict_info(self, e: ExpressionContext, sv_only: bool = False):  # pragma: no cover
         raise NotImplementedError
 
@@ -374,6 +387,10 @@ def _lower_mv_value_agg(ctx: AggPlanContext, name: str, label: str,
     semantics flatten all entries of matched docs — identical totals."""
 
     def op(kind: str) -> int:
+        if ctx._null_cond_for(arg) is not None:
+            raise UnsupportedQueryError(
+                f"{name} over nullable {arg} with enableNullHandling "
+                "runs on the host engine")
         r = ctx.mv_reduce_expr(arg, kind)
         if r is None:
             raise UnsupportedQueryError(
@@ -425,14 +442,24 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
     sem = get_semantics(name, extra)
 
     if name == "count":
+        # advanced null handling: COUNT(col) counts non-null rows
+        i = ctx.nonnull_count_op(data[0]) if data else 0
         spec, tag = VEC_RECIPES["count"]
         return LoweredAgg(
-            label, sem, lambda outs, g: int(outs[0][g]),
-            vec=VecAgg(spec, lambda outs, gids: (outs[0][gids],), tag))
+            label, sem, lambda outs, g: int(outs[i][g]),
+            vec=VecAgg(spec, lambda outs, gids: (outs[i][gids],), tag))
 
     if name in ("sum", "min", "max"):
-        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.value_expr(data[0]),
-                                **_int_bounds(ctx, data[0])))
+        ident = {"sum": 0, "min": "inf", "max": "-inf"}[name]
+        bounds = _int_bounds(ctx, data[0])
+        if bounds and ctx._null_cond_for(data[0]) is not None:
+            if name == "sum":  # null rows contribute identity 0
+                bounds = {"vmin": min(0, bounds["vmin"]),
+                          "vmax": max(0, bounds["vmax"])}
+            else:  # min/max compare in f64 with ±inf identities
+                bounds = {}
+        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.agg_operand(data[0], ident),
+                                **bounds))
         spec, tag = VEC_RECIPES[name]
         return LoweredAgg(
             label, sem, lambda outs, g: float(outs[i][g]),
@@ -444,8 +471,8 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
         return _lower_mv_value_agg(ctx, name, label, sem, data[0])
 
     if name == "minmaxrange":
-        i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.value_expr(data[0])))
-        i_max = ctx.add_op(ir.AggOp("max", vexpr=ctx.value_expr(data[0])))
+        i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.agg_operand(data[0], "inf")))
+        i_max = ctx.add_op(ir.AggOp("max", vexpr=ctx.agg_operand(data[0], "-inf")))
         spec, tag = VEC_RECIPES["minmaxrange"]
         return LoweredAgg(
             label, sem,
@@ -456,16 +483,31 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
                        tag))
 
     if name == "avg":
-        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.value_expr(data[0]),
-                                **_int_bounds(ctx, data[0])))
+        bounds = _int_bounds(ctx, data[0])
+        if bounds and ctx._null_cond_for(data[0]) is not None:
+            bounds = {"vmin": min(0, bounds["vmin"]),
+                      "vmax": max(0, bounds["vmax"])}
+        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.agg_operand(data[0], 0),
+                                **bounds))
+        # advanced null handling: divide by the NON-NULL count
+        c = ctx.nonnull_count_op(data[0])
         spec, tag = VEC_RECIPES["avg"]
         return LoweredAgg(
             label, sem,
-            lambda outs, g: (float(outs[i][g]), int(outs[0][g])),
+            lambda outs, g: (float(outs[i][g]), int(outs[c][g])),
             vec=VecAgg(spec,
-                       lambda outs, gids, _i=i: (outs[_i][gids].astype(float),
-                                                 outs[0][gids]),
+                       lambda outs, gids, _i=i, _c=c: (
+                           outs[_i][gids].astype(float), outs[_c][gids]),
                        tag))
+
+    # branches below don't have device null-skipping forms; under advanced
+    # null handling a nullable operand routes to the host engine (which
+    # drops null rows before building states)
+    for a in data:
+        if ctx._null_cond_for(a) is not None:
+            raise UnsupportedQueryError(
+                f"{name} over nullable {a} with enableNullHandling "
+                "runs on the host engine")
 
     if name in ("distinctcount", "distinctcountbitmap", "segmentpartitioneddistinctcount",
                 "distinctsum", "distinctavg"):

@@ -34,7 +34,8 @@ from .selection import selection_from_mask
 
 class HostSegmentExecutor:
     def execute(self, query: QueryContext, segment: ImmutableSegment):
-        mask = self._filter_mask(query.filter, segment)
+        mask = self._filter_mask(query.filter, segment,
+                                 nh=query.null_handling)
         if query.is_aggregation_query or query.distinct or query.is_group_by:
             group_exprs = list(query.group_by_expressions)
             if query.distinct and not query.is_aggregation_query:
@@ -45,9 +46,15 @@ class HostSegmentExecutor:
         return self._selection(query, segment, mask)
 
     # -- filter ------------------------------------------------------------
-    def _filter_mask(self, f, segment: ImmutableSegment) -> np.ndarray:
+    def _filter_mask(self, f, segment: ImmutableSegment,
+                     nh: bool = False) -> np.ndarray:
         n = segment.num_docs
-        mask = np.ones(n, dtype=bool) if f is None else self._eval_filter(f, segment)
+        if f is None:
+            mask = np.ones(n, dtype=bool)
+        elif nh:
+            mask, _unknown = self._eval_filter3(f, segment)
+        else:
+            mask = self._eval_filter(f, segment)
         vd = getattr(segment, "valid_doc_ids", None)
         if vd is not None:  # upsert validity plane (see plan._and_valid_docs)
             mask = mask & vd.mask(n)
@@ -70,6 +77,48 @@ class HostSegmentExecutor:
         if f.type == FilterNodeType.CONSTANT:
             return np.full(n, f.constant_value, dtype=bool)
         return self._eval_predicate(f.predicate, segment)
+
+    def _eval_filter3(self, f: FilterContext, segment):
+        """Kleene 3-valued evaluation → (definitely-true, unknown) masks;
+        mirrors plan.SegmentPlanner._lower_filter3."""
+        n = segment.num_docs
+        if f.type == FilterNodeType.AND:
+            t = np.ones(n, dtype=bool)
+            tu = np.ones(n, dtype=bool)  # true-or-unknown
+            for c in f.children:
+                ct, cu = self._eval_filter3(c, segment)
+                t &= ct
+                tu &= ct | cu
+            return t, tu & ~t
+        if f.type == FilterNodeType.OR:
+            t = np.zeros(n, dtype=bool)
+            u = np.zeros(n, dtype=bool)
+            for c in f.children:
+                ct, cu = self._eval_filter3(c, segment)
+                t |= ct
+                u |= cu
+            return t, u & ~t
+        if f.type == FilterNodeType.NOT:
+            ct, cu = self._eval_filter3(f.children[0], segment)
+            return ~ct & ~cu, cu
+        if f.type == FilterNodeType.CONSTANT:
+            return (np.full(n, f.constant_value, dtype=bool),
+                    np.zeros(n, dtype=bool))
+        m = self._eval_predicate(f.predicate, segment)
+        if f.predicate.type in (PredicateType.IS_NULL,
+                                PredicateType.IS_NOT_NULL):
+            return m, np.zeros(n, dtype=bool)
+        u = self._nulls_of(f.predicate.lhs.columns(), segment, n)
+        return m & ~u, u
+
+    def _nulls_of(self, cols, segment, n) -> np.ndarray:
+        out = np.zeros(n, dtype=bool)
+        for c in sorted(cols):
+            if segment.has_column(c):
+                nb = segment.get_null_bitmap(c)
+                if nb is not None:
+                    out |= nb
+        return out
 
     def _eval_predicate(self, p: Predicate, segment) -> np.ndarray:
         n = segment.num_docs
@@ -290,16 +339,24 @@ class HostSegmentExecutor:
 
     # -- shapes ------------------------------------------------------------
     def _aggregation(self, query, segment, mask) -> AggIntermediate:
+        nh = query.null_handling
         states = []
         for agg in query.aggregations:
-            states.append(self._agg_state(agg, segment, mask))
+            states.append(self._agg_state(agg, segment, mask, nh))
         return AggIntermediate(states, num_docs_scanned=int(mask.sum()))
 
-    def _agg_state(self, agg: ExpressionContext, segment, mask):
+    def _agg_state(self, agg: ExpressionContext, segment, mask, nh=False):
         name = agg.function.name
+        data, extra = split_args(agg.function)
+        if nh and data:
+            # skip rows where ANY operand column is null (COUNT(expr) too;
+            # multi-arg states must stay row-aligned)
+            cols_ref = set().union(*(a.columns() for a in data)) - {"*"}
+            drop = self._nulls_of(cols_ref, segment, segment.num_docs)
+            if drop.any():
+                mask = mask & ~drop
         if name == "count":
             return int(mask.sum())
-        data, extra = split_args(agg.function)
         arg = data[0] if data else None
         if (len(data) == 1 and arg.is_identifier and segment.has_column(arg.identifier)
                 and not segment.column_metadata(arg.identifier).single_value):
@@ -342,30 +399,47 @@ class HostSegmentExecutor:
             rows = sel_sorted[s:e]
             key = tuple(_to_python(col[rows[0]]) for col in key_cols)
             states = []
-            for agg, (kind, cols, extra) in zip(query.aggregations, agg_args):
+            for agg, (kind, cols, extra, drop) in zip(query.aggregations,
+                                                      agg_args):
+                r = rows if drop is None else rows[~drop[rows]]
                 if kind == "count":
-                    states.append(len(rows))
+                    states.append(len(r))
                 elif kind == "mv":
-                    flat = [v for i in rows for v in cols[i]]
+                    flat = [v for i in r for v in cols[i]]
                     states.append(
                         host_state(agg.function.name, np.asarray(flat), extra))
                 else:
                     states.append(
-                        host_state_full(agg.function.name, [c[rows] for c in cols], extra))
+                        host_state_full(agg.function.name, [c[r] for c in cols], extra))
             groups[key] = states
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
 
     def _classify_agg_args(self, query, segment) -> list:
-        """Per aggregation: ("count", None, ()) | ("mv", decoded rows,
-        extra) — the MV column decoded ONCE per query — | ("sv", eval'd
-        value arrays, extra). Shared by the SV and MV group-by paths."""
+        """Per aggregation: (kind, payload, extra, drop) where kind is
+        "count" | "mv" (MV column decoded ONCE per query) | "sv" (eval'd
+        value arrays) and drop is the advanced-null-handling bitmap of rows
+        to skip for this agg (None = keep all). Shared by the SV and MV
+        group-by paths."""
+        nh = query.null_handling
+        n = segment.num_docs
         agg_args = []
         mv_cache: dict[str, object] = {}
+
+        def drop_for(exprs):
+            if not nh:
+                return None
+            cols = set()
+            for a in exprs:
+                cols |= a.columns()
+            d = self._nulls_of(cols - {"*"}, segment, n)
+            return d if d.any() else None
+
         for agg in query.aggregations:
-            if agg.function.name == "count":
-                agg_args.append(("count", None, ()))
-                continue
             data, extra = split_args(agg.function)
+            if agg.function.name == "count":
+                # advanced null handling: COUNT(col) counts non-null rows
+                agg_args.append(("count", None, (), drop_for(data)))
+                continue
             if (len(data) == 1 and data[0].is_identifier
                     and segment.has_column(data[0].identifier)
                     and not segment.column_metadata(
@@ -376,11 +450,11 @@ class HostSegmentExecutor:
                 col = data[0].identifier
                 if col not in mv_cache:
                     mv_cache[col] = segment.get_mv_values(col)
-                agg_args.append(("mv", mv_cache[col], extra))
+                agg_args.append(("mv", mv_cache[col], extra, drop_for(data)))
             else:
                 agg_args.append(
                     ("sv", [np.asarray(self.eval_value(a, segment))
-                            for a in data], extra))
+                            for a in data], extra, drop_for(data)))
         return agg_args
 
     def _group_by_mv(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
@@ -426,16 +500,18 @@ class HostSegmentExecutor:
                 j += 1
             rows_idx = docs[order[i:j]]
             states = []
-            for agg, (kind, cols, extra) in zip(query.aggregations, agg_args):
+            for agg, (kind, cols, extra, drop) in zip(query.aggregations,
+                                                      agg_args):
+                r = rows_idx if drop is None else rows_idx[~drop[rows_idx]]
                 if kind == "count":
-                    states.append(j - i)
+                    states.append(len(r))
                 elif kind == "mv":
-                    flat = [v for d in rows_idx for v in cols[d]]
+                    flat = [v for d in r for v in cols[d]]
                     states.append(
                         host_state(agg.function.name, np.asarray(flat), extra))
                 else:
                     states.append(host_state_full(
-                        agg.function.name, [c[rows_idx] for c in cols], extra))
+                        agg.function.name, [c[r] for c in cols], extra))
             groups[keys_sorted[i]] = states
             i = j
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
@@ -450,15 +526,23 @@ class HostSegmentExecutor:
         (the general host_state_full loop handles it)."""
         from .results import GroupArrays
 
+        nh = query.null_handling
         agg_vals = []
         for agg in query.aggregations:
             name = agg.function.name
             if name not in self._VEC_AGGS:
                 return None
-            if name == "count":
+            if name == "count" and not nh:
                 agg_vals.append(None)
                 continue
             data, extra = split_args(agg.function)
+            if nh and any(self._nulls_of(a.columns() - {"*"}, segment,
+                                         segment.num_docs).any()
+                          for a in data):
+                return None  # null-skipping states: general loop handles
+            if name == "count":
+                agg_vals.append(None)
+                continue
             if len(data) != 1 or extra:
                 return None
             try:

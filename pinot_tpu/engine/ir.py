@@ -107,6 +107,16 @@ class Where(ValueExpr):
 
 
 @dataclass(frozen=True)
+class NullCol(ValueExpr):
+    """The column's null bitmap plane as a boolean value (advanced null
+    handling: agg operands wrap as Where(NullCol, identity, v) so null
+    rows contribute the op identity — reference
+    QueryContext.isNullHandlingEnabled semantics)."""
+
+    null_slot: int
+
+
+@dataclass(frozen=True)
 class MvLutReduce(ValueExpr):
     """Per-doc reduce of an MV column: params[lut_param][mv_ids] is a
     (docs, max_mv) value matrix whose pad-sentinel slot (index card) holds

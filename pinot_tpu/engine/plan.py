@@ -178,6 +178,8 @@ class SegmentPlanner(AggPlanContext):
         self._slots: list[tuple[str, str]] = []
         self._slot_index: dict[tuple[str, str], int] = {}
         self._params: list = []
+        # advanced null handling: see QueryContext.null_handling
+        self.null_handling = query.null_handling
 
     # -- slot/param bookkeeping -------------------------------------------
     def slot(self, column: str, kind: str) -> int:
@@ -207,6 +209,49 @@ class SegmentPlanner(AggPlanContext):
             return None
         kind = "ids" if m.single_value else "mvids"
         return self.slot(e.identifier, kind), m.cardinality, self.segment.get_dictionary(e.identifier)
+
+    def _null_cond_for(self, e: ExpressionContext):
+        """Boolean ValueExpr true where any column referenced by e is null
+        (a transform over a null input is null — reference semantics), or
+        None when advanced null handling is off / no referenced column is
+        nullable."""
+        if not self.null_handling:
+            return None
+        cond = None
+        for c in sorted(e.columns()):
+            if c == "*" or not self.segment.has_column(c) \
+                    or not self._meta(c).has_nulls:
+                continue
+            nc = ir.NullCol(self.slot(c, "null"))
+            cond = nc if cond is None else ir.Bin("or", cond, nc)
+        return cond
+
+    def agg_operand(self, e: ExpressionContext, identity):
+        """value_expr wrapped so null rows contribute the agg identity
+        (advanced null handling). identity: 0 | "inf" | "-inf"."""
+        ve = self.value_expr(e)
+        cond = self._null_cond_for(e)
+        if cond is None:
+            return ve
+        if identity in ("inf", "-inf"):
+            # min/max compare in f64 so ±inf identities exist for any dtype
+            ve = ir.Cast(ve, "DOUBLE")
+            ident = ir.ConstParam(self.param(
+                np.float64(np.inf if identity == "inf" else -np.inf)))
+        else:
+            ident = ir.ConstParam(self.param(np.int64(identity)))
+        return ir.Where(cond, ident, ve)
+
+    def nonnull_count_op(self, e: ExpressionContext) -> int:
+        """Kernel output index holding the per-group NON-NULL count of e;
+        0 (the group doc count) when nulls cannot occur."""
+        cond = self._null_cond_for(e)
+        if cond is None:
+            return 0
+        one = ir.ConstParam(self.param(np.int32(1)))
+        zero = ir.ConstParam(self.param(np.int32(0)))
+        return self.add_op(ir.AggOp(
+            "sum", vexpr=ir.Where(cond, zero, one), vmin=0, vmax=1))
 
     def mv_reduce_expr(self, e: ExpressionContext, op: str):
         """(vexpr, vmin, vmax) per-doc reduce of a numeric MV dict column
@@ -380,6 +425,9 @@ class SegmentPlanner(AggPlanContext):
     def lower_filter(self, f: Optional[FilterContext]) -> Optional[ir.FilterNode]:
         if f is None:
             return None
+        if self.null_handling:
+            true_node, _unknown = self._lower_filter3(f)
+            return true_node
         return self._lower_filter(f)
 
     def _lower_filter(self, f: FilterContext) -> ir.FilterNode:
@@ -392,6 +440,54 @@ class SegmentPlanner(AggPlanContext):
         if f.type == FilterNodeType.CONSTANT:
             return ir.FConst(f.constant_value)
         return self._lower_predicate(f.predicate)
+
+    # -- 3-valued lowering (advanced null handling) ------------------------
+    def _lower_filter3(self, f: FilterContext):
+        """Kleene logic as a (definitely-true, unknown) node pair — NOT of
+        unknown stays unknown (excluded), but a child whose truth is
+        DEFINED for null rows (IS NULL, constants, an OR with a true arm)
+        keeps them. The final filter is the definitely-true mask."""
+        FALSE = ir.FConst(False)
+
+        def is_false(n):
+            return isinstance(n, ir.FConst) and not n.value
+
+        if f.type == FilterNodeType.AND:
+            ts, us = zip(*(self._lower_filter3(c) for c in f.children))
+            t = ir.FAnd(tuple(ts))
+            if all(is_false(u) for u in us):
+                return t, FALSE
+            # unknown: every child true-or-unknown, not all definitely true
+            tu = ir.FAnd(tuple(ti if is_false(ui) else ir.FOr((ti, ui))
+                               for ti, ui in zip(ts, us)))
+            return t, ir.FAnd((tu, ir.FNot(t)))
+        if f.type == FilterNodeType.OR:
+            ts, us = zip(*(self._lower_filter3(c) for c in f.children))
+            t = ir.FOr(tuple(ts))
+            if all(is_false(u) for u in us):
+                return t, FALSE
+            return t, ir.FAnd((ir.FOr(tuple(u for u in us if not is_false(u))),
+                               ir.FNot(t)))
+        if f.type == FilterNodeType.NOT:
+            ct, cu = self._lower_filter3(f.children[0])
+            if is_false(cu):
+                return ir.FNot(ct), FALSE
+            # true ↔ child definitely false; unknown unchanged
+            return ir.FAnd((ir.FNot(ct), ir.FNot(cu))), cu
+        if f.type == FilterNodeType.CONSTANT:
+            return ir.FConst(f.constant_value), FALSE
+        p = f.predicate
+        node = self._lower_predicate(p)
+        if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+            return node, FALSE  # defined for every row
+        unknown = None
+        for c in sorted(p.lhs.columns()):
+            if self.segment.has_column(c) and self._meta(c).has_nulls:
+                nc = ir.Null(self.slot(c, "null"))
+                unknown = nc if unknown is None else ir.FOr((unknown, nc))
+        if unknown is None:
+            return node, FALSE
+        return ir.FAnd((node, ir.FNot(unknown))), unknown
 
     def _lower_predicate(self, p: Predicate) -> ir.FilterNode:
         lhs = p.lhs

@@ -59,6 +59,8 @@ def _eval_value(node: ir.ValueExpr, arrays, params):
             _eval_value(node.a, arrays, params),
             _eval_value(node.b, arrays, params),
         )
+    if isinstance(node, ir.NullCol):
+        return arrays[node.null_slot]
     if isinstance(node, ir.MvLutReduce):
         if node.op == "count":  # non-pad slots per doc; no LUT gather
             return (arrays[node.ids_slot] != node.card).sum(

@@ -70,6 +70,19 @@ class QueryContext:
     def is_selection(self) -> bool:
         return not self.aggregations and not self.distinct
 
+    @property
+    def null_handling(self) -> bool:
+        """Advanced null handling (reference
+        QueryContext.isNullHandlingEnabled; SET enableNullHandling = true):
+        predicates over null inputs are false (3-valued logic) and
+        aggregations skip null operand values. Basic mode (default)
+        treats stored default values as values. Group-by KEYS stay in
+        basic mode either way (null keys group under the default value),
+        and SUM/MIN/MAX over a group whose operand is entirely null
+        return the op identity rather than SQL NULL (AVG returns NULL)."""
+        opt = self.query_options.get("enableNullHandling")
+        return opt is True or str(opt).lower() == "true"
+
     def referenced_columns(self) -> set[str]:
         cols: set[str] = set()
         for e in self.select_expressions:

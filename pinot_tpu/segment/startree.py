@@ -235,6 +235,10 @@ def try_rewrite(query, segment) -> StarTreeRewrite | None:
         return None
     if query.distinct or (not query.is_aggregation_query):
         return None
+    if query.null_handling:
+        # pre-aggregated states were built in basic mode (default values
+        # count as values) — advanced null handling must see raw rows
+        return None
     from ..query.context import QueryContext
     from ..query.expressions import ExpressionContext
 
