@@ -74,6 +74,11 @@ class BrokerReducer:
             if fast is not None:
                 return fast
 
+        medium = self._medium_group_reduce(query, combined, group_exprs,
+                                           agg_exprs, semantics)
+        if medium is not None:
+            return medium
+
         # env rows: expression-string → value (+ select aliases, so ORDER BY
         # and HAVING can reference them like the reference's alias handling)
         env_rows = []
@@ -103,6 +108,57 @@ class BrokerReducer:
         for env in env_rows[query.offset : query.offset + query.limit]:
             rows.append([_round_type(_eval_post(e, env), t)
                          for e, t in zip(query.select_expressions, types)])
+        return ResultTable(DataSchema(names, types), rows)
+
+    def _medium_group_reduce(self, query: QueryContext, combined,
+                             group_exprs, agg_exprs,
+                             semantics) -> Optional[ResultTable]:
+        """Columnar reduce for dict-form intermediates (aggs without a vec
+        form — sketches, distincts) when the query is the plain
+        SELECT keys/aggs ... ORDER BY keys/aggs shape: one finalize pass
+        into columns + one argsort, instead of 100K env dicts (measured
+        ~37µs/group there — seconds at numGroupsLimit scale). Returns None
+        for HAVING / post-agg expressions / aliases-in-order-by."""
+        if query.having_filter is not None or not combined.groups:
+            return None
+        gkeys = [str(ge) for ge in group_exprs]
+        akeys = [str(ae) for ae in agg_exprs]
+        colpos = {k: i for i, k in enumerate(gkeys)}
+        for i, k in enumerate(akeys):
+            colpos.setdefault(k, len(gkeys) + i)
+        sel_keys = [str(e) for e in query.select_expressions]
+        if any(k not in colpos for k in sel_keys):
+            return None
+        for ob in query.order_by_expressions or []:
+            if str(ob.expression) not in colpos:
+                return None
+
+        nk, na = len(gkeys), len(akeys)
+        key_rows = list(combined.groups.keys())
+        cols: list[list] = [[] for _ in range(nk + na)]
+        for d in range(nk):
+            cols[d] = [k[d] for k in key_rows]
+        states_it = combined.groups.values()
+        fins = [sem.finalize for sem in semantics]
+        for states in states_it:
+            for i in range(na):
+                cols[nk + i].append(fins[i](states[i]))
+
+        # sort with the SAME comparator the env path uses (_sort_key:
+        # None-last, bool/str/mixed safe) — numpy argsort would need dtype
+        # guards for every shape the general path already tolerates
+        idx = list(range(len(key_rows)))
+        for ob in reversed(query.order_by_expressions or []):
+            vals = cols[colpos[str(ob.expression)]]
+            idx.sort(key=lambda i, _v=vals: _sort_key(_v[i]),
+                     reverse=not ob.ascending)
+        sel = idx[query.offset: query.offset + query.limit]
+        names, types = self._select_schema(query, group_exprs)
+        rows = []
+        sel_cols = [cols[colpos[k]] for k in sel_keys]
+        for i in sel:
+            rows.append([_round_type(c[i], t)
+                         for c, t in zip(sel_cols, types)])
         return ResultTable(DataSchema(names, types), rows)
 
     def _fast_group_reduce(self, query: QueryContext, ga: GroupArrays,

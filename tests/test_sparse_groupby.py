@@ -322,3 +322,17 @@ def test_trim_still_counts_scanned_docs(env):
     assert not full.exceptions and not trimmed.exceptions
     # trimming drops groups from the result but not from docs scanned
     assert trimmed.num_docs_scanned == full.num_docs_scanned == N
+
+
+def test_medium_reduce_desc_string_and_bool_keys(env):
+    """Dict-form intermediates (non-vec aggs) with DESC string keys and
+    boolean-ish keys exercise the columnar medium reduce's comparator —
+    shapes that numpy argsort would need dtype guards for."""
+    tpu, host, conn, segs = env
+    for sql in [
+        "SELECT tag, DISTINCTCOUNT(code) FROM hc GROUP BY tag "
+        "ORDER BY tag DESC LIMIT 10",
+        "SELECT tag, DISTINCTCOUNT(code) FROM hc GROUP BY tag "
+        "ORDER BY DISTINCTCOUNT(code) DESC, tag LIMIT 10",
+    ]:
+        _check(tpu, host, sql)
