@@ -192,7 +192,8 @@ class MseWorkerService:
                                 self.server.address, self._send_rpc)
         runner = StageRunner([stage], request.get("parallelism", 1),
                              self._make_execute_query(halves),
-                             self._make_read_table(halves))
+                             self._make_read_table(halves),
+                             query_options=request.get("options") or {})
         runner.mailbox = mailbox
 
         from .operators import pop_join_overflow
@@ -203,6 +204,10 @@ class MseWorkerService:
             runner.stats["leaf_ssqe_pushdowns"] += 1
             block = pushed
         else:
+            if stage.is_leaf and runner._null_handling_requested():
+                raise UnsupportedQueryError(
+                    "enableNullHandling requires this leaf stage to push "
+                    "down to the single-stage engine")
             block = runner._exec(stage.root, stage, worker)
         mailbox.send_partitioned(stage.stage_id, stage.parent_stage, block,
                                  stage.send_dist, stage.send_keys,
@@ -511,7 +516,7 @@ class DistributedMseDispatcher:
         # strictly after their children so mailboxes are always populated
         stats_agg = {"num_docs_scanned": 0, "total_docs": 0,
                      "leaf_ssqe_pushdowns": 0, "stages": len(stages),
-                     "join_overflow": False}
+                     "join_overflow": False, "num_groups_limit_reached": False}
         touched: set[str] = set()
         try:
             for stage in sorted(stages, key=lambda s: -s.stage_id):
@@ -534,7 +539,8 @@ class DistributedMseDispatcher:
                         "stage": sj, "worker": w_idx,
                         "parent_workers": len(parent_addrs),
                         "routing": routing, "tables": w["tables"],
-                        "parallelism": self.parallelism})
+                        "parallelism": self.parallelism,
+                        "options": dict(query.options)})
 
                 for st in self._pool.map(submit, enumerate(workers[stage.stage_id])):
                     for k in ("num_docs_scanned", "total_docs",
@@ -542,6 +548,8 @@ class DistributedMseDispatcher:
                         stats_agg[k] += st.get(k, 0)
                     stats_agg["join_overflow"] |= bool(
                         st.get("join_overflow"))
+                    stats_agg["num_groups_limit_reached"] |= bool(
+                        st.get("num_groups_limit_reached"))
 
             final_sid = stages[0].child_stages[0]
             block = concat_blocks(
@@ -552,7 +560,8 @@ class DistributedMseDispatcher:
                 result_table=result,
                 num_docs_scanned=stats_agg["num_docs_scanned"],
                 total_docs=stats_agg["total_docs"],
-                partial_result=stats_agg["join_overflow"])
+                partial_result=stats_agg["join_overflow"],
+                num_groups_limit_reached=stats_agg["num_groups_limit_reached"])
         finally:
             self.boxes.cleanup(query_id)
             for inst in touched:

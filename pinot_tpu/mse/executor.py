@@ -110,7 +110,8 @@ class MultistageExecutor:
 
             pop_join_overflow()  # clear any stale flag on this thread
             runner = StageRunner(stages, self.parallelism,
-                                 self.qe.execute, self._read_table)
+                                 self.qe.execute, self._read_table,
+                                 query_options=query.options)
             block = runner.run()
             schema = stages[0].root.schema
             result = _block_to_result(block, schema)
@@ -119,6 +120,8 @@ class MultistageExecutor:
                 num_docs_scanned=runner.stats["num_docs_scanned"],
                 total_docs=runner.stats["total_docs"],
                 partial_result=pop_join_overflow(),
+                num_groups_limit_reached=runner.stats.get(
+                    "num_groups_limit_reached", False),
                 time_used_ms=(time.perf_counter() - t0) * 1000)
         except Exception as e:
             return BrokerResponse(

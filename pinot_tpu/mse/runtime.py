@@ -50,20 +50,37 @@ EC = ExpressionContext
 _LEAF_LIMIT = 1_000_000_000  # effectively unlimited (leaf results feed merges)
 
 
+class LeafError(Exception):
+    """A leaf SSQE pushdown executed and FAILED (timeout, kill, engine
+    error) — distinct from UnsupportedQueryError (shape can't push down,
+    generic path takes over) so failures propagate instead of silently
+    re-running without their query options."""
+
+
 class StageRunner:
     """Executes one fragmented plan. ``execute_query`` is the single-stage
     engine entry (QueryContext → BrokerResponse); ``read_table`` returns raw
     column arrays for generic scans."""
 
     def __init__(self, stages: list[Stage], parallelism: int,
-                 execute_query: Callable, read_table: Callable):
+                 execute_query: Callable, read_table: Callable,
+                 query_options: Optional[dict] = None):
         self.stages = stages
         self.parallelism = max(1, parallelism)
         self.execute_query = execute_query
         self.read_table = read_table
+        # SET options from the MSE statement, forwarded into leaf SSQE
+        # pushdowns (enableNullHandling / numGroupsLimit / timeoutMs act at
+        # the single-stage engine)
+        self.query_options = dict(query_options or {})
         self.mailbox = MailboxService()
         self.stats = {"stages": len(stages), "leaf_ssqe_pushdowns": 0,
-                      "num_docs_scanned": 0, "total_docs": 0}
+                      "num_docs_scanned": 0, "total_docs": 0,
+                      "num_groups_limit_reached": False}
+
+    def _null_handling_requested(self) -> bool:
+        opt = self.query_options.get("enableNullHandling")
+        return opt is True or str(opt).lower() == "true"
 
     # -- topology ----------------------------------------------------------
     def workers_of(self, stage: Stage) -> int:
@@ -100,6 +117,13 @@ class StageRunner:
         pushed = None
         if stage.is_leaf:
             pushed = self._try_ssqe(stage)
+            if pushed is None and self._null_handling_requested():
+                # the generic scan path has no null semantics — failing is
+                # honest; silently flipping to basic mode per plan shape
+                # is not
+                raise UnsupportedQueryError(
+                    "enableNullHandling requires this leaf stage to push "
+                    "down to the single-stage engine")
         if pushed is not None:
             self.stats["leaf_ssqe_pushdowns"] += 1
             self.mailbox.send_partitioned(
@@ -181,7 +205,8 @@ class StageRunner:
                 select = [EC.for_identifier(unq[c]) for c in scan.schema]
                 qc = QueryContext(
                     table_name=scan.table, select_expressions=select,
-                    aliases=[None] * len(select), filter=fctx, limit=_LEAF_LIMIT)
+                    aliases=[None] * len(select), filter=fctx, limit=_LEAF_LIMIT,
+                    query_options=dict(self.query_options))
                 resp = self.execute_query(qc.finish())
                 return self._resp_to_block(resp, list(scan.schema))
 
@@ -198,7 +223,8 @@ class StageRunner:
                 table_name=scan.table, select_expressions=select,
                 aliases=[None] * len(select),
                 group_by_expressions=[_unqualify(g, unq) for g in agg.group_exprs],
-                filter=fctx, limit=_LEAF_LIMIT)
+                filter=fctx, limit=_LEAF_LIMIT,
+                query_options=dict(self.query_options))
             resp = self.execute_query(qc.finish())
             return self._resp_to_block(resp, list(agg.schema))
         except (FilterConversionError, UnsupportedQueryError, KeyError):
@@ -206,12 +232,24 @@ class StageRunner:
 
     def _resp_to_block(self, resp, names: list[str]) -> Optional[Block]:
         if resp.exceptions:
-            raise UnsupportedQueryError(f"leaf stage failed: {resp.exceptions}")
+            if all("UnsupportedQueryError" in e for e in resp.exceptions):
+                # shape the single-stage engine can't plan (e.g. strict-tpu
+                # backend + raw-string predicate): generic path takes over
+                raise UnsupportedQueryError(
+                    f"leaf stage unsupported: {resp.exceptions}")
+            # a leaf that RAN and failed (timeout, kill) must fail the
+            # query, not silently re-run on the generic path with no
+            # deadline and basic semantics
+            raise LeafError(f"leaf stage failed: {resp.exceptions}")
         self.stats["num_docs_scanned"] += resp.num_docs_scanned
         self.stats["total_docs"] += resp.total_docs
+        if getattr(resp, "num_groups_limit_reached", False):
+            self.stats["num_groups_limit_reached"] = True
         rt = resp.result_table
         if rt is None:
-            return None
+            # empty result still counts as a successful pushdown — a None
+            # here would re-run the leaf on the generic path
+            return {name: np.empty(0, object) for name in names}
         rows = rt.rows
         out: Block = {}
         for j, name in enumerate(names):

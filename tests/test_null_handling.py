@@ -186,3 +186,22 @@ def test_star_tree_skipped_under_null_handling(env):
     _, _, _, _, segs = env
     q = parse_sql(NH + "SELECT k, SUM(v) FROM nt GROUP BY k")
     assert try_rewrite(q, segs[0]) is None
+
+
+def test_mse_leaf_pushdown_honors_null_handling(env):
+    """SET options parsed by the MSE statement must reach the leaf SSQE
+    pushdown — previously they were silently dropped."""
+    from pinot_tpu.mse.executor import MultistageExecutor
+
+    tpu, _, _, conn, _ = env
+    mse = MultistageExecutor(tpu)
+    sql = "SELECT SUM(v), COUNT(v), COUNT(*) FROM nt WHERE k < 6"
+    want = conn.execute(sql).fetchone()
+    r = mse.execute_sql(NH + sql)
+    assert not r.exceptions, r.exceptions
+    got = r.result_table.rows[0]
+    assert (int(got[0]), int(got[1]), int(got[2])) == \
+        (int(want[0]), int(want[1]), int(want[2]))
+    # and without the option, basic mode still differs on COUNT(v)
+    r2 = mse.execute_sql(sql)
+    assert int(r2.result_table.rows[0][1]) != int(want[1])
