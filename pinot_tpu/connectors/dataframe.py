@@ -141,7 +141,10 @@ def write_dataframe(df, table_name: str, out_dir: str | Path,
                        segment_name=seg_name).build(cols, dest)
         paths.append(str(dest))
         if controller is not None:
+            from ..segment.format import partition_push_metadata
+
             meta = {"location": str(dest), "numDocs": len(part)}
+            meta.update(partition_push_metadata(dest))
             if time_column is not None and len(part):
                 tv = cols[time_column]  # already normalized to epoch millis
                 meta["startTimeMs"] = int(np.min(tv))

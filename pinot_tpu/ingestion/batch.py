@@ -81,6 +81,8 @@ class SegmentGenerationResult:
     output_uri: str
     num_docs: int
     rows_filtered: int
+    # {col: [partition ids]} from builder stamping (segmentPartitionConfig)
+    partitions: dict = field(default_factory=dict)
 
 
 class IngestionJobLauncher:
@@ -167,6 +169,9 @@ def _generate_one_job(spec: SegmentGenerationJobSpec, path: str,
         local = Path(tmp) / segment_name
         SegmentBuilder(spec.schema, spec.table_config, segment_name) \
             .build_from_rows(rows, local)
+        from ..segment.format import partition_push_metadata
+
+        parts = partition_push_metadata(local).get("partitions", {})
         out_uri = f"{spec.output_dir_uri.rstrip('/')}/{segment_name}"
         fs = get_fs(spec.output_dir_uri)
         if spec.create_tar:
@@ -177,7 +182,8 @@ def _generate_one_job(spec: SegmentGenerationJobSpec, path: str,
             fs.copy_from_local(str(tar_path), out_uri)
         else:
             fs.copy_from_local(str(local), out_uri)
-    return SegmentGenerationResult(segment_name, out_uri, len(rows), filtered)
+    return SegmentGenerationResult(segment_name, out_uri, len(rows), filtered,
+                                   partitions=parts)
 
 
 def push_segments_to_cluster(results: list[SegmentGenerationResult],
@@ -187,8 +193,10 @@ def push_segments_to_cluster(results: list[SegmentGenerationResult],
     the cluster controller, which assigns replicas and updates the ideal
     state."""
     for r in results:
-        controller.add_segment(table_name_with_type, r.segment_name,
-                               {"location": r.output_uri, "numDocs": r.num_docs})
+        meta = {"location": r.output_uri, "numDocs": r.num_docs}
+        if r.partitions:
+            meta["partitions"] = r.partitions
+        controller.add_segment(table_name_with_type, r.segment_name, meta)
 
 
 def untar_segment(tar_uri: str, dest_dir: str) -> str:

@@ -87,8 +87,15 @@ def segment_rows(segment) -> list[dict]:
 def _build_and_add(ctx: TaskContext, table: str, segment_name: str,
                    schema: Schema, rows: list[dict], extra_meta=None) -> str:
     out_dir = Path(ctx.work_dir) / table / segment_name
-    SegmentBuilder(schema, segment_name=segment_name).build_from_rows(rows, out_dir)
+    # rebuild WITH the table config: index declarations and partition
+    # stamping survive minion rewrites (reference SegmentProcessorFramework
+    # builds from the table config too)
+    SegmentBuilder(schema, table_config=_table_config_of(ctx, table),
+                   segment_name=segment_name).build_from_rows(rows, out_dir)
+    from ..segment.format import partition_push_metadata
+
     meta = {"location": str(out_dir), "numDocs": len(rows)}
+    meta.update(partition_push_metadata(out_dir))
     meta.update(extra_meta or {})
     ctx.controller.add_segment(table, segment_name, meta)
     return segment_name
@@ -279,10 +286,14 @@ def refresh_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
         seg = _load(ctx, table, name)
         rows = segment_rows(seg)
         out_dir = Path(ctx.work_dir) / table / f"{name}_refreshed"
-        SegmentBuilder(schema, segment_name=name).build_from_rows(rows, out_dir)
-        ctx.controller.add_segment(table, name, {
-            "location": str(out_dir), "numDocs": len(rows),
-            "refreshedAtMs": int(time.time() * 1000)})
+        SegmentBuilder(schema, table_config=_table_config_of(ctx, table),
+                       segment_name=name).build_from_rows(rows, out_dir)
+        from ..segment.format import partition_push_metadata
+
+        meta = {"location": str(out_dir), "numDocs": len(rows),
+                "refreshedAtMs": int(time.time() * 1000)}
+        meta.update(partition_push_metadata(out_dir))
+        ctx.controller.add_segment(table, name, meta)
         refreshed.append(name)
     return {"refreshed": refreshed}
 

@@ -39,7 +39,7 @@ from ..engine.results import BrokerResponse
 from ..query.converter import filter_from_expression
 from ..query.expressions import ExpressionContext
 from .executor import _block_to_result
-from .fragmenter import Stage, explain_stages, fragment
+from .fragmenter import Stage, explain_stages, fragment, receive_nodes
 from .logical import LogicalPlanner, prune_columns
 from .optimizer import push_filters
 from .mailbox import Block, concat_blocks, hash_partition, table_partition
@@ -445,6 +445,53 @@ class DistributedMseDispatcher:
         # in-process StageRunner's scan over zero segments
         return per_instance
 
+    def _partition_worker_placement(self, stage, stages, workers,
+                                    n: int) -> dict:
+        """partition id → instance for a stage fed by "partitioned"
+        (colocated-join) exchanges: worker p lands on the instance whose
+        assigned child segments carry partition p on the exchange's OWN
+        key column with a COMPATIBLE stamp (same function and count — a
+        stale stamp from a changed segmentPartitionConfig must not place),
+        so a single-partition leaf's send short-circuits to the local
+        mailbox instead of crossing the wire. Partitions without a stamped
+        host fall back to round-robin."""
+        from collections import Counter, defaultdict
+
+        if not any(node.dist == "partitioned"
+                   for node in receive_nodes(stage.root)):
+            return {}
+        votes: dict[int, Counter] = defaultdict(Counter)
+        for child_id in stage.child_stages:
+            child = stages[child_id]
+            if child.send_dist != "partitioned" or not child.send_keys:
+                continue
+            # the exchange key is qualified against the child's output
+            # schema; map it to the scanned source column
+            key_cols = set()
+            for scan in child.scans():
+                for q, src in zip(scan.schema, scan.source_columns):
+                    if q == child.send_keys[0]:
+                        key_cols.add(src)
+            if not key_cols:
+                continue
+            for w in workers.get(child_id, []):
+                for _raw, entries in (w.get("tables") or {}).items():
+                    for nwt, seg_names, _extra in entries:
+                        for s in seg_names:
+                            rec = self.store.get(f"/SEGMENTS/{nwt}/{s}") or {}
+                            for col, info in (rec.get("partitions") or {}).items():
+                                if col not in key_cols:
+                                    continue
+                                if not isinstance(info, dict) \
+                                        or info.get("numPartitions") != n \
+                                        or (child.send_pfunc and
+                                            info.get("functionName") != child.send_pfunc):
+                                    continue
+                                for p in info.get("partitions") or []:
+                                    if 0 <= int(p) < n:
+                                        votes[int(p)][w["instance"]] += 1
+        return {p: c.most_common(1)[0][0] for p, c in votes.items()}
+
     # -- execution ---------------------------------------------------------
     def execute_sql(self, sql: str) -> BrokerResponse:
         import time as _time
@@ -503,10 +550,14 @@ class DistributedMseDispatcher:
                     for inst in sorted(assignment)]
             else:
                 n = topo.workers_of(stage)
+                placed = self._partition_worker_placement(
+                    stage, stages, workers, n)
                 chosen = []
-                for _ in range(n):
-                    inst = servers[rr % len(servers)]
-                    rr += 1
+                for p in range(n):
+                    inst = placed.get(p) if placed else None
+                    if inst is None:
+                        inst = servers[rr % len(servers)]
+                        rr += 1
                     chosen.append({"instance": inst,
                                    "addr": self._instance_addr(inst),
                                    "tables": {}})

@@ -60,6 +60,17 @@ class Stage:
         return out
 
 
+def receive_nodes(node: PlanNode) -> list[MailboxReceiveNode]:
+    """All MailboxReceiveNode leaves under a stage root (shared by the
+    runtime's worker-count topology and the dispatcher's placement)."""
+    out: list[MailboxReceiveNode] = []
+    if isinstance(node, MailboxReceiveNode):
+        out.append(node)
+    for i in node.inputs:
+        out.extend(receive_nodes(i))
+    return out
+
+
 def fragment(root: ExchangeNode) -> list[Stage]:
     """Split at exchanges. Returns stages indexed by stage_id; stage 0 is
     the broker stage (a bare receive of the root exchange)."""

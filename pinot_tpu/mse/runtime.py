@@ -22,7 +22,7 @@ from ..engine.aggregation import UnsupportedQueryError
 from ..query.context import QueryContext
 from ..query.converter import FilterConversionError, filter_from_expression
 from ..query.expressions import ExpressionContext
-from .fragmenter import MailboxReceiveNode, Stage
+from .fragmenter import MailboxReceiveNode, Stage, receive_nodes
 from .logical import (
     AggregateNode,
     FilterNode,
@@ -84,21 +84,13 @@ class StageRunner:
 
     # -- topology ----------------------------------------------------------
     def workers_of(self, stage: Stage) -> int:
-        nodes = self._receives(stage.root)
+        nodes = receive_nodes(stage.root)
         nparts = [n.n_partitions for n in nodes
                   if n.dist == "partitioned" and n.n_partitions]
         if nparts:
             # colocated join: one worker per table partition
             return max(nparts)
         return self.parallelism if any(n.dist == "hash" for n in nodes) else 1
-
-    def _receives(self, node: PlanNode) -> list:
-        out = []
-        if isinstance(node, MailboxReceiveNode):
-            out.append(node)
-        for i in node.inputs:
-            out.extend(self._receives(i))
-        return out
 
     # -- run ---------------------------------------------------------------
     def run(self) -> Block:

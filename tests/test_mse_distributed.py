@@ -332,8 +332,11 @@ def test_distributed_colocated_join(tmp_path):
         path = str(tmp_path / f"orders_{p}")
         SegmentBuilder(ORDERS, table_config=tc,
                        segment_name=f"orders_{p}").build(sub, path)
-        controller.add_segment("orders_OFFLINE", f"orders_{p}",
-                               {"location": path, "numDocs": len(sub["amount"])})
+        from pinot_tpu.segment.format import partition_push_metadata
+
+        meta = {"location": path, "numDocs": len(sub["amount"])}
+        meta.update(partition_push_metadata(path))  # stamped partition ids
+        controller.add_segment("orders_OFFLINE", f"orders_{p}", meta)
         orders_sets.append(sub)
     ccols = _customers_cols()
     cpath = str(tmp_path / "customers_0")
@@ -349,6 +352,28 @@ def test_distributed_colocated_join(tmp_path):
         assert not resp.exceptions, resp.exceptions
         got = {r[0]: r[1] for r in resp.result_table.rows}
         assert got == _expected_region_sums(orders_sets)
+
+        # spy on the dispatcher's partition-aligned worker placement:
+        # orders' single-partition segments (with stamped push records)
+        # live on Server_0, so every join worker must land there
+        disp = broker._mse_dispatcher
+        placements = {}
+        orig = disp._partition_worker_placement
+
+        def spy(stage, stages, workers, n):
+            out = orig(stage, stages, workers, n)
+            if out:
+                placements.update(out)
+            return out
+
+        disp._partition_worker_placement = spy
+        try:
+            resp2 = broker.execute_sql_mse(JOIN_SQL)
+            assert not resp2.exceptions, resp2.exceptions
+        finally:
+            disp._partition_worker_placement = orig
+        assert placements, "no partition-aligned placement happened"
+        assert set(placements.values()) == {"Server_0"}, placements
     finally:
         for s in servers:
             try:
