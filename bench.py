@@ -225,6 +225,13 @@ def _init_backend():
     import jax
     from jax.extend import backend as jex_backend
 
+    try:  # persist compiles across bench runs (no-op for remote compile)
+        jax.config.update("jax_compilation_cache_dir",
+                          str(Path(__file__).parent / ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
     if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local runs; axon default
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     last_err = None
