@@ -475,10 +475,14 @@ class DistributedMseDispatcher:
             if not key_cols:
                 continue
             for w in workers.get(child_id, []):
-                for _raw, entries in (w.get("tables") or {}).items():
+                for raw, entries in (w.get("tables") or {}).items():
                     for nwt, seg_names, _extra in entries:
                         for s in seg_names:
-                            rec = self.store.get(f"/SEGMENTS/{nwt}/{s}") or {}
+                            # name-with-type: controller-pushed segments;
+                            # raw name: the realtime completion protocol's
+                            # DONE records (realtime/completion.py)
+                            rec = self.store.get(f"/SEGMENTS/{nwt}/{s}") \
+                                or self.store.get(f"/SEGMENTS/{raw}/{s}") or {}
                             for col, info in (rec.get("partitions") or {}).items():
                                 if col not in key_cols:
                                     continue

@@ -237,9 +237,17 @@ class RealtimeSegmentDataManager:
                     # simulated process death between build and commit —
                     # the lease expires and another replica is re-elected
                     return
+                from ..segment.format import partition_push_metadata
+
+                # DONE records carry partition stamps ({} when the table
+                # declares no partitioning); the MSE dispatcher reads them
+                # (falling back from the name-with-type namespace to this
+                # completion-protocol one) to place colocated workers next
+                # to realtime segments
                 end = self.completion.segment_commit_end(
                     table, name, self.instance_id,
-                    self.current_offset.offset, location)
+                    self.current_offset.offset, location,
+                    metadata=partition_push_metadata(location))
                 if end.status == COMMIT_SUCCESS:
                     self.on_commit_success(self, location)
                     self.state = COMMITTED

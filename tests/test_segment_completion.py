@@ -270,3 +270,32 @@ def test_chaos_replica_killed_mid_ingestion_recovers(registry, tmp_path):
     finally:
         a2.stop()
         b.stop()
+
+
+def test_committed_record_carries_partition_stamps(registry, tmp_path):
+    """A partitioned realtime table's DONE record includes the builder's
+    partition stamps, so the MSE dispatcher can place colocated workers
+    next to realtime segments too."""
+    from pinot_tpu.spi.table_config import IndexingConfig
+
+    registry.create_topic("evp", num_partitions=1)
+    store = PropertyStore()
+    completion = SegmentCompletionManager(store, num_replicas=1,
+                                          commit_lease_s=5, decision_wait_s=0.1)
+    cfg = table_config("evp", flush_rows=20)
+    cfg.indexing = IndexingConfig(segment_partition_config={
+        "n": {"functionName": "modulo", "numPartitions": 4}})
+    a = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
+                                 completion=completion, instance_id="A")
+    a.start()
+    try:
+        registry.publish("evp", rows(20))
+        assert wait_until(lambda: store.children("/SEGMENTS/events"))
+        name = store.children("/SEGMENTS/events")[0]
+        rec = store.get(f"/SEGMENTS/events/{name}")
+        assert rec["status"] == "DONE"
+        p = rec["partitions"]["n"]
+        assert p["functionName"] == "modulo" and p["numPartitions"] == 4
+        assert p["partitions"] == [1]  # every row has n = 1
+    finally:
+        a.stop()
