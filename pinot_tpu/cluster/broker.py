@@ -177,6 +177,13 @@ class Broker:
             return resp
         if query.query_options.get("useMultistageEngine") in (True, "true", 1):
             return self.execute_sql_mse(sql)
+        if getattr(query, "explain", False):
+            # plan-only: route to ONE server hosting routed segments
+            # (reference: EXPLAIN runs the plan maker, never the operators)
+            try:
+                return self._explain(query)
+            except Exception as e:
+                return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         try:
             self.quota.acquire(raw_table_name(query.table_name))
         except QueryQuotaExceededError as e:
@@ -279,6 +286,27 @@ class Broker:
     def fetch_cursor(self, cursor_id: str, offset: int,
                      num_rows: int = 1000) -> dict:
         return self.response_store.fetch(cursor_id, offset, num_rows)
+
+    def _explain(self, query: QueryContext) -> BrokerResponse:
+        from ..engine.results import DataSchema, ResultTable
+
+        raw = raw_table_name(query.table_name)
+        for ttype in ("OFFLINE", "REALTIME"):
+            nwt = table_name_with_type(raw, ttype)
+            if self.store.get(f"/CONFIGS/TABLE/{nwt}") is None:
+                continue
+            routing = self.routing_table(nwt)
+            if not routing:
+                continue
+            plan = self._select_instances(routing)
+            inst, segs = next(iter(plan.items()))
+            out = self._client(inst).call({
+                "type": "explain", "table": nwt, "segments": segs,
+                "query": query})
+            return BrokerResponse(result_table=ResultTable(
+                DataSchema(out["columns"], out["types"]), out["rows"]))
+        return BrokerResponse(
+            exceptions=[f"table {raw} not found or has no routable segments"])
 
     def _execute(self, query: QueryContext) -> BrokerResponse:
         raw = raw_table_name(query.table_name)

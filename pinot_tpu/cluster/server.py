@@ -149,6 +149,8 @@ class ServerInstance:
             return self._handle_query(request)
         if kind == "query_stream":
             return self._handle_query_stream(request)
+        if kind == "explain":
+            return self._handle_explain(request)
         if kind == "ping":
             return "pong"
         if isinstance(kind, str) and kind.startswith("mse_"):
@@ -187,6 +189,26 @@ class ServerInstance:
         from .datatable import encode
 
         return {"datatable": encode(combined, stats)}
+
+    def _handle_explain(self, request):
+        """Render the operator-tree plan for this server's hosted segments
+        without executing (reference: EXPLAIN runs the plan maker only)."""
+        from types import SimpleNamespace
+
+        from ..engine.explain import explain_plan
+
+        table = request["table"]
+        names = request["segments"]
+        query = request["query"]
+        with self._lock:
+            hosted = self.segments.get(table, {})
+            segs = [hosted[n] for n in names if n in hosted]
+        rt = explain_plan(query, SimpleNamespace(segments=segs),
+                          self.executor.pruner,
+                          backend=self.executor.backend,
+                          use_star_tree=self.executor.use_star_tree)
+        return {"columns": rt.schema.column_names,
+                "types": rt.schema.column_types, "rows": rt.rows}
 
     def _handle_query_stream(self, request):
         """Server-streaming query: one DataTable chunk per segment as each

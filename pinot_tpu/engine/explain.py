@@ -1,0 +1,152 @@
+"""EXPLAIN PLAN FOR — single-stage engine.
+
+Reference: pinot-core's EXPLAIN output (ExplainPlanDataTableReducer et al.)
+renders an operator tree as (Operator, Operator_Id, Parent_Id) rows:
+BROKER_REDUCE → COMBINE → per-segment plan operators. Here the per-segment
+"operators" are the kernel IR the query compiles to — one fused device
+program — so the tree shows the program mode, the lowered filter algebra,
+group dims/strides, and the primitive device reductions, plus which
+segments pruned and whether the shape falls back to the host engine.
+"""
+
+from __future__ import annotations
+
+from . import ir
+from .aggregation import UnsupportedQueryError
+from .plan import SegmentPlanner
+from .results import DataSchema, ResultTable
+
+
+def explain_plan(query, table, pruner, backend: str = "auto",
+                 use_star_tree: bool = True) -> ResultTable:
+    import copy
+
+    from ..query.optimizer import optimize_filter
+
+    # explain what EXECUTES: the same canonicalized filter the executor
+    # runs (NOT elimination, EQ/IN + range merging, constant folding)
+    query = copy.copy(query)
+    query.filter = optimize_filter(query.filter)
+
+    rows: list[list] = []
+    next_id = [0]
+
+    def add(op: str, parent: int) -> int:
+        oid = next_id[0]
+        next_id[0] += 1
+        rows.append([op, oid, parent])
+        return oid
+
+    ob = ""
+    if query.order_by_expressions:
+        ob = ", sort:[" + ", ".join(map(str, query.order_by_expressions)) + "]"
+    having = f", having:{query.having_filter}" if query.having_filter else ""
+    root = add(f"BROKER_REDUCE(limit:{query.limit}{ob}{having})", -1)
+
+    segments = [s for s in table.segments
+                if not getattr(s, "is_mutable", False)]
+    kept, pruned = pruner.prune(query, segments) if segments else ([], 0)
+    mutable = len(table.segments) - len(segments)
+
+    if query.is_aggregation_query or query.is_group_by or query.distinct:
+        combine = "COMBINE_GROUP_BY" if (query.is_group_by or query.distinct) \
+            else "COMBINE_AGGREGATE"
+    else:
+        combine = "COMBINE_SELECT"
+    cid = add(f"{combine}(segments:{len(kept)}, pruned:{pruned}"
+              + (f", consuming(host):{mutable}" if mutable else "") + ")",
+              root)
+
+    if not kept:
+        add("EMPTY(no immutable segments matched)", cid)
+        return _table(rows)
+
+    # mirror _segment_route: star-tree rewrite happens before planning
+    plan_query, plan_seg = query, kept[0]
+    star = None
+    if use_star_tree and getattr(kept[0], "valid_doc_ids", None) is None:
+        from ..segment.startree import try_rewrite
+
+        star = try_rewrite(query, kept[0])
+        if star is not None:
+            plan_query, plan_seg = star.query, star.view
+            cid = add("FILTER_STARTREE_INDEX(pre-aggregated docs)", cid)
+
+    try:
+        plan = SegmentPlanner(plan_query, plan_seg).plan()
+    except UnsupportedQueryError as e:
+        add(f"HOST_ENGINE(numpy fallback: {e})", cid)
+        return _table(rows)
+
+    engine = "HOST_KERNEL" if backend == "host" else "DEVICE_KERNEL"
+    p = plan.program
+    desc = f"{engine}(mode:{p.mode}"
+    if p.mode in ("group_by", "group_by_sparse"):
+        dims = ", ".join(f"{d.column}[card:{d.cardinality}]"
+                         for d in plan.group_dims)
+        desc += f", groups:{p.num_groups}, dims:[{dims}]"
+        if p.mode == "group_by_sparse":
+            desc += f", key_space:{p.key_space}"
+            if p.exact_trim:
+                desc += ", orderByTrim:exact"
+        if p.mv_group_slot is not None:
+            desc += ", mvExpansion:true"
+    kid = add(desc + ")", cid)
+
+    for a in query.aggregations:
+        # SQL-level functions; COUNT(*) answers from the shared per-group
+        # count column and registers no primitive op of its own
+        add(f"AGGREGATE(fn:{a})", kid)
+    reduce_tag = "HOST_REDUCE" if backend == "host" else "DEVICE_REDUCE"
+    for agg in p.aggs:
+        label = f"{reduce_tag}(op:{agg.kind}"
+        if agg.card is not None:
+            label += f", card:{agg.card}"
+        if agg.bins is not None:
+            label += f", bins:{agg.bins}"
+        if agg.vmin is not None:
+            label += f", bounds:[{agg.vmin},{agg.vmax}]"
+        add(label + ")", kid)
+    if not p.aggs and p.mode == "selection":
+        cols = ", ".join(str(e) for e in query.select_expressions)
+        add(f"SELECT(columns:[{cols}])", kid)
+
+    fid = add("FILTER" if p.filter is not None else "MATCH_ALL", kid)
+    if p.filter is not None:
+        _walk_filter(p.filter, fid, add)
+    return _table(rows)
+
+
+def _walk_filter(node, parent: int, add) -> None:
+    if isinstance(node, ir.FAnd):
+        oid = add("AND", parent)
+        for c in node.children:
+            _walk_filter(c, oid, add)
+    elif isinstance(node, ir.FOr):
+        oid = add("OR", parent)
+        for c in node.children:
+            _walk_filter(c, oid, add)
+    elif isinstance(node, ir.FNot):
+        oid = add("NOT", parent)
+        _walk_filter(node.child, oid, add)
+    elif isinstance(node, ir.Interval):
+        add(f"RANGE(slot dict-id/value interval, "
+            f"inclusive:[{node.lo_inclusive},{node.hi_inclusive}])", parent)
+    elif isinstance(node, ir.Lut):
+        add(f"DICT_LUT(ids_slot:{node.ids_slot}, mv:{node.mv})", parent)
+    elif isinstance(node, ir.Isin):
+        add("RAW_IN", parent)
+    elif isinstance(node, ir.Null):
+        add(f"IS_NULL(slot:{node.null_slot})", parent)
+    elif isinstance(node, ir.MaskParam):
+        add("HOST_INDEX_MASK(text/json/vector posting list)", parent)
+    elif isinstance(node, ir.FConst):
+        add(f"CONST({node.value})", parent)
+    else:
+        add(type(node).__name__.upper(), parent)
+
+
+def _table(rows) -> ResultTable:
+    return ResultTable(
+        DataSchema(["Operator", "Operator_Id", "Parent_Id"],
+                   ["STRING", "INT", "INT"]), rows)

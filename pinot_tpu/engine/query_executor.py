@@ -146,6 +146,19 @@ class QueryExecutor:
         if table is None:
             return BrokerResponse(exceptions=[f"table {query.table_name} not found"])
 
+        if getattr(query, "explain", False):
+            from .explain import explain_plan
+
+            try:
+                rt = explain_plan(query, table, self.pruner,
+                                  backend=self.backend,
+                                  use_star_tree=self.use_star_tree)
+                return BrokerResponse(
+                    result_table=rt,
+                    time_used_ms=(time.perf_counter() - t0) * 1000)
+            except Exception as e:
+                return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
+
         trace = None
         if query.query_options.get("trace") in (True, "true", 1):
             trace = TRACING.start_trace(f"{query.table_name}:{id(query):x}")
