@@ -147,6 +147,8 @@ def split_args(fn: FunctionContext):
 
 def semantics_for(expr: ExpressionContext) -> AggSemantics:
     fn = expr.function
+    if fn.name == "filter":  # FILTER (WHERE ...) wrapper: inner semantics
+        return semantics_for(fn.arguments[0])
     _, extra = split_args(fn)
     return get_semantics(fn.name, extra)
 
@@ -433,33 +435,95 @@ def _lower_mv_value_agg(ctx: AggPlanContext, name: str, label: str,
                                        outs[i_c][gids]), tag))
 
 
-def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAgg:
+_FILTERABLE = frozenset(("count", "sum", "min", "max", "avg", "minmaxrange"))
+
+
+def _count_op(ctx: AggPlanContext, arg, cond) -> int:
+    """Kernel output index for a COUNT under null handling and/or a FILTER
+    clause; 0 (the shared per-group doc count) when neither applies.
+    add_op dedups, so COUNT(x) FILTER(c) and AVG(x) FILTER(c) share one
+    op."""
+    ncond = ctx._null_cond_for(arg) if arg is not None else None
+    if cond is None and ncond is None:
+        return 0
+    one = ir.ConstParam(ctx.param(np.int32(1)))
+    zero = ir.ConstParam(ctx.param(np.int32(0)))
+    base = one if ncond is None else ir.Where(ncond, zero, one)
+    ve = base if cond is None else ir.Where(cond, base, zero)
+    return ctx.add_op(ir.AggOp("sum", vexpr=ve, vmin=0, vmax=1))
+
+
+def _scalar_op(ctx: AggPlanContext, kind: str, arg, cond) -> int:
+    """Kernel output index for a sum/min/max reduction over ``arg`` with
+    null handling (agg_operand identity wrap) and an optional FILTER
+    clause composed on top."""
+    nullable = ctx._null_cond_for(arg) is not None
+    if kind == "sum":
+        bounds = _int_bounds(ctx, arg)
+        if bounds and (nullable or cond is not None):
+            # identity rows contribute 0
+            bounds = {"vmin": min(0, bounds["vmin"]),
+                      "vmax": max(0, bounds["vmax"])}
+        ve = ctx.agg_operand(arg, 0)
+        if cond is not None:
+            ve = ir.Where(cond, ve, ir.ConstParam(ctx.param(np.int64(0))))
+        return ctx.add_op(ir.AggOp("sum", vexpr=ve, **bounds))
+    # min / max: identity rows need ±inf, so compare in f64
+    ident_tok = "inf" if kind == "min" else "-inf"
+    bounds = {} if (nullable or cond is not None) else _int_bounds(ctx, arg)
+    ve = ctx.agg_operand(arg, ident_tok)
+    if cond is not None:
+        inf = np.inf if kind == "min" else -np.inf
+        ve = ir.Where(cond, ir.Cast(ve, "DOUBLE"),
+                      ir.ConstParam(ctx.param(np.float64(inf))))
+    return ctx.add_op(ir.AggOp(kind, vexpr=ve, **bounds))
+
+
+def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext,
+                      _cond=None, _label=None) -> LoweredAgg:
     fn = expr.function
+    if fn.name == "filter":
+        # AGG(x) FILTER (WHERE cond) — reference
+        # FilteredAggregationFunction: rows failing the clause contribute
+        # the agg identity. The clause lowers through the PREDICATE path
+        # (dict-id LUTs, intervals, index masks — and 3VL under null
+        # handling), bridged into value space.
+        inner, cond_expr = fn.arguments
+        try:
+            from ..query.converter import (FilterConversionError,
+                                           filter_from_expression)
+
+            cond = ir.FilterVal(ctx.lower_filter(
+                filter_from_expression(cond_expr)))
+        except (FilterConversionError, UnsupportedQueryError, AttributeError):
+            cond = ctx.value_expr(cond_expr)  # boolean plane
+            ncond = ctx._null_cond_for(cond_expr)
+            if ncond is not None:  # 3VL: a null clause input is false
+                cond = ir.Bin("and", cond, ir.Un("not", ncond))
+        return lower_aggregation(ctx, inner, _cond=cond, _label=str(expr))
     raw_name, args = fn.name, fn.arguments
-    label = str(expr)
+    label = _label or str(expr)
     data, extra = split_args(fn)
     name, extra = canonicalize(raw_name, extra)
     sem = get_semantics(name, extra)
+    if _cond is not None and name not in _FILTERABLE:
+        raise UnsupportedQueryError(
+            f"FILTER clause over {name} has no device form (host path)")
+
+    def cond_wrap(ve: ir.ValueExpr, ident: ir.ValueExpr) -> ir.ValueExpr:
+        return ve if _cond is None else ir.Where(_cond, ve, ident)
 
     if name == "count":
-        # advanced null handling: COUNT(col) counts non-null rows
-        i = ctx.nonnull_count_op(data[0]) if data else 0
+        # advanced null handling counts non-null rows; a FILTER clause
+        # counts clause-passing rows (composable)
+        i = _count_op(ctx, data[0] if data else None, _cond)
         spec, tag = VEC_RECIPES["count"]
         return LoweredAgg(
             label, sem, lambda outs, g: int(outs[i][g]),
             vec=VecAgg(spec, lambda outs, gids: (outs[i][gids],), tag))
 
     if name in ("sum", "min", "max"):
-        ident = {"sum": 0, "min": "inf", "max": "-inf"}[name]
-        bounds = _int_bounds(ctx, data[0])
-        if bounds and ctx._null_cond_for(data[0]) is not None:
-            if name == "sum":  # null rows contribute identity 0
-                bounds = {"vmin": min(0, bounds["vmin"]),
-                          "vmax": max(0, bounds["vmax"])}
-            else:  # min/max compare in f64 with ±inf identities
-                bounds = {}
-        i = ctx.add_op(ir.AggOp(name, vexpr=ctx.agg_operand(data[0], ident),
-                                **bounds))
+        i = _scalar_op(ctx, name, data[0], _cond)
         spec, tag = VEC_RECIPES[name]
         return LoweredAgg(
             label, sem, lambda outs, g: float(outs[i][g]),
@@ -471,8 +535,8 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
         return _lower_mv_value_agg(ctx, name, label, sem, data[0])
 
     if name == "minmaxrange":
-        i_min = ctx.add_op(ir.AggOp("min", vexpr=ctx.agg_operand(data[0], "inf")))
-        i_max = ctx.add_op(ir.AggOp("max", vexpr=ctx.agg_operand(data[0], "-inf")))
+        i_min = _scalar_op(ctx, "min", data[0], _cond)
+        i_max = _scalar_op(ctx, "max", data[0], _cond)
         spec, tag = VEC_RECIPES["minmaxrange"]
         return LoweredAgg(
             label, sem,
@@ -483,14 +547,9 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext) -> LoweredAg
                        tag))
 
     if name == "avg":
-        bounds = _int_bounds(ctx, data[0])
-        if bounds and ctx._null_cond_for(data[0]) is not None:
-            bounds = {"vmin": min(0, bounds["vmin"]),
-                      "vmax": max(0, bounds["vmax"])}
-        i = ctx.add_op(ir.AggOp("sum", vexpr=ctx.agg_operand(data[0], 0),
-                                **bounds))
-        # advanced null handling: divide by the NON-NULL count
-        c = ctx.nonnull_count_op(data[0])
+        i = _scalar_op(ctx, "sum", data[0], _cond)
+        # divide by the rows that CONTRIBUTED (non-null ∩ clause-passing)
+        c = _count_op(ctx, data[0], _cond)
         spec, tag = VEC_RECIPES["avg"]
         return LoweredAgg(
             label, sem,

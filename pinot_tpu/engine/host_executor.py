@@ -345,8 +345,31 @@ class HostSegmentExecutor:
             states.append(self._agg_state(agg, segment, mask, nh))
         return AggIntermediate(states, num_docs_scanned=int(mask.sum()))
 
+    def _clause_mask(self, cond: ExpressionContext, segment,
+                     nh: bool) -> np.ndarray:
+        """FILTER (WHERE cond) clause mask via the same predicate
+        machinery as WHERE (LIKE/IN/IS NULL all work; 3VL under null
+        handling), mirroring the device's FilterVal lowering."""
+        from ..query.converter import FilterConversionError, filter_from_expression
+
+        try:
+            fc = filter_from_expression(cond)
+        except FilterConversionError:
+            m = np.asarray(self.eval_value(cond, segment)).astype(bool)
+            if nh:  # a null clause input is false
+                m &= ~self._nulls_of(cond.columns(), segment, segment.num_docs)
+            return m
+        if nh:
+            t, _u = self._eval_filter3(fc, segment)
+            return t
+        return self._eval_filter(fc, segment)
+
     def _agg_state(self, agg: ExpressionContext, segment, mask, nh=False):
         name = agg.function.name
+        if name == "filter":  # AGG(x) FILTER (WHERE cond)
+            inner, cond = agg.function.arguments
+            return self._agg_state(
+                inner, segment, mask & self._clause_mask(cond, segment, nh), nh)
         data, extra = split_args(agg.function)
         if nh and data:
             # skip rows where ANY operand column is null (COUNT(expr) too;
@@ -399,46 +422,56 @@ class HostSegmentExecutor:
             rows = sel_sorted[s:e]
             key = tuple(_to_python(col[rows[0]]) for col in key_cols)
             states = []
-            for agg, (kind, cols, extra, drop) in zip(query.aggregations,
-                                                      agg_args):
+            for (kind, cols, extra, drop, fname) in agg_args:
                 r = rows if drop is None else rows[~drop[rows]]
                 if kind == "count":
                     states.append(len(r))
                 elif kind == "mv":
                     flat = [v for i in r for v in cols[i]]
                     states.append(
-                        host_state(agg.function.name, np.asarray(flat), extra))
+                        host_state(fname, np.asarray(flat), extra))
                 else:
                     states.append(
-                        host_state_full(agg.function.name, [c[r] for c in cols], extra))
+                        host_state_full(fname, [c[r] for c in cols], extra))
             groups[key] = states
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))
 
     def _classify_agg_args(self, query, segment) -> list:
-        """Per aggregation: (kind, payload, extra, drop) where kind is
+        """Per aggregation: (kind, payload, extra, drop, name) where kind is
         "count" | "mv" (MV column decoded ONCE per query) | "sv" (eval'd
-        value arrays) and drop is the advanced-null-handling bitmap of rows
-        to skip for this agg (None = keep all). Shared by the SV and MV
-        group-by paths."""
+        value arrays), drop is a bitmap of rows to skip for this agg
+        (advanced null handling ∪ a FILTER (WHERE ...) clause; None = keep
+        all), and name is the state function to build (the INNER name for
+        filter-wrapped aggs). Shared by the SV and MV group-by paths."""
         nh = query.null_handling
         n = segment.num_docs
         agg_args = []
         mv_cache: dict[str, object] = {}
 
-        def drop_for(exprs):
-            if not nh:
-                return None
-            cols = set()
-            for a in exprs:
-                cols |= a.columns()
-            d = self._nulls_of(cols - {"*"}, segment, n)
-            return d if d.any() else None
+        def drop_for(exprs, clause_drop):
+            d = clause_drop
+            if nh:
+                cols = set()
+                for a in exprs:
+                    cols |= a.columns()
+                nd = self._nulls_of(cols - {"*"}, segment, n)
+                if nd.any():
+                    d = nd if d is None else (d | nd)
+            return d
 
         for agg in query.aggregations:
-            data, extra = split_args(agg.function)
-            if agg.function.name == "count":
+            fexpr = agg.function
+            clause_drop = None
+            if fexpr.name == "filter":  # AGG(x) FILTER (WHERE cond)
+                inner, cond = fexpr.arguments
+                clause_drop = ~self._clause_mask(cond, segment, nh)
+                fexpr = inner.function
+            name = fexpr.name
+            data, extra = split_args(fexpr)
+            if name == "count":
                 # advanced null handling: COUNT(col) counts non-null rows
-                agg_args.append(("count", None, (), drop_for(data)))
+                agg_args.append(
+                    ("count", None, (), drop_for(data, clause_drop), name))
                 continue
             if (len(data) == 1 and data[0].is_identifier
                     and segment.has_column(data[0].identifier)
@@ -450,11 +483,13 @@ class HostSegmentExecutor:
                 col = data[0].identifier
                 if col not in mv_cache:
                     mv_cache[col] = segment.get_mv_values(col)
-                agg_args.append(("mv", mv_cache[col], extra, drop_for(data)))
+                agg_args.append(("mv", mv_cache[col], extra,
+                                 drop_for(data, clause_drop), name))
             else:
                 agg_args.append(
                     ("sv", [np.asarray(self.eval_value(a, segment))
-                            for a in data], extra, drop_for(data)))
+                            for a in data], extra,
+                     drop_for(data, clause_drop), name))
         return agg_args
 
     def _group_by_mv(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
@@ -500,18 +535,17 @@ class HostSegmentExecutor:
                 j += 1
             rows_idx = docs[order[i:j]]
             states = []
-            for agg, (kind, cols, extra, drop) in zip(query.aggregations,
-                                                      agg_args):
+            for (kind, cols, extra, drop, fname) in agg_args:
                 r = rows_idx if drop is None else rows_idx[~drop[rows_idx]]
                 if kind == "count":
                     states.append(len(r))
                 elif kind == "mv":
                     flat = [v for d in r for v in cols[d]]
                     states.append(
-                        host_state(agg.function.name, np.asarray(flat), extra))
+                        host_state(fname, np.asarray(flat), extra))
                 else:
                     states.append(host_state_full(
-                        agg.function.name, [c[r] for c in cols], extra))
+                        fname, [c[r] for c in cols], extra))
             groups[keys_sorted[i]] = states
             i = j
         return GroupByIntermediate(groups, num_docs_scanned=int(mask.sum()))

@@ -107,6 +107,17 @@ class Where(ValueExpr):
 
 
 @dataclass(frozen=True)
+class FilterVal(ValueExpr):
+    """A lowered FILTER subtree used as a boolean VALUE plane — the bridge
+    that lets FILTER (WHERE ...) clause conditions reuse the whole
+    predicate lowering (dict-id LUTs, intervals, host index masks) inside
+    an aggregation operand wrap. Declared after FilterNode; the field is
+    typed loosely to avoid a forward reference."""
+
+    filter: object  # FilterNode
+
+
+@dataclass(frozen=True)
 class NullCol(ValueExpr):
     """The column's null bitmap plane as a boolean value (advanced null
     handling: agg operands wrap as Where(NullCol, identity, v) so null

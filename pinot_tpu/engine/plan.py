@@ -62,6 +62,24 @@ def _vexpr_uses_slots(ve, slots: set) -> bool:
         return (_vexpr_uses_slots(ve.cond, slots)
                 or _vexpr_uses_slots(ve.a, slots)
                 or _vexpr_uses_slots(ve.b, slots))
+    if isinstance(ve, ir.FilterVal):
+        return _filter_uses_slots(ve.filter, slots)
+    return False
+
+
+def _filter_uses_slots(f, slots: set) -> bool:
+    if isinstance(f, (ir.FAnd, ir.FOr)):
+        return any(_filter_uses_slots(c, slots) for c in f.children)
+    if isinstance(f, ir.FNot):
+        return _filter_uses_slots(f.child, slots)
+    if isinstance(f, ir.Lut):
+        return f.ids_slot in slots
+    if isinstance(f, ir.Null):
+        return f.null_slot in slots
+    if isinstance(f, ir.Interval):
+        return _vexpr_uses_slots(f.vexpr, slots)
+    if isinstance(f, ir.Isin):
+        return _vexpr_uses_slots(f.vexpr, slots)
     return False
 
 

@@ -61,6 +61,10 @@ def _eval_value(node: ir.ValueExpr, arrays, params):
         )
     if isinstance(node, ir.NullCol):
         return arrays[node.null_slot]
+    if isinstance(node, ir.FilterVal):
+        # n=1 for constant leaves: a (1,) mask broadcasts against (n,)
+        # operands in the Where wrap
+        return _eval_filter(node.filter, arrays, params, 1)
     if isinstance(node, ir.MvLutReduce):
         if node.op == "count":  # non-pad slots per doc; no LUT gather
             return (arrays[node.ids_slot] != node.card).sum(

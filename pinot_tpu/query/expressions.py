@@ -139,7 +139,15 @@ def is_aggregation_name(name: str) -> bool:
 
 
 def is_aggregation(expr: ExpressionContext) -> bool:
-    return expr.is_function and is_aggregation_name(expr.function.name)
+    if not expr.is_function:
+        return False
+    fn = expr.function
+    # filter(agg, cond): the FILTER (WHERE ...) clause wrapper
+    # (reference FilteredAggregationFunction)
+    if fn.name == "filter" and fn.arguments \
+            and is_aggregation(fn.arguments[0]):
+        return True
+    return is_aggregation_name(fn.name)
 
 
 def contains_aggregation(expr: ExpressionContext) -> bool:

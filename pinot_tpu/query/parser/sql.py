@@ -427,7 +427,22 @@ class _Parser:
         while self.accept_op(","):
             args.append(self.parse_expression())
         self.expect_op(")")
-        return ExpressionContext.for_function(name, *args)
+        e = ExpressionContext.for_function(name, *args)
+        # AGG(x) FILTER (WHERE cond) — reference FilteredAggregationFunction;
+        # postfix here so HAVING / ORDER BY positions parse too
+        if self.peek().kind == "ident" and self.peek().upper == "FILTER":
+            from ..expressions import is_aggregation
+
+            if not is_aggregation(e):
+                raise SqlParseError(
+                    "FILTER clause requires an aggregation function")
+            self.next()
+            self.expect_op("(")
+            self.expect_kw("WHERE")
+            cond = self.parse_expression()
+            self.expect_op(")")
+            e = ExpressionContext.for_function("filter", e, cond)
+        return e
 
     def _parse_case(self) -> ExpressionContext:
         """CASE WHEN c1 THEN v1 ... [ELSE d] END → case(c1,v1,...,d)

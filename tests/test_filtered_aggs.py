@@ -1,0 +1,144 @@
+"""AGG(x) FILTER (WHERE cond) — reference FilteredAggregationFunction:
+rows failing the clause contribute the aggregation identity. Device and
+host engines against a sqlite oracle (sqlite implements the SQL-standard
+FILTER clause natively)."""
+
+from __future__ import annotations
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.plan import SegmentPlanner
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.query.parser.sql import parse_sql
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "fa", dimensions=[("k", "INT"), ("s", "STRING")],
+    metrics=[("v", "INT"), ("f", "DOUBLE")])
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    rng = np.random.default_rng(55)
+    d = tmp_path_factory.mktemp("fa")
+    n = 3000
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE fa (k INT, s TEXT, v INT, f REAL)")
+    segs = []
+    for si in range(2):
+        k = rng.integers(0, 6, n)
+        s = [f"s{int(x)}" for x in rng.integers(0, 4, n)]
+        v = rng.integers(-30, 200, n)
+        f = np.round(rng.random(n) * 90, 3)
+        SegmentBuilder(SCHEMA, segment_name=f"fa{si}").build(
+            {"k": k.astype(np.int32), "s": np.asarray(s, object),
+             "v": v.astype(np.int32), "f": f}, d / f"fa{si}")
+        segs.append(load_segment(d / f"fa{si}"))
+        conn.executemany("INSERT INTO fa VALUES (?,?,?,?)",
+                         list(zip(map(int, k), s, map(int, v), map(float, f))))
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(SCHEMA, segs)
+    host = QueryExecutor(backend="host")
+    host.add_table(SCHEMA, segs)
+    return tpu, host, conn, segs
+
+
+def _norm(v):
+    return round(v, 5) if isinstance(v, float) else v
+
+
+def _check(env_t, sql, oracle_sql=None):
+    tpu, host, conn, _ = env_t
+    want = [[_norm(x) for x in r]
+            for r in conn.execute(oracle_sql or sql).fetchall()]
+    for ex in (tpu, host):
+        r = ex.execute_sql(sql)
+        assert not r.exceptions, (sql, r.exceptions)
+        got = [[_norm(x) for x in row] for row in r.result_table.rows]
+        assert got == want, (sql, got[:3], want[:3])
+
+
+QUERIES = [
+    "SELECT SUM(v) FILTER (WHERE s = 's1'), COUNT(*) FILTER (WHERE v > 50), "
+    "COUNT(*) FROM fa",
+    "SELECT AVG(v) FILTER (WHERE k < 3), SUM(v) FROM fa WHERE v > 0",
+    "SELECT k, SUM(v) FILTER (WHERE s = 's2'), COUNT(*) FROM fa "
+    "GROUP BY k ORDER BY k",
+    "SELECT k, AVG(f) FILTER (WHERE v > 100), MAX(f) FILTER (WHERE s <> 's0') "
+    "FROM fa GROUP BY k ORDER BY k",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_matches_sqlite(env, sql):
+    # sqlite: empty-input SUM/MAX/AVG yield NULL; the engine yields the
+    # identity — the data here never produces an empty filtered input per
+    # group (6 groups x 3000 rows), so results align exactly
+    _check(env, sql)
+
+
+def test_min_filter_identity_on_device(env):
+    tpu, host, conn, segs = env
+    sql = ("SELECT k, MIN(v) FILTER (WHERE v > 150) FROM fa "
+           "GROUP BY k ORDER BY k")
+    plan = SegmentPlanner(parse_sql(sql), segs[0]).plan()
+    assert any(op.kind == "min" for op in plan.program.aggs)
+    a = tpu.execute_sql(sql)
+    b = host.execute_sql(sql)
+    assert not a.exceptions and not b.exceptions
+    assert [[_norm(v) for v in r] for r in a.result_table.rows] == \
+        [[_norm(v) for v in r] for r in b.result_table.rows]
+
+
+def test_filter_clause_requires_aggregation(env):
+    tpu, _, _, _ = env
+    r = tpu.execute_sql("SELECT v FILTER (WHERE k = 1) FROM fa")
+    assert r.exceptions  # parse error, not silent misinterpretation
+
+
+def test_filter_composes_with_null_handling(tmp_path):
+    schema = Schema.build("nf", dimensions=[("k", "INT")], metrics=[("v", "INT")])
+    rng = np.random.default_rng(9)
+    n = 1500
+    k = rng.integers(0, 4, n)
+    v = [None if rng.random() < 0.3 else int(x)
+         for x in rng.integers(0, 100, n)]
+    SegmentBuilder(schema, segment_name="nf").build(
+        {"k": k.astype(np.int32), "v": v}, tmp_path / "nf")
+    seg = load_segment(tmp_path / "nf")
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE nf (k INT, v INT)")
+    conn.executemany("INSERT INTO nf VALUES (?,?)", list(zip(map(int, k), v)))
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(schema, [seg])
+    sql = ("SELECT k, COUNT(v) FILTER (WHERE k < 2), SUM(v) FILTER (WHERE k < 2) "
+           "FROM nf GROUP BY k ORDER BY k")
+    want = conn.execute(sql).fetchall()
+    r = qe.execute_sql("SET enableNullHandling = true; " + sql)
+    assert not r.exceptions, r.exceptions
+    got = [tuple(None if x is None else int(x) for x in row)
+           for row in r.result_table.rows]
+    # identity-vs-NULL divergence only on empty inputs (k >= 2 rows): accept 0
+    for g, w in zip(got, want):
+        assert g[0] == w[0]
+        assert g[1] == (w[1] if w[1] is not None else 0)
+        assert g[2] == (w[2] if w[2] is not None else 0)
+
+
+def test_filter_clause_in_having_and_like(env):
+    tpu, host, conn, _ = env
+    sql = ("SELECT k, COUNT(*) FROM fa GROUP BY k "
+           "HAVING SUM(v) FILTER (WHERE s LIKE 's1%') > 100 ORDER BY k")
+    want = conn.execute(
+        "SELECT k, COUNT(*) FROM fa GROUP BY k "
+        "HAVING SUM(v) FILTER (WHERE s LIKE 's1%') > 100 ORDER BY k").fetchall()
+    for ex in (tpu, host):
+        r = ex.execute_sql(sql)
+        assert not r.exceptions, (sql, r.exceptions)
+        got = [(int(a), int(b)) for a, b in r.result_table.rows]
+        assert got == [(int(a), int(b)) for a, b in want]
