@@ -225,9 +225,12 @@ def _init_backend():
     import jax
     from jax.extend import backend as jex_backend
 
-    try:  # persist compiles across bench runs (no-op for remote compile)
+    try:  # persist compiles across bench runs (no-op for remote compile).
+        # NOT shared with the test suite's cache: pytest compiles under
+        # different XLA flags and the AOT loader warns cross-loading could
+        # SIGILL on mismatched machine-feature sets
         jax.config.update("jax_compilation_cache_dir",
-                          str(Path(__file__).parent / ".jax_cache"))
+                          str(Path(__file__).parent / ".jax_cache_bench"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass
