@@ -202,6 +202,22 @@ _DECOMPOSE = {"count", "sum", "min", "max", "avg", "minmaxrange"}
 # -- planner -----------------------------------------------------------------
 
 
+def _reject_filter_clause(e: EC) -> None:
+    """AGG(x) FILTER (WHERE ...) parses (shared V1 grammar) but the MSE
+    operators can't evaluate it yet — reject clearly instead of letting it
+    surface as 'column must appear in GROUP BY' or 'transform function
+    filter'."""
+    if not e.is_function:
+        return
+    if e.function.name == "filter":
+        raise PlanError(
+            "FILTER (WHERE ...) aggregations are not yet supported in "
+            "the multi-stage engine; single-table queries support them "
+            "through the single-stage engine")
+    for a in e.function.arguments:
+        _reject_filter_clause(a)
+
+
 class LogicalPlanner:
     """Builds a PlanNode tree; identifiers are rewritten to exact input
     column names during planning so the runtime never resolves names.
@@ -263,6 +279,15 @@ class LogicalPlanner:
                                   condition=self._resolve(remaining, node.schema))
         if stmt.having is not None:
             _reject_nested_subqueries(stmt.having)
+
+        # unconditional pre-walk: short-circuiting any()/or below must not
+        # let a FILTER clause slip past to a misleading downstream error
+        for it in stmt.select_items:
+            _reject_filter_clause(it.expression)
+        if stmt.having is not None:
+            _reject_filter_clause(stmt.having)
+        for ob in stmt.order_by or []:
+            _reject_filter_clause(ob.expression)
 
         has_windows = any(it.window is not None for it in stmt.select_items)
         agg_in_select = any(

@@ -142,3 +142,32 @@ def test_filter_clause_in_having_and_like(env):
         assert not r.exceptions, (sql, r.exceptions)
         got = [(int(a), int(b)) for a, b in r.result_table.rows]
         assert got == [(int(a), int(b)) for a, b in want]
+
+
+def test_mse_join_with_filter_clause_errors_clearly(env):
+    """The MSE can't evaluate the clause yet — the error must say so
+    instead of the misleading 'must appear in GROUP BY'."""
+    tpu, _, _, _ = env
+    r = tpu.multistage.execute_sql(
+        "SELECT a.k, SUM(a.v) FILTER (WHERE a.v > 0) FROM fa a "
+        "JOIN fa b ON a.k = b.k GROUP BY a.k")
+    assert r.exceptions and "not yet supported in the multi-stage" in r.exceptions[0], \
+        r.exceptions
+
+
+def test_mse_filter_clause_error_covers_all_positions(env):
+    tpu, _, _, _ = env
+    for sql in [
+        # sibling aggregate before the FILTER item (any() short-circuit)
+        "SELECT a.k, SUM(a.v), SUM(a.v) FILTER (WHERE a.v > 0) FROM fa a "
+        "JOIN fa b ON a.k = b.k GROUP BY a.k",
+        # HAVING position with GROUP BY present (or-chain short-circuit)
+        "SELECT a.k, SUM(a.v) FROM fa a JOIN fa b ON a.k = b.k "
+        "GROUP BY a.k HAVING SUM(a.v) FILTER (WHERE a.v > 0) > 10",
+        # ORDER BY position
+        "SELECT a.k, SUM(a.v) FROM fa a JOIN fa b ON a.k = b.k "
+        "GROUP BY a.k ORDER BY SUM(a.v) FILTER (WHERE a.v > 0)",
+    ]:
+        r = tpu.multistage.execute_sql(sql)
+        assert r.exceptions and "not yet supported in the multi-stage" in \
+            r.exceptions[0], (sql, r.exceptions)
