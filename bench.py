@@ -3,22 +3,38 @@
 Configs (BASELINE.md, scaled to BENCH_ROWS total rows each):
   q1  SSB Q1.1-style range filter + SUM           (1 segment)
   q2  SSB Q2-style dict filter + GROUP BY 2 dims  (1 segment)   ← headline
-  q3  high-cardinality GROUP BY (sparse sort-based device path)
+  q3  high-cardinality GROUP BY (sparse device path)
   q4  16-segment combine of q2 (batched async dispatch)
   q5  NYC-Taxi-style COUNT DISTINCT + PERCENTILE_TDIGEST GROUP BY day
+  q6  sparse COUNT DISTINCT inside a high-card group-by
 
-The CPU baseline is this repo's host (numpy) engine running segments on a
-worker pool sized to the machine's cores (the reference publishes no
-absolute numbers — BASELINE.md — so the ratio is measured against the
-parallel vectorized CPU path on the same machine). Roofline: bytes/s is
-the column-plane bytes each query must read from HBM divided by p50,
-reported against the v5e peak of ~819 GB/s.
+Architecture (hardened after rounds 1-2 produced zero TPU artifacts):
+  * The PARENT process never touches the accelerator. It probes it in a
+    disposable subprocess, builds/caches segments on CPU, then runs each
+    config in its OWN subprocess (`bench.py --config qN --out FILE`).
+  * Each child enforces an INTERNAL deadline (checked between iterations)
+    and exits cleanly, releasing the TPU lease. Nothing is ever externally
+    killed mid-device-op: killing a process holding the axon lease wedges
+    the tunnel for hours (round-2 failure mode). A child that outlives its
+    deadline + grace is abandoned (orphaned, not killed) and remaining
+    configs are skipped.
+  * The parent RE-PRINTS the full summary JSON line after every config
+    completes (flushing stdout), so even if the driver times the bench out,
+    the last parseable line carries every config that finished. Partials
+    also land in .bench_partial/*.json.
 
-Prints ONE JSON line:
+The CPU baseline is this repo's host (numpy) engine on the same machine
+(the reference publishes no absolute numbers — BASELINE.md). Roofline:
+bytes/s is the column-plane bytes each query must read from HBM divided
+by p50, reported against the v5e peak of ~819 GB/s.
+
+Prints ONE JSON line (repeatedly, updated as configs finish):
   {"metric": ..., "value": rows/sec/chip, "unit": "rows/s", "vs_baseline": x}
 
 Env knobs: BENCH_ROWS (default 100M), BENCH_ITERS (default 10),
-BENCH_PLATFORM (e.g. cpu for local runs), BENCH_CONFIGS (csv, default all).
+BENCH_PLATFORM (e.g. cpu for local runs), BENCH_CONFIGS (csv, default all),
+BENCH_TIME_BUDGET_S (default 2040 — below the driver's external timeout so
+the parent always gets to emit).
 """
 
 from __future__ import annotations
@@ -33,12 +49,13 @@ import numpy as np
 
 ROWS = int(os.environ.get("BENCH_ROWS", 100_000_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 10))
-# global wall budget: emit whatever finished instead of being timed out by
-# the harness with NOTHING (round 1 lost its whole artifact that way)
-TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", 2400))
+TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", 2040))
 _START = time.monotonic()
-CONFIGS = os.environ.get("BENCH_CONFIGS", "q1,q2,q3,q4,q5,q6").split(",")
-CACHE = Path(__file__).parent / ".bench_cache"
+CONFIGS = [c for c in os.environ.get(
+    "BENCH_CONFIGS", "q1,q2,q3,q4,q5,q6").split(",") if c]
+ROOT = Path(__file__).parent
+CACHE = ROOT / ".bench_cache"
+PARTIAL = ROOT / ".bench_partial"
 V5E_HBM_PEAK = 819e9  # bytes/s
 
 Q1 = ("SELECT SUM(lo_extendedprice) FROM {t} WHERE d_year = 1993 "
@@ -56,6 +73,19 @@ Q6 = ("SET numGroupsLimit = 100000; "
       "FROM {t} GROUP BY lo_orderkey ORDER BY lo_orderkey LIMIT 100000")
 Q5 = ("SELECT pickup_day, DISTINCTCOUNT(passenger_count), "
       "PERCENTILETDIGEST(fare, 95) FROM taxi GROUP BY pickup_day LIMIT 1000")
+
+RUNS = {
+    "q1": ("q1_filter_sum", Q1.format(t="ssb"), "ssb", 1.0, 0.0),
+    "q2": ("q2_groupby", Q2.format(t="ssb"), "ssb", 1.0, 0.0),
+    "q3": ("q3_highcard_groupby", Q3.format(t="ssb"), "ssb", 1 / 3, 0.0),
+    "q4": ("q4_combine16", Q2.format(t="ssb16"), "ssb16", 1.0, 0.0),
+    # device tdigest is a fixed-bin histogram approximation; PERCENTILETDIGEST
+    # is approximate on BOTH paths (value-fed vs histogram-fed digests); a p95
+    # falling in a sparse tail gap of cent-rounded fares interpolates across
+    # the same gap from different cum positions — observed 1.2% on 1/730 groups
+    "q5": ("q5_distinct_tdigest", Q5, "taxi", 1 / 3, 0.02),
+    "q6": ("q6_sparse_distinct", Q6.format(t="ssb"), "ssb", 1 / 3, 0.0),
+}
 
 
 def _gen_ssb(rows: int, seed: int = 2024):
@@ -108,15 +138,6 @@ def _build(schema, cols, out_dir, seg_name, no_dict=()):
           f"in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
 
 
-def _load_table(qe_list, schema, seg_dirs):
-    from pinot_tpu.segment.loader import load_segment
-
-    segs = [load_segment(d) for d in seg_dirs]
-    for qe in qe_list:
-        qe.add_table(schema, segs)
-    return segs
-
-
 def prepare_tables(need_ssb, need_ssb16, need_taxi):
     """Build (once, cached on disk) and return {table: (schema, seg_dirs)}."""
     out = {}
@@ -160,109 +181,228 @@ def prepare_tables(need_ssb, need_ssb16, need_taxi):
     return out
 
 
-def _probe_accelerator(probe_s: float) -> bool:
-    """True iff a throwaway subprocess can run one device op within
-    probe_s. Transient init ERRORS get a second attempt (round-1 failure
-    mode); a TIMEOUT doesn't — a held lease won't heal in seconds. stderr
-    goes to a temp FILE, not a pipe: a wedged tunnel's helper process can
-    inherit a pipe fd and keep it open, which would block the parent in
-    communicate() past the timeout. The probe runs in its own session so
-    the timeout kill takes the whole process group with it."""
-    import signal
+def _remaining() -> float:
+    return TIME_BUDGET_S - (time.monotonic() - _START)
+
+
+# --------------------------------------------------------------------------
+# parent: probe + orchestrate per-config children
+# --------------------------------------------------------------------------
+
+def _probe_accelerator() -> bool:
+    """True iff a throwaway subprocess can run one device op.
+
+    Retries failed (errored) probes with backoff across the probe budget
+    (round-1 failure: ONE transient init error killed the bench). A HUNG
+    probe is waited on up to the probe budget and then ABANDONED, never
+    killed: killing a process mid-lease-acquisition is what wedged the
+    round-2 tunnel. stderr goes to a temp FILE, not a pipe, so a wedged
+    tunnel's helper child can't block us by inheriting the pipe fd.
+    """
     import subprocess
     import tempfile
 
-    for attempt in range(2):
+    budget = float(os.environ.get(
+        "BENCH_INIT_PROBE_S", min(600.0, TIME_BUDGET_S * 0.3)))
+    if budget <= 0:
+        return True
+    deadline = time.monotonic() + min(budget, max(_remaining() - 120, 30))
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
         with tempfile.TemporaryFile() as ef:
             proc = subprocess.Popen(
                 [sys.executable, "-c",
                  "import jax; jax.numpy.zeros(8).block_until_ready()"],
-                stdout=subprocess.DEVNULL, stderr=ef,
+                stdout=subprocess.DEVNULL, stderr=ef, env=env,
                 start_new_session=True)
-            try:
-                if proc.wait(timeout=probe_s) == 0:
-                    return True
-            except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except Exception:
-                    proc.kill()
-                proc.wait()
-                print(f"[bench] accelerator probe hung (> {probe_s:.0f}s)",
-                      file=sys.stderr)
+            while time.monotonic() < deadline and proc.poll() is None:
+                time.sleep(1.0)
+            rc = proc.poll()
+            if rc == 0:
+                return True
+            if rc is None:  # hung: abandon (no kill — lease-wedge hazard)
+                print(f"[bench] probe attempt {attempt} still hung after "
+                      f"{budget:.0f}s budget; abandoning it", file=sys.stderr)
                 return False
             ef.seek(0)
             tail = ef.read()[-2000:].decode(errors="replace").strip()
-            print(f"[bench] probe attempt {attempt + 1} failed:\n{tail}",
+            print(f"[bench] probe attempt {attempt} failed (rc={rc}):\n{tail}",
                   file=sys.stderr)
+        time.sleep(min(5 * 2 ** (attempt - 1), 60))
     return False
 
 
-def _init_backend():
-    """Initialize a jax backend with retry + CPU fallback.
+def _emit(results, platform, notes, skipped, final=False):
+    """(Re-)print the one-line summary JSON; also persist to .bench_partial."""
+    if "q2_groupby" in results:
+        hname = "q2_groupby"
+        metric = "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip"
+    elif results:
+        hname = next(iter(results))
+        metric = f"{hname}_rows_per_sec_per_chip"
+    else:
+        return
+    headline = results[hname]
+    out = {
+        "metric": metric,
+        "value": round(headline["rows_per_sec"]),
+        "unit": "rows/s",
+        "vs_baseline": round(headline["speedup"], 2),
+        "detail": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                       for kk, vv in v.items()} for k, v in results.items()},
+        "rows": ROWS,
+        "host_threads": os.cpu_count() or 1,
+        "platform": platform,
+        "final": final,
+    }
+    if notes:
+        out["warning"] = "; ".join(notes)
+    if skipped:
+        out["skipped_configs"] = skipped
+    line = json.dumps(out)
+    print(line, flush=True)
+    try:
+        PARTIAL.mkdir(exist_ok=True)
+        (PARTIAL / "summary.json").write_text(line)
+    except Exception:
+        pass
 
-    Round 1 died here: one transient axon/TPU init error at jax.devices()
-    crashed the whole bench (BENCH_r01.json rc=1). Retry with backoff; if the
-    accelerator never comes up, fall back to CPU so the round still produces
-    a parseable (clearly-labelled) number.
-    """
-    # a wedged accelerator tunnel HANGS at first device use rather than
-    # erroring (observed: axon lease held by a killed process) — probe in a
-    # disposable subprocess with a hard timeout BEFORE importing jax here,
-    # so a hang costs probe_s (per attempt), not the whole bench budget.
-    # Cost on a healthy accelerator: one extra backend init (~10-20s of the
-    # 2400s budget). BENCH_INIT_PROBE_S=0 disables the probe.
-    probe_note = None
-    probe_s = float(os.environ.get("BENCH_INIT_PROBE_S", 180))
-    if not os.environ.get("BENCH_PLATFORM") and probe_s > 0:
-        if not _probe_accelerator(probe_s):
-            print(f"[bench] accelerator probe failed/hung; forcing CPU",
+
+def orchestrate():
+    import subprocess
+
+    # the parent must NEVER initialize the accelerator backend (it would
+    # hold the single axon lease and starve the children) — pin it to CPU
+    # before any pinot_tpu import can pull jax in.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    platform_req = os.environ.get("BENCH_PLATFORM", "")
+    notes = []
+    if not platform_req:
+        if _probe_accelerator():
+            platform_req = ""  # default backend (axon/TPU)
+        else:
+            print("[bench] accelerator probe failed/hung; forcing CPU",
                   file=sys.stderr)
-            probe_note = "accelerator probe failed or hung, ran on cpu"
-            os.environ["BENCH_PLATFORM"] = "cpu"
-            os.environ["JAX_PLATFORMS"] = "cpu"
+            notes.append("accelerator probe failed or hung, ran on cpu")
+            platform_req = "cpu"
 
+    need_ssb = any(c in CONFIGS for c in ("q1", "q2", "q3", "q6"))
+    prepare_tables(need_ssb, "q4" in CONFIGS, "q5" in CONFIGS)
+
+    PARTIAL.mkdir(exist_ok=True)
+    results, skipped = {}, []
+    platform_seen = None
+    configs = [c for c in CONFIGS if c in RUNS]
+    hung = False
+    for i, cfg in enumerate(configs):
+        name = RUNS[cfg][0]
+        rem = _remaining()
+        if hung or rem < 60:
+            skipped.append(name)
+            print(f"[bench] SKIP {name}: "
+                  + ("previous config hung" if hung else "time budget exhausted"),
+                  file=sys.stderr)
+            continue
+        # fair share of the remaining budget, floor 120s (if we have it)
+        share = max(min(120.0, rem - 30), rem / (len(configs) - i))
+        outfile = PARTIAL / f"{cfg}.json"
+        outfile.unlink(missing_ok=True)
+        env = dict(os.environ)
+        env["BENCH_DEADLINE_S"] = str(share)
+        if platform_req:
+            env["BENCH_PLATFORM"] = platform_req
+            env["JAX_PLATFORMS"] = platform_req
+        else:
+            env.pop("BENCH_PLATFORM", None)
+            env.pop("JAX_PLATFORMS", None)
+        print(f"[bench] -> {cfg} (budget {share:.0f}s)", file=sys.stderr,
+              flush=True)
+        proc = subprocess.Popen(
+            [sys.executable, __file__, "--config", cfg, "--out", str(outfile)],
+            stdout=sys.stderr, stderr=sys.stderr, env=env,
+            start_new_session=True)
+        grace = share + 240  # child self-limits; grace covers init+build+host
+        t0 = time.monotonic()
+        while proc.poll() is None and time.monotonic() - t0 < grace \
+                and _remaining() > 20:
+            time.sleep(2.0)
+        if proc.poll() is None:
+            # abandon, never kill (axon lease-wedge hazard); skip the rest
+            print(f"[bench] {cfg} unresponsive after {grace:.0f}s; abandoning",
+                  file=sys.stderr)
+            notes.append(f"{cfg} hung and was abandoned")
+            hung = True
+            skipped.append(name)
+            continue
+        if outfile.exists():
+            try:
+                payload = json.loads(outfile.read_text())
+                platform_seen = payload.pop("platform", platform_seen)
+                note = payload.pop("note", None)
+                if note:
+                    notes.append(note)
+                results[name] = payload
+            except Exception as e:
+                notes.append(f"{cfg} result unreadable: {e}")
+                skipped.append(name)
+        else:
+            notes.append(f"{cfg} child exited rc={proc.returncode} "
+                         f"with no result")
+            skipped.append(name)
+        _emit(results, platform_seen or platform_req or "unknown", notes,
+              skipped)
+
+    if not results:
+        raise RuntimeError(
+            f"no benchmark configs produced results (BENCH_CONFIGS={CONFIGS})")
+    _emit(results, platform_seen or platform_req or "unknown", notes, skipped,
+          final=True)
+
+
+# --------------------------------------------------------------------------
+# child: run exactly one config, bounded by an internal deadline
+# --------------------------------------------------------------------------
+
+def _init_backend():
     import jax
-    from jax.extend import backend as jex_backend
 
     try:  # persist compiles across bench runs (no-op for remote compile).
         # NOT shared with the test suite's cache: pytest compiles under
         # different XLA flags and the AOT loader warns cross-loading could
         # SIGILL on mismatched machine-feature sets
         jax.config.update("jax_compilation_cache_dir",
-                          str(Path(__file__).parent / ".jax_cache_bench"))
+                          str(ROOT / ".jax_cache_bench"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass
-
-    if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu for local runs; axon default
+    if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     last_err = None
-    attempts = 4
-    for attempt in range(attempts):
+    for attempt in range(3):
         if attempt:
             time.sleep(min(5 * 2 ** (attempt - 1), 20))
         try:
             devs = jax.devices()
             print(f"[bench] devices: {devs}", file=sys.stderr)
-            return jax, devs[0].platform, probe_note
-        except Exception as e:  # backend init is the flaky part
+            return jax, devs[0].platform, None
+        except Exception as e:
             last_err = e
             print(f"[bench] backend init attempt {attempt + 1} failed: {e}",
                   file=sys.stderr)
             try:
+                from jax.extend import backend as jex_backend
                 jex_backend.clear_backends()
             except Exception:
                 pass
-    print("[bench] falling back to CPU platform", file=sys.stderr)
     jax.config.update("jax_platforms", "cpu")
     try:
+        from jax.extend import backend as jex_backend
         jex_backend.clear_backends()
     except Exception:
         pass
-    devs = jax.devices()
-    if devs[0].platform != "cpu":  # partial-cache left an accelerator backend
-        return jax, devs[0].platform, None
     return jax, "cpu", f"accelerator init failed, ran on cpu: {last_err}"
 
 
@@ -282,20 +422,6 @@ def _plan_bytes(qe, sql, segments):
         return total
     except Exception:
         return None
-
-
-def _time_query(qe, sql, iters):
-    r = qe.execute_sql(sql)  # warmup / compile / HBM residency
-    if r.exceptions:
-        raise RuntimeError(f"{sql}: {r.exceptions}")
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        r = qe.execute_sql(sql)
-        times.append(time.perf_counter() - t0)
-    if r.exceptions:
-        raise RuntimeError(f"{sql}: {r.exceptions}")
-    return float(np.median(times)), r
 
 
 def _rows_match(a, b, rel_tol=0.0) -> bool:
@@ -319,99 +445,86 @@ def _rows_match(a, b, rel_tol=0.0) -> bool:
     return True
 
 
-def main():
-    jax, platform, backend_note = _init_backend()
+def run_single(cfg: str, outpath: str):
+    name, sql, tname, iter_frac, tol = RUNS[cfg]
+    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S", 600))
+    jax, platform, note = _init_backend()
     from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.segment.loader import load_segment
 
-    need_ssb = any(c in CONFIGS for c in ("q1", "q2", "q3", "q6"))
-    need_ssb16 = "q4" in CONFIGS
-    need_taxi = "q5" in CONFIGS
-    tables = prepare_tables(need_ssb, need_ssb16, need_taxi)
-
+    tables = prepare_tables(tname in ("ssb",), tname == "ssb16",
+                            tname == "taxi")
+    schema, dirs = tables[tname]
+    segs = [load_segment(d) for d in dirs]
     ncpu = os.cpu_count() or 1
     tpu = QueryExecutor(backend="tpu")
     host = QueryExecutor(backend="host", num_threads=ncpu)
-    loaded = {}
-    for name, (schema, dirs) in tables.items():
-        loaded[name] = _load_table([tpu, host], schema, dirs)
+    for qe in (tpu, host):
+        qe.add_table(schema, segs)
 
-    runs = {
-        "q1_filter_sum": ("q1", Q1.format(t="ssb"), "ssb", ITERS, 0.0),
-        "q2_groupby": ("q2", Q2.format(t="ssb"), "ssb", ITERS, 0.0),
-        "q3_highcard_groupby": ("q3", Q3.format(t="ssb"), "ssb",
-                                max(3, ITERS // 3), 0.0),
-        "q4_combine16": ("q4", Q2.format(t="ssb16"), "ssb16", ITERS, 0.0),
-        # device tdigest is a fixed-bin histogram approximation; compare the
-        # host exact percentile within 1%
-        # 2%: PERCENTILETDIGEST is approximate on BOTH paths (value-fed vs
-        # histogram-fed digests); a p95 falling in a sparse tail gap of
-        # cent-rounded fares interpolates across the same gap from
-        # different cum positions — observed 1.2% on 1/730 groups
-        "q5_distinct_tdigest": ("q5", Q5, "taxi", max(3, ITERS // 3), 0.02),
-        # sparse (sort-based) COUNT DISTINCT inside a high-card group-by —
-        # the device pair-dedup path (VERDICT weak #5)
-        "q6_sparse_distinct": ("q6", Q6.format(t="ssb"), "ssb",
-                               max(3, ITERS // 3), 0.0),
-    }
+    target_iters = max(3, round(ITERS * iter_frac)) if iter_frac < 1 else ITERS
 
-    results = {}
-    skipped = []
-    for name, (cfg, sql, tname, iters, tol) in runs.items():
-        if cfg not in CONFIGS:
-            continue
-        if time.monotonic() - _START > TIME_BUDGET_S:
-            skipped.append(name)
-            print(f"[bench] SKIP {name}: time budget exhausted", file=sys.stderr)
-            continue
-        segs = loaded[tname]
-        p50, r = _time_query(tpu, sql, iters)
-        host_p50, rh = _time_query(host, sql, max(1, min(3, iters)))
-        match = _rows_match(r.result_table.rows, rh.result_table.rows, tol)
-        nbytes = _plan_bytes(tpu, sql, segs)
-        results[name] = {
-            "tpu_p50_s": p50,
-            "rows_per_sec": ROWS / p50,
-            "host_parallel_s": host_p50,
-            "speedup": host_p50 / p50,
-            "match": match,
-        }
-        if nbytes:
-            results[name]["hbm_bytes"] = nbytes
-            results[name]["hbm_bytes_per_sec"] = nbytes / p50
-            results[name]["hbm_peak_frac"] = (nbytes / p50) / V5E_HBM_PEAK
-        print(f"[bench] {name}: p50 {p50*1000:.1f}ms "
-              f"({ROWS/p50/1e9:.2f}B rows/s), host({ncpu}thr) "
-              f"{host_p50*1000:.0f}ms, speedup {host_p50/p50:.1f}x, "
-              f"match={match}"
-              + (f", {nbytes/p50/1e9:.0f} GB/s "
-                 f"({100*(nbytes/p50)/V5E_HBM_PEAK:.0f}% v5e peak)"
-                 if nbytes else ""),
-              file=sys.stderr)
+    r = tpu.execute_sql(sql)  # warmup / compile / HBM residency
+    if r.exceptions:
+        raise RuntimeError(f"{sql}: {r.exceptions}")
+    times = []
+    while len(times) < target_iters and (
+            not times or time.monotonic() + min(times) < deadline):
+        t0 = time.perf_counter()
+        r = tpu.execute_sql(sql)
+        times.append(time.perf_counter() - t0)
+    if r.exceptions:
+        raise RuntimeError(f"{sql}: {r.exceptions}")
+    p50 = float(np.median(times))
 
-    if not results:
-        raise RuntimeError(f"no benchmark configs ran (BENCH_CONFIGS={CONFIGS})")
-    if "q2_groupby" in results:
-        hname, metric = "q2_groupby", "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip"
-    else:
-        hname = next(iter(results))
-        metric = f"{hname}_rows_per_sec_per_chip"
-    headline = results[hname]
-    out = {
-        "metric": metric,
-        "value": round(headline["rows_per_sec"]),
-        "unit": "rows/s",
-        "vs_baseline": round(headline["speedup"], 2),
-        "detail": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
-                       for kk, vv in v.items()} for k, v in results.items()},
-        "rows": ROWS,
-        "host_threads": ncpu,
+    # host baseline: at least 1 run, more only if the deadline allows
+    host_times = []
+    while len(host_times) < 2 and (
+            not host_times or time.monotonic() + host_times[0] < deadline):
+        t0 = time.perf_counter()
+        rh = host.execute_sql(sql)
+        host_times.append(time.perf_counter() - t0)
+        if len(host_times) == 1 and rh.exceptions:
+            raise RuntimeError(f"host {sql}: {rh.exceptions}")
+    host_p50 = float(np.median(host_times))
+
+    match = _rows_match(r.result_table.rows, rh.result_table.rows, tol)
+    nbytes = _plan_bytes(tpu, sql, segs)
+    payload = {
+        "tpu_p50_s": p50,
+        "rows_per_sec": ROWS / p50,
+        "host_parallel_s": host_p50,
+        "speedup": host_p50 / p50,
+        "match": match,
+        "iters": len(times),
         "platform": platform,
     }
-    if backend_note:
-        out["warning"] = backend_note
-    if skipped:
-        out["skipped_configs"] = skipped
-    print(json.dumps(out))
+    if note:
+        payload["note"] = note
+    if nbytes:
+        payload["hbm_bytes"] = nbytes
+        payload["hbm_bytes_per_sec"] = nbytes / p50
+        payload["hbm_peak_frac"] = (nbytes / p50) / V5E_HBM_PEAK
+    print(f"[bench] {name}: p50 {p50*1000:.1f}ms "
+          f"({ROWS/p50/1e9:.2f}B rows/s), host({ncpu}thr) "
+          f"{host_p50*1000:.0f}ms, speedup {host_p50/p50:.1f}x, "
+          f"match={match}"
+          + (f", {nbytes/p50/1e9:.0f} GB/s "
+             f"({100*(nbytes/p50)/V5E_HBM_PEAK:.0f}% v5e peak)"
+             if nbytes else ""),
+          file=sys.stderr)
+    tmp = Path(outpath + ".tmp")
+    tmp.write_text(json.dumps(payload))
+    tmp.replace(outpath)
+
+
+def main():
+    if "--config" in sys.argv:
+        cfg = sys.argv[sys.argv.index("--config") + 1]
+        outpath = sys.argv[sys.argv.index("--out") + 1]
+        run_single(cfg, outpath)
+        return
+    orchestrate()
 
 
 if __name__ == "__main__":
@@ -421,11 +534,12 @@ if __name__ == "__main__":
         import traceback
 
         traceback.print_exc()
-        print(json.dumps({
-            "metric": "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip",
-            "value": 0,
-            "unit": "rows/s",
-            "vs_baseline": 0,
-            "error": f"{type(e).__name__}: {e}",
-        }))
-        sys.exit(0)
+        if "--config" not in sys.argv:
+            print(json.dumps({
+                "metric": "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip",
+                "value": 0,
+                "unit": "rows/s",
+                "vs_baseline": 0,
+                "error": f"{type(e).__name__}: {e}",
+            }))
+        sys.exit(0 if "--config" not in sys.argv else 1)

@@ -10,10 +10,18 @@ Here the dispatcher lives on the broker (`DistributedMseDispatcher`), plan
 fragments travel as the JSON contract in plan_serde.py, and mailbox blocks
 ride the same framed-TCP RPC plane the scatter/gather query path uses
 (cluster/transport.py). Stage workers are `ServerInstance` processes; each
-hosts an `MseWorkerService` holding its mailbox store. Dispatch is strictly
-bottom-up and synchronous: the dispatcher only submits a stage after every
-child stage's RPC has returned, and a child's RPC returns only after its
-output blocks are delivered — so a receive never has to wait on the wire.
+hosts an `MseWorkerService` holding its mailbox store.
+
+The data plane is PIPELINED, like the reference's streaming gRPC mailboxes
+(GrpcMailboxServer.java:43 + .../runtime/operator/exchange/): all stages'
+workers are dispatched CONCURRENTLY, producers ship their output in row
+CHUNKS as they become available followed by a per-sender EOS marker, and a
+receive blocks only until every declared sender has finished. Stages
+therefore overlap in wall time, and a final-phase aggregate consumes its
+mailbox incrementally (chunk → partial-merge) so a large shuffle never
+fully materializes in one process: buffered bytes are bounded by a credit
+(`MAILBOX_BUFFER_BYTES`) that blocks producers when a draining consumer
+falls behind (backpressure).
 
 Leaf stages execute over an explicit per-worker segment list chosen by the
 broker's replica selector (never "all hosted segments": with replication
@@ -26,7 +34,9 @@ from __future__ import annotations
 
 import copy
 import itertools
+import os
 import threading
+import time
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
@@ -42,7 +52,8 @@ from .executor import _block_to_result
 from .fragmenter import Stage, explain_stages, fragment, receive_nodes
 from .logical import LogicalPlanner, prune_columns
 from .optimizer import push_filters
-from .mailbox import Block, concat_blocks, hash_partition, table_partition
+from .mailbox import (Block, block_len, concat_blocks, hash_partition,
+                      table_partition)
 from .operators import op_filter
 from .parser import parse_relational
 from .plan_serde import expr_from_json, expr_to_json, stage_from_json, stage_to_json
@@ -51,83 +62,254 @@ from .runtime import StageRunner
 EC = ExpressionContext
 
 
+# rows per shipped chunk; small enough that a consumer overlaps a producer,
+# large enough that framing overhead stays negligible
+CHUNK_ROWS = int(os.environ.get("PINOT_TPU_MSE_CHUNK_ROWS", 65536))
+# buffered-bytes credit per mailbox once a streaming consumer is draining it
+MAILBOX_BUFFER_BYTES = int(os.environ.get(
+    "PINOT_TPU_MSE_MAILBOX_BUFFER_BYTES", 64 << 20))
+# ceiling on waiting for senders (a crashed producer must not hang a worker)
+MAILBOX_WAIT_S = float(os.environ.get("PINOT_TPU_MSE_MAILBOX_WAIT_S", 300))
+
+
+def _block_nbytes(block: Block) -> int:
+    return sum(np.asarray(v).nbytes for v in block.values())
+
+
+class MailboxCancelled(Exception):
+    pass
+
+
 class MailboxStore:
-    """Per-process store of delivered blocks, keyed by
+    """Per-process store of streamed chunks, keyed by
     (query_id, from_stage, to_stage, partition) — the mailbox id scheme of
-    the reference (`{requestId}|{sender}|{receiver}|{worker}`)."""
+    the reference (`{requestId}|{sender}|{receiver}|{worker}`).
+
+    Producers append chunks and finally mark per-sender EOS; consumers
+    either materialize (wait for all senders, concat) or stream (drain
+    chunks as they arrive — registering as a streamer arms the buffer
+    credit so `put` backpressures a runaway producer). Tracks cumulative
+    and high-water buffered bytes per query for the pipeline stats."""
 
     def __init__(self):
-        self._boxes: dict[tuple, list[Block]] = defaultdict(list)
-        self._lock = threading.Lock()
+        self._chunks: dict[tuple, list[Block]] = defaultdict(list)
+        self._eos: dict[tuple, set] = defaultdict(set)
+        self._buffered: dict[tuple, int] = defaultdict(int)
+        self._streaming: set = set()
+        self._cancelled: set = set()
+        self._total_bytes: dict[str, int] = defaultdict(int)
+        self._peak_bytes: dict[str, int] = defaultdict(int)
+        self._cond = threading.Condition()
+
+    def _check(self, query_id: str) -> None:
+        if query_id in self._cancelled:
+            raise MailboxCancelled(query_id)
 
     def put(self, query_id: str, from_stage: int, to_stage: int,
             partition: int, block: Block) -> None:
-        with self._lock:
-            self._boxes[(query_id, from_stage, to_stage, partition)].append(block)
+        key = (query_id, from_stage, to_stage, partition)
+        nbytes = _block_nbytes(block)
+        with self._cond:
+            deadline = time.monotonic() + MAILBOX_WAIT_S
+            while (key in self._streaming
+                   and self._buffered[key] + nbytes > MAILBOX_BUFFER_BYTES
+                   and self._buffered[key] > 0):
+                self._check(query_id)
+                if not self._cond.wait(1.0) and time.monotonic() > deadline:
+                    raise TimeoutError(f"mailbox {key} backpressure stall")
+            self._check(query_id)
+            self._chunks[key].append(block)
+            self._buffered[key] += nbytes
+            total = sum(v for k, v in self._buffered.items()
+                        if k[0] == query_id)
+            self._total_bytes[query_id] += nbytes
+            self._peak_bytes[query_id] = max(
+                self._peak_bytes[query_id], total)
+            self._cond.notify_all()
 
-    def get_all(self, query_id: str, from_stage: int, to_stage: int,
-                partition: int) -> list[Block]:
-        with self._lock:
-            return list(self._boxes.get((query_id, from_stage, to_stage, partition), []))
+    def mark_eos(self, query_id: str, from_stage: int, to_stage: int,
+                 partition: int, sender: int) -> None:
+        with self._cond:
+            self._eos[(query_id, from_stage, to_stage, partition)].add(sender)
+            self._cond.notify_all()
+
+    def wait_all(self, query_id: str, from_stage: int, to_stage: int,
+                 partition: int, expected_senders: int) -> list[Block]:
+        """Materializing receive: all senders' chunks, after every EOS."""
+        key = (query_id, from_stage, to_stage, partition)
+        deadline = time.monotonic() + MAILBOX_WAIT_S
+        with self._cond:
+            while len(self._eos[key]) < expected_senders:
+                self._check(query_id)
+                if not self._cond.wait(1.0) and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"mailbox {key}: {len(self._eos[key])}/"
+                        f"{expected_senders} senders after {MAILBOX_WAIT_S}s")
+            self._check(query_id)
+            chunks = self._chunks.pop(key, [])
+            self._buffered[key] = 0
+            self._cond.notify_all()
+            return chunks
+
+    def stream(self, query_id: str, from_stage: int, to_stage: int,
+               partition: int, expected_senders: int):
+        """Draining receive: yield chunks in arrival order, freeing each
+        (credit release) — stops once all senders EOS'd and queue is dry."""
+        key = (query_id, from_stage, to_stage, partition)
+        with self._cond:
+            self._streaming.add(key)
+        deadline = time.monotonic() + MAILBOX_WAIT_S
+        try:
+            while True:
+                with self._cond:
+                    while not self._chunks[key] and \
+                            len(self._eos[key]) < expected_senders:
+                        self._check(query_id)
+                        if not self._cond.wait(1.0) and \
+                                time.monotonic() > deadline:
+                            raise TimeoutError(f"mailbox {key} stream stall")
+                    self._check(query_id)
+                    if self._chunks[key]:
+                        chunk = self._chunks[key].pop(0)
+                        self._buffered[key] -= _block_nbytes(chunk)
+                        self._cond.notify_all()
+                    else:
+                        return
+                yield chunk
+                deadline = time.monotonic() + MAILBOX_WAIT_S
+        finally:
+            with self._cond:
+                self._streaming.discard(key)
+
+    def metrics(self, query_id: str) -> dict:
+        with self._cond:
+            return {"mailbox_bytes_total": self._total_bytes.get(query_id, 0),
+                    "mailbox_bytes_peak": self._peak_bytes.get(query_id, 0)}
+
+    def cancel(self, query_id: str) -> None:
+        with self._cond:
+            self._cancelled.add(query_id)
+            self._cond.notify_all()
 
     def cleanup(self, query_id: str) -> None:
-        with self._lock:
-            for key in [k for k in self._boxes if k[0] == query_id]:
-                del self._boxes[key]
+        with self._cond:
+            for d in (self._chunks, self._eos, self._buffered):
+                for key in [k for k in d if k[0] == query_id]:
+                    del d[key]
+            self._total_bytes.pop(query_id, None)
+            self._peak_bytes.pop(query_id, None)
+            self._cancelled.discard(query_id)
+            self._cond.notify_all()
 
 
 class RoutedMailbox:
     """StageRunner-compatible mailbox whose sends cross process boundaries.
 
     ``routing`` maps (to_stage, partition) → (host, port); a partition routed
-    to this process's own address short-circuits to the local store."""
+    to this process's own address short-circuits to the local store.
+    ``sender`` identifies this worker in EOS markers; ``expected`` maps
+    from_stage → number of sender workers a receive must wait for."""
 
     def __init__(self, boxes: MailboxStore, query_id: str,
                  routing: dict[tuple[int, int], tuple[str, int]],
-                 self_addr: tuple[str, int], send_rpc: Callable):
+                 self_addr: tuple[str, int], send_rpc: Callable,
+                 sender: int = 0, expected: Optional[dict[int, int]] = None):
         self.boxes = boxes
         self.query_id = query_id
         self.routing = routing
         self.self_addr = self_addr
         self.send_rpc = send_rpc  # (addr, request_dict) → None
+        self.sender = sender
+        self.expected = expected or {}
+        self.first_send_ts: Optional[float] = None
+        self.last_send_ts: Optional[float] = None
 
     def receive(self, from_stage: int, to_stage: int, partition: int,
                 schema=None) -> Block:
-        return concat_blocks(
-            self.boxes.get_all(self.query_id, from_stage, to_stage, partition),
-            schema)
+        chunks = self.boxes.wait_all(
+            self.query_id, from_stage, to_stage, partition,
+            self.expected.get(from_stage, 0))
+        return concat_blocks(chunks, schema)
+
+    def stream(self, from_stage: int, to_stage: int, partition: int,
+               schema=None):
+        return self.boxes.stream(self.query_id, from_stage, to_stage,
+                                 partition, self.expected.get(from_stage, 0))
 
     def send(self, from_stage: int, to_stage: int, partition: int,
-             block: Block) -> None:
+             block: Block, eos: bool = False) -> None:
         addr = self.routing.get((to_stage, partition))
         if addr is None:
             raise UnsupportedQueryError(
                 f"no route for stage {to_stage} partition {partition}")
+        now = time.monotonic()
+        self.first_send_ts = self.first_send_ts or now
+        self.last_send_ts = now
         if tuple(addr) == tuple(self.self_addr):
-            self.boxes.put(self.query_id, from_stage, to_stage, partition, block)
+            if block is not None:
+                self.boxes.put(self.query_id, from_stage, to_stage,
+                               partition, block)
+            if eos:
+                self.boxes.mark_eos(self.query_id, from_stage, to_stage,
+                                    partition, self.sender)
             return
-        self.send_rpc(tuple(addr), {
-            "type": "mse_mailbox", "query_id": self.query_id,
-            "from_stage": from_stage, "to_stage": to_stage,
-            "partition": partition, "block": block})
+        req = {"type": "mse_mailbox", "query_id": self.query_id,
+               "from_stage": from_stage, "to_stage": to_stage,
+               "partition": partition, "block": block,
+               "sender": self.sender}
+        if eos:
+            req["eos"] = True
+        self.send_rpc(tuple(addr), req)
+
+    def finish(self, from_stage: int, to_stage: int,
+               num_partitions: int) -> None:
+        """EOS to every partition of the parent stage (empty ones too)."""
+        for p in range(num_partitions):
+            self.send(from_stage, to_stage, p, None, eos=True)
 
     def send_partitioned(self, from_stage: int, to_stage: int, block: Block,
                          dist: str, keys: list[str], num_partitions: int,
-                         pfunc: Optional[str] = None) -> None:
-        if dist == "partitioned" and keys and num_partitions > 1:
-            # colocated join: route by the TABLE partition function — a leaf
-            # whose segments are all one partition sends one non-empty box
-            for p, b in enumerate(table_partition(
-                    block, keys[0], pfunc, num_partitions)):
-                self.send(from_stage, to_stage, p, b)
-        elif dist == "hash" and keys and num_partitions > 1:
-            for p, b in enumerate(hash_partition(block, keys, num_partitions)):
-                self.send(from_stage, to_stage, p, b)
-        elif dist == "broadcast":
-            for p in range(num_partitions):
-                self.send(from_stage, to_stage, p, block)
-        else:
-            self.send(from_stage, to_stage, 0, block)
+                         pfunc: Optional[str] = None,
+                         final: bool = True) -> None:
+        """Ship one output block in CHUNK_ROWS chunks (pipelining: the
+        consumer starts while later chunks are still in flight). With
+        ``final`` (the default, one-shot producers) EOS follows the last
+        chunk; chunked producers pass final=False and call finish()."""
+        for chunk in _iter_chunks(block):
+            if dist == "partitioned" and keys and num_partitions > 1:
+                # colocated join: route by the TABLE partition function — a
+                # leaf whose segments are all one partition sends one
+                # non-empty box
+                for p, b in enumerate(table_partition(
+                        chunk, keys[0], pfunc, num_partitions)):
+                    if block_len(b):
+                        self.send(from_stage, to_stage, p, b)
+            elif dist == "hash" and keys and num_partitions > 1:
+                for p, b in enumerate(hash_partition(
+                        chunk, keys, num_partitions)):
+                    if block_len(b):
+                        self.send(from_stage, to_stage, p, b)
+            elif dist == "broadcast":
+                for p in range(num_partitions):
+                    self.send(from_stage, to_stage, p, chunk)
+            else:
+                self.send(from_stage, to_stage, 0, chunk)
+        if final:
+            if dist == "broadcast" or (dist in ("hash", "partitioned")
+                                       and keys and num_partitions > 1):
+                self.finish(from_stage, to_stage, num_partitions)
+            else:
+                self.finish(from_stage, to_stage, 1)
+
+
+def _iter_chunks(block: Block):
+    n = block_len(block)
+    if n <= CHUNK_ROWS:
+        yield block
+        return
+    for lo in range(0, n, CHUNK_ROWS):
+        yield {c: np.asarray(v)[lo:lo + CHUNK_ROWS]
+               for c, v in block.items()}
 
 
 # -- worker side --------------------------------------------------------------
@@ -166,9 +348,17 @@ class MseWorkerService:
     def handle(self, request: dict):
         kind = request["type"]
         if kind == "mse_mailbox":
-            self.boxes.put(request["query_id"], request["from_stage"],
-                           request["to_stage"], request["partition"],
-                           request["block"])
+            if request.get("block") is not None:
+                self.boxes.put(request["query_id"], request["from_stage"],
+                               request["to_stage"], request["partition"],
+                               request["block"])
+            if request.get("eos"):
+                self.boxes.mark_eos(request["query_id"], request["from_stage"],
+                                    request["to_stage"], request["partition"],
+                                    request.get("sender", 0))
+            return True
+        if kind == "mse_cancel":
+            self.boxes.cancel(request["query_id"])
             return True
         if kind == "mse_cleanup":
             self.boxes.cleanup(request["query_id"])
@@ -188,8 +378,11 @@ class MseWorkerService:
         # halves: raw table → [(name_with_type, [segment], extra_filter_json)]
         halves = request.get("tables", {})
 
-        mailbox = RoutedMailbox(self.boxes, query_id, routing,
-                                self.server.address, self._send_rpc)
+        mailbox = RoutedMailbox(
+            self.boxes, query_id, routing, self.server.address,
+            self._send_rpc, sender=worker,
+            expected={int(k): int(v) for k, v in
+                      (request.get("child_workers") or {}).items()})
         runner = StageRunner([stage], request.get("parallelism", 1),
                              self._make_execute_query(halves),
                              self._make_read_table(halves),
@@ -199,6 +392,7 @@ class MseWorkerService:
         from .operators import pop_join_overflow
 
         pop_join_overflow()  # clear any stale flag on this handler thread
+        runner.stats["exec_start_ts"] = time.monotonic()
         pushed = runner._try_ssqe(stage) if stage.is_leaf else None
         if pushed is not None:
             runner.stats["leaf_ssqe_pushdowns"] += 1
@@ -213,6 +407,9 @@ class MseWorkerService:
                                  stage.send_dist, stage.send_keys,
                                  parent_workers, pfunc=stage.send_pfunc)
         runner.stats["join_overflow"] = pop_join_overflow()
+        runner.stats["first_send_ts"] = mailbox.first_send_ts
+        runner.stats["last_send_ts"] = mailbox.last_send_ts
+        runner.stats.update(self.boxes.metrics(query_id))
         return runner.stats
 
     def _halves_for(self, halves: dict, table: str):

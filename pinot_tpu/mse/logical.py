@@ -93,6 +93,11 @@ class AggCall:
     args: list[EC]
     out_name: str
     extra: tuple = ()
+    # AGG(x) FILTER (WHERE cond): rows failing cond contribute the identity
+    # (reference: FilteredAggregationFunction). Evaluated over the
+    # aggregate's INPUT rows — on the partial (pre-shuffle) phase when the
+    # call decomposes, so leaf pushdowns compile it into the device plan.
+    condition: Optional[EC] = None
 
 
 @dataclass
@@ -202,22 +207,6 @@ _DECOMPOSE = {"count", "sum", "min", "max", "avg", "minmaxrange"}
 # -- planner -----------------------------------------------------------------
 
 
-def _reject_filter_clause(e: EC) -> None:
-    """AGG(x) FILTER (WHERE ...) parses (shared V1 grammar) but the MSE
-    operators can't evaluate it yet — reject clearly instead of letting it
-    surface as 'column must appear in GROUP BY' or 'transform function
-    filter'."""
-    if not e.is_function:
-        return
-    if e.function.name == "filter":
-        raise PlanError(
-            "FILTER (WHERE ...) aggregations are not yet supported in "
-            "the multi-stage engine; single-table queries support them "
-            "through the single-stage engine")
-    for a in e.function.arguments:
-        _reject_filter_clause(a)
-
-
 class LogicalPlanner:
     """Builds a PlanNode tree; identifiers are rewritten to exact input
     column names during planning so the runtime never resolves names.
@@ -279,15 +268,6 @@ class LogicalPlanner:
                                   condition=self._resolve(remaining, node.schema))
         if stmt.having is not None:
             _reject_nested_subqueries(stmt.having)
-
-        # unconditional pre-walk: short-circuiting any()/or below must not
-        # let a FILTER clause slip past to a misleading downstream error
-        for it in stmt.select_items:
-            _reject_filter_clause(it.expression)
-        if stmt.having is not None:
-            _reject_filter_clause(stmt.having)
-        for ob in stmt.order_by or []:
-            _reject_filter_clause(ob.expression)
 
         has_windows = any(it.window is not None for it in stmt.select_items)
         agg_in_select = any(
@@ -529,6 +509,26 @@ class LogicalPlanner:
         group_names = [_expr_name(g, raw) for g, raw in zip(group_exprs, stmt.group_by)]
         agg_calls: list[AggCall] = []
 
+        def add_agg(e: EC, cond: Optional[EC]) -> EC:
+            args = [self._resolve(a, node.schema)
+                    for a in e.function.arguments
+                    if not (a.is_identifier and a.identifier == "*")]
+            # literal trailing args (percentile level etc.) stay as extras
+            value_args = [a for a in args if not a.is_literal]
+            extra = tuple(a.literal for a in args if a.is_literal)
+            sig = (e.function.name, tuple(map(str, value_args)),
+                   tuple(map(repr, extra)), str(cond))
+            for c in agg_calls:
+                if (c.name, tuple(map(str, c.args)), tuple(map(repr, c.extra)),
+                        str(c.condition)) == sig:
+                    return EC.for_identifier(c.out_name)
+            out = f"{e.function.name}({','.join(str(a) for a in e.function.arguments)})"
+            if cond is not None:
+                out += f" FILTER({cond})"
+            agg_calls.append(AggCall(e.function.name, value_args, out, extra,
+                                     condition=cond))
+            return EC.for_identifier(out)
+
         def extract(e: EC, raw_alias: Optional[str] = None) -> EC:
             """Replace group exprs / agg calls in a post-agg expression with
             identifiers over the Aggregate's output schema."""
@@ -536,21 +536,14 @@ class LogicalPlanner:
             for ge, gn in zip(group_exprs, group_names):
                 if resolved_candidates[0] is not None and resolved_candidates[0] == ge:
                     return EC.for_identifier(gn)
+            if e.is_function and e.function.name == "filter":
+                inner, cond_raw = e.function.arguments
+                if not (inner.is_function and is_agg_function(inner.function.name)):
+                    raise PlanError(
+                        "FILTER (WHERE ...) must be attached to an aggregate")
+                return add_agg(inner, self._resolve(cond_raw, node.schema))
             if e.is_function and is_agg_function(e.function.name):
-                args = [self._resolve(a, node.schema)
-                        for a in e.function.arguments
-                        if not (a.is_identifier and a.identifier == "*")]
-                # literal trailing args (percentile level etc.) stay as extras
-                value_args = [a for a in args if not a.is_literal]
-                extra = tuple(a.literal for a in args if a.is_literal)
-                key = (e.function.name, tuple(map(str, args)))
-                for c in agg_calls:
-                    if (c.name, tuple(map(str, c.args)) + tuple(map(repr, c.extra))) == \
-                            (key[0], tuple(map(str, value_args)) + tuple(map(repr, extra))):
-                        return EC.for_identifier(c.out_name)
-                out = f"{e.function.name}({','.join(str(a) for a in e.function.arguments)})"
-                agg_calls.append(AggCall(e.function.name, value_args, out, extra))
-                return EC.for_identifier(out)
+                return add_agg(e, None)
             if e.is_function:
                 return EC.for_function(
                     e.function.name, *[extract(a) for a in e.function.arguments])
@@ -613,31 +606,37 @@ class LogicalPlanner:
         final_calls: list[AggCall] = []
         reconstruct: list[EC] = []  # parallel to agg_calls
 
-        def add_phase(pname: str, fname: str, args: list[EC]) -> str:
+        def add_phase(pname: str, fname: str, args: list[EC],
+                      cond: Optional[EC] = None) -> str:
+            """The FILTER condition applies on the PARTIAL (pre-shuffle)
+            phase where raw input rows live; the final merge is unfiltered."""
             p = f"$p{len(partial_calls)}"
-            partial_calls.append(AggCall(pname, args, p))
+            partial_calls.append(AggCall(pname, args, p, condition=cond))
             final_calls.append(AggCall(fname, [EC.for_identifier(p)], p))
             return p
 
         for c in agg_calls:
             if c.name in ("count", "countmv"):
-                p = add_phase("count", "sum", c.args)
+                p = add_phase("count", "sum", c.args, c.condition)
                 reconstruct.append(EC.for_function(
                     "cast", EC.for_identifier(p), EC.for_literal("LONG")))
             elif c.name == "sum":
-                reconstruct.append(EC.for_identifier(add_phase("sum", "sum", c.args)))
+                reconstruct.append(EC.for_identifier(
+                    add_phase("sum", "sum", c.args, c.condition)))
             elif c.name == "min":
-                reconstruct.append(EC.for_identifier(add_phase("min", "min", c.args)))
+                reconstruct.append(EC.for_identifier(
+                    add_phase("min", "min", c.args, c.condition)))
             elif c.name == "max":
-                reconstruct.append(EC.for_identifier(add_phase("max", "max", c.args)))
+                reconstruct.append(EC.for_identifier(
+                    add_phase("max", "max", c.args, c.condition)))
             elif c.name == "avg":
-                s = add_phase("sum", "sum", c.args)
-                n = add_phase("count", "sum", c.args)
+                s = add_phase("sum", "sum", c.args, c.condition)
+                n = add_phase("count", "sum", c.args, c.condition)
                 reconstruct.append(EC.for_function(
                     "divide", EC.for_identifier(s), EC.for_identifier(n)))
             elif c.name == "minmaxrange":
-                mx = add_phase("max", "max", c.args)
-                mn = add_phase("min", "min", c.args)
+                mx = add_phase("max", "max", c.args, c.condition)
+                mn = add_phase("min", "min", c.args, c.condition)
                 reconstruct.append(EC.for_function(
                     "minus", EC.for_identifier(mx), EC.for_identifier(mn)))
             else:  # pragma: no cover — guarded by _DECOMPOSE
@@ -851,6 +850,8 @@ def prune_columns(node: PlanNode, required: Optional[set[str]] = None) -> PlanNo
             for c in n.agg_calls:
                 for a in c.args:
                     out |= a.columns()
+                if c.condition is not None:
+                    out |= c.condition.columns()
         elif isinstance(n, JoinNode):
             out |= set(n.left_keys) | set(n.right_keys)
             if n.residual is not None:

@@ -262,13 +262,27 @@ def _valid_mask(arg_vals: list[np.ndarray], n: int) -> np.ndarray:
     return mask
 
 
+def _cond_mask(call: AggCall, block: Block, n: int):
+    """FILTER (WHERE cond) mask; a NULL clause result is false (3VL)."""
+    if call.condition is None:
+        return None
+    v = np.asarray(eval_expr(call.condition, block, n))
+    if v.dtype.kind == "O":
+        return np.asarray([bool(x) and not (isinstance(x, float) and np.isnan(x))
+                           and x is not None for x in v], dtype=bool)
+    return v.astype(bool) & ~_null_mask(v)
+
+
 def _agg_full(call: AggCall, block: Block, n: int):
     """Whole-input aggregate → finalized scalar."""
     sem = get_semantics(call.name, call.extra)
+    cmask = _cond_mask(call, block, n)
     if call.name == "count" and not call.args:
-        return n
+        return n if cmask is None else int(cmask.sum())
     vals = _agg_args(call, block, n)
     mask = _valid_mask(vals, n)
+    if cmask is not None:
+        mask &= cmask
     vals = [v[mask] for v in vals]
     if not (len(vals[0]) if vals else 0) and call.name not in _ZERO_ON_EMPTY:
         return None  # SQL: aggregate over zero (non-null) rows is NULL
@@ -284,10 +298,14 @@ _ZERO_ON_EMPTY = {"count", "countmv", "distinctcount", "distinctcounthll",
 
 def _agg_grouped(call: AggCall, block: Block, codes: np.ndarray, num: int, n: int):
     name = call.name
+    cmask = _cond_mask(call, block, n)
     if name == "count" and not call.args:
-        return np.bincount(codes, minlength=num).astype(np.int64)
+        return np.bincount(codes if cmask is None else codes[cmask],
+                           minlength=num).astype(np.int64)
     vals = _agg_args(call, block, n)
     mask = _valid_mask(vals, n)
+    if cmask is not None:
+        mask &= cmask
     v = vals[0] if vals else None
     if name in _FAST_AGGS and v is not None and v.dtype.kind in "iufb":
         c = codes[mask]
@@ -537,7 +555,25 @@ def op_window(block: Block, calls: list[WindowCall], schema: list[str]) -> Block
     return out
 
 
+def _order_rank_arrays(v: np.ndarray) -> list[np.ndarray]:
+    """Sortable numeric arrays for one ORDER BY column, minor-first
+    ([value, class]), matching _sort_key's NULL<numeric<string classes."""
+    if v.dtype.kind in "iub":
+        return [v]
+    if v.dtype.kind == "f":
+        nan = np.isnan(v)
+        return [np.where(nan, 0.0, v), np.where(nan, 0, 1)]
+    keys = [_sort_key(x) for x in v]
+    uniq = {k: i for i, k in enumerate(sorted(set(keys)))}
+    return [np.asarray([uniq[k] for k in keys], dtype=np.int64)]
+
+
 def _window_call(block: Block, call: WindowCall, n: int) -> np.ndarray:
+    """One window column, fully vectorized (reference:
+    WindowAggregateOperator + window/ frames in pinot-query-runtime).
+    Global lexsort (partition major, order keys minor) + segment-boundary
+    arithmetic replaces per-group Python sorting; only exotic frames
+    (sliding MIN/MAX etc.) drop to a per-partition loop."""
     spec: WindowSpec = call.spec
     pcols = [np.asarray(eval_expr(p, block, n)) for p in spec.partition_by]
     if pcols:
@@ -545,21 +581,155 @@ def _window_call(block: Block, call: WindowCall, n: int) -> np.ndarray:
     else:
         codes, num = np.zeros(n, dtype=np.int64), 1 if n else 0
     ocols = [(np.asarray(eval_expr(e, block, n)), asc) for e, asc in spec.order_by]
-    result = np.empty(n, dtype=object)
 
-    order = np.argsort(codes, kind="stable")
-    sorted_codes = codes[order]
-    bounds = np.searchsorted(sorted_codes, np.arange(num + 1), "left")
-    for g in range(num):
-        rows = order[bounds[g]:bounds[g + 1]]
-        if len(ocols):
-            idx = list(range(len(rows)))
-            for vals, asc in reversed(ocols):
-                part = vals[rows]
-                idx.sort(key=lambda i: _sort_key(part[i]), reverse=not asc)
-            rows = rows[np.asarray(idx)]
-        result[rows] = _window_partition(block, call, rows, ocols)
+    if n == 0:
+        return np.empty(0)
+
+    # whole-partition aggregates don't need ordering at all: reuse the
+    # grouped-aggregate kernels and broadcast per-group results
+    if not spec.order_by and spec.frame is None and call.name not in (
+            "rownumber", "rank", "denserank", "cumedist", "percentrank",
+            "ntile", "lag", "lead", "firstvalue", "lastvalue"):
+        per_group = _agg_grouped(AggCall(call.name, call.args, "$w"),
+                                 block, codes, num, n)
+        return _tighten_col(np.asarray(per_group, dtype=object)[codes])
+
+    # global ordering: minor→major key list for lexsort (codes are primary)
+    lex: list[np.ndarray] = []
+    rank_arrays: list[list[np.ndarray]] = []  # per order col, asc direction
+    for v, asc in ocols:
+        rank_arrays.append(_order_rank_arrays(v))
+    for (v, asc), ranks in zip(reversed(ocols), reversed(rank_arrays)):
+        lex.extend(r if asc else -r.astype(np.float64) for r in ranks)
+    lex.append(codes)
+    order = np.lexsort(lex)
+
+    scodes = codes[order]
+    idx = np.arange(n, dtype=np.int64)
+    pstart = np.empty(n, dtype=bool)
+    pstart[0] = True
+    pstart[1:] = scodes[1:] != scodes[:-1]
+    pstart_idx = np.maximum.accumulate(np.where(pstart, idx, 0))
+    is_last = np.empty(n, dtype=bool)
+    is_last[:-1] = pstart[1:]
+    is_last[-1] = True
+    pend_idx = np.minimum.accumulate(
+        np.where(is_last, idx, n - 1)[::-1])[::-1]
+    pos = idx - pstart_idx
+    k_arr = pend_idx - pstart_idx + 1
+
+    newkey = pstart.copy()
+    for ranks in rank_arrays:
+        for r in ranks:
+            rs = r[order]
+            newkey[1:] |= rs[1:] != rs[:-1]
+
+    out_sorted = np.asarray(_window_sorted(
+        block, call, ocols, order, n, pstart, pstart_idx, pend_idx, pos,
+        k_arr, newkey, idx))
+    result = np.empty(n, dtype=out_sorted.dtype)
+    result[order] = out_sorted
     return _tighten_col(result)
+
+
+def _window_sorted(block, call, ocols, order, n, pstart, pstart_idx,
+                   pend_idx, pos, k_arr, newkey, idx) -> np.ndarray:
+    """Window values in sorted (partition, order-key) order."""
+    name = call.name
+    if name == "rownumber":
+        return pos + 1
+    if name in ("rank", "denserank", "percentrank"):
+        lastkey_idx = np.maximum.accumulate(np.where(newkey, idx, 0))
+        rank = lastkey_idx - pstart_idx + 1
+        if name == "rank":
+            return rank
+        if name == "percentrank":
+            return np.where(k_arr > 1, (rank - 1) / np.maximum(k_arr - 1, 1), 0.0)
+        dense = np.cumsum(newkey)
+        return dense - dense[pstart_idx] + 1
+    if name == "cumedist":
+        grp = np.cumsum(newkey) - 1  # global peer-group id, nondecreasing
+        grp_end = np.searchsorted(grp, np.arange(grp[-1] + 2), "left")[1:] - 1
+        return (grp_end[grp] - pstart_idx + 1) / k_arr
+    if name == "ntile":
+        buckets = int(call.args[0].literal) if call.args else 1
+        return (pos * buckets // k_arr) + 1
+    if name in ("lag", "lead"):
+        v = np.asarray(eval_expr(call.args[0], block, n))[order]
+        off = int(call.args[1].literal) if len(call.args) > 1 else 1
+        default = call.args[2].literal if len(call.args) > 2 else None
+        tgt = idx - off if name == "lag" else idx + off
+        valid = (tgt >= pstart_idx) & (tgt <= pend_idx)
+        out = np.empty(n, dtype=object)
+        out[:] = v[np.clip(tgt, 0, n - 1)]
+        out[~valid] = default
+        return out
+    if name in ("firstvalue", "lastvalue"):
+        v = np.asarray(eval_expr(call.args[0], block, n))[order]
+        return v[pstart_idx if name == "firstvalue" else pend_idx]
+
+    # aggregates over the window frame
+    frame = call.spec.frame
+    if not call.spec.order_by and frame is None:
+        per_group = _agg_grouped(AggCall(name, call.args, "$w"), block,
+                                 np.cumsum(pstart) - 1, int(pstart.sum()), n)
+        # codes in sorted space = partition ordinal
+        return np.asarray(per_group, dtype=object)[np.cumsum(pstart) - 1]
+    if frame is None:
+        frame = ("RANGE", None, 0)
+    kind, start, end = frame
+
+    vals = [np.asarray(eval_expr(a, block, n))[order] for a in call.args]
+    numeric = all(v.dtype.kind in "iufb" for v in vals)
+    # vectorized running frames: UNBOUNDED PRECEDING → CURRENT ROW (+peers
+    # for RANGE) for COUNT/SUM/AVG — prefix sums reproduce the sequential
+    # left-to-right addition order of a from-scratch per-frame sum
+    if start is None and end == 0 and name in ("count", "sum", "avg") \
+            and (numeric or not vals):
+        if kind == "RANGE" and call.spec.order_by:
+            grp = np.cumsum(newkey) - 1
+            grp_end = np.searchsorted(grp, np.arange(grp[-1] + 2), "left")[1:] - 1
+            hi = grp_end[grp]  # frame end includes peers
+        else:
+            hi = idx
+        if vals:
+            nulls = _null_mask(vals[0])
+            w = np.where(nulls, 0, vals[0])
+            cnt_prefix = np.cumsum(~nulls)
+        else:
+            w = np.ones(n, dtype=np.int64)
+            cnt_prefix = idx + 1
+        counts = cnt_prefix[hi] - np.where(
+            pstart_idx > 0, cnt_prefix[pstart_idx - 1], 0)
+        if name == "count":
+            return counts
+        prefix = np.cumsum(w.astype(np.float64) if w.dtype.kind == "f"
+                           else w.astype(np.int64))
+        sums = prefix[hi] - np.where(pstart_idx > 0, prefix[pstart_idx - 1], 0)
+        if name == "sum":
+            return np.where(counts > 0, sums, np.nan) if vals else sums
+        with np.errstate(invalid="ignore"):
+            return np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
+
+    # fallback: per-partition loop for exotic frames (sliding MIN/MAX, ...)
+    sem = get_semantics(name)
+    keys = None
+    if kind == "RANGE" and call.spec.order_by:
+        grp = np.cumsum(newkey) - 1
+        grp_end = np.searchsorted(grp, np.arange(grp[-1] + 2), "left")[1:] - 1
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        p0, p1 = pstart_idx[i], pend_idx[i]
+        lo = p0 if start is None else max(p0, i + start)
+        hi = p1 + 1 if end is None else min(p1 + 1, i + end + 1)
+        if kind == "RANGE" and call.spec.order_by:
+            hi = max(hi, grp_end[grp[i]] + 1)
+        if name == "count" and not vals:
+            out[i] = hi - lo
+        else:
+            seg = [v[lo:hi] for v in vals]
+            out[i] = sem.finalize(host_state_full(name, seg, ()))
+    return out
 
 
 def _window_partition(block: Block, call: WindowCall, rows: np.ndarray, ocols):

@@ -110,7 +110,9 @@ def node_to_json(node: PlanNode) -> dict:
     elif isinstance(node, AggregateNode):
         d.update(group_exprs=[expr_to_json(e) for e in node.group_exprs],
                  agg_calls=[{"n": c.name, "a": [expr_to_json(a) for a in c.args],
-                             "o": c.out_name, "x": list(c.extra)}
+                             "o": c.out_name, "x": list(c.extra),
+                             "f": expr_to_json(c.condition)
+                             if c.condition is not None else None}
                             for c in node.agg_calls])
     elif isinstance(node, JoinNode):
         d.update(join_type=node.join_type, left_keys=list(node.left_keys),
@@ -154,7 +156,10 @@ def node_from_json(d: dict) -> PlanNode:
             inputs, schema,
             group_exprs=[expr_from_json(e) for e in d["group_exprs"]],
             agg_calls=[AggCall(c["n"], [expr_from_json(a) for a in c["a"]],
-                               c["o"], tuple(c["x"])) for c in d["agg_calls"]])
+                               c["o"], tuple(c["x"]),
+                               condition=expr_from_json(c["f"])
+                               if c.get("f") is not None else None)
+                       for c in d["agg_calls"]])
     if kind == "JoinNode":
         return JoinNode(inputs, schema, join_type=d["join_type"],
                         left_keys=list(d["left_keys"]),

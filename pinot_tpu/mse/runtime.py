@@ -210,7 +210,12 @@ class StageRunner:
                     return None
                 args = [_unqualify(a, unq) for a in call.args] or \
                     [EC.for_identifier("*")]
-                select.append(EC.for_function(call.name, *args))
+                e = EC.for_function(call.name, *args)
+                if call.condition is not None:
+                    # AGG(x) FILTER (WHERE cond) — the V1 grammar's form
+                    e = EC.for_function(
+                        "filter", e, _unqualify(call.condition, unq))
+                select.append(e)
             qc = QueryContext(
                 table_name=scan.table, select_expressions=select,
                 aliases=[None] * len(select),

@@ -197,12 +197,73 @@ class PackedOuts:
         self.metas = metas  # [(np.dtype, shape), ...]
 
 
+# float64 cannot bitcast-convert on the axon AOT compile path (its
+# X64-element-type rewrite pass lacks f64 bitcast support; int64 works).
+# Encode f64 outputs with pure arithmetic instead: scale by a power-of-two
+# bucket into f32-safe exponent range, split into a non-overlapping f32
+# triplet (a = f32(y), b = f32(y-a), c = f32(y-a-b) — exact: 3x24 bits
+# cover the 53-bit mantissa with every residual in f32 normal range), and
+# carry bucket + nan/inf/sign flags in a fourth u32 word. Bit-exact for
+# every f64 including subnormals, +-0, +-inf, nan (verified on hardware).
+_F64_HALF_SCALES = tuple(2.0 ** (-90 * k) for k in range(-6, 7))
+
+
+def _encode_f64(x):
+    finite = jnp.isfinite(x)
+    xs = jnp.where(finite, x, 0.0)
+    ax = jnp.abs(xs)
+    # bucket k: exponent(x) in [180k-60, 180k+120) — thresholds 2^(180k-60)
+    # for k=-5..6 (the k=-6 threshold underflows f64 and is implicit)
+    k = sum(((ax >= (2.0 ** (180 * kk - 60))).astype(jnp.int32))
+            for kk in range(-5, 7)) - 6
+    half = jnp.asarray(_F64_HALF_SCALES, dtype=jnp.float64)[k + 6]
+    y = xs * half * half  # two exact multiplies (2^(180*6) overflows alone)
+    a = y.astype(jnp.float32)
+    r1 = y - a.astype(jnp.float64)
+    b = r1.astype(jnp.float32)
+    c = (r1 - b.astype(jnp.float64)).astype(jnp.float32)
+    # signbit without bitcast (jnp.signbit bitcasts f64 internally, which
+    # this compile path rejects): 1/-0.0 = -inf distinguishes the zero sign
+    neg = (x < 0) | ((x == 0) & (jnp.float64(1.0) / x < 0))
+    meta = ((k + 6).astype(jnp.uint32)
+            | (jnp.isnan(x).astype(jnp.uint32) << 8)
+            | ((~finite & ~jnp.isnan(x)).astype(jnp.uint32) << 9)
+            | (neg.astype(jnp.uint32) << 10))
+    words = jnp.stack(
+        [jax.lax.bitcast_convert_type(a, jnp.uint32),
+         jax.lax.bitcast_convert_type(b, jnp.uint32),
+         jax.lax.bitcast_convert_type(c, jnp.uint32), meta], axis=-1)
+    return words
+
+
+def _decode_f64(raw: np.ndarray, shape) -> np.ndarray:
+    w = raw.view(np.uint32).reshape(-1, 4)
+    a = np.ascontiguousarray(w[:, 0]).view(np.float32).astype(np.float64)
+    b = np.ascontiguousarray(w[:, 1]).view(np.float32).astype(np.float64)
+    c = np.ascontiguousarray(w[:, 2]).view(np.float32).astype(np.float64)
+    k = (w[:, 3] & 0xFF).astype(np.int32) - 6
+    neg = (w[:, 3] >> 10) & 1
+    x = np.ldexp(a + b + c, 180 * k)
+    zneg = (x == 0) & (neg == 1)  # -0.0 + 0.0 = +0.0 loses the zero sign
+    if zneg.any():
+        x = np.where(zneg, -0.0, x)
+    isinf = (w[:, 3] >> 9) & 1
+    if isinf.any():
+        x = np.where(isinf == 1, np.where(neg == 1, -np.inf, np.inf), x)
+    isnan = (w[:, 3] >> 8) & 1
+    if isnan.any():
+        x = np.where(isnan == 1, np.nan, x)
+    return x.reshape(shape)
+
+
 @jax.jit
 def _pack_u8(outs: tuple):
     chunks = []
     for o in outs:
         if o.dtype == jnp.bool_:
             o = o.astype(jnp.uint8)
+        elif o.dtype == jnp.float64:
+            o = _encode_f64(o)
         chunks.append(jax.lax.bitcast_convert_type(o, jnp.uint8).reshape(-1))
     return jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
 
@@ -220,8 +281,12 @@ def unpack_outputs(p: PackedOuts) -> list:
 def _split_flat(flat: np.ndarray, metas) -> list:
     out, off = [], 0
     for dt, shape in metas:
-        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
-        out.append(flat[off:off + nbytes].view(dt).reshape(shape))
+        if dt == np.float64:  # wire format: 4 u32 words per value
+            nbytes = int(np.prod(shape, dtype=np.int64)) * 16
+            out.append(_decode_f64(flat[off:off + nbytes], shape))
+        else:
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            out.append(flat[off:off + nbytes].view(dt).reshape(shape))
         off += nbytes
     return out
 
