@@ -160,12 +160,21 @@ class ServerInstance:
     @property
     def mse_worker(self):
         """Multi-stage worker endpoint (mse/distributed.py) — lazily built
-        so the MSE runtime only loads when a stage is dispatched here."""
-        if not hasattr(self, "_mse_worker"):
-            from ..mse.distributed import MseWorkerService
+        so the MSE runtime only loads when a stage is dispatched here.
+        Double-checked under the instance lock: stage dispatch and mailbox
+        deliveries arrive CONCURRENTLY (pipelined dispatcher), and an
+        unlocked first touch can build two services — the losing request's
+        blocks land in an orphaned MailboxStore and the query hangs."""
+        worker = getattr(self, "_mse_worker", None)
+        if worker is None:
+            with self._lock:
+                worker = getattr(self, "_mse_worker", None)
+                if worker is None:
+                    from ..mse.distributed import MseWorkerService
 
-            self._mse_worker = MseWorkerService(self)
-        return self._mse_worker
+                    worker = MseWorkerService(self)
+                    self._mse_worker = worker
+        return worker
 
     def _handle_query(self, request):
         """Execute a QueryContext over an explicit segment list (the broker

@@ -233,9 +233,16 @@ class RpcClient:
             s = self._ssl.wrap_socket(s, server_hostname=self.host)
         return s
 
-    def call(self, request):
+    def call(self, request, retry: bool = True):
+        """``retry`` re-sends once on a connection failure (the pooled
+        connection may have gone stale between calls). Callers whose
+        requests are NOT idempotent — e.g. an mse_stage dispatch, where a
+        re-run would consume mailboxes twice — pass retry=False; mailbox
+        block deliveries stay retryable because the receiver dedups on
+        (sender, seq)."""
+        attempts = (0, 1) if retry else (1,)
         with self._lock:
-            for attempt in (0, 1):
+            for attempt in attempts:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()

@@ -99,6 +99,15 @@ class MailboxStore:
         self._cancelled: set = set()
         self._total_bytes: dict[str, int] = defaultdict(int)
         self._peak_bytes: dict[str, int] = defaultdict(int)
+        # (key, sender) → highest seq accepted: transport-level retries
+        # re-deliver a chunk whose response was lost; duplicates must be
+        # dropped, not double-counted (reference: gRPC stream sequencing).
+        # _inflight_seq guards the window where the ORIGINAL delivery is
+        # still blocked in the backpressure wait — a retry arriving then
+        # must neither enqueue a second copy nor mark the seq accepted
+        # before the append actually happened.
+        self._last_seq: dict[tuple, int] = {}
+        self._inflight_seq: set = set()
         self._cond = threading.Condition()
 
     def _check(self, query_id: str) -> None:
@@ -106,18 +115,34 @@ class MailboxStore:
             raise MailboxCancelled(query_id)
 
     def put(self, query_id: str, from_stage: int, to_stage: int,
-            partition: int, block: Block) -> None:
+            partition: int, block: Block, sender: int = 0,
+            seq: Optional[int] = None) -> None:
         key = (query_id, from_stage, to_stage, partition)
         nbytes = _block_nbytes(block)
         with self._cond:
+            skey = None
+            if seq is not None:
+                skey = (key, sender, seq)
+                if seq <= self._last_seq.get((key, sender), -1) \
+                        or skey in self._inflight_seq:
+                    return  # duplicate delivery (retried RPC)
+                self._inflight_seq.add(skey)
             deadline = time.monotonic() + MAILBOX_WAIT_S
-            while (key in self._streaming
-                   and self._buffered[key] + nbytes > MAILBOX_BUFFER_BYTES
-                   and self._buffered[key] > 0):
+            try:
+                while (key in self._streaming
+                       and self._buffered[key] + nbytes > MAILBOX_BUFFER_BYTES
+                       and self._buffered[key] > 0):
+                    self._check(query_id)
+                    if not self._cond.wait(1.0) and time.monotonic() > deadline:
+                        raise TimeoutError(f"mailbox {key} backpressure stall")
                 self._check(query_id)
-                if not self._cond.wait(1.0) and time.monotonic() > deadline:
-                    raise TimeoutError(f"mailbox {key} backpressure stall")
-            self._check(query_id)
+            finally:
+                if skey is not None:
+                    self._inflight_seq.discard(skey)
+            if seq is not None:
+                # accepted only now — a put that failed in the wait leaves
+                # the seq unrecorded so a later retry can land it
+                self._last_seq[(key, sender)] = seq
             self._chunks[key].append(block)
             self._buffered[key] += nbytes
             total = sum(v for k, v in self._buffered.items()
@@ -126,6 +151,19 @@ class MailboxStore:
             self._peak_bytes[query_id] = max(
                 self._peak_bytes[query_id], total)
             self._cond.notify_all()
+
+    def deliver(self, request: dict) -> None:
+        """Apply one mse_mailbox request (chunk and/or EOS) — the single
+        decode point shared by worker and broker endpoints."""
+        if request.get("block") is not None:
+            self.put(request["query_id"], request["from_stage"],
+                     request["to_stage"], request["partition"],
+                     request["block"], sender=request.get("sender", 0),
+                     seq=request.get("seq"))
+        if request.get("eos"):
+            self.mark_eos(request["query_id"], request["from_stage"],
+                          request["to_stage"], request["partition"],
+                          request.get("sender", 0))
 
     def mark_eos(self, query_id: str, from_stage: int, to_stage: int,
                  partition: int, sender: int) -> None:
@@ -196,6 +234,10 @@ class MailboxStore:
             for d in (self._chunks, self._eos, self._buffered):
                 for key in [k for k in d if k[0] == query_id]:
                     del d[key]
+            for skey in [k for k in self._last_seq if k[0][0] == query_id]:
+                del self._last_seq[skey]
+            self._inflight_seq = {k for k in self._inflight_seq
+                                  if k[0][0] != query_id}
             self._total_bytes.pop(query_id, None)
             self._peak_bytes.pop(query_id, None)
             self._cancelled.discard(query_id)
@@ -221,20 +263,32 @@ class RoutedMailbox:
         self.send_rpc = send_rpc  # (addr, request_dict) → None
         self.sender = sender
         self.expected = expected or {}
+        self._seq: dict[tuple[int, int], int] = defaultdict(int)
         self.first_send_ts: Optional[float] = None
         self.last_send_ts: Optional[float] = None
+
+    def _expected_senders(self, from_stage: int) -> int:
+        # an absent declared-sender count must be loud: defaulting to 0 would
+        # make wait_all return immediately with whatever raced in (silently
+        # empty/partial results). A genuinely zero-worker child (empty table)
+        # is declared explicitly as 0 by the dispatcher.
+        if from_stage not in self.expected:
+            raise UnsupportedQueryError(
+                f"no declared sender count for child stage {from_stage} "
+                f"(dispatcher omitted child_workers)")
+        return self.expected[from_stage]
 
     def receive(self, from_stage: int, to_stage: int, partition: int,
                 schema=None) -> Block:
         chunks = self.boxes.wait_all(
             self.query_id, from_stage, to_stage, partition,
-            self.expected.get(from_stage, 0))
+            self._expected_senders(from_stage))
         return concat_blocks(chunks, schema)
 
     def stream(self, from_stage: int, to_stage: int, partition: int,
                schema=None):
         return self.boxes.stream(self.query_id, from_stage, to_stage,
-                                 partition, self.expected.get(from_stage, 0))
+                                 partition, self._expected_senders(from_stage))
 
     def send(self, from_stage: int, to_stage: int, partition: int,
              block: Block, eos: bool = False) -> None:
@@ -245,10 +299,12 @@ class RoutedMailbox:
         now = time.monotonic()
         self.first_send_ts = self.first_send_ts or now
         self.last_send_ts = now
+        seq = self._seq[(to_stage, partition)]
+        self._seq[(to_stage, partition)] += 1
         if tuple(addr) == tuple(self.self_addr):
             if block is not None:
                 self.boxes.put(self.query_id, from_stage, to_stage,
-                               partition, block)
+                               partition, block, sender=self.sender, seq=seq)
             if eos:
                 self.boxes.mark_eos(self.query_id, from_stage, to_stage,
                                     partition, self.sender)
@@ -256,7 +312,7 @@ class RoutedMailbox:
         req = {"type": "mse_mailbox", "query_id": self.query_id,
                "from_stage": from_stage, "to_stage": to_stage,
                "partition": partition, "block": block,
-               "sender": self.sender}
+               "sender": self.sender, "seq": seq}
         if eos:
             req["eos"] = True
         self.send_rpc(tuple(addr), req)
@@ -348,14 +404,7 @@ class MseWorkerService:
     def handle(self, request: dict):
         kind = request["type"]
         if kind == "mse_mailbox":
-            if request.get("block") is not None:
-                self.boxes.put(request["query_id"], request["from_stage"],
-                               request["to_stage"], request["partition"],
-                               request["block"])
-            if request.get("eos"):
-                self.boxes.mark_eos(request["query_id"], request["from_stage"],
-                                    request["to_stage"], request["partition"],
-                                    request.get("sender", 0))
+            self.boxes.deliver(request)
             return True
         if kind == "mse_cancel":
             self.boxes.cancel(request["query_id"])
@@ -526,9 +575,7 @@ class DistributedMseDispatcher:
 
     def _handle(self, request: dict):
         if request.get("type") == "mse_mailbox":
-            self.boxes.put(request["query_id"], request["from_stage"],
-                           request["to_stage"], request["partition"],
-                           request["block"])
+            self.boxes.deliver(request)
             return True
         raise ValueError("broker mailbox accepts only mse_mailbox")
 
@@ -764,12 +811,44 @@ class DistributedMseDispatcher:
                                    "tables": {}})
                 workers[stage.stage_id] = chosen
 
-        # dispatch bottom-up; a stage's workers run in parallel, stages run
-        # strictly after their children so mailboxes are always populated
+        # PIPELINED dispatch: every stage's workers are submitted
+        # concurrently, children strictly BEFORE parents (the pool queue is
+        # FIFO, so child workers always get slots first and a parent can
+        # never starve the children it waits on). A parent stage starts
+        # executing immediately and blocks inside its mailbox receive/stream
+        # while child chunks arrive — stages overlap in wall time, like the
+        # reference's streaming gRPC OpChains. Each mse_stage call rides a
+        # DEDICATED connection: the shared per-instance client serializes
+        # calls under a lock, and a long-blocking parent stage on it would
+        # deadlock the dispatch of its own children to the same instance.
+        from ..cluster.transport import RpcClient
+
         stats_agg = {"num_docs_scanned": 0, "total_docs": 0,
                      "leaf_ssqe_pushdowns": 0, "stages": len(stages),
                      "join_overflow": False, "num_groups_limit_reached": False}
         touched: set[str] = set()
+
+        def submit(stage, w_idx, w, parent_addrs, routing, sj, child_workers):
+            touched.add(w["instance"])
+            # a stage worker legitimately blocks in its receive while
+            # upstream stages still run — the dispatch call must outlive
+            # the worker's own mailbox-wait ceiling, and must NOT retry
+            # (a re-sent mse_stage would re-run the stage against
+            # already-consumed mailboxes)
+            client = RpcClient(*w["addr"], timeout=MAILBOX_WAIT_S + 30)
+            try:
+                return client.call({
+                    "type": "mse_stage", "query_id": query_id,
+                    "stage": sj, "worker": w_idx,
+                    "parent_workers": len(parent_addrs),
+                    "routing": routing, "tables": w["tables"],
+                    "child_workers": child_workers,
+                    "parallelism": self.parallelism,
+                    "options": dict(query.options)}, retry=False)
+            finally:
+                client.close()
+
+        futures = []
         try:
             for stage in sorted(stages, key=lambda s: -s.stage_id):
                 if stage.stage_id == 0:
@@ -781,31 +860,26 @@ class DistributedMseDispatcher:
                     parent_addrs = [w["addr"] for w in workers[parent_id]]
                 routing = {str(p): list(a) for p, a in enumerate(parent_addrs)}
                 sj = stage_to_json(stage)
+                child_workers = {str(cid): len(workers.get(cid, []))
+                                 for cid in stage.child_stages}
+                for w_idx, w in enumerate(workers[stage.stage_id]):
+                    futures.append(self._pool.submit(
+                        submit, stage, w_idx, w, parent_addrs, routing, sj,
+                        child_workers))
 
-                def submit(item):
-                    w_idx, w = item
-                    touched.add(w["instance"])
-                    client = self.broker._client(w["instance"])
-                    return client.call({
-                        "type": "mse_stage", "query_id": query_id,
-                        "stage": sj, "worker": w_idx,
-                        "parent_workers": len(parent_addrs),
-                        "routing": routing, "tables": w["tables"],
-                        "parallelism": self.parallelism,
-                        "options": dict(query.options)})
-
-                for st in self._pool.map(submit, enumerate(workers[stage.stage_id])):
-                    for k in ("num_docs_scanned", "total_docs",
-                              "leaf_ssqe_pushdowns"):
-                        stats_agg[k] += st.get(k, 0)
-                    stats_agg["join_overflow"] |= bool(
-                        st.get("join_overflow"))
-                    stats_agg["num_groups_limit_reached"] |= bool(
-                        st.get("num_groups_limit_reached"))
+            for f in futures:
+                st = f.result()
+                for k in ("num_docs_scanned", "total_docs",
+                          "leaf_ssqe_pushdowns"):
+                    stats_agg[k] += st.get(k, 0)
+                stats_agg["join_overflow"] |= bool(st.get("join_overflow"))
+                stats_agg["num_groups_limit_reached"] |= bool(
+                    st.get("num_groups_limit_reached"))
 
             final_sid = stages[0].child_stages[0]
             block = concat_blocks(
-                self.boxes.get_all(query_id, final_sid, 0, 0),
+                self.boxes.wait_all(query_id, final_sid, 0, 0,
+                                    len(workers.get(final_sid, []))),
                 stages[0].root.schema)
             result = _block_to_result(block, stages[0].root.schema)
             return BrokerResponse(
@@ -814,6 +888,27 @@ class DistributedMseDispatcher:
                 total_docs=stats_agg["total_docs"],
                 partial_result=stats_agg["join_overflow"],
                 num_groups_limit_reached=stats_agg["num_groups_limit_reached"])
+        except Exception:
+            # a failed worker must not hang its peers in receive/backpressure:
+            # stop still-queued dispatches (they'd land on instances the
+            # cancel broadcast below doesn't know about yet), cancel the
+            # query's mailboxes everywhere, then re-raise
+            for f in futures:
+                f.cancel()
+            self.boxes.cancel(query_id)
+            for inst in touched:
+                try:
+                    self.broker._client(inst).call(
+                        {"type": "mse_cancel", "query_id": query_id})
+                except Exception:
+                    pass
+            for f in futures:
+                try:
+                    f.result()
+                # CancelledError is a BaseException since 3.8
+                except BaseException:
+                    pass
+            raise
         finally:
             self.boxes.cleanup(query_id)
             for inst in touched:

@@ -109,6 +109,11 @@ class MailboxService:
         return concat_blocks(self._boxes.get((from_stage, to_stage, partition), []),
                              schema)
 
+    def stream(self, from_stage: int, to_stage: int, partition: int):
+        """Chunk-at-a-time receive (same contract as the distributed
+        RoutedMailbox.stream); in-process all chunks already exist."""
+        yield from self._boxes.get((from_stage, to_stage, partition), [])
+
     def send_partitioned(self, from_stage: int, to_stage: int, block: Block,
                          dist: str, keys: list[str], num_partitions: int,
                          pfunc: Optional[str] = None) -> None:

@@ -555,6 +555,16 @@ def op_window(block: Block, calls: list[WindowCall], schema: list[str]) -> Block
     return out
 
 
+def _desc_rank(r: np.ndarray) -> np.ndarray:
+    """Descending sort key for one rank array. Floats negate exactly;
+    integer keys dense-rank first (unique inverse) and negate in int64 —
+    a float64 cast would collapse int64 keys above 2^53, and native int64
+    negation overflows on INT64_MIN."""
+    if r.dtype.kind == "f":
+        return -r
+    return -np.unique(r, return_inverse=True)[1].astype(np.int64)
+
+
 def _order_rank_arrays(v: np.ndarray) -> list[np.ndarray]:
     """Sortable numeric arrays for one ORDER BY column, minor-first
     ([value, class]), matching _sort_key's NULL<numeric<string classes."""
@@ -600,7 +610,7 @@ def _window_call(block: Block, call: WindowCall, n: int) -> np.ndarray:
     for v, asc in ocols:
         rank_arrays.append(_order_rank_arrays(v))
     for (v, asc), ranks in zip(reversed(ocols), reversed(rank_arrays)):
-        lex.extend(r if asc else -r.astype(np.float64) for r in ranks)
+        lex.extend(r if asc else _desc_rank(r) for r in ranks)
     lex.append(codes)
     order = np.lexsort(lex)
 

@@ -34,7 +34,7 @@ from .logical import (
     TableScanNode,
     WindowNode,
 )
-from .mailbox import Block, MailboxService, block_len
+from .mailbox import Block, MailboxService, block_len, concat_blocks
 from .operators import (
     op_aggregate,
     op_filter,
@@ -143,6 +143,8 @@ class StageRunner:
             return op_project(self._exec(node.inputs[0], stage, worker),
                               node.schema, node.exprs)
         if isinstance(node, AggregateNode):
+            if self._can_stream_aggregate(node):
+                return self._streaming_aggregate(node, stage, worker)
             return op_aggregate(self._exec(node.inputs[0], stage, worker),
                                 node.group_exprs, node.agg_calls, node.schema)
         if isinstance(node, JoinNode):
@@ -161,6 +163,46 @@ class StageRunner:
             right = self._exec(node.inputs[1], stage, worker)
             return op_setop(node.kind, node.all, left, right, node.schema)
         raise UnsupportedQueryError(f"MSE cannot execute node {type(node).__name__}")
+
+    # rows buffered before an incremental collapse in a streaming aggregate
+    STREAM_COLLAPSE_ROWS = 262_144
+
+    def _can_stream_aggregate(self, node: AggregateNode) -> bool:
+        """True for the FINAL-merge shape of a two-phase aggregation: input
+        is a mailbox receive, every call is a re-mergeable merge fn
+        (sum/min/max — applying the aggregate to its own output is a no-op
+        on semantics), and the output schema equals the input schema so the
+        collapsed partial feeds back in. This is the streaming consumer of
+        the pipelined shuffle: chunks partial-merge as they arrive instead
+        of materializing the whole mailbox (reference: AggregateOperator
+        consuming TransferableBlocks incrementally)."""
+        child = node.inputs[0]
+        return (isinstance(child, MailboxReceiveNode)
+                and bool(node.agg_calls)
+                and all(c.name in ("sum", "min", "max") and c.condition is None
+                        and not c.extra for c in node.agg_calls)
+                and all(g.is_identifier for g in node.group_exprs)
+                and list(node.schema) == list(child.schema))
+
+    def _streaming_aggregate(self, node: AggregateNode, stage: Stage,
+                             worker: int) -> Block:
+        recv: MailboxReceiveNode = node.inputs[0]
+        buf: list[Block] = []
+        buf_rows = 0
+
+        def collapse() -> Block:
+            return op_aggregate(
+                concat_blocks(buf, list(recv.schema)),
+                node.group_exprs, node.agg_calls, node.schema)
+
+        for chunk in self.mailbox.stream(recv.from_stage, stage.stage_id,
+                                         worker):
+            buf.append(chunk)
+            buf_rows += block_len(chunk)
+            if buf_rows >= self.STREAM_COLLAPSE_ROWS:
+                buf = [collapse()]
+                buf_rows = block_len(buf[0])
+        return collapse()
 
     def _scan(self, node: TableScanNode) -> Block:
         cols = self.read_table(node.table, node.source_columns)

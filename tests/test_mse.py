@@ -527,3 +527,69 @@ def test_window_1m_rows_vectorized():
     assert lagged[0] is None
     assert np.allclose(lagged[1:].astype(np.float64),
                        block["v"][order][:-1], rtol=0, atol=0)
+
+
+def test_window_desc_order_large_int64_keys():
+    """Descending ORDER BY on int64 keys above 2**53 must not collapse
+    (regression: -r.astype(float64) lost low bits, returning ascending
+    row numbers for adjacent huge keys)."""
+    from pinot_tpu.mse.ast import WindowSpec
+    from pinot_tpu.mse.logical import WindowCall
+    from pinot_tpu.mse.operators import op_window
+    from pinot_tpu.query.expressions import ExpressionContext as EC
+
+    base = np.int64(1) << np.int64(60)
+    block = {"o": np.array([base, base + 1, base + 2], dtype=np.int64)}
+    spec = WindowSpec(partition_by=[],
+                      order_by=[(EC.for_identifier("o"), False)], frame=None)
+    out = op_window(block, [WindowCall("rownumber", [], spec, "$w0")],
+                    ["o", "$w0"])
+    assert list(out["$w0"]) == [3, 2, 1]
+    # INT64_MIN must sort last on DESC, not overflow into first
+    lo = np.iinfo(np.int64).min
+    block2 = {"o": np.array([lo, 0, 5], dtype=np.int64)}
+    out2 = op_window(block2, [WindowCall("rownumber", [], spec, "$w0")],
+                     ["o", "$w0"])
+    assert list(out2["$w0"]) == [3, 2, 1]
+
+
+def test_streaming_aggregate_matches_materialized(tmp_path):
+    """The final-merge phase consumes its mailbox chunk-at-a-time with
+    incremental collapse; results must equal the materialized path."""
+    from pinot_tpu.mse.logical import AggCall, AggregateNode
+    from pinot_tpu.mse.fragmenter import MailboxReceiveNode
+    from pinot_tpu.mse.runtime import StageRunner
+    from pinot_tpu.query.expressions import ExpressionContext as EC
+
+    recv = MailboxReceiveNode([], ["g", "$p0", "$p1"], from_stage=2,
+                              dist="hash", keys=["g"])
+    node = AggregateNode(
+        [recv], ["g", "$p0", "$p1"],
+        group_exprs=[EC.for_identifier("g")],
+        agg_calls=[AggCall("sum", [EC.for_identifier("$p0")], "$p0"),
+                   AggCall("max", [EC.for_identifier("$p1")], "$p1")])
+    runner = StageRunner([], 1, None, None)
+    assert runner._can_stream_aggregate(node)
+    runner.STREAM_COLLAPSE_ROWS = 4  # force several incremental collapses
+
+    rng = np.random.default_rng(3)
+    chunks = []
+    for _ in range(10):
+        m = int(rng.integers(1, 6))
+        chunks.append({"g": rng.integers(0, 4, m).astype(np.int64),
+                       "$p0": rng.integers(0, 100, m).astype(np.int64),
+                       "$p1": rng.integers(0, 100, m).astype(np.int64)})
+    for c in chunks:
+        runner.mailbox.send(2, 1, 0, c)
+
+    class FakeStage:
+        stage_id = 1
+
+    out = runner._streaming_aggregate(node, FakeStage(), 0)
+    merged = {}
+    for c in chunks:
+        for g, p0, p1 in zip(c["g"], c["$p0"], c["$p1"]):
+            s, mx = merged.get(g, (0, None))
+            merged[g] = (s + p0, p1 if mx is None else max(mx, p1))
+    got = {g: (s, mx) for g, s, mx in zip(out["g"], out["$p0"], out["$p1"])}
+    assert got == merged
