@@ -276,7 +276,10 @@ def decode(blob: bytes):
         raise DataTableError("not a PTDT DataTable")
     r = _Reader(blob, 4)
     (version,) = r.unpack("<H")
-    if version != VERSION:
+    if not 1 <= version <= VERSION:
+        # a NEWER writer (rolling upgrade, new server → old broker) fails
+        # loudly; OLDER versions decode below (old server → new broker —
+        # the compatibility-verifier guarantee, compCheck.sh analogue)
         raise DataTableError(f"unsupported DataTable version {version}")
     kind = r.u8()
     (mlen,) = r.unpack("<I")
@@ -288,7 +291,8 @@ def decode(blob: bytes):
         vec_specs = _r_value(r)
         fin_tags = [_to_tag(t) for t in _r_value(r)]
         nds = _r_value(r)
-        trimmed = _r_value(r)
+        # v1 predates the groups_trimmed flag: absent → not trimmed
+        trimmed = _r_value(r) if version >= 2 else False
         return GroupArrays(key_cols, [tuple(c) for c in state_cols],
                            [tuple(s) for s in vec_specs], fin_tags,
                            num_docs_scanned=nds,
@@ -296,7 +300,7 @@ def decode(blob: bytes):
     if kind == KIND_GROUP_DICT:
         groups = _r_value(r)
         nds = _r_value(r)
-        trimmed = _r_value(r)
+        trimmed = _r_value(r) if version >= 2 else False
         return GroupByIntermediate(groups, num_docs_scanned=nds,
                                    groups_trimmed=trimmed), stats
     if kind == KIND_AGG:

@@ -143,7 +143,14 @@ class HostSegmentExecutor:
         if p.lhs.is_identifier and not segment.column_metadata(p.lhs.identifier).single_value:
             return self._eval_mv_predicate(p, segment)
 
+        mm = eval_map_index_predicate(p, segment)
+        if mm is not None:
+            return mm
+
         v = self.eval_value(p.lhs, segment)
+        return self._compare_values(p, v, n)
+
+    def _compare_values(self, p: Predicate, v: np.ndarray, n: int) -> np.ndarray:
         if p.type == PredicateType.EQ:
             return v == _coerce_to(v, p.values[0])
         if p.type == PredicateType.NOT_EQ:
@@ -666,6 +673,53 @@ def eval_json_match(p: Predicate, segment) -> np.ndarray:
         raise UnsupportedQueryError(f"JSON_MATCH needs a column: {p.lhs}")
     idx = segment.get_json_index(col, or_build=True)
     return idx.mask_match(str(p.values[0]), segment.num_docs)
+
+
+def eval_map_index_predicate(p: Predicate, segment):
+    """Predicate over mapvalue(col, 'key') answered from a map index's
+    dense planes (segment/map_index.py) — one vector compare instead of a
+    row-wise JSON parse per doc. None when no index/key applies (the
+    generic transform path still answers exactly). Absent keys follow the
+    row-wise None semantics: they fail EQ/IN/RANGE and pass NOT_EQ/NOT_IN."""
+    from ..segment.map_index import map_value_args
+
+    args = map_value_args(p.lhs)
+    if args is None:
+        return None
+    col, key, default = args
+    if default is not None or not hasattr(segment, "get_map_index") \
+            or not segment.has_column(col):
+        return None
+    idx = segment.get_map_index(col)
+    if idx is None or not idx.has_key(key):
+        return None
+    lits = list(p.values or ())
+    lits += [x for x in (p.lower, p.upper) if x is not None]
+    try:
+        lits = [float(x) for x in lits]
+    except (TypeError, ValueError):
+        return None  # non-numeric comparison: dense planes are numeric
+    v, present = idx.value_plane(key)
+    if p.type in (PredicateType.EQ, PredicateType.IN):
+        m = np.zeros(len(v), dtype=bool)
+        for x in lits:
+            m |= v == x
+        return m & present
+    if p.type in (PredicateType.NOT_EQ, PredicateType.NOT_IN):
+        m = np.zeros(len(v), dtype=bool)
+        for x in lits:
+            m |= v == x
+        return ~(m & present)
+    if p.type == PredicateType.RANGE:
+        m = np.ones(len(v), dtype=bool)
+        if p.lower is not None:
+            lo = float(p.lower)
+            m &= (v >= lo) if p.lower_inclusive else (v > lo)
+        if p.upper is not None:
+            hi = float(p.upper)
+            m &= (v <= hi) if p.upper_inclusive else (v < hi)
+        return m & present
+    return None
 
 
 def eval_host_mask(p: Predicate, segment) -> np.ndarray:

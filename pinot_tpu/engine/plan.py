@@ -526,6 +526,13 @@ class SegmentPlanner(AggPlanContext):
         if info is not None:
             return self._lower_dict_predicate(p, lhs, info)
         if lhs.is_function:
+            # mapvalue(col,'key') over a map index: dense-plane compare on
+            # host → mask param (the map-index analogue of _lower_host_mask)
+            from .host_executor import eval_map_index_predicate
+
+            mm = eval_map_index_predicate(p, self.segment)
+            if mm is not None:
+                return self._mask_param(mm)
             try:
                 return self._lower_value_predicate(p)
             except UnsupportedQueryError:

@@ -161,7 +161,11 @@ def create_record_reader(path: str, fmt: Optional[str] = None,
             if name.endswith(suffix):
                 name = name[: -len(suffix)]
         fmt = Path(name).suffix.lstrip(".").lower()
-    factory = _READERS.get(fmt.lower())
+    fmt = fmt.lower()
+    if fmt not in _READERS and fmt in ("proto", "protobuf", "thrift"):
+        # registration-on-import, like stream plugins
+        from . import protobuf, thrift  # noqa: F401
+    factory = _READERS.get(fmt)
     if factory is None:
         raise ValueError(f"no record reader for format {fmt!r} "
                          f"(known: {sorted(_READERS)})")

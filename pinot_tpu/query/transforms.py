@@ -645,6 +645,24 @@ def _np_jsonextractscalar(blob, path, rtype="STRING", default=None):
     return rowfn(f)(blob)
 
 
+def _np_mapvalue(blob, key, default=None):
+    """mapCol['key'] / mapValue(col, 'key'[, default]) — row-wise parse of
+    the JSON/dict map column (reference: MapItemTransformFunction +
+    MapFunctions.mapValue). Segments carrying a map index answer indexed
+    predicates from dense planes instead (segment/map_index.py)."""
+    from ..segment.map_index import _parse_map
+
+    k = str(key)
+
+    def f(x):
+        m = _parse_map(x)
+        if m is None or k not in m:
+            return default
+        return m[k]
+
+    return rowfn(f)(blob)
+
+
 def _np_jsonextractkey(blob, path):
     def f(x):
         try:
@@ -860,6 +878,10 @@ TRANSFORMS: dict[str, TransformDef] = {
     "sha512": TransformDef(_hashfn("sha512")),
     "crc32": TransformDef(rowfn(
         lambda s: zlib.crc32(s if isinstance(s, bytes) else _sstr(s).encode()))),
+    # -- map ----------------------------------------------------------------
+    "mapvalue": TransformDef(_np_mapvalue),
+    "map_value": TransformDef(_np_mapvalue),
+    "item": TransformDef(_np_mapvalue),
     # -- json ---------------------------------------------------------------
     "jsonextractscalar": TransformDef(_np_jsonextractscalar),
     "jsonextractkey": TransformDef(_np_jsonextractkey),

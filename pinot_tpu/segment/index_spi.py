@@ -54,12 +54,20 @@ def register_index_type(index_type: IndexType) -> None:
 
 
 def get_index_type(name: str) -> IndexType:
+    if name not in _REGISTRY:
+        _load_builtin_types()
     try:
         return _REGISTRY[name]
     except KeyError:
         raise ValueError(
             f"unknown index type {name!r}; registered: "
             f"{sorted(_REGISTRY)}") from None
+
+
+def _load_builtin_types() -> None:
+    """Import modules that register the built-in custom index types (the
+    reference's ServiceLoader pass over IndexPlugin implementations)."""
+    from . import map_index  # noqa: F401  (registers "map")
 
 
 def registered_index_types() -> list[str]:

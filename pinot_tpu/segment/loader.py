@@ -185,6 +185,11 @@ class ImmutableSegment:
             self._indexes[key] = load_custom_index(self, column, type_name)
         return self._indexes[key]
 
+    def get_map_index(self, column: str):
+        """Dense per-key planes for a MAP column (segment/map_index.py);
+        None when this segment has no map index for the column."""
+        return self.get_custom_index(column, "map")
+
     def get_dictionary(self, column: str) -> Dictionary:
         if column not in self._dictionaries:
             m = self.column_metadata(column)

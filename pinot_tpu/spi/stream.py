@@ -192,8 +192,20 @@ def get_stream_consumer_factory(config: StreamConfig) -> StreamConsumerFactory:
 
 
 def get_decoder(config: StreamConfig) -> StreamDataDecoder:
-    name = config.decoder if config.decoder in _DECODERS else "json"
-    return _DECODERS[name]()
+    name = config.decoder
+    if name not in _DECODERS and "confluent" in name.lower():
+        # auto-import like stream types: decoder class names resolve on use
+        from ..plugins.stream import confluent  # noqa: F401
+    if name not in _DECODERS:
+        name = "json"
+    factory = _DECODERS[name]
+    try:
+        import inspect
+
+        takes_config = bool(inspect.signature(factory).parameters)
+    except (TypeError, ValueError):
+        takes_config = False
+    return factory(config) if takes_config else factory()
 
 
 # ---------------------------------------------------------------------------

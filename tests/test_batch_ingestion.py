@@ -262,3 +262,99 @@ def test_unknown_execution_framework_rejected(tmp_path):
         execution_framework="flink")
     with pytest.raises(ValueError, match="executionFramework"):
         IngestionJobLauncher(spec).run()
+
+
+def test_thrift_reader(tmp_path):
+    """Self-contained TBinaryProtocol decode (reference: pinot-thrift
+    ThriftRecordReader)."""
+    from pinot_tpu.plugins.inputformat.thrift import write_struct
+
+    buf = bytearray()
+    write_struct(buf, {1: "widget", 2: 42, 3: 9.5, 4: True,
+                       5: [1, 2, 3], 6: {1: "nested"}})
+    write_struct(buf, {1: "gadget", 2: -7})
+    p = tmp_path / "rows.thrift"
+    p.write_bytes(bytes(buf))
+    rows = list(create_record_reader(
+        str(p), config={"fieldIdToName": {"1": "name", "2": "qty",
+                                          "3": "price", "4": "ok",
+                                          "5": "tags"}}))
+    assert rows == [
+        {"name": "widget", "qty": 42, "price": 9.5, "ok": True,
+         "tags": [1, 2, 3], "6": {"1": "nested"}},
+        {"name": "gadget", "qty": -7},
+    ]
+
+
+def test_protobuf_reader(tmp_path):
+    """Descriptor-set driven decode of size-delimited messages (reference:
+    pinot-protobuf ProtoBufRecordReader)."""
+    pytest.importorskip("google.protobuf")
+    from google.protobuf import descriptor_pb2
+
+    # build a FileDescriptorSet for: message Item { string name=1; int64 qty=2; }
+    fds = descriptor_pb2.FileDescriptorSet()
+    fd = fds.file.add()
+    fd.name = "item.proto"
+    fd.package = "shop"
+    fd.syntax = "proto3"
+    msg = fd.message_type.add()
+    msg.name = "Item"
+    f1 = msg.field.add()
+    f1.name, f1.number, f1.type, f1.label = "name", 1, 9, 1  # TYPE_STRING
+    f2 = msg.field.add()
+    f2.name, f2.number, f2.type, f2.label = "qty", 2, 3, 1  # TYPE_INT64
+    desc_path = tmp_path / "item.desc"
+    desc_path.write_bytes(fds.SerializeToString())
+
+    from pinot_tpu.plugins.inputformat.protobuf import (load_message_class,
+                                                        write_delimited)
+
+    cls = load_message_class(fds.SerializeToString(), "shop.Item")
+    m1 = cls(name="widget", qty=42)
+    m2 = cls(name="gadget", qty=7)
+    p = tmp_path / "rows.proto"
+    with open(p, "wb") as f:
+        write_delimited(f, [m1, m2])
+    rows = list(create_record_reader(
+        str(p), config={"descriptorFile": str(desc_path),
+                        "protoClassName": "shop.Item"}))
+    assert rows == [{"name": "widget", "qty": "42"},
+                    {"name": "gadget", "qty": "7"}]
+
+
+def test_confluent_avro_decoder():
+    """Confluent wire format (magic 0 + schema id + avro binary) with
+    inline and injected schema resolution (reference:
+    KafkaConfluentSchemaRegistryAvroMessageDecoder)."""
+    from pinot_tpu.plugins.stream.confluent import (ConfluentAvroDecoder,
+                                                    encode_confluent,
+                                                    register_schema_provider)
+    from pinot_tpu.spi.stream import (StreamConfig, StreamMessage,
+                                      get_decoder)
+
+    schema = {"type": "record", "name": "Row", "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "qty", "type": "long"}]}
+    payload = encode_confluent(7, schema, {"name": "widget", "qty": 42})
+
+    cfg = StreamConfig(decoder="confluentavro", props={
+        "schema.registry.schemas": {"7": schema}})
+    dec = get_decoder(cfg)
+    assert isinstance(dec, ConfluentAvroDecoder)
+    row = dec.decode(StreamMessage(value=payload, key=None, offset=None,
+                                   timestamp_ms=0))
+    assert row == {"name": "widget", "qty": 42}
+
+    # registry-client seam: schema id resolved through an injected provider
+    register_schema_provider("http://sr.test", lambda sid: schema if sid == 7 else None)
+    cfg2 = StreamConfig(decoder="confluentavro", props={
+        "schema.registry.rest.url": "http://sr.test"})
+    row2 = get_decoder(cfg2).decode(
+        StreamMessage(value=payload, key=None, offset=None, timestamp_ms=0))
+    assert row2 == {"name": "widget", "qty": 42}
+
+    # non-confluent payload (no magic byte) is skipped, not crashed
+    assert get_decoder(cfg).decode(
+        StreamMessage(value=b"\x01junk", key=None, offset=None,
+                      timestamp_ms=0)) is None

@@ -113,3 +113,40 @@ def test_no_pickle_on_the_wire():
     blob = dt.encode(gb, {})
     with pytest.raises(Exception):
         pickle.loads(blob)  # not a pickle stream
+
+
+def test_wire_compat_v1_reader(  ):
+    """Old-writer/new-reader: a version-1 DataTable (pre groups_trimmed)
+    decodes on current code — the compatibility-verifier guarantee
+    (reference: compatibility-verifier/compCheck.sh rolling-upgrade
+    matrix). A FUTURE version fails loudly instead of misparsing."""
+    import json
+    import struct
+
+    import numpy as np
+
+    from pinot_tpu.cluster import datatable as dt
+    from pinot_tpu.engine.results import GroupByIntermediate
+
+    groups = {("a",): (np.int64(3),), ("b",): (np.int64(5),)}
+    # hand-rolled v1 writer: identical layout minus the trimmed flag
+    out = bytearray(dt.MAGIC)
+    out += struct.pack("<H", 1)
+    out.append(dt.KIND_GROUP_DICT)
+    meta = json.dumps({"total_docs": 8}).encode()
+    out += struct.pack("<I", len(meta)) + meta
+    dt._w_value(out, groups)
+    dt._w_value(out, 8)
+
+    combined, stats = dt.decode(bytes(out))
+    assert isinstance(combined, GroupByIntermediate)
+    assert combined.groups[("a",)][0] == 3
+    assert combined.groups_trimmed is False
+    assert stats["total_docs"] == 8
+
+    future = bytearray(bytes(out))
+    struct.pack_into("<H", future, 4, dt.VERSION + 1)
+    import pytest
+
+    with pytest.raises(dt.DataTableError, match="version"):
+        dt.decode(bytes(future))

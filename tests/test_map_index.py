@@ -1,0 +1,141 @@
+"""Map index: dense per-key planes for MAP columns (segment/map_index.py).
+
+Reference: StandardIndexes MAP_ID + pinot-segment-local/.../index/map/
+(MapIndexType, ImmutableMapIndexReader) and MapFunctions.mapValue."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.segment.map_index import MapIndex
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+N = 5000
+
+
+def _maps(rng):
+    out = []
+    for i in range(N):
+        m = {"qty": int(rng.integers(0, 100)), "color": ["red", "green", "blue"][i % 3]}
+        if i % 7 == 0:
+            m["rare"] = float(i)
+        if i % 11 == 0:
+            del m["qty"]  # absent key rows
+        out.append(json.dumps(m))
+    return np.asarray(out, dtype=object)
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory):
+    rng = np.random.default_rng(5)
+    schema = Schema.build("maps", dimensions=[("props", "STRING")],
+                          metrics=[("m", "INT")])
+    cols = {"props": _maps(rng),
+            "m": rng.integers(0, 10, N).astype(np.int32)}
+    cfg = TableConfig(table_name="maps", indexing=IndexingConfig(
+        custom_index_configs={"props": {"type": "map", "maxKeys": 8}}))
+    d = tmp_path_factory.mktemp("mapseg") / "s0"
+    SegmentBuilder(schema, cfg, "s0").build(cols, str(d))
+    return load_segment(d), cols
+
+
+def _expected_mask(cols, key, fn):
+    out = np.zeros(N, dtype=bool)
+    for i, s in enumerate(cols["props"]):
+        m = json.loads(s)
+        if key in m:
+            out[i] = fn(m[key])
+    return out
+
+
+def test_build_and_roundtrip(seg):
+    segment, cols = seg
+    idx = segment.get_map_index("props")
+    assert idx is not None
+    assert idx.has_key("qty") and idx.has_key("rare")
+    v, pr = idx.value_plane("qty")
+    expect_pr = np.asarray([("qty" in json.loads(s)) for s in cols["props"]])
+    assert np.array_equal(pr, expect_pr)
+    i = int(np.nonzero(pr)[0][0])
+    assert v[i] == json.loads(cols["props"][i])["qty"]
+    # serialize → deserialize parity
+    idx2 = MapIndex.deserialize({k: a for k, a in idx.serialize()})
+    assert idx2.dense_keys == idx.dense_keys
+    assert np.array_equal(idx2.values["qty"], idx.values["qty"])
+
+
+def test_indexed_predicate_matches_rowwise(seg):
+    segment, cols = seg
+    from pinot_tpu.engine.host_executor import eval_map_index_predicate
+    from pinot_tpu.query.parser.sql import parse_sql
+
+    q = parse_sql("SELECT COUNT(*) FROM maps WHERE mapValue(props, 'qty') > 50")
+    p = q.filter.predicate
+    mask = eval_map_index_predicate(p, segment)
+    assert mask is not None  # the index really answered
+    expect = _expected_mask(cols, "qty", lambda x: isinstance(x, (int, float)) and x > 50)
+    assert np.array_equal(mask, expect)
+
+
+@pytest.mark.parametrize("backend", ["host", "tpu"])
+def test_count_filter_both_engines(seg, backend):
+    segment, cols = seg
+    schema = Schema.build("maps", dimensions=[("props", "STRING")],
+                          metrics=[("m", "INT")])
+    qe = QueryExecutor(backend=backend)
+    qe.add_table(schema, [segment])
+    r = qe.execute_sql("SELECT COUNT(*) FROM maps WHERE mapValue(props, 'qty') > 50")
+    assert not r.exceptions, r.exceptions
+    expect = int(_expected_mask(cols, "qty",
+                                lambda x: isinstance(x, (int, float)) and x > 50).sum())
+    assert r.result_table.rows[0][0] == expect
+
+
+def test_absent_key_not_eq_semantics(seg):
+    segment, cols = seg
+    schema = Schema.build("maps", dimensions=[("props", "STRING")],
+                          metrics=[("m", "INT")])
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [segment])
+    r = qe.execute_sql("SELECT COUNT(*) FROM maps WHERE mapValue(props, 'qty') != 3")
+    assert not r.exceptions, r.exceptions
+    # absent-key rows PASS != (None != 3), matching the row-wise path
+    cnt = 0
+    for s in cols["props"]:
+        m = json.loads(s)
+        if "qty" not in m or m["qty"] != 3:
+            cnt += 1
+    assert r.result_table.rows[0][0] == cnt
+
+
+def test_rowwise_projection(seg):
+    segment, cols = seg
+    schema = Schema.build("maps", dimensions=[("props", "STRING")],
+                          metrics=[("m", "INT")])
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [segment])
+    r = qe.execute_sql("SELECT mapValue(props, 'color') FROM maps LIMIT 3")
+    assert not r.exceptions, r.exceptions
+    expect = [json.loads(s).get("color") for s in cols["props"][:3]]
+    assert [row[0] for row in r.result_table.rows] == expect
+
+
+def test_unindexed_key_falls_back(seg):
+    segment, cols = seg
+    schema = Schema.build("maps", dimensions=[("props", "STRING")],
+                          metrics=[("m", "INT")])
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [segment])
+    # 'color' is string-valued → no dense plane; row-wise answers it
+    r = qe.execute_sql(
+        "SELECT COUNT(*) FROM maps WHERE mapValue(props, 'color') = 'red'")
+    assert not r.exceptions, r.exceptions
+    expect = int(_expected_mask(cols, "color", lambda x: x == "red").sum())
+    assert r.result_table.rows[0][0] == expect
