@@ -393,7 +393,7 @@ class Broker:
             if err is not None:
                 retry.extend(segs)
             else:
-                results.append(out)
+                results.append((inst, out))
         if retry:
             # failover: re-route failed segments to remaining replicas
             # (reference: query-time replica failover via routing)
@@ -403,22 +403,51 @@ class Broker:
                 if err is not None:
                     raise TransportError(
                         f"segments {segs} unreachable on all replicas")
-                results.append(out)
+                results.append((inst, out))
         from .datatable import decode
 
-        missing = []
         combineds = []
-        for r in results:
+
+        def absorb(inst, r, missing_sink):
             combined, st = decode(r["datatable"])
             combineds.append(combined)
             stats_sum["total_docs"] += st["total_docs"]
             stats_sum["num_segments_processed"] += st["num_segments_processed"]
             stats_sum["num_segments_pruned"] += st["num_segments_pruned"]
-            missing.extend(st.get("missing_segments", []))
-        if missing:
-            # a routed segment the server no longer hosts → partial result;
-            # fail loudly rather than silently dropping rows
-            raise RuntimeError(f"servers missing routed segments: {missing}")
+            for s in st.get("missing_segments", []):
+                missing_sink.setdefault(inst, []).append(s)
+
+        missing_by_inst: dict[str, list[str]] = {}
+        for inst, r in results:
+            absorb(inst, r, missing_by_inst)
+        if missing_by_inst:
+            # a routed segment the server no longer hosts — normal during a
+            # rebalance (the routing snapshot raced the unload): refresh the
+            # routing and retry those segments on their CURRENT replicas,
+            # excluding the instance that just reported them gone
+            # (reference: broker retry with updated routing)
+            fresh = self.routing_table(table)
+            sub_routing = {}
+            for inst, segs in missing_by_inst.items():
+                for s in segs:
+                    replicas = [i for i in fresh.get(s, []) if i != inst]
+                    if not replicas:
+                        raise RuntimeError(
+                            f"segment {s} has no remaining replicas")
+                    sub_routing[s] = replicas
+            still_missing: dict[str, list[str]] = {}
+            for inst, segs, out, err in self._pool.map(
+                    call, self._select_instances(sub_routing).items()):
+                if err is not None:
+                    raise TransportError(
+                        f"segments {segs} unreachable on retry")
+                absorb(inst, out, still_missing)
+            if still_missing:
+                # twice-missing → genuinely gone; fail loudly rather than
+                # silently dropping rows
+                raise RuntimeError(
+                    f"servers missing routed segments after retry: "
+                    f"{sorted(s for v in still_missing.values() for s in v)}")
         return combineds
 
     def _merge(self, query: QueryContext, per_server: list):

@@ -95,7 +95,7 @@ class ClusterController:
         metadata = dict(metadata, segmentName=segment_name,
                         pushTimeMs=int(time.time() * 1000))
         self.store.set(f"/SEGMENTS/{name_with_type}/{segment_name}", metadata)
-        assigned = self._assign_segment(cfg)
+        assigned = self._assign_segment(cfg, metadata)
         state = CONSUMING if metadata.get("consuming") else ONLINE
 
         def upd(ideal):
@@ -118,10 +118,95 @@ class ClusterController:
     def segment_metadata(self, name_with_type: str, segment_name: str) -> Optional[dict]:
         return self.store.get(f"/SEGMENTS/{name_with_type}/{segment_name}")
 
+    # -- instance partitions (replica groups) --------------------------------
+    def configure_instance_partitions(self, name_with_type: str,
+                                      num_replica_groups: int,
+                                      instances_per_group: Optional[int] = None,
+                                      num_partitions: Optional[int] = None) -> dict:
+        """Partition the table's eligible instances into replica groups
+        (reference: InstanceAssignmentDriver +
+        InstanceReplicaGroupPartitionSelector — each replica of a segment
+        lands in a DISTINCT group, so one group can serve a full copy of
+        the table and queries fan out within a single group). Selection is
+        deterministic (sorted instances, round-robin into groups) so
+        re-running after membership changes moves as little as possible."""
+        cfg = self.table_config(name_with_type)
+        if cfg is None:
+            raise KeyError(name_with_type)
+        candidates = sorted(set(self.list_instances(cfg.get("serverTag")))
+                            & set(self.live_instances()))
+        per_group = instances_per_group or len(candidates) // num_replica_groups
+        need = num_replica_groups * per_group
+        if per_group < 1 or len(candidates) < need:
+            raise RuntimeError(
+                f"need {num_replica_groups}x{per_group} instances, "
+                f"have {candidates}")
+        # sticky re-run: instances keep their previous group when still
+        # eligible, so new capacity fills gaps instead of reshuffling
+        # whole groups (and the follow-up rebalance moves the minimum)
+        prev = (self.instance_partitions(name_with_type)
+                or {}).get("replicaGroups", [])
+        eligible = set(candidates)
+        groups: list[list] = []
+        taken: set = set()
+        for g in range(num_replica_groups):
+            kept = [i for i in (prev[g] if g < len(prev) else [])
+                    if i in eligible and i not in taken][:per_group]
+            groups.append(kept)
+            taken.update(kept)
+        pool = [i for i in candidates if i not in taken]
+        for g in range(num_replica_groups):
+            while len(groups[g]) < per_group:
+                groups[g].append(pool.pop(0))
+        record = {"replicaGroups": groups}
+        if num_partitions:
+            record["numPartitions"] = int(num_partitions)
+        self.store.set(f"/INSTANCEPARTITIONS/{name_with_type}", record)
+        return record
+
+    def instance_partitions(self, name_with_type: str) -> Optional[dict]:
+        return self.store.get(f"/INSTANCEPARTITIONS/{name_with_type}")
+
+    @staticmethod
+    def _segment_partition_id(metadata: Optional[dict]) -> Optional[int]:
+        """First stamped partition id on the segment's push metadata."""
+        for info in ((metadata or {}).get("partitions") or {}).values():
+            parts = info.get("partitions") if isinstance(info, dict) else None
+            if parts:
+                return int(parts[0])
+        return None
+
     # -- assignment ---------------------------------------------------------
-    def _assign_segment(self, cfg: dict) -> list[str]:
-        """Balanced assignment: pick the `replication` least-loaded eligible
-        live instances (reference: BalancedNumSegmentAssignmentStrategy)."""
+    def _assign_segment(self, cfg: dict,
+                        metadata: Optional[dict] = None) -> list[str]:
+        """Replica-group assignment when instance partitions are configured
+        (one instance from EACH group — partition-stamped segments pin to
+        group member p % group_size, reference
+        BaseSegmentAssignment.assignSegment replica-group path); otherwise
+        balanced least-loaded assignment
+        (BalancedNumSegmentAssignmentStrategy)."""
+        name = cfg["tableNameWithType"]
+        ideal = self.store.get(f"/IDEALSTATES/{name}") or {}
+        ip = self.instance_partitions(name)
+        if ip:
+            live = set(self.live_instances())
+            load = {}
+            for seg_map in ideal.values():
+                for inst in seg_map:
+                    load[inst] = load.get(inst, 0) + 1
+            pid = self._segment_partition_id(metadata)
+            out = []
+            for group in ip["replicaGroups"]:
+                members = [i for i in group if i in live]
+                if not members:
+                    raise RuntimeError(f"replica group {group} has no live "
+                                       f"members for {name}")
+                if pid is not None:
+                    out.append(members[pid % len(members)])
+                else:
+                    out.append(min(members,
+                                   key=lambda i: (load.get(i, 0), i)))
+            return out
         replication = int(cfg.get("replication", 1))
         tag = cfg.get("serverTag")
         candidates = [i for i in self.list_instances(tag)
@@ -130,8 +215,6 @@ class ClusterController:
             raise RuntimeError(
                 f"not enough live servers: need {replication}, have {candidates}")
         load = {i: 0 for i in candidates}
-        name = cfg["tableNameWithType"]
-        ideal = self.store.get(f"/IDEALSTATES/{name}") or {}
         for seg_map in ideal.values():
             for inst in seg_map:
                 if inst in load:
@@ -139,22 +222,49 @@ class ClusterController:
         return sorted(candidates, key=lambda i: (load[i], i))[:replication]
 
     # -- rebalance ----------------------------------------------------------
-    def rebalance(self, name_with_type: str, dry_run: bool = False) -> dict:
-        """Recompute a balanced target assignment with minimal movement and
-        write it to the ideal state (reference: TableRebalancer — target
-        computed then applied; servers converge; min-available-replica
-        stepping is not needed since the store update is atomic)."""
-        cfg = self.table_config(name_with_type)
-        if cfg is None:
-            raise KeyError(name_with_type)
+    def _rebalance_target(self, name_with_type: str, cfg: dict,
+                          ideal: dict) -> tuple[dict, int]:
+        """Minimal-movement balanced target (replica-group aware when
+        instance partitions are configured)."""
+        ip = self.instance_partitions(name_with_type)
+        if ip:
+            live = set(self.live_instances())
+            target: dict[str, dict] = {}
+            moves = 0
+            load: dict[str, int] = {}
+            for seg in sorted(ideal):
+                pid = self._segment_partition_id(
+                    self.segment_metadata(name_with_type, seg))
+                current = set(ideal[seg])
+                chosen = []
+                for group in ip["replicaGroups"]:
+                    members = [i for i in group if i in live]
+                    if not members:
+                        raise RuntimeError(
+                            f"replica group {group} has no live members")
+                    if pid is not None:
+                        pick = members[pid % len(members)]
+                    else:
+                        # keep the current in-group replica when possible
+                        keep = [i for i in members if i in current]
+                        pick = keep[0] if keep else min(
+                            members, key=lambda i: (load.get(i, 0), i))
+                    chosen.append(pick)
+                    load[pick] = load.get(pick, 0) + 1
+                moves += len(set(chosen) - current)
+                # preserve the segment's state (a moved CONSUMING replica
+                # must re-enter as CONSUMING, not as a deep-store load)
+                state = CONSUMING if CONSUMING in ideal[seg].values() else ONLINE
+                target[seg] = {i: state for i in chosen}
+            return target, moves
+
         replication = int(cfg.get("replication", 1))
         candidates = sorted(set(self.list_instances(cfg.get("serverTag")))
                             & set(self.live_instances()))
         if len(candidates) < replication:
             raise RuntimeError("not enough live servers to rebalance")
-        ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
         load = {i: 0 for i in candidates}
-        target: dict[str, dict] = {}
+        target = {}
         moves = 0
         for seg in sorted(ideal):
             keep = [i for i in ideal[seg] if i in candidates][:replication]
@@ -162,10 +272,11 @@ class ClusterController:
             for i in keep:
                 load[i] += 1
         for seg in sorted(ideal):
+            state = CONSUMING if CONSUMING in ideal[seg].values() else ONLINE
             while len(target[seg]) < replication:
                 pick = min((i for i in candidates if i not in target[seg]),
                            key=lambda i: (load[i], i))
-                target[seg][pick] = ONLINE
+                target[seg][pick] = state
                 load[pick] += 1
                 moves += 1
         # level loads: move replicas from the most- to the least-loaded host
@@ -183,10 +294,115 @@ class ClusterController:
             load[hi] -= 1
             load[lo] += 1
             moves += 1
-        result = {"table": name_with_type, "moves": moves, "target": target}
-        if not dry_run:
-            self.store.set(f"/IDEALSTATES/{name_with_type}", target)
-        return result
+        return target, moves
+
+    def rebalance(self, name_with_type: str, dry_run: bool = False,
+                  min_available_replicas: int = 1,
+                  ev_timeout_s: float = 30.0,
+                  include_consuming: bool = False) -> dict:
+        """Safe rebalance (reference: TableRebalancer.rebalance —
+        .../helix/core/rebalance/TableRebalancer.java): compute a
+        minimal-movement target, then converge the ideal state in TWO
+        phases per changed segment — first ADD the target replicas
+        (ideal = current ∪ target) and wait for the external view to show
+        every target replica ONLINE, only then REMOVE the departing ones.
+        A segment's routable replica count therefore never drops below
+        min(current availability, min_available_replicas) at any point:
+        queries keep succeeding throughout the move. Progress is tracked
+        in the store (/REBALANCE/{table}) like the reference's
+        ZK-persisted rebalance job context."""
+        cfg = self.table_config(name_with_type)
+        if cfg is None:
+            raise KeyError(name_with_type)
+        ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
+        # CONSUMING segments sit out by default (reference: rebalance
+        # includeConsuming=false) — moving an active consumer means
+        # restarting consumption on the new host
+        frozen = {} if include_consuming else {
+            s: m for s, m in ideal.items() if CONSUMING in m.values()}
+        movable = {s: m for s, m in ideal.items() if s not in frozen}
+        target, moves = self._rebalance_target(name_with_type, cfg, movable)
+        target.update({s: dict(m) for s, m in frozen.items()})
+        changed = [s for s in sorted(ideal)
+                   if set(target.get(s, {})) != set(ideal[s])]
+        result = {"table": name_with_type, "moves": moves, "target": target,
+                  "segments_changed": len(changed)}
+        if dry_run:
+            return result
+
+        for seg in target:
+            if len(target[seg]) < min_available_replicas:
+                raise RuntimeError(
+                    f"target for {seg} has {len(target[seg])} replicas "
+                    f"< minAvailableReplicas={min_available_replicas}")
+
+        job_id = f"rb_{int(time.time() * 1000)}"
+        job_path = f"/REBALANCE/{name_with_type}"
+        job = {"jobId": job_id, "status": "IN_PROGRESS",
+               "segmentsTotal": len(changed), "segmentsDone": 0,
+               "moves": moves, "startedMs": int(time.time() * 1000)}
+        self.store.set(job_path, job)
+        if not changed:
+            job["status"] = "DONE"
+            self.store.set(job_path, job)
+            return dict(result, jobId=job_id, status="DONE")
+
+        # phase 1: additive union — nothing is ever removed here, so
+        # availability only grows. Segments deleted concurrently (retention,
+        # drop) are SKIPPED, not resurrected: the closures re-read current
+        # membership under the store's atomic update.
+        def add_union(cur):
+            cur = cur or {}
+            for seg in changed:
+                if seg not in cur:
+                    continue
+                merged = dict(cur[seg])
+                merged.update(target[seg])
+                cur[seg] = merged
+            return cur
+
+        self.store.update(f"/IDEALSTATES/{name_with_type}", add_union)
+
+        # wait: every ONLINE-target replica of every changed segment shows
+        # ONLINE in the external view (CONSUMING replicas never report
+        # ONLINE — their handoff is the realtime manager's job, not ours)
+        def ev_wait_insts(seg):
+            return [i for i, st in target[seg].items() if st == ONLINE]
+
+        deadline = time.time() + ev_timeout_s
+        pending = set(changed)
+        while pending and time.time() < deadline:
+            view = self.store.get(f"/EXTERNALVIEW/{name_with_type}") or {}
+            ideal_now = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
+            pending = {s for s in pending if s in ideal_now
+                       and any((view.get(s) or {}).get(i) != ONLINE
+                               for i in ev_wait_insts(s))}
+            if pending:
+                time.sleep(0.05)
+        if pending:
+            job["status"] = "STUCK"
+            job["pending"] = sorted(pending)
+            self.store.set(job_path, job)
+            raise TimeoutError(
+                f"rebalance {job_id}: replicas not ONLINE after "
+                f"{ev_timeout_s}s: {sorted(pending)}")
+
+        # phase 2: drop the departing replicas (targets are serving)
+        def to_target(cur):
+            cur = cur or {}
+            for seg in changed:
+                if seg in cur:
+                    cur[seg] = dict(target[seg])
+            return cur
+
+        self.store.update(f"/IDEALSTATES/{name_with_type}", to_target)
+        job.update(status="DONE", segmentsDone=len(changed),
+                   finishedMs=int(time.time() * 1000))
+        self.store.set(job_path, job)
+        return dict(result, jobId=job_id, status="DONE")
+
+    def rebalance_status(self, name_with_type: str) -> Optional[dict]:
+        return self.store.get(f"/REBALANCE/{name_with_type}")
 
     # -- retention ----------------------------------------------------------
     def run_retention(self, now_ms: Optional[int] = None) -> list[str]:

@@ -1,0 +1,240 @@
+"""Replica-group assignment + safe rebalance (controller.py).
+
+Reference: InstanceAssignmentDriver / InstanceReplicaGroupPartitionSelector
+(pinot-controller/.../assignment/instance/), BaseSegmentAssignment's
+replica-group path, and TableRebalancer's min-available-replica stepping
+(pinot-controller/.../helix/core/rebalance/TableRebalancer.java)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "stats",
+    dimensions=[("team", "STRING"), ("year", "INT")],
+    metrics=[("runs", "INT")])
+
+TEAMS = ["BOS", "NYA", "SFN", "LAN"]
+
+
+def _build_segment(tmp, name, seed, n=400):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "team": np.asarray(TEAMS, dtype=object)[rng.integers(0, len(TEAMS), n)],
+        "year": rng.integers(2000, 2010, n).astype(np.int32),
+        "runs": rng.integers(0, 100, n).astype(np.int32),
+    }
+    path = str(tmp / name)
+    SegmentBuilder(SCHEMA, segment_name=name).build(cols, path)
+    return path, cols
+
+
+def _mk_cluster(n_servers):
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"S{i}", backend="host")
+               for i in range(n_servers)]
+    for s in servers:
+        s.start()
+    controller.add_schema(SCHEMA.to_json())
+    return store, controller, servers
+
+
+def test_replica_group_assignment(tmp_path):
+    store, controller, servers = _mk_cluster(4)
+    try:
+        table = controller.create_table({"tableName": "stats", "replication": 2})
+        ip = controller.configure_instance_partitions(table, 2)
+        groups = [set(g) for g in ip["replicaGroups"]]
+        assert len(groups) == 2 and not (groups[0] & groups[1])
+        for i in range(6):
+            path, _ = _build_segment(tmp_path, f"s{i}", seed=i)
+            assigned = controller.add_segment(
+                table, f"s{i}", {"location": path, "numDocs": 400})
+            # one replica in EACH group
+            assert len(assigned) == 2
+            assert sum(1 for a in assigned if a in groups[0]) == 1
+            assert sum(1 for a in assigned if a in groups[1]) == 1
+        # within each group, segments spread across both members
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        per_inst = {}
+        for seg_map in ideal.values():
+            for inst in seg_map:
+                per_inst[inst] = per_inst.get(inst, 0) + 1
+        assert all(c == 3 for c in per_inst.values()), per_inst
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_partition_pinned_assignment(tmp_path):
+    store, controller, servers = _mk_cluster(4)
+    try:
+        table = controller.create_table({"tableName": "stats", "replication": 2})
+        controller.configure_instance_partitions(table, 2, num_partitions=2)
+        ip = controller.instance_partitions(table)
+        picks = {}
+        for p in (0, 1, 0, 1):
+            name = f"p{p}_{len(picks)}"
+            path, _ = _build_segment(tmp_path, name, seed=p)
+            assigned = controller.add_segment(table, name, {
+                "location": path, "numDocs": 400,
+                "partitions": {"team": {"functionName": "murmur",
+                                        "numPartitions": 2,
+                                        "partitions": [p]}}})
+            picks.setdefault(p, set()).add(tuple(sorted(assigned)))
+        # same partition id → same instances, different ids → different
+        assert all(len(v) == 1 for v in picks.values())
+        assert picks[0] != picks[1]
+        for p, v in picks.items():
+            insts = next(iter(v))
+            for g, group in enumerate(ip["replicaGroups"]):
+                assert insts[g] in group or insts[1 - g] in group
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_safe_rebalance_zero_failed_queries(tmp_path):
+    """Add a server, rebalance onto it while hammering the broker: no
+    query may fail and no partial results may appear mid-move."""
+    store, controller, servers = _mk_cluster(2)
+    broker = Broker(store)
+    try:
+        table = controller.create_table({"tableName": "stats", "replication": 1})
+        all_cols = []
+        for i in range(8):
+            path, cols = _build_segment(tmp_path, f"s{i}", seed=i)
+            controller.add_segment(table, f"s{i}",
+                                   {"location": path, "numDocs": 400})
+            all_cols.append(cols)
+        expect = 400 * 8
+
+        failures, mismatches = [], []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                r = broker.execute_sql("SELECT COUNT(*) FROM stats")
+                if r.exceptions:
+                    failures.append(r.exceptions)
+                elif r.result_table.rows[0][0] != expect:
+                    mismatches.append(r.result_table.rows[0][0])
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        time.sleep(0.2)
+        # new capacity arrives; rebalance must move ~1/3 of segments onto it
+        s_new = ServerInstance(store, "S2", backend="host")
+        s_new.start()
+        servers.append(s_new)
+        res = controller.rebalance(table, min_available_replicas=1)
+        time.sleep(0.3)
+        stop.set()
+        t.join(timeout=10)
+
+        assert res["status"] == "DONE"
+        assert res["moves"] > 0
+        assert not failures, failures[:3]
+        assert not mismatches, mismatches[:5]
+        status = controller.rebalance_status(table)
+        assert status["status"] == "DONE"
+        assert status["segmentsDone"] == status["segmentsTotal"] > 0
+        # loads levelled: every server now hosts 2-3 of the 8 segments
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        per_inst = {}
+        for seg_map in ideal.values():
+            for inst in seg_map:
+                per_inst[inst] = per_inst.get(inst, 0) + 1
+        assert len(per_inst) == 3 and max(per_inst.values()) <= 3, per_inst
+    finally:
+        stop.set()
+        for s in servers:
+            s.stop()
+
+
+def test_rebalance_into_replica_groups(tmp_path):
+    """Configuring instance partitions then rebalancing restructures an
+    existing table into the replica-group layout without downtime."""
+    store, controller, servers = _mk_cluster(4)
+    broker = Broker(store)
+    try:
+        table = controller.create_table({"tableName": "stats", "replication": 2})
+        for i in range(4):
+            path, _ = _build_segment(tmp_path, f"s{i}", seed=i)
+            controller.add_segment(table, f"s{i}",
+                                   {"location": path, "numDocs": 400})
+        ip = controller.configure_instance_partitions(table, 2)
+        res = controller.rebalance(table, min_available_replicas=1)
+        assert res["status"] == "DONE"
+        groups = [set(g) for g in ip["replicaGroups"]]
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        for seg, seg_map in ideal.items():
+            insts = set(seg_map)
+            assert len(insts & groups[0]) == 1, (seg, seg_map)
+            assert len(insts & groups[1]) == 1, (seg, seg_map)
+        r = broker.execute_sql("SELECT COUNT(*) FROM stats")
+        assert not r.exceptions and r.result_table.rows[0][0] == 1600
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_rebalance_skips_consuming_segments(tmp_path):
+    """CONSUMING segments sit out of rebalance by default (reference:
+    includeConsuming=false) — no state flip to ONLINE, no EV-wait hang."""
+    store, controller, servers = _mk_cluster(3)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "tableType": "REALTIME", "replication": 1})
+        for i in range(4):
+            path, _ = _build_segment(tmp_path, f"done{i}", seed=i)
+            controller.add_segment(table, f"done{i}",
+                                   {"location": path, "numDocs": 400})
+        # an active consumer, pinned to S0 (no deep-store location yet)
+        store.update(f"/IDEALSTATES/{table}", lambda cur: dict(
+            cur or {}, consuming_0={"S0": "CONSUMING"}))
+        before = store.get(f"/IDEALSTATES/{table}")["consuming_0"]
+        res = controller.rebalance(table, min_available_replicas=1)
+        assert res["status"] == "DONE"
+        after = store.get(f"/IDEALSTATES/{table}")["consuming_0"]
+        assert after == before  # untouched, state still CONSUMING
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_sticky_instance_partitions(tmp_path):
+    """Re-running configure_instance_partitions keeps eligible instances in
+    their previous groups — new capacity fills gaps, groups don't reshuffle."""
+    store, controller, servers = _mk_cluster(4)
+    try:
+        table = controller.create_table({"tableName": "stats", "replication": 2})
+        ip1 = controller.configure_instance_partitions(table, 2)
+        ip2 = controller.configure_instance_partitions(table, 2)
+        assert ip1["replicaGroups"] == ip2["replicaGroups"]
+        # kill one member; its replacement joins, others stay put
+        lost = ip1["replicaGroups"][1][1]
+        victim = next(s for s in servers if s.instance_id == lost)
+        victim.stop()
+        s_new = ServerInstance(store, "S9", backend="host")
+        s_new.start()
+        servers.append(s_new)
+        ip3 = controller.configure_instance_partitions(table, 2)
+        assert ip3["replicaGroups"][0] == ip1["replicaGroups"][0]
+        assert ip3["replicaGroups"][1][0] == ip1["replicaGroups"][1][0]
+        assert "S9" in ip3["replicaGroups"][1]
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
