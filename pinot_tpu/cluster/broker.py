@@ -436,12 +436,32 @@ class Broker:
                             f"segment {s} has no remaining replicas")
                     sub_routing[s] = replicas
             still_missing: dict[str, list[str]] = {}
+            failed: list[tuple[str, list[str]]] = []
             for inst, segs, out, err in self._pool.map(
                     call, self._select_instances(sub_routing).items()):
                 if err is not None:
-                    raise TransportError(
-                        f"segments {segs} unreachable on retry")
-                absorb(inst, out, still_missing)
+                    failed.append((inst, segs))
+                else:
+                    absorb(inst, out, still_missing)
+            if failed:
+                # the retry pass keeps replica failover too: a transient
+                # connection failure re-routes once more to the segment's
+                # remaining replicas before the query fails
+                fo_routing = {}
+                for inst, segs in failed:
+                    for s in segs:
+                        replicas = [i for i in sub_routing.get(s, [])
+                                    if i != inst]
+                        if not replicas:
+                            raise TransportError(
+                                f"segment {s} unreachable on retry")
+                        fo_routing[s] = replicas
+                for inst, segs, out, err in self._pool.map(
+                        call, self._select_instances(fo_routing).items()):
+                    if err is not None:
+                        raise TransportError(
+                            f"segments {segs} unreachable on retry")
+                    absorb(inst, out, still_missing)
             if still_missing:
                 # twice-missing → genuinely gone; fail loudly rather than
                 # silently dropping rows

@@ -124,10 +124,21 @@ class ServerInstance:
     def _register_table(self, table: str) -> None:
         raw = raw_table_name(table)
         schema_json = self.store.get(f"/SCHEMAS/{raw}")
-        if schema_json is not None and table in self.segments:
-            self.executor.add_table(
-                Schema.from_json(schema_json),
-                list(self.segments[table].values()), name=table)
+        if schema_json is None or table not in self.segments:
+            return
+        schema = Schema.from_json(schema_json)
+        segments = list(self.segments[table].values())
+        cfg = self.store.get(f"/CONFIGS/TABLE/{table}") or {}
+        if cfg.get("isDimTable") and schema.primary_key_columns:
+            # dimension table: every server holds the full copy and serves
+            # LOOKUP joins from it (reference DimensionTableDataManager)
+            self.executor.add_dimension_table(schema, segments, name=table)
+            # LOOKUP callers name the RAW table
+            from ..engine.dim_tables import alias_dimension_table
+
+            alias_dimension_table(raw, table)
+            return
+        self.executor.add_table(schema, segments, name=table)
 
     def _update_external_view(self, table: str, online: set) -> None:
         def upd(view):

@@ -659,14 +659,49 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext,
                     compression=_TDIGEST_COMPRESSION)
 
             return LoweredAgg(label, sem, extract)
-        # raw numeric column (or an occupancy-capped dict column):
-        # fixed-bin device histogram → weighted t-digest
+        # raw numeric column (or an occupancy-capped dict column)
         mm = ctx.col_minmax(data[0])
         if mm is None:
             raise UnsupportedQueryError(f"{name} needs numeric column stats")
         lo, hi = float(mm[0]), float(mm[1])
         if hi <= lo:
             hi = lo + 1.0
+        pct = _pct(extra)
+
+        from ..ops import mxu_groupby
+
+        bins = min(64, max(1, (mxu_groupby.MAX_GROUPS - 1)
+                           // max(1, ctx.group_card_hint)))
+        if bins >= 8 and mxu_groupby.supports(
+                ctx.group_card_hint * bins + 1, 1):
+            # two-level adaptive device histogram (MXU count passes; see
+            # kernels "hist_adaptive"): quantile resolution (hi-lo)/bins^2
+            # concentrated around the asked percentile, 2*bins+1 output
+            # words per group instead of _HIST_BINS
+            i = ctx.add_op(ir.AggOp(
+                "hist_adaptive", vexpr=ctx.value_expr(data[0]), bins=bins,
+                lo_param=ctx.param(np.float64(lo)),
+                hi_param=ctx.param(np.float64(hi)), pct=float(pct)))
+            w1 = (hi - lo) / bins
+            c1 = lo + (np.arange(bins) + 0.5) * w1
+
+            def extract(outs, g, _i=i, _b=bins, _lo=lo, _w1=w1, _c1=c1):
+                row = outs[_i][g]
+                h1 = row[:_b].astype(np.float64)
+                h2 = row[_b:2 * _b].astype(np.float64)
+                bstar = int(row[2 * _b])
+                # coarse weights minus the refined bucket, plus the
+                # refined sub-bins centered inside it
+                w = h1.copy()
+                w[bstar] = 0.0
+                lo_g = _lo + bstar * _w1
+                c2 = lo_g + (np.arange(_b) + 0.5) * (_w1 / _b)
+                d = TDigest(_TDIGEST_COMPRESSION).add_weighted(_c1, w)
+                return d.add_weighted(c2, h2)
+
+            return LoweredAgg(label, sem, extract)
+
+        # fixed-bin device histogram → weighted t-digest
         i = ctx.add_op(ir.AggOp(
             "hist_fixed", vexpr=ctx.value_expr(data[0]), bins=_HIST_BINS,
             lo_param=ctx.param(np.float64(lo)), hi_param=ctx.param(np.float64(hi))))
@@ -759,48 +794,30 @@ def _occupancy_op(ctx: AggPlanContext, arg: ExpressionContext, name: str):
     return i, dictionary, card
 
 
-def _occ_ids(outs, i, g, card) -> np.ndarray:
+def _occ_row_ids(o: np.ndarray, g) -> np.ndarray:
     """Dict ids present in group g, from either occupancy form:
     - dense: (groups, card) boolean matrix → nonzero of row g
-    - sparse: sorted unique pair keys (groupKey*card + id, sentinel-padded);
-      the group's composite key is the last kernel output (keys_out) and
-      its id range is one binary search"""
-    o = outs[i]
-    # the sparse (1-D pair list) form only flows through the batch
-    # extractors (_occ_prepare via LoweredAgg.prepare); keeping a second
-    # decode here would duplicate that logic and drift
-    assert o.ndim == 2, "sparse occupancy must decode via prepare()"
+    - sparse: (slots, W) uint32 id bitmap words — little-endian bit j of
+      word w encodes dict id w*32+j"""
+    if o.dtype == np.uint32:
+        return np.nonzero(np.unpackbits(
+            np.ascontiguousarray(o[g]).view(np.uint8),
+            bitorder="little"))[0]
     return np.nonzero(o[g])[0]
 
 
+def _occ_ids(outs, i, g, card) -> np.ndarray:
+    return _occ_row_ids(outs[i], g)
+
+
 def _occ_prepare(i: int, card: int, state_fn):
-    """Batch extractor for occupancy aggs: one vectorized pass decodes the
-    sparse pair list into per-group dict-id slices; dense stays row-wise.
+    """Batch extractor for occupancy aggs; both forms decode row-wise
+    (sparse bitmap rows are already per-slot).
     state_fn(ids: np.ndarray) builds the per-group state."""
 
     def prepare(outs):
         o = outs[i]
-        if o.ndim == 2:
-            return lambda g: state_fn(np.nonzero(o[g])[0])
-        # filter ONCE (the kernel leaves unique pairs ascending with
-        # sentinel holes); the per-group ranges come from TWO vectorized
-        # binary searches over the compacted array (one scalar searchsorted
-        # per group re-promotes the operand array every call — measured
-        # 0.37ms/call, 74s at numGroupsLimit scale). The sentinel is
-        # dtype-sized: int32 pair kernels pad with 2^31-1, int64 with
-        # SPARSE_KEY_SPACE — filtering with the WRONG one leaves
-        # pad/duplicate holes inline and the array is no longer sorted
-        sent = (1 << 31) - 1 if o.dtype == np.int32 else ir.SPARSE_KEY_SPACE
-        valid = o[o < sent].astype(np.int64, copy=False)
-        bases = outs[-1].astype(np.int64) * card
-        los = np.searchsorted(valid, bases)
-        his = np.searchsorted(valid, bases + card)
-
-        def extract(g):
-            ids = valid[los[g]:his[g]] % card
-            return state_fn(ids)
-
-        return extract
+        return lambda g: state_fn(_occ_row_ids(o, g))
 
     return prepare
 

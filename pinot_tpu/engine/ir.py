@@ -235,15 +235,18 @@ class FNot(FilterNode):
 
 @dataclass(frozen=True)
 class AggOp:
-    kind: str  # count | sum | min | max | sumsq | distinct_bitmap | value_hist | hist_fixed
+    kind: str  # count | sum | min | max | sumsq | distinct_bitmap | value_hist | hist_fixed | hist_adaptive
     vexpr: Optional[ValueExpr] = None
     # distinct_bitmap / value_hist: dict-id plane slot + static cardinality
     ids_slot: Optional[int] = None
     card: Optional[int] = None
-    # hist_fixed: static bin count + runtime [lo, hi] bounds
+    # hist_fixed / hist_adaptive: static bin count + runtime [lo, hi] bounds
     bins: Optional[int] = None
     lo_param: Optional[int] = None
     hi_param: Optional[int] = None
+    # hist_adaptive: the target percentile (static) — level-2 bins refine
+    # each group's coarse bucket containing this quantile
+    pct: Optional[float] = None
     # static integer value bounds when the planner knows them (column
     # metadata / dictionary min-max) — lets integer sums skip limbs and the
     # negative-count pass in the exact i32-scatter decomposition

@@ -368,6 +368,10 @@ class SegmentPlanner(AggPlanContext):
         raise UnsupportedQueryError(f"transform function {name} not lowered to device")
 
     DICT_TRANSFORM_LIMIT = 1 << 18  # max cartesian LUT size for 2-col transforms
+    # single-column LUTs scale linearly with cardinality (no cartesian
+    # blowup): allow dimension-scale columns (LOOKUP joins over ~1M-row
+    # dim tables ride a fk-cardinality LUT)
+    DICT_TRANSFORM_LIMIT_1COL = 1 << 21
 
     def _dict_transform_expr(self, e: ExpressionContext) -> Optional[ir.ValueExpr]:
         """Numeric-valued transform over dict-encoded SV columns → evaluate
@@ -403,7 +407,9 @@ class SegmentPlanner(AggPlanContext):
             vals = np.asarray(self.segment.get_dictionary(c).values)
             infos.append((c, len(vals), vals))
             product *= len(vals)
-        if product > self.DICT_TRANSFORM_LIMIT:
+        limit = (self.DICT_TRANSFORM_LIMIT_1COL if len(infos) == 1
+                 else self.DICT_TRANSFORM_LIMIT)
+        if product > limit:
             return None
         if len(infos) == 1:
             c, _, vals = infos[0]
@@ -792,19 +798,29 @@ class SegmentPlanner(AggPlanContext):
             dense_reason = f"group cardinality product {num_groups}"
             for op in self.ops:
                 width = op.card if op.kind in ("distinct_bitmap", "value_hist") else (
-                    op.bins if op.kind == "hist_fixed" else None)
+                    op.bins if op.kind in ("hist_fixed", "hist_adaptive")
+                    else None)
                 if width is not None and num_groups * width > DENSE_GROUP_LIMIT:
                     dense_ok = False
                     dense_reason = f"{op.kind} occupancy {num_groups}x{width}"
             sparse = not dense_ok
             if sparse:
+                n_distinct = sum(1 for op in self.ops
+                                 if op.kind == "distinct_bitmap")
+                if n_distinct > 1:
+                    # one DISTINCT column rides the sort as the secondary
+                    # key; a second would need its own n-length sort
+                    raise UnsupportedQueryError(
+                        "sparse group-by supports one DISTINCT column "
+                        "(host path handles more)")
                 for op in self.ops:
                     if op.kind == "distinct_bitmap":
-                        # pair composite must stay below the kernel sentinel
-                        if num_groups * op.card >= SPARSE_KEY_LIMIT:
+                        # the sparse kernel ships per-slot dict-id bitmaps
+                        # (ceil(card/32) words/slot) — bound the width
+                        if op.card > 1024:
                             raise UnsupportedQueryError(
-                                f"distinct pair space {num_groups}x{op.card} "
-                                "exceeds the int64 composite-key space")
+                                f"sparse DISTINCTCOUNT bitmap over card "
+                                f"{op.card} > 1024 runs on the host engine")
                         continue
                     if op.kind not in _SPARSE_AGG_KINDS:
                         raise UnsupportedQueryError(

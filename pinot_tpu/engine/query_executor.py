@@ -107,6 +107,27 @@ class QueryExecutor:
         self.tables[name or schema.schema_name] = Table(
             name or schema.schema_name, schema, segments)
 
+    def add_dimension_table(self, schema: Schema, segments: list,
+                            name: Optional[str] = None) -> None:
+        """Register a queryable table that ALSO serves LOOKUP joins
+        (reference: TableConfig.isDimTable + DimensionTableDataManager —
+        dim tables replicate fully and back the LOOKUP transform). The
+        schema must declare primaryKeyColumns (single key)."""
+        import numpy as np
+
+        from .dim_tables import register_dimension_table
+
+        self.add_table(schema, segments, name)
+        if len(schema.primary_key_columns) != 1:
+            raise ValueError("dimension tables need exactly one primary key")
+        segs = self.tables[name or schema.schema_name].segments
+        cols = {}
+        for c in schema.column_names():
+            parts = [np.asarray(s.get_values(c)) for s in segs]
+            cols[c] = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        register_dimension_table(name or schema.schema_name,
+                                 schema.primary_key_columns[0], cols)
+
     def execute_sql(self, sql: str) -> BrokerResponse:
         """Engine selection mirrors the reference's
         BrokerRequestHandlerDelegate: V1 for single-table queries, V2 (MSE)

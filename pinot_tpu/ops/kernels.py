@@ -491,7 +491,8 @@ def _run_ungrouped(program: ir.Program, arrays, params, mask, n):
         if agg.kind == "count":
             outputs.append(jnp.stack([count, zero_i]))
             continue
-        if agg.kind in ("distinct_bitmap", "value_hist", "hist_fixed"):
+        if agg.kind in ("distinct_bitmap", "value_hist", "hist_fixed",
+                        "hist_adaptive"):
             # matrix shapes keep the (1 group + trash) scatter layout
             outputs.append(_run_agg(agg, arrays, params, mask,
                                     jnp.where(mask, 0, 1).astype(jnp.int32),
@@ -556,37 +557,28 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     key = jnp.where(mask, key, sentinel)
 
     # agg inputs with mask-neutral elements, computed BEFORE the sort so one
-    # lax.sort carries key + all values into group-contiguous order
+    # lax.sort carries key + all values into group-contiguous order.
+    # COUNT DISTINCT rides the SAME sort as a SECONDARY key: with dict ids
+    # sorted within each group, distinct (group, id) pairs are exactly the
+    # first-occurrence rows, and per-slot distinct counts + id bitmaps
+    # reduce on the already-computed group edges — no second n-length
+    # sort, no n-length output (the old pair-list output was ~100x the
+    # query's real bytes through a tunneled fetch and blew up compiles).
+    num_sort_keys = 1
     operands = [key]
-    specs = []  # per agg: (reduce_kind, operand index | pair array | None)
+    distinct_aggs = [a for a in program.aggs if a.kind == "distinct_bitmap"]
+    if len(distinct_aggs) > 1:
+        raise ValueError("sparse group-by supports one DISTINCT column")
+    if distinct_aggs:
+        operands.append(arrays[distinct_aggs[0].ids_slot].astype(jnp.int32))
+        num_sort_keys = 2
+    specs = []  # per agg: (reduce_kind, operand index | None[, agg])
     for agg in program.aggs:
         if agg.kind == "count":
             specs.append(("count", None))
             continue
         if agg.kind == "distinct_bitmap":
-            # COUNT DISTINCT at high group cardinality: dedupe
-            # (group, dictId) PAIRS with a second sort — the pair key is
-            # key*card + id, unique pairs sort to the front, sentinel pads
-            # the tail. Exact, device-side, O(n log n) — the dense
-            # (groups × card) occupancy matrix this replaces is the HBM
-            # blowup VERDICT weak #5 called out. Decoded on host by
-            # binary-searching each surviving group's pair range.
-            pair32 = 0 < program.key_space * agg.card < (1 << 31) - 1
-            pdtype = jnp.int32 if pair32 else jnp.int64
-            psent = (jnp.int32((1 << 31) - 1) if pair32
-                     else jnp.int64(ir.SPARSE_KEY_SPACE))
-            ids = arrays[agg.ids_slot].astype(pdtype)
-            pair = jnp.where(mask,
-                             key.astype(pdtype) * pdtype(agg.card) + ids,
-                             psent)
-            sp = jax.lax.sort(pair)
-            uniq = jnp.concatenate(
-                [jnp.ones((1,), dtype=bool), sp[1:] != sp[:-1]]) \
-                & (sp < psent)
-            # duplicates masked to the sentinel; the SURVIVING values keep
-            # ascending order, so the host filters + binary-searches without
-            # a second device sort
-            specs.append(("distinct", jnp.where(uniq, sp, psent)))
+            specs.append(("distinct", 1, agg))
             continue
         v = _eval_value(agg.vexpr, arrays, params)
         fast32 = jnp.issubdtype(v.dtype, jnp.integer) and _fits_i32(v, agg)
@@ -619,7 +611,7 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
             raise ValueError(f"agg kind {agg.kind} unsupported in sparse group-by")
         operands.append(v)
 
-    sorted_ops = jax.lax.sort(tuple(operands), num_keys=1)
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_sort_keys)
     skey = sorted_ops[0]
     valid = skey < sentinel
     first = jnp.concatenate(
@@ -657,7 +649,29 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
         if kind == "count":
             outputs.append(counts)
         elif kind == "distinct":
-            outputs.append(oi)  # sorted unique pair keys, sentinel-padded
+            agg = spec[2]
+            card = agg.card
+            sids = sorted_ops[oi]  # dict ids, sorted within each group
+            uniq = jnp.concatenate(
+                [jnp.ones((1,), dtype=bool),
+                 (skey[1:] != skey[:-1]) | (sids[1:] != sids[:-1])]) & valid
+            bit = sids.astype(jnp.uint32)
+            cols = []
+            for w in range(-(-card // 32)):
+                # each (group, id) bit appears at most once (uniq-masked),
+                # so the per-group OR equals the per-group SUM — one
+                # wrapping uint32 cumsum + edge diffs (mod-2^32 prefix
+                # differences are exact because every group sum < 2^32),
+                # instead of a log2(n)-pass segmented scan
+                val = jnp.where(uniq & ((bit >> 5) == jnp.uint32(w)),
+                                jnp.uint32(1) << (bit & jnp.uint32(31)),
+                                jnp.uint32(0))
+                pw = jnp.cumsum(val)
+                word = pw[li] - pw[fi] + val[fi]
+                cols.append(jnp.where(occupied, word, jnp.uint32(0)))
+            matrix = jnp.stack(cols, axis=1)  # (k, W) bitmap words
+            outputs.append(jnp.concatenate(
+                [matrix, jnp.zeros((1, matrix.shape[1]), jnp.uint32)]))
         elif kind == "sum_i" and not _prefix_exact_gate(sorted_ops[oi], agg):
             # unbounded int64 columns: f64 prefix DIFFS would round (the
             # per-group result must stay exact) — keep the limb scatters
@@ -769,6 +783,17 @@ def _segment_sum_exact_i64(v, gid, num_segments, n, vmin=None, vmax=None,
     return total
 
 
+def _mxu_or_scatter_counts(mask, sid, num_slots):
+    """Per-slot row counts: MXU one-hot matmul when the table fits its
+    accumulator, 32-bit scatter otherwise. Returns (num_slots,) int64."""
+    if mxu_groupby.supports(num_slots, 1):
+        return mxu_groupby.limb_sums(
+            (mask.astype(jnp.bfloat16),), sid, num_slots)[0]
+    return jax.ops.segment_sum(
+        mask.astype(jnp.int32), sid,
+        num_segments=num_slots).astype(jnp.int64)
+
+
 _I32_MAX = (1 << 31) - 1
 _I32_MIN = -(1 << 31)
 
@@ -802,20 +827,61 @@ def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n,
     if agg.kind in ("distinct_bitmap", "value_hist"):
         # per-(group, dictId) occupancy/count matrix — shipped to host so
         # distinct VALUE sets / exact value histograms (percentile, mode)
-        # can merge across segments (dict ids are segment-local)
+        # can merge across segments (dict ids are segment-local). When the
+        # (groups x card) table fits the MXU accumulator, the counts ride
+        # the one-hot matmul instead of a whole-column scatter (the
+        # scatter unit costs ~7.7ns/row — ~0.8s per 100M-row pass).
         card = agg.card
         num_groups = num_segments - 1
         ids = arrays[agg.ids_slot].astype(jnp.int32)
         sid = gid * jnp.int32(card) + ids
         sid = jnp.where(mask, sid, jnp.int32(num_groups * card))
-        occ = jax.ops.segment_sum(
-            mask.astype(jnp.int32), sid, num_segments=num_groups * card + 1
-        )
+        occ = _mxu_or_scatter_counts(mask, sid, num_groups * card + 1)
         occ = occ[: num_groups * card].reshape(num_groups, card)
-        # counts stay < 2^31 (rows per segment): scatter at 32 bits, widen
-        # after — 64-bit scatters are emulated on TPU
         return occ > 0 if agg.kind == "distinct_bitmap" else \
             occ.astype(jnp.int64)
+    if agg.kind == "hist_adaptive":
+        # percentile sketch: TWO MXU count passes replace the (groups x
+        # 2048)-slot scatter histogram. Pass 1 bins values coarsely; the
+        # per-group bucket holding the target quantile is found ON DEVICE
+        # (cumsum over the small (groups, bins) table); pass 2 re-bins the
+        # rows of exactly that bucket `bins`x finer. Effective resolution
+        # at the quantile = range/bins^2 with 2*bins+1 output words per
+        # group instead of 2048 (the reference's t-digest concentrates
+        # centroids at the tails the same way; this concentrates around
+        # the asked quantile).
+        bins = agg.bins
+        num_groups = num_segments - 1
+        v = _eval_value(agg.vexpr, arrays, params).astype(jnp.float64)
+        lo = params[agg.lo_param]
+        hi = params[agg.hi_param]
+        width1 = (hi - lo) / bins
+        b1 = jnp.clip(((v - lo) / width1).astype(jnp.int32), 0, bins - 1)
+        inside = mask & (v >= lo) & (v <= hi)
+        sid1 = jnp.where(inside, gid * jnp.int32(bins) + b1,
+                         jnp.int32(num_groups * bins))
+        h1 = _mxu_or_scatter_counts(inside, sid1, num_groups * bins + 1)
+        h1 = h1[: num_groups * bins].reshape(num_groups, bins)
+        cum = jnp.cumsum(h1, axis=1)
+        rank = cum[:, -1].astype(jnp.float64) * (agg.pct / 100.0)
+        bstar = jnp.argmax(cum.astype(jnp.float64) >= rank[:, None],
+                           axis=1).astype(jnp.int32)
+        # refine rows whose COARSE bin equals their group's target bucket
+        # (b1 equality, not float range tests: bit-identical membership)
+        bstar_pad = jnp.concatenate([bstar, jnp.zeros(1, jnp.int32)])
+        bstar_r = bstar_pad[jnp.minimum(gid, num_groups)]
+        lo_g = lo + bstar.astype(jnp.float64) * width1
+        lo_r = jnp.concatenate([lo_g, jnp.zeros(1)])[
+            jnp.minimum(gid, num_groups)]
+        width2 = width1 / bins
+        inside2 = inside & (b1 == bstar_r)
+        b2 = jnp.clip(((v - lo_r) / width2).astype(jnp.int32), 0, bins - 1)
+        sid2 = jnp.where(inside2, gid * jnp.int32(bins) + b2,
+                         jnp.int32(num_groups * bins))
+        h2 = _mxu_or_scatter_counts(inside2, sid2, num_groups * bins + 1)
+        h2 = h2[: num_groups * bins].reshape(num_groups, bins)
+        return jnp.concatenate(
+            [h1, h2, bstar.astype(jnp.int64)[:, None]], axis=1)
     if agg.kind == "hist_fixed":
         # equal-width bins over [lo, hi]; out-of-range rows are dropped
         # (reference HistogramAggregationFunction semantics)

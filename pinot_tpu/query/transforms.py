@@ -663,6 +663,25 @@ def _np_mapvalue(blob, key, default=None):
     return rowfn(f)(blob)
 
 
+def _np_lookup(table, attr, pk, keys):
+    """LOOKUP('dimTable', 'valueColumn', 'pkColumn', factKeyExpr) — the
+    dimension-table join UDF (reference: LookupTransformFunction backed by
+    DimensionTableDataManager). On the device path this never runs per
+    row: the planner evaluates it over the fact column's DICTIONARY grid,
+    so the join becomes a cardinality-sized LUT gather fused into the
+    kernel (the TPU-first broadcast join)."""
+    from ..engine.dim_tables import get_dimension_table
+
+    t = get_dimension_table(str(table))
+    if t is None:
+        raise ValueError(f"dimension table {table!r} not registered")
+    if str(pk) != t.pk_column:
+        raise ValueError(
+            f"dim table {table!r} joins on {t.pk_column!r}, not {pk!r}")
+    vals, _found = t.lookup(str(attr), np.asarray(keys))
+    return vals
+
+
 def _np_jsonextractkey(blob, path):
     def f(x):
         try:
@@ -878,6 +897,8 @@ TRANSFORMS: dict[str, TransformDef] = {
     "sha512": TransformDef(_hashfn("sha512")),
     "crc32": TransformDef(rowfn(
         lambda s: zlib.crc32(s if isinstance(s, bytes) else _sstr(s).encode()))),
+    # -- lookup join --------------------------------------------------------
+    "lookup": TransformDef(_np_lookup),
     # -- map ----------------------------------------------------------------
     "mapvalue": TransformDef(_np_mapvalue),
     "map_value": TransformDef(_np_mapvalue),

@@ -161,7 +161,7 @@ def test_tdigest_high_card_dict_column_stays_on_device(tmp_path):
            "GROUP BY day LIMIT 1000")
     plan = SegmentPlanner(parse_sql(sql), seg).plan()
     kinds = {op.kind for op in plan.program.aggs}
-    assert "hist_fixed" in kinds and "value_hist" not in kinds
+    assert kinds & {"hist_fixed", "hist_adaptive"} and "value_hist" not in kinds
 
     tpu = QueryExecutor(backend="tpu")
     tpu.add_table(schema, [seg])
@@ -287,3 +287,41 @@ def test_long_timestamp_aggregates_exact(tmp_path, rng):
         r = qe.execute_sql("SELECT MIN(big), MAX(neg) FROM tl")
         assert r.result_table.rows[0][0] == float(cols["big"].min())
         assert r.result_table.rows[0][1] == float(cols["neg"].max())
+
+
+def test_adaptive_hist_percentile_accuracy(tmp_path):
+    """The two-level adaptive device histogram (kernels "hist_adaptive")
+    must land p95 within the refined resolution (range/bins^2 around the
+    target bucket), far tighter than one coarse pass."""
+    rng = np.random.default_rng(3)
+    n = 300_000
+    schema = Schema.build(
+        "tx", dimensions=[("day", "INT")], metrics=[("fare", "DOUBLE")])
+    cols = {"day": rng.integers(0, 50, n).astype(np.int32),
+            "fare": np.round(rng.gamma(3.0, 9.0, n), 2)}
+    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+    cfg = TableConfig(table_name="tx", indexing=IndexingConfig(
+        no_dictionary_columns=["fare"]))
+    SegmentBuilder(schema, cfg, "tx0").build(cols, tmp_path / "tx0")
+    seg = load_segment(tmp_path / "tx0")
+
+    from pinot_tpu.engine.plan import SegmentPlanner
+    from pinot_tpu.query.parser.sql import parse_sql
+
+    sql = "SELECT day, PERCENTILETDIGEST(fare, 95) FROM tx GROUP BY day LIMIT 100"
+    plan = SegmentPlanner(parse_sql(sql), seg).plan()
+    assert {op.kind for op in plan.program.aggs} == {"hist_adaptive"}
+
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, [seg])
+    r = tpu.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    got = {int(row[0]): float(row[1]) for row in r.result_table.rows}
+    span = cols["fare"].max() - cols["fare"].min()
+    bins = next(op.bins for op in plan.program.aggs)
+    tol = 2 * span / (bins * bins)  # refined bucket width, with interp slack
+    for day in (0, 17, 49):
+        vals = np.sort(cols["fare"][cols["day"] == day])
+        exact = float(vals[int(len(vals) * 0.95)])
+        assert abs(got[day] - exact) <= tol, (day, got[day], exact, tol)
