@@ -159,7 +159,12 @@ class Broker:
         return plan
 
     # -- query --------------------------------------------------------------
-    def execute_sql(self, sql: str) -> BrokerResponse:
+    def execute_sql(self, sql: str,
+                    segments: Optional[dict] = None) -> BrokerResponse:
+        """``segments``: optional {tableNameWithType: [segment, ...]}
+        restriction — the connector's segment-parallel scan plane
+        (reference: the Spark connector dispatches per-segment reads with
+        an explicit searchSegments list)."""
         t0 = time.perf_counter()
         try:
             query = parse_sql(sql)
@@ -189,7 +194,7 @@ class Broker:
         except QueryQuotaExceededError as e:
             return BrokerResponse(exceptions=[f"QueryQuotaExceededError: {e}"])
         try:
-            resp = self._execute(query)
+            resp = self._execute(query, only_segments=segments)
         except Exception as e:
             return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
@@ -308,7 +313,8 @@ class Broker:
         return BrokerResponse(
             exceptions=[f"table {raw} not found or has no routable segments"])
 
-    def _execute(self, query: QueryContext) -> BrokerResponse:
+    def _execute(self, query: QueryContext,
+                 only_segments: Optional[dict] = None) -> BrokerResponse:
         raw = raw_table_name(query.table_name)
         offline = table_name_with_type(raw, "OFFLINE")
         realtime = table_name_with_type(raw, "REALTIME")
@@ -341,7 +347,9 @@ class Broker:
                      "num_segments_pruned": 0, "num_segments_queried": 0}
         for name_with_type, extra_filter in halves:
             sub = _with_filter(query, name_with_type, extra_filter)
-            results = self._scatter_gather(name_with_type, sub, stats_sum)
+            results = self._scatter_gather(
+                name_with_type, sub, stats_sum,
+                only_segments=(only_segments or {}).get(name_with_type))
             all_results.extend(results)
 
         combined = self._merge(query, all_results)
@@ -357,8 +365,11 @@ class Broker:
                                              False),
         )
 
-    def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict):
+    def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict,
+                        only_segments: Optional[list] = None):
         routing = self.routing_table(table)
+        if only_segments is not None:
+            routing = {s: routing[s] for s in only_segments if s in routing}
         if not routing:
             return []
         stats_sum["num_segments_queried"] += len(routing)

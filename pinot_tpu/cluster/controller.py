@@ -329,7 +329,16 @@ class ClusterController:
                   "segments_changed": len(changed)}
         if dry_run:
             return result
+        return dict(result, **self._apply_target_safely(
+            name_with_type, target, changed, min_available_replicas,
+            ev_timeout_s, moves))
 
+    def _apply_target_safely(self, name_with_type: str, target: dict,
+                             changed: list, min_available_replicas: int,
+                             ev_timeout_s: float, moves: int) -> dict:
+        """Two-phase ideal-state convergence shared by rebalance and the
+        tier relocator: ADD target replicas, wait for the external view,
+        then REMOVE departing ones — availability never dips."""
         for seg in target:
             if len(target[seg]) < min_available_replicas:
                 raise RuntimeError(
@@ -345,7 +354,7 @@ class ClusterController:
         if not changed:
             job["status"] = "DONE"
             self.store.set(job_path, job)
-            return dict(result, jobId=job_id, status="DONE")
+            return {"jobId": job_id, "status": "DONE"}
 
         # phase 1: additive union — nothing is ever removed here, so
         # availability only grows. Segments deleted concurrently (retention,
@@ -399,10 +408,102 @@ class ClusterController:
         job.update(status="DONE", segmentsDone=len(changed),
                    finishedMs=int(time.time() * 1000))
         self.store.set(job_path, job)
-        return dict(result, jobId=job_id, status="DONE")
+        return {"jobId": job_id, "status": "DONE"}
 
     def rebalance_status(self, name_with_type: str) -> Optional[dict]:
         return self.store.get(f"/REBALANCE/{name_with_type}")
+
+    # -- tiered storage ------------------------------------------------------
+    @staticmethod
+    def _parse_age_ms(age: str) -> int:
+        """'7d' / '12h' / '30m' / bare ms (reference TierConfig segmentAge
+        TimeUtils period format)."""
+        age = str(age).strip().lower()
+        mult = {"d": 86_400_000, "h": 3_600_000, "m": 60_000, "s": 1000}
+        if age and age[-1] in mult:
+            return int(float(age[:-1]) * mult[age[-1]])
+        return int(age)
+
+    def _tier_for_segment(self, cfg: dict, seg: str, meta: dict,
+                          now_ms: int) -> Optional[dict]:
+        """First matching tier config wins (reference TierConfigUtils
+        ordering). Selectors: 'time' (segment end time older than
+        segmentAge) and 'fixed' (explicit segment list)."""
+        tiers = cfg.get("tierConfigs") or []
+        # oldest-threshold tier first, so a segment past several thresholds
+        # lands on the coldest matching tier (reference TierConfigUtils
+        # comparator)
+        def age_of(t):
+            return self._parse_age_ms(t.get("segmentAge",
+                                            t.get("segmentAgeMs", "0d")))
+
+        for tier in sorted(tiers, key=lambda t: -age_of(t)):
+            sel = str(tier.get("segmentSelectorType", "time")).lower()
+            if sel == "fixed":
+                if seg in (tier.get("segmentList") or []):
+                    return tier
+            else:
+                end = meta.get("endTimeMs") or meta.get("pushTimeMs")
+                if end is not None and now_ms - int(end) >= age_of(tier):
+                    return tier
+        return None
+
+    def relocate_tiers(self, name_with_type: str, dry_run: bool = False,
+                       now_ms: Optional[int] = None,
+                       min_available_replicas: int = 1,
+                       ev_timeout_s: float = 30.0) -> dict:
+        """Move segments whose tier selector matches onto the tier's
+        tagged servers (reference: SegmentRelocator — relocate ONLINE
+        segments to tiers via a tier-aware rebalance, at most one replica
+        unavailable). Uses the same safe two-phase apply as rebalance."""
+        cfg = self.table_config(name_with_type)
+        if cfg is None:
+            raise KeyError(name_with_type)
+        if not cfg.get("tierConfigs"):
+            return {"table": name_with_type, "moves": 0, "status": "DONE"}
+        now_ms = now_ms or int(time.time() * 1000)
+        replication = int(cfg.get("replication", 1))
+        ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
+        live = set(self.live_instances())
+        load: dict[str, int] = {}
+        for seg_map in ideal.values():
+            for inst in seg_map:
+                load[inst] = load.get(inst, 0) + 1
+        target: dict[str, dict] = {}
+        tiers_of: dict[str, Optional[str]] = {}
+        moves = 0
+        for seg in sorted(ideal):
+            if CONSUMING in ideal[seg].values():
+                target[seg] = dict(ideal[seg])
+                continue
+            meta = self.segment_metadata(name_with_type, seg) or {}
+            tier = self._tier_for_segment(cfg, seg, meta, now_ms)
+            tag = (tier or {}).get("serverTag") or cfg.get("serverTag")
+            tiers_of[seg] = (tier or {}).get("name")
+            candidates = [i for i in self.list_instances(tag) if i in live]
+            if len(candidates) < replication:
+                raise RuntimeError(
+                    f"tier {tag!r} has {len(candidates)} live servers, "
+                    f"need {replication} for {seg}")
+            keep = [i for i in ideal[seg] if i in candidates][:replication]
+            chosen = list(keep)
+            while len(chosen) < replication:
+                pick = min((i for i in candidates if i not in chosen),
+                           key=lambda i: (load.get(i, 0), i))
+                chosen.append(pick)
+                load[pick] = load.get(pick, 0) + 1
+                moves += 1
+            target[seg] = {i: ONLINE for i in chosen}
+        changed = [s for s in sorted(ideal)
+                   if set(target.get(s, {})) != set(ideal[s])]
+        result = {"table": name_with_type, "moves": moves,
+                  "segments_changed": len(changed), "tiers": tiers_of,
+                  "target": target}
+        if dry_run:
+            return result
+        return dict(result, **self._apply_target_safely(
+            name_with_type, target, changed, min_available_replicas,
+            ev_timeout_s, moves))
 
     # -- retention ----------------------------------------------------------
     def run_retention(self, now_ms: Optional[int] = None) -> list[str]:

@@ -227,61 +227,32 @@ class SegmentLineageManager:
 
 class SegmentRelocator:
     """Moves aged segments onto their tier's servers (reference:
-    SegmentRelocator + TierConfig: segmentAge-based tier selection).
-    Table config: tierConfigs: [{"name", "segmentAgeMs", "serverTag"}],
-    most-specific (oldest threshold) tier wins."""
+    SegmentRelocator + TierConfig). Delegates to the controller's
+    tier-aware safe relocation (controller.relocate_tiers: two-phase
+    ideal-state convergence, availability never dips — "allow at most one
+    replica unavailable during rebalance"). Tier configs accept the
+    reference shape ({"name", "segmentSelectorType", "segmentAge",
+    "serverTag", "segmentList"}) and the legacy segmentAgeMs key."""
 
     def __init__(self, controller: ClusterController):
         self.controller = controller
 
     def __call__(self) -> dict:
         moves = {}
-        now = int(time.time() * 1000)
         for table in self.controller.store.children("/CONFIGS/TABLE"):
             cfg = self.controller.table_config(table) or {}
-            tiers = cfg.get("tierConfigs") or []
-            if not tiers:
+            if not cfg.get("tierConfigs"):
                 continue
-            tiers = sorted(tiers, key=lambda t: -int(t["segmentAgeMs"]))
-            moved = self._relocate_table(table, cfg, tiers, now)
-            if moved:
-                moves[table] = moved
+            try:
+                res = self.controller.relocate_tiers(table)
+            except (RuntimeError, TimeoutError):
+                continue  # tier servers down: retry on the next cycle
+            if res.get("segments_changed"):
+                moved = [(seg, res["tiers"].get(seg))
+                         for seg in sorted(res["target"])
+                         if res["tiers"].get(seg) is not None]
+                moves[table] = [(s, t) for s, t in moved]
         return moves
-
-    def _relocate_table(self, table: str, cfg: dict, tiers: list,
-                        now: int) -> list:
-        store = self.controller.store
-        live = set(self.controller.live_instances())
-        moved = []
-        for seg in store.children(f"/SEGMENTS/{table}"):
-            meta = store.get(f"/SEGMENTS/{table}/{seg}") or {}
-            end = meta.get("endTimeMs") or meta.get("pushTimeMs")
-            if end is None:
-                continue
-            age = now - int(end)
-            tier = next((t for t in tiers if age >= int(t["segmentAgeMs"])), None)
-            if tier is None:
-                continue
-            targets = [i for i in self.controller.list_instances(tier["serverTag"])
-                       if i in live]
-            if not targets:
-                continue
-            replication = int(cfg.get("replication", 1))
-            want = sorted(targets)[:replication]
-
-            def upd(ideal, _seg=seg, _want=want):
-                ideal = ideal or {}
-                cur = ideal.get(_seg, {})
-                if set(cur) != set(_want):
-                    ideal[_seg] = {i: ONLINE for i in _want}
-                return ideal
-
-            before = store.get(f"/IDEALSTATES/{table}") or {}
-            store.update(f"/IDEALSTATES/{table}", upd)
-            after = store.get(f"/IDEALSTATES/{table}") or {}
-            if before.get(seg) != after.get(seg):
-                moved.append((seg, tier["name"]))
-        return moved
 
 
 def build_default_scheduler(store: PropertyStore, controller: ClusterController,

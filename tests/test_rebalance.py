@@ -238,3 +238,63 @@ def test_sticky_instance_partitions(tmp_path):
                 s.stop()
             except Exception:
                 pass
+
+
+def test_tier_relocation_safe(tmp_path):
+    """Aged segments relocate to cold-tier servers via the safe two-phase
+    path, under continuous queries with zero failures (reference:
+    SegmentRelocator + TierConfig)."""
+    import time as _time
+
+    store = PropertyStore()
+    controller = ClusterController(store)
+    hot = [ServerInstance(store, f"H{i}", backend="host",
+                          tags=["hot", "DefaultTenant"]) for i in range(2)]
+    cold = [ServerInstance(store, f"C{i}", backend="host",
+                           tags=["cold"]) for i in range(2)]
+    servers = hot + cold
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    try:
+        controller.add_schema(SCHEMA.to_json())
+        now = int(_time.time() * 1000)
+        table = controller.create_table({
+            "tableName": "stats", "replication": 2, "serverTag": "hot",
+            "tierConfigs": [{"name": "coldTier", "segmentSelectorType": "time",
+                             "segmentAge": "7d", "serverTag": "cold"}]})
+        for i, age_days in enumerate([1, 2, 30, 40]):
+            path, _ = _build_segment(tmp_path, f"s{i}", seed=i)
+            controller.add_segment(table, f"s{i}", {
+                "location": path, "numDocs": 400,
+                "endTimeMs": now - age_days * 86_400_000})
+        ideal0 = store.get(f"/IDEALSTATES/{table}")
+        assert all(set(m) <= {"H0", "H1"} for m in ideal0.values())
+
+        failures = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                r = broker.execute_sql("SELECT COUNT(*) FROM stats")
+                if r.exceptions or r.result_table.rows[0][0] != 1600:
+                    failures.append(r.exceptions or r.result_table.rows)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        res = controller.relocate_tiers(table)
+        stop.set()
+        t.join(timeout=10)
+        assert res["status"] == "DONE" and res["moves"] == 4  # 2 segs x 2 reps
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert set(ideal["s2"]) <= {"C0", "C1"}, ideal["s2"]
+        assert set(ideal["s3"]) <= {"C0", "C1"}
+        assert set(ideal["s0"]) <= {"H0", "H1"}
+        assert not failures, failures[:2]
+        # idempotent: second run moves nothing
+        res2 = controller.relocate_tiers(table)
+        assert res2["moves"] == 0
+    finally:
+        stop.set()
+        for s in servers:
+            s.stop()
