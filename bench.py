@@ -55,7 +55,9 @@ CONFIGS = [c for c in os.environ.get(
     "BENCH_CONFIGS", "q1,q2,q3,q4,q5,q6,q7").split(",") if c]
 ROOT = Path(__file__).parent
 CACHE = ROOT / ".bench_cache"
-PARTIAL = ROOT / ".bench_partial"
+# smoke/dev runs point this elsewhere (BENCH_PARTIAL_DIR) so they never
+# overwrite the committed record of the last real TPU run
+PARTIAL = Path(os.environ.get("BENCH_PARTIAL_DIR", ROOT / ".bench_partial"))
 V5E_HBM_PEAK = 819e9  # bytes/s
 
 Q1 = ("SELECT SUM(lo_extendedprice) FROM {t} WHERE d_year = 1993 "
@@ -286,6 +288,10 @@ def _emit(results, platform, notes, skipped, final=False):
                        for kk, vv in v.items()} for k, v in results.items()},
         "rows": ROWS,
         "host_threads": os.cpu_count() or 1,
+        # this machine exposes ONE core to Python (os.cpu_count()=1), so
+        # the numpy host engine baseline is inherently single-threaded
+        # here — compare rows/s + roofline fractions, not just speedup
+        "host_baseline": f"numpy engine, {os.cpu_count() or 1} core(s)",
         "platform": platform,
         "final": final,
     }

@@ -1,0 +1,133 @@
+"""Device sort-merge equi-join for large MSE intermediates.
+
+Reference analogue: HashJoinOperator
+(pinot-query-runtime/.../runtime/operator/HashJoinOperator.java) builds a
+host hash table per worker. Hash tables are hostile to a TPU's vector
+units; the TPU-first shape is sort + vectorized binary search — the same
+machinery the sparse group-by kernel rides:
+
+    rs            = sort(right_keys, iota)          one lax.sort
+    starts, ends  = searchsorted(rs, left_keys)     log-passes, vectorized
+    expansion     = searchsorted(cumsum(counts), j) one output row per match
+
+Only the JOIN KEYS travel to the device (already dict-coded to int64 by
+the host join's joint-code pass); the result is (left_idx, right_idx)
+pairs, and payload columns gather on host. Output is capped at a static
+bucket so compiled programs are shared; overflow reports back for the
+THROW/BREAK join guards.
+
+Gating: ``PINOT_TPU_DEVICE_JOIN`` = auto (default: on when a non-CPU jax
+backend is live and the sides are large) | 1 (force) | 0 (off).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+# below this many total key rows the host numpy argsort wins (device
+# dispatch + transfer overhead dominates)
+AUTO_MIN_ROWS = 4_000_000
+
+
+def _bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return max(b, 1024)
+
+
+@functools.cache
+def _jit_join_kernel():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # engine-wide invariant
+    # ln/rn are TRACED scalars: only the padded bucket shapes and the
+    # output cap are static, so compiled programs are shared across the
+    # actual row counts (a static ln would recompile per input size,
+    # defeating the bucket padding)
+    return functools.partial(jax.jit, static_argnames=("max_out",))(
+        _join_kernel)
+
+
+def _join_kernel(lk, rk, ln, rn, max_out: int):
+    import jax
+    import jax.numpy as jnp
+
+    SENT = jnp.int64(1 << 62)
+    lvalid = jnp.arange(lk.shape[0]) < ln
+    rvalid = jnp.arange(rk.shape[0]) < rn
+    lkm = jnp.where(lvalid, lk, SENT)
+    rkm = jnp.where(rvalid, rk, SENT)
+    rs_keys, rs_idx = jax.lax.sort(
+        (rkm, jnp.arange(rk.shape[0], dtype=jnp.int32)), num_keys=1)
+    starts = jnp.searchsorted(rs_keys, lkm, side="left")
+    ends = jnp.searchsorted(rs_keys, lkm, side="right")
+    counts = jnp.where(lvalid, ends - starts, 0)
+    incl = jnp.cumsum(counts)
+    total = incl[-1]
+    excl = incl - counts
+    j = jnp.arange(max_out)
+    li = jnp.searchsorted(incl, j, side="right")
+    li_c = jnp.minimum(li, lk.shape[0] - 1)
+    ri = rs_idx[jnp.minimum(starts[li_c] + (j - excl[li_c]),
+                            rk.shape[0] - 1)]
+    valid_out = j < jnp.minimum(total, max_out)
+    return (jnp.where(valid_out, li_c, -1).astype(jnp.int32),
+            jnp.where(valid_out, ri, -1).astype(jnp.int32),
+            total.astype(jnp.int64))
+
+
+def device_join_indices(lcodes: np.ndarray, rcodes: np.ndarray,
+                        max_out: int):
+    """(lidx, ridx, total) for the INNER equi-join of two int64 key
+    arrays. ``total`` is the TRUE match count; at most ``max_out`` pairs
+    are returned (ascending left order, right order within a left row
+    following the right side's sort)."""
+    ln, rn = len(lcodes), len(rcodes)
+    lk = np.full(_bucket(ln), 0, dtype=np.int64)
+    rk = np.full(_bucket(rn), 0, dtype=np.int64)
+    lk[:ln] = lcodes
+    rk[:rn] = rcodes
+    li, ri, total = _jit_join_kernel()(
+        lk, rk, np.int64(ln), np.int64(rn), max_out=_bucket(max_out))
+    total = int(total)
+    n = min(total, max_out)
+    return np.asarray(li)[:n], np.asarray(ri)[:n], total
+
+
+_FAILED = False
+
+
+def note_failure(exc: BaseException) -> None:
+    """Log the first device-join failure and disable the path for the
+    process — a persistent misconfiguration must be visible, not a silent
+    per-join failed attempt."""
+    global _FAILED
+    if not _FAILED:
+        _FAILED = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "device join failed (%s: %s); falling back to the host join "
+            "for this process", type(exc).__name__, exc)
+
+
+def enabled(ln: int, rn: int) -> bool:
+    if _FAILED:
+        return False
+    mode = os.environ.get("PINOT_TPU_DEVICE_JOIN", "auto").lower()
+    if mode in ("0", "off", "false"):
+        return False
+    if mode in ("1", "on", "force", "true"):
+        return True
+    if ln + rn < AUTO_MIN_ROWS:
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False

@@ -445,24 +445,43 @@ def op_join(left: Block, right: Block, join_type: str,
         [np.asarray(left[k]) for k in left_keys],
         [np.asarray(right[k]) for k in right_keys], ln, rn)
 
-    rs = np.argsort(rcodes, kind="stable")
-    sorted_r = rcodes[rs]
-    starts = np.searchsorted(sorted_r, lcodes, "left")
-    ends = np.searchsorted(sorted_r, lcodes, "right")
-    counts = ends - starts
-    total = int(counts.sum())
-    cap = _guard_join_rows(total, ln, rn, join_type)
-    if cap is not None:
-        # BREAK: keep whole left rows up to the cap (partial result)
-        keep = np.searchsorted(np.cumsum(counts), cap, "right")
-        counts = counts[:keep]
-        starts = starts[:keep]
-        ln = keep
-        left = take_block(left, np.arange(keep))
+    lidx = ridx = None
+    from . import device_join
+
+    if device_join.enabled(ln, rn):
+        # large sides: the sort + binary-search runs on the accelerator
+        # (mse/device_join.py); only int64 key codes travel. Overflow
+        # (or any device hiccup) falls back to the host path, which owns
+        # the THROW/BREAK guard semantics.
+        try:
+            li, ri, total = device_join.device_join_indices(
+                lcodes, rcodes, MAX_ROWS_IN_JOIN)
+            if total <= MAX_ROWS_IN_JOIN:
+                lidx = li.astype(np.int64)
+                ridx = ri.astype(np.int64)
+        except Exception as e:
+            device_join.note_failure(e)  # logged once, then host path
+            lidx = ridx = None
+
+    if lidx is None:
+        rs = np.argsort(rcodes, kind="stable")
+        sorted_r = rcodes[rs]
+        starts = np.searchsorted(sorted_r, lcodes, "left")
+        ends = np.searchsorted(sorted_r, lcodes, "right")
+        counts = ends - starts
         total = int(counts.sum())
-    lidx = np.repeat(np.arange(ln), counts)
-    offs = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
-    ridx = rs[np.repeat(starts, counts) + offs]
+        cap = _guard_join_rows(total, ln, rn, join_type)
+        if cap is not None:
+            # BREAK: keep whole left rows up to the cap (partial result)
+            keep = np.searchsorted(np.cumsum(counts), cap, "right")
+            counts = counts[:keep]
+            starts = starts[:keep]
+            ln = keep
+            left = take_block(left, np.arange(keep))
+            total = int(counts.sum())
+        lidx = np.repeat(np.arange(ln), counts)
+        offs = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
+        ridx = rs[np.repeat(starts, counts) + offs]
 
     if residual is not None and total:
         combined = _combine(left, right, lidx, ridx)

@@ -217,6 +217,9 @@ def test_committer_crash_reelection_end_to_end(registry, tmp_path):
         survivor = rec["committer"]
         surv_mgr = a if survivor == "A" else b
         assert wait_until(lambda: _total_rows(surv_mgr) >= 40)
+        # the DONE store record lands before the committer's local
+        # _committed list update (separate thread) — wait, don't race it
+        assert wait_until(lambda: surv_mgr._committed)
         committed = surv_mgr._committed[0]
         # all 50 published rows: end criteria is checked after the batch
         assert committed.num_docs == 50
