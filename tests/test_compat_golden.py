@@ -1,0 +1,63 @@
+"""Compatibility verifier: GOLDEN artifacts written by past code must keep
+decoding on current code (reference: compatibility-verifier/compCheck.sh —
+old-writer/new-reader across a rolling upgrade). The fixtures under
+tests/golden/ are committed bytes; REGENERATING them defeats the test."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+GOLDEN = Path(__file__).parent / "golden"
+
+
+def test_golden_datatables_decode():
+    from pinot_tpu.cluster import datatable as dt
+    from pinot_tpu.engine.results import (AggIntermediate,
+                                          GroupByIntermediate,
+                                          SelectionIntermediate)
+    from pinot_tpu.utils.sketches import HyperLogLog, TDigest
+
+    combined, stats = dt.decode(
+        (GOLDEN / "datatable_v2_groupdict.bin").read_bytes())
+    assert isinstance(combined, GroupByIntermediate)
+    assert stats["total_docs"] == 20
+    assert combined.num_docs_scanned == 12
+    g = combined.groups
+    assert g[("x", 1)][0] == 5 and isinstance(g[("x", 1)][1], HyperLogLog)
+    assert g[("y", 2)][0] == 7 and isinstance(g[("y", 2)][1], TDigest)
+    assert 2 <= g[("x", 1)][1].cardinality() <= 4  # 3 distinct values
+    assert abs(g[("y", 2)][1].quantile(0.5) - 2.0) < 0.6
+
+    agg, stats = dt.decode((GOLDEN / "datatable_v2_agg.bin").read_bytes())
+    assert isinstance(agg, AggIntermediate)
+    assert agg.states[0] == 3.5 and agg.states[1] == frozenset({"a", "b"})
+
+    sel, _ = dt.decode((GOLDEN / "datatable_v2_selection.bin").read_bytes())
+    assert isinstance(sel, SelectionIntermediate)
+    assert sel.columns == ["c1", "c2"] and len(sel.rows) == 2
+
+
+def test_golden_segment_loads_and_queries():
+    from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.spi.data_types import Schema
+
+    seg = load_segment(GOLDEN / "segment_v3")
+    expect = np.load(GOLDEN / "segment_expected.npz")
+    assert seg.num_docs == 200
+    schema = Schema.build(
+        "golden",
+        dimensions=[("s", "STRING"), ("i", "INT"), ("mv", "INT", False)],
+        metrics=[("d", "DOUBLE"), ("l", "LONG")])
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [seg])
+    r = qe.execute_sql(
+        "SELECT SUM(i), SUM(d), SUM(l), DISTINCTCOUNT(s) FROM golden")
+    assert not r.exceptions, r.exceptions
+    row = r.result_table.rows[0]
+    assert row[0] == int(expect["i_sum"])
+    assert abs(row[1] - float(expect["d_sum"])) < 1e-6
+    assert row[2] == int(expect["l_sum"])
+    assert row[3] == int(expect["s_card"])
