@@ -298,6 +298,10 @@ class ControllerRestServer(_RestServer):
                     "instances": srv.controller.list_instances(),
                     "live": srv.controller.live_instances()})),
                 (r"/cluster/summary", lambda h, m, q: srv._summary()),
+                (r"/tables/([^/]+)/rebalanceStatus",
+                 lambda h, m, q: srv._rebalance_status(m.group(1))),
+                (r"/tables/([^/]+)/instancePartitions",
+                 lambda h, m, q: srv._instance_partitions(m.group(1))),
                 (r"/", lambda h, m, q: srv._home_page()),
             ]
             routes_post = [
@@ -309,7 +313,14 @@ class ControllerRestServer(_RestServer):
                  lambda h, m, q: srv._add_segment(m.group(1), m.group(2), h._body())),
                 (r"/tables/([^/]+)/rebalance",
                  lambda h, m, q: (200, srv.controller.rebalance(
-                     m.group(1), dry_run=q.get("dryRun", ["false"])[0] == "true"))),
+                     table_name_with_type(m.group(1)),
+                     dry_run=q.get("dryRun", ["false"])[0] == "true"))),
+                (r"/tables/([^/]+)/relocate",
+                 lambda h, m, q: (200, srv.controller.relocate_tiers(
+                     table_name_with_type(m.group(1)),
+                     dry_run=q.get("dryRun", ["false"])[0] == "true"))),
+                (r"/tables/([^/]+)/instancePartitions",
+                 lambda h, m, q: srv._assign_instances(m.group(1), h._body())),
             ]
             routes_delete = [
                 (r"/tables/([^/]+)",
@@ -359,6 +370,22 @@ class ControllerRestServer(_RestServer):
     def _drop_segment(self, table: str, segment: str):
         self.controller.drop_segment(table_name_with_type(table), segment)
         return 200, {"status": f"segment {segment} dropped"}
+
+    def _rebalance_status(self, table: str):
+        st = self.controller.rebalance_status(table_name_with_type(table))
+        return (200, st) if st else (404, {"error": "no rebalance recorded"})
+
+    def _instance_partitions(self, table: str):
+        ip = self.controller.instance_partitions(table_name_with_type(table))
+        return (200, ip) if ip else (404, {"error": "no instance partitions"})
+
+    def _assign_instances(self, table: str, body: dict):
+        ip = self.controller.configure_instance_partitions(
+            table_name_with_type(table),
+            int(body["numReplicaGroups"]),
+            instances_per_group=body.get("instancesPerReplicaGroup"),
+            num_partitions=body.get("numPartitions"))
+        return 200, ip
 
     # -- cluster summary / minimal UI (reference: controller UI's cluster
     # manager pages, served as data here) ----------------------------------

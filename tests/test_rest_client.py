@@ -173,3 +173,60 @@ def test_segment_parallel_scan(tmp_path):
     finally:
         for s in servers:
             s.stop()
+
+
+def test_rest_rebalance_and_instance_partitions(tmp_path):
+    """REST surface for instance partitions, rebalance status, tier
+    relocation (reference: controller resources under /tables/...)."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from pinot_tpu.cluster import ClusterController, PropertyStore, ServerInstance
+    from pinot_tpu.cluster.rest import ControllerRestServer
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi.data_types import Schema
+
+    schema = Schema.build("rst", dimensions=[("k", "INT")], metrics=[("v", "INT")])
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"S{i}", backend="host") for i in range(4)]
+    for s in servers:
+        s.start()
+    rest = ControllerRestServer(controller)
+    try:
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                rest.url + path, method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        call("POST", "/schemas", schema.to_json())
+        call("POST", "/tables", {"tableName": "rst", "replication": 2})
+        rng = np.random.default_rng(0)
+        for i in range(4):
+            cols = {"k": rng.integers(0, 5, 100).astype(np.int32),
+                    "v": rng.integers(0, 9, 100).astype(np.int32)}
+            SegmentBuilder(schema, segment_name=f"r{i}").build(cols, tmp_path / f"r{i}")
+            call("POST", f"/segments/rst/r{i}",
+                 {"location": str(tmp_path / f"r{i}"), "numDocs": 100})
+
+        ip = call("POST", "/tables/rst/instancePartitions",
+                  {"numReplicaGroups": 2})
+        assert len(ip["replicaGroups"]) == 2
+        assert call("GET", "/tables/rst/instancePartitions") == ip
+
+        res = call("POST", "/tables/rst/rebalance")
+        assert res["status"] == "DONE"
+        st = call("GET", "/tables/rst/rebalanceStatus")
+        assert st["status"] == "DONE"
+
+        rel = call("POST", "/tables/rst/relocate")
+        assert rel["status"] == "DONE" and rel["moves"] == 0  # no tiers
+    finally:
+        rest.close()
+        for s in servers:
+            s.stop()
