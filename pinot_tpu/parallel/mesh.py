@@ -129,6 +129,10 @@ def run_program_row_sharded(program: ir.Program, arrays: tuple, params: tuple,
         # keyed (sorted) outputs can't psum-merge across shards; the caller
         # runs sparse programs whole-segment and merges at combine instead
         raise ValueError("sparse group-by does not row-shard; run unsharded")
+    if any(op.kind == "hist_adaptive" for op in program.aggs):
+        # each shard refines a DIFFERENT per-group bucket (data-dependent),
+        # so the refined histograms are not psum-mergeable
+        raise ValueError("adaptive histograms do not row-shard; run unsharded")
     if program.mv_group_slot is not None:
         # the MV expansion's trailing scanned-docs output has no psum merge
         # wired; run whole-segment (matrix planes also shard per-doc rows

@@ -113,3 +113,24 @@ def test_row_sharded_value_hist_percentile(segment):
         plan.program, sharded_arrays, params, segment.num_docs, view.padded, mesh, plan.slots)
     for a, b in zip(single, sharded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_row_sharded_adaptive_hist_rejected(segment):
+    """hist_adaptive refines a data-dependent per-shard bucket — it must
+    refuse to row-shard (callers run it whole-segment)."""
+    import pytest
+
+    from pinot_tpu.engine import ir
+    from pinot_tpu.engine.plan import SegmentPlanner
+    from pinot_tpu.query.parser.sql import parse_sql
+
+    from pinot_tpu.engine import ir as _ir
+
+    program = _ir.Program(
+        mode="group_by", filter=None, group_slots=(0,), group_strides=(1,),
+        num_groups=10,
+        aggs=(_ir.AggOp("hist_adaptive", vexpr=_ir.Col(1), bins=8,
+                        lo_param=0, hi_param=1, pct=95.0),))
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="adaptive"):
+        run_program_row_sharded(program, (), (), 0, 8, mesh)
