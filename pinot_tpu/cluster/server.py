@@ -30,6 +30,7 @@ class ServerInstance:
         self.store = store
         self.instance_id = instance_id
         self.tags = tags or ["DefaultTenant"]
+        self.backend = backend
         self.executor = QueryExecutor(backend=backend)
         # admission control in front of execution (reference:
         # QueryScheduler.submit, fcfs default policy)
@@ -129,6 +130,22 @@ class ServerInstance:
         schema = Schema.from_json(schema_json)
         segments = list(self.segments[table].values())
         cfg = self.store.get(f"/CONFIGS/TABLE/{table}") or {}
+        if cfg.get("warmOnLoad") and self.backend != "host":
+            # pre-upload column planes to HBM off the convergence thread
+            # (reference: segment preload on load — first query skips H2D)
+            import threading as _threading
+
+            from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+
+            def _warm(segs=list(segments)):
+                for seg in segs:
+                    try:
+                        GLOBAL_DEVICE_CACHE.warm(seg)
+                    except Exception:
+                        return  # no accelerator / transient: queries warm lazily
+
+            _threading.Thread(target=_warm, daemon=True,
+                              name=f"warm-{table}").start()
         if cfg.get("isDimTable") and schema.primary_key_columns:
             # dimension table: every server holds the full copy and serves
             # LOOKUP joins from it (reference DimensionTableDataManager)

@@ -165,6 +165,28 @@ class DeviceSegmentCache:
         self._maybe_evict()
         return self._views[key]
 
+    def warm(self, segment: ImmutableSegment,
+             columns: Optional[list] = None) -> int:
+        """Pre-upload a segment's column planes to HBM so the first query
+        skips the host→device transfer (reference: segment preload /
+        warm-up on load). Returns planes uploaded. Dict-encoded columns
+        warm their narrow id planes + dictionary values; raw columns warm
+        the value plane. Errors are the caller's to handle (warming is
+        best-effort by policy, not by silent excepts)."""
+        v = self.view(segment)
+        n = 0
+        for col in (columns or segment.columns()):
+            m = segment.column_metadata(col)
+            if m.encoding == "DICT":
+                v.dict_ids_packed(col) if m.single_value else v.dict_ids(col)
+                if np.asarray(segment.get_dictionary(col).values).dtype.kind \
+                        in "iuf":
+                    v.dict_values(col)
+            else:
+                v.raw(col)
+            n += 1
+        return n
+
     def drop(self, segment: ImmutableSegment) -> None:
         """Release a retired segment's device planes (call on segment drop —
         reference: segment replace/delete in BaseTableDataManager)."""

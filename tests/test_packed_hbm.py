@@ -117,3 +117,34 @@ def test_f64_wire_codec_bit_exact():
     got = unpack_outputs(pack_outputs(outs))
     for g, o in zip(got, outs):
         assert np.asarray(g).tobytes() == np.asarray(o).tobytes()
+
+
+def test_device_cache_warm(tmp_path):
+    """warm() pre-uploads every column's planes (the segment-preload
+    analogue); a later view() reuses them."""
+    import numpy as np
+
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.segment.device_cache import DeviceSegmentCache
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.spi.data_types import Schema
+    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+    schema = Schema.build("w", dimensions=[("s", "STRING"), ("i", "INT")],
+                          metrics=[("d", "DOUBLE")])
+    rng = np.random.default_rng(0)
+    cols = {"s": np.asarray([f"x{i%5}" for i in range(500)], object),
+            "i": rng.integers(0, 100, 500).astype(np.int32),
+            "d": rng.standard_normal(500)}
+    cfg = TableConfig(table_name="w", indexing=IndexingConfig(
+        no_dictionary_columns=["d"]))
+    SegmentBuilder(schema, cfg, "w0").build(cols, tmp_path / "w0")
+    seg = load_segment(tmp_path / "w0")
+    cache = DeviceSegmentCache()
+    n = cache.warm(seg)
+    assert n == 3
+    v = cache.view(seg)
+    assert v.nbytes() > 0
+    before = v.nbytes()
+    cache.warm(seg)  # idempotent: planes cached, no double upload
+    assert v.nbytes() == before
