@@ -236,11 +236,19 @@ class _RelationalParser(_Parser):
         if self.accept_op("("):
             sub = self._parse_statement()
             self.expect_op(")")
-            self.accept_kw("AS")
-            t = self.next()
-            if t.kind != "ident":
-                raise SqlParseError(f"derived table needs an alias, got {t.value!r}")
-            return SubqueryRef(sub, t.value)
+            if self.accept_kw("AS"):
+                t = self.next()
+                if t.kind != "ident":
+                    raise SqlParseError(
+                        f"derived table needs an alias, got {t.value!r}")
+                return SubqueryRef(sub, t.value)
+            if self.peek().kind == "ident" \
+                    and self.peek().upper not in _STOP_ALIAS:
+                return SubqueryRef(sub, self.next().value)
+            # anonymous derived table: synthesize an alias (Calcite allows
+            # unaliased subqueries in FROM; columns resolve unqualified)
+            self._anon_subq = getattr(self, "_anon_subq", 0) + 1
+            return SubqueryRef(sub, f"$sq{self._anon_subq}")
         t = self.next()
         if t.kind != "ident":
             raise SqlParseError(f"expected table name, got {t.value!r}")

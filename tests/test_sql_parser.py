@@ -139,3 +139,15 @@ def test_underscore_function_canonicalization():
     qc = parse_sql("SELECT DISTINCT_COUNT(a), distinct_count_hll(b) FROM t")
     assert qc.aggregations[0].function.name == "distinctcount"
     assert qc.aggregations[1].function.name == "distinctcounthll"
+
+
+def test_anonymous_derived_table():
+    """FROM (subquery) without an alias parses (Calcite allows it); the
+    parser synthesizes one."""
+    from pinot_tpu.mse.parser import parse_relational
+
+    q = parse_relational(
+        "SELECT * FROM (SELECT k, SUM(v) AS s FROM t GROUP BY k) WHERE s > 9")
+    assert q is not None
+    q2 = parse_relational("SELECT COUNT(*) FROM (SELECT DISTINCT k FROM t)")
+    assert q2 is not None
