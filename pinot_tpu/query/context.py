@@ -47,7 +47,16 @@ class QueryContext:
 
     def finish(self) -> "QueryContext":
         """Derive aggregation list from select/having/order-by expressions
-        (reference QueryContext.Builder.build → generateAggregationFunctions)."""
+        (reference QueryContext.Builder.build → generateAggregationFunctions).
+        GROUP BY identifiers naming a SELECT alias resolve to the aliased
+        expression first (reference: Calcite's groupByAliasEnabled
+        behavior — GROUP BY dateTrunc('DAY', ts) AS d ... GROUP BY d)."""
+        alias_map = {a: e for e, a in zip(self.select_expressions,
+                                          self.aliases) if a}
+        if alias_map and self.group_by_expressions:
+            self.group_by_expressions = [
+                alias_map.get(g.identifier, g) if g.is_identifier else g
+                for g in self.group_by_expressions]
         aggs: list[ExpressionContext] = []
         for e in self.select_expressions:
             extract_aggregations(e, aggs)

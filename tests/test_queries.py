@@ -173,3 +173,33 @@ def test_alias_naming(table):
         "SELECT teamID AS team, SUM(runs) total FROM baseballStats GROUP BY teamID LIMIT 5"
     ).result_table
     assert rt.schema.column_names == ["team", "total"]
+
+
+def test_group_by_select_alias(tmp_path):
+    """GROUP BY / ORDER BY may name a SELECT alias (reference: Calcite
+    alias resolution) — the alias resolves to its expression before
+    planning, on both engines."""
+    import numpy as np
+
+    from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.spi.data_types import Schema
+
+    schema = Schema.build("al", dimensions=[("k", "STRING")],
+                          metrics=[("v", "INT")])
+    rng = np.random.default_rng(1)
+    cols = {"k": np.asarray([f"g{i % 4}" for i in range(400)], object),
+            "v": rng.integers(0, 100, 400).astype(np.int32)}
+    SegmentBuilder(schema, segment_name="al0").build(cols, tmp_path / "al0")
+    seg = load_segment(tmp_path / "al0")
+    want = {"hi": int((cols["v"] > 50).sum()),
+            "lo": int((cols["v"] <= 50).sum())}
+    for backend in ("host", "tpu"):
+        qe = QueryExecutor(backend=backend)
+        qe.add_table(schema, [seg])
+        r = qe.execute_sql(
+            "SELECT CASE WHEN v > 50 THEN 'hi' ELSE 'lo' END AS b, COUNT(*) "
+            "FROM al GROUP BY b ORDER BY b")
+        assert not r.exceptions, (backend, r.exceptions)
+        assert {row[0]: row[1] for row in r.result_table.rows} == want
