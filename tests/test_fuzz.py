@@ -294,3 +294,53 @@ def test_fuzz_tpu_vs_host_parity(env, tmp_path_factory):
         gb = sorted([tuple(_norm(v) for v in r) for r in b.result_table.rows],
                     key=_sort_key)
         assert _rows_equal(ga, gb), sql
+
+
+def test_fuzz_filter_clause_and_aliases(env):
+    """AGG(x) FILTER (WHERE ...) and CASE aliases in GROUP BY, vs sqlite
+    (which supports both natively)."""
+    qe, oracle = env
+    rng = np.random.default_rng(6)
+    for _ in range(40):
+        cond = _pred(rng)
+        col = rng.choice(NUM_COLS)
+        w = _where(rng)
+        sql = (f"SELECT SUM({col}) FILTER (WHERE {cond}), COUNT(*) "
+               f"FILTER (WHERE {cond}) FROM fz{w}")
+        oracle_sql = (f"SELECT COALESCE(SUM({col}) FILTER (WHERE {cond}), 0.0), "
+                      f"COUNT(*) FILTER (WHERE {cond}) FROM fz{w}")
+        _check(qe, oracle, sql, oracle_sql)
+    for _ in range(30):
+        cut = int(rng.integers(0, 500))
+        w = _where(rng)
+        sql = (f"SELECT CASE WHEN amount > {cut} THEN 'hi' ELSE 'lo' END AS b, "
+               f"COUNT(*), SUM(score) FROM fz{w} GROUP BY b LIMIT 5000")
+        oracle_sql = (f"SELECT CASE WHEN amount > {cut} THEN 'hi' ELSE 'lo' END AS b, "
+                      f"COUNT(*), COALESCE(SUM(score), 0.0) FROM fz{w} GROUP BY b")
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_having(env):
+    qe, oracle = env
+    rng = np.random.default_rng(7)
+    for _ in range(30):
+        dim = rng.choice(STR_COLS + ["code"])
+        cut = int(rng.integers(0, 400))
+        w = _where(rng)
+        sql = (f"SELECT {dim}, COUNT(*), SUM(amount) FROM fz{w} GROUP BY {dim} "
+               f"HAVING SUM(amount) > {cut} LIMIT 5000")
+        oracle_sql = (f"SELECT {dim}, COUNT(*), COALESCE(SUM(amount), 0.0) "
+                      f"FROM fz{w} GROUP BY {dim} HAVING SUM(amount) > {cut}")
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_derived_tables(env):
+    """FROM-subquery shapes through the MSE engine vs sqlite."""
+    qe, oracle = env
+    rng = np.random.default_rng(8)
+    for _ in range(20):
+        dim = rng.choice(STR_COLS)
+        cut = int(rng.integers(0, 300))
+        sql = (f"SELECT COUNT(*) FROM (SELECT {dim}, SUM(amount) AS s FROM fz "
+               f"GROUP BY {dim}) WHERE s > {cut}")
+        _check(qe, oracle, sql)
