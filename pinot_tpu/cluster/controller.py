@@ -314,6 +314,7 @@ class ClusterController:
         cfg = self.table_config(name_with_type)
         if cfg is None:
             raise KeyError(name_with_type)
+        self._check_upsert_movable(name_with_type, cfg)
         ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
         # CONSUMING segments sit out by default (reference: rebalance
         # includeConsuming=false) — moving an active consumer means
@@ -413,6 +414,20 @@ class ClusterController:
     def rebalance_status(self, name_with_type: str) -> Optional[dict]:
         return self.store.get(f"/REBALANCE/{name_with_type}")
 
+    def _check_upsert_movable(self, name_with_type: str, cfg: dict) -> None:
+        """Upsert tables keep a per-server primary-key map: every segment
+        of a pk partition must live on the same server or validity planes
+        diverge. Moves are only safe under partition-pinned placement, so
+        rebalance/relocation REFUSES without instance partitions
+        (reference: TableRebalancer requires strict replica groups for
+        upsert tables)."""
+        mode = ((cfg.get("upsertConfig") or {}).get("mode") or "NONE").upper()
+        if mode != "NONE" and not self.instance_partitions(name_with_type):
+            raise RuntimeError(
+                f"{name_with_type} is an upsert table: configure instance "
+                "partitions (partition-pinned placement) before rebalancing "
+                "so pk partitions stay colocated")
+
     # -- tiered storage ------------------------------------------------------
     @staticmethod
     def _parse_age_ms(age: str) -> int:
@@ -461,6 +476,7 @@ class ClusterController:
             raise KeyError(name_with_type)
         if not cfg.get("tierConfigs"):
             return {"table": name_with_type, "moves": 0, "status": "DONE"}
+        self._check_upsert_movable(name_with_type, cfg)
         now_ms = now_ms or int(time.time() * 1000)
         replication = int(cfg.get("replication", 1))
         ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}

@@ -298,3 +298,21 @@ def test_tier_relocation_safe(tmp_path):
         stop.set()
         for s in servers:
             s.stop()
+
+
+def test_upsert_table_rebalance_requires_instance_partitions(tmp_path):
+    """Moving upsert segments without partition-pinned placement would
+    split pk partitions across servers — rebalance must refuse."""
+    store, controller, servers = _mk_cluster(3)
+    try:
+        table = controller.create_table({
+            "tableName": "stats", "tableType": "REALTIME", "replication": 1,
+            "upsertConfig": {"mode": "FULL"}})
+        with pytest.raises(RuntimeError, match="upsert"):
+            controller.rebalance(table)
+        # with instance partitions it proceeds
+        controller.configure_instance_partitions(table, 1)
+        assert controller.rebalance(table)["status"] == "DONE"
+    finally:
+        for s in servers:
+            s.stop()
