@@ -351,6 +351,17 @@ class RealtimeTableDataManager:
         cp = self._load_checkpoints()
         self._offsets: dict[str, str] = cp.get("partitions", {})
         self._segment_names: list[str] = cp.get("segments", [])
+        # freshness gauges (reference: IngestionDelayTracker publishing
+        # realtimeIngestionDelayMs / realtimeIngestionOffsetLag per table)
+        from ..spi.metrics import SERVER_METRICS
+
+        tname = self.table_config.table_name
+        SERVER_METRICS.set_gauge(
+            f"realtimeIngestionDelayMs.{tname}",
+            lambda: max(self.ingestion_delay_ms().values(), default=0))
+        SERVER_METRICS.set_gauge(
+            f"realtimeIngestionOffsetLag.{tname}",
+            lambda: max(self.offset_lag().values(), default=0))
 
     # -- checkpoints (ZK segment-metadata equivalent) ----------------------
     # The checkpoint file is the COMMIT POINT: it atomically records both the
@@ -591,6 +602,29 @@ class RealtimeTableDataManager:
         now = int(time.time() * 1000)
         with self._lock:
             return {p: now - m.last_consumed_ms for p, m in self._consuming.items()}
+
+    def offset_lag(self) -> dict[int, int]:
+        """Per-partition messages behind the stream head (reference:
+        IngestionDelayTracker's offset lag companion metric). Uses the
+        stream's metadata provider; a provider error reports -1 for that
+        partition rather than failing the caller (it is a metric)."""
+        with self._lock:
+            current = {p: m.current_offset.offset
+                       for p, m in self._consuming.items()}
+        if not current:
+            return {}
+        out = {}
+        try:
+            provider = get_stream_consumer_factory(
+                self.stream_config).create_metadata_provider()
+        except Exception:
+            return {p: -1 for p in current}
+        for p, off in current.items():
+            try:
+                out[p] = max(0, provider.fetch_latest_offset(p).offset - off)
+            except Exception:
+                out[p] = -1
+        return out
 
     def total_docs(self) -> int:
         with self._lock:
