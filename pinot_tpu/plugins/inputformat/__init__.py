@@ -2,9 +2,10 @@
 
 Reference analogue: pinot-plugins/pinot-input-format/ — RecordReader SPI
 (pinot-spi/.../spi/data/readers/RecordReader.java) with avro, csv, json,
-orc, parquet, protobuf, thrift impls. Here: csv/json native, parquet+orc via
-pyarrow, avro via a self-contained container-file decoder
-(plugins/inputformat/avro.py)."""
+orc, parquet, protobuf, thrift, clp-log impls. Here: csv/json native,
+parquet+orc via pyarrow, avro via a self-contained container-file decoder
+(plugins/inputformat/avro.py), clp-log via the repo's CLP tokenizer
+(plugins/inputformat/clplog.py)."""
 
 from .readers import (
     RecordReader,

@@ -165,6 +165,8 @@ def create_record_reader(path: str, fmt: Optional[str] = None,
     if fmt not in _READERS and fmt in ("proto", "protobuf", "thrift"):
         # registration-on-import, like stream plugins
         from . import protobuf, thrift  # noqa: F401
+    if fmt not in _READERS and fmt in ("clplog", "clp"):
+        from . import clplog  # noqa: F401
     factory = _READERS.get(fmt)
     if factory is None:
         raise ValueError(f"no record reader for format {fmt!r} "

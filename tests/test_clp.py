@@ -99,3 +99,54 @@ def test_clp_on_wrong_column_is_clear_error(tmp_path):
         SegmentBuilder(schema, table_config=cfg, segment_name="s").build(
             {"msg": np.asarray(["a1"], dtype=object),
              "n": np.asarray([1], dtype=np.int32)}, tmp_path / "s")
+
+
+# -- clp-log input format (plugins/inputformat/clplog.py) ---------------------
+
+
+def test_clplog_reader_splits_and_roundtrips(tmp_path):
+    import json
+
+    from pinot_tpu.plugins.inputformat import create_record_reader
+    from pinot_tpu.plugins.inputformat.clplog import decode_field
+
+    msgs = [
+        "Task task_12 failed after 3.50s with code 7",
+        "GET /api/v2/users/881 took 12ms",
+        "heartbeat ok",
+        "weird float +3 007 1.2.3 12345678901234567890.5",
+    ]
+    p = tmp_path / "events.jsonl"
+    with open(p, "w") as f:
+        for i, m in enumerate(msgs):
+            f.write(json.dumps({"ts": i, "level": "INFO", "message": m}) + "\n")
+
+    rows = list(create_record_reader(
+        str(p), fmt="clplog",
+        config={"fields_for_clp_encoding": ["message"]}))
+    assert len(rows) == len(msgs)
+    for i, (row, msg) in enumerate(zip(rows, msgs)):
+        # passthrough fields untouched; message replaced by the split triple
+        assert row["ts"] == i and row["level"] == "INFO"
+        assert "message" not in row
+        assert decode_field(row["message_logtype"],
+                            row["message_dictionaryVars"],
+                            row["message_encodedVars"]) == msg
+    # template dedup: the logtype cardinality is what makes CLP tables small
+    assert rows[0]["message_logtype"] != rows[2]["message_logtype"]
+
+
+def test_clplog_encoded_var_packing_exact():
+    from pinot_tpu.plugins.inputformat.clplog import (
+        encode_var_to_long, long_to_encoded_var)
+
+    for kind, lit in [("i", "0"), ("i", "-17"), ("i", str((1 << 62) - 1)),
+                      ("f", "3.50"), ("f", "-0.001"), ("f", "123456789.000001")]:
+        w = encode_var_to_long(kind, lit)
+        assert w is not None
+        assert long_to_encoded_var(w) == (kind, lit)
+    # unpackable tokens must be refused (demoted to dictionary vars)
+    assert encode_var_to_long("i", "+3") is None
+    assert encode_var_to_long("i", "007") is None
+    assert encode_var_to_long("i", str(1 << 63)) is None
+    assert encode_var_to_long("f", "1234567890123456.5") is None
