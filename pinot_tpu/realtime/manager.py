@@ -356,12 +356,20 @@ class RealtimeTableDataManager:
         from ..spi.metrics import SERVER_METRICS
 
         tname = self.table_config.table_name
-        SERVER_METRICS.set_gauge(
-            f"realtimeIngestionDelayMs.{tname}",
-            lambda: max(self.ingestion_delay_ms().values(), default=0))
-        SERVER_METRICS.set_gauge(
-            f"realtimeIngestionOffsetLag.{tname}",
-            lambda: max(self.offset_lag().values(), default=0))
+        def _worst_delay():
+            return max(self.ingestion_delay_ms().values(), default=0)
+
+        def _worst_lag():
+            # -1 (provider error on ANY partition) must surface, not be
+            # masked by a healthy partition's larger non-negative lag
+            lags = self.offset_lag().values()
+            return -1 if any(v < 0 for v in lags) else max(lags, default=0)
+
+        self._gauges = {f"realtimeIngestionDelayMs.{tname}": _worst_delay,
+                        f"realtimeIngestionOffsetLag.{tname}": _worst_lag}
+        for gname, fn in self._gauges.items():
+            SERVER_METRICS.set_gauge(gname, fn)
+        self._meta_provider = None  # cached for offset_lag polls
 
     # -- checkpoints (ZK segment-metadata equivalent) ----------------------
     # The checkpoint file is the COMMIT POINT: it atomically records both the
@@ -482,6 +490,22 @@ class RealtimeTableDataManager:
                 break
             for m in managers:
                 m.stop()
+        # release the freshness gauges: they close over self, and the global
+        # registry would otherwise pin this manager (and poll a dead table's
+        # stream metadata) forever. Identity-guarded: if a replacement
+        # manager for the same table already re-registered, leave its
+        # gauges alone.
+        from ..spi.metrics import SERVER_METRICS
+
+        for gname, fn in self._gauges.items():
+            SERVER_METRICS.remove_gauge(gname, fn)
+        with self._lock:
+            provider, self._meta_provider = self._meta_provider, None
+        if provider is not None:
+            try:
+                provider.close()
+            except Exception:
+                pass
 
     # -- commit (in-process completion FSM) --------------------------------
     def _handle_commit(self, mgr: RealtimeSegmentDataManager):
@@ -614,16 +638,38 @@ class RealtimeTableDataManager:
         if not current:
             return {}
         out = {}
-        try:
-            provider = get_stream_consumer_factory(
-                self.stream_config).create_metadata_provider()
-        except Exception:
-            return {p: -1 for p in current}
+        # cache the metadata provider across polls (the gauge is scraped
+        # continuously; a fresh client connection per scrape would churn);
+        # drop it on any error so the next poll reconnects. The cache slot
+        # is guarded by self._lock (creation races between concurrent
+        # scrapes, and against stop(), would leak live client connections);
+        # the fetches themselves run outside the lock — they are network I/O.
+        with self._lock:
+            if self._shutdown:
+                return {p: -1 for p in current}
+            provider = self._meta_provider
+            if provider is None:
+                try:
+                    provider = get_stream_consumer_factory(
+                        self.stream_config).create_metadata_provider()
+                except Exception:
+                    return {p: -1 for p in current}
+                self._meta_provider = provider
+        errored = False
         for p, off in current.items():
             try:
                 out[p] = max(0, provider.fetch_latest_offset(p).offset - off)
             except Exception:
                 out[p] = -1
+                errored = True
+        if errored:
+            with self._lock:
+                if self._meta_provider is provider:
+                    self._meta_provider = None
+            try:
+                provider.close()
+            except Exception:
+                pass
         return out
 
     def total_docs(self) -> int:

@@ -67,6 +67,16 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = supplier
 
+    def remove_gauge(self, name: str, supplier=None) -> None:
+        """Unregister a gauge (reference: removeTableGauge on table
+        shutdown) so stopped components are released and snapshot() stops
+        polling their suppliers. With ``supplier``, removes only if that
+        exact supplier is still registered — an old component's shutdown
+        must not delete its replacement's gauge."""
+        with self._lock:
+            if supplier is None or self._gauges.get(name) is supplier:
+                self._gauges.pop(name, None)
+
     def gauge_value(self, name: str) -> Optional[float]:
         with self._lock:
             g = self._gauges.get(name)
@@ -97,13 +107,18 @@ class MetricsRegistry:
         return _Ctx()
 
     def snapshot(self) -> dict:
+        # gauge suppliers may block (e.g. stream-metadata RPCs behind the
+        # ingestion-lag gauge) — evaluate them OUTSIDE the registry lock so
+        # a slow supplier cannot stall query-path add_meter/update_timer
         with self._lock:
-            return {
+            out = {
                 "meters": dict(self._meters),
-                "gauges": {k: float(v()) for k, v in self._gauges.items()},
                 "timers": {k: {"count": v[0], "totalMs": round(v[1], 3)}
                            for k, v in self._timers.items()},
             }
+            gauges = dict(self._gauges)
+        out["gauges"] = {k: float(v()) for k, v in gauges.items()}
+        return out
 
 
 _FACTORY: Callable[[], MetricsRegistry] = MetricsRegistry
