@@ -418,7 +418,7 @@ def _run_dense_group_by(program: ir.Program, arrays, params, mask, gid,
     ops). Replaces the batched (n, C) vector-payload scatter, whose minor
     dim was padded 6→128 lanes by TPU tiling (a 21x HBM blowup that OOMed
     real 100M-row segments)."""
-    planes = [mask.astype(jnp.bfloat16)]  # count plane
+    planes = [mask.astype(mxu_groupby.PLANE_DTYPE)]  # count plane
     recipes: list = []  # per agg: callable(sums, counts) | None → _run_agg
     for agg in program.aggs:
         recipes.append(_mxu_agg(agg, arrays, params, mask, planes))
@@ -460,17 +460,19 @@ def _mxu_agg(agg: ir.AggOp, arrays, params, mask, planes):
         return None
     vm = jnp.where(mask, v, 0).astype(jnp.int32)
     u = vm.astype(jnp.uint32)
-    shifts, nonneg = _limb_shifts(agg.vmin, agg.vmax, 8)
+    b = mxu_groupby.LIMB_BITS
+    shifts, nonneg = _limb_shifts(agg.vmin, agg.vmax, b)
     if len(planes) + len(shifts) + (0 if nonneg else 1) > mxu_groupby.MAX_PLANES:
         return None
     refs = []
     for s in shifts:
         refs.append((len(planes), s))
-        planes.append(((u >> s) & jnp.uint32(0xFF)).astype(jnp.bfloat16))
+        planes.append(((u >> s) & jnp.uint32((1 << b) - 1))
+                      .astype(mxu_groupby.PLANE_DTYPE))
     neg_ref = None
     if not nonneg:
         neg_ref = len(planes)
-        planes.append((vm < 0).astype(jnp.bfloat16))
+        planes.append((vm < 0).astype(mxu_groupby.PLANE_DTYPE))
 
     def recipe(sums, counts, _refs=refs, _neg=neg_ref):
         total = jnp.zeros(counts.shape[0], dtype=jnp.int64)
@@ -788,7 +790,7 @@ def _mxu_or_scatter_counts(mask, sid, num_slots):
     accumulator, 32-bit scatter otherwise. Returns (num_slots,) int64."""
     if mxu_groupby.supports(num_slots, 1):
         return mxu_groupby.limb_sums(
-            (mask.astype(jnp.bfloat16),), sid, num_slots)[0]
+            (mask.astype(mxu_groupby.PLANE_DTYPE),), sid, num_slots)[0]
     return jax.ops.segment_sum(
         mask.astype(jnp.int32), sid,
         num_segments=num_slots).astype(jnp.int64)

@@ -15,11 +15,14 @@ One MXU pass of (S1 x B) @ (B x P*128) replaces P scatters over B rows; for
 S1 <= 128 the cost per row is *independent of the group count*, and all
 payload planes ride the same pass.
 
-Exactness: payloads must be integers in [0, 255] (8-bit limbs — bf16
-represents them exactly; int sums are decomposed into limb planes by the
-caller). Per-block f32 MXU accumulation is exact (B * 255 < 2^24) and the
-per-superblock i32 accumulator is exact (SB_ROWS * 255 < 2^31); superblock
-partials are summed in int64 outside the kernel.
+Exactness: payloads must be small non-negative integers. The default plane
+dtype is **int8 with 7-bit limbs** (values in [0, 127]): v5e executes s8xs8
+matmuls at twice the bf16 rate with native i32 accumulation, and the planes
+cost half the HBM bandwidth of bf16. Per-superblock i32 accumulation is
+exact (SB_ROWS * 127 < 2^31); superblock partials are summed in int64
+outside the kernel. Setting PINOT_TPU_MXU_INT8=0 falls back to bf16 planes
+with 8-bit limbs ([0, 255] — bf16-exact; per-block f32 accumulation exact
+because B * 255 < 2^24).
 
 Masked rows must already be routed to a trash slot by the caller (the dense
 planner convention: gid == num_segments - 1), with zeroed payloads.
@@ -28,6 +31,7 @@ planner convention: gid == num_segments - 1), with zeroed payloads.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -50,19 +54,34 @@ SB_ROWS = SB_BLOCKS * BLOCK_ROWS  # ~1M
 # above this many group slots the (S1, P*128) accumulator stops fitting
 # comfortably in VMEM next to the one-hot operands
 MAX_GROUPS = 1 << 15
-MAX_PLANES = 16
+
+# int8 MXU path (2x matmul rate + half the plane bandwidth on v5e).
+# PINOT_TPU_MXU_INT8=0 reverts to bf16/8-bit limbs.
+_INT8 = os.environ.get("PINOT_TPU_MXU_INT8", "1") != "0"
+PLANE_DTYPE = jnp.int8 if _INT8 else jnp.bfloat16
+LIMB_BITS = 7 if _INT8 else 8
+# int8 planes cost half the VMEM of bf16 AND 7-bit limbs need one more
+# plane per signed-i32 sum (5+neg vs 4+neg) — scale the plane budget so a
+# 3x signed-SUM query (1 + 3*6 = 19 planes) still rides one MXU pass
+MAX_PLANES = 24 if _INT8 else 16
 
 
 def supports(num_segments: int, num_planes: int) -> bool:
-    return 0 < num_planes <= MAX_PLANES and num_segments <= MAX_GROUPS
+    if not (0 < num_planes <= MAX_PLANES and num_segments <= MAX_GROUPS):
+        return False
+    # accumulator block is (num_planes * s1, 128) i32 — bound the product
+    # so it stays ~2 MB of VMEM next to the one-hot operands
+    s1 = max(1, -(-num_segments // LANES))
+    return num_planes * s1 <= 4096
 
 
 def limb_sums(planes, gid, num_segments: int, *, interpret: bool = False):
-    """Sum each plane per group: planes P x (n,) float (integer-valued,
-    [0, 255]), gid (n,) int32 in [0, num_segments); returns (P, num_segments)
-    int64. Uses the Pallas MXU kernel on TPU, a kron-factored XLA matmul
-    elsewhere (interpret=True forces the Pallas kernel in interpret mode for
-    kernel-parity tests)."""
+    """Sum each plane per group: planes P x (n,) of PLANE_DTYPE holding
+    integer limb values in [0, 2**LIMB_BITS - 1] (int8 planes: [0, 127];
+    bf16 planes: [0, 255]), gid (n,) int32 in [0, num_segments); returns
+    (P, num_segments) int64. Uses the Pallas MXU kernel on TPU, a
+    kron-factored XLA matmul elsewhere (interpret=True forces the Pallas
+    kernel in interpret mode for kernel-parity tests)."""
     assert supports(num_segments, len(planes))
     if interpret or jax.default_backend() == "tpu":
         return _pallas_limb_sums(tuple(planes), gid, num_segments,
@@ -101,6 +120,11 @@ def _kernel(s1: int, num_planes: int, gid_ref, *rest):
     plane_refs = rest[:num_planes]
     out_ref = rest[num_planes]
     j = pl.program_id(1)
+    # int8 planes ride the s8xs8->i32 MXU mode (2x bf16 rate on v5e);
+    # bf16 planes keep the f32-accumulating dot
+    int8 = plane_refs[0].dtype == jnp.int8
+    oh_dt = jnp.int8 if int8 else jnp.bfloat16
+    acc_dt = jnp.int32 if int8 else jnp.float32
 
     nb = G_TILES * SUBLANES  # batch dim of the MXU pass
     # leading-dim collapse (G, 8, 128) -> (G*8, 128): pure addressing, no
@@ -121,28 +145,30 @@ def _kernel(s1: int, num_planes: int, gid_ref, *rest):
     # better than s1 alone (s1 is ~55 for a 7K-group query — a 43% fill),
     # and the rhs one-hot + per-plane multiplies collapse into one
     # compare + P selects. Same MAC count, much higher MXU occupancy.
-    # Planes chunk so the lhs + f32 dot output stay within VMEM at the
-    # largest supported s1 (256): Pg*s1 <= 384.
-    # bf16 one-hot + multiply (not a bool mask + select: Mosaic rejects
+    # Planes chunk so the lhs + dot output stay within VMEM at the
+    # largest supported s1 (256): Pg*s1 <= 384 for 2-byte bf16 lanes,
+    # twice that for 1-byte int8.
+    # one-hot + multiply (not a bool mask + select: Mosaic rejects
     # the i1 relayout when the mask is reused across plane chunks)
     oh_hi = (jax.lax.broadcasted_iota(jnp.int32, (nb, s1, LANES), 1)
-             == mid(hi, s1)).astype(jnp.bfloat16)
+             == mid(hi, s1)).astype(oh_dt)
     rhs = (jax.lax.broadcasted_iota(jnp.int32, (nb, LANES, LANES), 1)
-           == mid(lo, LANES)).astype(jnp.bfloat16)  # (nb, L, C)
-    pg = max(1, 384 // s1)
+           == mid(lo, LANES)).astype(oh_dt)  # (nb, L, C)
+    pg = max(1, (768 if int8 else 384) // s1)
     # both operands keep the contraction (row) dim minor — an NT matmul,
     # the same shape attention uses for q @ k^T (Mosaic supports exactly
     # one contracting dim, so nb stays a batch dim and the batch outputs
-    # sum after). f32 accumulation is exact: each dot sums 128 values
-    # <= 255, the batch sum stays below 2^24.
+    # sum after). Accumulation is exact on both paths: i32 native for s8
+    # dots; f32 for bf16 (each dot sums 128 values <= 255 and the batch
+    # sum stays below 2^24).
     dn = (((2,), (2,)), ((0,), (0,)))
     parts = []
     for start in range(0, num_planes, pg):
         lhs = jnp.concatenate(
-            [oh_hi * mid(pr[...].reshape(nb, LANES).astype(jnp.bfloat16), s1)
+            [oh_hi * mid(pr[...].reshape(nb, LANES).astype(oh_dt), s1)
              for pr in plane_refs[start:start + pg]], axis=1)
         out = jax.lax.dot_general(lhs, rhs, dn,
-                                  preferred_element_type=jnp.float32)
+                                  preferred_element_type=acc_dt)
         parts.append(out.sum(axis=0))  # (Pg*s1, L)
     part = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 

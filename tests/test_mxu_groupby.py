@@ -41,6 +41,24 @@ def test_pallas_matches_reference(n, segs, p):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("n,segs,p", [
+    (1000, 7, 1),
+    (5000, 300, 3),
+    (70000, 9000, 4),
+])
+def test_pallas_int8_matches_reference(n, segs, p):
+    """The DEFAULT production path: int8 planes (7-bit limbs) through the
+    s8xs8->i32 dot branch of the same Pallas kernel."""
+    rng = np.random.default_rng(n * 7 + segs + p)
+    gid = rng.integers(0, segs, n).astype(np.int32)
+    planes = [rng.integers(0, 128, n).astype(np.int8) for _ in range(p)]
+    got = np.asarray(mxu_groupby.limb_sums(
+        [jnp.asarray(pl) for pl in planes],
+        jnp.asarray(gid), segs, interpret=True))
+    want = _reference(planes, gid, segs)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_xla_fallback_matches_reference():
     rng = np.random.default_rng(0)
     n, segs = 20000, 512
