@@ -12,3 +12,4 @@ from .dataframe import (  # noqa: F401
     read_sql_pandas,
     write_dataframe,
 )
+from .sink import StreamingSegmentWriter  # noqa: F401

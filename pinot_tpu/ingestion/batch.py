@@ -187,15 +187,19 @@ def _generate_one_job(spec: SegmentGenerationJobSpec, path: str,
 
 
 def push_segments_to_cluster(results: list[SegmentGenerationResult],
-                             controller, table_name_with_type: str) -> None:
+                             controller, table_name_with_type: str,
+                             extra_meta: Optional[dict] = None) -> None:
     """Metadata push (reference: SegmentPushUtils → controller
     /v2/segments): register each built segment's location + doc count with
     the cluster controller, which assigns replicas and updates the ideal
-    state."""
+    state. ``extra_meta`` merges into every segment's metadata (e.g. the
+    distributed runner's ``inputFile`` dedup marker)."""
     for r in results:
         meta = {"location": r.output_uri, "numDocs": r.num_docs}
         if r.partitions:
             meta["partitions"] = r.partitions
+        if extra_meta:
+            meta.update(extra_meta)
         controller.add_segment(table_name_with_type, r.segment_name, meta)
 
 

@@ -301,12 +301,82 @@ def refresh_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
 # -- SegmentGenerationAndPushTask --------------------------------------------
 
 
+def segment_gen_push_generator(controller, table: str,
+                               cfg: dict) -> list[TaskSpec]:
+    """ONE TASK PER INPUT FILE — the distributed batch-ingestion runner.
+
+    The reference distributes file→segment build tasks over cluster
+    executors (pinot-plugins/pinot-batch-ingestion/
+    pinot-batch-ingestion-spark-3/.../SparkSegmentGenerationJobRunner.java
+    parallelizes the input-file URI list; SegmentGenerationAndPushTask's
+    generator emits tableMaxNumTasks single-file tasks). Here each file
+    becomes its own TaskSpec, so any number of minion workers — on any
+    host sharing the property store and filesystem — claim and build
+    concurrently. Files already ingested are skipped by checking pushed
+    segments' ``inputFile`` marker, mirroring the reference generator's
+    ZK-metadata dedup."""
+    from ..ingestion.batch import IngestionJobLauncher, SegmentGenerationJobSpec
+
+    schema_raw = controller.store.get(f"/SCHEMAS/{raw_table_name(table)}")
+    if schema_raw is None:
+        raise KeyError(f"schema {raw_table_name(table)} not registered")
+    job = SegmentGenerationJobSpec(
+        input_dir_uri=cfg["inputDirURI"],
+        output_dir_uri=cfg.get("outputDirURI", cfg["inputDirURI"]),
+        schema=Schema.from_json(schema_raw),
+        table_config=TableConfig(table_name=raw_table_name(table)),
+        include_file_name_pattern=cfg.get("includeFileNamePattern"),
+    )
+    files = sorted(IngestionJobLauncher(job).list_input_files())
+    done = set()
+    for seg in controller.store.children(f"/SEGMENTS/{table}"):
+        meta = controller.segment_metadata(table, seg) or {}
+        if meta.get("inputFile"):
+            done.add(meta["inputFile"])
+    # also skip files with a non-terminal task in flight (reference: the
+    # generator checks task states so a scheduler tick during a long build
+    # cannot double-ingest a file)
+    for tid in controller.store.children("/TASKS/SegmentGenerationAndPushTask"):
+        t = controller.store.get(
+            f"/TASKS/SegmentGenerationAndPushTask/{tid}") or {}
+        if t.get("table") == table and t.get("state") in ("PENDING", "RUNNING"):
+            f = (t.get("config") or {}).get("inputFile")
+            if f:
+                done.add(f)
+    max_tasks = int(cfg.get("tableMaxNumTasks", 0) or 0)
+    new_files = [p for p in files if p not in done]
+    if max_tasks:
+        new_files = new_files[:max_tasks]
+    if not new_files:
+        return []
+    # sequence ids come from a monotonic per-table counter in the store —
+    # NOT the file's position in today's listing, which would reuse a
+    # consumed seq (and thus a segment name) when a late-arriving file
+    # sorts before already-ingested ones
+    base = {"n": 0}
+
+    def alloc(cur):
+        cur = int(cur or 0)
+        base["n"] = cur
+        return cur + len(new_files)
+
+    controller.store.update(f"/INGEST_SEQ/{table}", alloc)
+    return [TaskSpec("SegmentGenerationAndPushTask", table,
+                     config=dict(cfg, inputFile=path,
+                                 sequenceId=base["n"] + i))
+            for i, path in enumerate(new_files)]
+
+
 def segment_gen_push_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
     """Batch build + push as a minion task (reference:
-    SegmentGenerationAndPushTaskExecutor)."""
+    SegmentGenerationAndPushTaskExecutor). With ``inputFile`` in the
+    config (set by the per-file generator) this builds exactly one file —
+    the unit of cluster-wide distribution; without it, the whole job runs
+    in-process (the standalone fallback)."""
     from ..ingestion.batch import (
         IngestionJobLauncher,
         SegmentGenerationJobSpec,
+        _generate_one_job,
         push_segments_to_cluster,
     )
 
@@ -322,6 +392,14 @@ def segment_gen_push_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
         include_file_name_pattern=spec.config.get("includeFileNamePattern"),
         segment_name_prefix=spec.config.get("segmentNamePrefix"),
     )
+    if spec.config.get("inputFile"):
+        r = _generate_one_job(job, spec.config["inputFile"],
+                              int(spec.config.get("sequenceId", 0)))
+        push_segments_to_cluster([r], ctx.controller, table,
+                                 extra_meta={"inputFile":
+                                             spec.config["inputFile"]})
+        return {"segments": [r.segment_name], "numDocs": r.num_docs,
+                "inputFile": spec.config["inputFile"]}
     results = IngestionJobLauncher(job).run()
     push_segments_to_cluster(results, ctx.controller, table)
     return {"segments": [r.segment_name for r in results],
@@ -338,4 +416,6 @@ register_task_generator("PurgeTask", purge_generator)
 register_task_executor("PurgeTask", purge_executor)
 register_task_executor("UpsertCompactionTask", upsert_compaction_executor)
 register_task_executor("RefreshSegmentTask", refresh_executor)
+register_task_generator("SegmentGenerationAndPushTask",
+                        segment_gen_push_generator)
 register_task_executor("SegmentGenerationAndPushTask", segment_gen_push_executor)

@@ -146,15 +146,17 @@ def _kernel(s1: int, num_planes: int, gid_ref, *rest):
     # and the rhs one-hot + per-plane multiplies collapse into one
     # compare + P selects. Same MAC count, much higher MXU occupancy.
     # Planes chunk so the lhs + dot output stay within VMEM at the
-    # largest supported s1 (256): Pg*s1 <= 384 for 2-byte bf16 lanes,
-    # twice that for 1-byte int8.
+    # largest supported s1 (256). The binding buffer is the i32/f32 dot
+    # OUTPUT (nb, Pg*s1, 128) — 4 bytes per element on BOTH dtypes — so
+    # the Pg*s1 <= 384 budget holds for int8 too (a larger int8 chunk
+    # would only shrink the 1-byte lhs while doubling the accumulator).
     # one-hot + multiply (not a bool mask + select: Mosaic rejects
     # the i1 relayout when the mask is reused across plane chunks)
     oh_hi = (jax.lax.broadcasted_iota(jnp.int32, (nb, s1, LANES), 1)
              == mid(hi, s1)).astype(oh_dt)
     rhs = (jax.lax.broadcasted_iota(jnp.int32, (nb, LANES, LANES), 1)
            == mid(lo, LANES)).astype(oh_dt)  # (nb, L, C)
-    pg = max(1, (768 if int8 else 384) // s1)
+    pg = max(1, 384 // s1)
     # both operands keep the contraction (row) dim minor — an NT matmul,
     # the same shape attention uses for q @ k^T (Mosaic supports exactly
     # one contracting dim, so nb stays a batch dim and the batch outputs

@@ -161,3 +161,97 @@ def test_background_minion_polling(cluster, tmp_path):
         assert task_mgr.wait_all(timeout_s=10)
     finally:
         minion.stop()
+
+
+def test_distributed_segment_generation_per_file_tasks(cluster, tmp_path):
+    """The Spark-runner analogue: the generator emits one task per input
+    file and two minion workers build them concurrently; re-scheduling is
+    a no-op thanks to the inputFile dedup marker."""
+    from pinot_tpu.minion.tasks import segment_gen_push_generator
+
+    store, controller, server, broker, task_mgr, minion = cluster
+    input_dir = tmp_path / "input"
+    input_dir.mkdir()
+    for i in range(3):
+        (input_dir / f"part_{i}.csv").write_text(
+            "host,day,cpu\n" + "".join(
+                f"h{i},{i + 1},{float(j)}\n" for j in range(4)))
+    (input_dir / "ignore.txt").write_text("not data\n")
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"SegmentGenerationAndPushTask": {
+            "inputDirURI": str(input_dir),
+            "outputDirURI": str(tmp_path / "generated"),
+            "includeFileNamePattern": "*.csv",
+        }}})
+    ids = task_mgr.schedule_tasks()
+    assert len(ids) == 3  # one task per csv file
+    minion2 = MinionInstance(store, "Minion_1", controller,
+                             str(tmp_path / "minion2_work"))
+    ran = {"Minion_0": 0, "Minion_1": 0}
+    while True:
+        a = minion.run_pending_once()
+        b = minion2.run_pending_once()
+        ran["Minion_0"] += a
+        ran["Minion_1"] += b
+        if not a and not b:
+            break
+    assert sum(ran.values()) == 3
+    for tid in ids:
+        st = task_mgr.task_state("SegmentGenerationAndPushTask", tid)
+        assert st["state"] == "COMPLETED", st
+    r = broker.execute_sql(
+        "SELECT COUNT(*), SUM(cpu) FROM metrics")
+    assert [list(x) for x in r.result_table.rows] == [[12, 18.0]]
+    # every pushed segment carries its inputFile marker → rescheduling
+    # generates nothing
+    assert task_mgr.schedule_tasks() == []
+    # a NEW file appearing later yields exactly one incremental task
+    (input_dir / "part_3.csv").write_text("host,day,cpu\nh9,9,99.0\n")
+    ids2 = task_mgr.schedule_tasks()
+    assert len(ids2) == 1
+    # while that task is PENDING, another scheduler tick must not emit a
+    # duplicate for the same file (in-flight dedup)
+    assert task_mgr.schedule_tasks() == []
+    assert minion.run_pending_once() == 1
+    # late-arriving file got a FRESH sequence id from the store counter —
+    # a file sorting before the ingested ones must never reuse a consumed
+    # seq (segment-name collision would overwrite earlier rows)
+    (input_dir / "a_first.csv").write_text("host,day,cpu\nh0,1,1.0\n")
+    specs = segment_gen_push_generator(
+        controller, table, {"inputDirURI": str(input_dir),
+                            "includeFileNamePattern": "*.csv"})
+    assert len(specs) == 1
+    assert specs[0].config["sequenceId"] == 4  # 0-3 already consumed
+    segs = set(store.children(f"/SEGMENTS/{table}"))
+    assert len(segs) == 4  # nothing overwritten
+    r = broker.execute_sql("SELECT COUNT(*) FROM metrics")
+    assert [list(x) for x in r.result_table.rows] == [[13]]
+
+
+def test_streaming_segment_writer_sink(cluster, tmp_path):
+    """Flink-connector analogue: row-at-a-time collect with threshold
+    flush; segments appear in the cluster as they are cut."""
+    from pinot_tpu.connectors import StreamingSegmentWriter
+
+    store, controller, server, broker, task_mgr, minion = cluster
+    controller.create_table({"tableName": "metrics", "replication": 1})
+    with StreamingSegmentWriter(
+            SCHEMA, str(tmp_path / "sink_out"), controller=controller,
+            partition_id=3, flush_max_rows=5) as w:
+        for i in range(12):
+            w.collect({"host": f"h{i % 2}", "day": i, "cpu": float(i)})
+    # 12 rows / threshold 5 → two full segments + one tail flush on close
+    assert len(w.segments) == 3
+    assert all("metrics_3_" in s for s in w.segments)
+    r = broker.execute_sql("SELECT COUNT(*), SUM(cpu) FROM metrics")
+    assert [list(x) for x in r.result_table.rows] == [[12, 66.0]]
+    # a RESTARTED writer re-seeds its sequence past registered segments,
+    # so it appends instead of overwriting the first run's segments
+    with StreamingSegmentWriter(
+            SCHEMA, str(tmp_path / "sink_out"), controller=controller,
+            partition_id=3, flush_max_rows=5) as w2:
+        w2.collect({"host": "h9", "day": 99, "cpu": 1.5})
+    assert w2.segments == [str(tmp_path / "sink_out") + "/metrics_3_3"]
+    r = broker.execute_sql("SELECT COUNT(*), SUM(cpu) FROM metrics")
+    assert [list(x) for x in r.result_table.rows] == [[13, 67.5]]
