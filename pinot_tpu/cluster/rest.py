@@ -103,8 +103,12 @@ class _JsonHandler(BaseHTTPRequestHandler):
         self.principal = None
         routes = [r if len(r) == 3 else (r[0], r[1], access_type)
                   for r in routes]
+        # health endpoints (incl. /health/liveness, /health/readiness) are
+        # auth-exempt: orchestrator probes carry no credentials (reference:
+        # health resources sit outside the auth filter)
         if ac is not None and not isinstance(ac, AllowAllAccessControl) \
-                and parsed.path != "/health":
+                and parsed.path != "/health" \
+                and not parsed.path.startswith("/health/"):
             self.principal = ac.authenticate(self.headers)
             if self.principal is None:
                 self.send_response(401)
@@ -446,3 +450,148 @@ class ControllerRestServer(_RestServer):
             num_rows=int(body.get("numRows", 1_000_000)),
             qps=float(body.get("qps", 10.0)))
         return 200, rec.to_json()
+
+
+class ServerRestServer(_RestServer):
+    """Server-role admin/debug REST (reference: pinot-server/.../api/
+    resources — TablesResource /tables + /tables/{t}/segments,
+    /segments/{t}/{s}/metadata, DebugResource, HealthCheckResource
+    /health/liveness + /health/readiness). Read-only introspection of one
+    server's hosted state plus query-kill; cluster mutations stay on the
+    controller REST."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
+                 access_control=None):
+        srv = self
+
+        class Handler(_JsonHandler):
+            routes_get = [
+                (r"/health/liveness", lambda h, m, q: (200, {"status": "OK"})),
+                (r"/health(/readiness)?", lambda h, m, q: srv._readiness()),
+                (r"/instance", lambda h, m, q: srv._instance()),
+                (r"/tables", lambda h, m, q: (200, {
+                    "tables": sorted(srv.server.segments)})),
+                (r"/tables/([^/]+)/segments",
+                 lambda h, m, q: srv._table_segments(m.group(1))),
+                (r"/tables/([^/]+)/size",
+                 lambda h, m, q: srv._table_size(m.group(1))),
+                (r"/segments/([^/]+)/([^/]+)/metadata",
+                 lambda h, m, q: srv._segment_metadata(m.group(1), m.group(2))),
+                (r"/debug/tables/([^/]+)",
+                 lambda h, m, q: srv._debug_table(m.group(1))),
+                (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
+            ]
+            routes_post = [
+                (r"/queries/([^/]+)/kill",
+                 lambda h, m, q: srv._kill_query(m.group(1)), "WRITE"),
+            ]
+            routes_delete = []
+
+        Handler.access_control = access_control
+        self.server = server
+        super().__init__(Handler, host, port)
+
+    def _readiness(self):
+        """Readiness gates on Helix join + converged state (reference:
+        ServiceStatus consumption/ideal-state checkers)."""
+        ok = bool(getattr(self.server, "_started", False))
+        return (200 if ok else 503), {"status": "OK" if ok else "STARTING"}
+
+    def _instance(self):
+        host, port = self.server.address
+        return 200, {"instanceId": self.server.instance_id,
+                     "host": host, "port": port,
+                     "tags": self.server.tags,
+                     "backend": self.server.backend}
+
+    def _table_segments(self, table: str):
+        segs = self.server.segments.get(table)
+        if segs is None:
+            return 404, {"error": f"table {table} not hosted"}
+        return 200, {"segments": [
+            {"name": name, "numDocs": seg.num_docs,
+             "mutable": bool(getattr(seg, "is_mutable", False))}
+            for name, seg in sorted(segs.items())]}
+
+    def _table_size(self, table: str):
+        segs = self.server.segments.get(table)
+        if segs is None:
+            return 404, {"error": f"table {table} not hosted"}
+        per_seg = {}
+        for name, seg in segs.items():
+            loc = getattr(seg, "directory", None)
+            nbytes = 0
+            if loc:
+                import os as _os
+
+                for root, _dirs, files in _os.walk(str(loc)):
+                    nbytes += sum(
+                        _os.path.getsize(_os.path.join(root, f))
+                        for f in files)
+            per_seg[name] = {"diskSizeBytes": nbytes,
+                             "numDocs": seg.num_docs}
+        return 200, {"tableName": table, "segments": per_seg,
+                     "totalDiskSizeBytes": sum(
+                         v["diskSizeBytes"] for v in per_seg.values())}
+
+    def _segment_metadata(self, table: str, segment: str):
+        segs = self.server.segments.get(table) or {}
+        seg = segs.get(segment)
+        if seg is None:
+            return 404, {"error": f"{table}/{segment} not hosted"}
+        meta = {"segmentName": segment, "numDocs": seg.num_docs,
+                "mutable": bool(getattr(seg, "is_mutable", False))}
+        cols = {}
+        for c in getattr(seg, "columns", lambda: [])() \
+                if callable(getattr(seg, "columns", None)) \
+                else getattr(seg, "columns", []):
+            m = seg.column_metadata(c) if hasattr(seg, "column_metadata") \
+                else None
+            if m is not None:
+                cols[c] = {"cardinality": getattr(m, "cardinality", None),
+                           "dataType": str(getattr(m, "data_type", "")),
+                           "singleValue": getattr(m, "single_value", True),
+                           "minValue": _json_safe(getattr(m, "min_value", None)),
+                           "maxValue": _json_safe(getattr(m, "max_value", None))}
+        if cols:
+            meta["columns"] = cols
+        return 200, meta
+
+    def _debug_table(self, table: str):
+        """Hosted vs ideal comparison for one table (reference:
+        DebugResource.getTableDebugInfo segment-error surface)."""
+        hosted = set(self.server.segments.get(table) or {})
+        ideal = self.server.store.get(f"/IDEALSTATES/{table}") or {}
+        want = {s for s, inst_map in ideal.items()
+                if self.server.instance_id in inst_map}
+        return 200, {"tableName": table,
+                     "hostedSegments": sorted(hosted),
+                     "idealSegments": sorted(want),
+                     "missing": sorted(want - hosted),
+                     "unexpected": sorted(hosted - want)}
+
+    def _debug_queries(self):
+        from ..engine.scheduler import GLOBAL_ACCOUNTANT
+
+        return 200, {"inflight": GLOBAL_ACCOUNTANT.inflight(),
+                     "allocatedBytes": GLOBAL_ACCOUNTANT.total_allocated()}
+
+    def _kill_query(self, query_id: str):
+        from ..engine.scheduler import GLOBAL_ACCOUNTANT
+
+        ok = GLOBAL_ACCOUNTANT.kill_query(query_id)
+        return (200 if ok else 404), {
+            "queryId": query_id, "killed": ok}
+
+
+def _json_safe(v):
+    if hasattr(v, "item"):  # numpy scalar → native number, not a string
+        try:
+            return v.item()
+        except (TypeError, ValueError):
+            pass
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)

@@ -13,13 +13,11 @@ loop over a queue) can land rows as query-ready segments.
 
 from __future__ import annotations
 
-from pathlib import Path
 from typing import Mapping, Optional
 
+from ..ingestion.batch import upload_segment_from_rows
 from ..ingestion.transform import build_transform_pipeline
-from ..segment.builder import SegmentBuilder
 from ..spi.data_types import Schema
-from ..spi.filesystem import get_fs
 from ..spi.table_config import TableConfig
 
 
@@ -97,18 +95,13 @@ class StreamingSegmentWriter:
         name = (f"{self.schema.schema_name}_{self.partition_id}"
                 f"_{self._seq}")
         self._seq += 1
-        import tempfile
-
-        with tempfile.TemporaryDirectory() as tmp:
-            local = Path(tmp) / name
-            SegmentBuilder(self.schema, self.table_config, name) \
-                .build_from_rows(self._rows, local)
-            out_uri = f"{self.out_dir_uri}/{name}"
-            fs = get_fs(self.out_dir_uri)
-            fs.mkdir(self.out_dir_uri)
-            fs.copy_from_local(str(local), out_uri)
+        out_uri, partitions = upload_segment_from_rows(
+            self.schema, self.table_config, name, self._rows,
+            self.out_dir_uri)
         if self.controller is not None:
             meta = {"location": out_uri, "numDocs": len(self._rows)}
+            if partitions:
+                meta["partitions"] = partitions
             if self.time_column:
                 tv = [r[self.time_column] for r in self._rows
                       if r.get(self.time_column) is not None]

@@ -148,6 +148,34 @@ def _worker_init(setup_module: Optional[str]) -> None:
         importlib.import_module(setup_module)
 
 
+def upload_segment_from_rows(schema: Schema, table_config, segment_name: str,
+                             rows, out_dir_uri: str,
+                             create_tar: bool = False) -> tuple[str, dict]:
+    """Rows → two-pass segment build → upload to the output FS. Returns
+    (out_uri, partition stamps). The ONE build-and-upload recipe shared by
+    the batch runners and the streaming sink, so metadata (partition
+    stamps, tar layout) can't diverge between push paths."""
+    with tempfile.TemporaryDirectory() as tmp:
+        local = Path(tmp) / segment_name
+        SegmentBuilder(schema, table_config, segment_name) \
+            .build_from_rows(rows, local)
+        from ..segment.format import partition_push_metadata
+
+        parts = partition_push_metadata(local).get("partitions", {})
+        out_uri = f"{out_dir_uri.rstrip('/')}/{segment_name}"
+        fs = get_fs(out_dir_uri)
+        fs.mkdir(out_dir_uri)
+        if create_tar:
+            tar_path = Path(tmp) / f"{segment_name}.tar.gz"
+            with tarfile.open(tar_path, "w:gz") as tf:
+                tf.add(local, arcname=segment_name)
+            out_uri += ".tar.gz"
+            fs.copy_from_local(str(tar_path), out_uri)
+        else:
+            fs.copy_from_local(str(local), out_uri)
+    return out_uri, parts
+
+
 def _generate_one_job(spec: SegmentGenerationJobSpec, path: str,
                       seq: int) -> SegmentGenerationResult:
     """File → segment → push, self-contained so worker processes can run it
@@ -165,23 +193,9 @@ def _generate_one_job(spec: SegmentGenerationJobSpec, path: str,
             filtered += 1
             continue
         rows.append(row)
-    with tempfile.TemporaryDirectory() as tmp:
-        local = Path(tmp) / segment_name
-        SegmentBuilder(spec.schema, spec.table_config, segment_name) \
-            .build_from_rows(rows, local)
-        from ..segment.format import partition_push_metadata
-
-        parts = partition_push_metadata(local).get("partitions", {})
-        out_uri = f"{spec.output_dir_uri.rstrip('/')}/{segment_name}"
-        fs = get_fs(spec.output_dir_uri)
-        if spec.create_tar:
-            tar_path = Path(tmp) / f"{segment_name}.tar.gz"
-            with tarfile.open(tar_path, "w:gz") as tf:
-                tf.add(local, arcname=segment_name)
-            out_uri += ".tar.gz"
-            fs.copy_from_local(str(tar_path), out_uri)
-        else:
-            fs.copy_from_local(str(local), out_uri)
+    out_uri, parts = upload_segment_from_rows(
+        spec.schema, spec.table_config, segment_name, rows,
+        spec.output_dir_uri, create_tar=spec.create_tar)
     return SegmentGenerationResult(segment_name, out_uri, len(rows), filtered,
                                    partitions=parts)
 

@@ -273,6 +273,33 @@ def upsert_compaction_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
     return {"compacted": compacted}
 
 
+def upsert_compact_merge_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
+    """Compact N upsert segments AND merge the survivors into one segment
+    (reference: UpsertCompactMergeTaskExecutor — the compact-only task
+    still leaves many small segments; this variant concats the valid rows
+    of a group of segments into a single replacement). Validity snapshots
+    ride in the config exactly like UpsertCompactionTask."""
+    table = spec.table
+    schema = _schema_of(ctx, table)
+    valid_ids = spec.config["validDocIds"]  # {segment: [valid doc ids]}
+    group = spec.config.get("segments") or sorted(valid_ids)
+    rows: list[dict] = []
+    dropped = 0
+    for name in group:
+        seg = _load(ctx, table, name)
+        keep = set(valid_ids.get(name, range(seg.num_docs)))
+        kept = [r for i, r in enumerate(segment_rows(seg)) if i in keep]
+        dropped += seg.num_docs - len(kept)
+        rows.extend(kept)
+    new_name = spec.config.get(
+        "mergedSegmentName", f"{group[0]}_merged_{len(group)}")
+    _build_and_add(ctx, table, new_name, schema, rows)
+    for name in group:
+        ctx.controller.drop_segment(table, name)
+    return {"merged": group, "outputSegment": new_name,
+            "numDocs": len(rows), "invalidDropped": dropped}
+
+
 # -- RefreshSegmentTask ------------------------------------------------------
 
 
@@ -352,11 +379,23 @@ def segment_gen_push_generator(controller, table: str,
     # sequence ids come from a monotonic per-table counter in the store —
     # NOT the file's position in today's listing, which would reuse a
     # consumed seq (and thus a segment name) when a late-arriving file
-    # sorts before already-ingested ones
+    # sorts before already-ingested ones. An ABSENT counter seeds past any
+    # existing `{prefix}_{n}` segments (tables first loaded through the
+    # standalone/whole-job path carry no counter, and reusing their names
+    # would overwrite their metadata).
+    import re as _re
+
+    prefix = cfg.get("segmentNamePrefix") or raw_table_name(table)
+    pat = _re.compile(rf"^{_re.escape(prefix)}_(\d+)$")
+    floor = 0
+    for seg in controller.store.children(f"/SEGMENTS/{table}"):
+        m = pat.match(seg)
+        if m:
+            floor = max(floor, int(m.group(1)) + 1)
     base = {"n": 0}
 
     def alloc(cur):
-        cur = int(cur or 0)
+        cur = max(int(cur or 0), floor)
         base["n"] = cur
         return cur + len(new_files)
 
@@ -415,6 +454,7 @@ register_task_executor("RealtimeToOfflineSegmentsTask", rt2off_executor)
 register_task_generator("PurgeTask", purge_generator)
 register_task_executor("PurgeTask", purge_executor)
 register_task_executor("UpsertCompactionTask", upsert_compaction_executor)
+register_task_executor("UpsertCompactMergeTask", upsert_compact_merge_executor)
 register_task_executor("RefreshSegmentTask", refresh_executor)
 register_task_generator("SegmentGenerationAndPushTask",
                         segment_gen_push_generator)

@@ -854,12 +854,24 @@ def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n,
         # the asked quantile).
         bins = agg.bins
         num_groups = num_segments - 1
-        v = _eval_value(agg.vexpr, arrays, params).astype(jnp.float64)
-        lo = params[agg.lo_param]
-        hi = params[agg.hi_param]
-        width1 = (hi - lo) / bins
-        b1 = jnp.clip(((v - lo) / width1).astype(jnp.int32), 0, bins - 1)
-        inside = mask & (v >= lo) & (v <= hi)
+        # the whole-column binning arithmetic runs in f32: the TPU has no
+        # f64 ALU (XLA software-emulates it, ~10x), and bucket assignment
+        # only needs edge precision — an edge-adjacent row landing one
+        # bucket over moves the decoded quantile by ≤ 1 refined bucket,
+        # already inside the stated range/bins^2 bound. The ONE op kept in
+        # f64 is the (v - lo) rebase: casting v itself to f32 would round
+        # by ulp(|v|), which for large-magnitude narrow-range columns
+        # (epoch-millis) dwarfs the bucket width; the rebased offset has
+        # magnitude ≤ (hi-lo) where f32 ulp is ~1e-7 of the range.
+        # Membership between the two passes stays BIT-IDENTICAL because
+        # pass 2 recomputes b1 with the same ops.
+        v64 = _eval_value(agg.vexpr, arrays, params).astype(jnp.float64)
+        lo64 = params[agg.lo_param]
+        v = (v64 - lo64).astype(jnp.float32)  # offset from lo, f32-safe
+        span = jnp.float32(params[agg.hi_param] - lo64)
+        width1 = span / bins
+        b1 = jnp.clip((v / width1).astype(jnp.int32), 0, bins - 1)
+        inside = mask & (v >= 0) & (v <= span)
         sid1 = jnp.where(inside, gid * jnp.int32(bins) + b1,
                          jnp.int32(num_groups * bins))
         h1 = _mxu_or_scatter_counts(inside, sid1, num_groups * bins + 1)
@@ -869,11 +881,12 @@ def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n,
         bstar = jnp.argmax(cum.astype(jnp.float64) >= rank[:, None],
                            axis=1).astype(jnp.int32)
         # refine rows whose COARSE bin equals their group's target bucket
-        # (b1 equality, not float range tests: bit-identical membership)
+        # (b1 equality, not float range tests: bit-identical membership);
+        # bucket offsets stay relative to lo, so all f32 magnitudes ≤ span
         bstar_pad = jnp.concatenate([bstar, jnp.zeros(1, jnp.int32)])
         bstar_r = bstar_pad[jnp.minimum(gid, num_groups)]
-        lo_g = lo + bstar.astype(jnp.float64) * width1
-        lo_r = jnp.concatenate([lo_g, jnp.zeros(1)])[
+        lo_g = bstar.astype(jnp.float32) * width1
+        lo_r = jnp.concatenate([lo_g, jnp.zeros(1, jnp.float32)])[
             jnp.minimum(gid, num_groups)]
         width2 = width1 / bins
         inside2 = inside & (b1 == bstar_r)

@@ -29,7 +29,8 @@ def cmd_quickstart(args) -> int:
     """Boot an in-process cluster with sample data and serve HTTP
     (reference: the Quickstart command's batch flavor)."""
     from ..cluster import Broker, ClusterController, PropertyStore, ServerInstance
-    from ..cluster.rest import BrokerRestServer, ControllerRestServer
+    from ..cluster.rest import (BrokerRestServer, ControllerRestServer,
+                                ServerRestServer)
     from ..segment.builder import SegmentBuilder
     from ..spi.data_types import Schema
     from ..timeseries import TimeSeriesEngine
@@ -79,8 +80,11 @@ def cmd_quickstart(args) -> int:
     broker_rest = BrokerRestServer(broker, port=args.broker_port,
                                    timeseries_engine=ts_engine)
     controller_rest = ControllerRestServer(controller, port=args.controller_port)
+    server_rests = [ServerRestServer(s) for s in servers]
     print(f"broker:     {broker_rest.url}")
     print(f"controller: {controller_rest.url}")
+    for s_inst, sr in zip(servers, server_rests):
+        print(f"server {s_inst.instance_id}: {sr.url}")
 
     demo = [
         "SELECT COUNT(*) FROM baseballStats",
@@ -101,6 +105,8 @@ def cmd_quickstart(args) -> int:
     if args.once:
         broker_rest.close()
         controller_rest.close()
+        for sr in server_rests:
+            sr.close()
         for s in servers:
             s.stop()
         return 0

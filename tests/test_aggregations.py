@@ -325,3 +325,44 @@ def test_adaptive_hist_percentile_accuracy(tmp_path):
         vals = np.sort(cols["fare"][cols["day"] == day])
         exact = float(vals[int(len(vals) * 0.95)])
         assert abs(got[day] - exact) <= tol, (day, got[day], exact, tol)
+
+
+def test_adaptive_hist_large_magnitude_values(tmp_path):
+    """Binning runs in f32 AFTER an f64 rebase to lo — large-magnitude
+    narrow-range columns (epoch-millis) must keep the range/bins^2 bound
+    (an f32 cast of v itself would round by ulp(1.7e12) ≈ 131s)."""
+    rng = np.random.default_rng(11)
+    n = 200_000
+    base = 1.7e12  # epoch millis
+    span_ms = 3_600_000.0  # one hour
+    schema = Schema.build(
+        "evt", dimensions=[("day", "INT")], metrics=[("ts", "DOUBLE")])
+    cols = {"day": rng.integers(0, 10, n).astype(np.int32),
+            "ts": base + rng.uniform(0, span_ms, n)}
+    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+    cfg = TableConfig(table_name="evt", indexing=IndexingConfig(
+        no_dictionary_columns=["ts"]))
+    SegmentBuilder(schema, cfg, "e0").build(cols, tmp_path / "e0")
+    seg = load_segment(tmp_path / "e0")
+
+    from pinot_tpu.engine.plan import SegmentPlanner
+    from pinot_tpu.query.parser.sql import parse_sql
+
+    sql = "SELECT day, PERCENTILETDIGEST(ts, 95) FROM evt GROUP BY day LIMIT 100"
+    plan = SegmentPlanner(parse_sql(sql), seg).plan()
+    assert {op.kind for op in plan.program.aggs} == {"hist_adaptive"}
+    bins = next(op.bins for op in plan.program.aggs)
+
+    tpu = QueryExecutor(backend="tpu")
+    tpu.add_table(schema, [seg])
+    r = tpu.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    got = {int(row[0]): float(row[1]) for row in r.result_table.rows}
+    vspan = cols["ts"].max() - cols["ts"].min()
+    tol = 2 * vspan / (bins * bins)
+    assert tol < span_ms / 100  # the bound itself is sub-1%-of-range
+    for day in (0, 4, 9):
+        vals = np.sort(cols["ts"][cols["day"] == day])
+        exact = float(vals[int(len(vals) * 0.95)])
+        assert abs(got[day] - exact) <= tol, (day, got[day] - exact, tol)

@@ -255,3 +255,83 @@ def test_streaming_segment_writer_sink(cluster, tmp_path):
     assert w2.segments == [str(tmp_path / "sink_out") + "/metrics_3_3"]
     r = broker.execute_sql("SELECT COUNT(*), SUM(cpu) FROM metrics")
     assert [list(x) for x in r.result_table.rows] == [[13, 67.5]]
+
+
+def _enqueue_task(store, task_type, table, config):
+    from pinot_tpu.minion.framework import PENDING, TaskSpec
+    import uuid
+
+    spec = TaskSpec(task_type, table, config=config,
+                    task_id=f"{task_type}_{uuid.uuid4().hex[:8]}")
+    store.set(spec.path(), {
+        "state": PENDING, "table": spec.table, "taskType": spec.task_type,
+        "config": spec.config, "owner": None, "output": None, "error": None})
+    return spec.task_id
+
+
+def test_upsert_compaction_task(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({"tableName": "metrics", "replication": 1})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 1.0}, {"host": "a", "day": 2, "cpu": 2.0},
+         {"host": "b", "day": 1, "cpu": 4.0}],
+    ])
+    # doc 0 invalidated by a newer version of ("a") elsewhere
+    tid = _enqueue_task(store, "UpsertCompactionTask", table,
+                        {"validDocIds": {"seg_0": [1, 2]}})
+    assert minion.run_pending_once() == 1
+    st = task_mgr.task_state("UpsertCompactionTask", tid)
+    assert st["state"] == "COMPLETED", st
+    assert st["output"]["compacted"] == {"seg_0": 1}
+    r = broker.execute_sql("SELECT COUNT(*), SUM(cpu) FROM metrics")
+    assert [list(x) for x in r.result_table.rows] == [[2, 6.0]]
+
+
+def test_upsert_compact_merge_task(cluster, tmp_path):
+    store, controller, server, broker, task_mgr, minion = cluster
+    table = controller.create_table({"tableName": "metrics", "replication": 1})
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "a", "day": 1, "cpu": 1.0}, {"host": "b", "day": 1, "cpu": 2.0}],
+        [{"host": "a", "day": 2, "cpu": 4.0}, {"host": "c", "day": 2, "cpu": 8.0}],
+    ])
+    tid = _enqueue_task(store, "UpsertCompactMergeTask", table, {
+        "validDocIds": {"seg_0": [1], "seg_1": [0, 1]},
+        "segments": ["seg_0", "seg_1"]})
+    assert minion.run_pending_once() == 1
+    st = task_mgr.task_state("UpsertCompactMergeTask", tid)
+    assert st["state"] == "COMPLETED", st
+    out = st["output"]
+    assert out["invalidDropped"] == 1 and out["numDocs"] == 3
+    # the two inputs are replaced by ONE merged segment
+    assert store.children(f"/SEGMENTS/{table}") == [out["outputSegment"]]
+    r = broker.execute_sql(
+        "SELECT host, SUM(cpu) FROM metrics GROUP BY host ORDER BY host")
+    assert [list(x) for x in r.result_table.rows] == \
+        [["a", 4.0], ["b", 2.0], ["c", 8.0]]
+
+
+def test_segment_generation_seeds_past_existing_segments(cluster, tmp_path):
+    """A table first loaded through the whole-job path (no inputFile
+    markers, no counter) must not have its segments overwritten when the
+    per-file generator is enabled: the counter seeds past `{prefix}_{n}`."""
+    store, controller, server, broker, task_mgr, minion = cluster
+    input_dir = tmp_path / "inc"
+    input_dir.mkdir()
+    table = controller.create_table({
+        "tableName": "metrics", "replication": 1,
+        "taskConfigs": {"SegmentGenerationAndPushTask": {
+            "inputDirURI": str(input_dir),
+            "outputDirURI": str(tmp_path / "gen2"),
+            "includeFileNamePattern": "*.csv"}}})
+    # pre-existing whole-job segments named metrics_0 / metrics_1
+    _add_segments(controller, table, tmp_path, [
+        [{"host": "x", "day": 1, "cpu": 1.0}]])
+    controller.store.set(f"/SEGMENTS/{table}/metrics_0",
+                         {"location": "x", "numDocs": 1})
+    controller.store.set(f"/SEGMENTS/{table}/metrics_1",
+                         {"location": "y", "numDocs": 1})
+    (input_dir / "new.csv").write_text("host,day,cpu\nh1,1,5.0\n")
+    ids = task_mgr.schedule_tasks()
+    assert len(ids) == 1
+    t = store.get(f"/TASKS/SegmentGenerationAndPushTask/{ids[0]}")
+    assert t["config"]["sequenceId"] >= 2  # past metrics_0/metrics_1

@@ -34,14 +34,14 @@ def stack(tmp_path):
                                          "numDocs": 4})
     brest = BrokerRestServer(broker)
     crest = ControllerRestServer(controller)
-    yield brest, crest, controller
+    yield brest, crest, controller, server
     brest.close()
     crest.close()
     server.stop()
 
 
 def test_query_over_http(stack):
-    brest, _, _ = stack
+    brest = stack[0]
     conn = connect(brest.url)
     rs = conn.execute("SELECT path, SUM(hits) FROM web GROUP BY path ORDER BY path")
     assert rs.column_names[0] == "path"
@@ -51,14 +51,14 @@ def test_query_over_http(stack):
 
 
 def test_query_error_surfaces(stack):
-    brest, _, _ = stack
+    brest = stack[0]
     conn = connect(brest.url)
     with pytest.raises(PinotClientError, match="not found"):
         conn.execute("SELECT * FROM nosuch")
 
 
 def test_controller_rest_endpoints(stack, tmp_path):
-    _, crest, controller = stack
+    _, crest, controller, _ = stack
 
     def get(path):
         with urllib.request.urlopen(crest.url + path) as r:
@@ -95,7 +95,7 @@ def test_controller_rest_endpoints(stack, tmp_path):
 
 
 def test_http_404(stack):
-    brest, _, _ = stack
+    brest = stack[0]
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(brest.url + "/nope")
     assert e.value.code == 404
@@ -230,3 +230,50 @@ def test_rest_rebalance_and_instance_partitions(tmp_path):
         rest.close()
         for s in servers:
             s.stop()
+
+
+def test_server_rest_endpoints(stack):
+    """Server-role admin/debug REST (reference: pinot-server api/resources)."""
+    from pinot_tpu.cluster.rest import ServerRestServer
+
+    server = stack[3]
+    rest = ServerRestServer(server)
+    try:
+        def get(path, expect=200):
+            try:
+                with urllib.request.urlopen(rest.url + path) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                assert e.code == expect, (path, e.code)
+                return e.code, json.loads(e.read())
+
+        st, h = get("/health")
+        assert st == 200 and h["status"] == "OK"
+        st, inst = get("/instance")
+        assert inst["instanceId"] == "Server_0"
+        st, tables = get("/tables")
+        assert "web_OFFLINE" in tables["tables"]
+        st, segs = get("/tables/web_OFFLINE/segments")
+        assert segs["segments"][0]["name"] == "w0"
+        assert segs["segments"][0]["numDocs"] == 4
+        st, size = get("/tables/web_OFFLINE/size")
+        assert size["totalDiskSizeBytes"] > 0
+        st, meta = get("/segments/web_OFFLINE/w0/metadata")
+        assert meta["numDocs"] == 4
+        assert meta["columns"]["path"]["cardinality"] == 3
+        st, dbg = get("/debug/tables/web_OFFLINE")
+        assert dbg["hostedSegments"] == ["w0"]
+        assert dbg["missing"] == []
+        st, q = get("/debug/queries")
+        assert q["inflight"] == []
+        st, _ = get("/tables/nosuch/segments", expect=404)
+        assert st == 404
+        # liveness vs readiness split
+        st, _ = get("/health/liveness")
+        assert st == 200
+        server._started = False
+        st, r = get("/health/readiness", expect=503)
+        assert st == 503 and r["status"] == "STARTING"
+        server._started = True
+    finally:
+        rest.close()
