@@ -320,7 +320,7 @@ def test_fuzz_filter_clause_and_aliases(env):
         _check(qe, oracle, sql, oracle_sql)
 
 
-def test_fuzz_having(env):
+def test_fuzz_having_with_where(env):
     qe, oracle = env
     rng = np.random.default_rng(7)
     for _ in range(30):
@@ -344,3 +344,36 @@ def test_fuzz_derived_tables(env):
         sql = (f"SELECT COUNT(*) FROM (SELECT {dim}, SUM(amount) AS s FROM fz "
                f"GROUP BY {dim}) WHERE s > {cut}")
         _check(qe, oracle, sql)
+
+
+def test_fuzz_windows_mse(env):
+    """Window functions through the MSE vs sqlite (reference: V2 window
+    operator H2-verified tests)."""
+    qe, oracle = env
+    rng = np.random.default_rng(9)
+    fns = ["ROW_NUMBER()", "RANK()", "DENSE_RANK()",
+           "SUM(amount)", "COUNT(*)", "MIN(score)", "MAX(score)"]
+    for _ in range(25):
+        fn = rng.choice(fns)
+        part = rng.choice(STR_COLS)
+        # deterministic total order: break amount ties by rowid-ish code+city
+        order = "amount, code, city"
+        w = _where(rng)
+        sql = (f"SELECT city, code, amount, {fn} OVER "
+               f"(PARTITION BY {part} ORDER BY {order}) FROM fz{w} LIMIT 5000")
+        oracle_sql = sql.replace(" LIMIT 5000", "")
+        _check(qe, oracle, sql, oracle_sql)
+
+
+def test_fuzz_setops_mse(env):
+    """UNION/INTERSECT/EXCEPT [ALL] through the MSE vs sqlite."""
+    qe, oracle = env
+    rng = np.random.default_rng(10)
+    for _ in range(25):
+        op = rng.choice(["UNION", "UNION ALL", "INTERSECT", "EXCEPT"])
+        c1 = int(rng.integers(0, 400))
+        c2 = int(rng.integers(0, 400))
+        sql = (f"SELECT city, code FROM fz WHERE amount > {c1} "
+               f"{op} SELECT city, code FROM fz WHERE score > {c2} LIMIT 9000")
+        oracle_sql = sql.replace(" LIMIT 9000", "")
+        _check(qe, oracle, sql, oracle_sql)
