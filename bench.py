@@ -54,7 +54,7 @@ _START = time.monotonic()
 # q6 runs LAST: its sparse-distinct program has the slowest cold compile,
 # and a hung/abandoned child skips every config after it
 CONFIGS = [c for c in os.environ.get(
-    "BENCH_CONFIGS", "q1,q2,q3,q4,q5,q7,q6").split(",") if c]
+    "BENCH_CONFIGS", "q1,q2,q3,q4,q5,q7,q8,q6").split(",") if c]
 ROOT = Path(__file__).parent
 CACHE = ROOT / ".bench_cache"
 # smoke/dev runs point this elsewhere (BENCH_PARTIAL_DIR) so they never
@@ -85,6 +85,15 @@ Q7 = ("SELECT d_year, LOOKUP('brands', 'b_category', 'b_id', p_brand), "
       "WHERE LOOKUP('brands', 'b_region', 'b_id', p_brand) = 'ASIA' "
       "GROUP BY d_year, LOOKUP('brands', 'b_category', 'b_id', p_brand) "
       "LIMIT 1000")
+# MSE equi-join (the full V2 pipeline: device leaf selections → shuffle →
+# sort-merge join, device-side when the key volume clears the gate —
+# mse/device_join.py; reference pattern: HashJoinOperator two-table query).
+# Filters keep the pair count bounded: ~4%·N ⋈ ~9%·N on a N/10-key space
+# ≈ 0.036·N expected output pairs.
+Q8 = ("SELECT a.d_year, COUNT(*), SUM(b.lo_revenue) FROM {t} a "
+      "JOIN {t} b ON a.lo_orderkey = b.lo_orderkey "
+      "WHERE a.lo_quantity < 3 AND b.lo_discount = 0 "
+      "GROUP BY a.d_year ORDER BY a.d_year LIMIT 100")
 
 RUNS = {
     "q1": ("q1_filter_sum", Q1.format(t="ssb"), "ssb", 1.0, 0.0),
@@ -101,6 +110,7 @@ RUNS = {
     "q5": ("q5_distinct_tdigest", Q5, "taxi", 1 / 3, 0.02),
     "q6": ("q6_sparse_distinct", Q6.format(t="ssb"), "ssb", 1 / 3, 0.0),
     "q7": ("q7_lookup_join", Q7.format(t="ssb"), "ssb", 1.0, 0.0),
+    "q8": ("q8_mse_join", Q8.format(t="ssb"), "ssb", 1 / 3, 0.0),
 }
 
 N_BRANDS = 1000
@@ -274,7 +284,9 @@ def _emit(results, platform, notes, skipped, final=False):
     """(Re-)print the one-line summary JSON; also persist to .bench_partial."""
     if "q2_groupby" in results:
         hname = "q2_groupby"
-        metric = "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip"
+        # row count rides in the name so scaled (cpu-fallback) runs
+        # never masquerade as the 100M-row series
+        metric = f"ssb_{ROWS // 1_000_000}m_q2_filter_groupby_rows_per_sec_per_chip"
     elif results:
         hname = next(iter(results))
         metric = f"{hname}_rows_per_sec_per_chip"
@@ -342,7 +354,7 @@ def orchestrate():
         notes.append("cpu fallback: rows scaled to 20M")
         print("[bench] cpu fallback: ROWS -> 20M", file=sys.stderr)
 
-    need_ssb = any(c in CONFIGS for c in ("q1", "q2", "q3", "q6"))
+    need_ssb = any(c in CONFIGS for c in ("q1", "q2", "q3", "q6", "q7", "q8"))
     prepare_tables(need_ssb, "q4" in CONFIGS, "q5" in CONFIGS)
 
     PARTIAL.mkdir(exist_ok=True)
@@ -419,18 +431,26 @@ def orchestrate():
 # child: run exactly one config, bounded by an internal deadline
 # --------------------------------------------------------------------------
 
-def _init_backend():
-    import jax
+def _set_compile_cache(jax, platform: str) -> None:
+    """Persist compiles across bench runs (no-op for remote compile).
 
-    try:  # persist compiles across bench runs (no-op for remote compile).
-        # NOT shared with the test suite's cache: pytest compiles under
-        # different XLA flags and the AOT loader warns cross-loading could
-        # SIGILL on mismatched machine-feature sets
+    NOT shared with the test suite's cache: pytest compiles under
+    different XLA flags and the AOT loader warns cross-loading could
+    SIGILL on mismatched machine-feature sets. Keyed per RESOLVED platform
+    for the same reason: CPU AOT entries are machine-feature-sensitive
+    while TPU entries are not — a cpu-fallback run must never write into
+    (or load from) the TPU-keyed cache."""
+    try:
         jax.config.update("jax_compilation_cache_dir",
-                          str(ROOT / ".jax_cache_bench"))
+                          str(ROOT / f".jax_cache_bench_{platform}"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass
+
+
+def _init_backend():
+    import jax
+
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     last_err = None
@@ -440,6 +460,7 @@ def _init_backend():
         try:
             devs = jax.devices()
             print(f"[bench] devices: {devs}", file=sys.stderr)
+            _set_compile_cache(jax, devs[0].platform)
             return jax, devs[0].platform, None
         except Exception as e:
             last_err = e
@@ -456,6 +477,7 @@ def _init_backend():
         jex_backend.clear_backends()
     except Exception:
         pass
+    _set_compile_cache(jax, "cpu")
     return jax, "cpu", f"accelerator init failed, ran on cpu: {last_err}"
 
 
@@ -649,7 +671,7 @@ if __name__ == "__main__":
         traceback.print_exc()
         if "--config" not in sys.argv:
             print(json.dumps({
-                "metric": "ssb_100m_q2_filter_groupby_rows_per_sec_per_chip",
+                "metric": f"ssb_{ROWS // 1_000_000}m_q2_filter_groupby_rows_per_sec_per_chip",
                 "value": 0,
                 "unit": "rows/s",
                 "vs_baseline": 0,

@@ -146,8 +146,11 @@ class TpuSegmentExecutor:
 
             host = HostSegmentExecutor()
             evaluator = lambda e, doc_ids: host.eval_value_at(e, segment, doc_ids)  # noqa: E731
+        # kernel emits the mask bit-packed (kernels.py selection mode)
+        bits = np.unpackbits(np.asarray(mask),
+                             bitorder="little")[: segment.num_docs]
         return selection_from_mask(query, segment, plan.selection_columns,
-                                   np.asarray(mask[: segment.num_docs]),
+                                   bits.astype(bool),
                                    extra_exprs=plan.selection_exprs or None,
                                    evaluator=evaluator)
 

@@ -354,7 +354,13 @@ def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_doc
         mask = valid
 
     if program.mode == "selection":
-        return (mask,)
+        # ship the mask as a BITMAP (n/8 uint8), not one byte per row: a
+        # 100M-row segment's selection leaf costs 12.5MB D2H instead of
+        # 100MB — the MSE leaf-selection transfer is tunnel-bound.
+        # Padded buckets (and row shards of them) are always 8-divisible.
+        # bitorder matches every other packed bitmap in the repo
+        # (segment/bitpack.py, aggregation.py occupancy words: little).
+        return (jnp.packbits(mask, bitorder="little"),)
 
     if program.mv_group_slot is not None and program.mode in (
             "group_by", "group_by_sparse"):
