@@ -91,6 +91,9 @@ class Broker:
         self.quota = QueryQuotaManager()
         self.response_store = ResponseStore()
         self.adaptive_selection = adaptive_selection
+        from .querylog import QueryLogger
+
+        self.query_logger = QueryLogger()
         self._server_stats: dict[str, _ServerStats] = {}
         self._clients: dict[str, RpcClient] = {}
         self._rr = 0  # round-robin cursor for replica selection
@@ -196,8 +199,9 @@ class Broker:
         try:
             resp = self._execute(query, only_segments=segments)
         except Exception as e:
-            return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
+            resp = BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
+        self.query_logger.log(sql, resp, table=query.table_name)
         return resp
 
     def execute_sql_stream(self, sql: str):

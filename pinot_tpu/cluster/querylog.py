@@ -1,0 +1,60 @@
+"""Broker query log with rate throttling.
+
+Reference analogue: pinot-broker/.../querylog/QueryLogger.java — one
+structured log line per completed query (requestId, table, latency,
+docs scanned/table size, exceptions), throttled by a token-bucket rate
+limiter so a hot broker can't melt the log volume; dropped lines are
+counted and surfaced periodically.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+
+logger = logging.getLogger("pinot_tpu.querylog")
+
+
+class QueryLogger:
+    """Token-bucket-throttled per-query log (default 10 lines/s)."""
+
+    def __init__(self, max_lines_per_s: float = 10.0, max_sql_len: int = 200):
+        self.rate = float(max_lines_per_s)
+        self.max_sql_len = max_sql_len
+        # cap ≥ 1.0: with a sub-1 rate a rate-sized cap could never reach
+        # one token and the logger would be permanently, silently mute
+        self._cap = max(self.rate, 1.0)
+        self._tokens = self._cap
+        self._last = time.monotonic()
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+
+    def log(self, sql: str, response, table: str = "") -> None:
+        rid = next(self._ids)
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self._cap, self._tokens
+                               + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens < 1.0:
+                self._dropped += 1
+                return
+            self._tokens -= 1.0
+            dropped, self._dropped = self._dropped, 0
+        sql_part = sql if len(sql) <= self.max_sql_len else \
+            sql[: self.max_sql_len] + "..."
+        parts = [
+            f"requestId={rid}",
+            f"table={table}" if table else None,
+            f"timeMs={getattr(response, 'time_used_ms', 0):.1f}",
+            f"docsScanned={getattr(response, 'num_docs_scanned', 0)}",
+            f"totalDocs={getattr(response, 'total_docs', 0)}",
+            f"segmentsQueried={getattr(response, 'num_segments_queried', 0)}",
+            f"exceptions={len(getattr(response, 'exceptions', []) or [])}",
+            f"droppedSinceLast={dropped}" if dropped else None,
+            f"query={sql_part!r}",
+        ]
+        logger.info("%s", " ".join(p for p in parts if p))

@@ -146,11 +146,13 @@ class TpuSegmentExecutor:
 
             host = HostSegmentExecutor()
             evaluator = lambda e, doc_ids: host.eval_value_at(e, segment, doc_ids)  # noqa: E731
-        # kernel emits the mask bit-packed (kernels.py selection mode)
-        bits = np.unpackbits(np.asarray(mask),
-                             bitorder="little")[: segment.num_docs]
+        # kernel emits the mask bit-packed (kernels.py selection mode);
+        # decode through the repo's one little-endian bitmap helper
+        from ..segment.bitpack import unpack_bitmap
+
+        bits = unpack_bitmap(np.asarray(mask), segment.num_docs)
         return selection_from_mask(query, segment, plan.selection_columns,
-                                   bits.astype(bool),
+                                   bits,
                                    extra_exprs=plan.selection_exprs or None,
                                    evaluator=evaluator)
 

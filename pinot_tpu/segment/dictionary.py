@@ -72,8 +72,14 @@ class Dictionary:
 def build_dictionary(raw_values: np.ndarray, data_type: DataType) -> tuple[Dictionary, np.ndarray]:
     """Build sorted dictionary + dict-id plane from raw values.
 
-    Returns (dictionary, dict_ids[int32]). np.unique gives sorted uniques and
-    inverse indices in one pass — this IS the dictionary encode.
+    Returns (dictionary, dict_ids[int32]). This IS the dictionary encode —
+    and the segment builder's hot loop (reference:
+    SegmentDictionaryCreator + column stats collection), so it avoids
+    np.unique's O(n log n) argsort wherever a linear path exists:
+    narrow-range integers take an O(n + range) presence/bincount route,
+    and strings/wide ints take a hash factorize (first-occurrence codes)
+    re-sorted through a cardinality-sized LUT. All paths produce the same
+    SORTED dictionary the predicate planner depends on.
     """
     if data_type in (DataType.STRING, DataType.JSON, DataType.BIG_DECIMAL):
         arr = np.asarray([str(v) for v in raw_values], dtype=object)
@@ -83,12 +89,44 @@ def build_dictionary(raw_values: np.ndarray, data_type: DataType) -> tuple[Dicti
         uniques, inverse = _unique_object(arr)
     else:
         arr = np.ascontiguousarray(raw_values, dtype=data_type.numpy_dtype)
-        uniques, inverse = np.unique(arr, return_inverse=True)
+        uniques = inverse = None
+        if arr.dtype.kind in "iu" and arr.size:
+            vmin = int(arr.min())
+            rng = int(arr.max()) - vmin + 1
+            if rng <= max(2 * arr.size, 1 << 16):
+                off = arr.astype(np.int64) - vmin if vmin else \
+                    arr.astype(np.int64, copy=False)
+                present = np.zeros(rng, dtype=bool)
+                present[off] = True
+                values = np.flatnonzero(present) + vmin  # sorted uniques
+                lut = np.cumsum(present, dtype=np.int32)
+                lut -= 1  # value offset → dict id
+                inverse = lut[off]
+                uniques = values.astype(arr.dtype)
+        if uniques is None:
+            uniques, inverse = _factorize_sorted(arr)
     return Dictionary(data_type, uniques), inverse.astype(np.int32)
 
 
+def _factorize_sorted(arr: np.ndarray):
+    """Sorted uniques + inverse via hash factorize (O(n) + sort of the
+    cardinality) when pandas is importable; np.unique otherwise."""
+    try:
+        import pandas as pd
+    except ImportError:
+        return np.unique(arr, return_inverse=True)
+    codes, firsts = pd.factorize(arr, use_na_sentinel=False)
+    order = np.argsort(firsts, kind="stable")  # cardinality-sized sort
+    rank = np.empty_like(order)
+    rank[order] = np.arange(order.size)
+    values = np.asarray(firsts)[order]
+    if arr.dtype != object:
+        values = values.astype(arr.dtype, copy=False)
+    return values, rank[codes]
+
+
 def _unique_object(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    uniques, inverse = np.unique(arr, return_inverse=True)
+    uniques, inverse = _factorize_sorted(arr)
     return uniques.astype(object), inverse
 
 

@@ -273,3 +273,63 @@ def test_realtime_freshness_gauges(tmp_path):
         assert lag == 0  # fully caught up
     finally:
         mgr.stop()
+
+
+def test_broker_query_log_throttles(caplog):
+    """Reference: pinot-broker querylog QueryLogger — one structured line
+    per query, token-bucket throttled, dropped count surfaced."""
+    import logging
+
+    from pinot_tpu.cluster.querylog import QueryLogger
+    from pinot_tpu.engine.results import BrokerResponse
+
+    ql = QueryLogger(max_lines_per_s=2.0)
+    resp = BrokerResponse()
+    resp.time_used_ms = 12.5
+    resp.num_docs_scanned = 42
+    with caplog.at_level(logging.INFO, logger="pinot_tpu.querylog"):
+        for _ in range(10):
+            ql.log("SELECT COUNT(*) FROM t", resp, table="t_OFFLINE")
+    lines = [r.message for r in caplog.records]
+    # bucket starts full at 2 tokens -> exactly 2 lines, 8 dropped
+    assert len(lines) == 2, lines
+    assert "table=t_OFFLINE" in lines[0] and "docsScanned=42" in lines[0]
+    assert "requestId=" in lines[0]
+    # next accepted line carries the dropped-since-last counter
+    import time as _t
+
+    _t.sleep(0.6)  # refill ~1.2 tokens
+    with caplog.at_level(logging.INFO, logger="pinot_tpu.querylog"):
+        ql.log("SELECT 1", resp)
+    assert "droppedSinceLast=8" in caplog.records[-1].message
+
+
+def test_broker_logs_queries_end_to_end(caplog):
+    import logging
+
+    import numpy as np
+
+    from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi.data_types import Schema
+
+    schema = Schema.build("ql", dimensions=[("d", "STRING")], metrics=[("m", "INT")])
+    store = PropertyStore()
+    ctl = ClusterController(store)
+    srv = ServerInstance(store, "Server_0", backend="host")
+    srv.start()
+    broker = Broker(store)
+    ctl.add_schema(schema.to_json())
+    import tempfile
+
+    t = ctl.create_table({"tableName": "ql", "replication": 1})
+    d = tempfile.mkdtemp()
+    SegmentBuilder(schema, segment_name="s").build(
+        {"d": np.asarray(["x", "y"], dtype=object),
+         "m": np.asarray([1, 2], dtype=np.int32)}, d)
+    ctl.add_segment(t, "s", {"location": d, "numDocs": 2})
+    with caplog.at_level(logging.INFO, logger="pinot_tpu.querylog"):
+        r = broker.execute_sql("SELECT SUM(m) FROM ql")
+    assert not r.exceptions
+    assert any("docsScanned=2" in rec.message for rec in caplog.records)
+    srv.stop()
