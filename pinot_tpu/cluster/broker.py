@@ -167,7 +167,20 @@ class Broker:
         """``segments``: optional {tableNameWithType: [segment, ...]}
         restriction — the connector's segment-parallel scan plane
         (reference: the Spark connector dispatches per-segment reads with
-        an explicit searchSegments list)."""
+        an explicit searchSegments list). EVERY return path — including
+        quota rejections, parse errors, and the MSE route — funnels
+        through the query log (reference: QueryLogger logs completions
+        AND failures)."""
+        t0 = time.perf_counter()
+        resp = self._execute_sql_impl(sql, segments)
+        if not getattr(resp, "time_used_ms", 0):
+            resp.time_used_ms = (time.perf_counter() - t0) * 1000
+        self.query_logger.log(sql, resp,
+                              table=getattr(resp, "_log_table", ""))
+        return resp
+
+    def _execute_sql_impl(self, sql: str,
+                          segments: Optional[dict]) -> BrokerResponse:
         t0 = time.perf_counter()
         try:
             query = parse_sql(sql)
@@ -184,24 +197,31 @@ class Broker:
                 return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
             return resp
         if query.query_options.get("useMultistageEngine") in (True, "true", 1):
-            return self.execute_sql_mse(sql)
+            resp = self.execute_sql_mse(sql)
+            resp._log_table = query.table_name
+            return resp
         if getattr(query, "explain", False):
             # plan-only: route to ONE server hosting routed segments
             # (reference: EXPLAIN runs the plan maker, never the operators)
             try:
-                return self._explain(query)
+                resp = self._explain(query)
             except Exception as e:
-                return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
+                resp = BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
+            resp._log_table = query.table_name
+            return resp
         try:
             self.quota.acquire(raw_table_name(query.table_name))
         except QueryQuotaExceededError as e:
-            return BrokerResponse(exceptions=[f"QueryQuotaExceededError: {e}"])
+            resp = BrokerResponse(
+                exceptions=[f"QueryQuotaExceededError: {e}"])
+            resp._log_table = query.table_name
+            return resp
         try:
             resp = self._execute(query, only_segments=segments)
         except Exception as e:
             resp = BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
-        self.query_logger.log(sql, resp, table=query.table_name)
+        resp._log_table = query.table_name
         return resp
 
     def execute_sql_stream(self, sql: str):

@@ -63,9 +63,29 @@ class TpuSegmentExecutor:
         # produced under x64).
         params = tuple(p if isinstance(p, (np.ndarray, np.generic))
                        else np.asarray(p) for p in plan.params)
-        outs = run_program(plan.program, arrays, params,
-                           np.int32(segment.num_docs), view.padded,
-                           packed=packed)
+        from ..ops import fused_groupby
+
+        # decide HERE whether the fused kernel applies, so the failure
+        # fallback below can never be tripped (and permanently disable
+        # fusion) by an error from a program the fused path never touched
+        fused = fused_groupby.active()
+        if fused and not (plan.program.mode == "group_by"
+                          and fused_groupby.plan(plan.program, arrays)
+                          is not None):
+            fused = ""
+        try:
+            outs = run_program(plan.program, arrays, params,
+                               np.int32(segment.num_docs), view.padded,
+                               packed=packed, fused=fused)
+        except Exception as e:
+            if not fused:
+                raise
+            # Mosaic/VMEM failure on this machine's toolchain: disable the
+            # fused kernel for the process and recompile the two-step path
+            fused_groupby.note_failure(e)
+            outs = run_program(plan.program, arrays, params,
+                               np.int32(segment.num_docs), view.padded,
+                               packed=packed, fused="")
         # one flat buffer per query → one D2H transfer at collect() (a
         # tunneled device pays a fixed round trip PER materialized array)
         return pack_outputs(outs)

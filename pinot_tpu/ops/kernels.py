@@ -325,12 +325,12 @@ def fetch_packed_batch(packs: list) -> list:
     return out
 
 
-@partial(jax.jit, static_argnames=("program", "padded", "packed"))
+@partial(jax.jit, static_argnames=("program", "padded", "packed", "fused"))
 def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, padded: int,
-                row_offset=0, packed: tuple = ()):
+                row_offset=0, packed: tuple = (), fused: str = ""):
     """Execute a Program over padded column planes. Returns a tuple:
 
-    selection   → (mask,)
+    selection   → (mask bitmap, packed little-endian)
     aggregation → (count, agg_0, agg_1, ...) each shape (1+trash,) sliced later
     group_by    → (counts[G+1], agg_0[G+1], ...)
 
@@ -339,7 +339,18 @@ def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, pad
     mesh row axis — parallel/mesh.py): each shard sees rows
     [row_offset, row_offset+padded) of the global segment.
     `packed` marks id slots resident in HBM as packed/narrow planes.
+    `fused` ('' | 'tpu' | 'interpret') enables the single-pass fused dense
+    group-by kernel (ops/fused_groupby.py) for programs in its scope — the
+    RAW narrow planes feed the kernel directly, skipping `_apply_packed`.
     """
+    if fused and program.mode == "group_by":
+        from . import fused_groupby
+
+        fp = fused_groupby.plan(program, arrays)
+        if fp is not None:
+            return fused_groupby.execute(
+                fp, program, arrays, params, num_docs, padded, row_offset,
+                interpret=(fused == "interpret"))
     arrays = _apply_packed(arrays, packed)
     return _run_program_impl(program, arrays, params, num_docs, padded, row_offset)
 

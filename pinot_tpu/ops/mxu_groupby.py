@@ -120,16 +120,29 @@ def _kernel(s1: int, num_planes: int, gid_ref, *rest):
     plane_refs = rest[:num_planes]
     out_ref = rest[num_planes]
     j = pl.program_id(1)
-    # int8 planes ride the s8xs8->i32 MXU mode (2x bf16 rate on v5e);
-    # bf16 planes keep the f32-accumulating dot
-    int8 = plane_refs[0].dtype == jnp.int8
-    oh_dt = jnp.int8 if int8 else jnp.bfloat16
-    acc_dt = jnp.int32 if int8 else jnp.float32
-
     nb = G_TILES * SUBLANES  # batch dim of the MXU pass
     # leading-dim collapse (G, 8, 128) -> (G*8, 128): pure addressing, no
     # sublane/lane relayout
     g = gid_ref[...].reshape(nb, LANES)
+    mats = [pr[...].reshape(nb, LANES) for pr in plane_refs]
+    _matmul_tail(g, mats, s1, out_ref, j)
+
+
+def _matmul_tail(g, mats, s1: int, out_ref, j):
+    """The one-hot matmul chain shared by the pre-materialized-plane kernel
+    (`_kernel`) and the fused filter+gid+limb kernel
+    (ops/fused_groupby.py): g (nb, 128) int32 gids, mats P x (nb, 128)
+    PLANE_DTYPE limb values, accumulated into out_ref block (1, P*s1, 128)
+    i32 across the j grid axis."""
+    from jax.experimental import pallas as pl
+
+    num_planes = len(mats)
+    # int8 planes ride the s8xs8->i32 MXU mode (2x bf16 rate on v5e);
+    # bf16 planes keep the f32-accumulating dot
+    int8 = mats[0].dtype == jnp.int8
+    oh_dt = jnp.int8 if int8 else jnp.bfloat16
+    acc_dt = jnp.int32 if int8 else jnp.float32
+    nb = g.shape[0]
     hi = g >> 7
     lo = g & (LANES - 1)
 
@@ -167,8 +180,8 @@ def _kernel(s1: int, num_planes: int, gid_ref, *rest):
     parts = []
     for start in range(0, num_planes, pg):
         lhs = jnp.concatenate(
-            [oh_hi * mid(pr[...].reshape(nb, LANES).astype(oh_dt), s1)
-             for pr in plane_refs[start:start + pg]], axis=1)
+            [oh_hi * mid(pm.astype(oh_dt), s1)
+             for pm in mats[start:start + pg]], axis=1)
         out = jax.lax.dot_general(lhs, rhs, dn,
                                   preferred_element_type=acc_dt)
         parts.append(out.sum(axis=0))  # (Pg*s1, L)

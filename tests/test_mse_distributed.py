@@ -382,3 +382,53 @@ def test_distributed_colocated_join(tmp_path):
                 pass
         if hasattr(broker, "_mse_dispatcher"):
             broker._mse_dispatcher.close()
+
+
+def test_distributed_join_worker_unreachable_fails_loudly(join_cluster):
+    """A worker that crashes without its ephemeral store entry expiring
+    (hard kill) must surface as a query error within bounded time — not a
+    hang (reference: QueryDispatcher cancels the query and propagates the
+    gRPC failure; round-3's regression was exactly this path shipping
+    broken)."""
+    store, controller, servers, broker, orders_sets = join_cluster
+    # simulate a crash: the RPC endpoint dies but /LIVEINSTANCES persists,
+    # so routing still targets the dead worker
+    servers[1]._rpc.close()
+    t0 = time.time()
+    resp = broker.execute_sql_mse(JOIN_SQL)
+    elapsed = time.time() - t0
+    assert resp.exceptions, "dead worker must fail the query, not hang"
+    assert elapsed < 30, f"failure took {elapsed:.0f}s — dispatcher hung"
+
+
+def test_distributed_join_recovers_after_worker_restart(join_cluster):
+    """After the dead worker's session expires and a replacement converges,
+    the same query succeeds (reference: Helix external-view self-healing +
+    broker failure detector backoff)."""
+    store, controller, servers, broker, orders_sets = join_cluster
+    servers[1].stop()  # clean death: ephemeral entries expire
+    resp = broker.execute_sql_mse(JOIN_SQL)
+    assert resp.exceptions  # customers table momentarily unhosted
+    # replacement with the same tag joins; ideal state replays onto it
+    s2 = ServerInstance(store, "Server_2", backend="host",
+                        tags=["tenant1", "DefaultTenant"])
+    s2.start()
+    try:
+        # the periodic RebalanceChecker repairs the under-replicated ideal
+        # state onto the replacement (reference: RebalanceChecker +
+        # external-view convergence)
+        from pinot_tpu.cluster.periodic import RebalanceChecker
+
+        RebalanceChecker(controller)()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            view = store.get("/EXTERNALVIEW/customers_OFFLINE") or {}
+            if any("Server_2" in m for m in view.values()):
+                break
+            time.sleep(0.05)
+        resp = broker.execute_sql_mse(JOIN_SQL)
+        assert not resp.exceptions, resp.exceptions
+        got = {r[0]: r[1] for r in resp.result_table.rows}
+        assert got == _expected_region_sums(orders_sets)
+    finally:
+        s2.stop()

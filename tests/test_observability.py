@@ -333,3 +333,21 @@ def test_broker_logs_queries_end_to_end(caplog):
     assert not r.exceptions
     assert any("docsScanned=2" in rec.message for rec in caplog.records)
     srv.stop()
+
+
+def test_query_log_covers_quota_rejections(caplog):
+    """Quota-rejected and parse-failed queries land in the query log too
+    (every broker return path funnels through it)."""
+    import logging
+
+    from pinot_tpu.cluster import Broker, ClusterController, PropertyStore
+
+    store = PropertyStore()
+    ClusterController(store)
+    broker = Broker(store)
+    with caplog.at_level(logging.INFO, logger="pinot_tpu.querylog"):
+        broker.execute_sql("SELECT COUNT(*) FROM missing_table")
+        broker.execute_sql("THIS IS NOT SQL AT ALL")
+    msgs = [r.message for r in caplog.records]
+    assert len(msgs) == 2
+    assert all("exceptions=1" in m for m in msgs), msgs
