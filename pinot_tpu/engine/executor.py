@@ -10,6 +10,7 @@ group keys back to values.
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 import jax.numpy as jnp
 
@@ -33,6 +34,7 @@ class TpuSegmentExecutor:
 
     def __init__(self, cache: DeviceSegmentCache = None):
         self.cache = cache or GLOBAL_DEVICE_CACHE
+        self._fused_validated: set = set()  # programs proven on-device once
 
     def plan(self, query: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         return SegmentPlanner(query, segment).plan()
@@ -77,6 +79,13 @@ class TpuSegmentExecutor:
             outs = run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused=fused)
+            if fused and plan.program not in self._fused_validated:
+                # dispatch is async: a device-side kernel failure would
+                # otherwise surface at collect(), past this fallback. Block
+                # ONCE per program shape to prove the kernel end-to-end;
+                # later executions stay fully async.
+                jax.block_until_ready(outs)
+                self._fused_validated.add(plan.program)
         except Exception as e:
             if not fused:
                 raise

@@ -91,6 +91,13 @@ def explain_plan(query, table, pruner, backend: str = "auto",
                 desc += ", orderByTrim:exact"
         if p.mv_group_slot is not None:
             desc += ", mvExpansion:true"
+        if backend != "host":
+            from ..ops import fused_groupby
+
+            if fused_groupby.plan(p, None) is not None:
+                # single-pass MXU kernel shape (ops/fused_groupby.py);
+                # actual use still depends on plane dtypes + backend
+                desc += ", fusedMxu:eligible"
     kid = add(desc + ")", cid)
 
     for a in query.aggregations:

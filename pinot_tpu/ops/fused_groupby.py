@@ -102,13 +102,16 @@ def _filter_leaves(node):
 
 def plan(program: ir.Program, arrays) -> Optional[FusedPlan]:
     """Static shape analysis; `arrays` contributes only dtypes/ndims (known
-    at trace time). Returns None when the program leaves the fused scope."""
+    at trace time). Returns None when the program leaves the fused scope.
+    ``arrays=None`` checks program STRUCTURE only (EXPLAIN eligibility)."""
     if program.mode != "group_by" or program.mv_group_slot is not None:
         return None
     if program.group_vexprs or not program.group_slots:
         return None
 
     def plane_ok(slot, payload=False):
+        if arrays is None:
+            return True
         a = arrays[slot]
         if getattr(a, "ndim", None) != 1:
             return False
@@ -140,6 +143,10 @@ def plan(program: ir.Program, arrays) -> Optional[FusedPlan]:
             return None
     groups = tuple(zip(program.group_slots, program.group_strides))
 
+    # limb policy comes from the ONE shared helper so fused and two-step
+    # sums can never drift (kernels._limb_shifts)
+    from .kernels import _limb_shifts
+
     planes: list = [("count",)]
     recipes: list = []
     b = mxu_groupby.LIMB_BITS
@@ -151,11 +158,7 @@ def plan(program: ir.Program, arrays) -> Optional[FusedPlan]:
                 not plane_ok(agg.vexpr.slot, payload=True):
             return None
         slot = agg.vexpr.slot
-        nonneg = agg.vmin is not None and agg.vmin >= 0
-        nbits = 32
-        if nonneg and agg.vmax is not None:
-            nbits = max(1, int(agg.vmax).bit_length())
-        shifts = tuple(range(0, nbits, b))
+        shifts, nonneg = _limb_shifts(agg.vmin, agg.vmax, b)
         refs = tuple((len(planes) + k, s) for k, s in enumerate(shifts))
         planes.extend(("limb", slot, s) for s in shifts)
         neg_idx = None
@@ -187,22 +190,40 @@ def execute(fp: FusedPlan, program: ir.Program, arrays, params, num_docs,
     """Run the fused kernel; returns the `_run_dense_group_by` output
     contract: (counts_i64, per-agg columns...)."""
     num_segments = program.num_groups + 1
-    # runtime scalar vector: [num_docs, row_offset, lo0, hi0, lo1, hi1, ..]
-    # open/missing bounds normalize to CLOSED i32 bounds in i64 arithmetic
-    # (ids and int32 raws both compare exactly in i32 space)
+    # runtime scalar vector: [num_docs, row_offset, lo0, hi0, lo1, hi1, ..].
+    # Bounds normalize to CLOSED i32 intervals over integer planes:
+    #   * float bounds round INWARD (v >= 5.5 ≡ v >= 6; v <= 5.5 ≡ v <= 5;
+    #     open bounds v > 5.0 ≡ v >= 6) — matching the two-step path's
+    #     float-space compare on integer values
+    #   * bounds outside int32 collapse to an EMPTY interval when they
+    #     exclude the whole plane (lo > I32_MAX / hi < I32_MIN), never to
+    #     a spurious point-match at the clipped extreme
     svals = [jnp.asarray(num_docs, jnp.int64),
              jnp.asarray(row_offset, jnp.int64)]
     for _slot, lo_p, hi_p, lo_inc, hi_inc in fp.terms:
         if lo_p is None:
             lo = jnp.int64(_I32_MIN)
         else:
-            lo = jnp.asarray(params[lo_p], jnp.int64) + (0 if lo_inc else 1)
+            p = jnp.asarray(params[lo_p])
+            if jnp.issubdtype(p.dtype, jnp.inexact):
+                lo = (jnp.ceil(p) if lo_inc
+                      else jnp.floor(p) + 1).astype(jnp.int64)
+            else:
+                lo = p.astype(jnp.int64) + (0 if lo_inc else 1)
         if hi_p is None:
             hi = jnp.int64(_I32_MAX)
         else:
-            hi = jnp.asarray(params[hi_p], jnp.int64) - (0 if hi_inc else 1)
-        svals.append(jnp.clip(lo, _I32_MIN, _I32_MAX))
-        svals.append(jnp.clip(hi, _I32_MIN, _I32_MAX))
+            p = jnp.asarray(params[hi_p])
+            if jnp.issubdtype(p.dtype, jnp.inexact):
+                hi = (jnp.floor(p) if hi_inc
+                      else jnp.ceil(p) - 1).astype(jnp.int64)
+            else:
+                hi = p.astype(jnp.int64) - (0 if hi_inc else 1)
+        empty = (lo > _I32_MAX) | (hi < _I32_MIN) | (lo > hi)
+        svals.append(jnp.where(empty, jnp.int64(1),
+                               jnp.clip(lo, _I32_MIN, _I32_MAX)))
+        svals.append(jnp.where(empty, jnp.int64(0),
+                               jnp.clip(hi, _I32_MIN, _I32_MAX)))
     scalars = jnp.stack([v.astype(jnp.int32) for v in svals])
 
     planes_in = tuple(arrays[s] for s in fp.slots)

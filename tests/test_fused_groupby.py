@@ -144,3 +144,26 @@ def test_failure_falls_back_to_two_step(segment, monkeypatch):
     assert not r.exceptions, r.exceptions
     assert fused_groupby._STATE["error"] is not None
     monkeypatch.setitem(fused_groupby._STATE, "error", None)
+
+
+FLOAT_BOUND_SQLS = [
+    # fractional bounds on a raw int32 column round INWARD
+    "SELECT year, SUM(rev) FROM fg WHERE rev >= 299999.5 GROUP BY year LIMIT 100",
+    "SELECT year, COUNT(*) FROM fg WHERE rev <= 0.5 GROUP BY year LIMIT 100",
+    "SELECT year, COUNT(*) FROM fg WHERE signed > -0.5 GROUP BY year LIMIT 100",
+    # bounds outside int32 range: empty / all rows, never a clipped match
+    "SELECT year, COUNT(*) FROM fg WHERE rev = 2147483648 GROUP BY year LIMIT 100",
+    "SELECT year, COUNT(*) FROM fg WHERE signed < -3000000000 GROUP BY year LIMIT 10",
+    "SELECT year, COUNT(*) FROM fg WHERE rev < 3000000000 GROUP BY year LIMIT 100",
+]
+
+
+@pytest.mark.parametrize("sql", FLOAT_BOUND_SQLS)
+def test_fused_bound_normalization(segment, sql):
+    """Float and out-of-int32 predicate bounds must agree with the
+    two-step path (inward rounding; empty — not clipped — intervals)."""
+    seg, *_ = segment
+    _p1, base = _outs(seg, sql, fused="")
+    _p2, got = _outs(seg, sql, fused="interpret")
+    for b, g in zip(base, got):
+        np.testing.assert_array_equal(b, g)

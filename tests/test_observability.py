@@ -345,9 +345,13 @@ def test_query_log_covers_quota_rejections(caplog):
     store = PropertyStore()
     ClusterController(store)
     broker = Broker(store)
+    broker.quota.set_qps_limit("t", 0.0001)  # trip on the first query
     with caplog.at_level(logging.INFO, logger="pinot_tpu.querylog"):
+        broker.execute_sql("SELECT COUNT(*) FROM t")
         broker.execute_sql("SELECT COUNT(*) FROM missing_table")
         broker.execute_sql("THIS IS NOT SQL AT ALL")
     msgs = [r.message for r in caplog.records]
-    assert len(msgs) == 2
+    assert len(msgs) == 3
     assert all("exceptions=1" in m for m in msgs), msgs
+    assert "QueryQuotaExceededError" not in msgs[0]  # log line, not the exc
+    assert "table=t_OFFLINE" in msgs[0] or "table=t" in msgs[0], msgs[0]
