@@ -519,8 +519,33 @@ def _run_ungrouped(program: ir.Program, arrays, params, mask, n):
             continue
         v = _eval_value(agg.vexpr, arrays, params)
         is_int = jnp.issubdtype(v.dtype, jnp.integer)
+        fast32 = is_int and _fits_i32(v, agg)
         if agg.kind == "sum":
-            if is_int:
+            if fast32 and n % 4096 == 0:
+                # the TPU has no 64-bit ALU: a whole-column i64 (or f64)
+                # reduction runs on emulated adds per element. Split into
+                # u16 limbs, reduce 4096-element blocks in NATIVE i32
+                # (4096*65535 < 2^31: exact), and only the tiny per-block
+                # partials touch i64. Two's complement fixes negatives:
+                # sum(u32) = sum(v) + 2^32 * count_neg. _limb_shifts skips
+                # the high limb and/or the negative pass when the planner
+                # proved bounds.
+                vm = jnp.where(mask, v.astype(jnp.int32), 0)
+                u = vm.astype(jnp.uint32)
+                shifts, nonneg = _limb_shifts(agg.vmin, agg.vmax, 16)
+
+                def _blk(x):
+                    return x.reshape(-1, 4096).sum(
+                        axis=1).astype(jnp.int64).sum()
+
+                s = jnp.int64(0)
+                for sh in shifts:
+                    s = s + (_blk(((u >> sh) & jnp.uint32(0xFFFF))
+                                  .astype(jnp.int32)) << sh)
+                if not nonneg:
+                    s = s - (_blk((vm < 0).astype(jnp.int32)) << 32)
+                s = s.astype(jnp.float64)
+            elif is_int:
                 s = jnp.where(mask, v, 0).astype(jnp.int64).sum() \
                     .astype(jnp.float64)
             else:
@@ -530,11 +555,27 @@ def _run_ungrouped(program: ir.Program, arrays, params, mask, n):
             vf = jnp.where(mask, v, 0).astype(jnp.float64)
             outputs.append(jnp.stack([(vf * vf).sum(), jnp.float64(0)]))
         elif agg.kind == "min":
-            vf = jnp.where(mask, v, jnp.inf).astype(jnp.float64)
-            outputs.append(jnp.stack([vf.min(), jnp.float64(jnp.inf)]))
+            if fast32:  # native i32 compares; empty → +inf via the count
+                s = jnp.where(mask, v.astype(jnp.int32), _I32_MAX).min()
+                out = jnp.where(count > 0, s.astype(jnp.float64), jnp.inf)
+            elif v.dtype == jnp.float32:  # exact: f32→f64 is lossless
+                out = jnp.where(mask, v, jnp.float32(jnp.inf)).min() \
+                    .astype(jnp.float64)
+            else:
+                vf = jnp.where(mask, v, jnp.inf).astype(jnp.float64)
+                out = vf.min()
+            outputs.append(jnp.stack([out, jnp.float64(jnp.inf)]))
         elif agg.kind == "max":
-            vf = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
-            outputs.append(jnp.stack([vf.max(), jnp.float64(-jnp.inf)]))
+            if fast32:
+                s = jnp.where(mask, v.astype(jnp.int32), _I32_MIN).max()
+                out = jnp.where(count > 0, s.astype(jnp.float64), -jnp.inf)
+            elif v.dtype == jnp.float32:
+                out = jnp.where(mask, v, jnp.float32(-jnp.inf)).max() \
+                    .astype(jnp.float64)
+            else:
+                vf = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
+                out = vf.max()
+            outputs.append(jnp.stack([out, jnp.float64(-jnp.inf)]))
         else:
             raise ValueError(f"unknown agg kind {agg.kind}")
     return tuple(outputs)

@@ -366,3 +366,36 @@ def test_adaptive_hist_large_magnitude_values(tmp_path):
         vals = np.sort(cols["ts"][cols["day"] == day])
         exact = float(vals[int(len(vals) * 0.95)])
         assert abs(got[day] - exact) <= tol, (day, got[day] - exact, tol)
+
+
+def test_ungrouped_limb_sum_exact_extremes(tmp_path):
+    """The ungrouped i32 limb-block sum (kernels._run_ungrouped) must be
+    bit-exact vs int64 ground truth at int32 extremes with many negatives
+    (two's-complement correction) and a non-4096-multiple doc count."""
+    rng = np.random.default_rng(2)
+    n = 50_001  # padded bucket stays 4096-divisible; num_docs is odd
+    vals = rng.choice(np.asarray(
+        [-2**31, 2**31 - 1, -1, 0, 1, 123456789, -987654321], dtype=np.int32),
+        n)
+    schema = Schema.build("ex", dimensions=[("k", "INT")],
+                          metrics=[("v", "INT")])
+    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+    cfg = TableConfig(table_name="ex", indexing=IndexingConfig(
+        no_dictionary_columns=["v"]))
+    cols = {"k": (np.arange(n) % 3).astype(np.int32), "v": vals}
+    SegmentBuilder(schema, cfg, "e0").build(cols, tmp_path / "e0")
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(schema, [load_segment(tmp_path / "e0")])
+    r = qe.execute_sql("SELECT SUM(v), MIN(v), MAX(v), COUNT(*) FROM ex")
+    assert not r.exceptions, r.exceptions
+    row = r.result_table.rows[0]
+    assert int(row[0]) == int(vals.astype(np.int64).sum())
+    assert int(row[1]) == int(vals.min()) and int(row[2]) == int(vals.max())
+    assert row[3] == n
+    # filtered to empty: identities — the fast32 sentinel paths must NOT
+    # leak I32_MAX/I32_MIN as results
+    r = qe.execute_sql("SELECT COUNT(*), MIN(v), MAX(v) FROM ex WHERE k = 99")
+    row = r.result_table.rows[0]
+    assert row[0] == 0
+    assert row[1] == float("inf") and row[2] == float("-inf"), row
