@@ -520,6 +520,65 @@ def _rows_match(a, b, rel_tol=0.0) -> bool:
     return True
 
 
+def _plan_first_segment(qe, sql, segs):
+    """(executor, seg0, compiled plan) for the single-stage device path,
+    or None when the shape doesn't ride it (e.g. the MSE join config)."""
+    from pinot_tpu.query.parser.sql import parse_sql
+
+    try:
+        query = parse_sql(sql)
+        ex = qe.tpu
+        seg = segs[0]
+        return ex, seg, ex.plan(query, seg)
+    except Exception:
+        return None
+
+
+def _kernel_time_est(planned, deadline, iters: int = 5):
+    """Pure device-kernel seconds for one segment's program: median of
+    (dispatch TWO kernels + one fetch) minus (ONE kernel + one fetch).
+    The device executes in order, so the last output materializes after
+    both kernels; the delta is the second kernel's compute with every
+    fixed tunnel/dispatch cost cancelled. Deadline-aware (measurement is
+    OPTIONAL — it must never eat the host baseline's budget); returns
+    None without at least 2+2 clean rounds or a positive delta."""
+    if planned is None:
+        return None
+    ex, seg, plan = planned
+
+    def run(k):
+        t0 = time.perf_counter()
+        outs = None
+        for _ in range(k):
+            outs = ex.dispatch_plan(seg, plan)
+        if hasattr(outs, "flat"):
+            np.asarray(outs.flat)
+        else:
+            for o in outs:
+                np.asarray(o)
+        return time.perf_counter() - t0
+
+    singles, doubles = [], []
+    try:
+        run(1)  # warm
+        for _ in range(iters):
+            if time.monotonic() > deadline:
+                break
+            singles.append(run(1))
+        for _ in range(iters):
+            if time.monotonic() > deadline:
+                break
+            doubles.append(run(2))
+    except Exception:
+        return None
+    if len(singles) < 2 or len(doubles) < 2:
+        return None
+    delta = float(np.median(doubles) - np.median(singles))
+    # a non-positive delta is measurement noise — suppress rather than
+    # emit absurd derived rates
+    return delta if delta > 0 else None
+
+
 def _measure_rtt(jax) -> float:
     """Median blocking round trip for a trivial fetch — the tunnel's fixed
     per-query latency floor, reported so kernel time can be read out of
@@ -610,6 +669,13 @@ def run_single(cfg: str, outpath: str):
         note = "; ".join(filter(None, [
             note, f"{name}: host baseline exceeded deadline, skipped"]))
 
+    # kernel-only measurement LAST: optional, never at the expense of the
+    # host-verified numbers above
+    kernel_s = None
+    if platform != "cpu":
+        kernel_s = _kernel_time_est(
+            _plan_first_segment(tpu, sql, segs), deadline)
+
     nbytes = _plan_bytes(tpu, sql, segs)
     # device-side time estimate: end-to-end p50 minus the tunnel's fixed
     # round trip (the fetch RPC). On a directly-attached TPU rtt≈0 and
@@ -629,6 +695,13 @@ def run_single(cfg: str, outpath: str):
     }
     if note:
         payload["note"] = note
+    if kernel_s is not None:
+        # measured pure-kernel time for ONE segment's program (all fixed
+        # dispatch/tunnel costs cancelled); per-segment bytes give the
+        # kernel's true roofline fraction
+        payload["kernel_s"] = kernel_s
+        payload["kernel_rows_per_sec"] = \
+            (ROWS / len(segs)) / max(kernel_s, 1e-9)
     if nbytes:
         payload["hbm_bytes"] = nbytes
         payload["hbm_bytes_per_sec"] = nbytes / p50
@@ -636,6 +709,9 @@ def run_single(cfg: str, outpath: str):
         payload["device_hbm_bytes_per_sec"] = nbytes / max(device_est, 1e-9)
         payload["device_hbm_peak_frac"] = \
             (nbytes / max(device_est, 1e-9)) / V5E_HBM_PEAK
+        if kernel_s is not None:
+            payload["kernel_hbm_peak_frac"] = \
+                ((nbytes / len(segs)) / max(kernel_s, 1e-9)) / V5E_HBM_PEAK
     host_part = (f"host({ncpu}thr) {host_p50*1000:.0f}ms, "
                  f"speedup {host_p50/p50:.1f}x"
                  if host_p50 is not None else "host skipped (deadline)")

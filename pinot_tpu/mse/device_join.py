@@ -128,6 +128,8 @@ def enabled(ln: int, rn: int) -> bool:
     try:
         import jax
 
-        return jax.default_backend() != "cpu"
+        from ..ops.mxu_groupby import backend_platform
+
+        return backend_platform() != "cpu"
     except Exception:
         return False

@@ -67,10 +67,7 @@ def active() -> str:
     if mode == "interpret":
         return "interpret"
     if mode in ("auto", "1"):
-        try:
-            return "tpu" if jax.default_backend() == "tpu" else ""
-        except Exception:
-            return ""
+        return "tpu" if mxu_groupby.backend_platform() == "tpu" else ""
     return ""
 
 

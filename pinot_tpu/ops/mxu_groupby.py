@@ -66,6 +66,17 @@ LIMB_BITS = 7 if _INT8 else 8
 MAX_PLANES = 24 if _INT8 else 16
 
 
+def backend_platform() -> str:
+    """The default jax backend's platform, or 'cpu' when backend init
+    fails. A flapping accelerator plugin (the axon tunnel going
+    unavailable mid-process) must degrade path SELECTION, never raise
+    into a query."""
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
 def supports(num_segments: int, num_planes: int) -> bool:
     if not (0 < num_planes <= MAX_PLANES and num_segments <= MAX_GROUPS):
         return False
@@ -83,7 +94,7 @@ def limb_sums(planes, gid, num_segments: int, *, interpret: bool = False):
     kron-factored XLA matmul elsewhere (interpret=True forces the Pallas
     kernel in interpret mode for kernel-parity tests)."""
     assert supports(num_segments, len(planes))
-    if interpret or jax.default_backend() == "tpu":
+    if interpret or backend_platform() == "tpu":
         return _pallas_limb_sums(tuple(planes), gid, num_segments,
                                  interpret=interpret)
     return _xla_limb_sums(tuple(planes), gid, num_segments)

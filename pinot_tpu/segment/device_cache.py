@@ -31,7 +31,9 @@ def packed_hbm_enabled() -> bool:
     env = os.environ.get("PINOT_TPU_PACKED_HBM")
     if env is not None:
         return env not in ("0", "false", "")
-    return jax.default_backend() not in ("cpu",)
+    from ..ops.mxu_groupby import backend_platform
+
+    return backend_platform() not in ("cpu",)
 
 
 def pad_bucket(n: int) -> int:
