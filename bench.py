@@ -280,8 +280,25 @@ def _probe_accelerator() -> bool:
     return False
 
 
+def _record_dir(platform) -> Path:
+    """Where a run's artifacts belong: accelerator runs own the committed
+    record dir; a cpu run lands in a sibling so it can never overwrite the
+    record of the last REAL accelerator run (rounds 1-2 lost their only
+    TPU evidence exactly this way)."""
+    if platform != "cpu":
+        return PARTIAL
+    try:
+        prev = json.loads((PARTIAL / "summary.json").read_text())
+        if prev.get("platform") not in ("cpu", None):
+            return PARTIAL.parent / (PARTIAL.name + "_cpu")
+    except Exception:
+        pass
+    return PARTIAL
+
+
 def _emit(results, platform, notes, skipped, final=False):
-    """(Re-)print the one-line summary JSON; also persist to .bench_partial."""
+    """(Re-)print the one-line summary JSON; also persist to the record
+    dir (_record_dir)."""
     if "q2_groupby" in results:
         hname = "q2_groupby"
         # row count rides in the name so scaled (cpu-fallback) runs
@@ -319,14 +336,15 @@ def _emit(results, platform, notes, skipped, final=False):
     line = json.dumps(out)
     print(line, flush=True)
     try:
-        PARTIAL.mkdir(exist_ok=True)
-        (PARTIAL / "summary.json").write_text(line)
+        target = _record_dir(platform)
+        target.mkdir(exist_ok=True)
+        (target / "summary.json").write_text(line)
     except Exception:
         pass
 
 
 def orchestrate():
-    global ROWS
+    global ROWS, PARTIAL
     import subprocess
 
     # the parent must NEVER initialize the accelerator backend (it would
@@ -358,6 +376,8 @@ def orchestrate():
     prepare_tables(need_ssb, "q4" in CONFIGS, "q5" in CONFIGS)
 
     PARTIAL.mkdir(exist_ok=True)
+    stage = PARTIAL.parent / (PARTIAL.name + "_stage")
+    stage.mkdir(exist_ok=True)
     results, skipped = {}, []
     platform_seen = None
     configs = [c for c in CONFIGS if c in RUNS]
@@ -373,7 +393,7 @@ def orchestrate():
             continue
         # fair share of the remaining budget, floor 120s (if we have it)
         share = max(min(120.0, rem - 30), rem / (len(configs) - i))
-        outfile = PARTIAL / f"{cfg}.json"
+        outfile = stage / f"{cfg}.json"
         outfile.unlink(missing_ok=True)
         env = dict(os.environ)
         env["BENCH_DEADLINE_S"] = str(share)
@@ -405,6 +425,12 @@ def orchestrate():
         if outfile.exists():
             try:
                 payload = json.loads(outfile.read_text())
+                # a child may fall back to cpu mid-run even when the probe
+                # succeeded — place each config's record by the platform
+                # the child ACTUALLY ran on
+                rec = _record_dir(payload.get("platform"))
+                rec.mkdir(exist_ok=True)
+                (rec / f"{cfg}.json").write_text(outfile.read_text())
                 platform_seen = payload.pop("platform", platform_seen)
                 note = payload.pop("note", None)
                 if note:
@@ -539,9 +565,13 @@ def _kernel_time_est(planned, deadline, iters: int = 5):
     (dispatch TWO kernels + one fetch) minus (ONE kernel + one fetch).
     The device executes in order, so the last output materializes after
     both kernels; the delta is the second kernel's compute with every
-    fixed tunnel/dispatch cost cancelled. Deadline-aware (measurement is
-    OPTIONAL — it must never eat the host baseline's budget); returns
-    None without at least 2+2 clean rounds or a positive delta."""
+    fixed tunnel/dispatch cost cancelled. Residual bias: the second
+    dispatch's HOST-side work (~1ms of plan/pack per dispatch) overlaps
+    kernel #1 only partially, so for sub-millisecond kernels kernel_s is
+    an UPPER bound on device compute, not an exact reading. Deadline-aware
+    (measurement is OPTIONAL — it must never eat the host baseline's
+    budget); returns None without at least 2+2 clean rounds or a positive
+    delta."""
     if planned is None:
         return None
     ex, seg, plan = planned

@@ -126,8 +126,6 @@ def enabled(ln: int, rn: int) -> bool:
     if ln + rn < AUTO_MIN_ROWS:
         return False
     try:
-        import jax
-
         from ..ops.mxu_groupby import backend_platform
 
         return backend_platform() != "cpu"
