@@ -69,30 +69,45 @@ class TpuSegmentExecutor:
 
         # decide HERE whether the fused kernel applies, so the failure
         # fallback below can never be tripped (and permanently disable
-        # fusion) by an error from a program the fused path never touched
+        # fusion) by an error from a program the fused path never touched.
+        # Dict-LUT predicates (IN/LIKE/NOT...) join the fused scope when
+        # their boolean LUT compresses to a few contiguous dict-id runs —
+        # a dispatch-time property of the CONCRETE host params.
         fused = fused_groupby.active()
-        if fused and not (plan.program.mode == "group_by"
-                          and fused_groupby.plan(plan.program, arrays)
-                          is not None):
-            fused = ""
+        lut_meta: tuple = ()
+        base_params = params
+        if fused:
+            extra, lut_meta = fused_groupby.lut_run_params(
+                plan.program, params)
+            if plan.program.mode == "group_by" and fused_groupby.plan(
+                    plan.program, arrays, lut_meta) is not None:
+                params = params + extra  # run arrays ride as extra params
+            else:
+                fused, lut_meta = "", ()
         try:
             outs = run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
-                               packed=packed, fused=fused)
-            if fused and plan.program not in self._fused_validated:
+                               packed=packed, fused=fused,
+                               fused_lut_meta=lut_meta if fused else ())
+            # the compiled fused kernel varies with lut_meta (run counts
+            # are static), so validation is keyed per (program, meta)
+            vkey = (plan.program, lut_meta)
+            if fused and vkey not in self._fused_validated:
                 # dispatch is async: a device-side kernel failure would
                 # otherwise surface at collect(), past this fallback. Block
-                # ONCE per program shape to prove the kernel end-to-end;
+                # ONCE per compiled variant to prove the kernel end-to-end;
                 # later executions stay fully async.
                 jax.block_until_ready(outs)
-                self._fused_validated.add(plan.program)
+                self._fused_validated.add(vkey)
         except Exception as e:
             if not fused:
                 raise
             # Mosaic/VMEM failure on this machine's toolchain: disable the
-            # fused kernel for the process and recompile the two-step path
+            # fused kernel for the process and recompile the two-step
+            # path — with the ORIGINAL params so this compile is the one
+            # every later (post-disable) dispatch of the program reuses
             fused_groupby.note_failure(e)
-            outs = run_program(plan.program, arrays, params,
+            outs = run_program(plan.program, arrays, base_params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused="")
         # one flat buffer per query → one D2H transfer at collect() (a

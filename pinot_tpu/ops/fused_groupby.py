@@ -79,7 +79,11 @@ def note_failure(e: BaseException) -> None:
 
 @dataclass(frozen=True)
 class FusedPlan:
-    # (slot, lo_param|None, hi_param|None, lo_inclusive, hi_inclusive)
+    # ("iv", slot, lo_param|None, hi_param|None, lo_inc, hi_inc)
+    # | ("runs", slot, runs_param, n_runs) — a dict-LUT predicate (IN,
+    #   LIKE, NOT...) whose boolean LUT compresses to n_runs contiguous
+    #   dict-id ranges; the [lo0,hi0,lo1,hi1,...] i64 array rides in
+    #   params[runs_param] (appended at dispatch — lut_run_params)
     terms: tuple
     groups: tuple  # (slot, stride)
     # ("count",) | ("limb", slot, shift) | ("neg", slot)
@@ -87,6 +91,44 @@ class FusedPlan:
     # per agg: ("count",) | ("sum", ((plane_idx, shift), ...), neg_idx|None)
     recipes: tuple
     slots: tuple  # unique slots the kernel loads, in ref order
+
+
+MAX_LUT_RUNS = 4
+
+
+def lut_run_params(program: ir.Program, params):
+    """Dispatch-time (host, CONCRETE params) analysis: for each Lut filter
+    leaf whose boolean LUT is a union of ≤ MAX_LUT_RUNS contiguous
+    dict-id ranges, build the [lo,hi,...] run array. Returns
+    (extra_params, meta) — meta is the STATIC ((lut_param, appended_param
+    index, n_runs), ...) that keys the jit trace; ((), ()) when any Lut
+    doesn't compress (the program then stays on the two-step path)."""
+    if program.mode != "group_by" or program.filter is None:
+        return (), ()
+    extra: list = []
+    meta: list = []
+    base = len(params)
+    for leaf in _filter_leaves(program.filter):
+        if not isinstance(leaf, ir.Lut):
+            continue
+        lut = np.asarray(params[leaf.lut_param])
+        if lut.dtype != np.bool_ or lut.ndim != 1:
+            return (), ()
+        idx = np.flatnonzero(lut)
+        if idx.size == 0:
+            runs = np.asarray([1, 0], dtype=np.int64)  # empty interval
+        else:
+            breaks = np.flatnonzero(np.diff(idx) > 1)
+            starts = np.concatenate([[idx[0]], idx[breaks + 1]])
+            ends = np.concatenate([idx[breaks], [idx[-1]]])
+            if len(starts) > MAX_LUT_RUNS:
+                return (), ()
+            runs = np.empty(2 * len(starts), dtype=np.int64)
+            runs[0::2] = starts
+            runs[1::2] = ends
+        meta.append((leaf.lut_param, base + len(extra), len(runs) // 2))
+        extra.append(runs)
+    return tuple(extra), tuple(meta)
 
 
 def _filter_leaves(node):
@@ -97,10 +139,14 @@ def _filter_leaves(node):
         yield node
 
 
-def plan(program: ir.Program, arrays) -> Optional[FusedPlan]:
+def plan(program: ir.Program, arrays,
+         lut_meta: tuple = ()) -> Optional[FusedPlan]:
     """Static shape analysis; `arrays` contributes only dtypes/ndims (known
     at trace time). Returns None when the program leaves the fused scope.
-    ``arrays=None`` checks program STRUCTURE only (EXPLAIN eligibility)."""
+    ``arrays=None`` checks program STRUCTURE only (EXPLAIN eligibility:
+    Lut leaves count as eligible — run-compression is a dispatch-time
+    property). ``lut_meta`` is lut_run_params' static description of the
+    appended run arrays."""
     if program.mode != "group_by" or program.mv_group_slot is not None:
         return None
     if program.group_vexprs or not program.group_slots:
@@ -117,6 +163,7 @@ def plan(program: ir.Program, arrays) -> Optional[FusedPlan]:
             return dt == jnp.int32
         return dt in (jnp.uint8, jnp.uint16, jnp.int32)
 
+    runs_of = {m[0]: m for m in lut_meta}
     terms = []
     if program.filter is not None:
         for leaf in _filter_leaves(program.filter):
@@ -124,13 +171,24 @@ def plan(program: ir.Program, arrays) -> Optional[FusedPlan]:
                 if leaf.value:
                     continue
                 return None
+            if isinstance(leaf, ir.Lut):
+                if leaf.mv or not plane_ok(leaf.ids_slot):
+                    return None
+                m = runs_of.get(leaf.lut_param)
+                if m is None:
+                    if arrays is None:  # EXPLAIN structural eligibility
+                        terms.append(("runs", leaf.ids_slot, -1, 1))
+                        continue
+                    return None
+                terms.append(("runs", leaf.ids_slot, m[1], m[2]))
+                continue
             if not isinstance(leaf, ir.Interval):
                 return None
             ve = leaf.vexpr
             if not isinstance(ve, (ir.IdsCol, ir.Col)) or \
                     not plane_ok(ve.slot):
                 return None
-            terms.append((ve.slot, leaf.lo_param, leaf.hi_param,
+            terms.append(("iv", ve.slot, leaf.lo_param, leaf.hi_param,
                           leaf.lo_inclusive, leaf.hi_inclusive))
     if len(terms) > _MAX_TERMS:
         return None
@@ -169,9 +227,9 @@ def plan(program: ir.Program, arrays) -> Optional[FusedPlan]:
         return None
 
     slots = []
-    for s, *_ in terms:
-        if s not in slots:
-            slots.append(s)
+    for term in terms:
+        if term[1] not in slots:
+            slots.append(term[1])
     for s, _ in groups:
         if s not in slots:
             slots.append(s)
@@ -197,7 +255,15 @@ def execute(fp: FusedPlan, program: ir.Program, arrays, params, num_docs,
     #     a spurious point-match at the clipped extreme
     svals = [jnp.asarray(num_docs, jnp.int64),
              jnp.asarray(row_offset, jnp.int64)]
-    for _slot, lo_p, hi_p, lo_inc, hi_inc in fp.terms:
+    for term in fp.terms:
+        if term[0] == "runs":
+            # dict-id run bounds: already closed i32-safe intervals
+            _, _slot, runs_param, n_runs = term
+            arr = jnp.asarray(params[runs_param])
+            for k in range(2 * n_runs):
+                svals.append(arr[k].astype(jnp.int64))
+            continue
+        _, _slot, lo_p, hi_p, lo_inc, hi_inc = term
         if lo_p is None:
             lo = jnp.int64(_I32_MIN)
         else:
@@ -306,9 +372,19 @@ def _kernel(fp: FusedPlan, s1: int, bpsb: int, num_segments: int,
             + jax.lax.broadcasted_iota(jnp.int32, (nb, LANES), 0) * LANES
             + jax.lax.broadcasted_iota(jnp.int32, (nb, LANES), 1))
     m = (rows + scal_ref[1]) < scal_ref[0]
-    for t, (slot, *_bounds) in enumerate(fp.terms):
-        p = loaded[slot]
-        m &= (p >= scal_ref[2 + 2 * t]) & (p <= scal_ref[3 + 2 * t])
+    si = 2  # scalar cursor: [num_docs, row_offset, <term bounds...>]
+    for term in fp.terms:
+        if term[0] == "runs":
+            p = loaded[term[1]]
+            tm = jnp.zeros_like(m)
+            for _ in range(term[3]):
+                tm |= (p >= scal_ref[si]) & (p <= scal_ref[si + 1])
+                si += 2
+            m &= tm
+        else:
+            p = loaded[term[1]]
+            m &= (p >= scal_ref[si]) & (p <= scal_ref[si + 1])
+            si += 2
 
     gid = jnp.zeros((nb, LANES), dtype=jnp.int32)
     for slot, stride in fp.groups:

@@ -325,9 +325,11 @@ def fetch_packed_batch(packs: list) -> list:
     return out
 
 
-@partial(jax.jit, static_argnames=("program", "padded", "packed", "fused"))
+@partial(jax.jit, static_argnames=("program", "padded", "packed", "fused",
+                                   "fused_lut_meta"))
 def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, padded: int,
-                row_offset=0, packed: tuple = (), fused: str = ""):
+                row_offset=0, packed: tuple = (), fused: str = "",
+                fused_lut_meta: tuple = ()):
     """Execute a Program over padded column planes. Returns a tuple:
 
     selection   → (mask bitmap, packed little-endian)
@@ -346,7 +348,7 @@ def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, pad
     if fused and program.mode == "group_by":
         from . import fused_groupby
 
-        fp = fused_groupby.plan(program, arrays)
+        fp = fused_groupby.plan(program, arrays, fused_lut_meta)
         if fp is not None:
             return fused_groupby.execute(
                 fp, program, arrays, params, num_docs, padded, row_offset,

@@ -167,3 +167,77 @@ def test_fused_bound_normalization(segment, sql):
     _p2, got = _outs(seg, sql, fused="interpret")
     for b, g in zip(base, got):
         np.testing.assert_array_equal(b, g)
+
+
+LUT_SQLS = [
+    # IN list → dict LUT with (usually) several runs
+    "SELECT year, SUM(rev), COUNT(*) FROM fg WHERE region IN ('A', 'C') "
+    "GROUP BY year LIMIT 100",
+    # NOT-EQ → two runs around the excluded id
+    "SELECT year, SUM(rev) FROM fg WHERE region <> 'C' GROUP BY year LIMIT 100",
+    # LUT combined with an interval term
+    "SELECT year, COUNT(*) FROM fg WHERE region IN ('B', 'D', 'E') "
+    "AND qty < 30 GROUP BY year LIMIT 100",
+]
+
+
+def _engine_pair(segment, monkeypatch):
+    seg, schema, cols = segment
+    monkeypatch.setenv("PINOT_TPU_FUSED", "interpret")
+    tpu = QueryExecutor(backend="tpu")
+    host = QueryExecutor(backend="host")
+    for qe in (tpu, host):
+        qe.add_table(schema, [seg])
+    return tpu, host
+
+
+@pytest.mark.parametrize("sql", LUT_SQLS)
+def test_fused_lut_runs_parity(segment, sql, monkeypatch):
+    """Dict-LUT predicates whose LUT compresses to ≤4 id runs ride the
+    fused kernel; results must match the host engine."""
+    tpu, host = _engine_pair(segment, monkeypatch)
+    a = tpu.execute_sql(sql)
+    b = host.execute_sql(sql)
+    assert not a.exceptions and not b.exceptions, (sql, a.exceptions, b.exceptions)
+    assert sorted(map(tuple, a.result_table.rows)) == \
+        sorted(map(tuple, b.result_table.rows)), sql
+
+
+def test_lut_run_params_extraction(segment):
+    """Run extraction: adjacency merges; >MAX_LUT_RUNS bails; empty LUT
+    yields an empty interval."""
+    import numpy as np
+
+    from pinot_tpu.engine import ir
+
+    prog = ir.Program(mode="group_by", filter=ir.Lut(ids_slot=0, lut_param=0),
+                      group_slots=(1,), group_strides=(1,), num_groups=4,
+                      aggs=())
+    lut = np.zeros(10, dtype=bool)
+    lut[[2, 3, 4, 7]] = True  # two runs: [2,4], [7,7]
+    extra, meta = fused_groupby.lut_run_params(prog, (lut,))
+    assert meta == ((0, 1, 2),)
+    assert list(extra[0]) == [2, 4, 7, 7]
+    # empty LUT → the canonical empty interval
+    extra, meta = fused_groupby.lut_run_params(prog, (np.zeros(6, bool),))
+    assert list(extra[0]) == [1, 0]
+    # too fragmented → not fusable
+    frag = np.zeros(12, dtype=bool)
+    frag[[0, 2, 4, 6, 8]] = True
+    extra, meta = fused_groupby.lut_run_params(prog, (frag,))
+    assert extra == () and meta == ()
+
+
+def test_lut_query_takes_fused_path(segment):
+    """End-to-end wiring check: the planner's Lut program + concrete params
+    produce a FusedPlan with a runs term (not a silent two-step fall)."""
+    seg, *_ = segment
+    p = SegmentPlanner(parse_sql(LUT_SQLS[0]), seg).plan()
+    view = SegmentDeviceView(seg)
+    arrays, _ = p.gather_arrays_packed(view)
+    params = tuple(np.asarray(x) for x in p.params)
+    extra, meta = fused_groupby.lut_run_params(p.program, params)
+    assert meta, "IN-list LUT should compress to runs"
+    fp = fused_groupby.plan(p.program, tuple(arrays), meta)
+    assert fp is not None
+    assert any(t[0] == "runs" for t in fp.terms)
