@@ -88,7 +88,7 @@ class TpuSegmentExecutor:
             outs = run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused=fused,
-                               fused_lut_meta=lut_meta if fused else ())
+                               fused_lut_meta=lut_meta)
             # the compiled fused kernel varies with lut_meta (run counts
             # are static), so validation is keyed per (program, meta)
             vkey = (plan.program, lut_meta)

@@ -88,9 +88,11 @@ def slot_specs(slots) -> tuple:
     return tuple(P() if kind == "dict" else P(ROW_AXIS) for _col, kind in slots)
 
 
-@partial(jax.jit, static_argnames=("program", "padded", "mesh", "kinds"))
+@partial(jax.jit, static_argnames=("program", "padded", "mesh", "kinds",
+                                   "fused", "lut_meta"))
 def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_docs,
-                      padded: int, mesh: Mesh, kinds: tuple):
+                      padded: int, mesh: Mesh, kinds: tuple,
+                      fused: str = "", lut_meta: tuple = ()):
     n_shards = mesh.shape[ROW_AXIS]
     local_n = padded // n_shards
     array_specs = tuple(P() if k == "dict" else P(ROW_AXIS) for k in kinds)
@@ -98,6 +100,17 @@ def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_doc
     def shard_fn(arrays_l, params_l, num_docs_l):
         idx = jax.lax.axis_index(ROW_AXIS)
         offset = idx.astype(jnp.int32) * jnp.int32(local_n)
+        if fused and program.mode == "group_by":
+            # per-shard fused kernel; table outputs psum over ICI exactly
+            # like the two-step path (same output contract)
+            from ..ops import fused_groupby
+
+            fp = fused_groupby.plan(program, arrays_l, lut_meta)
+            if fp is not None:
+                outs = fused_groupby.execute(
+                    fp, program, arrays_l, params_l, num_docs_l, local_n,
+                    offset, interpret=(fused == "interpret"))
+                return _combine_collectives(program, outs, ROW_AXIS)
         outs = _run_program_impl(program, arrays_l, params_l, num_docs_l, local_n, offset)
         if program.mode == "selection":
             return outs  # masks stay row-sharded
@@ -111,12 +124,18 @@ def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_doc
         shard_fn, mesh=mesh,
         in_specs=(array_specs, param_specs, P()),
         out_specs=out_specs,
+        # the fused pallas_call's out_shape carries no varying-mesh-axes
+        # annotation, so the vma check cannot validate it; keep the check
+        # ON for every other path (it catches missing collective merges
+        # at trace time)
+        check_vma=not fused,
     )
     return fn(arrays, params, num_docs)
 
 
 def run_program_row_sharded(program: ir.Program, arrays: tuple, params: tuple,
-                            num_docs, padded: int, mesh: Mesh, slots=None):
+                            num_docs, padded: int, mesh: Mesh, slots=None,
+                            fused: str = "", lut_meta: tuple = ()):
     """Execute one segment's program with rows sharded across mesh[ROW_AXIS].
 
     `arrays` are global (padded) planes; `padded` must divide evenly by the
@@ -142,7 +161,9 @@ def run_program_row_sharded(program: ir.Program, arrays: tuple, params: tuple,
     assert padded % n_shards == 0, (padded, n_shards)
     kinds = tuple(kind for _col, kind in slots) if slots else tuple(
         "dict" if (a.ndim >= 1 and a.shape[0] != padded) else "ids" for a in arrays)
-    return _row_sharded_call(program, arrays, params, jnp.int32(num_docs), padded, mesh, kinds)
+    return _row_sharded_call(program, arrays, params, jnp.int32(num_docs),
+                             padded, mesh, kinds, fused=fused,
+                             lut_meta=lut_meta)
 
 
 def shard_segment_arrays(arrays: tuple, mesh: Mesh, padded: int, slots=None):

@@ -134,3 +134,44 @@ def test_row_sharded_adaptive_hist_rejected(segment):
     mesh = make_mesh(8)
     with pytest.raises(ValueError, match="adaptive"):
         run_program_row_sharded(program, (), (), 0, 8, mesh)
+
+
+def test_row_sharded_fused_kernel_parity(tmp_path):
+    """The fused single-pass kernel runs per shard inside shard_map with
+    psum-merged tables — identical to the unsharded two-step result. Uses
+    a RAW int32 metric so the program is genuinely fused-eligible."""
+    from pinot_tpu.ops import fused_groupby
+    from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+
+    rng = np.random.default_rng(11)
+    n = 20_000
+    schema = Schema.build(
+        "tf", dimensions=[("d1", "STRING"), ("d2", "INT")],
+        metrics=[("m", "INT")])
+    cfg = TableConfig(table_name="tf", indexing=IndexingConfig(
+        no_dictionary_columns=["m"]))
+    cols = {"d1": [f"k{i}" for i in rng.integers(0, 10, n)],
+            "d2": rng.integers(0, 5, n).astype(np.int32),
+            "m": rng.integers(0, 1000, n).astype(np.int32)}
+    SegmentBuilder(schema, cfg, "tf0").build(cols, tmp_path / "tf0")
+    segment = load_segment(tmp_path / "tf0")
+    query = parse_sql(
+        "SELECT d1, SUM(m), COUNT(*) FROM tf WHERE d2 = 2 GROUP BY d1 LIMIT 100")
+    plan = SegmentPlanner(query, segment).plan()
+    view = SegmentDeviceView(segment)
+    arrays = plan.gather_arrays(view)
+    assert fused_groupby.plan(plan.program, tuple(
+        jnp.asarray(a) for a in arrays)) is not None  # genuinely fused
+    params = tuple(jnp.asarray(p) for p in plan.params)
+    from pinot_tpu.ops.kernels import run_program
+
+    single = run_program(plan.program, arrays, params,
+                         jnp.int32(segment.num_docs), view.padded)
+    mesh = make_mesh(8)
+    arrays_sharded = shard_segment_arrays(arrays, mesh, view.padded,
+                                          slots=plan.slots)
+    multi = run_program_row_sharded(
+        plan.program, arrays_sharded, params, segment.num_docs, view.padded,
+        mesh, slots=plan.slots, fused="interpret")
+    for a, b in zip(single, multi):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
