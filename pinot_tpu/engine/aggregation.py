@@ -368,6 +368,11 @@ class AggPlanContext:
     def dict_info(self, e: ExpressionContext, sv_only: bool = False):  # pragma: no cover
         raise NotImplementedError
 
+    def col_meta(self, e: ExpressionContext):
+        """Column metadata for a plain identifier, else None (feeds
+        storage-aware lowerings like the f32 shadow-plane histogram)."""
+        return None
+
     def col_minmax(self, e: ExpressionContext):  # pragma: no cover
         raise NotImplementedError
 
@@ -677,11 +682,28 @@ def lower_aggregation(ctx: AggPlanContext, expr: ExpressionContext,
             # two-level adaptive device histogram (MXU count passes; see
             # kernels "hist_adaptive"): quantile resolution (hi-lo)/bins^2
             # concentrated around the asked percentile, 2*bins+1 output
-            # words per group instead of _HIST_BINS
+            # words per group instead of _HIST_BINS.
+            # Plain raw FLOAT/DOUBLE identifiers bin from a PRE-REBASED
+            # f32 plane ((v - col_min) in HBM, half the f64 read
+            # bandwidth); lo from col stats == the rebase base, so the
+            # kernel's offsets line up exactly.
+            vexpr = prebased = None
+            e0 = data[0]
+            m = ctx.col_meta(e0)
+            if m is not None and m.encoding == "RAW" and m.single_value \
+                    and str(m.data_type) in ("FLOAT", "DOUBLE"):
+                vexpr = ir.Col(ctx.slot(e0.identifier, "rawf32r"))
+                prebased = True
+            if vexpr is None:
+                # registering value_expr's raw/dict slots only on this
+                # branch keeps the f64 plane OUT of the query's HBM
+                # residency when the f32 shadow serves it alone
+                vexpr, prebased = ctx.value_expr(data[0]), False
             i = ctx.add_op(ir.AggOp(
-                "hist_adaptive", vexpr=ctx.value_expr(data[0]), bins=bins,
+                "hist_adaptive", vexpr=vexpr, bins=bins,
                 lo_param=ctx.param(np.float64(lo)),
-                hi_param=ctx.param(np.float64(hi)), pct=float(pct)))
+                hi_param=ctx.param(np.float64(hi)), pct=float(pct),
+                prebased=prebased))
             w1 = (hi - lo) / bins
             c1 = lo + (np.arange(bins) + 0.5) * w1
 

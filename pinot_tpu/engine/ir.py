@@ -252,6 +252,11 @@ class AggOp:
     # negative-count pass in the exact i32-scatter decomposition
     vmin: Optional[int] = None
     vmax: Optional[int] = None
+    # hist_adaptive over a raw float column: vexpr evaluates to a PRE-REBASED
+    # f32 offset plane ((v - column_min) stored f32 in HBM — half the read
+    # bandwidth of the f64 plane and no per-row f64 subtract; the TPU has no
+    # f64 ALU). lo_param still carries the f64 base for host-side decode.
+    prebased: bool = False
 
 
 # ---------------------------------------------------------------------------

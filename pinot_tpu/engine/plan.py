@@ -147,7 +147,7 @@ def _coerce_like(vals: np.ndarray, v):
 @dataclass
 class SegmentPlan:
     program: ir.Program
-    slots: list  # (column, kind) in slot order; kind ∈ ids|mvids|raw|dict|null
+    slots: list  # (column, kind); kind ∈ ids|mvids|raw|rawf32r|dict|null
     params: list  # host param values in order (np scalars / arrays)
     lowered_aggs: list[LoweredAgg] = field(default_factory=list)
     group_dims: list[GroupDim] = field(default_factory=list)
@@ -176,6 +176,8 @@ class SegmentPlan:
                 out.append(view.mv_dict_ids(column))
             elif kind == "raw":
                 out.append(view.raw(column))
+            elif kind == "rawf32r":
+                out.append(view.raw_f32_rebased(column))
             elif kind == "dict":
                 out.append(view.dict_values(column))
             elif kind == "null":
@@ -302,6 +304,11 @@ class SegmentPlanner(AggPlanContext):
         ident = {"sum": 0.0, "min": np.inf, "max": -np.inf}[op]
         lut = np.concatenate([vals.astype(np.float64), [ident]])
         return ir.MvLutReduce(slot, self.param(lut), op), None, None
+
+    def col_meta(self, e: ExpressionContext):
+        if not e.is_identifier:
+            return None
+        return self._meta(e.identifier)
 
     def col_minmax(self, e: ExpressionContext):
         """(min, max) stats for a plain numeric column, else None — feeds
@@ -876,7 +883,7 @@ class SegmentPlanner(AggPlanContext):
                 mv_group_card=mv_group_card if mode != "aggregation" else None,
                 mv_doc_slots=tuple(
                     i for i, (_c, k) in enumerate(self._slots)
-                    if k in ("ids", "raw", "null"))
+                    if k in ("ids", "raw", "rawf32r", "null"))
                 if mv_group_slot is not None else (),
             )
             return SegmentPlan(program, self._slots, self._params, lowered, group_dims)

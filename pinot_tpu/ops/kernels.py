@@ -925,9 +925,14 @@ def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n,
         # magnitude ≤ (hi-lo) where f32 ulp is ~1e-7 of the range.
         # Membership between the two passes stays BIT-IDENTICAL because
         # pass 2 recomputes b1 with the same ops.
-        v64 = _eval_value(agg.vexpr, arrays, params).astype(jnp.float64)
         lo64 = params[agg.lo_param]
-        v = (v64 - lo64).astype(jnp.float32)  # offset from lo, f32-safe
+        if agg.prebased:
+            # the plane in HBM is already (v - lo) as f32 (the planner's
+            # rawf32r slot; lo == the column min the plane was rebased by)
+            v = _eval_value(agg.vexpr, arrays, params)
+        else:
+            v64 = _eval_value(agg.vexpr, arrays, params).astype(jnp.float64)
+            v = (v64 - lo64).astype(jnp.float32)  # offset from lo, f32-safe
         span = jnp.float32(params[agg.hi_param] - lo64)
         width1 = span / bins
         b1 = jnp.clip((v / width1).astype(jnp.int32), 0, bins - 1)

@@ -96,21 +96,27 @@ def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_doc
     n_shards = mesh.shape[ROW_AXIS]
     local_n = padded // n_shards
     array_specs = tuple(P() if k == "dict" else P(ROW_AXIS) for k in kinds)
+    fp = None
+    if fused and program.mode == "group_by":
+        # static dtype/ndim analysis — shard dtypes equal global dtypes,
+        # so plan once OUTSIDE shard_fn (also scopes check_vma below to
+        # programs that genuinely run the fused kernel)
+        from ..ops import fused_groupby
+
+        fp = fused_groupby.plan(program, arrays, lut_meta)
 
     def shard_fn(arrays_l, params_l, num_docs_l):
         idx = jax.lax.axis_index(ROW_AXIS)
         offset = idx.astype(jnp.int32) * jnp.int32(local_n)
-        if fused and program.mode == "group_by":
+        if fp is not None:
             # per-shard fused kernel; table outputs psum over ICI exactly
             # like the two-step path (same output contract)
             from ..ops import fused_groupby
 
-            fp = fused_groupby.plan(program, arrays_l, lut_meta)
-            if fp is not None:
-                outs = fused_groupby.execute(
-                    fp, program, arrays_l, params_l, num_docs_l, local_n,
-                    offset, interpret=(fused == "interpret"))
-                return _combine_collectives(program, outs, ROW_AXIS)
+            outs = fused_groupby.execute(
+                fp, program, arrays_l, params_l, num_docs_l, local_n,
+                offset, interpret=(fused == "interpret"))
+            return _combine_collectives(program, outs, ROW_AXIS)
         outs = _run_program_impl(program, arrays_l, params_l, num_docs_l, local_n, offset)
         if program.mode == "selection":
             return outs  # masks stay row-sharded
@@ -126,9 +132,9 @@ def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_doc
         out_specs=out_specs,
         # the fused pallas_call's out_shape carries no varying-mesh-axes
         # annotation, so the vma check cannot validate it; keep the check
-        # ON for every other path (it catches missing collective merges
-        # at trace time)
-        check_vma=not fused,
+        # ON for every path that doesn't actually run the fused kernel
+        # (it catches missing collective merges at trace time)
+        check_vma=fp is None,
     )
     return fn(arrays, params, num_docs)
 

@@ -119,6 +119,21 @@ class SegmentDeviceView:
             self._put(key, out)
         return self._planes[key]
 
+    def raw_f32_rebased(self, column: str) -> jnp.ndarray:
+        """(v - column_min) as an f32 plane — the histogram-binning view
+        of a raw float column. Rebasing BEFORE the f32 cast keeps
+        large-magnitude narrow-range columns (epoch millis) at full range
+        precision; the f32 plane costs half the f64 plane's HBM residency
+        and read bandwidth."""
+        key = (column, "rawf32r")
+        if key not in self._planes:
+            vals = self.segment.get_raw(column)
+            base = float(self.segment.column_metadata(column).min_value)
+            out = np.zeros(self.padded, dtype=np.float32)
+            out[: vals.shape[0]] = (vals - base).astype(np.float32)
+            self._put(key, out)
+        return self._planes[key]
+
     def dict_values(self, column: str) -> jnp.ndarray:
         """Numeric dictionary shipped to device for on-device decode."""
         key = (column, "dict")
