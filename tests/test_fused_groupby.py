@@ -57,6 +57,11 @@ SQLS = [
     ("SELECT brand, COUNT(*), SUM(rev) FROM fg GROUP BY brand LIMIT 10000"),
     # empty result (filter matches nothing)
     ("SELECT year, SUM(rev) FROM fg WHERE qty > 1000 GROUP BY year LIMIT 10"),
+    # DISTINCT → group-by with zero aggregations (count plane only)
+    ("SELECT DISTINCT year, region FROM fg LIMIT 100"),
+    # multiple sums incl. signed (many limb planes in one pass)
+    ("SELECT year, SUM(rev), SUM(signed), COUNT(*) FROM fg "
+     "WHERE brand < 350 GROUP BY year LIMIT 100"),
 ]
 
 
