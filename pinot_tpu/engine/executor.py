@@ -73,7 +73,7 @@ class TpuSegmentExecutor:
         # Dict-LUT predicates (IN/LIKE/NOT...) join the fused scope when
         # their boolean LUT compresses to a few contiguous dict-id runs —
         # a dispatch-time property of the CONCRETE host params.
-        fused = fused_groupby.active()
+        fused = fused_groupby.active() if plan.fused_ok else ""
         lut_meta: tuple = ()
         base_params = params
         if fused:

@@ -153,6 +153,10 @@ class SegmentPlan:
     group_dims: list[GroupDim] = field(default_factory=list)
     selection_columns: list[str] = field(default_factory=list)
     selection_exprs: dict = field(default_factory=dict)  # label → transform expr
+    # per-query kill switch for the single-pass fused kernel
+    # (SET useFusedKernel = false — reference pattern: per-query engine
+    # toggles like useStarTree applied by the plan maker)
+    fused_ok: bool = True
 
     def gather_arrays(self, view: SegmentDeviceView) -> tuple:
         return self.gather_arrays_packed(view, allow_packed=False)[0]
@@ -309,6 +313,13 @@ class SegmentPlanner(AggPlanContext):
         if not e.is_identifier:
             return None
         return self._meta(e.identifier)
+
+    def _fused_ok(self) -> bool:
+        # case-insensitive off-spellings: options arrive as raw strings
+        # through the distributed request path (mse/runtime._null_handling
+        # normalizes the same way)
+        opt = self.query.query_options.get("useFusedKernel")
+        return str(opt).lower() not in ("false", "0", "off")
 
     def col_minmax(self, e: ExpressionContext):
         """(min, max) stats for a plain numeric column, else None — feeds
@@ -886,7 +897,9 @@ class SegmentPlanner(AggPlanContext):
                     if k in ("ids", "raw", "rawf32r", "null"))
                 if mv_group_slot is not None else (),
             )
-            return SegmentPlan(program, self._slots, self._params, lowered, group_dims)
+            return SegmentPlan(program, self._slots, self._params,
+                               lowered, group_dims,
+                               fused_ok=self._fused_ok())
 
         # selection: kernel computes the mask; host materializes rows.
         # Transform select/order expressions evaluate host-side over the

@@ -246,3 +246,21 @@ def test_lut_query_takes_fused_path(segment):
     fp = fused_groupby.plan(p.program, tuple(arrays), meta)
     assert fp is not None
     assert any(t[0] == "runs" for t in fp.terms)
+
+
+def test_use_fused_kernel_option(segment, monkeypatch):
+    """SET useFusedKernel = false forces the two-step path per query."""
+    seg, schema, cols = segment
+    monkeypatch.setenv("PINOT_TPU_FUSED", "interpret")
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(schema, [seg])
+    plain = SegmentPlanner(parse_sql(SQLS[0]), seg).plan()
+    assert plain.fused_ok
+    off = SegmentPlanner(
+        parse_sql("SET useFusedKernel = false; " + SQLS[0]), seg).plan()
+    assert not off.fused_ok
+    a = qe.execute_sql("SET useFusedKernel = false; " + SQLS[0])
+    b = qe.execute_sql(SQLS[0])
+    assert not a.exceptions and not b.exceptions
+    assert sorted(map(tuple, a.result_table.rows)) == \
+        sorted(map(tuple, b.result_table.rows))
