@@ -7,6 +7,7 @@ Configs (BASELINE.md, scaled to BENCH_ROWS total rows each):
   q4  16-segment combine of q2 (batched async dispatch)
   q5  NYC-Taxi-style COUNT DISTINCT + PERCENTILE_TDIGEST GROUP BY day
   q6  sparse COUNT DISTINCT inside a high-card group-by
+  q7  LOOKUP star join    q8  MSE equi-join    q9  3-SUM group-by
 
 Architecture (hardened after rounds 1-2 produced zero TPU artifacts):
   * The PARENT process never touches the accelerator. It probes it in a
@@ -54,7 +55,7 @@ _START = time.monotonic()
 # q6 runs LAST: its sparse-distinct program has the slowest cold compile,
 # and a hung/abandoned child skips every config after it
 CONFIGS = [c for c in os.environ.get(
-    "BENCH_CONFIGS", "q1,q2,q3,q4,q5,q7,q8,q6").split(",") if c]
+    "BENCH_CONFIGS", "q1,q2,q9,q3,q4,q5,q7,q8,q6").split(",") if c]
 ROOT = Path(__file__).parent
 CACHE = ROOT / ".bench_cache"
 # smoke/dev runs point this elsewhere (BENCH_PARTIAL_DIR) so they never
@@ -94,6 +95,11 @@ Q8 = ("SELECT a.d_year, COUNT(*), SUM(b.lo_revenue) FROM {t} a "
       "JOIN {t} b ON a.lo_orderkey = b.lo_orderkey "
       "WHERE a.lo_quantity < 3 AND b.lo_discount = 0 "
       "GROUP BY a.d_year ORDER BY a.d_year LIMIT 100")
+# BASELINE config 3 verbatim shape: 3 SUM measures through one MXU pass
+# (1 count + 3x3 limb planes with int8 limbs)
+Q9 = ("SELECT d_year, p_brand, SUM(lo_revenue), SUM(lo_extendedprice), "
+      "SUM(lo_quantity) FROM {t} WHERE s_region = 'ASIA' "
+      "GROUP BY d_year, p_brand LIMIT 10000")
 
 RUNS = {
     "q1": ("q1_filter_sum", Q1.format(t="ssb"), "ssb", 1.0, 0.0),
@@ -111,6 +117,7 @@ RUNS = {
     "q6": ("q6_sparse_distinct", Q6.format(t="ssb"), "ssb", 1 / 3, 0.0),
     "q7": ("q7_lookup_join", Q7.format(t="ssb"), "ssb", 1.0, 0.0),
     "q8": ("q8_mse_join", Q8.format(t="ssb"), "ssb", 1 / 3, 0.0),
+    "q9": ("q9_groupby_3sums", Q9.format(t="ssb"), "ssb", 1.0, 0.0),
 }
 
 N_BRANDS = 1000
@@ -190,16 +197,17 @@ def prepare_tables(need_ssb, need_ssb16, need_taxi):
     ssb_cols = None
     if need_ssb or need_ssb16:
         schema = _ssb_schema("ssb")
-        d = CACHE / f"ssb_{ROWS}_v2"
+        d = CACHE / f"ssb_{ROWS}_v3"
         if not (d / "metadata.json").exists():
             ssb_cols = _gen_ssb(ROWS)
             print(f"[bench] generating ssb {ROWS:,} rows", file=sys.stderr)
             _build(schema, ssb_cols, d, "ssb_0",
-                   no_dict=["lo_extendedprice", "lo_revenue"])
+                   no_dict=["lo_extendedprice", "lo_revenue",
+                            "lo_quantity"])
         out["ssb"] = (schema, [d])
     if need_ssb16:
         schema16 = _ssb_schema("ssb16")
-        dirs = [CACHE / f"ssb16_{ROWS}" / f"s{i}" for i in range(16)]
+        dirs = [CACHE / f"ssb16_{ROWS}_v3" / f"s{i}" for i in range(16)]
         if not (dirs[-1] / "metadata.json").exists():
             if ssb_cols is None:
                 ssb_cols = _gen_ssb(ROWS)
@@ -208,7 +216,8 @@ def prepare_tables(need_ssb, need_ssb16, need_taxi):
                 sl = slice(int(bounds[i]), int(bounds[i + 1]))
                 _build(schema16, {k: v[sl] for k, v in ssb_cols.items()},
                        dirs[i], f"ssb16_{i}",
-                       no_dict=["lo_extendedprice", "lo_revenue"])
+                       no_dict=["lo_extendedprice", "lo_revenue",
+                                "lo_quantity"])
         out["ssb16"] = (schema16, dirs)
     del ssb_cols
     if need_taxi:
@@ -372,7 +381,7 @@ def orchestrate():
         notes.append("cpu fallback: rows scaled to 20M")
         print("[bench] cpu fallback: ROWS -> 20M", file=sys.stderr)
 
-    need_ssb = any(c in CONFIGS for c in ("q1", "q2", "q3", "q6", "q7", "q8"))
+    need_ssb = any(RUNS[c][2] == "ssb" for c in CONFIGS if c in RUNS)
     prepare_tables(need_ssb, "q4" in CONFIGS, "q5" in CONFIGS)
 
     PARTIAL.mkdir(exist_ok=True)
