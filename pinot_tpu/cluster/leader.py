@@ -50,6 +50,11 @@ class LeadControllerManager:
     def stop(self) -> None:
         """Graceful resignation (session stays alive, e.g. rolling restart)."""
         self._started = False
+        try:
+            self.store.unwatch(self._on_event)  # don't pin the elector
+            self._watched = False  # a restart must re-register
+        except AttributeError:
+            pass
         with self._lock:
             was = self._is_leader
             self._is_leader = False

@@ -49,6 +49,12 @@ class PropertyStoreServer:
 
     def close(self) -> None:
         self._rpc.close()
+        try:
+            # a closed server must not keep accumulating the shared
+            # store's events (or be pinned by its watch list)
+            self.store.unwatch(self._on_change)
+        except AttributeError:
+            pass
 
     def _on_change(self, path: str, value) -> None:
         with self._lock:
@@ -145,6 +151,13 @@ class RemoteStore:
         self._call("expire_session", owner)
 
     # -- watches -----------------------------------------------------------
+    def unwatch(self, callback: Callable) -> None:
+        with self._lock:
+            # equality, not identity: bound methods are re-created per
+            # access, so `is` would never match
+            self._watches = [(p, cb) for p, cb in self._watches
+                             if cb != callback]
+
     def watch(self, prefix: str, callback: Callable[[str, Optional[Any]], None]) -> None:
         with self._lock:
             self._watches.append((prefix, callback))

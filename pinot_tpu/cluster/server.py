@@ -60,6 +60,13 @@ class ServerInstance:
         Instance config stays (reference: ZK session expiry vs config)."""
         self._started = False
         self._rpc.close()
+        # unregister the ideal-state watcher: a dead server left in the
+        # store's watch list is pinned alive with every loaded segment's
+        # memmap fd — unbounded fd/memory growth under server churn
+        try:
+            self.store.unwatch(self._on_ideal_state)
+        except AttributeError:
+            pass  # store impls without unwatch (older remote protocol)
         self.store.expire_session(self.instance_id)
 
     @property

@@ -118,6 +118,18 @@ class PropertyStore:
         with self._lock:
             self._watches.append((prefix, callback))
 
+    def unwatch(self, callback: Callable) -> None:
+        """Remove every watch registered with this callback. A stopped
+        component MUST unregister, or the store pins it (and everything it
+        references — loaded segments, sockets) for the store's lifetime:
+        a real fd/memory leak under server churn (reference analogue: ZK
+        watcher removal on Helix disconnect)."""
+        with self._lock:
+            # equality, not identity: bound methods are re-created per
+            # access, so `is` would never match
+            self._watches = [(p, cb) for p, cb in self._watches
+                             if cb != callback]
+
     def _notify(self, path: str, value: Optional[Any]) -> None:
         with self._lock:
             targets = [cb for prefix, cb in self._watches if path.startswith(prefix)]

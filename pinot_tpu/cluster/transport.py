@@ -105,6 +105,8 @@ class RpcServer:
         self.host = host
         self.port = self._sock.getsockname()[1]
         self._closed = threading.Event()
+        self._conns: set = set()  # live per-connection sockets
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"rpc-accept-{self.port}", daemon=True)
         self._accept_thread.start()
@@ -150,12 +152,24 @@ class RpcServer:
                 self._inflight -= n
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        import types
-
         handshaken = self._handshake(conn)
         if handshaken is None:
             return
         conn = handshaken
+        with self._conns_lock:
+            if self._closed.is_set():
+                conn.close()
+                return
+            self._conns.add(conn)
+        try:
+            self._serve_conn_loop(conn)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _serve_conn_loop(self, conn: socket.socket) -> None:
+        import types
+
         with conn:
             while not self._closed.is_set():
                 try:
@@ -209,9 +223,33 @@ class RpcServer:
     def close(self) -> None:
         self._closed.set()
         try:
+            # close() alone does NOT wake a thread blocked in accept() on
+            # Linux — shutdown() does (EINVAL), so the accept thread can
+            # actually exit and release its reference to the handler
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        # established connections must die too: a per-connection thread
+        # blocked in recv() on a still-open socket pins self.handler (and
+        # through the bound method, the whole owning server instance)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        # join the accept thread: while alive it pins self.handler
+        if self._accept_thread is not threading.current_thread():
+            self._accept_thread.join(timeout=2.0)
 
 
 class RpcClient:

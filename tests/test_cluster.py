@@ -316,3 +316,22 @@ def test_rpc_memory_budget_sheds_load():
         client.close()
     finally:
         server.close()
+
+
+def test_stopped_server_unpins_from_store():
+    """stop() must unregister the store watcher — a dead server left in
+    the watch list is pinned alive with every loaded segment's memmap fd
+    (unbounded growth under server churn; found by the chaos soak)."""
+    import gc
+    import weakref
+
+    store = PropertyStore()
+    s = ServerInstance(store, "Server_X", backend="host")
+    s.start()
+    ref = weakref.ref(s)
+    n_watches = len(store._watches)
+    s.stop()
+    assert len(store._watches) == n_watches - 1
+    del s
+    gc.collect()
+    assert ref() is None, "stopped server still referenced (store pin?)"
