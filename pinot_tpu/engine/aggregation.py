@@ -24,7 +24,6 @@ DISTINCTCOUNTHLL/THETA→LONG.
 from __future__ import annotations
 
 import math
-import re
 from dataclasses import dataclass
 from decimal import Decimal
 from typing import Callable, Optional

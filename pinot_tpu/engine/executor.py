@@ -18,7 +18,6 @@ from ..ops.kernels import PackedOuts, pack_outputs, run_program, unpack_outputs
 from ..query.context import QueryContext
 from ..segment.device_cache import GLOBAL_DEVICE_CACHE, DeviceSegmentCache
 from ..segment.loader import ImmutableSegment
-from .aggregation import UnsupportedQueryError
 from .plan import SegmentPlan, SegmentPlanner
 from .results import (
     AggIntermediate,

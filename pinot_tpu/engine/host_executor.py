@@ -10,7 +10,6 @@ python where not — clarity over speed.
 
 from __future__ import annotations
 
-import math
 import re
 
 import numpy as np

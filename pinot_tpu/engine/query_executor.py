@@ -24,7 +24,7 @@ from ..spi.trace import TRACING, ServerQueryPhase
 from .scheduler import GLOBAL_ACCOUNTANT
 from ..segment.loader import ImmutableSegment
 from ..spi.data_types import Schema
-from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
+from .aggregation import UnsupportedQueryError, semantics_for
 from .combine import (combine_aggregation, combine_group_by,
                       combine_selection, trim_group_by)
 from ..ops.kernels import PackedOuts, fetch_packed_batch

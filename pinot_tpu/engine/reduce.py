@@ -16,11 +16,10 @@ from ..query.context import QueryContext
 from ..query.expressions import ExpressionContext, is_aggregation
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
 from ..spi.data_types import DataType, Schema
-from .aggregation import UnsupportedQueryError, get_semantics, semantics_for
+from .aggregation import UnsupportedQueryError, semantics_for
 from .plan import like_to_regex
 from .results import (
     AggIntermediate,
-    BrokerResponse,
     DataSchema,
     GroupArrays,
     GroupByIntermediate,

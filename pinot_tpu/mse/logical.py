@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..engine.aggregation import UnsupportedQueryError, get_semantics
-from ..query.expressions import ExpressionContext, ExpressionType
+from ..query.expressions import ExpressionContext
 from ..query.parser.sql import SqlParseError
 from .ast import (
     JoinRel,

@@ -26,7 +26,7 @@ from ..query.expressions import ExpressionContext
 from ..query.transforms import eval_expr_np
 from .ast import OrderItem, WindowSpec
 from .logical import AggCall, WindowCall
-from .mailbox import Block, block_len, concat_blocks, take_block
+from .mailbox import Block, block_len, take_block
 
 EC = ExpressionContext
 

@@ -21,7 +21,6 @@ diverge:
 from __future__ import annotations
 
 import base64
-import binascii
 import datetime as _dt
 import hashlib
 import json

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 from pathlib import Path
 
-import numpy as np
 
 from ..segment.builder import SegmentBuilder
 from ..segment.mutable import MutableSegment

@@ -15,7 +15,7 @@ from typing import Iterable, Mapping, Optional, Sequence
 
 import numpy as np
 
-from ..spi.data_types import DataType, FieldType, Schema
+from ..spi.data_types import DataType, Schema
 from ..spi.partition import get_partition_function
 from ..spi.table_config import TableConfig
 from . import bitpack

@@ -22,7 +22,6 @@ planes.
 
 from __future__ import annotations
 
-import re
 import shlex
 from dataclasses import dataclass, field
 from typing import Optional

@@ -33,7 +33,6 @@ def cmd_quickstart(args) -> int:
                                 ServerRestServer)
     from ..segment.builder import SegmentBuilder
     from ..spi.data_types import Schema
-    from ..timeseries import TimeSeriesEngine
 
     store = PropertyStore()
     controller = ClusterController(store)
