@@ -627,11 +627,22 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     # sort, no n-length output (the old pair-list output was ~100x the
     # query's real bytes through a tunneled fetch and blew up compiles).
     num_sort_keys = 1
-    operands = [key]
     distinct_aggs = [a for a in program.aggs if a.kind == "distinct_bitmap"]
     if len(distinct_aggs) > 1:
         raise ValueError("sparse group-by supports one DISTINCT column")
-    if distinct_aggs:
+    # DISTINCT ids PACK into the key's low digits when the combined space
+    # fits int32 (key' = key*card + id): one sort operand fewer — the
+    # secondary sort order arrives free, and uniq/group edges both fall
+    # out of the single packed key. Falls back to a two-key sort when the
+    # product overflows.
+    pack_card = None
+    if distinct_aggs and key32 and \
+            0 < program.key_space * distinct_aggs[0].card < _I32_MAX:
+        pack_card = int(distinct_aggs[0].card)
+        ids_raw = arrays[distinct_aggs[0].ids_slot].astype(jnp.int32)
+        key = jnp.where(mask, key * jnp.int32(pack_card) + ids_raw, sentinel)
+    operands = [key]
+    if distinct_aggs and pack_card is None:
         operands.append(arrays[distinct_aggs[0].ids_slot].astype(jnp.int32))
         num_sort_keys = 2
     specs = []  # per agg: (reduce_kind, operand index | None[, agg])
@@ -640,7 +651,7 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
             specs.append(("count", None))
             continue
         if agg.kind == "distinct_bitmap":
-            specs.append(("distinct", 1, agg))
+            specs.append(("distinct", None if pack_card else 1, agg))
             continue
         v = _eval_value(agg.vexpr, arrays, params)
         fast32 = jnp.issubdtype(v.dtype, jnp.integer) and _fits_i32(v, agg)
@@ -674,8 +685,17 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
         operands.append(v)
 
     sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_sort_keys)
-    skey = sorted_ops[0]
-    valid = skey < sentinel
+    skey_raw = sorted_ops[0]
+    valid = skey_raw < sentinel
+    if pack_card is not None:
+        # unpack: group key = high digits; the id low digit feeds the
+        # distinct branch. Sentinel rows' quotient stays huge (> any real
+        # key) so the sentinel-tail ordering survives the division.
+        skey = skey_raw // jnp.int32(pack_card)
+        packed_sids = skey_raw - skey * jnp.int32(pack_card)
+    else:
+        skey = skey_raw
+        packed_sids = None
     first = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), skey[1:] != skey[:-1]]) & valid
     gidx = jnp.cumsum(first.astype(jnp.int32)) - 1
@@ -713,10 +733,16 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
         elif kind == "distinct":
             agg = spec[2]
             card = agg.card
-            sids = sorted_ops[oi]  # dict ids, sorted within each group
-            uniq = jnp.concatenate(
-                [jnp.ones((1,), dtype=bool),
-                 (skey[1:] != skey[:-1]) | (sids[1:] != sids[:-1])]) & valid
+            if oi is None:  # ids packed into the sort key's low digit
+                sids = packed_sids
+                uniq = jnp.concatenate(
+                    [jnp.ones((1,), dtype=bool),
+                     skey_raw[1:] != skey_raw[:-1]]) & valid
+            else:
+                sids = sorted_ops[oi]  # dict ids, sorted within each group
+                uniq = jnp.concatenate(
+                    [jnp.ones((1,), dtype=bool),
+                     (skey[1:] != skey[:-1]) | (sids[1:] != sids[:-1])]) & valid
             bit = sids.astype(jnp.uint32)
             cols = []
             for w in range(-(-card // 32)):
