@@ -264,3 +264,63 @@ def test_use_fused_kernel_option(segment, monkeypatch):
     assert not a.exceptions and not b.exceptions
     assert sorted(map(tuple, a.result_table.rows)) == \
         sorted(map(tuple, b.result_table.rows))
+
+
+@pytest.mark.skipif(not __import__("os").environ.get("PINOT_TPU_BF16_TEST"),
+                    reason="slow cold-compile subprocess; set "
+                           "PINOT_TPU_BF16_TEST=1 to run (parity also "
+                           "verified standalone)")
+def test_fused_bf16_mode_parity(tmp_path):
+    """PINOT_TPU_MXU_INT8=0 switches the plane dtype to bf16/8-bit limbs
+    at import time — run the parity check in a subprocess with that env."""
+    import subprocess
+    import sys
+
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PINOT_TPU_MXU_INT8"] = "0"
+import numpy as np
+from pinot_tpu.ops import mxu_groupby
+assert mxu_groupby.LIMB_BITS == 8 and "bfloat16" in str(mxu_groupby.PLANE_DTYPE)
+from pinot_tpu.engine.plan import SegmentPlanner
+from pinot_tpu.ops.kernels import run_program
+from pinot_tpu.query.parser.sql import parse_sql
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.device_cache import SegmentDeviceView
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.table_config import IndexingConfig, TableConfig
+rng = np.random.default_rng(7)
+n = 9000
+schema = Schema.build("b", dimensions=[("g", "INT")], metrics=[("v", "INT"), ("s", "INT")])
+cfg = TableConfig(table_name="b", indexing=IndexingConfig(no_dictionary_columns=["v", "s"]))
+SegmentBuilder(schema, cfg, "b0").build(
+    {"g": rng.integers(0, 50, n).astype(np.int32),
+     "v": rng.integers(0, 1_000_000, n).astype(np.int32),
+     "s": rng.integers(-99_000, 99_000, n).astype(np.int32)}, r"OUT")
+seg = load_segment(r"OUT")
+plan = SegmentPlanner(parse_sql(
+    "SELECT g, SUM(v), SUM(s), COUNT(*) FROM b WHERE g < 40 GROUP BY g LIMIT 100"), seg).plan()
+view = SegmentDeviceView(seg)
+arrays, packed = plan.gather_arrays_packed(view)
+params = tuple(np.asarray(p) for p in plan.params)
+base = [np.asarray(o) for o in run_program(
+    plan.program, tuple(arrays), params, np.int32(seg.num_docs),
+    view.padded, packed=tuple(packed), fused="")]
+got = [np.asarray(o) for o in run_program(
+    plan.program, tuple(arrays), params, np.int32(seg.num_docs),
+    view.padded, packed=tuple(packed), fused="interpret")]
+for b_, g_ in zip(base, got):
+    np.testing.assert_array_equal(b_, g_)
+print("BF16 PARITY OK")
+""".replace("OUT", str(tmp_path / "bfseg"))
+    import os as _os
+
+    env = {k: v for k, v in _os.environ.items() if k != "XLA_FLAGS"}
+    # the suite's 8-virtual-device flag makes the child's compiles ~15x
+    # slower; this test needs one CPU device only
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "BF16 PARITY OK" in r.stdout
