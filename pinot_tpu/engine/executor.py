@@ -10,6 +10,10 @@ group keys back to values.
 
 from __future__ import annotations
 
+import logging
+import os
+import threading
+
 import jax
 import numpy as np
 import jax.numpy as jnp
@@ -28,12 +32,61 @@ from .results import (
 from .selection import selection_from_mask
 
 
+class _CompileCacheGuard:
+    """Process-global valve over jax's UNBOUNDED executable cache.
+
+    A long-lived server compiling unbounded distinct query shapes dies
+    with LLVM "Cannot allocate memory" (observed at ~10K distinct shapes
+    in a query-fuzz soak). The guard counts distinct (program, padded,
+    fused-variant) keys — one per compiled executable family — at the
+    same PROCESS scope the jax cache lives at, and drops all jit caches
+    wholesale when the limit is hit: recompiling is slow but alive (the
+    reference's DirectOOMHandler shed-load philosophy applied to compile
+    caches). Bookkeeping is locked; the clear itself is best-effort
+    against concurrently-compiling threads."""
+
+    def __init__(self):
+        self.limit = int(os.environ.get(
+            "PINOT_TPU_COMPILE_CACHE_LIMIT", 4096))
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._validated: set = set()  # fused variants proven on-device
+
+    def note(self, key) -> None:
+        with self._lock:
+            if key in self._seen:
+                return
+            if len(self._seen) >= self.limit:
+                logging.getLogger(__name__).warning(
+                    "dropping jit caches after %d distinct compiled "
+                    "variants (PINOT_TPU_COMPILE_CACHE_LIMIT)",
+                    len(self._seen))
+                try:
+                    jax.clear_caches()
+                except Exception:
+                    pass  # another thread mid-compile: retry next miss
+                else:
+                    self._seen.clear()
+                    self._validated.clear()
+            self._seen.add(key)
+
+    def validated(self, vkey) -> bool:
+        with self._lock:
+            return vkey in self._validated
+
+    def mark_validated(self, vkey) -> None:
+        with self._lock:
+            self._validated.add(vkey)
+
+
+_GUARD = _CompileCacheGuard()
+
+
 class TpuSegmentExecutor:
     """Executes one QueryContext against one segment on the device."""
 
     def __init__(self, cache: DeviceSegmentCache = None):
         self.cache = cache or GLOBAL_DEVICE_CACHE
-        self._fused_validated: set = set()  # programs proven on-device once
 
     def plan(self, query: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         return SegmentPlanner(query, segment).plan()
@@ -83,6 +136,9 @@ class TpuSegmentExecutor:
                 params = params + extra  # run arrays ride as extra params
             else:
                 fused, lut_meta = "", ()
+        # one entry per compiled executable family: padded shape and the
+        # fused/lut variants each compile separately
+        _GUARD.note((plan.program, view.padded, fused, lut_meta))
         try:
             outs = run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
@@ -91,13 +147,13 @@ class TpuSegmentExecutor:
             # the compiled fused kernel varies with lut_meta (run counts
             # are static), so validation is keyed per (program, meta)
             vkey = (plan.program, lut_meta)
-            if fused and vkey not in self._fused_validated:
+            if fused and not _GUARD.validated(vkey):
                 # dispatch is async: a device-side kernel failure would
                 # otherwise surface at collect(), past this fallback. Block
                 # ONCE per compiled variant to prove the kernel end-to-end;
                 # later executions stay fully async.
                 jax.block_until_ready(outs)
-                self._fused_validated.add(vkey)
+                _GUARD.mark_validated(vkey)
         except Exception as e:
             if not fused:
                 raise
