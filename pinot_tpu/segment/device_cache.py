@@ -196,12 +196,23 @@ class DeviceSegmentCache:
             m = segment.column_metadata(col)
             if m.encoding == "DICT":
                 v.dict_ids_packed(col) if m.single_value else v.dict_ids(col)
+                n += 1
                 if np.asarray(segment.get_dictionary(col).values).dtype.kind \
                         in "iuf":
                     v.dict_values(col)
+                    n += 1
             else:
                 v.raw(col)
-            n += 1
+                n += 1
+                if m.data_type in ("FLOAT", "DOUBLE") and m.single_value \
+                        and m.min_value is not None:
+                    # percentile histograms bin from the f32 shadow plane
+                    # (plan.py rawf32r) — warm it so the first q5-shaped
+                    # query skips a whole-column convert + upload. Pins
+                    # 1.5x the raw plane's bytes for float columns; the
+                    # budget-driven eviction handles pressure.
+                    v.raw_f32_rebased(col)
+                    n += 1
         return n
 
     def drop(self, segment: ImmutableSegment) -> None:

@@ -142,7 +142,9 @@ def test_device_cache_warm(tmp_path):
     seg = load_segment(tmp_path / "w0")
     cache = DeviceSegmentCache()
     n = cache.warm(seg)
-    assert n == 3
+    # planes: s ids + s dict? (string dict not numeric -> no values
+    # plane), i ids + i dict values, d raw + d f32 shadow
+    assert n == 5
     v = cache.view(seg)
     assert v.nbytes() > 0
     before = v.nbytes()
