@@ -257,8 +257,12 @@ def _probe_accelerator() -> bool:
     import subprocess
     import tempfile
 
+    # probe budget sized so a DEAD tunnel (one hung attempt consumes the
+    # whole budget) still leaves room for all nine cpu-fallback configs:
+    # observed init latencies are ~30s when the tunnel is healthy, and
+    # fail-fast errors retry with backoff well inside 360s
     budget = float(os.environ.get(
-        "BENCH_INIT_PROBE_S", min(600.0, TIME_BUDGET_S * 0.3)))
+        "BENCH_INIT_PROBE_S", min(360.0, TIME_BUDGET_S * 0.25)))
     if budget <= 0:
         return True
     deadline = time.monotonic() + min(budget, max(_remaining() - 120, 30))
