@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probes the axon tunnel every 10 min; writes status lines to status.log.
+# On first success, writes LIVE marker file and keeps watching.
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 150 python -c "import jax; jax.numpy.zeros(8).block_until_ready(); print('OK', [d.platform for d in jax.devices()])" 2>&1)
+  rc=$?
+  if [ $rc -eq 0 ] && echo "$out" | grep -q "OK"; then
+    echo "$ts LIVE $out" >> /root/repo/.tunnel_watch/status.log
+    touch /root/repo/.tunnel_watch/LIVE
+  else
+    echo "$ts DOWN rc=$rc $(echo "$out" | tail -1 | head -c 120)" >> /root/repo/.tunnel_watch/status.log
+    rm -f /root/repo/.tunnel_watch/LIVE
+  fi
+  sleep 600
+done

@@ -35,6 +35,11 @@ from .store import PropertyStore
 from .transport import RpcClient, TransportError
 
 
+class _StaleRoutingError(Exception):
+    """A routed segment vanished mid-query (atomic lineage swap committed);
+    the scatter must restart on a fresh routing snapshot."""
+
+
 class _FailureDetector:
     """Unhealthy-server book-keeping with exponential backoff retry
     (reference: ConnectionFailureDetector)."""
@@ -105,15 +110,21 @@ class Broker:
     def routing_table(self, name_with_type: str) -> dict[str, list[str]]:
         """segment → online instances, from the external view (reference:
         BrokerRoutingManager watching ExternalView)."""
-        view = self.store.get(f"/EXTERNALVIEW/{name_with_type}") or {}
-        ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
-        live = set(self.store.children("/LIVEINSTANCES"))
-        # lineage: in-flight replacement targets are not routable yet
-        # (reference: lineage-based segment selection at the broker)
-        hidden = set()
-        for entry in (self.store.get(f"/LINEAGE/{name_with_type}") or {}).values():
-            if entry.get("state") == "IN_PROGRESS":
-                hidden |= set(entry.get("to", []))
+        from .periodic import hidden_from_lineage
+
+        # lineage is read BEFORE and AFTER the ideal-state read: if a
+        # replacement committed in between (entry state changed/vanished),
+        # the ideal snapshot may contain FROM ∪ TO with nothing hidden —
+        # re-snapshot instead of double counting. A stable pair of lineage
+        # reads brackets the ideal read into one routing generation.
+        for _ in range(5):
+            lineage_before = self.store.get(f"/LINEAGE/{name_with_type}")
+            view = self.store.get(f"/EXTERNALVIEW/{name_with_type}") or {}
+            ideal = self.store.get(f"/IDEALSTATES/{name_with_type}") or {}
+            live = set(self.store.children("/LIVEINSTANCES"))
+            if self.store.get(f"/LINEAGE/{name_with_type}") == lineage_before:
+                break
+        hidden = hidden_from_lineage(lineage_before)
         out = {}
         for seg in ideal:
             if seg in hidden:
@@ -391,9 +402,39 @@ class Broker:
 
     def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict,
                         only_segments: Optional[list] = None):
+        """Scatter with a bounded whole-query restart: when a routed segment
+        vanishes from routing mid-flight (an atomic lineage swap committed —
+        merge/compaction replaced it), per-segment retry would double-count
+        or under-count, so re-snapshot the routing and re-run (reference:
+        broker re-executing on stale routing generation)."""
+        last: Exception | None = None
+        for _ in range(3):
+            local = {"total_docs": 0, "num_segments_processed": 0,
+                     "num_segments_pruned": 0, "num_segments_queried": 0}
+            try:
+                results = self._scatter_gather_once(
+                    table, query, local, only_segments)
+            except _StaleRoutingError as e:
+                last = e
+                continue
+            for k, v in local.items():
+                stats_sum[k] += v
+            return results
+        raise RuntimeError(f"routing kept changing mid-query: {last}")
+
+    def _scatter_gather_once(self, table: str, query: QueryContext,
+                             stats_sum: dict,
+                             only_segments: Optional[list] = None):
         routing = self.routing_table(table)
         if only_segments is not None:
-            routing = {s: routing[s] for s in only_segments if s in routing}
+            missing = [s for s in only_segments if s not in routing]
+            if missing:
+                # an explicitly requested segment (connector per-segment
+                # scan) that is not routable must fail loudly — silently
+                # skipping it would drop its rows from the scan
+                raise RuntimeError(
+                    f"requested segments not routable: {missing}")
+            routing = {s: routing[s] for s in only_segments}
         if not routing:
             return []
         stats_sum["num_segments_queried"] += len(routing)
@@ -465,7 +506,13 @@ class Broker:
             sub_routing = {}
             for inst, segs in missing_by_inst.items():
                 for s in segs:
-                    replicas = [i for i in fresh.get(s, []) if i != inst]
+                    if s not in fresh:
+                        # the segment left the routing table entirely: a
+                        # lineage swap (or drop) committed under us — the
+                        # whole snapshot is stale, restart the query
+                        raise _StaleRoutingError(
+                            f"segment {s} replaced mid-query")
+                    replicas = [i for i in fresh[s] if i != inst]
                     if not replicas:
                         raise RuntimeError(
                             f"segment {s} has no remaining replicas")

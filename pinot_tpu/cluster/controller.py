@@ -58,6 +58,18 @@ class ClusterController:
     def live_instances(self) -> list[str]:
         return self.store.children("/LIVEINSTANCES")
 
+    def server_instances(self, tag: Optional[str] = None) -> list[str]:
+        """Segment-hosting candidates: registered instances that are
+        servers. Minions/brokers register with an explicit non-SERVER type
+        and must never be assigned segments (reference: Helix instance
+        tags — segments go to server-tenant-tagged instances only)."""
+        out = []
+        for inst in self.list_instances(tag):
+            cfg = self.store.get(f"/INSTANCECONFIGS/{inst}") or {}
+            if cfg.get("type", "SERVER") == "SERVER":
+                out.append(inst)
+        return out
+
     # -- schemas / tables ---------------------------------------------------
     def add_schema(self, schema_json: dict) -> None:
         self.store.set(f"/SCHEMAS/{schema_json['schemaName']}", schema_json)
@@ -133,7 +145,7 @@ class ClusterController:
         cfg = self.table_config(name_with_type)
         if cfg is None:
             raise KeyError(name_with_type)
-        candidates = sorted(set(self.list_instances(cfg.get("serverTag")))
+        candidates = sorted(set(self.server_instances(cfg.get("serverTag")))
                             & set(self.live_instances()))
         per_group = instances_per_group or len(candidates) // num_replica_groups
         need = num_replica_groups * per_group
@@ -209,7 +221,7 @@ class ClusterController:
             return out
         replication = int(cfg.get("replication", 1))
         tag = cfg.get("serverTag")
-        candidates = [i for i in self.list_instances(tag)
+        candidates = [i for i in self.server_instances(tag)
                       if i in set(self.live_instances())]
         if len(candidates) < replication:
             raise RuntimeError(
@@ -259,7 +271,7 @@ class ClusterController:
             return target, moves
 
         replication = int(cfg.get("replication", 1))
-        candidates = sorted(set(self.list_instances(cfg.get("serverTag")))
+        candidates = sorted(set(self.server_instances(cfg.get("serverTag")))
                             & set(self.live_instances()))
         if len(candidates) < replication:
             raise RuntimeError("not enough live servers to rebalance")
@@ -496,7 +508,7 @@ class ClusterController:
             tier = self._tier_for_segment(cfg, seg, meta, now_ms)
             tag = (tier or {}).get("serverTag") or cfg.get("serverTag")
             tiers_of[seg] = (tier or {}).get("name")
-            candidates = [i for i in self.list_instances(tag) if i in live]
+            candidates = [i for i in self.server_instances(tag) if i in live]
             if len(candidates) < replication:
                 raise RuntimeError(
                     f"tier {tag!r} has {len(candidates)} live servers, "

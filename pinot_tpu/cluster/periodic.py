@@ -171,10 +171,17 @@ class SegmentLineageManager:
     def start_replace(self, table: str, from_segments: list[str],
                       to_segments: list[str]) -> str:
         lineage_id = uuid.uuid4().hex[:12]
+        # snapshot the FROM generation (push time) so trailing cleanup can
+        # tell a replaced segment from one RE-pushed under the same name
+        # after the swap — the latter must not be deleted
+        from_push = {
+            seg: (self.store.get(f"/SEGMENTS/{table}/{seg}") or {}).get(
+                "pushTimeMs")
+            for seg in from_segments}
         self.store.update(f"/LINEAGE/{table}", lambda cur: {
             **(cur or {}),
             lineage_id: {"state": "IN_PROGRESS", "from": from_segments,
-                         "to": to_segments,
+                         "to": to_segments, "fromPushMs": from_push,
                          "tsMs": int(time.time() * 1000)}})
         return lineage_id
 
@@ -182,18 +189,43 @@ class SegmentLineageManager:
         entry = (self.store.get(f"/LINEAGE/{table}") or {}).get(lineage_id)
         if entry is None or entry["state"] != "IN_PROGRESS":
             raise KeyError(f"lineage {lineage_id} not in progress")
-        # atomic swap: new segments live, old segments dropped
+        # the state flip IS the atomic routing switch: brokers route the TO
+        # set and hide the FROM set the instant this single key updates.
+        # Ideal-state removal is trailing cleanup (servers unload); a crash
+        # between flip and cleanup leaves a COMPLETED entry that cleanup()
+        # (periodic LineageCleanupTask) finishes idempotently.
+        self.store.update(f"/LINEAGE/{table}", lambda cur: {
+            **(cur or {}), lineage_id: {**entry, "state": "COMPLETED"}})
+        self._finish_completed(table, lineage_id, entry)
+
+    def _finish_completed(self, table: str, lineage_id: str,
+                          entry: dict) -> None:
+        """Idempotent trailing cleanup for a COMPLETED entry: drop the FROM
+        set from the ideal state and metadata, then delete the entry itself
+        so the FROM names become reusable (brokers hide FROM of COMPLETED
+        entries only while this cleanup is pending). A FROM name whose
+        current metadata no longer matches the generation snapshotted at
+        start_replace was re-pushed after the swap and is left alone."""
+        from_push = entry.get("fromPushMs", {})
+        victims = []
+        for seg in entry["from"]:
+            meta = self.store.get(f"/SEGMENTS/{table}/{seg}")
+            if meta is not None and seg in from_push and \
+                    meta.get("pushTimeMs") != from_push[seg]:
+                continue  # re-created under the same name — not ours
+            victims.append(seg)
+
         def upd(ideal):
             ideal = ideal or {}
-            for seg in entry["from"]:
+            for seg in victims:
                 ideal.pop(seg, None)
             return ideal
 
         self.store.update(f"/IDEALSTATES/{table}", upd)
-        for seg in entry["from"]:
+        for seg in victims:
             self.store.delete(f"/SEGMENTS/{table}/{seg}")
         self.store.update(f"/LINEAGE/{table}", lambda cur: {
-            **(cur or {}), lineage_id: {**entry, "state": "COMPLETED"}})
+            k: v for k, v in (cur or {}).items() if k != lineage_id})
 
     def revert_replace(self, table: str, lineage_id: str) -> None:
         entry = (self.store.get(f"/LINEAGE/{table}") or {}).get(lineage_id)
@@ -212,14 +244,54 @@ class SegmentLineageManager:
             **(cur or {}), lineage_id: {**entry, "state": "REVERTED"}})
 
     def routable_segments(self, table: str, all_segments: set) -> set:
-        """Filter by lineage: while IN_PROGRESS serve FROM, hide TO
-        (reference: the broker's lineage-based segment selection)."""
-        lineage = self.store.get(f"/LINEAGE/{table}") or {}
-        out = set(all_segments)
-        for entry in lineage.values():
-            if entry["state"] == "IN_PROGRESS":
-                out -= set(entry["to"])
-        return out
+        """Filter by lineage (reference: the broker's lineage-based segment
+        selection)."""
+        return set(all_segments) - hidden_segments(self.store, table)
+
+    def cleanup(self, table: str, stale_in_progress_s: float = 86400.0) -> dict:
+        """Crash recovery + GC, idempotent (reference: lineage cleanup in
+        RetentionManager): finish trailing cleanup of COMPLETED entries
+        (process died between the routing flip and the ideal-state sweep),
+        drop REVERTED tombstones, and revert IN_PROGRESS entries stale
+        enough that their task is certainly dead."""
+        now_ms = time.time() * 1000
+        report = {"finished": [], "dropped": [], "reverted": []}
+        for lid, entry in dict(self.store.get(f"/LINEAGE/{table}") or {}).items():
+            if entry["state"] == "COMPLETED":
+                self._finish_completed(table, lid, entry)
+                report["finished"].append(lid)
+            elif entry["state"] == "REVERTED":
+                self.store.update(f"/LINEAGE/{table}", lambda cur, lid=lid: {
+                    k: v for k, v in (cur or {}).items() if k != lid})
+                report["dropped"].append(lid)
+            elif (entry["state"] == "IN_PROGRESS"
+                  and now_ms - entry.get("tsMs", now_ms)
+                  > stale_in_progress_s * 1000):
+                self.revert_replace(table, lid)
+                report["reverted"].append(lid)
+        return report
+
+
+def hidden_segments(store: PropertyStore, table: str) -> set:
+    """Segments brokers must NOT route for this table, per lineage (reads a
+    fresh snapshot; pass an already-read snapshot to
+    hidden_from_lineage when bracketing reads for consistency)."""
+    return hidden_from_lineage(store.get(f"/LINEAGE/{table}"))
+
+
+def hidden_from_lineage(entries: Optional[dict]) -> set:
+    """The TO set of IN_PROGRESS replacements (not yet committed) and the
+    FROM set of COMPLETED ones (swap committed, ideal-state cleanup still
+    trailing). The single lineage-entry state flip is the atomic routing
+    switch; this is the one place that encodes it (used by the broker and
+    by SegmentLineageManager.routable_segments)."""
+    hidden = set()
+    for entry in (entries or {}).values():
+        if entry.get("state") == "IN_PROGRESS":
+            hidden |= set(entry.get("to", []))
+        elif entry.get("state") == "COMPLETED":
+            hidden |= set(entry.get("from", []))
+    return hidden
 
 
 # -- tier relocation ---------------------------------------------------------
@@ -268,4 +340,10 @@ def build_default_scheduler(store: PropertyStore, controller: ClusterController,
                    SegmentStatusChecker(store, controller))
     sched.register("RebalanceChecker", interval_s, RebalanceChecker(controller))
     sched.register("SegmentRelocator", interval_s, SegmentRelocator(controller))
+
+    def _lineage_cleanup():
+        mgr = SegmentLineageManager(store, controller)
+        return {t: mgr.cleanup(t) for t in store.children("/LINEAGE")}
+
+    sched.register("LineageCleanupTask", interval_s, _lineage_cleanup)
     return sched

@@ -126,6 +126,44 @@ def merge_rollup_generator(controller, table: str, cfg: dict) -> list[TaskSpec]:
                      {**cfg, "segments": segs})]
 
 
+def _replace_via_lineage(ctx: TaskContext, table: str, from_names: list[str],
+                         add_fn, to_names: list[str],
+                         online_timeout_s: float = 30.0) -> None:
+    """Atomic segment replacement: start lineage (brokers keep routing the
+    FROM set, ignore TO), add the replacement segments, wait until every TO
+    segment has an online replica, then commit the swap with the lineage
+    state flip. On timeout the replacement is reverted so queries never see
+    a half-swapped table (reference: PinotHelixResourceManager
+    startReplaceSegments/endReplaceSegments driven from minion merge
+    tasks)."""
+    from ..cluster.periodic import SegmentLineageManager
+
+    lineage = SegmentLineageManager(ctx.controller.store, ctx.controller)
+    lid = lineage.start_replace(table, from_names, to_names)
+    try:
+        add_fn()
+        store = ctx.controller.store
+        deadline = time.time() + online_timeout_s
+        live_key = "/LIVEINSTANCES"
+        while True:
+            view = store.get(f"/EXTERNALVIEW/{table}") or {}
+            live = set(store.children(live_key))
+            ok = all(
+                any(st == "ONLINE" and inst in live
+                    for inst, st in (view.get(seg) or {}).items())
+                for seg in to_names)
+            if ok:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"replacement segments {to_names} never came online")
+            time.sleep(0.02)
+    except Exception:
+        lineage.revert_replace(table, lid)
+        raise
+    lineage.end_replace(table, lid)
+
+
 def merge_rollup_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
     """Concat or rollup N segments into one (reference:
     MergeRollupTaskExecutor over SegmentProcessorFramework)."""
@@ -139,10 +177,11 @@ def merge_rollup_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
     if merge_type == "rollup":
         rows = _rollup(schema, rows, spec.config)
     out_name = f"merged_{raw_table_name(table)}_{int(time.time() * 1000)}"
-    _build_and_add(ctx, table, out_name, schema, rows,
-                   {"mergedFrom": names})
-    for name in names:
-        ctx.controller.drop_segment(table, name)
+    _replace_via_lineage(
+        ctx, table, names,
+        lambda: _build_and_add(ctx, table, out_name, schema, rows,
+                               {"mergedFrom": names}),
+        [out_name])
     return {"outputSegment": out_name, "numDocs": len(rows),
             "merged": names}
 
@@ -243,8 +282,11 @@ def purge_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
             continue
         rows = [r for r, m in zip(segment_rows(seg), mask) if not m]
         new_name = f"{name}_purged"
-        _build_and_add(ctx, table, new_name, schema, rows)
-        ctx.controller.drop_segment(table, name)
+        _replace_via_lineage(
+            ctx, table, [name],
+            lambda new_name=new_name, rows=rows:
+                _build_and_add(ctx, table, new_name, schema, rows),
+            [new_name])
         purged[name] = int(mask.sum())
     return {"purged": purged}
 
@@ -267,8 +309,11 @@ def upsert_compaction_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
         if len(rows) == seg.num_docs:
             continue
         new_name = f"{name}_compacted"
-        _build_and_add(ctx, table, new_name, schema, rows)
-        ctx.controller.drop_segment(table, name)
+        _replace_via_lineage(
+            ctx, table, [name],
+            lambda new_name=new_name, rows=rows:
+                _build_and_add(ctx, table, new_name, schema, rows),
+            [new_name])
         compacted[name] = seg.num_docs - len(rows)
     return {"compacted": compacted}
 
@@ -293,9 +338,10 @@ def upsert_compact_merge_executor(ctx: TaskContext, spec: TaskSpec) -> dict:
         rows.extend(kept)
     new_name = spec.config.get(
         "mergedSegmentName", f"{group[0]}_merged_{len(group)}")
-    _build_and_add(ctx, table, new_name, schema, rows)
-    for name in group:
-        ctx.controller.drop_segment(table, name)
+    _replace_via_lineage(
+        ctx, table, group,
+        lambda: _build_and_add(ctx, table, new_name, schema, rows),
+        [new_name])
     return {"merged": group, "outputSegment": new_name,
             "numDocs": len(rows), "invalidDropped": dropped}
 

@@ -1,0 +1,629 @@
+"""Committed soak/chaos harness — the reproducible form of the round-4
+reliability evidence (README "Reliability evidence").
+
+Three suites, each a pure function returning a stats dict, plus a CLI:
+
+  sql       randomized SQL vs a sqlite oracle (host engine + optional
+            device-vs-host parity) — the QueryGenerator/H2 pattern
+            (reference: pinot-integration-test-base/.../QueryGenerator.java,
+            ClusterIntegrationTestUtils.testQueries).
+  chaos     embedded cluster (controller + servers + broker, replication 2)
+            under continuous exact-result queries while servers are killed
+            and restarted, RebalanceChecker heals placement, and minion
+            merge-rollup compacts the table concurrently (reference:
+            pinot-integration-tests/.../ChaosMonkeyIntegrationTest.java).
+  realtime  repeated committer-crash/re-election rounds with zero row loss
+            (reference: pinot-controller/src/test/.../realtime/
+            SegmentCompletionTest.java, pauseless/LLC FSM).
+
+Default profile is a ~2-minute smoke across all suites:
+
+    python -m pinot_tpu.tools.soak
+
+The README's full numbers reproduce with bigger knobs, e.g.:
+
+    python -m pinot_tpu.tools.soak --suite sql --seconds 7200
+    python -m pinot_tpu.tools.soak --suite chaos --seconds 14400
+    python -m pinot_tpu.tools.soak --suite realtime --rounds 1500
+
+Every run is seeded; a failure prints the offending SQL/round and the seed
+that reproduces it, then exits non-zero.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sqlite3
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+# -- shared result normalization (FP jitter + None/str/float mixing) ----------
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, float):
+        if math.isnan(v):
+            return None
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        return v
+    if isinstance(v, (int, np.integer)):
+        return float(v)
+    return v
+
+
+def _sort_key(row):
+    out = []
+    for v in row:
+        if v is None:
+            out.append((0, ""))
+        elif isinstance(v, float):
+            out.append((1, round(v, 2)))
+        else:
+            out.append((2, str(v)))
+    return tuple(out)
+
+
+def _rows_equal(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if not math.isclose(va, vb, rel_tol=1e-9, abs_tol=1e-6):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def _canon(rows):
+    return sorted([tuple(_norm(v) for v in r) for r in rows], key=_sort_key)
+
+
+class SoakFailure(AssertionError):
+    pass
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# Suite 1: randomized SQL vs sqlite oracle
+# ════════════════════════════════════════════════════════════════════════════
+
+_CITIES = ["sf", "ny", "la", "chi", "sea", "aus", "bos", "den"]
+_STATUSES = ["open", "closed", "pending"]
+_NUM_COLS = ["code", "amount", "score"]
+_STR_COLS = ["city", "status"]
+_AGGS = ["SUM", "COUNT", "MIN", "MAX", "AVG"]
+
+
+class _SqlSoak:
+    """Self-contained generator + oracle + engines for the sql suite."""
+
+    def __init__(self, seed: int, rows: int = 1600, device_parity: bool = True):
+        from pinot_tpu.engine.query_executor import QueryExecutor
+        from pinot_tpu.segment.builder import SegmentBuilder
+        from pinot_tpu.segment.loader import load_segment
+        from pinot_tpu.spi.data_types import Schema
+
+        self.rng = np.random.default_rng(seed)
+        self.device_parity = device_parity
+        schema = Schema.build(
+            "fz",
+            dimensions=[("city", "STRING"), ("status", "STRING"),
+                        ("code", "INT")],
+            metrics=[("amount", "INT"), ("score", "DOUBLE")])
+        dim_schema = Schema.build(
+            "fzdim", dimensions=[("dcode", "INT"), ("region", "STRING")])
+
+        rng = np.random.default_rng(seed)
+        n = rows
+        data = {
+            "city": np.asarray(_CITIES, dtype=object)[
+                rng.integers(0, len(_CITIES), n)],
+            "status": np.asarray(_STATUSES, dtype=object)[
+                rng.integers(0, len(_STATUSES), n)],
+            "code": rng.integers(0, 40, n).astype(np.int32),
+            "amount": rng.integers(-50, 1000, n).astype(np.int32),
+            "score": np.round(rng.random(n) * 100, 3),
+        }
+        dim = {"dcode": np.arange(0, 30, dtype=np.int32),
+               "region": np.asarray([["west", "east", "south"][i % 3]
+                                     for i in range(30)], dtype=object)}
+
+        self._tmp = tempfile.TemporaryDirectory(prefix="pinot_soak_sql_")
+        d = Path(self._tmp.name)
+        half = n // 2
+        segs = []
+        for i, sl in enumerate([slice(0, half), slice(half, n)]):
+            SegmentBuilder(schema, segment_name=f"fz_{i}").build(
+                {k: v[sl] for k, v in data.items()}, d / f"s{i}")
+            segs.append(load_segment(d / f"s{i}"))
+        SegmentBuilder(dim_schema, segment_name="dim0").build(dim, d / "dim")
+
+        self.qe = QueryExecutor(backend="host")
+        self.qe.add_table(schema, segs)
+        self.qe.add_table(dim_schema, [load_segment(d / "dim")])
+        if device_parity:
+            self.qe_dev = QueryExecutor(backend="auto")
+            for name, t in self.qe.tables.items():
+                self.qe_dev.add_table(t.schema, t.segments, name=name)
+
+        self.oracle = sqlite3.connect(":memory:")
+        self.oracle.execute(
+            "CREATE TABLE fz (city TEXT, status TEXT, code INT, "
+            "amount INT, score REAL)")
+        self.oracle.execute("CREATE TABLE fzdim (dcode INT, region TEXT)")
+        self.oracle.executemany(
+            "INSERT INTO fz VALUES (?,?,?,?,?)",
+            [(data["city"][i], data["status"][i], int(data["code"][i]),
+              int(data["amount"][i]), float(data["score"][i]))
+             for i in range(n)])
+        self.oracle.executemany(
+            "INSERT INTO fzdim VALUES (?,?)",
+            [(int(dim["dcode"][i]), dim["region"][i]) for i in range(30)])
+
+    # -- generators ----------------------------------------------------------
+
+    def _pred(self, p: str = "") -> str:
+        rng = self.rng
+        kind = rng.integers(0, 6)
+        if kind == 0:
+            return f"{p}{rng.choice(_STR_COLS)} = '{rng.choice(_CITIES + _STATUSES)}'"
+        if kind == 1:
+            return f"{p}{rng.choice(_STR_COLS)} <> '{rng.choice(_CITIES + _STATUSES)}'"
+        if kind == 2:
+            col = rng.choice(_NUM_COLS)
+            op = rng.choice(["<", ">", "<=", ">="])
+            return f"{p}{col} {op} {rng.integers(-20, 500)}"
+        if kind == 3:
+            col = rng.choice(_NUM_COLS)
+            lo = int(rng.integers(-20, 200))
+            return f"{p}{col} BETWEEN {lo} AND {lo + int(rng.integers(1, 300))}"
+        if kind == 4:
+            vals = ", ".join(
+                f"'{v}'" for v in self.rng.choice(_CITIES, size=3, replace=False))
+            return f"{p}city IN ({vals})"
+        return f"{p}code = {rng.integers(0, 40)}"
+
+    def _where(self, prefix: str = "") -> str:
+        n = int(self.rng.integers(0, 3))
+        if n == 0:
+            return ""
+        parts = [self._pred(prefix) for _ in range(n)]
+        joiner = " AND " if self.rng.random() < 0.7 else " OR "
+        return " WHERE " + joiner.join(parts)
+
+    def _agg_expr(self):
+        # oracle side encodes Pinot's empty-group defaults: SUM()=0,
+        # MIN()=+inf, MAX()=-inf (not SQL NULL)
+        fn = self.rng.choice(_AGGS)
+        if fn == "COUNT":
+            return "COUNT(*)", "COUNT(*)"
+        col = self.rng.choice(_NUM_COLS)
+        e = f"{fn}({col})"
+        if fn == "SUM":
+            return e, f"COALESCE(SUM({col}), 0.0)"
+        if fn == "MIN":
+            return e, f"COALESCE(MIN({col}), 9e999)"
+        if fn == "MAX":
+            return e, f"COALESCE(MAX({col}), -9e999)"
+        return e, e
+
+    def _gen(self):
+        """One random (sql, oracle_sql, parity_eligible) triple."""
+        rng = self.rng
+        shape = rng.integers(0, 8)
+        if shape == 0:  # plain aggregation
+            pairs = [self._agg_expr() for _ in range(int(rng.integers(1, 4)))]
+            w = self._where()
+            return (f"SELECT {', '.join(p[0] for p in pairs)} FROM fz{w}",
+                    f"SELECT {', '.join(p[1] for p in pairs)} FROM fz{w}",
+                    True)
+        if shape == 1:  # group by
+            dims = list(rng.choice(_STR_COLS + ["code"],
+                                   size=int(rng.integers(1, 3)), replace=False))
+            pairs = [self._agg_expr() for _ in range(int(rng.integers(1, 3)))]
+            w = self._where()
+            g = f" GROUP BY {', '.join(dims)}"
+            return (f"SELECT {', '.join(dims + [p[0] for p in pairs])} "
+                    f"FROM fz{w}{g} LIMIT 5000",
+                    f"SELECT {', '.join(dims + [p[1] for p in pairs])} "
+                    f"FROM fz{w}{g}",
+                    True)
+        if shape == 2:  # selection
+            cols = list(rng.choice(_STR_COLS + _NUM_COLS,
+                                   size=int(rng.integers(1, 4)), replace=False))
+            sql = f"SELECT {', '.join(cols)} FROM fz{self._where()} LIMIT 5000"
+            return sql, sql.replace(" LIMIT 5000", ""), True
+        if shape == 3:  # having
+            dim = rng.choice(_STR_COLS + ["code"])
+            cut = int(rng.integers(0, 400))
+            w = self._where()
+            return (f"SELECT {dim}, COUNT(*), SUM(amount) FROM fz{w} "
+                    f"GROUP BY {dim} HAVING SUM(amount) > {cut} LIMIT 5000",
+                    f"SELECT {dim}, COUNT(*), COALESCE(SUM(amount), 0.0) "
+                    f"FROM fz{w} GROUP BY {dim} HAVING SUM(amount) > {cut}",
+                    False)
+        if shape == 4:  # join through MSE
+            jt = rng.choice(["JOIN", "LEFT JOIN"])
+            w = self._where(prefix="a.")
+            if rng.random() < 0.5:
+                sql = (f"SELECT b.region, SUM(a.amount) FROM fz a {jt} fzdim b "
+                       f"ON a.code = b.dcode{w} GROUP BY b.region LIMIT 5000")
+            else:
+                sql = (f"SELECT a.city, b.region FROM fz a {jt} fzdim b "
+                       f"ON a.code = b.dcode{w} LIMIT 5000")
+            return sql, sql.replace(" LIMIT 5000", ""), False
+        if shape == 5:  # window through MSE
+            fn = rng.choice(["ROW_NUMBER()", "RANK()", "DENSE_RANK()",
+                             "SUM(amount)", "COUNT(*)", "MIN(score)",
+                             "MAX(score)"])
+            part = rng.choice(_STR_COLS)
+            w = self._where()
+            sql = (f"SELECT city, code, amount, {fn} OVER "
+                   f"(PARTITION BY {part} ORDER BY amount, code, city) "
+                   f"FROM fz{w} LIMIT 5000")
+            return sql, sql.replace(" LIMIT 5000", ""), False
+        if shape == 6:  # set op through MSE
+            op = rng.choice(["UNION", "UNION ALL", "INTERSECT", "EXCEPT"])
+            c1, c2 = int(rng.integers(0, 400)), int(rng.integers(0, 400))
+            sql = (f"SELECT city, code FROM fz WHERE amount > {c1} "
+                   f"{op} SELECT city, code FROM fz WHERE score > {c2} "
+                   f"LIMIT 9000")
+            return sql, sql.replace(" LIMIT 9000", ""), False
+        # derived table + FILTER clause mix
+        if rng.random() < 0.5:
+            dim = rng.choice(_STR_COLS)
+            cut = int(rng.integers(0, 300))
+            sql = (f"SELECT COUNT(*) FROM (SELECT {dim}, SUM(amount) AS s "
+                   f"FROM fz GROUP BY {dim}) WHERE s > {cut}")
+            return sql, sql, False
+        cond = self._pred()
+        col = rng.choice(_NUM_COLS)
+        w = self._where()
+        return (f"SELECT SUM({col}) FILTER (WHERE {cond}), COUNT(*) "
+                f"FILTER (WHERE {cond}) FROM fz{w}",
+                f"SELECT COALESCE(SUM({col}) FILTER (WHERE {cond}), 0.0), "
+                f"COUNT(*) FILTER (WHERE {cond}) FROM fz{w}",
+                False)
+
+    # -- one soak step -------------------------------------------------------
+
+    def step(self) -> dict:
+        sql, oracle_sql, parity = self._gen()
+        resp = self.qe.execute_sql(sql)
+        if resp.exceptions:
+            raise SoakFailure(f"engine error\n{sql}\n→ {resp.exceptions}")
+        got = _canon(resp.result_table.rows)
+        want = _canon(self.oracle.execute(oracle_sql).fetchall())
+        if not _rows_equal(got, want):
+            raise SoakFailure(
+                f"oracle mismatch\n{sql}\ngot:  {got[:6]}…\nwant: {want[:6]}…")
+        checks = 1
+        if parity and self.device_parity:
+            dresp = self.qe_dev.execute_sql(sql)
+            if dresp.exceptions:
+                raise SoakFailure(f"device error\n{sql}\n→ {dresp.exceptions}")
+            dgot = _canon(dresp.result_table.rows)
+            if not _rows_equal(dgot, got):
+                raise SoakFailure(
+                    f"device/host mismatch\n{sql}\n"
+                    f"dev:  {dgot[:6]}…\nhost: {got[:6]}…")
+            checks += 1
+        return {"checks": checks}
+
+    def close(self):
+        self.oracle.close()
+        self._tmp.cleanup()
+
+
+def soak_sql(seconds: float = 60.0, seed: int = 0, rows: int = 1600,
+             device_parity: bool = True, max_checks: int | None = None,
+             progress=None) -> dict:
+    """Randomized SQL soak. Returns {'checks': n, 'elapsed_s': t, 'seed': s}."""
+    s = _SqlSoak(seed, rows=rows, device_parity=device_parity)
+    t0 = time.time()
+    checks = 0
+    try:
+        while time.time() - t0 < seconds:
+            checks += s.step()["checks"]
+            if max_checks and checks >= max_checks:
+                break
+            if progress and checks % 500 < 2:
+                progress(f"sql: {checks} checks")
+    finally:
+        s.close()
+    return {"suite": "sql", "checks": checks,
+            "elapsed_s": round(time.time() - t0, 1), "seed": seed,
+            "device_parity": device_parity}
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# Suite 2: cluster chaos — kills + rebalance + concurrent compaction
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
+               replication: int = 2, n_segments: int = 6,
+               rows_per_segment: int = 400, progress=None) -> dict:
+    """ChaosMonkey soak: continuous exact-result broker queries while
+    servers die/restart, RebalanceChecker heals, and minion merge-rollup
+    compacts concurrently. Returns counters."""
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+    from pinot_tpu.cluster.periodic import RebalanceChecker
+    from pinot_tpu.minion import MinionInstance, PinotTaskManager
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi.data_types import Schema
+
+    schema = Schema.build(
+        "stats",
+        dimensions=[("team", "STRING"), ("year", "INT")],
+        metrics=[("runs", "INT")])
+    teams = ["BOS", "NYA", "SFN", "LAN", "CHC", "HOU"]
+    rng = np.random.default_rng(seed)
+
+    tmp = tempfile.TemporaryDirectory(prefix="pinot_soak_chaos_")
+    d = Path(tmp.name)
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = {}
+    for i in range(n_servers):
+        s = ServerInstance(store, f"Server_{i}", backend="host")
+        s.start()
+        servers[f"Server_{i}"] = s
+    broker = Broker(store)
+    controller.add_schema(schema.to_json())
+    table = controller.create_table({
+        "tableName": "stats", "replication": replication,
+        "taskConfigs": {"MergeRollupTask": {"mergeType": "concat"}}})
+    task_mgr = PinotTaskManager(store, controller)
+    minion = MinionInstance(store, "Minion_0", controller, str(d / "minion"))
+    checker = RebalanceChecker(controller)
+
+    expected = {}
+    total_docs = 0
+    for i in range(n_segments):
+        n = rows_per_segment
+        cols = {
+            "team": np.asarray(teams, dtype=object)[
+                rng.integers(0, len(teams), n)],
+            "year": rng.integers(2000, 2020, n).astype(np.int32),
+            "runs": rng.integers(0, 100, n).astype(np.int32),
+        }
+        name = f"stats_{i}"
+        SegmentBuilder(schema, segment_name=name).build(cols, d / name)
+        controller.add_segment(table, name,
+                               {"location": str(d / name), "numDocs": n})
+        for t, r in zip(cols["team"], cols["runs"]):
+            expected[t] = expected.get(t, 0) + int(r)
+        total_docs += n
+
+    sql = "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 20"
+    stats = {"queries": 0, "kills": 0, "restarts": 0, "rebalances": 0,
+             "compactions": 0}
+    down: list[str] = []
+    t0 = time.time()
+    try:
+        while time.time() - t0 < seconds:
+            # the soak invariant: EXACT results, always
+            resp = broker.execute_sql(sql)
+            if resp.exceptions:
+                raise SoakFailure(f"query error during chaos: {resp.exceptions}")
+            got = {r[0]: r[1] for r in resp.result_table.rows}
+            if got != expected:
+                raise SoakFailure(
+                    f"wrong results during chaos (seed {seed}): "
+                    f"got {got} want {expected}")
+            stats["queries"] += 1
+
+            r = rng.random()
+            if r < 0.08 and len(down) < replication - 1:
+                # kill a random live server; at most replication-1 down at
+                # once so every segment keeps >=1 online replica (the soak
+                # asserts EXACT results, not graceful degradation)
+                name = rng.choice([n for n in servers if n not in down])
+                servers[name].stop()
+                down.append(name)
+                stats["kills"] += 1
+            elif r < 0.16 and down:
+                # resurrect: fresh instance, same identity; converges from
+                # ideal state
+                name = down.pop(0)
+                s = ServerInstance(store, name, backend="host")
+                s.start()
+                servers[name] = s
+                stats["restarts"] += 1
+            elif r < 0.22:
+                fixed = checker()
+                stats["rebalances"] += sum(1 for _ in fixed)
+            elif r < 0.26:
+                ids = task_mgr.schedule_tasks()
+                if ids:
+                    stats["compactions"] += minion.run_pending_once()
+            if progress and stats["queries"] % 500 == 0:
+                progress(f"chaos: {stats}")
+    finally:
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+        tmp.cleanup()
+    stats.update({"suite": "chaos", "elapsed_s": round(time.time() - t0, 1),
+                  "seed": seed, "total_docs": total_docs})
+    return stats
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# Suite 3: realtime committer-crash rounds
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def soak_realtime(rounds: int = 3, seed: int = 0, rows_per_round: int = 50,
+                  progress=None) -> dict:
+    """Repeated committer-crash/re-election rounds; every round must commit
+    all published rows with zero loss after the first-elected committer dies
+    between build and commit."""
+    from pinot_tpu.cluster.store import PropertyStore
+    from pinot_tpu.realtime.completion import SegmentCompletionManager
+    from pinot_tpu.realtime.manager import RealtimeTableDataManager
+    from pinot_tpu.spi.data_types import Schema
+    from pinot_tpu.spi.stream import GLOBAL_STREAM_REGISTRY
+    from pinot_tpu.spi.table_config import (IngestionConfig,
+                                            SegmentsValidationConfig,
+                                            TableConfig, TableType)
+
+    schema = Schema.build(
+        "events",
+        dimensions=[("user", "STRING"), ("ts", "LONG")],
+        metrics=[("n", "INT")])
+
+    def wait_until(pred, timeout=30.0):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False
+
+    t0 = time.time()
+    completed = 0
+    run_tag = f"{seed}_{int(t0 * 1000) % 100_000_000}"
+    for rnd in range(rounds):
+        registry = GLOBAL_STREAM_REGISTRY
+        # consumers resolve topics through the process-global registry;
+        # unique per-round topic names keep rounds independent
+        topic = f"soak_ev_{run_tag}_{rnd}"
+        registry.create_topic(topic, num_partitions=1)
+        store = PropertyStore()
+        completion = SegmentCompletionManager(store, num_replicas=2,
+                                              commit_lease_s=1.0,
+                                              decision_wait_s=2)
+        cfg = TableConfig(
+            table_name="events",
+            table_type=TableType.REALTIME,
+            validation=SegmentsValidationConfig(time_column_name="ts"),
+            ingestion=IngestionConfig(stream_configs={
+                "streamType": "inmemory",
+                "stream.inmemory.topic.name": topic,
+                "realtime.segment.flush.threshold.rows":
+                    max(10, rows_per_round - 10),
+            }))
+        killed = {"done": False}
+
+        def die_once(mgr, killed=killed):
+            if mgr.seq == 0 and not killed["done"]:
+                killed["done"] = True
+                return True
+            return False
+
+        hooks = {"die_before_commit_end": die_once}
+        with tempfile.TemporaryDirectory(prefix="pinot_soak_rt_") as td:
+            tp = Path(td)
+            a = RealtimeTableDataManager(schema, cfg, tp / "a",
+                                         completion=completion,
+                                         instance_id="A", test_hooks=hooks)
+            b = RealtimeTableDataManager(schema, cfg, tp / "b",
+                                         completion=completion,
+                                         instance_id="B", test_hooks=hooks)
+            a.start()
+            b.start()
+            try:
+                registry.publish(topic, [
+                    {"user": f"u{i % 5}", "ts": 1_600_000_000_000 + i, "n": 1}
+                    for i in range(rows_per_round)])
+                if not wait_until(lambda: store.children("/SEGMENTS/events")):
+                    raise SoakFailure(
+                        f"round {rnd}: no segment committed (seed {seed})")
+                seg = store.children("/SEGMENTS/events")[0]
+
+                def done(store=store, seg=seg):
+                    rec = store.get(f"/SEGMENTS/events/{seg}")
+                    return rec and rec["status"] == "DONE"
+
+                if not wait_until(done):
+                    raise SoakFailure(f"round {rnd}: segment never DONE")
+                if not killed["done"]:
+                    raise SoakFailure(f"round {rnd}: crash hook never fired")
+                rec = store.get(f"/SEGMENTS/events/{seg}")
+                survivor = a if rec["committer"] == "A" else b
+                if not wait_until(lambda: survivor._committed):
+                    raise SoakFailure(f"round {rnd}: committer list empty")
+                if survivor._committed[0].num_docs != rows_per_round:
+                    raise SoakFailure(
+                        f"round {rnd}: row loss — committed "
+                        f"{survivor._committed[0].num_docs} of "
+                        f"{rows_per_round}")
+                completed += 1
+                if progress:
+                    progress(f"realtime: round {rnd + 1}/{rounds} clean")
+            finally:
+                a.stop()
+                b.stop()
+    return {"suite": "realtime", "rounds": completed,
+            "rows_per_round": rows_per_round,
+            "elapsed_s": round(time.time() - t0, 1), "seed": seed}
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# CLI
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="pinot_tpu soak/chaos harness (committed, reproducible)")
+    p.add_argument("--suite", choices=["sql", "chaos", "realtime", "all"],
+                   default="all")
+    p.add_argument("--seconds", type=float, default=45.0,
+                   help="wall-clock budget per time-based suite (sql, chaos)")
+    p.add_argument("--rounds", type=int, default=3,
+                   help="committer-crash rounds for the realtime suite")
+    p.add_argument("--seed", type=int, default=20260731)
+    p.add_argument("--rows", type=int, default=1600,
+                   help="fuzz table rows for the sql suite")
+    p.add_argument("--no-device-parity", action="store_true",
+                   help="skip device-vs-host parity checks in the sql suite")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    def progress(msg):
+        if not args.quiet:
+            print(f"  … {msg}", file=sys.stderr, flush=True)
+
+    results = []
+    failed = None
+    try:
+        if args.suite in ("sql", "all"):
+            results.append(soak_sql(
+                seconds=args.seconds, seed=args.seed, rows=args.rows,
+                device_parity=not args.no_device_parity, progress=progress))
+        if args.suite in ("chaos", "all"):
+            results.append(soak_chaos(
+                seconds=args.seconds, seed=args.seed, progress=progress))
+        if args.suite in ("realtime", "all"):
+            results.append(soak_realtime(
+                rounds=args.rounds, seed=args.seed, progress=progress))
+    except SoakFailure as e:
+        failed = str(e)
+
+    summary = {"ok": failed is None, "results": results}
+    if failed:
+        summary["failure"] = failed
+    print(json.dumps(summary))
+    return 0 if failed is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,6 +146,25 @@ def test_error_surfaces(cluster, tmp_path):
     assert state["error"]
 
 
+def test_minion_never_assigned_segments(cluster, tmp_path):
+    """A registered+live minion must never receive segment assignments
+    (reference: Helix instance tags keep segments on server-tenant
+    instances)."""
+    store, controller, server, broker, task_mgr, minion = cluster
+    minion.start()
+    try:
+        table = controller.create_table({"tableName": "metrics",
+                                         "replication": 1})
+        _add_segments(controller, table, tmp_path, [
+            [{"host": "a", "day": 1, "cpu": 1.0}]])
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        for seg, m in ideal.items():
+            assert "Minion_0" not in m, ideal
+        assert controller.server_instances() == ["Server_0"]
+    finally:
+        minion.stop()
+
+
 def test_background_minion_polling(cluster, tmp_path):
     store, controller, server, broker, task_mgr, minion = cluster
     table = controller.create_table({

@@ -102,6 +102,75 @@ def test_lineage_atomic_replacement(cluster):
     assert set(broker.routing_table(table)) == {"merged"}
 
 
+def test_lineage_entry_gc_allows_name_reuse(cluster):
+    """After end_replace, the lineage entry is gone and a segment re-pushed
+    under a replaced name is routable again (reference: re-pushing offline
+    segments under deterministic names is normal operation)."""
+    store, controller, server, broker, tmp_path = cluster
+    table = controller.create_table({"tableName": "p", "replication": 1})
+    controller.add_segment(table, "old0", {
+        "location": _seg(tmp_path, "old0", [1]), "numDocs": 1})
+    lineage = SegmentLineageManager(store, controller)
+    lid = lineage.start_replace(table, ["old0"], ["m0"])
+    controller.add_segment(table, "m0", {
+        "location": _seg(tmp_path, "m0", [1]), "numDocs": 1})
+    lineage.end_replace(table, lid)
+    assert store.get(f"/LINEAGE/{table}") == {}
+    # re-push under the replaced name: must be routable, not hidden forever
+    controller.add_segment(table, "old0", {
+        "location": _seg(tmp_path, "old0_v2", [10]), "numDocs": 1})
+    r = broker.execute_sql("SELECT SUM(v) FROM p")
+    assert r.result_table.rows[0][0] == 11.0
+    assert set(broker.routing_table(table)) == {"m0", "old0"}
+
+
+def test_lineage_cleanup_recovers_stranded_completed(cluster):
+    """Crash between the COMPLETED flip and the ideal-state sweep: the
+    periodic cleanup finishes the swap idempotently."""
+    store, controller, server, broker, tmp_path = cluster
+    table = controller.create_table({"tableName": "p", "replication": 1})
+    controller.add_segment(table, "old0", {
+        "location": _seg(tmp_path, "old0", [1, 2]), "numDocs": 2})
+    lineage = SegmentLineageManager(store, controller)
+    lid = lineage.start_replace(table, ["old0"], ["m0"])
+    controller.add_segment(table, "m0", {
+        "location": _seg(tmp_path, "m0", [1, 2]), "numDocs": 2})
+    # simulate the crash: flip state only, no trailing cleanup
+    entry = store.get(f"/LINEAGE/{table}")[lid]
+    store.update(f"/LINEAGE/{table}", lambda cur: {
+        **cur, lid: {**entry, "state": "COMPLETED"}})
+    # broker already routes TO and hides FROM (no double count, no gap)
+    r = broker.execute_sql("SELECT COUNT(*), SUM(v) FROM p")
+    assert r.result_table.rows[0] == [2, 3.0]
+    report = lineage.cleanup(table)
+    assert lid in report["finished"]
+    assert store.get(f"/LINEAGE/{table}") == {}
+    assert "old0" not in (store.get(f"/IDEALSTATES/{table}") or {})
+    r = broker.execute_sql("SELECT COUNT(*), SUM(v) FROM p")
+    assert r.result_table.rows[0] == [2, 3.0]
+
+
+def test_lineage_cleanup_reverts_stale_in_progress(cluster):
+    store, controller, server, broker, tmp_path = cluster
+    table = controller.create_table({"tableName": "p", "replication": 1})
+    controller.add_segment(table, "keep", {
+        "location": _seg(tmp_path, "keep", [7]), "numDocs": 1})
+    lineage = SegmentLineageManager(store, controller)
+    lid = lineage.start_replace(table, ["keep"], ["zombie"])
+    # fresh IN_PROGRESS entries are left alone
+    assert lineage.cleanup(table)["reverted"] == []
+    # backdate it past the staleness bar → reverted + dropped
+    entry = store.get(f"/LINEAGE/{table}")[lid]
+    store.update(f"/LINEAGE/{table}", lambda cur: {
+        **cur, lid: {**entry, "tsMs": entry["tsMs"] - 10_000}})
+    report = lineage.cleanup(table, stale_in_progress_s=5.0)
+    assert lid in report["reverted"]
+    assert set(broker.routing_table(table)) == {"keep"}
+    # the REVERTED tombstone is dropped on the next pass
+    assert lid in lineage.cleanup(table)["dropped"]
+    assert store.get(f"/LINEAGE/{table}") == {}
+
+
 def test_lineage_revert(cluster):
     store, controller, server, broker, tmp_path = cluster
     table = controller.create_table({"tableName": "p", "replication": 1})

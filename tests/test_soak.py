@@ -1,0 +1,45 @@
+"""Short-profile CI runs of the committed soak harness
+(pinot_tpu/tools/soak.py) so every reliability-evidence class in the README
+is reproducible from a committed entry point.
+
+Reference pattern: ChaosMonkeyIntegrationTest and the H2-oracle
+testQueries harness run inside the normal integration-test suite at reduced
+scale; the long profiles are the same code with bigger knobs.
+"""
+
+from __future__ import annotations
+
+from pinot_tpu.tools.soak import soak_chaos, soak_realtime, soak_sql
+
+
+def test_soak_sql_short_profile():
+    out = soak_sql(seconds=8.0, seed=7, rows=600, device_parity=False)
+    assert out["checks"] >= 20, out
+
+
+def test_soak_sql_device_parity_short_profile():
+    out = soak_sql(seconds=8.0, seed=11, rows=400, device_parity=True,
+                   max_checks=60)
+    assert out["checks"] >= 10, out
+
+
+def test_soak_chaos_short_profile():
+    out = soak_chaos(seconds=12.0, seed=5, n_servers=3, replication=2,
+                     n_segments=4, rows_per_segment=200)
+    assert out["queries"] >= 10, out
+    # chaos actually happened: at least one kill or rebalance or compaction
+    assert out["kills"] + out["rebalances"] + out["compactions"] >= 1, out
+
+
+def test_soak_realtime_one_round():
+    out = soak_realtime(rounds=1, seed=3, rows_per_round=40)
+    assert out["rounds"] == 1, out
+
+
+def test_soak_cli_smoke(capsys):
+    from pinot_tpu.tools.soak import main
+    rc = main(["--suite", "realtime", "--rounds", "1", "--quiet"])
+    assert rc == 0
+    import json
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["ok"] is True
