@@ -30,6 +30,7 @@ from .combine import (combine_aggregation, combine_group_by,
 from ..ops.kernels import PackedOuts, fetch_packed_batch
 from .executor import TpuSegmentExecutor
 from .host_executor import HostSegmentExecutor
+from .oom import with_oom_retry
 from .pruner import SegmentPrunerService
 from .reduce import BrokerReducer
 from .results import (
@@ -286,7 +287,12 @@ class QueryExecutor:
                 continue
             try:
                 plan = self.tpu.plan(run_query, run_segment)
-                outs = self.tpu.dispatch_plan(run_segment, plan)
+                # HBM pressure during plane upload/dispatch: evict cold
+                # cached segments once and retry (engine/oom.py — the
+                # DirectOOMHandler analogue)
+                outs = with_oom_retry(
+                    lambda: self.tpu.dispatch_plan(run_segment, plan),
+                    keep_segment=run_segment, cache=self.tpu.cache)
             except UnsupportedQueryError:
                 if self.backend == "tpu":
                     raise
@@ -335,12 +341,34 @@ class QueryExecutor:
                 isinstance(p[5], PackedOuts) for p in pending):
             # ONE device→host transfer for the whole multi-segment batch
             # (a tunneled device pays a fixed round trip per fetch)
-            fetched = fetch_packed_batch([p[5] for p in pending])
+            # async dispatch means an in-flight OOM surfaces HERE on
+            # error-poisoned buffers: the retry must RE-DISPATCH every
+            # pending segment after eviction, not re-fetch the dead outputs
+            def _refetch():
+                return fetch_packed_batch([
+                    self.tpu.dispatch_plan(p[2], p[4]) for p in pending])
+
+            fetched = with_oom_retry(
+                lambda: fetch_packed_batch([p[5] for p in pending]),
+                cache=self.tpu.cache, retry_fn=_refetch)
             pending = [p[:5] + (raw,) for p, raw in zip(pending, fetched)]
         for idx, run_query, run_segment, rewrite, plan, outs in pending:
             check(done)
-            inter = self._account(tracker, lambda: self.tpu.collect(
-                run_query, run_segment, plan, outs), run_segment)
+
+            def _recollect(run_query=run_query, run_segment=run_segment,
+                           plan=plan):
+                return self.tpu.collect(
+                    run_query, run_segment, plan,
+                    self.tpu.dispatch_plan(run_segment, plan))
+
+            inter = self._account(
+                tracker,
+                lambda: with_oom_retry(
+                    lambda: self.tpu.collect(
+                        run_query, run_segment, plan, outs),
+                    keep_segment=run_segment, cache=self.tpu.cache,
+                    retry_fn=_recollect),
+                run_segment)
             intermediates[idx] = (
                 self._remap_star_tree(rewrite, inter) if rewrite else inter)
             done += 1

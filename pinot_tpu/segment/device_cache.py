@@ -12,6 +12,7 @@ scalar.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -171,16 +172,20 @@ class DeviceSegmentCache:
         self.device = device
         self._views: dict[int, SegmentDeviceView] = {}
         self._order: list[int] = []  # LRU
+        # guards _views/_order: concurrent queries share this cache, and
+        # OOM-relief eviction (engine/oom.py) races view()/_maybe_evict()
+        self._lock = threading.Lock()
 
     def view(self, segment: ImmutableSegment) -> SegmentDeviceView:
         key = id(segment)
-        if key not in self._views:
-            self._views[key] = SegmentDeviceView(segment, self.device)
-        if key in self._order:
-            self._order.remove(key)
-        self._order.append(key)
-        self._maybe_evict()
-        return self._views[key]
+        with self._lock:
+            if key not in self._views:
+                self._views[key] = SegmentDeviceView(segment, self.device)
+            if key in self._order:
+                self._order.remove(key)
+            self._order.append(key)
+            self._maybe_evict()
+            return self._views[key]
 
     def warm(self, segment: ImmutableSegment,
              columns: Optional[list] = None) -> int:
@@ -219,13 +224,32 @@ class DeviceSegmentCache:
         """Release a retired segment's device planes (call on segment drop —
         reference: segment replace/delete in BaseTableDataManager)."""
         key = id(segment)
-        v = self._views.pop(key, None)
-        if v is not None:
-            v.evict()
-        if key in self._order:
-            self._order.remove(key)
+        with self._lock:
+            v = self._views.pop(key, None)
+            if v is not None:
+                v.evict()
+            if key in self._order:
+                self._order.remove(key)
+
+    def evict_all_except(self, keep_segment=None) -> tuple[int, int]:
+        """HBM-pressure relief (engine/oom.py): evict every cached view
+        except ``keep_segment``'s. Returns (bytes_freed, victims)."""
+        keep_key = id(keep_segment) if keep_segment is not None else None
+        freed = victims = 0
+        with self._lock:
+            for key in list(self._views):
+                if key == keep_key:
+                    continue
+                freed += self._views[key].nbytes()
+                self._views[key].evict()
+                del self._views[key]
+                if key in self._order:
+                    self._order.remove(key)
+                victims += 1
+        return freed, victims
 
     def _maybe_evict(self) -> None:
+        # caller holds self._lock
         if self.budget_bytes is None:
             return
         total = sum(v.nbytes() for v in self._views.values())

@@ -26,6 +26,9 @@ class ServerMeter:
     REALTIME_ROWS_CONSUMED = "realtimeRowsConsumed"
     QUERIES_KILLED = "queriesKilled"
     QUERIES_REJECTED = "queriesRejected"
+    HBM_OOM_EVENTS = "hbmOomEvents"
+    HBM_OOM_EVICTIONS = "hbmOomEvictions"
+    HBM_OOM_QUERY_FAILURES = "hbmOomQueryFailures"
 
 
 class BrokerMeter:

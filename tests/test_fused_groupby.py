@@ -331,7 +331,7 @@ print("BF16 PARITY OK")
         p for p in env.get("PYTHONPATH", "").split(_os.pathsep)
         if p and "axon" not in p)
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=300, env=env, cwd=str(
+                       text=True, timeout=600, env=env, cwd=str(
                            Path(__file__).resolve().parent.parent))
     assert r.returncode == 0, r.stderr[-2000:]
     assert "BF16 PARITY OK" in r.stdout

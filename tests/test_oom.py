@@ -1,0 +1,194 @@
+"""HBM-OOM handling (engine/oom.py) — the DirectOOMHandler analogue.
+
+Reference: pinot-core/.../transport/DirectOOMHandler.java sheds load on
+direct-memory OOM instead of dying. Here: RESOURCE_EXHAUSTED during device
+work triggers one LRU eviction + retry, then a clean metered query failure.
+
+A real deliberately-oversized allocation cannot run safely on the CI CPU
+backend (it would OOM host RAM, not HBM), so the XLA failure is injected
+at the dispatch seam with the same exception type/message jaxlib raises on
+a v5e when an allocation exceeds free HBM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.oom import (HbmExhaustedError, is_hbm_oom,
+                                  relieve_pressure, with_oom_retry)
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.device_cache import GLOBAL_DEVICE_CACHE
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.metrics import SERVER_METRICS, ServerMeter
+
+from jax.errors import JaxRuntimeError as XlaRuntimeError
+
+OOM_MSG = ("RESOURCE_EXHAUSTED: Error allocating device buffer: "
+           "Attempting to allocate 12.50G. That was not possible. "
+           "There are 5.17G free.")
+
+SCHEMA = Schema.build(
+    "t", dimensions=[("g", "INT")], metrics=[("v", "INT")])
+
+
+def _build(tmp_path, name, n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    SegmentBuilder(SCHEMA, segment_name=name).build(
+        {"g": rng.integers(0, 8, n).astype(np.int32),
+         "v": rng.integers(0, 100, n).astype(np.int32)}, tmp_path / name)
+    return load_segment(tmp_path / name)
+
+
+def test_is_hbm_oom_classification():
+    assert is_hbm_oom(XlaRuntimeError(OOM_MSG))
+    assert is_hbm_oom(MemoryError())
+    assert not is_hbm_oom(ValueError(OOM_MSG))
+    assert not is_hbm_oom(XlaRuntimeError("INVALID_ARGUMENT: bad shape"))
+
+
+def test_oom_retry_succeeds_after_eviction(tmp_path):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise XlaRuntimeError(OOM_MSG)
+        return "ok"
+
+    before = SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_EVENTS)
+    freed = []
+    assert with_oom_retry(flaky, on_relief=freed.append) == "ok"
+    assert calls["n"] == 2
+    assert len(freed) == 1
+    assert SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_EVENTS) \
+        == before + 1
+
+
+def test_oom_retry_fails_cleanly_when_persistent():
+    def always():
+        raise XlaRuntimeError(OOM_MSG)
+
+    before = SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_QUERY_FAILURES)
+    with pytest.raises(HbmExhaustedError):
+        with_oom_retry(always)
+    assert SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_QUERY_FAILURES) \
+        == before + 1
+
+
+def test_non_oom_errors_pass_through():
+    def boom():
+        raise ValueError("unrelated")
+
+    with pytest.raises(ValueError):
+        with_oom_retry(boom)
+
+
+def test_relieve_pressure_keeps_current_segment(tmp_path):
+    a = _build(tmp_path, "a", seed=1)
+    b = _build(tmp_path, "b", seed=2)
+    va = GLOBAL_DEVICE_CACHE.view(a)
+    vb = GLOBAL_DEVICE_CACHE.view(b)
+    va.dict_ids("g")
+    vb.dict_ids("g")
+    assert va.nbytes() > 0 and vb.nbytes() > 0
+    freed = relieve_pressure(keep_segment=b)
+    assert freed > 0
+    # the executing segment's planes survive; the cold one is gone
+    assert id(b) in GLOBAL_DEVICE_CACHE._views
+    assert id(a) not in GLOBAL_DEVICE_CACHE._views
+    GLOBAL_DEVICE_CACHE.drop(b)
+
+
+def test_query_survives_one_dispatch_oom(tmp_path, monkeypatch):
+    """End-to-end: first device dispatch OOMs (injected), cold segments are
+    evicted, the retry succeeds, and the query answer is exact."""
+    seg = _build(tmp_path, "s0")
+    cold = _build(tmp_path, "cold", seed=9)
+    GLOBAL_DEVICE_CACHE.view(cold).dict_ids("g")  # a cold resident victim
+
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(SCHEMA, [seg])
+    host = QueryExecutor(backend="host")
+    host.add_table(SCHEMA, [seg])
+    sql = "SELECT g, SUM(v), COUNT(*) FROM t GROUP BY g LIMIT 20"
+
+    real = qe.tpu.dispatch_plan
+    state = {"failed": False}
+
+    def flaky_dispatch(segment, plan):
+        if not state["failed"]:
+            state["failed"] = True
+            raise XlaRuntimeError(OOM_MSG)
+        return real(segment, plan)
+
+    monkeypatch.setattr(qe.tpu, "dispatch_plan", flaky_dispatch)
+    before = SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_EVICTIONS)
+    resp = qe.execute_sql(sql)
+    assert not resp.exceptions, resp.exceptions
+    assert state["failed"]
+    # at least the cold victim was evicted (meter counts victims)
+    assert SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_EVICTIONS) \
+        >= before + 1
+    assert id(cold) not in GLOBAL_DEVICE_CACHE._views  # victim evicted
+    want = host.execute_sql(sql)
+    assert sorted(map(tuple, resp.result_table.rows)) == \
+        sorted(map(tuple, want.result_table.rows))
+
+
+def test_query_survives_collect_seam_oom(tmp_path, monkeypatch):
+    """Async dispatch surfaces in-flight OOM at collect on poisoned
+    buffers; the retry path re-dispatches and the query still answers."""
+    seg = _build(tmp_path, "s2")
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(SCHEMA, [seg])
+
+    real_collect = qe.tpu.collect
+    real_dispatch = qe.tpu.dispatch_plan
+    state = {"collect_calls": 0, "dispatches": 0}
+
+    def counting_dispatch(segment, plan):
+        state["dispatches"] += 1
+        return real_dispatch(segment, plan)
+
+    def flaky_collect(query, segment, plan, outs):
+        state["collect_calls"] += 1
+        if state["collect_calls"] == 1:
+            raise XlaRuntimeError(OOM_MSG)
+        return real_collect(query, segment, plan, outs)
+
+    monkeypatch.setattr(qe.tpu, "dispatch_plan", counting_dispatch)
+    monkeypatch.setattr(qe.tpu, "collect", flaky_collect)
+    resp = qe.execute_sql("SELECT g, SUM(v) FROM t GROUP BY g LIMIT 20")
+    assert not resp.exceptions, resp.exceptions
+    assert state["collect_calls"] == 2
+    assert state["dispatches"] == 2  # the retry RE-dispatched
+
+
+def test_query_fails_cleanly_on_persistent_oom(tmp_path, monkeypatch):
+    """The deliberately-oversized-allocation shape: every dispatch attempt
+    OOMs → the QUERY fails with a clean broker exception (no raw XLA abort,
+    process stays healthy) and the failure meter ticks."""
+    seg = _build(tmp_path, "s1")
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(SCHEMA, [seg])
+
+    def always_oom(segment, plan):
+        raise XlaRuntimeError(OOM_MSG)
+
+    monkeypatch.setattr(qe.tpu, "dispatch_plan", always_oom)
+    before = SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_QUERY_FAILURES)
+    resp = qe.execute_sql("SELECT g, SUM(v) FROM t GROUP BY g LIMIT 20")
+    assert resp.exceptions and "HbmExhaustedError" in resp.exceptions[0], \
+        resp.exceptions
+    assert SERVER_METRICS.meter_count(ServerMeter.HBM_OOM_QUERY_FAILURES) \
+        == before + 1
+    # the process (and executor) remain usable afterwards
+    resp2 = qe.execute_sql("SELECT COUNT(*) FROM t")
+    assert resp2.exceptions and "HbmExhaustedError" in resp2.exceptions[0]
+    monkeypatch.undo()
+    resp3 = qe.execute_sql("SELECT COUNT(*) FROM t")
+    assert not resp3.exceptions, resp3.exceptions
+    assert resp3.result_table.rows[0][0] == 400
