@@ -121,6 +121,8 @@ _DATA_ARITY = {
 # legacy digit-suffixed percentiles: PERCENTILE95(col) ≡ PERCENTILE(col, 95)
 # (shared pattern — query/expressions.py uses it for is_aggregation too)
 from ..query.expressions import PERCENTILE_SUFFIX_RE as _PCT_SUFFIX  # noqa: E402
+# cycle-safe: funnel.py imports this module only lazily
+from .funnel import FUNNEL_FNS as _FUNNEL_FNS  # noqa: E402
 
 
 def canonicalize(name: str, extra: tuple) -> tuple[str, tuple]:
@@ -148,6 +150,10 @@ def semantics_for(expr: ExpressionContext) -> AggSemantics:
     fn = expr.function
     if fn.name == "filter":  # FILTER (WHERE ...) wrapper: inner semantics
         return semantics_for(fn.arguments[0])
+    if fn.name in _FUNNEL_FNS:  # funnel args aren't (data, literal*)-shaped
+        from .funnel import funnel_semantics
+
+        return funnel_semantics(fn)
     _, extra = split_args(fn)
     return get_semantics(fn.name, extra)
 

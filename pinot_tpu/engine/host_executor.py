@@ -19,6 +19,7 @@ from ..query.expressions import ExpressionContext
 from ..query.filter import FilterContext, FilterNodeType, Predicate, PredicateType
 from ..segment.loader import ImmutableSegment
 from ..query.transforms import get_transform
+from . import funnel
 from .aggregation import (
     VEC_RECIPES,
     UnsupportedQueryError,
@@ -376,6 +377,9 @@ class HostSegmentExecutor:
             inner, cond = agg.function.arguments
             return self._agg_state(
                 inner, segment, mask & self._clause_mask(cond, segment, nh), nh)
+        if name in funnel.FUNNEL_FNS:
+            return self._funnel_builder(agg.function, segment)(
+                np.nonzero(mask)[0])
         data, extra = split_args(agg.function)
         if nh and data:
             # skip rows where ANY operand column is null (COUNT(expr) too;
@@ -396,6 +400,26 @@ class HostSegmentExecutor:
             return host_state(name, np.asarray(flat), extra)
         cols = [np.asarray(self.eval_value(a, segment))[mask] for a in data]
         return host_state_full(name, cols, extra)
+
+    def _funnel_builder(self, fn, segment):
+        """rows_idx → funnel intermediate state, with the whole-segment row
+        arrays (step masks, timestamps, correlation values) computed once
+        and shared across groups (engine/funnel.py)."""
+        spec = funnel.parse_funnel(fn)
+        if isinstance(spec, funnel.FunnelCountSpec):
+            corr, masks = funnel.count_row_arrays(self, spec, segment)
+
+            def build_count(rows_idx):
+                return funnel.count_state(corr, masks, rows_idx)
+
+            return build_count
+        ts, step, valid = funnel.window_row_arrays(self, spec, segment)
+
+        def build_window(rows_idx):
+            r = rows_idx[valid[rows_idx]]
+            return funnel.window_state(ts, step, r)
+
+        return build_window
 
     def _group_by(self, query, segment, mask, group_exprs) -> GroupByIntermediate:
         if any(e.is_identifier and segment.has_column(e.identifier)
@@ -432,6 +456,8 @@ class HostSegmentExecutor:
                 r = rows if drop is None else rows[~drop[rows]]
                 if kind == "count":
                     states.append(len(r))
+                elif kind == "funnel":
+                    states.append(cols(r))
                 elif kind == "mv":
                     flat = [v for i in r for v in cols[i]]
                     states.append(
@@ -473,6 +499,10 @@ class HostSegmentExecutor:
                 clause_drop = ~self._clause_mask(cond, segment, nh)
                 fexpr = inner.function
             name = fexpr.name
+            if name in funnel.FUNNEL_FNS:
+                agg_args.append(("funnel", self._funnel_builder(fexpr, segment),
+                                 (), clause_drop, name))
+                continue
             data, extra = split_args(fexpr)
             if name == "count":
                 # advanced null handling: COUNT(col) counts non-null rows
@@ -545,6 +575,8 @@ class HostSegmentExecutor:
                 r = rows_idx if drop is None else rows_idx[~drop[rows_idx]]
                 if kind == "count":
                     states.append(len(r))
+                elif kind == "funnel":
+                    states.append(cols(r))
                 elif kind == "mv":
                     flat = [v for d in r for v in cols[d]]
                     states.append(

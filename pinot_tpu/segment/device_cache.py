@@ -58,12 +58,17 @@ class SegmentDeviceView:
         self.packed_bits: dict[tuple[str, str], int] = {}
 
     def _put(self, key: tuple[str, str], host: np.ndarray) -> jnp.ndarray:
-        if key not in self._planes:
+        """Upload-and-cache. Returns the plane via a LOCAL reference (never
+        a second dict read): OOM-relief eviction (engine/oom.py) may clear
+        _planes concurrently with readers, which must keep their array and
+        at worst re-upload next time — not die on a missing key."""
+        arr = self._planes.get(key)
+        if arr is None:
             arr = jnp.asarray(host)
             if self.device is not None:
                 arr = jax.device_put(arr, self.device)
             self._planes[key] = arr
-        return self._planes[key]
+        return arr
 
     def dict_ids(self, column: str) -> jnp.ndarray:
         """Padded int32 dict-id plane (pad value 0; rows masked by num_docs)."""
@@ -71,12 +76,13 @@ class SegmentDeviceView:
         if not m.single_value:
             return self.mv_dict_ids(column)
         key = (column, "ids")
-        if key not in self._planes:
-            ids = self.segment.get_dict_ids(column)
-            out = np.zeros(self.padded, dtype=np.int32)
-            out[: ids.shape[0]] = ids
-            self._put(key, out)
-        return self._planes[key]
+        cached = self._planes.get(key)
+        if cached is not None:
+            return cached
+        ids = self.segment.get_dict_ids(column)
+        out = np.zeros(self.padded, dtype=np.int32)
+        out[: ids.shape[0]] = ids
+        return self._put(key, out)
 
     def dict_ids_packed(self, column: str):
         """(plane, width) with the id plane stored NARROW in HBM: uint8 for
@@ -92,33 +98,37 @@ class SegmentDeviceView:
             return self.dict_ids(column), 0
         width = 8 if bits <= 8 else 16
         key = (column, "ids_packed")  # distinct from the plain plane key
-        if key not in self._planes:
-            ids = self.segment.get_dict_ids(column)
-            out = np.zeros(self.padded,
-                           dtype=np.uint8 if width == 8 else np.uint16)
-            out[: ids.shape[0]] = ids
-            self._put(key, out)
-            self.packed_bits[key] = width
-        return self._planes[key], self.packed_bits.get(key, 0)
+        cached = self._planes.get(key)
+        if cached is not None:
+            return cached, self.packed_bits.get(key, width)
+        ids = self.segment.get_dict_ids(column)
+        out = np.zeros(self.padded,
+                       dtype=np.uint8 if width == 8 else np.uint16)
+        out[: ids.shape[0]] = ids
+        arr = self._put(key, out)
+        self.packed_bits[key] = width
+        return arr, width
 
     def mv_dict_ids(self, column: str) -> jnp.ndarray:
         key = (column, "mvids")
-        if key not in self._planes:
-            mat = self.segment.get_mv_dict_id_matrix(column)
-            card = self.segment.column_metadata(column).cardinality
-            out = np.full((self.padded, mat.shape[1]), card, dtype=np.int32)
-            out[: mat.shape[0]] = mat
-            self._put(key, out)
-        return self._planes[key]
+        cached = self._planes.get(key)
+        if cached is not None:
+            return cached
+        mat = self.segment.get_mv_dict_id_matrix(column)
+        card = self.segment.column_metadata(column).cardinality
+        out = np.full((self.padded, mat.shape[1]), card, dtype=np.int32)
+        out[: mat.shape[0]] = mat
+        return self._put(key, out)
 
     def raw(self, column: str) -> jnp.ndarray:
         key = (column, "raw")
-        if key not in self._planes:
-            vals = self.segment.get_raw(column)
-            out = np.zeros(self.padded, dtype=vals.dtype)
-            out[: vals.shape[0]] = vals
-            self._put(key, out)
-        return self._planes[key]
+        cached = self._planes.get(key)
+        if cached is not None:
+            return cached
+        vals = self.segment.get_raw(column)
+        out = np.zeros(self.padded, dtype=vals.dtype)
+        out[: vals.shape[0]] = vals
+        return self._put(key, out)
 
     def raw_f32_rebased(self, column: str) -> jnp.ndarray:
         """(v - column_min) as an f32 plane — the histogram-binning view
@@ -127,34 +137,37 @@ class SegmentDeviceView:
         precision; the f32 plane costs half the f64 plane's HBM residency
         and read bandwidth."""
         key = (column, "rawf32r")
-        if key not in self._planes:
-            vals = self.segment.get_raw(column)
-            base = float(self.segment.column_metadata(column).min_value)
-            out = np.zeros(self.padded, dtype=np.float32)
-            out[: vals.shape[0]] = (vals - base).astype(np.float32)
-            self._put(key, out)
-        return self._planes[key]
+        cached = self._planes.get(key)
+        if cached is not None:
+            return cached
+        vals = self.segment.get_raw(column)
+        base = float(self.segment.column_metadata(column).min_value)
+        out = np.zeros(self.padded, dtype=np.float32)
+        out[: vals.shape[0]] = (vals - base).astype(np.float32)
+        return self._put(key, out)
 
     def dict_values(self, column: str) -> jnp.ndarray:
         """Numeric dictionary shipped to device for on-device decode."""
         key = (column, "dict")
-        if key not in self._planes:
-            d = self.segment.get_dictionary(column)
-            assert DataType(self.segment.column_metadata(column).data_type).is_fixed_width, (
-                f"{column}: var-width dictionaries stay host-side"
-            )
-            self._put(key, np.ascontiguousarray(d.values))
-        return self._planes[key]
+        cached = self._planes.get(key)
+        if cached is not None:
+            return cached
+        d = self.segment.get_dictionary(column)
+        assert DataType(self.segment.column_metadata(column).data_type).is_fixed_width, (
+            f"{column}: var-width dictionaries stay host-side"
+        )
+        return self._put(key, np.ascontiguousarray(d.values))
 
     def null_plane(self, column: str) -> jnp.ndarray:
         key = (column, "null")
-        if key not in self._planes:
-            nulls = self.segment.get_null_bitmap(column)
-            out = np.zeros(self.padded, dtype=bool)
-            if nulls is not None:
-                out[: nulls.shape[0]] = nulls
-            self._put(key, out)
-        return self._planes[key]
+        cached = self._planes.get(key)
+        if cached is not None:
+            return cached
+        nulls = self.segment.get_null_bitmap(column)
+        out = np.zeros(self.padded, dtype=bool)
+        if nulls is not None:
+            out[: nulls.shape[0]] = nulls
+        return self._put(key, out)
 
     def nbytes(self) -> int:
         return sum(p.nbytes for p in self._planes.values())

@@ -1,0 +1,321 @@
+"""FUNNEL aggregation family vs an independent per-entity oracle.
+
+Reference: pinot-core/.../aggregation/function/funnel/ (FUNNEL_COUNT with
+set strategy) and .../funnel/window/ (FUNNEL_MAX_STEP / FUNNEL_MATCH_STEP /
+FUNNEL_COMPLETE_COUNT with sliding windows + modes). The oracle here
+recomputes results from raw rows with simple python (sets / brute-force
+window scans), independent of the engine's vectorized state machinery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "ev",
+    dimensions=[("uid", "INT"), ("url", "STRING"), ("ts", "LONG"),
+                ("day", "INT")])
+
+URLS = ["/home", "/cart", "/pay", "/done", "/other"]
+STEPS3 = ["/cart", "/pay", "/done"]
+
+
+def _gen(n, seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "uid": rng.integers(0, 40, n).astype(np.int32),
+        "url": np.asarray(URLS, dtype=object)[rng.integers(0, len(URLS), n)],
+        "ts": (1_000 + rng.integers(0, 5_000, n)).astype(np.int64),
+        "day": rng.integers(0, 3, n).astype(np.int32),
+    }
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    d = tmp_path_factory.mktemp("funnel")
+    # two segments: cross-segment state merges are part of what's under test
+    data = []
+    for i in range(2):
+        cols = _gen(600, seed=100 + i)
+        SegmentBuilder(SCHEMA, segment_name=f"ev{i}").build(cols, d / f"s{i}")
+        data.append(cols)
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, [load_segment(d / "s0"), load_segment(d / "s1")])
+    rows = {k: np.concatenate([c[k] for c in data]) for k in data[0]}
+    return qe, rows
+
+
+# -- oracle -------------------------------------------------------------------
+
+
+def _first_step(url, steps):
+    for j, s in enumerate(steps):
+        if url == s:
+            return j
+    return None
+
+
+def oracle_events(rows, steps, keep_all=False, sel=None):
+    """[(ts, step)] sorted, per reference event extraction."""
+    out = []
+    n = len(rows["ts"])
+    for i in range(n):
+        if sel is not None and not sel[i]:
+            continue
+        j = _first_step(rows["url"][i], steps)
+        if j is None:
+            if keep_all:
+                out.append((int(rows["ts"][i]), -1))
+            continue
+        out.append((int(rows["ts"][i]), j))
+    return sorted(out)
+
+
+def oracle_max_step(events, nsteps, window, modes=(), max_dur=0):
+    """Brute force: for every step-0 anchor, scan forward within the
+    window honoring the modes; also honors the reference's window-fill
+    bound (events stop at the first MAXSTEPDURATION gap)."""
+    best = 0
+    for k, (t0, s0) in enumerate(events):
+        if s0 != 0:
+            continue
+        win = []
+        last = t0
+        for t, s in events[k:]:
+            if t >= t0 + window:
+                break
+            if max_dur and win and t - last > max_dur:
+                break
+            win.append((t, s))
+            last = t
+        best = max(best, _scan(win, nsteps, modes))
+        if best == nsteps:
+            return best
+    return best
+
+
+def _scan(win, nsteps, modes):
+    mx, prev = 0, -1
+    for t, s in win:
+        if "STRICT_DEDUPLICATION" in modes and s == mx - 1:
+            return mx
+        if "STRICT_ORDER" in modes and s != mx:
+            return mx
+        if "STRICT_INCREASE" in modes and prev == t:
+            continue
+        if mx == s:
+            mx += 1
+            prev = t
+        if mx == nsteps:
+            break
+    return mx
+
+
+def oracle_funnel_count(rows, steps, sel=None):
+    sets = [set() for _ in steps]
+    n = len(rows["ts"])
+    for i in range(n):
+        if sel is not None and not sel[i]:
+            continue
+        for j, s in enumerate(steps):
+            if rows["url"][i] == s:
+                sets[j].add(int(rows["uid"][i]))
+    out, run = [], None
+    for s in sets:
+        run = set(s) if run is None else run & s
+        out.append(len(run))
+    return out
+
+
+# -- tests --------------------------------------------------------------------
+
+
+def _steps_sql(steps):
+    return ", ".join(f"url = '{s}'" for s in steps)
+
+
+def test_funnel_count_ungrouped(env):
+    qe, rows = env
+    sql = (f"SELECT FUNNEL_COUNT(STEPS({_steps_sql(STEPS3)}), "
+           f"CORRELATE_BY(uid)) FROM ev")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    assert list(r.result_table.rows[0][0]) == oracle_funnel_count(rows, STEPS3)
+
+
+def test_funnel_count_with_where(env):
+    qe, rows = env
+    sql = (f"SELECT FUNNEL_COUNT(STEPS({_steps_sql(STEPS3)}), "
+           f"CORRELATE_BY(uid)) FROM ev WHERE day = 1")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    sel = rows["day"] == 1
+    assert list(r.result_table.rows[0][0]) == \
+        oracle_funnel_count(rows, STEPS3, sel=sel)
+
+
+def test_funnel_count_group_by(env):
+    qe, rows = env
+    sql = (f"SELECT day, FUNNEL_COUNT(STEPS({_steps_sql(STEPS3)}), "
+           f"CORRELATE_BY(uid)) FROM ev GROUP BY day LIMIT 10")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    got = {row[0]: list(row[1]) for row in r.result_table.rows}
+    for day in (0, 1, 2):
+        sel = rows["day"] == day
+        assert got[day] == oracle_funnel_count(rows, STEPS3, sel=sel), day
+
+
+def test_funnel_count_settings_accepted(env):
+    qe, rows = env
+    sql = (f"SELECT FUNNEL_COUNT(STEPS({_steps_sql(STEPS3)}), "
+           f"CORRELATE_BY(uid), SETTINGS('theta_sketch')) FROM ev")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    assert list(r.result_table.rows[0][0]) == oracle_funnel_count(rows, STEPS3)
+
+
+@pytest.mark.parametrize("modes", [(), ("STRICT_ORDER",),
+                                   ("STRICT_DEDUPLICATION",),
+                                   ("STRICT_INCREASE",)])
+def test_funnel_max_step_modes(env, modes):
+    qe, rows = env
+    mode_sql = "".join(f", '{m}'" for m in modes)
+    sql = (f"SELECT FUNNEL_MAX_STEP(ts, 800, 3, {_steps_sql(STEPS3)}"
+           f"{mode_sql}) FROM ev")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    events = oracle_events(rows, STEPS3)
+    assert r.result_table.rows[0][0] == \
+        oracle_max_step(events, 3, 800, modes)
+
+
+def test_funnel_max_step_group_by(env):
+    qe, rows = env
+    sql = (f"SELECT day, FUNNEL_MAX_STEP(ts, 500, 3, {_steps_sql(STEPS3)}) "
+           f"FROM ev GROUP BY day LIMIT 10")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    got = {row[0]: row[1] for row in r.result_table.rows}
+    for day in (0, 1, 2):
+        sel = rows["day"] == day
+        events = oracle_events(rows, STEPS3, sel=sel)
+        assert got[day] == oracle_max_step(events, 3, 500), day
+
+
+def test_funnel_match_step(env):
+    qe, rows = env
+    sql = (f"SELECT FUNNEL_MATCH_STEP(ts, 800, 3, {_steps_sql(STEPS3)}) "
+           f"FROM ev")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    events = oracle_events(rows, STEPS3)
+    m = oracle_max_step(events, 3, 800)
+    assert list(r.result_table.rows[0][0]) == [1] * m + [0] * (3 - m)
+
+
+def test_funnel_max_step_keep_all_blocks_strict_order(env):
+    """KEEP_ALL emits -1 dummy events for non-step rows, which break
+    STRICT_ORDER sequences (the reference's intervention semantics)."""
+    qe, rows = env
+    sql = (f"SELECT FUNNEL_MAX_STEP(ts, 800, 3, {_steps_sql(STEPS3)}, "
+           f"'KEEP_ALL', 'STRICT_ORDER') FROM ev")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    events = oracle_events(rows, STEPS3, keep_all=True)
+    assert r.result_table.rows[0][0] == \
+        oracle_max_step(events, 3, 800, ("STRICT_ORDER",))
+
+
+def test_funnel_max_step_duration_cap(env):
+    qe, rows = env
+    sql = (f"SELECT FUNNEL_MAX_STEP(ts, 2000, 3, {_steps_sql(STEPS3)}, "
+           f"'MAXSTEPDURATION=50') FROM ev")
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    events = oracle_events(rows, STEPS3)
+    assert r.result_table.rows[0][0] == \
+        oracle_max_step(events, 3, 2000, (), max_dur=50)
+
+
+def test_funnel_complete_count_hand_checked(tmp_path):
+    """Deterministic event sequences with known complete-round counts."""
+    rows = [
+        # uid, url, ts: two full rounds inside one window, then a partial
+        (1, "/cart", 10), (1, "/pay", 20), (1, "/done", 30),
+        (1, "/cart", 40), (1, "/pay", 50), (1, "/done", 60),
+        (1, "/cart", 70), (1, "/pay", 80),
+    ]
+    cols = {
+        "uid": np.asarray([r[0] for r in rows], dtype=np.int32),
+        "url": np.asarray([r[1] for r in rows], dtype=object),
+        "ts": np.asarray([r[2] for r in rows], dtype=np.int64),
+        "day": np.zeros(len(rows), dtype=np.int32),
+    }
+    SegmentBuilder(SCHEMA, segment_name="cc").build(cols, tmp_path / "cc")
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, [load_segment(tmp_path / "cc")])
+    r = qe.execute_sql(
+        f"SELECT FUNNEL_COMPLETE_COUNT(ts, 1000, 3, {_steps_sql(STEPS3)}) "
+        f"FROM ev")
+    assert not r.exceptions, r.exceptions
+    assert r.result_table.rows[0][0] == 2
+
+    # window too small for any complete round
+    r = qe.execute_sql(
+        f"SELECT FUNNEL_COMPLETE_COUNT(ts, 15, 3, {_steps_sql(STEPS3)}) "
+        f"FROM ev")
+    assert r.result_table.rows[0][0] == 0
+
+
+def test_funnel_max_step_hand_checked(tmp_path):
+    rows = [
+        (1, "/cart", 10), (1, "/other", 15), (1, "/pay", 20),
+        (1, "/done", 500),  # outside the 100-window from ts=10
+    ]
+    cols = {
+        "uid": np.asarray([r[0] for r in rows], dtype=np.int32),
+        "url": np.asarray([r[1] for r in rows], dtype=object),
+        "ts": np.asarray([r[2] for r in rows], dtype=np.int64),
+        "day": np.zeros(len(rows), dtype=np.int32),
+    }
+    SegmentBuilder(SCHEMA, segment_name="ms").build(cols, tmp_path / "ms")
+    qe = QueryExecutor(backend="host")
+    qe.add_table(SCHEMA, [load_segment(tmp_path / "ms")])
+    r = qe.execute_sql(
+        f"SELECT FUNNEL_MAX_STEP(ts, 100, 3, {_steps_sql(STEPS3)}) FROM ev")
+    assert r.result_table.rows[0][0] == 2  # cart→pay inside, done outside
+    # STRICT_ORDER: the /other row doesn't emit an event without KEEP_ALL,
+    # so the order is still cart,pay → 2
+    r = qe.execute_sql(
+        f"SELECT FUNNEL_MAX_STEP(ts, 100, 3, {_steps_sql(STEPS3)}, "
+        f"'STRICT_ORDER') FROM ev")
+    assert r.result_table.rows[0][0] == 2
+    # KEEP_ALL + STRICT_ORDER: /other emits step -1 between cart and pay →
+    # the sequence breaks after step 1
+    r = qe.execute_sql(
+        f"SELECT FUNNEL_MAX_STEP(ts, 100, 3, {_steps_sql(STEPS3)}, "
+        f"'KEEP_ALL', 'STRICT_ORDER') FROM ev")
+    assert r.result_table.rows[0][0] == 1
+
+
+def test_funnel_through_mse_and_device_auto(env):
+    """The auto backend (device engine falls back per segment for funnel)
+    and the single-stage host engine agree."""
+    qe_host, rows = env
+    qe_auto = QueryExecutor(backend="auto")
+    for name, t in qe_host.tables.items():
+        qe_auto.add_table(t.schema, t.segments, name=name)
+    sql = (f"SELECT day, FUNNEL_MAX_STEP(ts, 800, 3, {_steps_sql(STEPS3)}) "
+           f"FROM ev GROUP BY day LIMIT 10")
+    a = qe_host.execute_sql(sql)
+    b = qe_auto.execute_sql(sql)
+    assert not a.exceptions and not b.exceptions, (a.exceptions, b.exceptions)
+    assert sorted(map(tuple, a.result_table.rows)) == \
+        sorted(map(tuple, b.result_table.rows))
