@@ -186,6 +186,8 @@ class ServerInstance:
             return self._handle_query_stream(request)
         if kind == "explain":
             return self._handle_explain(request)
+        if kind == "scan_arrow":
+            return self._handle_scan_arrow(request)
         if kind == "ping":
             return "pong"
         if isinstance(kind, str) and kind.startswith("mse_"):
@@ -233,6 +235,22 @@ class ServerInstance:
         from .datatable import encode
 
         return {"datatable": encode(combined, stats)}
+
+    def _handle_scan_arrow(self, request):
+        """Direct Arrow IPC segment read for external engines — straight
+        from segment storage, no SQL/DataTable in the data path
+        (reference: the Spark connector's gRPC server reads;
+        connectors/arrow_reader.py holds the client half)."""
+        from ..connectors.arrow_reader import segment_ipc_bytes
+
+        table = request["table"]
+        name = request["segment"]
+        with self._lock:
+            seg = self.segments.get(table, {}).get(name)
+        if seg is None:
+            raise ValueError(f"segment {name} not hosted for {table}")
+        ipc = segment_ipc_bytes(seg, request.get("columns"))
+        return {"ipc": ipc, "numRows": seg.num_docs}
 
     def _handle_explain(self, request):
         """Render the operator-tree plan for this server's hosted segments

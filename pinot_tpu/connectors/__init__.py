@@ -6,6 +6,12 @@ In the Python ecosystem the equivalent surfaces are pandas/pyarrow:
 connectors/dataframe.py provides both directions.
 """
 
+from .arrow_reader import (  # noqa: F401
+    ScanSplit,
+    plan_scan,
+    read_split,
+    read_table,
+)
 from .dataframe import (  # noqa: F401
     infer_schema,
     read_sql,

@@ -1,0 +1,164 @@
+"""Arrow IPC bulk-read path for external engines.
+
+Reference analogue: pinot-connectors/pinot-spark-3-connector — Spark's
+DataSource v2 plans one InputPartition per Pinot segment and each
+partition reader pulls that segment's rows from the hosting server over
+gRPC, bypassing SQL fan-out. Here the same contract is Arrow-native:
+
+  plan_scan(broker, table)        → splits (segment + hosting servers)
+  read_split(split, ...)          → one pyarrow.RecordBatch, fetched
+                                    DIRECTLY from a hosting server over
+                                    the framed-TCP RPC plane ("scan_arrow"
+                                    request, Arrow IPC stream bytes back)
+  read_table(broker, table, ...)  → partition-parallel whole-table read
+
+Servers serialize straight from segment storage (dictionary decode /
+raw planes / MV lists) — no SQL, no DataTable, no broker in the data
+path. Failover: each split carries every replica's address and the reader
+tries them in order.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Server side: segment → Arrow IPC bytes
+# ---------------------------------------------------------------------------
+
+
+def segment_record_batch(segment, columns: Optional[list[str]] = None):
+    """Materialize segment columns as one pyarrow.RecordBatch. SV columns
+    decode through the dictionary (or raw plane); MV columns become Arrow
+    list arrays; null bitmaps become Arrow validity."""
+    import pyarrow as pa
+
+    cols = columns or segment.columns()
+    arrays, names = [], []
+    for c in cols:
+        if not segment.has_column(c):
+            raise ValueError(f"unknown column {c}")
+        m = segment.column_metadata(c)
+        nulls = segment.get_null_bitmap(c)
+        if m.single_value:
+            vals = segment.get_values(c)
+            if vals.dtype == object:
+                arr = pa.array(vals.tolist(),
+                               mask=nulls if nulls is not None else None)
+            else:
+                arr = pa.array(vals, mask=nulls if nulls is not None else None)
+        else:
+            rows = segment.get_mv_values(c)
+            arr = pa.array([list(map(_py, r)) for r in rows])
+        arrays.append(arr)
+        names.append(c)
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+def _py(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def segment_ipc_bytes(segment, columns: Optional[list[str]] = None) -> bytes:
+    import pyarrow as pa
+
+    batch = segment_record_batch(segment, columns)
+    buf = io.BytesIO()
+    with pa.ipc.new_stream(buf, batch.schema) as w:
+        w.write_batch(batch)
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Client side: plan + parallel read
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScanSplit:
+    """One unit of partition-parallel work: a segment plus every replica
+    address able to serve it (reference: PinotInputPartition)."""
+
+    table: str
+    segment: str
+    addresses: list[tuple[str, int]]  # (host, port) per hosting server
+
+
+def plan_scan(broker, table: str) -> list[ScanSplit]:
+    """Splits for a partition-parallel read (reference:
+    planInputPartitions). ``table`` is the name with type suffix
+    (e.g. "t_OFFLINE")."""
+    routing = broker.routing_table(table)
+    store = broker.store
+    addr_cache: dict[str, tuple[str, int]] = {}
+
+    def addr(inst: str):
+        if inst not in addr_cache:
+            cfg = store.get(f"/LIVEINSTANCES/{inst}") or \
+                store.get(f"/INSTANCECONFIGS/{inst}")
+            if cfg is None:
+                return None
+            addr_cache[inst] = (cfg["host"], cfg["port"])
+        return addr_cache[inst]
+
+    splits = []
+    for seg in sorted(routing):
+        addresses = [a for a in (addr(i) for i in routing[seg])
+                     if a is not None]
+        if not addresses:
+            raise RuntimeError(f"segment {seg} has no online replica")
+        splits.append(ScanSplit(table, seg, addresses))
+    return splits
+
+
+def read_split(split: ScanSplit, columns: Optional[list[str]] = None):
+    """Fetch one split as a pyarrow.RecordBatch, failing over across the
+    split's replicas."""
+    import pyarrow as pa
+
+    from ..cluster.transport import RemoteError, RpcClient, TransportError
+
+    last: Exception | None = None
+    for host, port in split.addresses:
+        try:
+            client = RpcClient(host, port)
+            try:
+                out = client.call({"type": "scan_arrow", "table": split.table,
+                                   "segment": split.segment,
+                                   "columns": columns})
+            finally:
+                client.close()
+            with pa.ipc.open_stream(out["ipc"]) as r:
+                return r.read_all().combine_chunks().to_batches()[0]
+        except TransportError as e:  # connection-level: try next replica
+            last = e
+        except RemoteError as e:
+            # stale routing ("not hosted") fails over; anything else (e.g.
+            # unknown column) is the caller's bug — fail fast
+            if "not hosted" not in str(e):
+                raise
+            last = e
+    raise RuntimeError(
+        f"segment {split.segment} unreadable on all replicas: {last}")
+
+
+def read_table(broker, table: str, columns: Optional[list[str]] = None,
+               num_readers: int = 4):
+    """Partition-parallel whole-table read → pyarrow.Table (reference: the
+    Spark connector's parallel partition readers)."""
+    import concurrent.futures as cf
+
+    import pyarrow as pa
+
+    splits = plan_scan(broker, table)
+    if not splits:
+        raise RuntimeError(f"no routable segments for {table}")
+    with cf.ThreadPoolExecutor(max_workers=num_readers) as pool:
+        batches = list(pool.map(
+            lambda s: read_split(s, columns), splits))
+    return pa.Table.from_batches(batches)

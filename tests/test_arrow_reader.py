@@ -1,0 +1,147 @@
+"""Arrow IPC bulk-read path (connectors/arrow_reader.py).
+
+Reference: pinot-connectors/pinot-spark-3-connector — one InputPartition
+per segment, read directly from servers, bypassing SQL. Done-bar from the
+round-4 verdict: a pyarrow client reads a sharded table in parallel and
+matches scan_table.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, PropertyStore, ServerInstance
+from pinot_tpu.connectors import plan_scan, read_split, read_table
+from pinot_tpu.connectors.dataframe import scan_table
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "ar",
+    dimensions=[("name", "STRING"), ("code", "INT"), ("tags", "INT", False)],
+    metrics=[("v", "INT"), ("score", "DOUBLE")])
+
+
+def _cols(rng, n=250):
+    return {
+        "name": np.asarray(["ann", "bob", "cat", "dan"], dtype=object)[
+            rng.integers(0, 4, n)],
+        "code": rng.integers(0, 50, n).astype(np.int32),
+        "tags": [rng.integers(0, 9, rng.integers(0, 4)).astype(np.int32)
+                 for _ in range(n)],
+        "v": rng.integers(-500, 500, n).astype(np.int32),
+        "score": np.round(rng.random(n) * 100, 3),
+    }
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    rng = np.random.default_rng(11)
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="host")
+               for i in range(3)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    table = controller.create_table({"tableName": "ar", "replication": 2})
+    data = []
+    for i in range(4):
+        cols = _cols(rng)
+        SegmentBuilder(SCHEMA, segment_name=f"ar{i}").build(
+            cols, tmp_path / f"ar{i}")
+        controller.add_segment(table, f"ar{i}",
+                               {"location": str(tmp_path / f"ar{i}"),
+                                "numDocs": len(cols["v"])})
+        data.append(cols)
+    yield store, controller, servers, broker, table, data
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _expected_rows(data, cols):
+    rows = []
+    for d in data:
+        n = len(d["v"])
+        for i in range(n):
+            rows.append(tuple(
+                [int(v) for v in d[c][i]] if c == "tags" else
+                (d[c][i].item() if isinstance(d[c][i], np.generic)
+                 else d[c][i])
+                for c in cols))
+    return sorted(rows, key=repr)
+
+
+def _table_rows(t: pa.Table, cols):
+    pydict = t.to_pydict()
+    return sorted(
+        (tuple(pydict[c][i] for c in cols) for i in range(t.num_rows)),
+        key=repr)
+
+
+def test_parallel_read_matches_data_and_scan_table(cluster):
+    store, controller, servers, broker, table, data = cluster
+    cols = ["name", "code", "v", "score"]
+    t = read_table(broker, table, columns=cols, num_readers=4)
+    assert t.num_rows == sum(len(d["v"]) for d in data)
+    assert _table_rows(t, cols) == _expected_rows(data, cols)
+
+    # agrees with the SQL-based scan_table path row-for-row
+    sql_rows = []
+    for _seg, batch in scan_table(broker, table, cols):
+        d = batch.to_pydict()
+        sql_rows.extend(tuple(d[c][i] for c in cols)
+                        for i in range(batch.num_rows))
+    assert sorted(sql_rows, key=repr) == _table_rows(t, cols)
+
+
+def test_mv_column_reads_as_list_array(cluster):
+    store, controller, servers, broker, table, data = cluster
+    t = read_table(broker, table, columns=["code", "tags"])
+    assert pa.types.is_list(t.schema.field("tags").type)
+    assert _table_rows(t, ["code", "tags"]) == \
+        _expected_rows(data, ["code", "tags"])
+
+
+def test_plan_scan_splits_cover_table_with_replicas(cluster):
+    store, controller, servers, broker, table, data = cluster
+    splits = plan_scan(broker, table)
+    assert [s.segment for s in splits] == ["ar0", "ar1", "ar2", "ar3"]
+    for s in splits:
+        assert len(s.addresses) == 2  # replication 2
+
+
+def test_read_split_failover_when_replica_dies(cluster):
+    store, controller, servers, broker, table, data = cluster
+    splits = plan_scan(broker, table)
+    # kill the first-listed replica of the first split AFTER planning: the
+    # reader must fail over to the surviving address
+    hosts = {s.address: s for s in servers
+             for s in [s]}  # address → server
+    victim_addr = splits[0].addresses[0]
+    for s in servers:
+        if s.address == victim_addr:
+            s.stop()
+            break
+    batch = read_split(splits[0], columns=["code", "v"])
+    assert batch.num_rows == len(data[0]["v"])
+
+
+def test_unknown_column_fails_fast(cluster):
+    store, controller, servers, broker, table, data = cluster
+    splits = plan_scan(broker, table)
+    with pytest.raises(Exception, match="unknown column"):
+        read_split(splits[0], columns=["nope"])
+
+
+def test_full_table_default_columns(cluster):
+    store, controller, servers, broker, table, data = cluster
+    t = read_table(broker, table)
+    assert set(t.schema.names) == {"name", "code", "tags", "v", "score"}
+    assert t.num_rows == 1000
