@@ -57,13 +57,23 @@ def take_block(block: Block, idx) -> Block:
     return {c: np.asarray(v)[idx] for c, v in block.items()}
 
 
-def hash_partition(block: Block, keys: list[str], num_partitions: int) -> list[Block]:
-    """Deterministic value-hash partitioning — every producer must route the
-    same key to the same consumer worker (reference: KeySelector hashCode %
-    partitions in HashExchange)."""
-    n = block_len(block)
-    if num_partitions == 1 or not keys:
-        return [block]
+def _string_crc(v: np.ndarray) -> np.ndarray:
+    """CRC32 memoized per distinct value — shuffle keys are dict-decoded
+    strings with few distincts, so encode+crc runs once per distinct and
+    every repeat is a dict hit (Python caches each str object's hash, and
+    dict-decoded columns share value objects). Hash values are identical
+    to the former per-row loop (str(x) then crc32)."""
+    cache: dict = {}
+    get = cache.get
+    return np.fromiter(
+        (h if (h := get(x)) is not None
+         else cache.setdefault(x, zlib.crc32(str(x).encode("utf-8")))
+         for x in v),
+        dtype=np.uint64, count=len(v))
+
+
+def hash_codes(block: Block, keys: list[str], n: int) -> np.ndarray:
+    """uint64 combined hash of the key columns (row-wise)."""
     h = np.zeros(n, dtype=np.uint64)
     for k in keys:
         v = np.asarray(block[k])
@@ -77,12 +87,35 @@ def hash_partition(block: Block, keys: list[str], num_partitions: int) -> list[B
             # deterministic across OS processes — Python's str hash is
             # randomized per process (PYTHONHASHSEED) and would route the
             # same key to different workers on different hosts
-            hv = np.fromiter(
-                (zlib.crc32(str(x).encode("utf-8")) for x in v),
-                dtype=np.uint64, count=n)
+            hv = _string_crc(v)
         h = h * np.uint64(1000003) ^ hv
+    return h
+
+
+def split_by_partition(block: Block, part: np.ndarray,
+                       num_partitions: int) -> list[Block]:
+    """One stable argsort + one gather per column, then zero-copy slices —
+    replaces the O(n·P) boolean-mask scan. Output blocks are views over the
+    gathered arrays; consumers treat blocks as immutable."""
+    order = np.argsort(part, kind="stable")
+    counts = np.bincount(part, minlength=num_partitions)
+    offs = np.zeros(num_partitions + 1, dtype=np.int64)
+    np.cumsum(counts, out=offs[1:])
+    gathered = {c: np.asarray(v)[order] for c, v in block.items()}
+    return [{c: v[offs[p]:offs[p + 1]] for c, v in gathered.items()}
+            for p in range(num_partitions)]
+
+
+def hash_partition(block: Block, keys: list[str], num_partitions: int) -> list[Block]:
+    """Deterministic value-hash partitioning — every producer must route the
+    same key to the same consumer worker (reference: KeySelector hashCode %
+    partitions in HashExchange)."""
+    n = block_len(block)
+    if num_partitions == 1 or not keys:
+        return [block]
+    h = hash_codes(block, keys, n)
     part = (h % np.uint64(num_partitions)).astype(np.int64)
-    return [take_block(block, part == p) for p in range(num_partitions)]
+    return split_by_partition(block, part, num_partitions)
 
 
 def table_partition(block: Block, key: str, pfunc: str,
@@ -91,8 +124,8 @@ def table_partition(block: Block, key: str, pfunc: str,
     the partition key, so worker p sees exactly table partition p — the
     same assignment the segments were stamped with at build time."""
     fn = get_partition_function(pfunc, num_partitions)
-    part = fn.partitions_of(np.asarray(block[key]))
-    return [take_block(block, part == p) for p in range(num_partitions)]
+    part = np.asarray(fn.partitions_of(np.asarray(block[key])), dtype=np.int64)
+    return split_by_partition(block, part, num_partitions)
 
 
 class MailboxService:
