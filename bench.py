@@ -738,6 +738,13 @@ def run_single(cfg: str, outpath: str):
     }
     if note:
         payload["note"] = note
+    stage_stats = getattr(r, "mse_stage_stats", None)
+    if stage_stats:
+        # per-stage attribution (rows in/out, shuffled bytes, wall) from
+        # the LAST timed tpu run — lets bench rounds split MSE time into
+        # shuffle vs join vs agg
+        payload["mse_stage_stats"] = {str(k): v
+                                      for k, v in stage_stats.items()}
     if kernel_s is not None:
         # measured pure-kernel time for ONE segment's program (all fixed
         # dispatch/tunnel costs cancelled); per-segment bytes give the

@@ -49,6 +49,9 @@ class BrokerResponse:
     # the numGroupsLimit trim dropped groups (reference:
     # numGroupsLimitReached) — surviving groups stay exact
     num_groups_limit_reached: bool = False
+    # MSE only: stage_id → {rows_in, rows_out, shuffled_rows,
+    # shuffled_bytes, wall_ms, workers, leaf_pushdown}
+    mse_stage_stats: Optional[dict] = None
 
     def to_json(self) -> dict:
         out = {
@@ -67,6 +70,9 @@ class BrokerResponse:
             out["partialResult"] = True
         if self.num_groups_limit_reached:
             out["numGroupsLimitReached"] = True
+        if self.mse_stage_stats is not None:
+            out["mseStageStats"] = {str(k): v for k, v in
+                                    self.mse_stage_stats.items()}
         return out
 
 

@@ -52,8 +52,8 @@ from .executor import _block_to_result
 from .fragmenter import Stage, explain_stages, fragment, receive_nodes
 from .logical import LogicalPlanner, prune_columns
 from .optimizer import push_filters
-from .mailbox import (Block, block_len, concat_blocks, hash_partition,
-                      table_partition)
+from .mailbox import (Block, block_len, block_nbytes, concat_blocks,
+                      hash_partition, table_partition)
 from .operators import op_filter
 from .parser import parse_relational
 from .plan_serde import expr_from_json, expr_to_json, stage_from_json, stage_to_json
@@ -266,6 +266,9 @@ class RoutedMailbox:
         self._seq: dict[tuple[int, int], int] = defaultdict(int)
         self.first_send_ts: Optional[float] = None
         self.last_send_ts: Optional[float] = None
+        # same stage-stats counters as the in-process MailboxService
+        self.sent_rows: dict[int, int] = defaultdict(int)
+        self.sent_bytes: dict[int, int] = defaultdict(int)
 
     def _expected_senders(self, from_stage: int) -> int:
         # an absent declared-sender count must be loud: defaulting to 0 would
@@ -299,6 +302,9 @@ class RoutedMailbox:
         now = time.monotonic()
         self.first_send_ts = self.first_send_ts or now
         self.last_send_ts = now
+        if block is not None:
+            self.sent_rows[from_stage] += block_len(block)
+            self.sent_bytes[from_stage] += block_nbytes(block)
         seq = self._seq[(to_stage, partition)]
         self._seq[(to_stage, partition)] += 1
         if tuple(addr) == tuple(self.self_addr):
@@ -442,9 +448,12 @@ class MseWorkerService:
 
         pop_join_overflow()  # clear any stale flag on this handler thread
         runner.stats["exec_start_ts"] = time.monotonic()
+        sstat = runner._sstat(stage.stage_id)
+        t0 = time.perf_counter()
         pushed = runner._try_ssqe(stage) if stage.is_leaf else None
         if pushed is not None:
             runner.stats["leaf_ssqe_pushdowns"] += 1
+            sstat["leaf_pushdown"] = True
             block = pushed
         else:
             if stage.is_leaf and runner._null_handling_requested():
@@ -452,12 +461,19 @@ class MseWorkerService:
                     "enableNullHandling requires this leaf stage to push "
                     "down to the single-stage engine")
             block = runner._exec(stage.root, stage, worker)
+        sstat["workers"] = 1  # this worker's share; the dispatcher sums
+        sstat["rows_out"] += block_len(block)
         mailbox.send_partitioned(stage.stage_id, stage.parent_stage, block,
                                  stage.send_dist, stage.send_keys,
                                  parent_workers, pfunc=stage.send_pfunc)
+        sstat["wall_ms"] += (time.perf_counter() - t0) * 1000
+        sstat["shuffled_rows"] = mailbox.sent_rows[stage.stage_id]
+        sstat["shuffled_bytes"] = mailbox.sent_bytes[stage.stage_id]
         runner.stats["join_overflow"] = pop_join_overflow()
         runner.stats["first_send_ts"] = mailbox.first_send_ts
         runner.stats["last_send_ts"] = mailbox.last_send_ts
+        runner.stats["stage_stats"] = {
+            str(k): v for k, v in runner.stage_stats.items()}
         runner.stats.update(self.boxes.metrics(query_id))
         return runner.stats
 
@@ -867,6 +883,7 @@ class DistributedMseDispatcher:
                         submit, stage, w_idx, w, parent_addrs, routing, sj,
                         child_workers))
 
+            stage_stats_agg: dict[int, dict] = {}
             for f in futures:
                 st = f.result()
                 for k in ("num_docs_scanned", "total_docs",
@@ -875,6 +892,19 @@ class DistributedMseDispatcher:
                 stats_agg["join_overflow"] |= bool(st.get("join_overflow"))
                 stats_agg["num_groups_limit_reached"] |= bool(
                     st.get("num_groups_limit_reached"))
+                for sid, ss in (st.get("stage_stats") or {}).items():
+                    agg = stage_stats_agg.setdefault(int(sid), {
+                        "workers": 0, "leaf_pushdown": False, "rows_in": 0,
+                        "rows_out": 0, "shuffled_rows": 0,
+                        "shuffled_bytes": 0, "wall_ms": 0.0})
+                    for k in ("workers", "rows_in", "rows_out",
+                              "shuffled_rows", "shuffled_bytes"):
+                        agg[k] += ss.get(k, 0)
+                    # workers run concurrently: the stage's wall time is
+                    # its slowest worker, not the sum
+                    agg["wall_ms"] = max(agg["wall_ms"],
+                                         float(ss.get("wall_ms", 0.0)))
+                    agg["leaf_pushdown"] |= bool(ss.get("leaf_pushdown"))
 
             final_sid = stages[0].child_stages[0]
             block = concat_blocks(
@@ -887,7 +917,8 @@ class DistributedMseDispatcher:
                 num_docs_scanned=stats_agg["num_docs_scanned"],
                 total_docs=stats_agg["total_docs"],
                 partial_result=stats_agg["join_overflow"],
-                num_groups_limit_reached=stats_agg["num_groups_limit_reached"])
+                num_groups_limit_reached=stats_agg["num_groups_limit_reached"],
+                mse_stage_stats=stage_stats_agg)
         except Exception:
             # a failed worker must not hang its peers in receive/backpressure:
             # stop still-queued dispatches (they'd land on instances the

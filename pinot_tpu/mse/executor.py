@@ -99,7 +99,7 @@ class MultistageExecutor:
             plan = push_filters(plan)
             prune_columns(plan)
             stages = fragment(plan)
-            if query.explain:
+            if query.explain is True:
                 text = explain_stages(stages)
                 return BrokerResponse(
                     result_table=ResultTable(
@@ -113,6 +113,15 @@ class MultistageExecutor:
                                  self.qe.execute, self._read_table,
                                  query_options=query.options)
             block = runner.run()
+            if query.explain == "implementation":
+                # the query RAN; the plan text carries each stage's
+                # measured rows/bytes/time
+                text = explain_stages(stages, runner.stage_stats)
+                return BrokerResponse(
+                    result_table=ResultTable(
+                        DataSchema(["plan"], ["STRING"]),
+                        [[line] for line in text.split("\n")]),
+                    time_used_ms=(time.perf_counter() - t0) * 1000)
             schema = stages[0].root.schema
             result = _block_to_result(block, schema)
             return BrokerResponse(
@@ -122,6 +131,7 @@ class MultistageExecutor:
                 partial_result=pop_join_overflow(),
                 num_groups_limit_reached=runner.stats.get(
                     "num_groups_limit_reached", False),
+                mse_stage_stats=runner.stage_stats,
                 time_used_ms=(time.perf_counter() - t0) * 1000)
         except Exception as e:
             return BrokerResponse(

@@ -108,7 +108,8 @@ def fragment(root: ExchangeNode) -> list[Stage]:
     return stages
 
 
-def explain_stages(stages: list[Stage]) -> str:
+def explain_stages(stages: list[Stage],
+                   stage_stats: Optional[dict] = None) -> str:
     lines = []
     for s in stages:
         head = f"[Stage {s.stage_id}]"
@@ -116,5 +117,13 @@ def explain_stages(stages: list[Stage]) -> str:
             head += f" → stage {s.parent_stage} ({s.send_dist}" + (
                 f" on {s.send_keys})" if s.send_keys else ")")
         lines.append(head)
+        st = (stage_stats or {}).get(s.stage_id)
+        if st is not None:
+            lines.append(
+                "  [impl] workers={workers} leaf_pushdown={leaf_pushdown} "
+                "rows_in={rows_in} rows_out={rows_out} "
+                "shuffled_rows={shuffled_rows} "
+                "shuffled_bytes={shuffled_bytes} "
+                "wall_ms={wall_ms:.1f}".format(**st))
         lines.extend("  " + ln for ln in s.root.tree_lines())
     return "\n".join(lines)

@@ -33,6 +33,13 @@ def block_len(block: Block) -> int:
     return 0
 
 
+def block_nbytes(block: Block) -> int:
+    """Payload size of a block as shipped through a mailbox. Object columns
+    count pointer width per row (cheap, consistent) — the stats consumer
+    compares plans against each other, not against the wire."""
+    return sum(np.asarray(v).nbytes for v in block.values())
+
+
 def concat_blocks(blocks: list[Block], schema: Optional[list[str]] = None) -> Block:
     blocks = [b for b in blocks if b and block_len(b)]
     if not blocks:
@@ -133,8 +140,13 @@ class MailboxService:
 
     def __init__(self):
         self._boxes: dict[tuple, list[Block]] = defaultdict(list)
+        # per sending stage, for the stage-stats plane
+        self.sent_rows: dict[int, int] = defaultdict(int)
+        self.sent_bytes: dict[int, int] = defaultdict(int)
 
     def send(self, from_stage: int, to_stage: int, partition: int, block: Block) -> None:
+        self.sent_rows[from_stage] += block_len(block)
+        self.sent_bytes[from_stage] += block_nbytes(block)
         self._boxes[(from_stage, to_stage, partition)].append(block)
 
     def receive(self, from_stage: int, to_stage: int, partition: int,

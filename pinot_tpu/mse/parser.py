@@ -51,11 +51,15 @@ class _RelationalParser(_Parser):
             self.expect_op("=")
             options[key] = _literal_value(self.next())
             self.accept_op(";")
-        explain = False
+        explain: Any = False
         if self.accept_kw("EXPLAIN"):
+            # EXPLAIN IMPLEMENTATION [PLAN] [FOR]: execute the query and
+            # annotate each stage with its runtime stats (rows in/out,
+            # shuffled bytes, wall time)
+            explain = "implementation" if self.accept_kw("IMPLEMENTATION") \
+                else True
             self.accept_kw("PLAN")
             self.accept_kw("FOR")
-            explain = True
         stmt = self._parse_statement()
         self.accept_op(";")
         if self.peek().kind != "eof":

@@ -77,6 +77,19 @@ class StageRunner:
         self.stats = {"stages": len(stages), "leaf_ssqe_pushdowns": 0,
                       "num_docs_scanned": 0, "total_docs": 0,
                       "num_groups_limit_reached": False}
+        # per-stage observability: stage_id → counters (rows in/out,
+        # shuffled rows/bytes, wall time) — the attribution plane for
+        # EXPLAIN IMPLEMENTATION and bench's mse_stage_stats
+        self.stage_stats: dict[int, dict] = {}
+
+    def _sstat(self, stage_id: int) -> dict:
+        st = self.stage_stats.get(stage_id)
+        if st is None:
+            st = self.stage_stats[stage_id] = {
+                "workers": 0, "leaf_pushdown": False, "rows_in": 0,
+                "rows_out": 0, "shuffled_rows": 0, "shuffled_bytes": 0,
+                "wall_ms": 0.0}
+        return st
 
     def _null_handling_requested(self) -> bool:
         opt = self.query_options.get("enableNullHandling")
@@ -104,8 +117,12 @@ class StageRunner:
                                     broker.root.schema)
 
     def _run_stage(self, stage: Stage) -> None:
+        import time
+
         parent = self.stages[stage.parent_stage]
         parent_workers = 1 if parent.stage_id == 0 else self.workers_of(parent)
+        st = self._sstat(stage.stage_id)
+        t0 = time.perf_counter()
         pushed = None
         if stage.is_leaf:
             pushed = self._try_ssqe(stage)
@@ -118,23 +135,33 @@ class StageRunner:
                     "down to the single-stage engine")
         if pushed is not None:
             self.stats["leaf_ssqe_pushdowns"] += 1
+            st["workers"] = 1
+            st["leaf_pushdown"] = True
+            st["rows_out"] += block_len(pushed)
             self.mailbox.send_partitioned(
                 stage.stage_id, parent.stage_id, pushed,
                 stage.send_dist, stage.send_keys, parent_workers,
                 pfunc=stage.send_pfunc)
-            return
-        for w in range(self.workers_of(stage)):
-            block = self._exec(stage.root, stage, w)
-            self.mailbox.send_partitioned(
-                stage.stage_id, parent.stage_id, block,
-                stage.send_dist, stage.send_keys, parent_workers,
-                pfunc=stage.send_pfunc)
+        else:
+            st["workers"] = self.workers_of(stage)
+            for w in range(st["workers"]):
+                block = self._exec(stage.root, stage, w)
+                st["rows_out"] += block_len(block)
+                self.mailbox.send_partitioned(
+                    stage.stage_id, parent.stage_id, block,
+                    stage.send_dist, stage.send_keys, parent_workers,
+                    pfunc=stage.send_pfunc)
+        st["wall_ms"] += (time.perf_counter() - t0) * 1000
+        st["shuffled_rows"] = self.mailbox.sent_rows[stage.stage_id]
+        st["shuffled_bytes"] = self.mailbox.sent_bytes[stage.stage_id]
 
     # -- node execution ----------------------------------------------------
     def _exec(self, node: PlanNode, stage: Stage, worker: int) -> Block:
         if isinstance(node, MailboxReceiveNode):
-            return self.mailbox.receive(node.from_stage, stage.stage_id, worker,
-                                        node.schema)
+            block = self.mailbox.receive(node.from_stage, stage.stage_id,
+                                         worker, node.schema)
+            self._sstat(stage.stage_id)["rows_in"] += block_len(block)
+            return block
         if isinstance(node, TableScanNode):
             return self._scan(node)
         if isinstance(node, FilterNode):
@@ -198,6 +225,7 @@ class StageRunner:
         for chunk in self.mailbox.stream(recv.from_stage, stage.stage_id,
                                          worker):
             buf.append(chunk)
+            self._sstat(stage.stage_id)["rows_in"] += block_len(chunk)
             buf_rows += block_len(chunk)
             if buf_rows >= self.STREAM_COLLAPSE_ROWS:
                 buf = [collapse()]
