@@ -27,7 +27,7 @@ from ..spi.data_types import Schema
 from .aggregation import UnsupportedQueryError, semantics_for
 from .combine import (combine_aggregation, combine_group_by,
                       combine_selection, trim_group_by)
-from ..ops.kernels import PackedOuts, fetch_packed_batch
+from ..ops.kernels import PackedOuts, fetch_packed_batch, unpack_outputs
 from .executor import TpuSegmentExecutor
 from .host_executor import HostSegmentExecutor
 from .oom import with_oom_retry
@@ -215,6 +215,85 @@ class QueryExecutor:
             TRACING.end_trace()
             resp.trace_info = trace.to_json()
         return resp
+
+    def execute_selection_columnar(self, query: QueryContext):
+        """Columnar leaf for MSE scan+filter stages: device filter mask →
+        numpy column gather, skipping SelectionIntermediate's Python row
+        materialization and the broker's row→column round trip. Returns
+        (source-column arrays, stats) or None when the shape or backend
+        doesn't qualify — the caller falls back to the row path, which owns
+        ordering, deadlines, tracing and null handling."""
+        import numpy as np
+
+        if self.backend == "host":
+            return None
+        if (not query.is_selection or query.distinct
+                or query.group_by_expressions or query.order_by_expressions
+                or query.having_filter is not None or query.offset
+                or query.null_handling
+                or query.query_options.get("timeoutMs") is not None
+                or query.query_options.get("trace") in (True, "true", 1)):
+            return None
+        if not query.select_expressions or not all(
+                e.is_identifier and e.identifier != "*"
+                for e in query.select_expressions):
+            return None
+        table = self.tables.get(query.table_name)
+        if table is None:
+            table = self.tables.get(query.table_name.rsplit("_", 1)[0])
+        if table is None:
+            return None
+        segments = list(table.segments)
+        if any(getattr(s, "is_mutable", False) for s in segments):
+            return None
+        from ..query.optimizer import optimize_filter
+        from ..segment.bitpack import unpack_bitmap
+
+        names = [e.identifier for e in query.select_expressions]
+        try:
+            query.filter = optimize_filter(query.filter)
+            kept, _ = self.pruner.prune(query, segments)
+            pending = []
+            for seg in kept:
+                plan = self.tpu.plan(query, seg)
+                if plan.program.mode != "selection" or plan.selection_exprs:
+                    return None
+                outs = with_oom_retry(
+                    lambda: self.tpu.dispatch_plan(seg, plan),
+                    keep_segment=seg, cache=self.tpu.cache)
+                pending.append((seg, outs))
+            parts: dict[str, list] = {c: [] for c in names}
+            scanned = 0
+            remaining = max(0, int(query.limit))
+            for seg, outs in pending:
+                if remaining <= 0:
+                    break
+                mats = unpack_outputs(outs) if isinstance(outs, PackedOuts) \
+                    else [np.asarray(o) for o in outs]
+                bits = unpack_bitmap(np.asarray(mats[0]), seg.num_docs)
+                doc_ids = np.nonzero(bits)[0]
+                if len(doc_ids) > remaining:
+                    doc_ids = doc_ids[:remaining]
+                scanned += len(doc_ids)
+                remaining -= len(doc_ids)
+                for c in names:
+                    parts[c].append(np.asarray(seg.get_values(c))[doc_ids])
+        except Exception:
+            # any planning/device hiccup: the row path re-runs the leaf
+            # with identical semantics (and surfaces real failures)
+            return None
+        cols: dict = {}
+        for c, ps in parts.items():
+            if not ps:
+                cols[c] = np.empty(0)
+            elif len(ps) == 1:
+                cols[c] = ps[0]
+            else:
+                if any(p.dtype.kind == "O" for p in ps):
+                    ps = [p.astype(object) for p in ps]
+                cols[c] = np.concatenate(ps)
+        return cols, {"num_docs_scanned": scanned,
+                      "total_docs": sum(s.num_docs for s in segments)}
 
     def execute_segments(self, query: QueryContext, segments: list, tracker=None):
         """Server-side half of a query: prune → per-segment execute →

@@ -98,6 +98,44 @@ def device_join_indices(lcodes: np.ndarray, rcodes: np.ndarray,
     return np.asarray(li)[:n], np.asarray(ri)[:n], total
 
 
+@functools.cache
+def _jit_gather_kernel():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    def _gather(idx, cols):
+        import jax.numpy as jnp
+
+        return [jnp.take(c, idx, mode="clip") for c in cols]
+
+    return jax.jit(_gather)
+
+
+def gather_payload(cols: dict, idx: np.ndarray):
+    """Fused payload gather: materialize every pruned output column of a
+    device-joined side in ONE device dispatch (XLA fuses the per-column
+    takes) instead of one host fancy-index per column. Inputs are padded to
+    power-of-2 buckets so compiled programs are shared across row counts.
+    Returns None (caller falls back to the host gather) on any failure."""
+    if _FAILED or not cols:
+        return None
+    try:
+        n = len(idx)
+        pidx = np.zeros(_bucket(max(n, 1)), dtype=np.int64)
+        pidx[:n] = idx
+        padded = []
+        for v in cols.values():
+            pv = np.zeros(_bucket(max(len(v), 1)), dtype=v.dtype)
+            pv[:len(v)] = v
+            padded.append(pv)
+        out = _jit_gather_kernel()(pidx, padded)
+        return {name: np.asarray(o)[:n] for name, o in zip(cols, out)}
+    except Exception as e:
+        note_failure(e)
+        return None
+
+
 _FAILED = False
 
 

@@ -463,13 +463,15 @@ class MseWorkerService:
             block = runner._exec(stage.root, stage, worker)
         sstat["workers"] = 1  # this worker's share; the dispatcher sums
         sstat["rows_out"] += block_len(block)
-        mailbox.send_partitioned(stage.stage_id, stage.parent_stage, block,
+        mailbox.send_partitioned(stage.stage_id, stage.parent_stage,
+                                 runner._trim_to_send(stage, block),
                                  stage.send_dist, stage.send_keys,
                                  parent_workers, pfunc=stage.send_pfunc)
         sstat["wall_ms"] += (time.perf_counter() - t0) * 1000
         sstat["shuffled_rows"] = mailbox.sent_rows[stage.stage_id]
         sstat["shuffled_bytes"] = mailbox.sent_bytes[stage.stage_id]
-        runner.stats["join_overflow"] = pop_join_overflow()
+        runner.stats["join_overflow"] = (
+            pop_join_overflow() or bool(runner.stats.get("join_overflow")))
         runner.stats["first_send_ts"] = mailbox.first_send_ts
         runner.stats["last_send_ts"] = mailbox.last_send_ts
         runner.stats["stage_stats"] = {

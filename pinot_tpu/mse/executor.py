@@ -109,9 +109,11 @@ class MultistageExecutor:
             from .operators import pop_join_overflow
 
             pop_join_overflow()  # clear any stale flag on this thread
-            runner = StageRunner(stages, self.parallelism,
-                                 self.qe.execute, self._read_table,
-                                 query_options=query.options)
+            runner = StageRunner(
+                stages, self.parallelism, self.qe.execute, self._read_table,
+                query_options=query.options,
+                execute_columnar=getattr(self.qe, "execute_selection_columnar",
+                                         None))
             block = runner.run()
             if query.explain == "implementation":
                 # the query RAN; the plan text carries each stage's
@@ -128,7 +130,8 @@ class MultistageExecutor:
                 result_table=result,
                 num_docs_scanned=runner.stats["num_docs_scanned"],
                 total_docs=runner.stats["total_docs"],
-                partial_result=pop_join_overflow(),
+                partial_result=pop_join_overflow()
+                or bool(runner.stats.get("join_overflow")),
                 num_groups_limit_reached=runner.stats.get(
                     "num_groups_limit_reached", False),
                 mse_stage_stats=runner.stage_stats,

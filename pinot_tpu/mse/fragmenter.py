@@ -42,6 +42,10 @@ class Stage:
     # partitioned send only; the fan-out COUNT comes from the receive side
     # (MailboxReceiveNode.n_partitions → parent worker count)
     send_pfunc: Optional[str] = None
+    # the exchange's (pruned) schema: the stage's output block is trimmed
+    # to exactly these columns before it enters the mailbox. None (old
+    # serialized plans) means "ship whatever the root produced".
+    send_schema: Optional[list[str]] = None
 
     @property
     def is_leaf(self) -> bool:
@@ -85,7 +89,8 @@ def fragment(root: ExchangeNode) -> list[Stage]:
         sid = len(stages)
         stage = Stage(sid, None, send_dist=exchange.dist,
                       send_keys=list(exchange.keys), parent_stage=parent_id,
-                      send_pfunc=exchange.pfunc)
+                      send_pfunc=exchange.pfunc,
+                      send_schema=list(exchange.schema))
         stages.append(stage)
         stage.root = rewrite(exchange.inputs[0], sid)
         return sid

@@ -830,10 +830,17 @@ def _expr_name(resolved: EC, raw: EC) -> str:
 
 
 def prune_columns(node: PlanNode, required: Optional[set[str]] = None) -> PlanNode:
-    """Trim TableScan outputs to columns actually consumed upstream
-    (reference: Calcite's ProjectPushDown / field trimming). Mutates scans
-    in place; other nodes keep their schemas (they already only carry what
-    the planner resolved)."""
+    """Trim the plan to columns actually consumed upstream (reference:
+    Calcite's ProjectPushDown / field trimming). Three cuts, all in place:
+
+    - TableScan outputs narrow to referenced columns (as before);
+    - ExchangeNode schemas narrow to what the consuming stage references
+      (plus routing keys) — the fragmenter turns these into each stage's
+      send schema, so only referenced columns are shuffled. A column a
+      pushed-down filter consumes at the leaf no longer crosses the wire;
+    - JoinNode schemas narrow to what the parent references — the join's
+      late-materialized gather then touches only those payload columns.
+    """
     if required is None:
         required = set(node.schema)
 
@@ -879,6 +886,24 @@ def prune_columns(node: PlanNode, required: Optional[set[str]] = None) -> PlanNo
                 n.schema = [n.schema[i] for i in keep]
             return
         refs = node_refs(n)
+        if isinstance(n, ExchangeNode):
+            # narrow the shuffle schema: only columns the consuming stage
+            # references (plus the routing keys) cross the mailbox. Keep at
+            # least one column so row counts survive (COUNT(*) shapes).
+            keep = [c for c in n.schema if c in req or c in n.keys]
+            n.schema = keep if keep else n.schema[:1]
+            visit(n.inputs[0], set(n.schema))
+            return
+        if isinstance(n, JoinNode):
+            # narrow the join OUTPUT: the late-materialized gather in
+            # op_join only touches these columns. Keys/residual columns
+            # still flow to the children via refs.
+            keep = [c for c in n.schema if c in req]
+            n.schema = keep if keep else n.schema[:1]
+            child_req = set(n.schema) | refs
+            for inp in n.inputs:
+                visit(inp, child_req)
+            return
         if isinstance(n, (ProjectNode, AggregateNode, WindowNode)):
             child_req = refs if not isinstance(n, WindowNode) else refs | {
                 c for c in n.inputs[0].schema if c in req}

@@ -411,9 +411,61 @@ def _guard_join_rows(total: int, ln: int, rn: int,
            "; set PINOT_TPU_JOIN_OVERFLOW_MODE=BREAK to truncate instead"))
 
 
+class JoinCtx:
+    """Per-query join state shared by every partition (and worker thread)
+    of a join stage: persistent value→code maps keyed by (stage, key
+    position) so a second partition factorizes only values it has not seen,
+    plus counters for the perf plane (int fast-path, cache reuse)."""
+
+    def __init__(self):
+        self.counters: Counter = Counter()
+        self._maps: dict = {}
+        self.lock = threading.RLock()
+
+    def for_stage(self, stage_id: int) -> "_StageJoinCtx":
+        return _StageJoinCtx(self, stage_id)
+
+    def mapping(self, stage_id: int, pos: int) -> dict:
+        with self.lock:
+            return self._maps.setdefault((stage_id, pos), {})
+
+    def bump(self, name: str) -> None:
+        with self.lock:
+            self.counters[name] += 1
+
+
+class _StageJoinCtx:
+    """JoinCtx view bound to one stage id (what op_join receives)."""
+
+    __slots__ = ("_ctx", "_stage")
+
+    def __init__(self, ctx: JoinCtx, stage_id: int):
+        self._ctx = ctx
+        self._stage = stage_id
+
+    @property
+    def lock(self):
+        return self._ctx.lock
+
+    @property
+    def counters(self) -> Counter:
+        return self._ctx.counters
+
+    def mapping(self, pos: int) -> dict:
+        return self._ctx.mapping(self._stage, pos)
+
+    def bump(self, name: str) -> None:
+        self._ctx.bump(name)
+
+
 def op_join(left: Block, right: Block, join_type: str,
             left_keys: list[str], right_keys: list[str],
-            residual: Optional[EC], schema: list[str]) -> Block:
+            residual: Optional[EC], schema: list[str],
+            ctx=None) -> Block:
+    """Late-materialized hash join: match on key codes, thread (lidx, ridx)
+    index pairs through residual/SEMI/ANTI/padding, and gather ONLY the
+    columns the output schema demands at the very end. An empty schema means
+    "emit everything" (back-compat for direct callers)."""
     ln = block_len(left)
     rn = block_len(right)
 
@@ -429,23 +481,24 @@ def op_join(left: Block, right: Block, join_type: str,
             right = take_block(right, np.arange(rn))
         lidx = np.repeat(np.arange(ln), rn)
         ridx = np.tile(np.arange(rn), ln)
-        combined = _combine(left, right, lidx, ridx)
-        if residual is not None:
-            m = _truthy(eval_expr(residual, combined, len(lidx)), len(lidx))
-            combined, lidx = take_block(combined, m), lidx[m]
+        if residual is not None and len(lidx):
+            rb = _residual_block(left, right, lidx, ridx, residual)
+            m = _truthy(eval_expr(residual, rb, len(lidx)), len(lidx))
+            lidx, ridx = lidx[m], ridx[m]
         if join_type in ("SEMI", "ANTI"):
             sel = np.unique(lidx)
             if join_type == "ANTI":
                 sel = np.setdiff1d(np.arange(ln), sel)
-            return take_block(left, sel)
-        return combined
+            return _project_side(left, schema, sel)
+        return _emit(left, right, lidx, ridx, schema)
 
     # dict-encode key tuples across both sides so codes are comparable
     lcodes, rcodes = _joint_codes(
         [np.asarray(left[k]) for k in left_keys],
-        [np.asarray(right[k]) for k in right_keys], ln, rn)
+        [np.asarray(right[k]) for k in right_keys], ln, rn, ctx)
 
     lidx = ridx = None
+    device_used = False
     from . import device_join
 
     if device_join.enabled(ln, rn):
@@ -459,6 +512,7 @@ def op_join(left: Block, right: Block, join_type: str,
             if total <= MAX_ROWS_IN_JOIN:
                 lidx = li.astype(np.int64)
                 ridx = ri.astype(np.int64)
+                device_used = True
         except Exception as e:
             device_join.note_failure(e)  # logged once, then host path
             lidx = ridx = None
@@ -483,15 +537,18 @@ def op_join(left: Block, right: Block, join_type: str,
         offs = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
         ridx = rs[np.repeat(starts, counts) + offs]
 
-    if residual is not None and total:
-        combined = _combine(left, right, lidx, ridx)
-        m = _truthy(eval_expr(residual, combined, total), total)
+    if residual is not None and len(lidx):
+        # evaluate over a gather of ONLY the residual's columns, not the
+        # whole combined row set
+        rb = _residual_block(left, right, lidx, ridx, residual)
+        m = _truthy(eval_expr(residual, rb, len(lidx)), len(lidx))
         lidx, ridx = lidx[m], ridx[m]
 
     if join_type == "SEMI":
-        return take_block(left, np.unique(lidx))
+        return _project_side(left, schema, np.unique(lidx))
     if join_type == "ANTI":
-        return take_block(left, np.setdiff1d(np.arange(ln), np.unique(lidx)))
+        return _project_side(left, schema,
+                             np.setdiff1d(np.arange(ln), np.unique(lidx)))
 
     if join_type in ("LEFT", "FULL"):
         matched_l = np.zeros(ln, dtype=bool)
@@ -499,6 +556,7 @@ def op_join(left: Block, right: Block, join_type: str,
         extra_l = np.nonzero(~matched_l)[0]
         lidx = np.concatenate([lidx, extra_l])
         ridx = np.concatenate([ridx, np.full(len(extra_l), -1, dtype=np.int64)])
+        device_used = False
     if join_type in ("RIGHT", "FULL"):
         matched_r = np.zeros(rn, dtype=bool)
         if len(ridx):
@@ -506,32 +564,174 @@ def op_join(left: Block, right: Block, join_type: str,
         extra_r = np.nonzero(~matched_r)[0]
         lidx = np.concatenate([lidx, np.full(len(extra_r), -1, dtype=np.int64)])
         ridx = np.concatenate([ridx, extra_r])
+        device_used = False
 
-    return _combine(left, right, lidx, ridx)
+    return _emit(left, right, lidx, ridx, schema, device_used)
 
 
-def _joint_codes(lcols, rcols, ln, rn):
+def _expr_ids(e: EC, out: set) -> None:
+    if e.is_identifier:
+        out.add(e.identifier)
+    elif e.is_function:
+        for a in e.function.arguments:
+            _expr_ids(a, out)
+
+
+def _residual_block(left: Block, right: Block, lidx: np.ndarray,
+                    ridx: np.ndarray, residual: EC) -> Block:
+    """Gather only the columns the residual filter references (qualified or
+    suffix-matchable), mirroring _combine's dup naming so eval_expr resolves
+    identifiers identically to the old full-row path."""
+    ids: set = set()
+    _expr_ids(residual, ids)
+
+    def want(c: str) -> bool:
+        return c in ids or any(c.endswith("." + i) for i in ids)
+
+    out: Block = {}
+    for c, v in left.items():
+        if want(c):
+            out[c] = _gather_pad(np.asarray(v), lidx)
+    for c, v in right.items():
+        if not want(c):
+            continue
+        name = c if c not in out else c + "0"
+        out[name] = _gather_pad(np.asarray(v), ridx)
+    return out
+
+
+def _project_side(side: Block, schema: list[str], sel: np.ndarray) -> Block:
+    """SEMI/ANTI output: rows of one side, trimmed to the columns the
+    output schema still needs."""
+    proj = {c: side[c] for c in schema if c in side}
+    return take_block(proj if proj else side, sel)
+
+
+def _emit(left: Block, right: Block, lidx: np.ndarray, ridx: np.ndarray,
+          schema: list[str], device_used: bool = False) -> Block:
+    """The deferred gather: materialize exactly the schema's columns from
+    the surviving index pairs. Right-side columns may appear under their
+    own name or _combine's dup suffix (c+"0")."""
+    if not schema:
+        return _combine(left, right, lidx, ridx)
+    plan: list[tuple] = []
+    for name in schema:
+        if name in left:
+            plan.append((name, True, np.asarray(left[name])))
+        elif name in right:
+            plan.append((name, False, np.asarray(right[name])))
+        elif name.endswith("0") and name[:-1] in right:
+            plan.append((name, False, np.asarray(right[name[:-1]])))
+        else:
+            raise UnsupportedQueryError(
+                f"join schema column {name!r} missing from inputs")
+    out: Block = {}
+    for is_left, idx in ((True, lidx), (False, ridx)):
+        cols = {nm: a for nm, s, a in plan if s is is_left}
+        if not cols:
+            continue
+        got = None
+        if (device_used and len(cols) > 1 and len(idx)
+                and all(a.dtype.kind in "iufb" for a in cols.values())
+                and int(idx.min()) >= 0):
+            from . import device_join
+            got = device_join.gather_payload(cols, idx)
+        if got is None:
+            got = {nm: _gather_pad(a, idx) for nm, a in cols.items()}
+        out.update(got)
+    return {nm: out[nm] for nm, _, _ in plan}
+
+
+def _int_like(c: np.ndarray) -> bool:
+    # uint64 is excluded: viewing it as int64 would alias large values onto
+    # real negatives from the other side
+    return c.dtype.kind in "ib" or (c.dtype.kind == "u" and c.dtype.itemsize < 8)
+
+
+_FALLBACK_LOCK = threading.RLock()  # string-code path without a JoinCtx
+
+
+def _joint_codes(lcols, rcols, ln, rn, ctx=None):
+    if len(lcols) == 1:
+        lc, rc = lcols[0], rcols[0]
+        if _int_like(lc) and _int_like(rc):
+            # already-integer keys ARE their own codes (q8's lo_orderkey):
+            # skip factorization entirely. Int columns cannot hold SQL
+            # NULL, so no sentinel handling is needed here.
+            if ctx is not None:
+                ctx.bump("joint_codes_int_fastpath")
+            return lc.astype(np.int64), rc.astype(np.int64)
+        il, ir, _ = _column_codes(lc, rc, ln, ctx, 0)
+        return il, ir
     codes_l = np.zeros(ln, dtype=np.int64)
     codes_r = np.zeros(rn, dtype=np.int64)
-    for lc, rc in zip(lcols, rcols):
-        both = np.concatenate([_unify(lc), _unify(rc)])
-        _, inv = np.unique(both, return_inverse=True)
-        il, ir = inv[:ln], inv[ln:]
-        m = np.int64(inv.max(initial=0) + 1)
-        combined_l = codes_l * m + il
-        combined_r = codes_r * m + ir
+    for pos, (lc, rc) in enumerate(zip(lcols, rcols)):
+        il, ir, m = _column_codes(lc, rc, ln, ctx, pos)
+        mm = np.int64(max(m, 1))
+        combined_l = codes_l * mm + il
+        combined_r = codes_r * mm + ir
         _, inv2 = np.unique(np.concatenate([combined_l, combined_r]),
                             return_inverse=True)
         codes_l, codes_r = inv2[:ln].astype(np.int64), inv2[ln:].astype(np.int64)
     return codes_l, codes_r
 
 
-def _unify(c: np.ndarray) -> np.ndarray:
-    if c.dtype.kind in "iub":
-        return c.astype(np.int64)
-    if c.dtype.kind == "f":
-        return c.astype(np.float64)
-    return c.astype(object).astype(str)
+def _column_codes(lc: np.ndarray, rc: np.ndarray, ln: int, ctx, pos: int):
+    """Per-column join codes: int64 arrays in [0, m) where equal non-NULL
+    values share a code and NULL keys never match across sides (left NULLs
+    take code m-2, right NULLs m-1). Returns (lcodes, rcodes, m)."""
+    if _int_like(lc) and _int_like(rc):
+        both = np.concatenate([lc.astype(np.int64), rc.astype(np.int64)])
+        _, inv = np.unique(both, return_inverse=True)
+        m = int(inv.max(initial=-1)) + 1
+        return (inv[:ln].astype(np.int64), inv[ln:].astype(np.int64), m)
+    if lc.dtype.kind in "iufb" and rc.dtype.kind in "iufb":
+        l64 = lc.astype(np.float64)
+        r64 = rc.astype(np.float64)
+        nl, nr = np.isnan(l64), np.isnan(r64)
+        both = np.concatenate([np.where(nl, 0.0, l64), np.where(nr, 0.0, r64)])
+        _, inv = np.unique(both, return_inverse=True)
+        m = int(inv.max(initial=-1)) + 1
+        il = inv[:ln].astype(np.int64)
+        ir = inv[ln:].astype(np.int64)
+        il[nl] = m      # NaN is SQL NULL: never equal, not even to itself
+        ir[nr] = m + 1
+        return il, ir, m + 2
+    # string/object path: persistent value→code map (JoinCtx) so a second
+    # partition of the same stage reuses codes instead of re-factorizing
+    lock = ctx.lock if ctx is not None else _FALLBACK_LOCK
+    with lock:
+        mp = ctx.mapping(pos) if ctx is not None else {}
+        if ctx is not None and mp:
+            ctx.bump("joint_codes_cache_hits")
+        nl = _null_mask(lc) if lc.dtype.kind == "O" else \
+            np.zeros(len(lc), dtype=bool)
+        nr = _null_mask(rc) if rc.dtype.kind == "O" else \
+            np.zeros(len(rc), dtype=bool)
+        il = _mapped_codes(np.where(nl, "", lc) if nl.any() else lc, mp)
+        ir = _mapped_codes(np.where(nr, "", rc) if nr.any() else rc, mp)
+        m = len(mp)
+    il[nl] = m
+    ir[nr] = m + 1
+    return il, ir, m + 2
+
+
+def _mapped_codes(arr: np.ndarray, mp: dict) -> np.ndarray:
+    """Dense codes from a persistent value→code dict; values normalize
+    through str() (matching the old astype(str) factorization, where 1 and
+    "1" joined). Caller holds the map's lock."""
+    get = mp.get
+
+    def code(x):
+        if type(x) is not str:
+            x = str(x)
+        c = get(x)
+        if c is None:
+            c = mp[x] = len(mp)
+        return c
+
+    return np.fromiter((code(x) for x in arr), dtype=np.int64,
+                       count=len(arr))
 
 
 def _combine(left: Block, right: Block, lidx: np.ndarray, ridx: np.ndarray) -> Block:

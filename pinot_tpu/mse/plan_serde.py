@@ -199,7 +199,8 @@ def stage_to_json(stage: Stage) -> dict:
             "send_keys": list(stage.send_keys),
             "parent_stage": stage.parent_stage,
             "child_stages": list(stage.child_stages),
-            "send_pfunc": stage.send_pfunc}
+            "send_pfunc": stage.send_pfunc,
+            "send_schema": stage.send_schema}
 
 
 def stage_from_json(d: dict) -> Stage:
@@ -208,4 +209,5 @@ def stage_from_json(d: dict) -> Stage:
     return Stage(d["stage_id"], node_from_json(d["root"]), d["send_dist"],
                  list(d["send_keys"]), d["parent_stage"],
                  list(d["child_stages"]),
-                 send_pfunc=d.get("send_pfunc"))
+                 send_pfunc=d.get("send_pfunc"),
+                 send_schema=d.get("send_schema"))

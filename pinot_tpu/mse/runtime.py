@@ -14,6 +14,9 @@ segment combine); only stages above the first exchange run host-side.
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 import numpy as np
@@ -36,6 +39,7 @@ from .logical import (
 )
 from .mailbox import Block, MailboxService, block_len, concat_blocks
 from .operators import (
+    JoinCtx,
     op_aggregate,
     op_filter,
     op_join,
@@ -43,11 +47,23 @@ from .operators import (
     op_setop,
     op_sort,
     op_window,
+    pop_join_overflow,
 )
 
 EC = ExpressionContext
 
 _LEAF_LIMIT = 1_000_000_000  # effectively unlimited (leaf results feed merges)
+
+
+def _mse_threads() -> int:
+    """Worker threads available to one stage's partitions. NumPy releases
+    the GIL on the hot kernels (argsort/unique/gather), so partition-level
+    threading pays off even on CPython."""
+    try:
+        return max(1, int(os.environ.get("PINOT_TPU_MSE_THREADS",
+                                         os.cpu_count() or 1)))
+    except ValueError:
+        return 1
 
 
 class LeafError(Exception):
@@ -64,11 +80,19 @@ class StageRunner:
 
     def __init__(self, stages: list[Stage], parallelism: int,
                  execute_query: Callable, read_table: Callable,
-                 query_options: Optional[dict] = None):
+                 query_options: Optional[dict] = None,
+                 execute_columnar: Optional[Callable] = None):
         self.stages = stages
         self.parallelism = max(1, parallelism)
         self.execute_query = execute_query
         self.read_table = read_table
+        # optional columnar leaf entry (QueryContext → (block, stats) or
+        # None): a selection leaf that skips Python row materialization
+        self.execute_columnar = execute_columnar
+        # per-(stage, key-columns) joint-code cache + counters, shared by
+        # every partition of a join stage (operators.JoinCtx)
+        self._join_ctx = JoinCtx()
+        self._overflow_lock = threading.Lock()
         # SET options from the MSE statement, forwarded into leaf SSQE
         # pushdowns (enableNullHandling / numGroupsLimit / timeoutMs act at
         # the single-stage engine)
@@ -112,9 +136,28 @@ class StageRunner:
             if stage.stage_id == 0:
                 continue
             self._run_stage(stage)
+        self.stats["join_ctx"] = dict(self._join_ctx.counters)
         broker = self.stages[0]
         return self.mailbox.receive(broker.child_stages[0], 0, 0,
                                     broker.root.schema)
+
+    def _trim_to_send(self, stage: Stage, block: Block) -> Block:
+        """Drop columns the consuming stage never references (the pruned
+        exchange schema) — e.g. a filter column a leaf consumed locally."""
+        ss = stage.send_schema
+        if ss is None or set(ss) >= set(block.keys()):
+            return block
+        return {c: block[c] for c in ss if c in block}
+
+    def _worker_block(self, stage: Stage, w: int) -> Block:
+        """One partition worker: execute the stage tree and capture the
+        thread-local BREAK-overflow flag before leaving the thread (a
+        pooled worker's flag would otherwise be stranded in the pool)."""
+        block = self._exec(stage.root, stage, w)
+        if pop_join_overflow():
+            with self._overflow_lock:
+                self.stats["join_overflow"] = True
+        return block
 
     def _run_stage(self, stage: Stage) -> None:
         import time
@@ -137,20 +180,28 @@ class StageRunner:
             self.stats["leaf_ssqe_pushdowns"] += 1
             st["workers"] = 1
             st["leaf_pushdown"] = True
-            st["rows_out"] += block_len(pushed)
-            self.mailbox.send_partitioned(
-                stage.stage_id, parent.stage_id, pushed,
-                stage.send_dist, stage.send_keys, parent_workers,
-                pfunc=stage.send_pfunc)
+            blocks = [pushed]
         else:
             st["workers"] = self.workers_of(stage)
-            for w in range(st["workers"]):
-                block = self._exec(stage.root, stage, w)
-                st["rows_out"] += block_len(block)
-                self.mailbox.send_partitioned(
-                    stage.stage_id, parent.stage_id, block,
-                    stage.send_dist, stage.send_keys, parent_workers,
-                    pfunc=stage.send_pfunc)
+            pool_size = min(st["workers"], _mse_threads())
+            if pool_size > 1:
+                # independent partitions of the stage execute concurrently;
+                # sends stay in worker order below, so mailbox contents are
+                # deterministic regardless of completion order
+                with ThreadPoolExecutor(max_workers=pool_size) as pool:
+                    futs = [pool.submit(self._worker_block, stage, w)
+                            for w in range(st["workers"])]
+                    blocks = [f.result() for f in futs]
+            else:
+                blocks = [self._worker_block(stage, w)
+                          for w in range(st["workers"])]
+        for block in blocks:
+            st["rows_out"] += block_len(block)
+            self.mailbox.send_partitioned(
+                stage.stage_id, parent.stage_id,
+                self._trim_to_send(stage, block),
+                stage.send_dist, stage.send_keys, parent_workers,
+                pfunc=stage.send_pfunc)
         st["wall_ms"] += (time.perf_counter() - t0) * 1000
         st["shuffled_rows"] = self.mailbox.sent_rows[stage.stage_id]
         st["shuffled_bytes"] = self.mailbox.sent_bytes[stage.stage_id]
@@ -178,7 +229,8 @@ class StageRunner:
             left = self._exec(node.inputs[0], stage, worker)
             right = self._exec(node.inputs[1], stage, worker)
             return op_join(left, right, node.join_type, node.left_keys,
-                           node.right_keys, node.residual, node.schema)
+                           node.right_keys, node.residual, node.schema,
+                           ctx=self._join_ctx.for_stage(stage.stage_id))
         if isinstance(node, WindowNode):
             return op_window(self._exec(node.inputs[0], stage, worker),
                              node.calls, node.schema)
@@ -263,14 +315,29 @@ class StageRunner:
                 fctx = filter_from_expression(_unqualify(cond, unq))
 
             if agg is None:
-                # plain scan+filter: ship projected rows via SSQE selection
-                select = [EC.for_identifier(unq[c]) for c in scan.schema]
+                # plain scan+filter leaf: the filter is pushed into the
+                # QueryContext, so only the columns the exchange actually
+                # ships (send_schema) need to be selected — consumed
+                # filter columns stay on the server
+                names = [c for c in (stage.send_schema or list(scan.schema))
+                         if c in unq] or list(scan.schema)
+                select = [EC.for_identifier(unq[c]) for c in names]
                 qc = QueryContext(
                     table_name=scan.table, select_expressions=select,
                     aliases=[None] * len(select), filter=fctx, limit=_LEAF_LIMIT,
-                    query_options=dict(self.query_options))
-                resp = self.execute_query(qc.finish())
-                return self._resp_to_block(resp, list(scan.schema))
+                    query_options=dict(self.query_options)).finish()
+                if self.execute_columnar is not None:
+                    got = self.execute_columnar(qc)
+                    if got is not None:
+                        cols, cstats = got
+                        self.stats["num_docs_scanned"] += \
+                            cstats.get("num_docs_scanned", 0)
+                        self.stats["total_docs"] += cstats.get("total_docs", 0)
+                        self.stats["leaf_columnar"] = \
+                            self.stats.get("leaf_columnar", 0) + 1
+                        return {q: cols[unq[q]] for q in names}
+                resp = self.execute_query(qc)
+                return self._resp_to_block(resp, names)
 
             select: list[EC] = []
             for g in agg.group_exprs:
