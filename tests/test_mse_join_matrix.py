@@ -1,0 +1,165 @@
+"""op_join semantics matrix vs a sqlite oracle.
+
+Covers INNER/LEFT/RIGHT/FULL/SEMI/ANTI × NULL join keys × residual (ON
+conjunct) filters, plus the THROW/BREAK overflow guard and the
+device-join-failure → host fallback. The oracle runs the same rows through
+sqlite (RIGHT emulated as a swapped LEFT, FULL as LEFT ∪ right-anti, since
+the baked-in sqlite predates native RIGHT/FULL support).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.mse import operators as ops
+from pinot_tpu.mse.mailbox import block_len
+from pinot_tpu.mse.operators import op_join, pop_join_overflow
+from pinot_tpu.query.expressions import ExpressionContext as EC
+
+SCHEMA = ["k", "v", "k2", "w"]
+RESIDUAL = EC.for_function("lessthan", EC.for_identifier("v"),
+                           EC.for_identifier("w"))
+
+
+def _blocks(null_mode: str):
+    """(left, right, lrows, rrows): numpy blocks plus python row tuples for
+    the oracle. null_mode: "none" | "object" (None keys) | "float" (NaN)."""
+    rng = np.random.default_rng(7)
+    ln, rn = 83, 67
+    lk = rng.integers(0, 12, ln)
+    rk = rng.integers(0, 12, rn)
+    lv = rng.integers(0, 50, ln).astype(np.int64)
+    rw = rng.integers(0, 50, rn).astype(np.int64)
+    if null_mode == "none":
+        left = {"k": lk.astype(np.int64), "v": lv}
+        right = {"k2": rk.astype(np.int64), "w": rw}
+        lkeys = [int(x) for x in lk]
+        rkeys = [int(x) for x in rk]
+    elif null_mode == "object":
+        lkeys = [None if i % 7 == 0 else int(x) for i, x in enumerate(lk)]
+        rkeys = [None if i % 5 == 0 else int(x) for i, x in enumerate(rk)]
+        left = {"k": np.asarray(lkeys, dtype=object), "v": lv}
+        right = {"k2": np.asarray(rkeys, dtype=object), "w": rw}
+    else:  # float NaN keys
+        lkeys = [None if i % 7 == 0 else int(x) for i, x in enumerate(lk)]
+        rkeys = [None if i % 5 == 0 else int(x) for i, x in enumerate(rk)]
+        left = {"k": np.asarray([np.nan if x is None else float(x)
+                                 for x in lkeys]), "v": lv}
+        right = {"k2": np.asarray([np.nan if x is None else float(x)
+                                   for x in rkeys]), "w": rw}
+    lrows = [(lkeys[i], int(lv[i])) for i in range(ln)]
+    rrows = [(rkeys[i], int(rw[i])) for i in range(rn)]
+    return left, right, lrows, rrows
+
+
+def _oracle(lrows, rrows, join_type: str, residual: bool):
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE L (k INT, v INT)")
+    conn.execute("CREATE TABLE R (k2 INT, w INT)")
+    conn.executemany("INSERT INTO L VALUES (?,?)", lrows)
+    conn.executemany("INSERT INTO R VALUES (?,?)", rrows)
+    on = "L.k = R.k2" + (" AND L.v < R.w" if residual else "")
+    corr = "R.k2 = L.k" + (" AND L.v < R.w" if residual else "")
+    if join_type == "INNER":
+        q = f"SELECT L.k, L.v, R.k2, R.w FROM L JOIN R ON {on}"
+    elif join_type == "LEFT":
+        q = f"SELECT L.k, L.v, R.k2, R.w FROM L LEFT JOIN R ON {on}"
+    elif join_type == "RIGHT":
+        q = f"SELECT L.k, L.v, R.k2, R.w FROM R LEFT JOIN L ON {on}"
+    elif join_type == "FULL":
+        q = (f"SELECT L.k, L.v, R.k2, R.w FROM L LEFT JOIN R ON {on} "
+             f"UNION ALL SELECT NULL, NULL, R.k2, R.w FROM R "
+             f"WHERE NOT EXISTS (SELECT 1 FROM L WHERE {corr})")
+    elif join_type == "SEMI":
+        q = (f"SELECT L.k, L.v FROM L "
+             f"WHERE EXISTS (SELECT 1 FROM R WHERE {corr})")
+    else:  # ANTI
+        q = (f"SELECT L.k, L.v FROM L "
+             f"WHERE NOT EXISTS (SELECT 1 FROM R WHERE {corr})")
+    rows = conn.execute(q).fetchall()
+    conn.close()
+    return _sorted(map(tuple, rows))
+
+
+def _norm(x):
+    if x is None:
+        return None
+    if isinstance(x, float):
+        if np.isnan(x):
+            return None
+        if x.is_integer():
+            return int(x)
+    if isinstance(x, np.generic):
+        return _norm(x.item())
+    return x
+
+
+def _sorted(rows):
+    return sorted(rows, key=lambda t: tuple((x is None, x if x is not None
+                                             else 0) for x in t))
+
+
+def _rowset(block, columns):
+    n = block_len(block)
+    cols = [np.asarray(block[c]) for c in columns]
+    return _sorted(tuple(_norm(c[i]) for c in cols) for i in range(n))
+
+
+@pytest.mark.parametrize("null_mode", ["none", "object", "float"])
+@pytest.mark.parametrize("residual", [False, True])
+@pytest.mark.parametrize("join_type",
+                         ["INNER", "LEFT", "RIGHT", "FULL", "SEMI", "ANTI"])
+def test_join_matrix_vs_sqlite(join_type, residual, null_mode):
+    left, right, lrows, rrows = _blocks(null_mode)
+    schema = ["k", "v"] if join_type in ("SEMI", "ANTI") else SCHEMA
+    out = op_join(dict(left), dict(right), join_type, ["k"], ["k2"],
+                  RESIDUAL if residual else None, list(schema))
+    assert _rowset(out, schema) == _oracle(lrows, rrows, join_type, residual)
+
+
+def test_overflow_throw_vs_break_matrix(monkeypatch):
+    left, right, _, _ = _blocks("none")
+    monkeypatch.setattr(ops, "MAX_ROWS_IN_JOIN", 50)
+
+    monkeypatch.setattr(ops, "JOIN_OVERFLOW_MODE", "THROW")
+    for jt in ("INNER", "LEFT", "RIGHT", "FULL", "ANTI"):
+        with pytest.raises(ops.JoinRowLimitExceeded):
+            op_join(dict(left), dict(right), jt, ["k"], ["k2"], None,
+                    list(SCHEMA))
+
+    monkeypatch.setattr(ops, "JOIN_OVERFLOW_MODE", "BREAK")
+    pop_join_overflow()
+    out = op_join(dict(left), dict(right), "INNER", ["k"], ["k2"], None,
+                  list(SCHEMA))
+    assert 0 < block_len(out) <= 50
+    assert pop_join_overflow() is True
+    # truncating ANTI/RIGHT/FULL inputs would emit WRONG rows, not a
+    # partial subset: they must still raise in BREAK mode
+    for jt in ("ANTI", "RIGHT", "FULL"):
+        with pytest.raises(ops.JoinRowLimitExceeded):
+            op_join(dict(left), dict(right), jt, ["k"], ["k2"], None,
+                    list(SCHEMA))
+    assert pop_join_overflow() is False
+
+
+def test_device_join_failure_falls_back_identical(monkeypatch):
+    from pinot_tpu.mse import device_join
+
+    left, right, lrows, rrows = _blocks("none")
+    monkeypatch.setattr(device_join, "_FAILED", False)
+    calls = {"n": 0}
+
+    def boom(lcodes, rcodes, max_out):
+        calls["n"] += 1
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(device_join, "device_join_indices", boom)
+    monkeypatch.setenv("PINOT_TPU_DEVICE_JOIN", "1")
+    out = op_join(dict(left), dict(right), "INNER", ["k"], ["k2"],
+                  RESIDUAL, list(SCHEMA))
+    assert calls["n"] == 1
+    assert device_join._FAILED  # disabled for the process after failure
+    assert _rowset(out, SCHEMA) == _oracle(lrows, rrows, "INNER", True)

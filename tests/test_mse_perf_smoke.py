@@ -1,0 +1,140 @@
+"""Structural perf guards for the MSE join pipeline (tier-1-safe, no
+wall-clock thresholds): the q8-shaped int-key join must take the
+joint-codes int fast-path, a partitioned string-key join must reuse the
+persistent factorization cache on its second partition, and the mailbox
+must carry only the pruned column set (bytes bounded by the pruned
+schema, columns exactly the exchange's send_schema)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.mse.mailbox import MailboxService
+from pinot_tpu.mse.runtime import StageRunner
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+
+@pytest.fixture(scope="module")
+def qe(tmp_path_factory):
+    d = tmp_path_factory.mktemp("msesmoke")
+    rng = np.random.default_rng(11)
+    n = 5000
+    cols = {
+        "lo_orderkey": rng.integers(0, 800, n).astype(np.int32),
+        "lo_quantity": rng.integers(1, 10, n).astype(np.int32),
+        "lo_discount": rng.integers(0, 4, n).astype(np.int32),
+        "lo_revenue": rng.integers(100, 9000, n).astype(np.int32),
+        "d_year": (1992 + rng.integers(0, 7, n)).astype(np.int32),
+        "p_brand": np.asarray([f"brand_{i}" for i in
+                               rng.integers(0, 40, n)], dtype=object),
+    }
+    schema = Schema.build(
+        "ssb",
+        dimensions=[("lo_orderkey", "INT"), ("lo_quantity", "INT"),
+                    ("lo_discount", "INT"), ("d_year", "INT"),
+                    ("p_brand", "STRING")],
+        metrics=[("lo_revenue", "INT")])
+    SegmentBuilder(schema, segment_name="s0").build(cols, d / "s0")
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [load_segment(d / "s0")])
+    return qe
+
+
+@pytest.fixture
+def captured_runner(monkeypatch):
+    captured = {}
+    orig = StageRunner.run
+
+    def run(self):
+        captured["runner"] = self
+        return orig(self)
+
+    monkeypatch.setattr(StageRunner, "run", run)
+    return captured
+
+
+Q8_SHAPED = (
+    "SET useMultistageEngine = true; "
+    "SELECT a.d_year, COUNT(*), SUM(b.lo_revenue) FROM ssb a "
+    "JOIN ssb b ON a.lo_orderkey = b.lo_orderkey "
+    "WHERE a.lo_quantity < 3 AND b.lo_discount = 0 "
+    "GROUP BY a.d_year ORDER BY a.d_year LIMIT 100")
+
+
+def test_int_key_join_takes_fastpath_and_prunes_shuffle(qe, captured_runner):
+    resp = qe.execute_sql(Q8_SHAPED)
+    assert not resp.exceptions, resp.exceptions
+    runner = captured_runner["runner"]
+
+    # (a) integer keys skip factorization entirely
+    assert runner.stats["join_ctx"].get("joint_codes_int_fastpath", 0) >= 1
+
+    # (b) each leaf ships exactly the pruned 2-column schema (key +
+    # payload), never the consumed filter column: bytes/row bounded by
+    # 2 × int64, not 3 ×
+    leaf_stats = [st for sid, st in runner.stage_stats.items()
+                  if runner.stages[sid].is_leaf]
+    assert leaf_stats, runner.stage_stats
+    for st in leaf_stats:
+        assert st["shuffled_rows"] > 0
+        assert st["shuffled_bytes"] <= st["shuffled_rows"] * 2 * 8
+    for stage in runner.stages:
+        if stage.is_leaf:
+            assert stage.send_schema is not None
+            assert len(stage.send_schema) == 2
+
+
+def test_string_key_join_reuses_code_cache(qe, captured_runner):
+    resp = qe.execute_sql(
+        "SET useMultistageEngine = true; "
+        "SELECT a.p_brand, COUNT(*) FROM ssb a "
+        "JOIN ssb b ON a.p_brand = b.p_brand "
+        "WHERE b.lo_discount = 0 GROUP BY a.p_brand LIMIT 10")
+    assert not resp.exceptions, resp.exceptions
+    runner = captured_runner["runner"]
+    # the hash-partitioned join stage runs ≥2 partitions; the second one
+    # must hit the persistent value→code map instead of re-factorizing
+    assert runner.stats["join_ctx"].get("joint_codes_cache_hits", 0) >= 1
+    assert runner.stats["join_ctx"].get("joint_codes_int_fastpath", 0) == 0
+
+
+def test_mailbox_receives_only_pruned_columns(qe, monkeypatch):
+    """Representative 2-stage join+agg plan: every block entering the
+    mailbox from a leaf stage carries exactly the exchange's pruned
+    send_schema — the filter columns were consumed server-side."""
+    sent: list[tuple[int, tuple]] = []
+    orig_send = MailboxService.send
+
+    def send(self, from_stage, to_stage, partition, block):
+        if block is not None:
+            sent.append((from_stage, tuple(sorted(block.keys()))))
+        return orig_send(self, from_stage, to_stage, partition, block)
+
+    monkeypatch.setattr(MailboxService, "send", send)
+    captured = {}
+    orig_run = StageRunner.run
+
+    def run(self):
+        captured["runner"] = self
+        return orig_run(self)
+
+    monkeypatch.setattr(StageRunner, "run", run)
+    resp = qe.execute_sql(Q8_SHAPED)
+    assert not resp.exceptions, resp.exceptions
+    runner = captured["runner"]
+    leaf_ids = {s.stage_id: set(s.send_schema) for s in runner.stages
+                if s.is_leaf}
+    saw = set()
+    for from_stage, colnames in sent:
+        if from_stage in leaf_ids:
+            saw.add(from_stage)
+            assert set(colnames) == leaf_ids[from_stage], (
+                from_stage, colnames, leaf_ids[from_stage])
+    assert saw == set(leaf_ids)
+    # and the pruned set excludes the consumed filter columns
+    for cols in leaf_ids.values():
+        assert not cols & {"a.lo_quantity", "b.lo_discount"}
