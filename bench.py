@@ -152,8 +152,14 @@ def _gen_ssb(rows: int, seed: int = 2024):
         "lo_quantity": rng.integers(1, 51, rows).astype(np.int32),
         "lo_extendedprice": rng.integers(1, 55_001, rows).astype(np.int32),
         "lo_revenue": rng.integers(1, 600_000, rows).astype(np.int32),
-        # high-card key for the sparse group-by config (~rows/10 distinct)
-        "lo_orderkey": rng.integers(0, max(1 << 22, rows // 10), rows).astype(np.int32),
+        # high-card key for the sparse group-by config (~rows/10 distinct),
+        # SORTED in ingestion order like real SSB lineorder (rows arrive in
+        # orderkey order) — the segment builder records is_sorted and q3/q6
+        # ride the sparse-presorted (zero-sort) kernel path. Only the
+        # marginal distribution matters to the other configs, so sorting
+        # this one column changes nothing else.
+        "lo_orderkey": np.sort(
+            rng.integers(0, max(1 << 22, rows // 10), rows)).astype(np.int32),
     }
 
 
@@ -197,7 +203,7 @@ def prepare_tables(need_ssb, need_ssb16, need_taxi):
     ssb_cols = None
     if need_ssb or need_ssb16:
         schema = _ssb_schema("ssb")
-        d = CACHE / f"ssb_{ROWS}_v3"
+        d = CACHE / f"ssb_{ROWS}_v4"
         if not (d / "metadata.json").exists():
             ssb_cols = _gen_ssb(ROWS)
             print(f"[bench] generating ssb {ROWS:,} rows", file=sys.stderr)
@@ -207,7 +213,7 @@ def prepare_tables(need_ssb, need_ssb16, need_taxi):
         out["ssb"] = (schema, [d])
     if need_ssb16:
         schema16 = _ssb_schema("ssb16")
-        dirs = [CACHE / f"ssb16_{ROWS}_v3" / f"s{i}" for i in range(16)]
+        dirs = [CACHE / f"ssb16_{ROWS}_v4" / f"s{i}" for i in range(16)]
         if not (dirs[-1] / "metadata.json").exists():
             if ssb_cols is None:
                 ssb_cols = _gen_ssb(ROWS)
@@ -244,8 +250,15 @@ def _remaining() -> float:
 # parent: probe + orchestrate per-config children
 # --------------------------------------------------------------------------
 
-def _probe_accelerator() -> bool:
-    """True iff a throwaway subprocess can run one device op.
+def _probe_accelerator():
+    """(ok, report) — ok iff a throwaway subprocess can run one device op.
+
+    ``report`` distinguishes the two failure modes round reports kept
+    conflating ("no TPU available" vs "our code broke on TPU"):
+      {"status": "ok" | "hung" | "errored" | "skipped",
+       "attempts": [{"rc": int, "stderr_tail": str}, ...]}
+    It rides into the BENCH json (probe field + warning) and is persisted
+    to PROBE_REPORT_PATH for the multichip dryrun to pick up.
 
     Retries failed (errored) probes with backoff across the probe budget
     (round-1 failure: ONE transient init error killed the bench). A HUNG
@@ -257,6 +270,7 @@ def _probe_accelerator() -> bool:
     import subprocess
     import tempfile
 
+    report = {"status": "skipped", "attempts": []}
     # probe budget sized so a DEAD tunnel (one hung attempt consumes the
     # whole budget) still leaves room for all nine cpu-fallback configs:
     # observed init latencies are ~30s when the tunnel is healthy, and
@@ -264,7 +278,7 @@ def _probe_accelerator() -> bool:
     budget = float(os.environ.get(
         "BENCH_INIT_PROBE_S", min(360.0, TIME_BUDGET_S * 0.25)))
     if budget <= 0:
-        return True
+        return True, report
     deadline = time.monotonic() + min(budget, max(_remaining() - 120, 30))
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     attempt = 0
@@ -280,17 +294,39 @@ def _probe_accelerator() -> bool:
                 time.sleep(1.0)
             rc = proc.poll()
             if rc == 0:
-                return True
+                report["status"] = "ok"
+                return True, report
             if rc is None:  # hung: abandon (no kill — lease-wedge hazard)
                 print(f"[bench] probe attempt {attempt} still hung after "
                       f"{budget:.0f}s budget; abandoning it", file=sys.stderr)
-                return False
+                report["status"] = "hung"
+                report["attempts"].append(
+                    {"rc": None, "stderr_tail":
+                     f"hung past the {budget:.0f}s probe budget; abandoned"})
+                return False, report
             ef.seek(0)
             tail = ef.read()[-2000:].decode(errors="replace").strip()
             print(f"[bench] probe attempt {attempt} failed (rc={rc}):\n{tail}",
                   file=sys.stderr)
+            report["status"] = "errored"
+            report["attempts"].append({"rc": rc, "stderr_tail": tail[-500:]})
         time.sleep(min(5 * 2 ** (attempt - 1), 60))
-    return False
+    return False, report
+
+
+# the last probe's verdict, readable by the multichip dryrun
+# (__graft_entry__.dryrun_multichip) so round reports can tell a missing
+# accelerator from broken accelerator code
+PROBE_REPORT_PATH = Path(os.environ.get(
+    "BENCH_PROBE_REPORT", ROOT / ".bench_partial" / "probe_report.json"))
+
+
+def _persist_probe_report(report) -> None:
+    try:
+        PROBE_REPORT_PATH.parent.mkdir(exist_ok=True)
+        PROBE_REPORT_PATH.write_text(json.dumps(report))
+    except Exception:
+        pass
 
 
 def _record_dir(platform) -> Path:
@@ -309,9 +345,13 @@ def _record_dir(platform) -> Path:
     return PARTIAL
 
 
-def _emit(results, platform, notes, skipped, final=False):
+def _emit(results, platform, notes, skipped, final=False, statuses=None,
+          probe=None):
     """(Re-)print the one-line summary JSON; also persist to the record
-    dir (_record_dir)."""
+    dir (_record_dir). ALWAYS emits — a probe or per-config failure must
+    never leave the driver with rc!=0 and no JSON line (the BENCH_r01
+    failure shape): with zero completed configs the line carries value 0,
+    the per-config statuses, and the probe attempts instead of vanishing."""
     if "q2_groupby" in results:
         hname = "q2_groupby"
         # row count rides in the name so scaled (cpu-fallback) runs
@@ -321,12 +361,13 @@ def _emit(results, platform, notes, skipped, final=False):
         hname = next(iter(results))
         metric = f"{hname}_rows_per_sec_per_chip"
     else:
-        return
-    headline = results[hname]
-    speedup = headline.get("speedup")
+        hname = None
+        metric = f"ssb_{ROWS // 1_000_000}m_q2_filter_groupby_rows_per_sec_per_chip"
+    headline = results.get(hname) if hname else None
+    speedup = headline.get("speedup") if headline else None
     out = {
         "metric": metric,
-        "value": round(headline["rows_per_sec"]),
+        "value": round(headline["rows_per_sec"]) if headline else 0,
         "unit": "rows/s",
         # null (not 0) when the baseline was skipped — 0 would read as a
         # measured 0x speedup
@@ -342,10 +383,18 @@ def _emit(results, platform, notes, skipped, final=False):
         "platform": platform,
         "final": final,
     }
+    if not results:
+        out["error"] = "no benchmark config completed"
     if notes:
         out["warning"] = "; ".join(notes)
     if skipped:
         out["skipped_configs"] = skipped
+    if statuses:
+        # one status per requested config: ok / hung / skipped:<why> /
+        # failed:rc=<n> — the per-config audit trail for partial runs
+        out["configs"] = statuses
+    if probe and probe.get("status") not in (None, "skipped"):
+        out["probe"] = probe
     line = json.dumps(out)
     print(line, flush=True)
     try:
@@ -367,13 +416,25 @@ def orchestrate():
 
     platform_req = os.environ.get("BENCH_PLATFORM", "")
     notes = []
+    probe_report = {"status": "skipped", "attempts": []}
     if not platform_req:
-        if _probe_accelerator():
+        probe_ok, probe_report = _probe_accelerator()
+        _persist_probe_report(probe_report)
+        if probe_ok:
             platform_req = ""  # default backend (axon/TPU)
         else:
             print("[bench] accelerator probe failed/hung; forcing CPU",
                   file=sys.stderr)
-            notes.append("accelerator probe failed or hung, ran on cpu")
+            # say WHICH failure mode: a hung probe means no accelerator
+            # was reachable; an errored probe carries the last stderr tail
+            # (our code / toolchain broke on the device)
+            why = probe_report.get("status", "failed")
+            last = (probe_report.get("attempts") or [{}])[-1]
+            tail = (last.get("stderr_tail") or "").splitlines()
+            notes.append(
+                f"accelerator probe {why}"
+                + (f" (last stderr: {tail[-1][:200]})" if tail else "")
+                + ", ran on cpu")
             platform_req = "cpu"
     if platform_req == "cpu" and ROWS > 20_000_000 \
             and not os.environ.get("BENCH_ROWS"):
@@ -392,6 +453,7 @@ def orchestrate():
     stage = PARTIAL.parent / (PARTIAL.name + "_stage")
     stage.mkdir(exist_ok=True)
     results, skipped = {}, []
+    statuses: dict = {}
     platform_seen = None
     configs = [c for c in CONFIGS if c in RUNS]
     hung = False
@@ -400,6 +462,8 @@ def orchestrate():
         rem = _remaining()
         if hung or rem < 60:
             skipped.append(name)
+            statuses[cfg] = ("skipped:previous config hung" if hung
+                             else "skipped:time budget exhausted")
             print(f"[bench] SKIP {name}: "
                   + ("previous config hung" if hung else "time budget exhausted"),
                   file=sys.stderr)
@@ -433,6 +497,7 @@ def orchestrate():
                   file=sys.stderr)
             notes.append(f"{cfg} hung and was abandoned")
             hung = True
+            statuses[cfg] = "hung"
             skipped.append(name)
             continue
         if outfile.exists():
@@ -449,21 +514,24 @@ def orchestrate():
                 if note:
                     notes.append(note)
                 results[name] = payload
+                statuses[cfg] = "ok"
             except Exception as e:
                 notes.append(f"{cfg} result unreadable: {e}")
+                statuses[cfg] = f"failed:unreadable result ({e})"
                 skipped.append(name)
         else:
             notes.append(f"{cfg} child exited rc={proc.returncode} "
                          f"with no result")
+            statuses[cfg] = f"failed:rc={proc.returncode}"
             skipped.append(name)
         _emit(results, platform_seen or platform_req or "unknown", notes,
-              skipped)
+              skipped, statuses=statuses, probe=probe_report)
 
-    if not results:
-        raise RuntimeError(
-            f"no benchmark configs produced results (BENCH_CONFIGS={CONFIGS})")
+    # always emit the final line — even a fully-failed run must leave the
+    # driver one parseable JSON record of WHAT failed and on which platform
     _emit(results, platform_seen or platform_req or "unknown", notes, skipped,
-          final=True)
+          final=True, statuses=statuses, probe=probe_report)
+    return len(results)
 
 
 # --------------------------------------------------------------------------
@@ -785,7 +853,10 @@ def main():
         outpath = sys.argv[sys.argv.index("--out") + 1]
         run_single(cfg, outpath)
         return
-    orchestrate()
+    completed = orchestrate()
+    # exit 0 when at least one config completed; a zero-config run still
+    # emitted its JSON (with per-config statuses) before this nonzero exit
+    sys.exit(0 if completed else 1)
 
 
 if __name__ == "__main__":

@@ -169,6 +169,22 @@ class TpuSegmentExecutor:
         # tunneled device pays a fixed round trip PER materialized array)
         return pack_outputs(outs)
 
+    def dispatch_plan_raw(self, segment: ImmutableSegment, plan: SegmentPlan):
+        """dispatch_plan without the flat-buffer packing: returns the raw
+        device output tuple for callers that keep computing ON DEVICE with
+        the per-segment outputs (the sparse device combine,
+        query_executor._try_sparse_device_combine) rather than fetching
+        them. Sparse programs never take the fused path, so the fused
+        negotiation is skipped."""
+        view = self.cache.view(segment)
+        arrays, packed = plan.gather_arrays_packed(view)
+        params = tuple(p if isinstance(p, (np.ndarray, np.generic))
+                       else np.asarray(p) for p in plan.params)
+        _GUARD.note((plan.program, view.padded, "", ()))
+        return run_program(plan.program, arrays, params,
+                           np.int32(segment.num_docs), view.padded,
+                           packed=packed, fused=""), view
+
     def collect(self, query: QueryContext, segment: ImmutableSegment,
                 plan: SegmentPlan, outs):
         """Materialize device outputs (blocks) and decode the intermediate."""

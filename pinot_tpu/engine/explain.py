@@ -85,7 +85,12 @@ def explain_plan(query, table, pruner, backend: str = "auto",
         dims = ", ".join(f"{d.column}[card:{d.cardinality}]"
                          for d in plan.group_dims)
         desc += f", groups:{p.num_groups}, dims:[{dims}]"
+        # the concrete group-by kernel variant: dense segment_sum table,
+        # MXU one-hot matmul, or one of the sparse sort strategies
+        # (ir.sparse_groupby_path mirrors the kernel's branch)
+        path = "dense"
         if p.mode == "group_by_sparse":
+            path = ir.sparse_groupby_path(p)
             desc += f", key_space:{p.key_space}"
             if p.exact_trim:
                 desc += ", orderByTrim:exact"
@@ -98,7 +103,30 @@ def explain_plan(query, table, pruner, backend: str = "auto",
                 # single-pass MXU kernel shape (ops/fused_groupby.py);
                 # actual use still depends on plane dtypes + backend
                 desc += ", fusedMxu:eligible"
+                if p.mode == "group_by":
+                    path = "mxu"
+        desc += f", path:{path}"
     kid = add(desc + ")", cid)
+
+    if getattr(query, "explain", False) == "implementation" and \
+            p.mode in ("group_by", "group_by_sparse"):
+        # implementation mode also names HOW the per-segment tables merge:
+        # the sparse device concat+edge-reduce, the columnar factorize/
+        # scatter merge, or the per-group dict merge fallback
+        import numpy as np
+
+        vec_ok = all(la.vec is not None for la in plan.lowered_aggs)
+        if (p.mode == "group_by_sparse" and backend != "host"
+                and len(kept) > 1 and p.group_strides == (1,)
+                and len(p.group_slots) == 1 and plan.group_dims and vec_ok
+                and np.issubdtype(
+                    plan.group_dims[0].dictionary.values.dtype, np.integer)):
+            impl = "device-sparse(concat+edge-reduce)"
+        elif vec_ok:
+            impl = "host-columnar-scatter"
+        else:
+            impl = "host-dict-merge"
+        add(f"SERVER_COMBINE(impl:{impl}, segments:{len(kept)})", cid)
 
     for a in query.aggregations:
         # SQL-level functions; COUNT(*) answers from the shared per-group

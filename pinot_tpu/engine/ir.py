@@ -293,6 +293,13 @@ class Program:
     # sparse mode: the device trim is an ORDER BY pushdown (ASC group-key
     # prefix + LIMIT) — result is exact, so don't flag numGroupsLimitReached
     exact_trim: bool = False
+    # sparse mode: the SINGLE group key is a dict column whose id plane is
+    # nondecreasing over the segment (ColumnMetadata.is_sorted — sorted
+    # ingestion order, e.g. an order-key or time column). The kernel then
+    # skips lax.sort entirely: group runs are already contiguous, so edges
+    # come straight from transitions in the raw id plane (the reference's
+    # SortedGroupByOperator analogue).
+    keys_presorted: bool = False
     # MV group-by: ONE group dim may be a multi-value column. The kernel
     # expands (doc × mv-slot) pairs up front — every 1-D plane broadcasts
     # across the MV width, the MV id matrix flattens, non-entries mask off
@@ -306,3 +313,17 @@ class Program:
     # must broadcast across the MV width — dictionary planes are
     # cardinality-sized and must pass through untouched
     mv_doc_slots: tuple = ()
+
+
+def sparse_groupby_path(p: Program) -> str:
+    """The sparse kernel variant a Program lowers to — mirrors the branch
+    taken by ops/kernels._run_sparse_group_by so EXPLAIN IMPLEMENTATION can
+    name it without tracing the kernel: `sparse-presorted` skips lax.sort,
+    `sparse-sort+gather` sorts (key[, distinct_ids], iota32) and gathers the
+    >=2 payload operands through the permutation, `sparse-sort` carries a
+    single payload through the sort network directly."""
+    if p.keys_presorted:
+        return "sparse-presorted"
+    payloads = sum(1 for a in p.aggs
+                   if a.kind in ("sum", "sumsq", "min", "max"))
+    return "sparse-sort+gather" if payloads >= 2 else "sparse-sort"

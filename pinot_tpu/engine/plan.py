@@ -822,6 +822,14 @@ class SegmentPlanner(AggPlanContext):
                     dense_ok = False
                     dense_reason = f"{op.kind} occupancy {num_groups}x{width}"
             sparse = not dense_ok
+            if not sparse and group_exprs and self.query.query_options.get(
+                    "sparseGroupBy") in (True, "true", 1):
+                # per-query escape hatch (SET sparseGroupBy = true): route a
+                # dense-eligible group-by through the sparse kernel — lets
+                # tests and benchmarks exercise the sort/presorted/device-
+                # combine machinery without multi-million-cardinality data
+                sparse = True
+                dense_reason = "sparseGroupBy=true"
             if sparse:
                 n_distinct = sum(1 for op in self.ops
                                  if op.kind == "distinct_bitmap")
@@ -853,6 +861,19 @@ class SegmentPlanner(AggPlanContext):
                     f"{dense_reason} exceeds the dense limit for an "
                     "un-grouped aggregation")
             exact_trim = False
+            keys_presorted = False
+            if (sparse and len(group_exprs) == 1 and not any_derived
+                    and mv_group_slot is None
+                    and group_exprs[0].is_identifier):
+                # sorted-key fast path: a single dict group key whose id
+                # plane is nondecreasing in doc order (sorted ingestion —
+                # ColumnMetadata.is_sorted) needs NO sort at all; the kernel
+                # reads group edges off the raw id plane (reference
+                # SortedGroupByOperator). Multi-key presorted detection
+                # (lexicographic co-sort) is a ROADMAP open item.
+                m = self._meta(group_exprs[0].identifier)
+                keys_presorted = bool(m.single_value
+                                      and getattr(m, "is_sorted", False))
             if sparse and group_exprs:
                 # output capacity = numGroupsLimit: groups beyond it are
                 # trimmed on device (reference InstancePlanMakerImplV2:245-270)
@@ -890,6 +911,8 @@ class SegmentPlanner(AggPlanContext):
                 group_vexprs=tuple(group_vexprs) if any_derived else (),
                 key_space=num_groups if mode == "group_by_sparse" else 0,
                 exact_trim=exact_trim,
+                keys_presorted=(keys_presorted
+                                and mode == "group_by_sparse"),
                 mv_group_slot=mv_group_slot if mode != "aggregation" else None,
                 mv_group_card=mv_group_card if mode != "aggregation" else None,
                 mv_doc_slots=tuple(

@@ -353,6 +353,12 @@ class QueryExecutor:
                     f"query exceeded timeoutMs={timeout_ms} "
                     f"({done}/{len(kept)} segments done)")
 
+        if len(kept) > 1 and self.backend != "host":
+            merged = self._try_sparse_device_combine(query, kept, tracker,
+                                                     check)
+            if merged is not None:
+                return merged
+
         pending: list = []  # (idx, run_query, segment, rewrite, plan, outs)
         host_work: list = []  # (idx, run_query, run_segment, rewrite)
         intermediates: list = [None] * len(kept)
@@ -452,6 +458,105 @@ class QueryExecutor:
                 self._remap_star_tree(rewrite, inter) if rewrite else inter)
             done += 1
         return intermediates
+
+    # device merge ops per sparse AggOp kind (count columns merge like sums)
+    _SPARSE_COMBINE_KINDS = {"count": "add", "sum": "add", "sumsq": "add",
+                             "min": "min", "max": "max"}
+
+    def _try_sparse_device_combine(self, query: QueryContext, kept, tracker,
+                                   check):
+        """Server-level merge ON DEVICE for multi-segment single-key sparse
+        group-bys: dispatch every segment's kernel, translate each key
+        column to dictionary VALUE space on device (dictionaries are
+        segment-local), merge the S tables with one sort/edge-reduce
+        (kernels.combine_sparse_group_tables), and fetch ONE merged table —
+        replacing S device→host table transfers + the host factorize/
+        scatter merge in combine_group_arrays. Restricted to shapes where
+        value-space keys are exact: one identifier group key over an
+        integer dictionary, vectorizable aggs only. Returns the 1-element
+        intermediates list, or None to fall back to the normal
+        per-segment collect + host merge (any failure here is recoverable
+        — nothing has been consumed)."""
+        if query.query_options.get("deviceCombine") in (False, "false", 0):
+            return None
+        import logging
+
+        import numpy as np
+
+        from ..ops import kernels
+        from .results import GroupArrays
+
+        plans, segs = [], []
+        for segment in kept:
+            run_query, run_segment, rewrite = self._segment_route(
+                query, segment)
+            if rewrite is not None or \
+                    getattr(run_segment, "is_mutable", False):
+                return None
+            try:
+                plans.append(self.tpu.plan(run_query, run_segment))
+            except UnsupportedQueryError:
+                return None
+            segs.append(run_segment)
+        p0 = plans[0].program
+        kinds = tuple(self._SPARSE_COMBINE_KINDS.get(a.kind)
+                      for a in p0.aggs)
+        agg_kinds = tuple(a.kind for a in p0.aggs)
+        if p0.mode != "group_by_sparse" or not kinds or None in kinds:
+            return None
+        for pl in plans:
+            p = pl.program
+            if not (p.mode == "group_by_sparse"
+                    and p.group_strides == (1,)
+                    and len(p.group_slots) == 1
+                    and not p.group_vexprs
+                    and p.mv_group_slot is None
+                    and p.exact_trim == p0.exact_trim
+                    and tuple(a.kind for a in p.aggs) == agg_kinds
+                    and pl.group_dims
+                    and np.issubdtype(
+                        pl.group_dims[0].dictionary.values.dtype,
+                        np.integer)
+                    and all(la.vec is not None for la in pl.lowered_aggs)):
+                return None
+        try:
+            seg_keys, seg_counts, seg_states = [], [], []
+            for done, (segment, pl) in enumerate(zip(segs, plans)):
+                check(done)
+                outs, view = self.tpu.dispatch_plan_raw(segment, pl)
+                seg_keys.append(kernels.ids_to_values_i64(
+                    outs[-1], view.dict_values(pl.group_dims[0].column)))
+                seg_counts.append(outs[0])
+                seg_states.append(tuple(outs[1:-1]))
+            merged = kernels.combine_sparse_group_tables(
+                tuple(seg_keys), tuple(seg_counts), tuple(seg_states),
+                kinds)
+            # one flat D2H transfer for the whole query
+            outs_np = unpack_outputs(kernels.pack_outputs(merged))
+        except TimeoutError:
+            raise
+        except Exception:
+            logging.getLogger(__name__).debug(
+                "sparse device combine failed; host merge fallback",
+                exc_info=True)
+            return None
+        counts = outs_np[0][:-1]
+        gids = np.nonzero(counts)[0]
+        trash = int(outs_np[0][-1])
+        dim = plans[0].group_dims[0]
+        key_col = outs_np[-1][gids].astype(dim.dictionary.values.dtype,
+                                           copy=False)
+        las = plans[0].lowered_aggs
+        ga = GroupArrays(
+            [key_col],
+            [la.vec.extract(outs_np, gids) for la in las],
+            [la.vec.spec for la in las],
+            [la.vec.fin_tag for la in las],
+            num_docs_scanned=int(counts.sum()) + trash,
+            groups_trimmed=trash > 0 and not p0.exact_trim)
+        if tracker is not None:
+            GLOBAL_ACCOUNTANT.on_allocation(tracker, _estimate_bytes(ga))
+        return [ga]
 
     def _segment_route(self, query: QueryContext, segment):
         rewrite = None

@@ -602,6 +602,15 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     the same "stop creating new groups" trim semantics as the reference. The
     composite keys of the surviving groups are emitted as the LAST output so
     the host can decode per-dim dict ids with the usual stride arithmetic.
+
+    Two fast paths shave the sort cost (ir.sparse_groupby_path names the
+    variant for EXPLAIN IMPLEMENTATION):
+    - keys_presorted: the single group key plane is already nondecreasing in
+      doc order (sorted ingestion) — skip lax.sort entirely; group edges
+      come from transitions in the raw id plane.
+    - sort-iota + gather: with >= 2 payload operands, sort only
+      (key[, distinct_ids], iota32) and gather each payload through the
+      permutation — (1+A)·n sorted bytes become ~2·n.
     """
     # 64-bit sorts/scatters are emulated on TPU: sort 32-bit keys whenever
     # the composite key space fits (key_space is static on the Program)
@@ -616,7 +625,11 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
             key = key + arrays[slot].astype(kdtype) * stride
     sentinel = (jnp.int32((1 << 31) - 1) if key32
                 else jnp.int64(ir.SPARSE_KEY_SPACE))
-    key = jnp.where(mask, key, sentinel)
+    if not program.keys_presorted:
+        # masked rows sort to a sentinel tail. The presorted path keeps the
+        # RAW key plane instead: rows never move, so masked rows stay in
+        # place and are skipped via op identities + mask prefix sums.
+        key = jnp.where(mask, key, sentinel)
 
     # agg inputs with mask-neutral elements, computed BEFORE the sort so one
     # lax.sort carries key + all values into group-contiguous order.
@@ -636,7 +649,7 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     # out of the single packed key. Falls back to a two-key sort when the
     # product overflows.
     pack_card = None
-    if distinct_aggs and key32 and \
+    if distinct_aggs and key32 and not program.keys_presorted and \
             0 < program.key_space * distinct_aggs[0].card < _I32_MAX:
         pack_card = int(distinct_aggs[0].card)
         ids_raw = arrays[distinct_aggs[0].ids_slot].astype(jnp.int32)
@@ -684,7 +697,26 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
             raise ValueError(f"agg kind {agg.kind} unsupported in sparse group-by")
         operands.append(v)
 
-    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_sort_keys)
+    if program.keys_presorted:
+        return _presorted_sparse_tail(program, operands, specs, mask, n)
+
+    num_payloads = len(operands) - num_sort_keys
+    if num_payloads >= 2:
+        # sort-iota + gather: dragging every payload through the bitonic
+        # sort network costs (num_keys+A)·n sorted bytes and A extra
+        # compare-network permute lanes. Sort only (keys..., iota32) and
+        # gather each payload through the permutation instead — the sort
+        # moves ~2·n values and the payloads cross HBM once via gathers.
+        # lax.sort is stable, so the permutation (iota as the tie-broken
+        # last operand) reproduces the multi-operand sort bit-for-bit.
+        iota = jnp.arange(n, dtype=jnp.int32)
+        head = jax.lax.sort(tuple(operands[:num_sort_keys]) + (iota,),
+                            num_keys=num_sort_keys)
+        perm = head[num_sort_keys]
+        sorted_ops = tuple(head[:num_sort_keys]) + tuple(
+            op[perm] for op in operands[num_sort_keys:])
+    else:
+        sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_sort_keys)
     skey_raw = sorted_ops[0]
     valid = skey_raw < sentinel
     if pack_card is not None:
@@ -794,6 +826,125 @@ def _run_sparse_group_by(program: ir.Program, arrays, params, mask, n):
     keys_out = jnp.where(occupied,
                          skey[jnp.clip(fi, 0, n - 1)].astype(jnp.int64),
                          jnp.int64(-1))
+    outputs.append(keys_out)
+    return tuple(outputs)
+
+
+def _presorted_sparse_tail(program: ir.Program, operands, specs, mask, n):
+    """Sorted-key fast path: ZERO lax.sort (reference SortedGroupByOperator).
+
+    The single key plane (operands[0], RAW — no sentinel) is nondecreasing
+    over the segment (planner checked ColumnMetadata.is_sorted), so group
+    runs are already contiguous in DOC order. Rows never move, which changes
+    the bookkeeping versus the sorted path in two ways: masked rows (filter
+    misses + the padded tail) sit INSIDE/AFTER runs instead of sorting to a
+    sentinel tail, so
+
+    - a group exists only where a key run has >= 1 masked-in row, and the
+      run's FIRST such row opens the group — fully-masked runs must not
+      consume numGroupsLimit slots, or an exact ORDER BY trim could drop a
+      live group that a sorted-path run would keep;
+    - per-group reductions skip masked rows via op identities (the operand
+      loop already substituted them) and counts come from a mask prefix sum.
+
+    The padded tail (device planes pad dict id 0 past num_docs) would break
+    the nondecreasing invariant, but those rows are always masked off
+    (run_program ANDs the doc-count iota mask), and masked rows only ever
+    contribute op identities here — a masked out-of-order row can at worst
+    sit inside the span [fi, li] of an earlier group, where its identity
+    value is harmless. Only MASKED-IN rows must be nondecreasing, which the
+    planner's is_sorted check guarantees.
+    """
+    key = operands[0]
+    k = program.num_groups
+    first_key = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), key[1:] != key[:-1]])
+    # running masked-in row count within each key run (inclusive): the row
+    # where it first hits 1 opens that run's group
+    mrun = _segmented_scan(mask.astype(jnp.int32), first_key, jnp.add)
+    first = mask & (mrun == 1)
+    gidx = jnp.cumsum(first.astype(jnp.int32)) - 1
+    # gidx is nondecreasing (-1 before the first live group), so slot edges
+    # still come from one vectorized binary search — same machinery as the
+    # sorted path, no scatters
+    edges = jnp.searchsorted(gidx, jnp.arange(k + 1, dtype=jnp.int32))
+    fi = edges[:k]
+    li = jnp.maximum(edges[1:] - 1, fi)
+    fic = jnp.clip(fi, 0, n - 1)
+    lic = jnp.clip(li, 0, n - 1)
+    occupied = jnp.arange(k, dtype=jnp.int32) < gidx[n - 1] + 1
+    # per-group masked-in row counts from one mask prefix sum: rows of later
+    # fully-masked runs inside [fi, li] contribute zero by construction
+    pm = jnp.cumsum(mask.astype(jnp.int32))
+    counts_k = jnp.where(
+        occupied, pm[lic] - pm[fic] + mask[fic].astype(jnp.int32),
+        0).astype(jnp.int64)
+    n_valid = pm[n - 1].astype(jnp.int64)
+    counts = jnp.concatenate([counts_k, (n_valid - counts_k.sum())[None]])
+    # a group's span [fi, li] may run past its own key run into later
+    # FULLY-masked runs (which never opened a group) — segmented scans reset
+    # at those run boundaries, so scan-based reductions must read at the
+    # last row of the group's OWN run, not at li. Mask/value prefix-diffs
+    # don't care (masked rows contribute exact zeros globally).
+    run_id = jnp.cumsum(first_key.astype(jnp.int32)) - 1  # nondecreasing
+    rlast = jnp.clip(
+        jnp.searchsorted(run_id, run_id[fic], side="right") - 1, 0, n - 1)
+
+    def group_sums(prefix_incl, v_f64):
+        s = prefix_incl[lic] - prefix_incl[fic] + v_f64[fic]
+        return jnp.where(occupied, s, 0.0)
+
+    outputs = [counts]
+    for spec in specs:
+        kind, oi = spec[0], spec[1]
+        agg = spec[2] if len(spec) > 2 else None
+        if kind == "count":
+            outputs.append(counts)
+        elif kind == "distinct":
+            # ids are NOT sorted within a run here (no sort happened), so
+            # the sorted path's uniq-row trick is unavailable — but OR is
+            # idempotent, so the log2(n)-pass segmented OR scan builds the
+            # same per-group bitmap words without dedup
+            card = agg.card
+            bit = operands[oi].astype(jnp.uint32)
+            cols = []
+            for w in range(-(-card // 32)):
+                val = jnp.where(mask & ((bit >> 5) == jnp.uint32(w)),
+                                jnp.uint32(1) << (bit & jnp.uint32(31)),
+                                jnp.uint32(0))
+                word = _segmented_scan(val, first_key, jnp.bitwise_or)[rlast]
+                cols.append(jnp.where(occupied, word, jnp.uint32(0)))
+            matrix = jnp.stack(cols, axis=1)
+            outputs.append(jnp.concatenate(
+                [matrix, jnp.zeros((1, matrix.shape[1]), jnp.uint32)]))
+        elif kind == "sum_i" and not _prefix_exact_gate(operands[oi], agg):
+            # unbounded int64 columns keep the exact limb scatters; indices
+            # are NOT flagged sorted (masked rows scatter into the trash)
+            gid = jnp.where(mask & (gidx >= 0) & (gidx < k),
+                            gidx, jnp.int32(k))
+            outputs.append(_segment_sum_exact_i64(
+                operands[oi], gid, k + 1, n, agg.vmin, agg.vmax,
+                indices_are_sorted=False).astype(jnp.float64))
+        elif kind == "sum_i":
+            v = operands[oi]  # masked rows already zeroed
+            sums = group_sums(_sorted_prefix_f64(v, agg),
+                              v.astype(jnp.float64))
+            outputs.append(jnp.concatenate([sums, jnp.zeros(1)]))
+        elif kind == "sum_f":
+            s = _segmented_scan(operands[oi], first_key, jnp.add)[rlast]
+            outputs.append(jnp.concatenate(
+                [jnp.where(occupied, s, 0.0), jnp.zeros(1)]))
+        elif kind in ("min_i", "min_f"):
+            smin = _segmented_scan(operands[oi], first_key, jnp.minimum)[rlast]
+            outputs.append(jnp.concatenate(
+                [jnp.where(occupied, smin.astype(jnp.float64), jnp.inf),
+                 jnp.full(1, jnp.inf)]))
+        else:  # max_i / max_f
+            smax = _segmented_scan(operands[oi], first_key, jnp.maximum)[rlast]
+            outputs.append(jnp.concatenate(
+                [jnp.where(occupied, smax.astype(jnp.float64), -jnp.inf),
+                 jnp.full(1, -jnp.inf)]))
+    keys_out = jnp.where(occupied, key[fic].astype(jnp.int64), jnp.int64(-1))
     outputs.append(keys_out)
     return tuple(outputs)
 
@@ -1044,3 +1195,95 @@ def _run_agg(agg: ir.AggOp, arrays, params, mask, gid, num_segments, n,
         v = jnp.where(mask, v, -jnp.inf).astype(jnp.float64)
         return jax.ops.segment_max(v, gid, num_segments=num_segments)
     raise ValueError(f"unknown agg kind {agg.kind}")
+
+
+# ---------------------------------------------------------------------------
+# Device-side sparse combine (server-level merge of per-segment group tables)
+# ---------------------------------------------------------------------------
+
+# empty merged-table slots carry this key; above any real dictionary VALUE
+# (sparse value-space keys are int64 dictionary values, not composite ids)
+COMBINE_KEY_SENTINEL = 1 << 62
+
+
+@jax.jit
+def ids_to_values_i64(keys, dict_plane):
+    """Translate one segment's sparse key output (dict IDS; -1 = empty slot)
+    into dictionary VALUE space. Dictionaries are segment-local (the same id
+    means different values in different segments — engine/results.py), so
+    cross-segment merge keys must be values. int64 holds every integer dict
+    exactly; empty slots map to the sort sentinel so they tail the merge."""
+    card = dict_plane.shape[0]
+    ids = jnp.clip(keys, 0, card - 1).astype(jnp.int32)
+    return jnp.where(keys >= 0, dict_plane[ids].astype(jnp.int64),
+                     jnp.int64(COMBINE_KEY_SENTINEL))
+
+
+@partial(jax.jit, static_argnames=("kinds",))
+def combine_sparse_group_tables(seg_keys, seg_counts, seg_states, kinds):
+    """Merge S per-segment sparse group tables ON DEVICE.
+
+    Replaces the host-side factorize+scatter merge (combine.py
+    combine_group_arrays) for single-key sparse group-bys: per-segment
+    tables are already key-sorted, so the merge is the SAME
+    sort/edges/segmented-scan machinery as _run_sparse_group_by, over
+    S*K rows instead of n docs — and only the merged table crosses to host.
+
+    seg_keys:   S × (K,) int64 VALUE-space keys (ids_to_values_i64 output)
+    seg_counts: S × (K+1,) int64 count columns (slot K = trash)
+    seg_states: S × tuple of (K+1,) state columns (one per Program agg op,
+                in op order — count copies are int64, the rest f64)
+    kinds:      per state column: "add" | "min" | "max" (static)
+
+    Returns (counts(M+1) i64, *states(M+1), keys(M) i64) with M = S*K — the
+    per-segment output layout, so LoweredAgg.vec.extract decodes it
+    unchanged. All merged groups are kept (M slots hold the worst-case
+    union) for bit-for-bit parity with the host merge; the ordered
+    server-level trim still runs downstream on the single merged table.
+    """
+    key = jnp.concatenate(seg_keys)
+    cnt = jnp.concatenate([c[:-1] for c in seg_counts])
+    trash = sum(c[-1] for c in seg_counts)
+    states = [jnp.concatenate([s[i][:-1] for s in seg_states])
+              for i in range(len(kinds))]
+    m = key.shape[0]
+    # sort-iota + gather, same as the n-row kernel: permute only (key, iota)
+    skey, perm = jax.lax.sort(
+        (key, jnp.arange(m, dtype=jnp.int32)), num_keys=1)
+    cnt = cnt[perm]
+    states = [s[perm] for s in states]
+    valid = skey < jnp.int64(COMBINE_KEY_SENTINEL)
+    first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), skey[1:] != skey[:-1]]) & valid
+    gidx = jnp.cumsum(first.astype(jnp.int32)) - 1
+    gidx_m = jnp.where(valid, gidx, jnp.int32(1 << 30))
+    edges = jnp.searchsorted(gidx_m, jnp.arange(m + 1, dtype=jnp.int32))
+    fi = edges[:m]
+    li = jnp.maximum(edges[1:] - 1, fi)
+    fic = jnp.clip(fi, 0, m - 1)
+    lic = jnp.clip(li, 0, m - 1)
+    occupied = edges[1:] > edges[:-1]
+    pc = jnp.cumsum(jnp.where(valid, cnt, 0))
+    counts_m = jnp.where(
+        occupied,
+        pc[lic] - pc[fic] + jnp.where(valid[fic], cnt[fic], 0), 0)
+    outs = [jnp.concatenate([counts_m, trash[None]])]
+    for v, kind in zip(states, kinds):
+        if kind == "add":
+            vz = jnp.where(valid, v, jnp.zeros((), v.dtype))
+            s = _segmented_scan(vz, first, jnp.add)[lic]
+            merged = jnp.where(occupied, s, jnp.zeros((), v.dtype))
+            tail = jnp.zeros((1,), v.dtype)
+        elif kind == "min":
+            vz = jnp.where(valid, v, jnp.inf)
+            s = _segmented_scan(vz, first, jnp.minimum)[lic]
+            merged = jnp.where(occupied, s, jnp.inf)
+            tail = jnp.full((1,), jnp.inf)
+        else:  # max
+            vz = jnp.where(valid, v, -jnp.inf)
+            s = _segmented_scan(vz, first, jnp.maximum)[lic]
+            merged = jnp.where(occupied, s, -jnp.inf)
+            tail = jnp.full((1,), -jnp.inf)
+        outs.append(jnp.concatenate([merged, tail]))
+    outs.append(jnp.where(occupied, skey[fic], jnp.int64(-1)))
+    return tuple(outs)

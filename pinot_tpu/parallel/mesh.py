@@ -32,6 +32,18 @@ ROW_AXIS = "sp"  # intra-segment row sharding (sequence-parallel analogue)
 SEGMENT_AXIS = "dp"  # across segments (data-parallel analogue)
 
 
+def shard_map_compat(f, **kwargs):
+    """jax.shard_map with a fallback for jax 0.4.x, where it still lives in
+    jax.experimental.shard_map and `check_vma` is spelled `check_rep`."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, **kwargs)
+    from jax.experimental.shard_map import shard_map
+
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return shard_map(f, **kwargs)
+
+
 def make_mesh(n_devices: int | None = None, axes=(ROW_AXIS,)) -> Mesh:
     devices = jax.devices()
     if n_devices is not None:
@@ -126,7 +138,7 @@ def _row_sharded_call(program: ir.Program, arrays: tuple, params: tuple, num_doc
     param_specs = tuple(
         P(ROW_AXIS) if i in mask_idxs else P() for i in range(len(params)))
     out_specs = P(ROW_AXIS) if program.mode == "selection" else P()
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         shard_fn, mesh=mesh,
         in_specs=(array_specs, param_specs, P()),
         out_specs=out_specs,

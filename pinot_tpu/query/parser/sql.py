@@ -132,11 +132,17 @@ class _Parser:
             val_tok = self.next()
             options[key] = _literal_value(val_tok)
             self.accept_op(";")
-        explain = False
+        explain: Any = False
         if self.accept_kw("EXPLAIN"):
-            self.accept_kw("PLAN")
+            # EXPLAIN IMPLEMENTATION FOR names the concrete kernel variants
+            # (group-by path, device combine) instead of the logical plan —
+            # same contract as the MSE parser (mse/parser.py)
+            if self.accept_kw("IMPLEMENTATION"):
+                explain = "implementation"
+            else:
+                self.accept_kw("PLAN")
+                explain = True
             self.accept_kw("FOR")
-            explain = True
         qc = self._parse_select()
         qc.query_options.update(options)
         qc.explain = explain
