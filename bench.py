@@ -55,7 +55,7 @@ _START = time.monotonic()
 # q6 runs LAST: its sparse-distinct program has the slowest cold compile,
 # and a hung/abandoned child skips every config after it
 CONFIGS = [c for c in os.environ.get(
-    "BENCH_CONFIGS", "q1,q2,q9,q3,q4,q5,q7,q8,q6").split(",") if c]
+    "BENCH_CONFIGS", "q1,q2,q9,q3,q4,q5,q7,q8,q3m,q6m,q6").split(",") if c]
 ROOT = Path(__file__).parent
 CACHE = ROOT / ".bench_cache"
 # smoke/dev runs point this elsewhere (BENCH_PARTIAL_DIR) so they never
@@ -118,6 +118,12 @@ RUNS = {
     "q7": ("q7_lookup_join", Q7.format(t="ssb"), "ssb", 1.0, 0.0),
     "q8": ("q8_mse_join", Q8.format(t="ssb"), "ssb", 1 / 3, 0.0),
     "q9": ("q9_groupby_3sums", Q9.format(t="ssb"), "ssb", 1.0, 0.0),
+    # multi-segment (16) variants: the stacked segment-batching configs —
+    # num_device_dispatches should track batch FAMILIES, not segments
+    "q3m": ("q3m_highcard_groupby16", Q3.format(t="ssb16"), "ssb16",
+            1 / 3, 0.0),
+    "q6m": ("q6m_sparse_distinct16", Q6.format(t="ssb16"), "ssb16",
+            1 / 3, 0.0),
 }
 
 N_BRANDS = 1000
@@ -447,7 +453,8 @@ def orchestrate():
         print("[bench] cpu fallback: ROWS -> 20M", file=sys.stderr)
 
     need_ssb = any(RUNS[c][2] == "ssb" for c in CONFIGS if c in RUNS)
-    prepare_tables(need_ssb, "q4" in CONFIGS, "q5" in CONFIGS)
+    need_ssb16 = any(RUNS[c][2] == "ssb16" for c in CONFIGS if c in RUNS)
+    prepare_tables(need_ssb, need_ssb16, "q5" in CONFIGS)
 
     PARTIAL.mkdir(exist_ok=True)
     stage = PARTIAL.parent / (PARTIAL.name + "_stage")
@@ -803,6 +810,11 @@ def run_single(cfg: str, outpath: str):
         "match": match,
         "iters": len(times),
         "platform": platform,
+        # device-dispatch economics of the LAST timed run: dispatches
+        # should track batch families (not segments) and steady-state
+        # compiles should be 0
+        "num_device_dispatches": getattr(r, "num_device_dispatches", 0),
+        "num_compiles": getattr(r, "num_compiles", 0),
     }
     if note:
         payload["note"] = note

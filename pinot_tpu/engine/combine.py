@@ -26,6 +26,56 @@ _MERGE_INIT = {"add": 0.0, "min": np.inf, "max": -np.inf}
 _MERGE_AT = {"add": np.add.at, "min": np.minimum.at, "max": np.maximum.at}
 
 
+def combine_batched_dense(outs_b: Sequence, plans: Sequence) -> Optional[list]:
+    """Vectorized decode of a batched dense group-by FAMILY's outputs
+    (engine/executor.py:dispatch_plan_batch — every array carries a
+    leading [S] member dim) into per-member GroupArrays: ONE np.nonzero
+    over the whole [S, G] counts block and one scanned-docs reduction,
+    instead of S of each. Key-value gathers stay per member because group
+    dictionaries are segment-local. Bit-identical to running each member's
+    slice through TpuSegmentExecutor.collect(); returns None when any
+    member needs the general (dict-form) path."""
+    p0 = plans[0].program
+    if p0.mode != "group_by" or p0.mv_group_slot is not None:
+        return None
+    if any(not all(la.vec is not None for la in pl.lowered_aggs)
+           for pl in plans):
+        return None
+    num_groups = p0.num_groups
+    counts_b = np.asarray(outs_b[0])[:, :num_groups]
+    rows, gids = np.nonzero(counts_b)  # row-major: member order preserved
+    bounds = np.searchsorted(rows, np.arange(len(plans) + 1))
+    scanned_b = counts_b.sum(axis=1)
+    result = []
+    for s, pl in enumerate(plans):
+        g = gids[bounds[s]:bounds[s + 1]]
+        outs_s = [o[s] for o in outs_b]  # zero-copy views
+        key_cols = [
+            np.asarray(dim.dictionary.values[(g // stride) % dim.cardinality])
+            for dim, stride in zip(pl.group_dims, pl.program.group_strides)]
+        result.append(GroupArrays(
+            key_cols,
+            [la.vec.extract(outs_s, g) for la in pl.lowered_aggs],
+            [la.vec.spec for la in pl.lowered_aggs],
+            [la.vec.fin_tag for la in pl.lowered_aggs],
+            num_docs_scanned=int(scanned_b[s]), groups_trimmed=False))
+    return result
+
+
+def combine_batched_aggregation(outs_b: Sequence, plans: Sequence) -> list:
+    """Per-member AggIntermediates from a batched aggregation family: the
+    scanned-docs column reads once for the whole family; per-agg state
+    extraction is O(1) per member (scalar indexing into the [S, ...]
+    views). Bit-identical to per-member collect()."""
+    scanned_b = np.asarray(outs_b[0])[:, 0]
+    return [
+        AggIntermediate(
+            [la.extract([o[s] for o in outs_b], 0)
+             for la in pl.lowered_aggs],
+            num_docs_scanned=int(scanned_b[s]))
+        for s, pl in enumerate(plans)]
+
+
 def combine_group_arrays(
     intermediates: Sequence[GroupArrays],
 ) -> Optional[GroupArrays]:

@@ -52,10 +52,13 @@ class _CompileCacheGuard:
         self._seen: set = set()
         self._validated: set = set()  # fused variants proven on-device
 
-    def note(self, key) -> None:
+    def note(self, key) -> bool:
+        """Record a compiled-executable-family key. Returns True when the
+        key is NEW (a fresh compile is about to happen) — the per-query
+        num_compiles counter feeds off this."""
         with self._lock:
             if key in self._seen:
-                return
+                return False
             if len(self._seen) >= self.limit:
                 logging.getLogger(__name__).warning(
                     "dropping jit caches after %d distinct compiled "
@@ -69,6 +72,7 @@ class _CompileCacheGuard:
                     self._seen.clear()
                     self._validated.clear()
             self._seen.add(key)
+            return True
 
     def validated(self, vkey) -> bool:
         with self._lock:
@@ -80,6 +84,95 @@ class _CompileCacheGuard:
 
 
 _GUARD = _CompileCacheGuard()
+
+# Per-QUERY dispatch/compile counters. Thread-local because concurrent
+# queries share this module: every device dispatch happens on the query's
+# own thread (query_executor's host pool never dispatches), so a
+# reset-at-start / read-at-end pair on the query thread sees exactly its
+# own dispatches — a global snapshot delta would interleave queries.
+_TLS = threading.local()
+
+
+def reset_dispatch_counters() -> None:
+    _TLS.counts = [0, 0]  # [num_device_dispatches, num_compiles]
+
+
+def dispatch_counters() -> tuple[int, int]:
+    c = getattr(_TLS, "counts", None)
+    return (c[0], c[1]) if c else (0, 0)
+
+
+def _count_dispatch(new_compile: bool) -> None:
+    c = getattr(_TLS, "counts", None)
+    if c is not None:
+        c[0] += 1
+        if new_compile:
+            c[1] += 1
+
+
+class BatchFamilyMismatch(Exception):
+    """A family grouped by the host-side key turned out to gather planes of
+    unequal dtype/shape — the caller falls back to per-segment dispatch."""
+
+
+def _dict_pad(card: int) -> int:
+    """Shape bucket for dictionary-values planes: next power of two ≥ card.
+    Dict planes are only ever gathered by ids < the segment's OWN
+    cardinality, so zero-padding to a shared bucket lets segments with
+    different dictionary sizes join one batch family without changing any
+    gathered value."""
+    b = 1
+    while b < card:
+        b <<= 1
+    return b
+
+
+def batch_family_key(segment: ImmutableSegment, plan: SegmentPlan):
+    """Host-computable batch family key: segments with equal keys gather
+    identically-shaped device planes and params, so their kernel inputs can
+    stack into [S, ...] arrays and run as ONE vmapped dispatch.
+
+    The key is (program, padded bucket, per-slot dtype/packing signature,
+    per-param dtype/shape signature) — derived purely from column METADATA
+    (no device upload), so EXPLAIN and the dispatcher share it. It mirrors
+    what gather_arrays_packed will produce; dispatch_plan_batch re-verifies
+    the real gathered shapes and raises BatchFamilyMismatch if the mirror
+    ever drifts. Returns None when a slot's shape can't be predicted."""
+    from ..segment.device_cache import pad_bucket, packed_hbm_enabled
+    from ..spi.data_types import DataType
+
+    padded = pad_bucket(max(1, segment.num_docs))
+    packed_on = packed_hbm_enabled()
+    sig = []
+    try:
+        for column, kind in plan.slots:
+            m = segment.column_metadata(column)
+            if kind == "ids" and not m.single_value:
+                kind = "mvids"  # view.dict_ids falls through to the matrix
+            if kind == "ids":
+                bits = getattr(m, "bits_per_value", 32) or 32
+                width = 32
+                if bits <= 16 and packed_on:
+                    width = 8 if bits <= 8 else 16
+                sig.append(("ids", width))
+            elif kind == "mvids":
+                sig.append(("mvids", max(1, m.max_number_of_multi_values)))
+            elif kind == "raw":
+                sig.append(("raw", str(DataType(m.data_type).numpy_dtype)))
+            elif kind == "rawf32r":
+                sig.append(("rawf32r",))
+            elif kind == "dict":
+                sig.append(("dict", str(DataType(m.data_type).numpy_dtype),
+                            _dict_pad(int(m.cardinality))))
+            elif kind == "null":
+                sig.append(("null",))
+            else:
+                return None
+        psig = tuple((str(np.asarray(p).dtype), np.asarray(p).shape)
+                     for p in plan.params)
+    except Exception:
+        return None
+    return (plan.program, padded, tuple(sig), psig)
 
 
 class TpuSegmentExecutor:
@@ -138,7 +231,8 @@ class TpuSegmentExecutor:
                 fused, lut_meta = "", ()
         # one entry per compiled executable family: padded shape and the
         # fused/lut variants each compile separately
-        _GUARD.note((plan.program, view.padded, fused, lut_meta))
+        _count_dispatch(_GUARD.note(
+            (plan.program, view.padded, fused, lut_meta)))
         try:
             outs = run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
@@ -180,10 +274,97 @@ class TpuSegmentExecutor:
         arrays, packed = plan.gather_arrays_packed(view)
         params = tuple(p if isinstance(p, (np.ndarray, np.generic))
                        else np.asarray(p) for p in plan.params)
-        _GUARD.note((plan.program, view.padded, "", ()))
+        _count_dispatch(_GUARD.note((plan.program, view.padded, "", ())))
         return run_program(plan.program, arrays, params,
                            np.int32(segment.num_docs), view.padded,
                            packed=packed, fused=""), view
+
+    def _gather_batch(self, segments: list, plans: list):
+        """Gather + stack a batch family's kernel inputs: per-member planes
+        come from the per-segment HBM cache (gather_arrays_packed — upload
+        happens at most once per plane), the [S, ...] stacks from the
+        cache's stacked-view layer (derived copies under the same byte
+        budget). Raises BatchFamilyMismatch if the members' gathered planes
+        disagree in dtype/shape/packing — the host-side family key should
+        prevent that; the check makes a drift fall back, not corrupt."""
+        views = [self.cache.view(s) for s in segments]
+        gathered = [pl.gather_arrays_packed(v)
+                    for pl, v in zip(plans, views)]
+        packed = gathered[0][1]
+        nslots = len(gathered[0][0])
+        for arrs, pk in gathered[1:]:
+            if pk != packed or len(arrs) != nslots:
+                raise BatchFamilyMismatch("packing/slot-count mismatch")
+        sview = self.cache.stacked_view(segments)
+        stacked = []
+        for i in range(nslots):
+            col = [g[0][i] for g in gathered]
+            if plans[0].slots[i][1] == "dict":
+                # dictionary sizes are segment-local: zero-pad every
+                # member's values plane to the family's shared power-of-two
+                # bucket (see _dict_pad — pads are never gathered)
+                target = _dict_pad(max(a.shape[0] for a in col))
+                col = [a if a.shape[0] == target
+                       else jnp.pad(a, (0, target - a.shape[0]))
+                       for a in col]
+            a0 = col[0]
+            if any(a.shape != a0.shape or a.dtype != a0.dtype
+                   for a in col[1:]):
+                raise BatchFamilyMismatch(
+                    f"slot {i} ({plans[0].slots[i]}): unequal plane "
+                    f"shapes/dtypes across family members")
+            pkey = (plans[0].slots[i], str(a0.dtype), tuple(a0.shape))
+            stacked.append(sview.plane(pkey, lambda c=tuple(col):
+                                       jnp.stack(c)))
+        nparams = len(plans[0].params)
+        if any(len(pl.params) != nparams for pl in plans):
+            raise BatchFamilyMismatch("param-count mismatch")
+        params_b = []
+        for j in range(nparams):
+            ps = [np.asarray(pl.params[j]) for pl in plans]
+            p0 = ps[0]
+            if any(p.shape != p0.shape or p.dtype != p0.dtype
+                   for p in ps[1:]):
+                raise BatchFamilyMismatch(f"param {j}: shape/dtype mismatch")
+            params_b.append(np.stack(ps))
+        num_docs = np.asarray([s.num_docs for s in segments],
+                              dtype=np.int32)
+        return views, tuple(stacked), tuple(params_b), packed, num_docs
+
+    def _dispatch_batch(self, segments: list, plans: list):
+        from ..ops.kernels import run_program_batch
+
+        views, arrays, params_b, packed, num_docs = self._gather_batch(
+            segments, plans)
+        plan0 = plans[0]
+        # batch compiles are keyed per FAMILY (program, bucket, slot sig,
+        # batch size) — the executable cache scales with families, not S
+        asig = tuple((str(a.dtype), tuple(a.shape)) for a in arrays)
+        _count_dispatch(_GUARD.note(
+            ("batch", plan0.program, views[0].padded, packed, asig,
+             len(segments))))
+        outs = run_program_batch(plan0.program, arrays, params_b, num_docs,
+                                 views[0].padded, packed=packed)
+        return outs, views
+
+    def dispatch_plan_batch(self, segments: list, plans: list):
+        """ONE vmapped device dispatch for a whole batch family (equal
+        batch_family_key). Returns a PackedOuts whose arrays carry a
+        leading [S] dim; the caller slices row s for member s and feeds the
+        slices through collect() unchanged — bit-for-bit what S separate
+        dispatch_plan(..., fused='') calls would return, for one launch and
+        one D2H transfer. Raises BatchFamilyMismatch to request the
+        per-segment fallback."""
+        outs, _ = self._dispatch_batch(segments, plans)
+        return pack_outputs(outs)
+
+    def dispatch_plan_batch_raw(self, segments: list, plans: list):
+        """dispatch_plan_batch without the flat-buffer packing: returns
+        (outs, views) with every output carrying a leading [S] dim, for
+        callers that keep computing on device (the batched sparse device
+        combine slices per-member rows lazily — the slices never leave
+        HBM)."""
+        return self._dispatch_batch(segments, plans)
 
     def collect(self, query: QueryContext, segment: ImmutableSegment,
                 plan: SegmentPlan, outs):

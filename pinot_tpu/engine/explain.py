@@ -128,6 +128,39 @@ def explain_plan(query, table, pruner, backend: str = "auto",
             impl = "host-dict-merge"
         add(f"SERVER_COMBINE(impl:{impl}, segments:{len(kept)})", cid)
 
+    if getattr(query, "explain", False) == "implementation" and \
+            backend != "host" and len(kept) > 1:
+        # stacked segment batching: families = device dispatches
+        # (query_executor._batch_families over the same host-side key the
+        # dispatcher groups by)
+        from .executor import batch_family_key
+
+        if str(query.query_options.get("segmentBatch")).lower() in (
+                "false", "0", "off"):
+            add("SEGMENT_BATCH(disabled)", cid)
+        else:
+            fams: set = set()
+            planned = 0
+            for seg in kept:
+                pq, ps = query, seg
+                if use_star_tree and getattr(
+                        seg, "valid_doc_ids", None) is None:
+                    from ..segment.startree import try_rewrite
+
+                    st = try_rewrite(query, seg)
+                    if st is not None:
+                        pq, ps = st.query, st.view
+                try:
+                    pl = SegmentPlanner(pq, ps).plan()
+                except UnsupportedQueryError:
+                    continue
+                fk = batch_family_key(ps, pl)
+                fams.add(fk if fk is not None else ("solo", id(ps)))
+                planned += 1
+            if planned:
+                add(f"SEGMENT_BATCH(families:{len(fams)}, "
+                    f"segments:{planned})", cid)
+
     for a in query.aggregations:
         # SQL-level functions; COUNT(*) answers from the shared per-group
         # count column and registers no primitive op of its own

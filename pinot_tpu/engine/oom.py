@@ -65,9 +65,12 @@ def is_hbm_oom(exc: BaseException) -> bool:
 def relieve_pressure(keep_segment=None, cache=None) -> int:
     """Evict every cached segment's device planes except the one currently
     executing (its uploads would just be redone), then nudge the runtime to
-    actually release the buffers. Returns bytes freed (host-side
-    estimate). ``cache`` defaults to the process-global device cache; pass
-    the executor's own cache when it uses a private one."""
+    actually release the buffers. Stacked [S, N] segment-batch views are
+    evicted wholesale first (evict_all_except drops every stack — they are
+    derived data, rebuildable from the per-segment planes). Returns bytes
+    freed (host-side estimate). ``cache`` defaults to the process-global
+    device cache; pass the executor's own cache when it uses a private
+    one."""
     import gc
 
     if cache is None:

@@ -862,18 +862,33 @@ class SegmentPlanner(AggPlanContext):
                     "un-grouped aggregation")
             exact_trim = False
             keys_presorted = False
-            if (sparse and len(group_exprs) == 1 and not any_derived
+            if (sparse and group_exprs and not any_derived
                     and mv_group_slot is None
-                    and group_exprs[0].is_identifier):
-                # sorted-key fast path: a single dict group key whose id
-                # plane is nondecreasing in doc order (sorted ingestion —
-                # ColumnMetadata.is_sorted) needs NO sort at all; the kernel
-                # reads group edges off the raw id plane (reference
-                # SortedGroupByOperator). Multi-key presorted detection
-                # (lexicographic co-sort) is a ROADMAP open item.
-                m = self._meta(group_exprs[0].identifier)
-                keys_presorted = bool(m.single_value
-                                      and getattr(m, "is_sorted", False))
+                    and all(e.is_identifier for e in group_exprs)):
+                # sorted-key fast path: group keys whose COMPOSITE id
+                # Σ id_i·stride_i is nondecreasing in doc order need NO
+                # sort at all; the kernel reads group edges off the id
+                # planes (reference SortedGroupByOperator).
+                #   single key  — the column's own dict-id plane is
+                #     nondecreasing (sorted ingestion, ColumnMetadata
+                #     .is_sorted);
+                #   composite — the keys are, IN ORDER, a prefix of the
+                #     segment's lexicographic co-sort chain
+                #     (SegmentMetadata.sort_order: leading key globally
+                #     sorted, later keys sorted within runs of the
+                #     prefix). Row-major strides make lexicographic
+                #     nondecreasing ids ⇒ nondecreasing composite.
+                metas = [self._meta(e.identifier) for e in group_exprs]
+                if all(m.single_value for m in metas):
+                    if len(group_exprs) == 1:
+                        keys_presorted = bool(
+                            getattr(metas[0], "is_sorted", False))
+                    else:
+                        so = list(getattr(
+                            getattr(self.segment, "metadata", None),
+                            "sort_order", None) or [])
+                        cols = [e.identifier for e in group_exprs]
+                        keys_presorted = so[:len(cols)] == cols
             if sparse and group_exprs:
                 # output capacity = numGroupsLimit: groups beyond it are
                 # trimmed on device (reference InstancePlanMakerImplV2:245-270)

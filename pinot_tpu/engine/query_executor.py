@@ -28,7 +28,9 @@ from .aggregation import UnsupportedQueryError, semantics_for
 from .combine import (combine_aggregation, combine_group_by,
                       combine_selection, trim_group_by)
 from ..ops.kernels import PackedOuts, fetch_packed_batch, unpack_outputs
-from .executor import TpuSegmentExecutor
+from .executor import (BatchFamilyMismatch, TpuSegmentExecutor,
+                       batch_family_key, dispatch_counters,
+                       reset_dispatch_counters)
 from .host_executor import HostSegmentExecutor
 from .oom import with_oom_retry
 from .pruner import SegmentPrunerService
@@ -209,6 +211,8 @@ class QueryExecutor:
             num_segments_pruned=stats["num_segments_pruned"],
             num_groups_limit_reached=getattr(combined, "groups_trimmed",
                                              False),
+            num_device_dispatches=stats.get("num_device_dispatches", 0),
+            num_compiles=stats.get("num_compiles", 0),
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
         if trace is not None:
@@ -250,6 +254,7 @@ class QueryExecutor:
         from ..segment.bitpack import unpack_bitmap
 
         names = [e.identifier for e in query.select_expressions]
+        reset_dispatch_counters()
         try:
             query.filter = optimize_filter(query.filter)
             kept, _ = self.pruner.prune(query, segments)
@@ -292,8 +297,11 @@ class QueryExecutor:
                 if any(p.dtype.kind == "O" for p in ps):
                     ps = [p.astype(object) for p in ps]
                 cols[c] = np.concatenate(ps)
+        num_dispatches, num_compiles = dispatch_counters()
         return cols, {"num_docs_scanned": scanned,
-                      "total_docs": sum(s.num_docs for s in segments)}
+                      "total_docs": sum(s.num_docs for s in segments),
+                      "num_device_dispatches": num_dispatches,
+                      "num_compiles": num_compiles}
 
     def execute_segments(self, query: QueryContext, segments: list, tracker=None):
         """Server-side half of a query: prune → per-segment execute →
@@ -312,6 +320,9 @@ class QueryExecutor:
         from ..query.optimizer import optimize_filter
 
         query.filter = optimize_filter(query.filter)
+        # per-query dispatch/compile counters (engine/executor.py): every
+        # device dispatch for this query happens on this thread
+        reset_dispatch_counters()
         # snapshot: realtime tables mutate the live list concurrently;
         # consuming segments pin a consistent row-count view per query
         segments = [s.snapshot_view() if getattr(s, "is_mutable", False) else s
@@ -325,15 +336,21 @@ class QueryExecutor:
         intermediates = self._run_segments(query, kept, tracker, deadline,
                                            timeout_ms)
         combined = self._combine(query, intermediates)
+        num_dispatches, num_compiles = dispatch_counters()
         SERVER_METRICS.add_meter(ServerMeter.QUERIES)
         SERVER_METRICS.add_meter(ServerMeter.NUM_DOCS_SCANNED,
                                  getattr(combined, "num_docs_scanned", 0))
         SERVER_METRICS.add_meter(ServerMeter.NUM_SEGMENTS_PROCESSED, len(kept))
         SERVER_METRICS.add_meter(ServerMeter.NUM_SEGMENTS_PRUNED, num_pruned)
+        SERVER_METRICS.add_meter(ServerMeter.NUM_DEVICE_DISPATCHES,
+                                 num_dispatches)
+        SERVER_METRICS.add_meter(ServerMeter.NUM_COMPILES, num_compiles)
         return combined, {
             "total_docs": total_docs,
             "num_segments_processed": len(kept),
             "num_segments_pruned": num_pruned,
+            "num_device_dispatches": num_dispatches,
+            "num_compiles": num_compiles,
         }
 
     def _run_segments(self, query: QueryContext, kept: list, tracker,
@@ -359,9 +376,10 @@ class QueryExecutor:
             if merged is not None:
                 return merged
 
-        pending: list = []  # (idx, run_query, segment, rewrite, plan, outs)
+        pending: list = []  # (idx, run_query, segment, rewrite, plan, token)
         host_work: list = []  # (idx, run_query, run_segment, rewrite)
         intermediates: list = [None] * len(kept)
+        device_entries: list = []  # (idx, run_query, run_segment, rewrite, plan)
         for idx, segment in enumerate(kept):
             check(idx)
             run_query, run_segment, rewrite = self._segment_route(query, segment)
@@ -371,19 +389,47 @@ class QueryExecutor:
                 host_work.append((idx, run_query, run_segment, rewrite))
                 continue
             try:
-                plan = self.tpu.plan(run_query, run_segment)
-                # HBM pressure during plane upload/dispatch: evict cold
-                # cached segments once and retry (engine/oom.py — the
-                # DirectOOMHandler analogue)
-                outs = with_oom_retry(
-                    lambda: self.tpu.dispatch_plan(run_segment, plan),
-                    keep_segment=run_segment, cache=self.tpu.cache)
+                device_entries.append((idx, run_query, run_segment, rewrite,
+                                       self.tpu.plan(run_query, run_segment)))
             except UnsupportedQueryError:
                 if self.backend == "tpu":
                     raise
                 host_work.append((idx, run_query, run_segment, rewrite))
-                continue
-            pending.append((idx, run_query, run_segment, rewrite, plan, outs))
+
+        # stacked segment batching: one vmapped dispatch per batch FAMILY
+        # (equal host-side family key → identical plane shapes), single-
+        # member families keep the per-segment path (incl. the fused
+        # kernel). Tokens mark family members: (family key, row in batch).
+        fam_packs: dict = {}    # fkey → batched PackedOuts
+        fam_inputs: dict = {}   # fkey → (segments, plans) for re-dispatch
+        for fkey, positions in self._batch_families(
+                query, [(e[2], e[4]) for e in device_entries]):
+            entries = [device_entries[p] for p in positions]
+            if fkey is not None and len(entries) > 1:
+                segs_f = [e[2] for e in entries]
+                plans_f = [e[4] for e in entries]
+                try:
+                    # HBM pressure during plane upload/dispatch: evict cold
+                    # cached segments once and retry (engine/oom.py — the
+                    # DirectOOMHandler analogue). Relief drops whole stacks.
+                    pack = with_oom_retry(
+                        lambda: self.tpu.dispatch_plan_batch(segs_f, plans_f),
+                        keep_segment=segs_f[0], cache=self.tpu.cache)
+                except BatchFamilyMismatch:
+                    pass  # host key over-grouped; per-segment is always valid
+                else:
+                    fam_packs[fkey] = pack
+                    fam_inputs[fkey] = (segs_f, plans_f)
+                    for row, e in enumerate(entries):
+                        pending.append(e + ((fkey, row),))
+                    continue
+            for e in entries:
+                idx, run_query, run_segment, rewrite, plan = e
+                outs = with_oom_retry(
+                    lambda: self.tpu.dispatch_plan(run_segment, plan),
+                    keep_segment=run_segment, cache=self.tpu.cache)
+                pending.append((idx, run_query, run_segment, rewrite, plan,
+                                outs))
 
         done = 0
         if self.num_threads > 1 and len(host_work) > 1:
@@ -422,23 +468,76 @@ class QueryExecutor:
             intermediates[idx] = (
                 self._remap_star_tree(rewrite, inter) if rewrite else inter)
             done += 1
-        if len(pending) > 1 and all(
-                isinstance(p[5], PackedOuts) for p in pending):
-            # ONE device→host transfer for the whole multi-segment batch
-            # (a tunneled device pays a fixed round trip per fetch)
+        solo = [p for p in pending if isinstance(p[5], PackedOuts)]
+        fam_keys = list(fam_packs)
+        if fam_keys or len(solo) > 1:
+            # ONE device→host transfer for the whole multi-segment batch —
+            # each batched family is already a single flat buffer, solo
+            # packs of equal length concat with it (a tunneled device pays
+            # a fixed round trip per fetch).
             # async dispatch means an in-flight OOM surfaces HERE on
             # error-poisoned buffers: the retry must RE-DISPATCH every
-            # pending segment after eviction, not re-fetch the dead outputs
+            # pending segment/family after eviction, not re-fetch the dead
+            # outputs
             def _refetch():
-                return fetch_packed_batch([
-                    self.tpu.dispatch_plan(p[2], p[4]) for p in pending])
+                packs = [self.tpu.dispatch_plan(p[2], p[4]) for p in solo]
+                packs += [self.tpu.dispatch_plan_batch(*fam_inputs[k])
+                          for k in fam_keys]
+                return fetch_packed_batch(packs)
 
             fetched = with_oom_retry(
-                lambda: fetch_packed_batch([p[5] for p in pending]),
+                lambda: fetch_packed_batch(
+                    [p[5] for p in solo] + [fam_packs[k] for k in fam_keys]),
                 cache=self.tpu.cache, retry_fn=_refetch)
-            pending = [p[:5] + (raw,) for p, raw in zip(pending, fetched)]
+            solo_outs = {id(p): raw for p, raw in zip(solo, fetched)}
+            fam_outs = dict(zip(fam_keys, fetched[len(solo):]))
+            # vectorized family combine (engine/combine.py): dense and
+            # un-grouped aggregation families decode all members in one
+            # pass over the batched arrays; other modes slice per member
+            # and ride the normal collect()
+            from .combine import (combine_batched_aggregation,
+                                  combine_batched_dense)
+
+            precomputed: dict = {}
+            for fkey in fam_keys:
+                members = [p for p in pending
+                           if not isinstance(p[5], PackedOuts)
+                           and p[5][0] == fkey]
+                plans_f = [p[4] for p in members]
+                mode = plans_f[0].program.mode
+                batched = None
+                if mode == "group_by":
+                    batched = combine_batched_dense(fam_outs[fkey], plans_f)
+                elif mode == "aggregation":
+                    batched = combine_batched_aggregation(
+                        fam_outs[fkey], plans_f)
+                if batched is not None:
+                    for row, inter in enumerate(batched):
+                        precomputed[(fkey, row)] = inter
+            new_pending = []
+            for p in pending:
+                tok = p[5]
+                if isinstance(tok, PackedOuts):
+                    new_pending.append(p[:5] + (solo_outs[id(p)],))
+                elif tok in precomputed:
+                    new_pending.append(p[:5] + (precomputed[tok],))
+                else:
+                    fkey, row = tok
+                    # zero-copy per-segment views of the batched [S, ...]
+                    # host arrays; collect() consumes them unchanged
+                    new_pending.append(
+                        p[:5] + ([o[row] for o in fam_outs[fkey]],))
+            pending = new_pending
         for idx, run_query, run_segment, rewrite, plan, outs in pending:
             check(done)
+            if isinstance(outs, (AggIntermediate, GroupByIntermediate)):
+                # vectorized family combine already decoded this member
+                inter = self._account(tracker, lambda o=outs: o, run_segment)
+                intermediates[idx] = (
+                    self._remap_star_tree(rewrite, inter) if rewrite
+                    else inter)
+                done += 1
+                continue
 
             def _recollect(run_query=run_query, run_segment=run_segment,
                            plan=plan):
@@ -458,6 +557,32 @@ class QueryExecutor:
                 self._remap_star_tree(rewrite, inter) if rewrite else inter)
             done += 1
         return intermediates
+
+    def _segment_batch_enabled(self, query: QueryContext) -> bool:
+        """Stacked segment batching is ON by default; SET segmentBatch =
+        false opts a query out (same spelling family as deviceCombine)."""
+        return str(query.query_options.get("segmentBatch")).lower() \
+            not in ("false", "0", "off")
+
+    def _batch_families(self, query: QueryContext, pairs: list) -> list:
+        """Group (segment, plan) pairs into batch families by the
+        host-side family key (engine/executor.py:batch_family_key).
+        Returns ordered (fkey, positions) groups; fkey is None for pairs
+        that can't batch (unpredictable slot shapes, or batching disabled)
+        — those take the per-segment path."""
+        if len(pairs) < 2 or not self._segment_batch_enabled(query):
+            return [(None, [i]) for i in range(len(pairs))]
+        groups: dict = {}
+        order: list = []
+        for pos, (segment, plan) in enumerate(pairs):
+            fkey = batch_family_key(segment, plan)
+            k = ("__solo__", pos) if fkey is None else fkey
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(pos)
+        return [(None if k[0] == "__solo__" else k, groups[k])
+                for k in order]
 
     # device merge ops per sparse AggOp kind (count columns merge like sums)
     _SPARSE_COMBINE_KINDS = {"count": "add", "sum": "add", "sumsq": "add",
@@ -520,10 +645,31 @@ class QueryExecutor:
                     and all(la.vec is not None for la in pl.lowered_aggs)):
                 return None
         try:
+            # one vmapped dispatch per batch family; members pull lazy
+            # device-side rows from the batched outputs (never fetched —
+            # the merged table below is the only D2H transfer)
+            member_outs: list = [None] * len(segs)
+            for fkey, positions in self._batch_families(
+                    query, list(zip(segs, plans))):
+                if fkey is not None and len(positions) > 1:
+                    try:
+                        outs_b, views_b = self.tpu.dispatch_plan_batch_raw(
+                            [segs[i] for i in positions],
+                            [plans[i] for i in positions])
+                    except BatchFamilyMismatch:
+                        pass
+                    else:
+                        for row, i in enumerate(positions):
+                            member_outs[i] = (
+                                tuple(o[row] for o in outs_b), views_b[row])
+                        continue
+                for i in positions:
+                    member_outs[i] = self.tpu.dispatch_plan_raw(
+                        segs[i], plans[i])
             seg_keys, seg_counts, seg_states = [], [], []
             for done, (segment, pl) in enumerate(zip(segs, plans)):
                 check(done)
-                outs, view = self.tpu.dispatch_plan_raw(segment, pl)
+                outs, view = member_outs[done]
                 seg_keys.append(kernels.ids_to_values_i64(
                     outs[-1], view.dict_values(pl.group_dims[0].column)))
                 seg_counts.append(outs[0])

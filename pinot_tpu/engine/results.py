@@ -52,6 +52,11 @@ class BrokerResponse:
     # MSE only: stage_id → {rows_in, rows_out, shuffled_rows,
     # shuffled_bytes, wall_ms, workers, leaf_pushdown}
     mse_stage_stats: Optional[dict] = None
+    # device launch accounting (engine/executor.py per-query counters):
+    # with stacked segment batching, dispatches scale with batch FAMILIES,
+    # not segments — these make the win visible per query
+    num_device_dispatches: int = 0
+    num_compiles: int = 0
 
     def to_json(self) -> dict:
         out = {
@@ -73,6 +78,9 @@ class BrokerResponse:
         if self.mse_stage_stats is not None:
             out["mseStageStats"] = {str(k): v for k, v in
                                     self.mse_stage_stats.items()}
+        if self.num_device_dispatches:
+            out["numDeviceDispatches"] = self.num_device_dispatches
+            out["numCompiles"] = self.num_compiles
         return out
 
 

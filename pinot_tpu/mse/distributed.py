@@ -496,7 +496,7 @@ class MseWorkerService:
             from ..query.filter import FilterContext
 
             out_rows, schema = [], None
-            scanned = total = 0
+            scanned = total = dispatches = compiles = 0
             for nwt, seg_names, extra in self._halves_for(halves, qc.table_name):
                 hosted = self.server.segments.get(nwt, {})
                 segs = [hosted[n] for n in seg_names if n in hosted]
@@ -512,6 +512,8 @@ class MseWorkerService:
                     q2, combined)
                 scanned += getattr(combined, "num_docs_scanned", 0)
                 total += stats.get("total_docs", 0)
+                dispatches += stats.get("num_device_dispatches", 0)
+                compiles += stats.get("num_compiles", 0)
                 if result is not None:
                     schema = schema or result.schema
                     out_rows.extend(result.rows)
@@ -519,7 +521,9 @@ class MseWorkerService:
 
             rt = ResultTable(schema, out_rows) if schema is not None else None
             return BrokerResponse(result_table=rt, num_docs_scanned=scanned,
-                                  total_docs=total)
+                                  total_docs=total,
+                                  num_device_dispatches=dispatches,
+                                  num_compiles=compiles)
 
         return execute_query
 
@@ -843,6 +847,7 @@ class DistributedMseDispatcher:
 
         stats_agg = {"num_docs_scanned": 0, "total_docs": 0,
                      "leaf_ssqe_pushdowns": 0, "stages": len(stages),
+                     "num_device_dispatches": 0, "num_compiles": 0,
                      "join_overflow": False, "num_groups_limit_reached": False}
         touched: set[str] = set()
 
@@ -889,7 +894,8 @@ class DistributedMseDispatcher:
             for f in futures:
                 st = f.result()
                 for k in ("num_docs_scanned", "total_docs",
-                          "leaf_ssqe_pushdowns"):
+                          "leaf_ssqe_pushdowns", "num_device_dispatches",
+                          "num_compiles"):
                     stats_agg[k] += st.get(k, 0)
                 stats_agg["join_overflow"] |= bool(st.get("join_overflow"))
                 stats_agg["num_groups_limit_reached"] |= bool(
@@ -920,6 +926,8 @@ class DistributedMseDispatcher:
                 total_docs=stats_agg["total_docs"],
                 partial_result=stats_agg["join_overflow"],
                 num_groups_limit_reached=stats_agg["num_groups_limit_reached"],
+                num_device_dispatches=stats_agg["num_device_dispatches"],
+                num_compiles=stats_agg["num_compiles"],
                 mse_stage_stats=stage_stats_agg)
         except Exception:
             # a failed worker must not hang its peers in receive/backpressure:

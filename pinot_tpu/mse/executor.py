@@ -134,6 +134,9 @@ class MultistageExecutor:
                 or bool(runner.stats.get("join_overflow")),
                 num_groups_limit_reached=runner.stats.get(
                     "num_groups_limit_reached", False),
+                num_device_dispatches=runner.stats.get(
+                    "num_device_dispatches", 0),
+                num_compiles=runner.stats.get("num_compiles", 0),
                 mse_stage_stats=runner.stage_stats,
                 time_used_ms=(time.perf_counter() - t0) * 1000)
         except Exception as e:

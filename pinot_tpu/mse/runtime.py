@@ -100,6 +100,7 @@ class StageRunner:
         self.mailbox = MailboxService()
         self.stats = {"stages": len(stages), "leaf_ssqe_pushdowns": 0,
                       "num_docs_scanned": 0, "total_docs": 0,
+                      "num_device_dispatches": 0, "num_compiles": 0,
                       "num_groups_limit_reached": False}
         # per-stage observability: stage_id → counters (rows in/out,
         # shuffled rows/bytes, wall time) — the attribution plane for
@@ -333,6 +334,8 @@ class StageRunner:
                         self.stats["num_docs_scanned"] += \
                             cstats.get("num_docs_scanned", 0)
                         self.stats["total_docs"] += cstats.get("total_docs", 0)
+                        for k in ("num_device_dispatches", "num_compiles"):
+                            self.stats[k] += cstats.get(k, 0)
                         self.stats["leaf_columnar"] = \
                             self.stats.get("leaf_columnar", 0) + 1
                         return {q: cols[unq[q]] for q in names}
@@ -377,6 +380,8 @@ class StageRunner:
             raise LeafError(f"leaf stage failed: {resp.exceptions}")
         self.stats["num_docs_scanned"] += resp.num_docs_scanned
         self.stats["total_docs"] += resp.total_docs
+        for k in ("num_device_dispatches", "num_compiles"):
+            self.stats[k] += getattr(resp, k, 0)
         if getattr(resp, "num_groups_limit_reached", False):
             self.stats["num_groups_limit_reached"] = True
         rt = resp.result_table

@@ -357,6 +357,30 @@ def run_program(program: ir.Program, arrays: tuple, params: tuple, num_docs, pad
     return _run_program_impl(program, arrays, params, num_docs, padded, row_offset)
 
 
+@partial(jax.jit, static_argnames=("program", "padded", "packed"))
+def run_program_batch(program: ir.Program, arrays: tuple, params: tuple,
+                      num_docs, padded: int, packed: tuple = ()):
+    """Execute one Program over a stacked FAMILY of segments in a single
+    dispatch: every plane in `arrays` and every param in `params` carries a
+    leading batch dim [S, ...] (one row per member segment) and `num_docs`
+    is an (S,) vector. The body is `jax.vmap` of the exact per-segment
+    implementation, so each output gains a leading S dim and row s is
+    bit-for-bit what `run_program(..., fused="")` would have produced for
+    member s — the host slices outputs per segment after one transfer.
+
+    Narrow packed planes widen via `_apply_packed` BEFORE the vmap
+    (elementwise astype is shape-agnostic), so stacks stay narrow in HBM.
+    The fused dense kernel is per-segment-only: batched families always
+    take the reference `_run_program_impl` path.
+    """
+    arrays = _apply_packed(arrays, packed)
+
+    def one(arrays_s, params_s, nd):
+        return _run_program_impl(program, arrays_s, params_s, nd, padded)
+
+    return jax.vmap(one)(arrays, params, num_docs)
+
+
 def _run_program_impl(program: ir.Program, arrays: tuple, params: tuple, num_docs, padded: int,
                       row_offset=0):
     n = padded

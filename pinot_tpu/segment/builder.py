@@ -107,9 +107,51 @@ class SegmentBuilder:
             end_time=end_t,
             creation_time_ms=int(time.time() * 1000),
             star_trees=star_tree_metas,
+            sort_order=self._compute_sort_order(writer, col_metas),
         )
         writer.write(meta)
         return out_dir
+
+    def _compute_sort_order(self, writer, col_metas) -> list:
+        """Ingestion-order metadata: the longest greedy chain of dict-
+        encoded SV columns whose dict ids are LEXICOGRAPHICALLY
+        nondecreasing over the rows — the leading column is globally
+        sorted, each later column is nondecreasing within every run of
+        equal chain-prefix values. Any prefix of the chain qualifies as
+        presorted composite group keys: with row-major strides the
+        composite id Σ id_i·stride_i is then nondecreasing, which is all
+        the sparse kernel's presorted fast path needs (engine/plan.py
+        keys_presorted, ops/kernels.py _presorted_sparse_tail)."""
+        names = [n for n, m in col_metas.items()
+                 if m.encoding == "DICT" and m.single_value]
+        ids_cache: dict[str, np.ndarray] = {}
+
+        def diff_of(n):
+            if n not in ids_cache:
+                m = col_metas[n]
+                ids = bitpack.unpack(
+                    writer.peek_buffer(f"{n}.fwd"), m.bits_per_value,
+                    m.total_number_of_entries)
+                ids_cache[n] = np.diff(ids.astype(np.int64))
+            return ids_cache[n]
+
+        chain: list[str] = []
+        new_run = None  # True where the chain prefix changes between rows
+        progress = True
+        while progress:
+            progress = False
+            for n in names:
+                if n in chain:
+                    continue
+                d = diff_of(n)
+                ok = bool(np.all(d >= 0)) if new_run is None \
+                    else bool(np.all((d >= 0) | new_run))
+                if ok:
+                    chain.append(n)
+                    new_run = (d != 0) if new_run is None \
+                        else (new_run | (d != 0))
+                    progress = True
+        return chain
 
     def _build_star_trees(self, writer, col_metas) -> list:
         """Pre-aggregated dense tables per star_tree_index_configs

@@ -176,6 +176,36 @@ class SegmentDeviceView:
         self._planes.clear()
 
 
+class StackedSegmentView:
+    """Device-resident [S, ...] planes stacked from a batch FAMILY of
+    same-bucket member views (engine/executor.py:dispatch_plan_batch).
+    Stacks are DERIVED data: each plane is a `jnp.stack` of the members'
+    cached per-segment planes, cached here so repeated queries over the
+    same family skip the device-side stack copies. They count against the
+    owning DeviceSegmentCache's byte budget and are evicted wholesale
+    under HBM pressure — rebuilding a stack only needs the (cheaper,
+    also-cached) member planes, so relief still converges."""
+
+    def __init__(self, key: tuple):
+        self.key = key  # tuple of member id(segment)s
+        self._planes: dict[tuple, jnp.ndarray] = {}
+
+    def plane(self, plane_key: tuple, build) -> jnp.ndarray:
+        # same local-reference discipline as SegmentDeviceView._put:
+        # OOM relief may clear _planes concurrently with readers
+        arr = self._planes.get(plane_key)
+        if arr is None:
+            arr = build()
+            self._planes[plane_key] = arr
+        return arr
+
+    def nbytes(self) -> int:
+        return sum(p.nbytes for p in self._planes.values())
+
+    def evict(self) -> None:
+        self._planes.clear()
+
+
 class DeviceSegmentCache:
     """Process-wide segment→device-view cache with byte-budget eviction
     (reference precedent: mmap'd segments stay resident until dropped)."""
@@ -185,8 +215,10 @@ class DeviceSegmentCache:
         self.device = device
         self._views: dict[int, SegmentDeviceView] = {}
         self._order: list[int] = []  # LRU
-        # guards _views/_order: concurrent queries share this cache, and
-        # OOM-relief eviction (engine/oom.py) races view()/_maybe_evict()
+        self._stacks: dict[tuple, StackedSegmentView] = {}
+        self._stack_order: list[tuple] = []  # LRU over stacked views
+        # guards _views/_order/_stacks: concurrent queries share this cache,
+        # and OOM-relief eviction (engine/oom.py) races view()/_maybe_evict()
         self._lock = threading.Lock()
 
     def view(self, segment: ImmutableSegment) -> SegmentDeviceView:
@@ -199,6 +231,19 @@ class DeviceSegmentCache:
             self._order.append(key)
             self._maybe_evict()
             return self._views[key]
+
+    def stacked_view(self, segments: list) -> StackedSegmentView:
+        """Get-or-create the stacked [S, ...] view for a batch family
+        (identified by its ordered member segments)."""
+        key = tuple(id(s) for s in segments)
+        with self._lock:
+            if key not in self._stacks:
+                self._stacks[key] = StackedSegmentView(key)
+            if key in self._stack_order:
+                self._stack_order.remove(key)
+            self._stack_order.append(key)
+            self._maybe_evict()
+            return self._stacks[key]
 
     def warm(self, segment: ImmutableSegment,
              columns: Optional[list] = None) -> int:
@@ -243,6 +288,10 @@ class DeviceSegmentCache:
                 v.evict()
             if key in self._order:
                 self._order.remove(key)
+            # any stack containing the dropped segment is stale
+            for skey in [k for k in self._stacks if key in k]:
+                self._stacks.pop(skey).evict()
+                self._stack_order.remove(skey)
 
     def evict_all_except(self, keep_segment=None) -> tuple[int, int]:
         """HBM-pressure relief (engine/oom.py): evict every cached view
@@ -250,6 +299,12 @@ class DeviceSegmentCache:
         keep_key = id(keep_segment) if keep_segment is not None else None
         freed = victims = 0
         with self._lock:
+            # stacks first: derived [S, N] copies, always safe to rebuild
+            for skey in list(self._stacks):
+                freed += self._stacks[skey].nbytes()
+                self._stacks.pop(skey).evict()
+                victims += 1
+            self._stack_order.clear()
             for key in list(self._views):
                 if key == keep_key:
                     continue
@@ -266,6 +321,13 @@ class DeviceSegmentCache:
         if self.budget_bytes is None:
             return
         total = sum(v.nbytes() for v in self._views.values())
+        total += sum(s.nbytes() for s in self._stacks.values())
+        # stacks evict first: they duplicate member planes, so dropping a
+        # stack frees bytes without costing a host→device re-upload
+        while total > self.budget_bytes and self._stack_order:
+            victim = self._stack_order.pop(0)
+            total -= self._stacks[victim].nbytes()
+            self._stacks.pop(victim).evict()
         while total > self.budget_bytes and len(self._order) > 1:
             victim = self._order.pop(0)
             total -= self._views[victim].nbytes()

@@ -111,6 +111,11 @@ class SegmentMetadata:
     crc: Optional[str] = None
     creation_time_ms: int = 0
     star_trees: list = field(default_factory=list)  # build_star_tree meta dicts
+    # ingestion-order metadata (builder._compute_sort_order): longest
+    # column chain whose dict ids are LEXICOGRAPHICALLY nondecreasing over
+    # the rows — any prefix of it qualifies as presorted composite group
+    # keys (engine/plan.py keys_presorted)
+    sort_order: list = field(default_factory=list)
 
     def to_json(self) -> dict:
         return {
@@ -126,6 +131,7 @@ class SegmentMetadata:
             "columns": {k: v.to_json() for k, v in self.columns.items()},
             "buffers": self.buffers,
             "starTrees": self.star_trees,
+            "sortOrder": self.sort_order,
         }
 
     @classmethod
@@ -143,6 +149,7 @@ class SegmentMetadata:
             columns={k: ColumnMetadata.from_json(v) for k, v in d.get("columns", {}).items()},
             buffers=d.get("buffers", {}),
             star_trees=d.get("starTrees", []),
+            sort_order=d.get("sortOrder", []),
         )
 
 

@@ -21,6 +21,8 @@ class ServerMeter:
     NUM_DOCS_SCANNED = "numDocsScanned"
     NUM_SEGMENTS_PROCESSED = "numSegmentsProcessed"
     NUM_SEGMENTS_PRUNED = "numSegmentsPruned"
+    NUM_DEVICE_DISPATCHES = "numDeviceDispatches"
+    NUM_COMPILES = "numCompiles"
     QUERY_EXECUTION_EXCEPTIONS = "queryExecutionExceptions"
     DELETED_SEGMENT_COUNT = "deletedSegmentCount"
     REALTIME_ROWS_CONSUMED = "realtimeRowsConsumed"

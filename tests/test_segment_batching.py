@@ -1,0 +1,198 @@
+"""Stacked segment batching (ISSUE 3): bit-parity oracle + structure guards.
+
+Two families of checks:
+
+  * PARITY — every cell of {COUNT, SUM, MIN, MAX, DISTINCTCOUNT, AVG} ×
+    {filter, no filter} over MIXED segment sizes spanning a pad-bucket
+    boundary (6000/9000/3000 rows straddle the 8192 bucket) must return
+    rows bit-for-bit equal to `SET segmentBatch = false` (per-segment
+    dispatch). Sparse group-by + device combine and plain selections ride
+    the same oracle.
+
+  * STRUCTURE — a multi-segment single-family query must execute with
+    exactly ONE device dispatch (was S), the compile guard must record one
+    family key (not S per-segment keys), mixed pad buckets must split into
+    exactly the predicted number of families, and EXPLAIN IMPLEMENTATION
+    must surface the SEGMENT_BATCH row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import executor as executor_mod
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "sb",
+    dimensions=[("k", "INT"), ("d", "INT")],
+    metrics=[("v", "LONG"), ("f", "DOUBLE")])
+
+N_KEYS = 40
+# 6000/3000 pad to the 8192 bucket, 9000 pads to 16384 — the fixture
+# deliberately straddles a bucket boundary so batching must mix stacked
+# and differently-shaped segments in one query
+MIXED_SIZES = [6000, 9000, 3000]
+
+NO_BATCH = "SET segmentBatch = false; "
+
+
+def _gen(rng, n):
+    return {
+        "k": rng.integers(0, N_KEYS, n).astype(np.int32),
+        "d": rng.integers(0, 16, n).astype(np.int32),
+        # 1000 possible values keeps every segment's v-dictionary inside
+        # the 1024 pad bucket regardless of segment size
+        "v": rng.integers(-500, 500, n).astype(np.int64),
+        "f": rng.normal(100.0, 25.0, n).astype(np.float64),
+    }
+
+
+@pytest.fixture(scope="module")
+def mixed(tmp_path_factory):
+    rng = np.random.default_rng(31)
+    d = tmp_path_factory.mktemp("sb_mixed")
+    segs = []
+    for i, n in enumerate(MIXED_SIZES):
+        SegmentBuilder(SCHEMA, segment_name=f"m{i}").build(
+            _gen(rng, n), d / f"m{i}")
+        segs.append(load_segment(d / f"m{i}"))
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(SCHEMA, segs)
+    return qe
+
+
+@pytest.fixture(scope="module")
+def uniform(tmp_path_factory):
+    """Four segments built from IDENTICAL rows: metadata (and therefore the
+    batch family key) is equal by construction — one family, guaranteed."""
+    rng = np.random.default_rng(77)
+    cols = _gen(rng, 2048)
+    d = tmp_path_factory.mktemp("sb_uniform")
+    segs = []
+    for i in range(4):
+        SegmentBuilder(SCHEMA, segment_name=f"u{i}").build(cols, d / f"u{i}")
+        segs.append(load_segment(d / f"u{i}"))
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(SCHEMA, segs)
+    return qe
+
+
+def _rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return resp.result_table.rows
+
+
+def _assert_parity(qe, sql):
+    batched = qe.execute_sql(sql)
+    solo = qe.execute_sql(NO_BATCH + sql)
+    # bit-for-bit: no tolerance, floats included — the batched kernel is a
+    # vmap of the exact per-segment impl and combines in segment order
+    assert _rows(batched) == _rows(solo), sql
+    assert batched.num_docs_scanned == solo.num_docs_scanned
+    return batched, solo
+
+
+MATRIX_SQL = ("SELECT k, COUNT(*), SUM(v), MIN(v), MAX(v), "
+              "DISTINCTCOUNT(d), AVG(v) FROM sb {where}"
+              "GROUP BY k ORDER BY k LIMIT 100000")
+
+
+@pytest.mark.parametrize("where", ["", "WHERE v > 100 AND d < 12 "],
+                         ids=["nofilter", "filter"])
+def test_groupby_matrix_parity(mixed, where):
+    _assert_parity(mixed, MATRIX_SQL.format(where=where))
+
+
+@pytest.mark.parametrize("where", ["", "WHERE v > 100 AND d < 12 "],
+                         ids=["nofilter", "filter"])
+def test_aggregation_only_parity(mixed, where):
+    _assert_parity(
+        mixed, "SELECT COUNT(*), SUM(v), MIN(v), MAX(v), "
+               f"DISTINCTCOUNT(d), AVG(f), SUM(f) FROM sb {where}")
+
+
+def test_sparse_groupby_device_combine_parity(mixed):
+    # the batched RAW dispatch must feed the device-side sparse combine the
+    # same per-segment tables, in the same merge order, as solo dispatch
+    for where in ("", "WHERE v > 100 "):
+        _assert_parity(
+            mixed, "SET sparseGroupBy = true; "
+                   "SELECT k, COUNT(*), SUM(v), DISTINCTCOUNT(d) FROM sb "
+                   f"{where}GROUP BY k ORDER BY k LIMIT 100000")
+
+
+def test_selection_parity(mixed):
+    _assert_parity(
+        mixed, "SELECT k, d, v FROM sb WHERE v > 250 LIMIT 50")
+
+
+def test_double_sum_parity(mixed):
+    _assert_parity(
+        mixed, "SELECT k, SUM(f), AVG(f) FROM sb "
+               "GROUP BY k ORDER BY k LIMIT 1000")
+
+
+# -- structure guards --------------------------------------------------------
+
+STRUCT_SQL = "SELECT k, SUM(v), COUNT(*) FROM sb GROUP BY k ORDER BY k LIMIT 1000"
+
+
+def test_single_family_is_one_dispatch(uniform):
+    batched = uniform.execute_sql(STRUCT_SQL)
+    solo = uniform.execute_sql(NO_BATCH + STRUCT_SQL)
+    assert _rows(batched) == _rows(solo)
+    # the tentpole: 4 identical segments = 1 family = 1 device dispatch
+    assert batched.num_device_dispatches == 1
+    assert solo.num_device_dispatches == 4
+
+
+def test_steady_state_has_zero_compiles(uniform):
+    uniform.execute_sql(STRUCT_SQL)  # warm the compile guard
+    again = uniform.execute_sql(STRUCT_SQL)
+    assert not again.exceptions
+    assert again.num_device_dispatches == 1
+    assert again.num_compiles == 0
+
+
+def test_compile_guard_records_one_family_not_s(uniform, monkeypatch):
+    guard = executor_mod._CompileCacheGuard()
+    monkeypatch.setattr(executor_mod, "_GUARD", guard)
+    resp = uniform.execute_sql(STRUCT_SQL)
+    assert not resp.exceptions
+    # one guard entry for the whole 4-segment query — the batched key, with
+    # the batch size as its trailing component — NOT one entry per segment
+    assert len(guard._seen) == 1
+    (key,) = guard._seen
+    assert key[0] == "batch"
+    assert key[-1] == 4
+
+
+def test_mixed_buckets_split_into_two_families(mixed):
+    batched = mixed.execute_sql(STRUCT_SQL)
+    solo = mixed.execute_sql(NO_BATCH + STRUCT_SQL)
+    assert _rows(batched) == _rows(solo)
+    # 6000+3000 share the 8192 pad bucket; 9000 pads to 16384: 2 families
+    assert batched.num_device_dispatches == 2
+    assert solo.num_device_dispatches == 3
+
+
+def test_explain_implementation_shows_segment_batch(uniform):
+    r = uniform.execute_sql("EXPLAIN IMPLEMENTATION FOR " + STRUCT_SQL)
+    ops = [row[0] for row in _rows(r)]
+    assert any(op == "SEGMENT_BATCH(families:1, segments:4)" for op in ops), ops
+    r2 = uniform.execute_sql(
+        NO_BATCH + "EXPLAIN IMPLEMENTATION FOR " + STRUCT_SQL)
+    ops2 = [row[0] for row in _rows(r2)]
+    assert any(op == "SEGMENT_BATCH(disabled)" for op in ops2), ops2
+
+
+def test_counters_surface_in_json(uniform):
+    r = uniform.execute_sql(STRUCT_SQL)
+    j = r.to_json()
+    assert j["numDeviceDispatches"] == 1
+    assert "numCompiles" in j
