@@ -32,7 +32,7 @@ from .executor import (BatchFamilyMismatch, TpuSegmentExecutor,
                        batch_family_key, dispatch_counters,
                        reset_dispatch_counters)
 from .host_executor import HostSegmentExecutor
-from .oom import with_oom_retry
+from .oom import HbmExhaustedError, with_oom_retry
 from .pruner import SegmentPrunerService
 from .reduce import BrokerReducer
 from .results import (
@@ -417,6 +417,12 @@ class QueryExecutor:
                         keep_segment=segs_f[0], cache=self.tpu.cache)
                 except BatchFamilyMismatch:
                     pass  # host key over-grouped; per-segment is always valid
+                except HbmExhaustedError:
+                    # the [S, N] stacks ~double the family's footprint, so a
+                    # family that fits per-segment can OOM batched even after
+                    # relief — fall back rather than fail a query the 1x
+                    # per-segment path (below, with its own retry) completes
+                    pass
                 else:
                     fam_packs[fkey] = pack
                     fam_inputs[fkey] = (segs_f, plans_f)
@@ -653,10 +659,18 @@ class QueryExecutor:
                     query, list(zip(segs, plans))):
                 if fkey is not None and len(positions) > 1:
                     try:
-                        outs_b, views_b = self.tpu.dispatch_plan_batch_raw(
-                            [segs[i] for i in positions],
-                            [plans[i] for i in positions])
-                    except BatchFamilyMismatch:
+                        # same batched-OOM discipline as _run_segments: a
+                        # transient OOM gets one eviction+retry, a persistent
+                        # one (or a family-key drift) falls back to the 1x-
+                        # footprint per-segment dispatch loop below instead
+                        # of abandoning the device combine entirely
+                        outs_b, views_b = with_oom_retry(
+                            lambda: self.tpu.dispatch_plan_batch_raw(
+                                [segs[i] for i in positions],
+                                [plans[i] for i in positions]),
+                            keep_segment=segs[positions[0]],
+                            cache=self.tpu.cache)
+                    except (BatchFamilyMismatch, HbmExhaustedError):
                         pass
                     else:
                         for row, i in enumerate(positions):

@@ -170,7 +170,10 @@ class SegmentDeviceView:
         return self._put(key, out)
 
     def nbytes(self) -> int:
-        return sum(p.nbytes for p in self._planes.values())
+        # snapshot: _put inserts without the cache lock, and the budget
+        # accounting iterates here under it — iterate a copied list so a
+        # concurrent insert can't raise "dict changed size during iteration"
+        return sum(p.nbytes for p in list(self._planes.values()))
 
     def evict(self) -> None:
         self._planes.clear()
@@ -200,7 +203,9 @@ class StackedSegmentView:
         return arr
 
     def nbytes(self) -> int:
-        return sum(p.nbytes for p in self._planes.values())
+        # same snapshot discipline as SegmentDeviceView.nbytes: plane()
+        # mutates _planes lock-free on every batched gather
+        return sum(p.nbytes for p in list(self._planes.values()))
 
     def evict(self) -> None:
         self._planes.clear()
@@ -234,16 +239,25 @@ class DeviceSegmentCache:
 
     def stacked_view(self, segments: list) -> StackedSegmentView:
         """Get-or-create the stacked [S, ...] view for a batch family
-        (identified by its ordered member segments)."""
+        (identified by its ordered member segments). Families containing a
+        realtime snapshot view get an UNCACHED view: snapshot objects are
+        fresh per query, so an id()-keyed cache entry could never be hit
+        again and would only pin dead HBM bytes until eviction."""
         key = tuple(id(s) for s in segments)
+        if any(getattr(s, "is_mutable", False) for s in segments):
+            return StackedSegmentView(key)
         with self._lock:
-            if key not in self._stacks:
-                self._stacks[key] = StackedSegmentView(key)
+            sv = self._stacks.get(key)
+            if sv is None:
+                sv = self._stacks[key] = StackedSegmentView(key)
             if key in self._stack_order:
                 self._stack_order.remove(key)
             self._stack_order.append(key)
+            # _maybe_evict never drops the just-touched (last-ordered)
+            # stack, and sv is a local reference regardless — the return
+            # cannot KeyError under budget pressure
             self._maybe_evict()
-            return self._stacks[key]
+            return sv
 
     def warm(self, segment: ImmutableSegment,
              columns: Optional[list] = None) -> int:
@@ -323,8 +337,10 @@ class DeviceSegmentCache:
         total = sum(v.nbytes() for v in self._views.values())
         total += sum(s.nbytes() for s in self._stacks.values())
         # stacks evict first: they duplicate member planes, so dropping a
-        # stack frees bytes without costing a host→device re-upload
-        while total > self.budget_bytes and self._stack_order:
+        # stack frees bytes without costing a host→device re-upload. Like
+        # the views loop below, the most-recently-touched entry survives —
+        # stacked_view() must not lose the stack it just registered.
+        while total > self.budget_bytes and len(self._stack_order) > 1:
             victim = self._stack_order.pop(0)
             total -= self._stacks[victim].nbytes()
             self._stacks.pop(victim).evict()

@@ -196,3 +196,66 @@ def test_counters_surface_in_json(uniform):
     j = r.to_json()
     assert j["numDeviceDispatches"] == 1
     assert "numCompiles" in j
+
+
+# -- cache/OOM regression guards ---------------------------------------------
+
+
+class _FakeSeg:
+    num_docs = 100
+
+
+class _FakeSnap:
+    num_docs = 100
+    is_mutable = True
+
+
+def test_stacked_view_survives_budget_pressure():
+    # regression: with segment views alone over budget, registering a new
+    # stack used to drain _stack_order (the fresh 0-byte stack included)
+    # and then KeyError on the return read
+    from pinot_tpu.segment.device_cache import DeviceSegmentCache
+
+    cache = DeviceSegmentCache(budget_bytes=16)
+    s1, s2 = _FakeSeg(), _FakeSeg()
+    v1 = cache.view(s1)
+    v1._planes[("c", "ids")] = np.zeros(64, np.int32)  # 256 bytes > budget
+    sv = cache.stacked_view([s1, s2])
+    # the just-registered stack must survive the same-call eviction pass
+    assert cache.stacked_view([s1, s2]) is sv
+
+
+def test_snapshot_members_skip_stack_cache():
+    # stacks are keyed by member id(); realtime snapshot views are fresh
+    # objects per query, so caching them would only pin dead HBM bytes
+    from pinot_tpu.segment.device_cache import DeviceSegmentCache
+
+    cache = DeviceSegmentCache()
+    imm, snap = _FakeSeg(), _FakeSnap()
+    sv1 = cache.stacked_view([imm, snap])
+    sv2 = cache.stacked_view([imm, snap])
+    assert sv1 is not sv2
+    assert not cache._stacks and not cache._stack_order
+
+
+def test_batched_oom_falls_back_to_per_segment(uniform, monkeypatch):
+    # a family near HBM capacity can OOM batched (2x footprint) yet fit
+    # per-segment — the dispatcher must fall back, not fail the query
+    def boom(*a, **k):
+        raise MemoryError("fake HBM OOM")
+
+    monkeypatch.setattr(uniform.tpu, "dispatch_plan_batch", boom)
+    resp = uniform.execute_sql(STRUCT_SQL)
+    assert _rows(resp) == _rows(uniform.execute_sql(NO_BATCH + STRUCT_SQL))
+    assert resp.num_device_dispatches == 4  # per-segment path ran
+
+
+def test_sparse_combine_batched_oom_falls_back(mixed, monkeypatch):
+    def boom(*a, **k):
+        raise MemoryError("fake HBM OOM")
+
+    monkeypatch.setattr(mixed.tpu, "dispatch_plan_batch_raw", boom)
+    _assert_parity(
+        mixed, "SET sparseGroupBy = true; "
+               "SELECT k, COUNT(*), SUM(v) FROM sb "
+               "GROUP BY k ORDER BY k LIMIT 100000")
