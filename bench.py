@@ -747,6 +747,19 @@ def run_single(cfg: str, outpath: str):
     p50 = float(np.median(times))
     rtt = _measure_rtt(jax) if platform != "cpu" else 0.0
 
+    # one traced run OUTSIDE the timed loop (tracing blocks on every
+    # family dispatch to split compile vs device-execute, so it must not
+    # pollute p50): per-phase attribution for the BENCH json
+    phases = None
+    try:
+        rt = tpu.execute_sql("SET trace = true; " + sql)
+        if not rt.exceptions and rt.trace_info:
+            from pinot_tpu.spi.trace import phase_breakdown
+
+            phases = phase_breakdown(rt.trace_info)
+    except Exception:
+        pass  # tracing is diagnostics; never fail the bench numbers
+
     # host baseline: the FIRST run is bounded by the remaining deadline —
     # an unbounded host run on a slow/fallback platform would blow the
     # child's share and make the parent abandon every later config (the
@@ -818,6 +831,11 @@ def run_single(cfg: str, outpath: str):
     }
     if note:
         payload["note"] = note
+    if phases is not None:
+        # compileMs/deviceExecMs/transferBytes sum the family_dispatch
+        # span attributes; hostCombineMs sums the SERVER_COMBINE +
+        # BROKER_REDUCE spans (see pinot_tpu/spi/trace.py:phase_breakdown)
+        payload["phases"] = phases
     stage_stats = getattr(r, "mse_stage_stats", None)
     if stage_stats:
         # per-stage attribution (rows in/out, shuffled bytes, wall) from

@@ -186,8 +186,18 @@ class Broker:
         resp = self._execute_sql_impl(sql, segments)
         if not getattr(resp, "time_used_ms", 0):
             resp.time_used_ms = (time.perf_counter() - t0) * 1000
-        self.query_logger.log(sql, resp,
-                              table=getattr(resp, "_log_table", ""))
+        # broker-side end-to-end latency histogram — the p50/p95/p99
+        # behind the broker's GET /metrics
+        from ..spi.metrics import BROKER_METRICS, BrokerTimer
+
+        BROKER_METRICS.update_timer(BrokerTimer.QUERY_PROCESSING_TIME_MS,
+                                    resp.time_used_ms)
+        table = getattr(resp, "_log_table", "")
+        if table:
+            from ..spi.metrics import BrokerMeter
+
+            BROKER_METRICS.add_table_meter(table, BrokerMeter.QUERIES)
+        self.query_logger.log(sql, resp, table=table)
         return resp
 
     def _execute_sql_impl(self, sql: str,
@@ -377,19 +387,47 @@ class Broker:
         schema_json = self.store.get(f"/SCHEMAS/{raw}")
         schema = Schema.from_json(schema_json) if schema_json else None
 
+        # trace option: the broker owns the root trace; each server ships
+        # its own span list back next to the datatable and they are merged
+        # (ids namespaced per instance) into one response trace_info
+        from ..spi.trace import TRACING
+
+        trace = None
+        if query.query_options.get("trace") in (True, "true", 1) \
+                and TRACING.active_trace() is None:
+            trace = TRACING.start_trace(f"broker:{raw}")
+
         all_results = []
         stats_sum = {"total_docs": 0, "num_segments_processed": 0,
-                     "num_segments_pruned": 0, "num_segments_queried": 0}
-        for name_with_type, extra_filter in halves:
-            sub = _with_filter(query, name_with_type, extra_filter)
-            results = self._scatter_gather(
-                name_with_type, sub, stats_sum,
-                only_segments=(only_segments or {}).get(name_with_type))
-            all_results.extend(results)
+                     "num_segments_pruned": 0, "num_segments_queried": 0,
+                     "server_traces": []}
+        try:
+            for name_with_type, extra_filter in halves:
+                sub = _with_filter(query, name_with_type, extra_filter)
+                results = self._scatter_gather(
+                    name_with_type, sub, stats_sum,
+                    only_segments=(only_segments or {}).get(name_with_type))
+                all_results.extend(results)
 
-        combined = self._merge(query, all_results)
-        result = BrokerReducer(schema).reduce(query, combined)
-        return BrokerResponse(
+            with TRACING.scope("BROKER_REDUCE"):
+                combined = self._merge(query, all_results)
+                result = BrokerReducer(schema).reduce(query, combined)
+        finally:
+            if trace is not None:
+                TRACING.end_trace()
+        trace_info = None
+        if trace is not None:
+            trace_info = trace.to_json()
+            for inst, server_spans in stats_sum["server_traces"]:
+                for s in server_spans:
+                    s = dict(s)
+                    s["spanId"] = f"{inst}:{s['spanId']}"
+                    if s.get("parentId") is not None:
+                        s["parentId"] = f"{inst}:{s['parentId']}"
+                    else:
+                        s["server"] = inst
+                    trace_info.append(s)
+        resp = BrokerResponse(
             result_table=result,
             num_docs_scanned=getattr(combined, "num_docs_scanned", 0),
             total_docs=stats_sum["total_docs"],
@@ -399,6 +437,9 @@ class Broker:
             num_groups_limit_reached=getattr(combined, "groups_trimmed",
                                              False),
         )
+        if trace_info is not None:
+            resp.trace_info = trace_info
+        return resp
 
     def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict,
                         only_segments: Optional[list] = None):
@@ -410,7 +451,8 @@ class Broker:
         last: Exception | None = None
         for _ in range(3):
             local = {"total_docs": 0, "num_segments_processed": 0,
-                     "num_segments_pruned": 0, "num_segments_queried": 0}
+                     "num_segments_pruned": 0, "num_segments_queried": 0,
+                     "server_traces": []}
             try:
                 results = self._scatter_gather_once(
                     table, query, local, only_segments)
@@ -418,7 +460,10 @@ class Broker:
                 last = e
                 continue
             for k, v in local.items():
-                stats_sum[k] += v
+                if isinstance(v, list):
+                    stats_sum.setdefault(k, []).extend(v)
+                else:
+                    stats_sum[k] += v
             return results
         raise RuntimeError(f"routing kept changing mid-query: {last}")
 
@@ -487,6 +532,9 @@ class Broker:
         def absorb(inst, r, missing_sink):
             combined, st = decode(r["datatable"])
             combineds.append(combined)
+            if r.get("trace"):
+                stats_sum.setdefault("server_traces", []).append(
+                    (inst, r["trace"]))
             stats_sum["total_docs"] += st["total_docs"]
             stats_sum["num_segments_processed"] += st["num_segments_processed"]
             stats_sum["num_segments_pruned"] += st["num_segments_pruned"]

@@ -11,18 +11,30 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import threading
 import time
+from collections import deque
 
 logger = logging.getLogger("pinot_tpu.querylog")
 
 
 class QueryLogger:
-    """Token-bucket-throttled per-query log (default 10 lines/s)."""
+    """Token-bucket-throttled per-query log (default 10 lines/s), plus a
+    slow-query ring buffer: every completed query over
+    ``slow_threshold_ms`` (PINOT_TPU_SLOW_QUERY_MS, default 500) is kept —
+    with its full phase breakdown when it ran traced — in a bounded deque
+    served by the broker's GET /debug/queries. The slow capture is NOT
+    throttled: the worst queries are exactly the ones a drop would hide."""
 
-    def __init__(self, max_lines_per_s: float = 10.0, max_sql_len: int = 200):
+    def __init__(self, max_lines_per_s: float = 10.0, max_sql_len: int = 200,
+                 slow_threshold_ms: float = None, slow_buffer_size: int = 50):
         self.rate = float(max_lines_per_s)
         self.max_sql_len = max_sql_len
+        self.slow_threshold_ms = float(
+            os.environ.get("PINOT_TPU_SLOW_QUERY_MS", 500.0)
+            if slow_threshold_ms is None else slow_threshold_ms)
+        self._slow: deque = deque(maxlen=slow_buffer_size)
         # cap ≥ 1.0: with a sub-1 rate a rate-sized cap could never reach
         # one token and the logger would be permanently, silently mute
         self._cap = max(self.rate, 1.0)
@@ -32,8 +44,43 @@ class QueryLogger:
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
 
+    def _note_slow(self, rid: int, sql: str, response, table: str) -> None:
+        time_ms = getattr(response, "time_used_ms", 0) or 0
+        if time_ms < self.slow_threshold_ms:
+            return
+        sql_part = sql if len(sql) <= self.max_sql_len else \
+            sql[: self.max_sql_len] + "..."
+        entry = {
+            "requestId": rid,
+            "table": table,
+            "timeMs": round(time_ms, 3),
+            "docsScanned": getattr(response, "num_docs_scanned", 0),
+            "segmentsQueried": getattr(response, "num_segments_queried", 0),
+            "numDeviceDispatches": getattr(response,
+                                           "num_device_dispatches", 0),
+            "numCompiles": getattr(response, "num_compiles", 0),
+            "exceptions": len(getattr(response, "exceptions", []) or []),
+            "timestamp": time.time(),
+            "sql": sql_part,
+        }
+        trace_info = getattr(response, "trace_info", None)
+        if trace_info:
+            from ..spi.trace import phase_breakdown
+
+            entry["phases"] = phase_breakdown(trace_info)
+            entry["trace"] = trace_info
+        with self._lock:
+            self._slow.append(entry)
+
+    def slow_queries(self) -> list:
+        """Ring contents, worst (slowest) first."""
+        with self._lock:
+            entries = list(self._slow)
+        return sorted(entries, key=lambda e: -e["timeMs"])
+
     def log(self, sql: str, response, table: str = "") -> None:
         rid = next(self._ids)
+        self._note_slow(rid, sql, response, table)
         with self._lock:
             now = time.monotonic()
             self._tokens = min(self._cap, self._tokens

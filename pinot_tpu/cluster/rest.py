@@ -65,6 +65,11 @@ class RawHtml(str):
     """Marker: a handler returning this gets text/html instead of JSON."""
 
 
+class RawText(str):
+    """Marker: a handler returning this gets Prometheus text exposition
+    content-type instead of JSON (the /metrics routes)."""
+
+
 class _JsonHandler(BaseHTTPRequestHandler):
     routes_get: list = []
     routes_post: list = []
@@ -77,6 +82,11 @@ class _JsonHandler(BaseHTTPRequestHandler):
         if isinstance(payload, RawHtml):
             body = str(payload).encode("utf-8")
             ctype = "text/html; charset=utf-8"
+        elif isinstance(payload, RawText):
+            from ..spi.metrics import PROMETHEUS_CONTENT_TYPE
+
+            body = str(payload).encode("utf-8")
+            ctype = PROMETHEUS_CONTENT_TYPE
         else:
             body = json.dumps(payload).encode("utf-8")
             ctype = "application/json"
@@ -103,11 +113,12 @@ class _JsonHandler(BaseHTTPRequestHandler):
         self.principal = None
         routes = [r if len(r) == 3 else (r[0], r[1], access_type)
                   for r in routes]
-        # health endpoints (incl. /health/liveness, /health/readiness) are
-        # auth-exempt: orchestrator probes carry no credentials (reference:
-        # health resources sit outside the auth filter)
+        # health + metrics endpoints (incl. /health/liveness, /health/
+        # readiness) are auth-exempt: orchestrator probes and Prometheus
+        # scrapers carry no credentials (reference: health resources sit
+        # outside the auth filter)
         if ac is not None and not isinstance(ac, AllowAllAccessControl) \
-                and parsed.path != "/health" \
+                and parsed.path not in ("/health", "/metrics") \
                 and not parsed.path.startswith("/health/"):
             self.principal = ac.authenticate(self.headers)
             if self.principal is None:
@@ -184,6 +195,8 @@ class BrokerRestServer(_RestServer):
         class Handler(_JsonHandler):
             routes_get = [
                 (r"/health", lambda h, m, q: (200, {"status": "OK"})),
+                (r"/metrics", lambda h, m, q: srv._metrics()),
+                (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
                 # cursor ids are not table names: no group-based table check
                 (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
                     m.group(1), int(q.get("offset", ["0"])[0]),
@@ -210,6 +223,18 @@ class BrokerRestServer(_RestServer):
         # entries are owner-scoped); only the creator may fetch/delete
         self._cursor_owners = {}
         super().__init__(Handler, host, port)
+
+    def _metrics(self):
+        from ..spi.metrics import BROKER_METRICS, render_prometheus
+
+        return 200, RawText(render_prometheus(BROKER_METRICS, role="broker"))
+
+    def _debug_queries(self):
+        """Slow-query ring buffer (worst traced queries over the
+        threshold), fed by QueryLogger on every broker return path."""
+        ql = self.broker.query_logger
+        return 200, {"slowThresholdMs": ql.slow_threshold_ms,
+                     "slowQueries": ql.slow_queries()}
 
     def _query(self, body: dict, principal=None):
         sql = body.get("sql")
@@ -294,6 +319,7 @@ class ControllerRestServer(_RestServer):
         class Handler(_JsonHandler):
             routes_get = [
                 (r"/health", lambda h, m, q: (200, {"status": "OK"})),
+                (r"/metrics", lambda h, m, q: srv._metrics()),
                 (r"/tables", lambda h, m, q: srv._list_tables()),
                 (r"/tables/([^/]+)", lambda h, m, q: srv._get_table(m.group(1))),
                 (r"/schemas/([^/]+)", lambda h, m, q: srv._get_schema(m.group(1))),
@@ -336,6 +362,12 @@ class ControllerRestServer(_RestServer):
         Handler.access_control = access_control
         self.controller = controller
         super().__init__(Handler, host, port)
+
+    def _metrics(self):
+        from ..spi.metrics import CONTROLLER_METRICS, render_prometheus
+
+        return 200, RawText(
+            render_prometheus(CONTROLLER_METRICS, role="controller"))
 
     def _list_tables(self):
         return 200, {"tables": self.controller.store.children("/CONFIGS/TABLE")}
@@ -468,6 +500,7 @@ class ServerRestServer(_RestServer):
             routes_get = [
                 (r"/health/liveness", lambda h, m, q: (200, {"status": "OK"})),
                 (r"/health(/readiness)?", lambda h, m, q: srv._readiness()),
+                (r"/metrics", lambda h, m, q: srv._metrics()),
                 (r"/instance", lambda h, m, q: srv._instance()),
                 (r"/tables", lambda h, m, q: (200, {
                     "tables": sorted(srv.server.segments)})),
@@ -490,6 +523,11 @@ class ServerRestServer(_RestServer):
         Handler.access_control = access_control
         self.server = server
         super().__init__(Handler, host, port)
+
+    def _metrics(self):
+        from ..spi.metrics import SERVER_METRICS, render_prometheus
+
+        return 200, RawText(render_prometheus(SERVER_METRICS, role="server"))
 
     def _readiness(self):
         """Readiness gates on Helix join + converged state (reference:

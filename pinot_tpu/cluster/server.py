@@ -228,13 +228,30 @@ class ServerInstance:
         def run(tracker):
             return self.executor.execute_segments(query, segs, tracker=tracker)
 
-        combined, stats = self.scheduler.submit(run, group=table)
+        # trace option: the server owns a trace for its shard of the query
+        # (scheduler.submit runs `run` on this thread, so the thread-local
+        # trace covers execute_segments and its family dispatches); the span
+        # list rides back next to the datatable for the broker to merge
+        from ..spi.trace import TRACING
+
+        trace = None
+        if query.query_options.get("trace") in (True, "true", 1) \
+                and TRACING.active_trace() is None:
+            trace = TRACING.start_trace(f"server:{self.instance_id}")
+        try:
+            combined, stats = self.scheduler.submit(run, group=table)
+        finally:
+            if trace is not None:
+                TRACING.end_trace()
         stats["missing_segments"] = missing
         # intermediates travel as the versioned binary DataTable, not as
         # pickled Python objects (reference: DataTableImplV4 on the wire)
         from .datatable import encode
 
-        return {"datatable": encode(combined, stats)}
+        out = {"datatable": encode(combined, stats)}
+        if trace is not None:
+            out["trace"] = trace.to_json()
+        return out
 
     def _handle_scan_arrow(self, request):
         """Direct Arrow IPC segment read for external engines — straight

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 
 import jax
 import numpy as np
@@ -20,8 +21,15 @@ import jax.numpy as jnp
 
 from ..ops.kernels import PackedOuts, pack_outputs, run_program, unpack_outputs
 from ..query.context import QueryContext
-from ..segment.device_cache import GLOBAL_DEVICE_CACHE, DeviceSegmentCache
+from ..segment.device_cache import (
+    GLOBAL_DEVICE_CACHE,
+    DeviceSegmentCache,
+    clear_transfer_stats,
+    reset_transfer_stats,
+    transfer_stats,
+)
 from ..segment.loader import ImmutableSegment
+from ..spi.trace import TRACING
 from .plan import SegmentPlan, SegmentPlanner
 from .results import (
     AggIntermediate,
@@ -108,6 +116,20 @@ def _count_dispatch(new_compile: bool) -> None:
         c[0] += 1
         if new_compile:
             c[1] += 1
+
+
+def _attach_dispatch_stats(span, cache: DeviceSegmentCache) -> None:
+    """Fold the thread-local transfer counters + an HBM snapshot into a
+    finished family-dispatch span (traced paths only)."""
+    stats = transfer_stats()
+    if stats is not None:
+        span.set_attribute("transferBytes", stats["transferBytes"])
+        if stats["transfers"]:
+            span.set_attribute("transfers", dict(stats["transfers"]))
+        span.set_attribute("stackHits", stats["stackHits"])
+        span.set_attribute("stackMisses", stats["stackMisses"])
+    span.attributes.update(cache.hbm_stats())
+    clear_transfer_stats()
 
 
 class BatchFamilyMismatch(Exception):
@@ -200,7 +222,27 @@ class TpuSegmentExecutor:
         collect() each — host planning/decoding overlaps device compute
         (replaces the reference's per-segment worker-pool combine,
         pinot-core/.../operator/combine/GroupByCombineOperator.java:54, with
-        async device queueing instead of threads)."""
+        async device queueing instead of threads).
+
+        When a trace is active, the dispatch runs under a family_dispatch
+        span with the compile/execute split (compile detected via the
+        compile-cache guard; execute measured around block_until_ready —
+        which costs the async overlap, so traced runs are NOT perf runs),
+        per-slot transfer bytes, and an HBM snapshot. Tracing off takes the
+        first branch: one thread-local read, no spans, no added syncs."""
+        if TRACING.active_trace() is None:
+            return self._dispatch_plan(segment, plan, None)
+        with TRACING.scope("family_dispatch") as span:
+            reset_transfer_stats()
+            try:
+                span.set_attribute("segment", segment.name)
+                span.set_attribute("numSegments", 1)
+                return self._dispatch_plan(segment, plan, span)
+            finally:
+                _attach_dispatch_stats(span, self.cache)
+
+    def _dispatch_plan(self, segment: ImmutableSegment, plan: SegmentPlan,
+                       span):
         view = self.cache.view(segment)
         arrays, packed = plan.gather_arrays_packed(view)
         # params pass as host numpy: jit converts arguments itself — an
@@ -231,13 +273,30 @@ class TpuSegmentExecutor:
                 fused, lut_meta = "", ()
         # one entry per compiled executable family: padded shape and the
         # fused/lut variants each compile separately
-        _count_dispatch(_GUARD.note(
-            (plan.program, view.padded, fused, lut_meta)))
+        new_compile = _GUARD.note((plan.program, view.padded, fused, lut_meta))
+        _count_dispatch(new_compile)
+        if span is not None:
+            span.set_attribute("mode", plan.program.mode)
+            span.set_attribute("padded", view.padded)
+            if fused:
+                span.set_attribute("fused", fused)
+            t0 = time.perf_counter()
         try:
             outs = run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused=fused,
                                fused_lut_meta=lut_meta)
+            if span is not None:
+                # jit's first call compiles synchronously before the async
+                # dispatch, so host wall of run_program ≈ compile cost on
+                # a guard miss; block_until_ready then isolates execute
+                t1 = time.perf_counter()
+                span.set_attribute(
+                    "compileMs",
+                    round((t1 - t0) * 1000, 3) if new_compile else 0.0)
+                jax.block_until_ready(outs)
+                span.set_attribute(
+                    "deviceExecMs", round((time.perf_counter() - t1) * 1000, 3))
             # the compiled fused kernel varies with lut_meta (run counts
             # are static), so validation is keyed per (program, meta)
             vkey = (plan.program, lut_meta)
@@ -259,6 +318,12 @@ class TpuSegmentExecutor:
             outs = run_program(plan.program, arrays, base_params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused="")
+            if span is not None:
+                span.set_attribute("fusedFallback", True)
+                jax.block_until_ready(outs)
+                span.set_attribute(
+                    "deviceExecMs",
+                    round((time.perf_counter() - t0) * 1000, 3))
         # one flat buffer per query → one D2H transfer at collect() (a
         # tunneled device pays a fixed round trip PER materialized array)
         return pack_outputs(outs)
@@ -270,14 +335,42 @@ class TpuSegmentExecutor:
         query_executor._try_sparse_device_combine) rather than fetching
         them. Sparse programs never take the fused path, so the fused
         negotiation is skipped."""
+        if TRACING.active_trace() is None:
+            return self._dispatch_plan_raw(segment, plan, None)
+        with TRACING.scope("family_dispatch") as span:
+            reset_transfer_stats()
+            try:
+                span.set_attribute("segment", segment.name)
+                span.set_attribute("numSegments", 1)
+                return self._dispatch_plan_raw(segment, plan, span)
+            finally:
+                _attach_dispatch_stats(span, self.cache)
+
+    def _dispatch_plan_raw(self, segment: ImmutableSegment,
+                           plan: SegmentPlan, span):
         view = self.cache.view(segment)
         arrays, packed = plan.gather_arrays_packed(view)
         params = tuple(p if isinstance(p, (np.ndarray, np.generic))
                        else np.asarray(p) for p in plan.params)
-        _count_dispatch(_GUARD.note((plan.program, view.padded, "", ())))
-        return run_program(plan.program, arrays, params,
+        new_compile = _GUARD.note((plan.program, view.padded, "", ()))
+        _count_dispatch(new_compile)
+        if span is None:
+            return run_program(plan.program, arrays, params,
+                               np.int32(segment.num_docs), view.padded,
+                               packed=packed, fused=""), view
+        span.set_attribute("mode", plan.program.mode)
+        span.set_attribute("padded", view.padded)
+        t0 = time.perf_counter()
+        outs = run_program(plan.program, arrays, params,
                            np.int32(segment.num_docs), view.padded,
-                           packed=packed, fused=""), view
+                           packed=packed, fused="")
+        t1 = time.perf_counter()
+        span.set_attribute("compileMs",
+                           round((t1 - t0) * 1000, 3) if new_compile else 0.0)
+        jax.block_until_ready(outs)
+        span.set_attribute("deviceExecMs",
+                           round((time.perf_counter() - t1) * 1000, 3))
+        return outs, view
 
     def _gather_batch(self, segments: list, plans: list):
         """Gather + stack a batch family's kernel inputs: per-member planes
@@ -332,6 +425,17 @@ class TpuSegmentExecutor:
         return views, tuple(stacked), tuple(params_b), packed, num_docs
 
     def _dispatch_batch(self, segments: list, plans: list):
+        if TRACING.active_trace() is None:
+            return self._dispatch_batch_inner(segments, plans, None)
+        with TRACING.scope("family_dispatch") as span:
+            reset_transfer_stats()
+            try:
+                span.set_attribute("numSegments", len(segments))
+                return self._dispatch_batch_inner(segments, plans, span)
+            finally:
+                _attach_dispatch_stats(span, self.cache)
+
+    def _dispatch_batch_inner(self, segments: list, plans: list, span):
         from ..ops.kernels import run_program_batch
 
         views, arrays, params_b, packed, num_docs = self._gather_batch(
@@ -340,11 +444,25 @@ class TpuSegmentExecutor:
         # batch compiles are keyed per FAMILY (program, bucket, slot sig,
         # batch size) — the executable cache scales with families, not S
         asig = tuple((str(a.dtype), tuple(a.shape)) for a in arrays)
-        _count_dispatch(_GUARD.note(
+        new_compile = _GUARD.note(
             ("batch", plan0.program, views[0].padded, packed, asig,
-             len(segments))))
+             len(segments)))
+        _count_dispatch(new_compile)
+        if span is None:
+            return run_program_batch(plan0.program, arrays, params_b,
+                                     num_docs, views[0].padded,
+                                     packed=packed), views
+        span.set_attribute("mode", plan0.program.mode)
+        span.set_attribute("padded", views[0].padded)
+        t0 = time.perf_counter()
         outs = run_program_batch(plan0.program, arrays, params_b, num_docs,
                                  views[0].padded, packed=packed)
+        t1 = time.perf_counter()
+        span.set_attribute("compileMs",
+                           round((t1 - t0) * 1000, 3) if new_compile else 0.0)
+        jax.block_until_ready(outs)
+        span.set_attribute("deviceExecMs",
+                           round((time.perf_counter() - t1) * 1000, 3))
         return outs, views
 
     def dispatch_plan_batch(self, segments: list, plans: list):

@@ -19,7 +19,7 @@ from typing import Optional
 
 from ..query.context import QueryContext
 from ..query.parser.sql import SqlParseError, parse_sql
-from ..spi.metrics import SERVER_METRICS, ServerMeter
+from ..spi.metrics import SERVER_METRICS, ServerMeter, ServerTimer
 from ..spi.trace import TRACING, ServerQueryPhase
 from .scheduler import GLOBAL_ACCOUNTANT
 from ..segment.loader import ImmutableSegment
@@ -183,9 +183,17 @@ class QueryExecutor:
             except Exception as e:
                 return BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
 
+        # own the trace only when nobody upstream (the MSE stage runner)
+        # already started one — nested engine calls join the caller's span
+        # tree and leave attaching trace_info to the owner
         trace = None
+        owns_trace = False
         if query.query_options.get("trace") in (True, "true", 1):
-            trace = TRACING.start_trace(f"{query.table_name}:{id(query):x}")
+            trace = TRACING.active_trace()
+            if trace is None:
+                trace = TRACING.start_trace(
+                    f"{query.table_name}:{id(query):x}")
+                owns_trace = True
         try:
             with TRACING.scope(ServerQueryPhase.QUERY_PLAN_EXECUTION):
                 combined, stats = self.execute_segments(
@@ -195,7 +203,7 @@ class QueryExecutor:
                 result = reducer.reduce(query, combined)
         except Exception as e:  # clean broker-style error (reference QueryException)
             SERVER_METRICS.add_meter(ServerMeter.QUERY_EXECUTION_EXCEPTIONS)
-            if trace is not None:
+            if owns_trace:
                 TRACING.end_trace()
             return BrokerResponse(
                 exceptions=[f"{type(e).__name__}: {e}"],
@@ -215,7 +223,7 @@ class QueryExecutor:
             num_compiles=stats.get("num_compiles", 0),
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
-        if trace is not None:
+        if owns_trace:
             TRACING.end_trace()
             resp.trace_info = trace.to_json()
         return resp
@@ -319,6 +327,7 @@ class QueryExecutor:
         # re-dispatched QueryContext is safe to re-optimize.
         from ..query.optimizer import optimize_filter
 
+        t_start = time.perf_counter()
         query.filter = optimize_filter(query.filter)
         # per-query dispatch/compile counters (engine/executor.py): every
         # device dispatch for this query happens on this thread
@@ -335,9 +344,16 @@ class QueryExecutor:
             deadline = time.perf_counter() + float(timeout_ms) / 1000
         intermediates = self._run_segments(query, kept, tracker, deadline,
                                            timeout_ms)
-        combined = self._combine(query, intermediates)
+        with TRACING.scope(ServerQueryPhase.SERVER_COMBINE):
+            combined = self._combine(query, intermediates)
         num_dispatches, num_compiles = dispatch_counters()
+        # the declared server-phase timer (reference ServerQueryPhase
+        # QUERY_PROCESSING): wall time of the server-side half, into the
+        # histogram that backs the /metrics p50/p95/p99
+        SERVER_METRICS.update_timer(ServerTimer.QUERY_PROCESSING_TIME_MS,
+                                    (time.perf_counter() - t_start) * 1000)
         SERVER_METRICS.add_meter(ServerMeter.QUERIES)
+        SERVER_METRICS.add_table_meter(query.table_name, ServerMeter.QUERIES)
         SERVER_METRICS.add_meter(ServerMeter.NUM_DOCS_SCANNED,
                                  getattr(combined, "num_docs_scanned", 0))
         SERVER_METRICS.add_meter(ServerMeter.NUM_SEGMENTS_PROCESSED, len(kept))
@@ -440,9 +456,12 @@ class QueryExecutor:
         done = 0
         if self.num_threads > 1 and len(host_work) > 1:
             caller_trace = TRACING.active_trace()
+            caller_span = TRACING.current_span()
 
             def run_one(run_query, run_segment):
-                TRACING.adopt(caller_trace)  # traces are thread-local
+                # traces are thread-local; seed the caller's span so
+                # worker scopes nest under QUERY_PLAN_EXECUTION
+                TRACING.adopt(caller_trace, caller_span)
                 try:
                     cpu0 = time.thread_time_ns()
                     with TRACING.scope(

@@ -22,6 +22,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..spi.metrics import SERVER_METRICS, ServerTimer
+
 
 class QueryKilledError(Exception):
     """Reference: QueryCancelledException from the accountant interrupt."""
@@ -154,7 +156,11 @@ class QueryScheduler:
         finally:
             with self._lock:
                 self._pending -= 1
-        self.wait_ms_total += (time.perf_counter() - t0) * 1000
+        wait_ms = (time.perf_counter() - t0) * 1000
+        self.wait_ms_total += wait_ms
+        # reference ServerQueryPhase.SCHEDULER_WAIT: admission-control
+        # latency into the server timer histogram
+        SERVER_METRICS.update_timer(ServerTimer.SCHEDULER_WAIT_MS, wait_ms)
         tracker = self.accountant.start_query(group=group)
         try:
             return fn(tracker, *args, **kwargs)
@@ -180,6 +186,7 @@ class PriorityQueryScheduler(QueryScheduler):
     def submit(self, fn: Callable, *args, group: str = "default",
                timeout_s: float = 60.0, **kwargs):
         deadline = time.monotonic() + timeout_s
+        t_wait = time.perf_counter()
         with self._cv:
             if self._pending >= self.max_pending:
                 raise QueryRejectedError("scheduler queue full")
@@ -198,6 +205,9 @@ class PriorityQueryScheduler(QueryScheduler):
                 self._waiting[group] -= 1
                 if not self._waiting[group]:
                     del self._waiting[group]
+        wait_ms = (time.perf_counter() - t_wait) * 1000
+        self.wait_ms_total += wait_ms
+        SERVER_METRICS.update_timer(ServerTimer.SCHEDULER_WAIT_MS, wait_ms)
         tracker = self.accountant.start_query(group=group)
         t0 = time.perf_counter()
         try:

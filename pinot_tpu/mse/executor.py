@@ -16,6 +16,7 @@ from typing import Optional
 import numpy as np
 
 from ..engine.results import BrokerResponse, DataSchema, ResultTable
+from ..spi.trace import TRACING
 from .fragmenter import explain_stages, fragment
 from .logical import LogicalPlanner, prune_columns
 from .optimizer import push_filters
@@ -91,8 +92,14 @@ class MultistageExecutor:
     # -- entry -------------------------------------------------------------
     def execute_sql(self, sql: str) -> BrokerResponse:
         t0 = time.perf_counter()
+        trace = None
         try:
             query = parse_relational(sql)
+            # the MSE entry owns the span tree: stage spans (runtime.py)
+            # and nested leaf-engine dispatch spans all join this trace
+            if query.options.get("trace") in (True, "true", 1) \
+                    and TRACING.active_trace() is None:
+                trace = TRACING.start_trace(f"mse:{id(query):x}")
             planner = LogicalPlanner(query, self._catalog(),
                                      partition_catalog=self._partition_catalog)
             plan = planner.plan()
@@ -126,7 +133,7 @@ class MultistageExecutor:
                     time_used_ms=(time.perf_counter() - t0) * 1000)
             schema = stages[0].root.schema
             result = _block_to_result(block, schema)
-            return BrokerResponse(
+            resp = BrokerResponse(
                 result_table=result,
                 num_docs_scanned=runner.stats["num_docs_scanned"],
                 total_docs=runner.stats["total_docs"],
@@ -139,10 +146,16 @@ class MultistageExecutor:
                 num_compiles=runner.stats.get("num_compiles", 0),
                 mse_stage_stats=runner.stage_stats,
                 time_used_ms=(time.perf_counter() - t0) * 1000)
+            if trace is not None:
+                resp.trace_info = trace.to_json()
+            return resp
         except Exception as e:
             return BrokerResponse(
                 exceptions=[f"{type(e).__name__}: {e}"],
                 time_used_ms=(time.perf_counter() - t0) * 1000)
+        finally:
+            if trace is not None:
+                TRACING.end_trace()
 
 
 def _block_to_result(block: Block, schema: list[str]) -> ResultTable:

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..engine.aggregation import UnsupportedQueryError
 from ..query.context import QueryContext
+from ..spi.trace import TRACING
 from ..query.converter import FilterConversionError, filter_from_expression
 from ..query.expressions import ExpressionContext
 from .fragmenter import MailboxReceiveNode, Stage, receive_nodes
@@ -161,6 +162,19 @@ class StageRunner:
         return block
 
     def _run_stage(self, stage: Stage) -> None:
+        if TRACING.active_trace() is None:
+            return self._run_stage_inner(stage)
+        # one span per stage so broker reduce → stage → nested leaf-engine
+        # family_dispatch spans line up in one tree
+        with TRACING.scope(f"mse_stage:{stage.stage_id}") as span:
+            self._run_stage_inner(stage)
+            st = self._sstat(stage.stage_id)
+            for k in ("workers", "rows_in", "rows_out", "shuffled_rows",
+                      "shuffled_bytes", "leaf_pushdown"):
+                if k in st:
+                    span.set_attribute(k, st[k])
+
+    def _run_stage_inner(self, stage: Stage) -> None:
         import time
 
         parent = self.stages[stage.parent_stage]
@@ -189,8 +203,22 @@ class StageRunner:
                 # independent partitions of the stage execute concurrently;
                 # sends stay in worker order below, so mailbox contents are
                 # deterministic regardless of completion order
+                caller_trace = TRACING.active_trace()
+                caller_span = TRACING.current_span()
+
+                def run_worker(w):
+                    if caller_trace is None:
+                        return self._worker_block(stage, w)
+                    # traces are thread-local: nest pool-worker scopes
+                    # under this stage's span
+                    TRACING.adopt(caller_trace, caller_span)
+                    try:
+                        return self._worker_block(stage, w)
+                    finally:
+                        TRACING.adopt(None)
+
                 with ThreadPoolExecutor(max_workers=pool_size) as pool:
-                    futs = [pool.submit(self._worker_block, stage, w)
+                    futs = [pool.submit(run_worker, w)
                             for w in range(st["workers"])]
                     blocks = [f.result() for f in futs]
             else:

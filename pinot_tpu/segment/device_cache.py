@@ -24,6 +24,43 @@ from .loader import ImmutableSegment
 
 _MIN_PAD = 1 << 13
 
+# Per-thread transfer attribution for device-phase tracing: armed by
+# reset_transfer_stats() at dispatch start (only when a trace is active),
+# read back into span attributes. When disarmed the upload path pays one
+# thread-local getattr — nothing else.
+_TRANSFER_TL = threading.local()
+
+
+def reset_transfer_stats() -> None:
+    """Arm per-thread transfer counters (tracing on)."""
+    _TRANSFER_TL.stats = {"transferBytes": 0, "transfers": {},
+                          "stackHits": 0, "stackMisses": 0}
+
+
+def clear_transfer_stats() -> None:
+    _TRANSFER_TL.stats = None
+
+
+def transfer_stats() -> Optional[dict]:
+    """Counters since the last reset on this thread, or None when off:
+    host→device bytes total + per-(column, plane-kind) slot, stacked-view
+    plane cache hits/misses."""
+    return getattr(_TRANSFER_TL, "stats", None)
+
+
+def _note_upload(key: tuple[str, str], nbytes: int) -> None:
+    stats = getattr(_TRANSFER_TL, "stats", None)
+    if stats is not None:
+        stats["transferBytes"] += nbytes
+        slot = f"{key[0]}:{key[1]}"
+        stats["transfers"][slot] = stats["transfers"].get(slot, 0) + nbytes
+
+
+def _note_stack(hit: bool) -> None:
+    stats = getattr(_TRANSFER_TL, "stats", None)
+    if stats is not None:
+        stats["stackHits" if hit else "stackMisses"] += 1
+
 
 def packed_hbm_enabled() -> bool:
     """Packed id planes default ON for the TPU backend (bandwidth-bound:
@@ -68,6 +105,7 @@ class SegmentDeviceView:
             if self.device is not None:
                 arr = jax.device_put(arr, self.device)
             self._planes[key] = arr
+            _note_upload(key, arr.nbytes)
         return arr
 
     def dict_ids(self, column: str) -> jnp.ndarray:
@@ -200,6 +238,9 @@ class StackedSegmentView:
         if arr is None:
             arr = build()
             self._planes[plane_key] = arr
+            _note_stack(hit=False)
+        else:
+            _note_stack(hit=True)
         return arr
 
     def nbytes(self) -> int:
@@ -222,6 +263,9 @@ class DeviceSegmentCache:
         self._order: list[int] = []  # LRU
         self._stacks: dict[tuple, StackedSegmentView] = {}
         self._stack_order: list[tuple] = []  # LRU over stacked views
+        # lifetime pressure-eviction count (budget LRU + OOM relief),
+        # surfaced in hbm_stats() / dispatch-span HBM snapshots
+        self.evictions = 0
         # guards _views/_order/_stacks: concurrent queries share this cache,
         # and OOM-relief eviction (engine/oom.py) races view()/_maybe_evict()
         self._lock = threading.Lock()
@@ -328,6 +372,7 @@ class DeviceSegmentCache:
                 if key in self._order:
                     self._order.remove(key)
                 victims += 1
+            self.evictions += victims
         return freed, victims
 
     def _maybe_evict(self) -> None:
@@ -344,11 +389,25 @@ class DeviceSegmentCache:
             victim = self._stack_order.pop(0)
             total -= self._stacks[victim].nbytes()
             self._stacks.pop(victim).evict()
+            self.evictions += 1
         while total > self.budget_bytes and len(self._order) > 1:
             victim = self._order.pop(0)
             total -= self._views[victim].nbytes()
             self._views[victim].evict()
             del self._views[victim]
+            self.evictions += 1
+
+    def hbm_stats(self) -> dict:
+        """Residency snapshot for dispatch-span attributes and /metrics
+        gauges: bytes used vs budget plus lifetime pressure evictions.
+        Sums plane bytes under the lock — call from traced paths, not the
+        tracing-off hot path."""
+        with self._lock:
+            used = sum(v.nbytes() for v in self._views.values())
+            used += sum(s.nbytes() for s in self._stacks.values())
+            return {"hbmBytesUsed": used,
+                    "hbmBudgetBytes": self.budget_bytes,
+                    "hbmEvictions": self.evictions}
 
 
 # Default budget keeps headroom on a 16GB v5e; override via env.

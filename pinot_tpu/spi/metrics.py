@@ -1,4 +1,4 @@
-"""Metrics SPI: meters / gauges / timers with a pluggable factory.
+"""Metrics SPI: meters / gauges / histogram timers with a pluggable factory.
 
 Reference analogue: pinot-spi/.../spi/metrics/ + AbstractMetrics
 (pinot-common/.../common/metrics/AbstractMetrics.java) with the typed
@@ -6,10 +6,18 @@ per-role enums (ServerMeter/ServerGauge/ServerTimer, Broker*, Controller*)
 and swappable yammer/dropwizard backends
 (pinot-plugins/pinot-metrics/). The in-memory registry here is the default
 backend; `register_metrics_factory` swaps it (e.g. a Prometheus exporter).
+
+Timers are log-bucketed histograms (4 buckets per octave, so quantile
+estimates carry at most ~19% relative error) rather than plain
+count/total pairs — `snapshot()` reports p50/p95/p99 per timer, and
+`render_prometheus` exposes the whole registry in Prometheus text format
+for the REST `/metrics` route.
 """
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 from collections import defaultdict
@@ -45,20 +53,81 @@ class ServerTimer:
     SCHEDULER_WAIT_MS = "schedulerWaitMs"
 
 
+class BrokerTimer:
+    QUERY_PROCESSING_TIME_MS = "queryProcessingTimeMs"
+
+
 class ServerGauge:
     DOCUMENT_COUNT = "documentCount"
     SEGMENT_COUNT = "segmentCount"
     UPSERT_PRIMARY_KEYS_COUNT = "upsertPrimaryKeysCount"
 
 
+# log-bucketed histogram resolution: 4 buckets per power of two keeps the
+# worst-case quantile error at 2**0.25 - 1 ~= 19% with O(40*4) buckets
+# across the practical 1us..1000s range
+_BUCKETS_PER_OCTAVE = 4
+_MIN_MS = 2.0 ** -10  # ~1us floor; everything below lands in one bucket
+
+
+def _bucket_index(ms: float) -> int:
+    if ms <= _MIN_MS:
+        return -10 * _BUCKETS_PER_OCTAVE
+    return math.ceil(math.log2(ms) * _BUCKETS_PER_OCTAVE)
+
+
+def _bucket_upper_ms(idx: int) -> float:
+    return 2.0 ** (idx / _BUCKETS_PER_OCTAVE)
+
+
+class TimerHistogram:
+    """Log-bucketed latency histogram (lock handled by the registry)."""
+
+    __slots__ = ("count", "total_ms", "min_ms", "max_ms", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.min_ms = math.inf
+        self.max_ms = 0.0
+        self.buckets: dict[int, int] = {}
+
+    def add(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        if ms < self.min_ms:
+            self.min_ms = ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+        idx = _bucket_index(ms)
+        self.buckets[idx] = self.buckets.get(idx, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = max(1, math.ceil(q * self.count))
+        cum = 0
+        for idx in sorted(self.buckets):
+            cum += self.buckets[idx]
+            if cum >= target:
+                # clamp the bucket bound to the observed range so small
+                # samples don't report an estimate outside [min, max]
+                est = _bucket_upper_ms(idx)
+                return min(max(est, self.min_ms), self.max_ms)
+        return self.max_ms
+
+
 class MetricsRegistry:
-    """In-memory backend: thread-safe counters, gauges, timer stats."""
+    """In-memory backend: thread-safe counters, gauges, timer histograms."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._meters: dict[str, int] = defaultdict(int)
+        # per-table labeled meters, keyed (name, table)
+        # (reference: AbstractMetrics.addMeteredTableValue)
+        self._table_meters: dict[tuple[str, str], int] = defaultdict(int)
         self._gauges: dict[str, Callable[[], float]] = {}
-        self._timers: dict[str, list] = defaultdict(lambda: [0, 0.0])  # n, total_ms
+        self._timers: dict[str, TimerHistogram] = defaultdict(TimerHistogram)
 
     def add_meter(self, name: str, value: int = 1) -> None:
         with self._lock:
@@ -67,6 +136,14 @@ class MetricsRegistry:
     def meter_count(self, name: str) -> int:
         with self._lock:
             return self._meters.get(name, 0)
+
+    def add_table_meter(self, table: str, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._table_meters[(name, table)] += value
+
+    def table_meter_count(self, table: str, name: str) -> int:
+        with self._lock:
+            return self._table_meters.get((name, table), 0)
 
     def set_gauge(self, name: str, supplier: Callable[[], float]) -> None:
         with self._lock:
@@ -89,14 +166,17 @@ class MetricsRegistry:
 
     def update_timer(self, name: str, ms: float) -> None:
         with self._lock:
-            t = self._timers[name]
-            t[0] += 1
-            t[1] += ms
+            self._timers[name].add(ms)
 
     def timer_stats(self, name: str) -> tuple[int, float]:
         with self._lock:
-            n, total = self._timers.get(name, [0, 0.0])
-            return n, total
+            t = self._timers.get(name)
+            return (0, 0.0) if t is None else (t.count, t.total_ms)
+
+    def timer_quantile(self, name: str, q: float) -> float:
+        with self._lock:
+            t = self._timers.get(name)
+            return 0.0 if t is None else t.quantile(q)
 
     def timed(self, name: str):
         registry = self
@@ -112,18 +192,76 @@ class MetricsRegistry:
         return _Ctx()
 
     def snapshot(self) -> dict:
-        # gauge suppliers may block (e.g. stream-metadata RPCs behind the
-        # ingestion-lag gauge) — evaluate them OUTSIDE the registry lock so
-        # a slow supplier cannot stall query-path add_meter/update_timer
+        # gauge suppliers may block or raise (e.g. stream-metadata RPCs
+        # behind the ingestion-lag gauge) — evaluate them OUTSIDE the
+        # registry lock so a slow supplier cannot stall query-path
+        # add_meter/update_timer, and skip any that raise so one broken
+        # supplier cannot take down the whole snapshot
         with self._lock:
             out = {
                 "meters": dict(self._meters),
-                "timers": {k: {"count": v[0], "totalMs": round(v[1], 3)}
-                           for k, v in self._timers.items()},
+                "tableMeters": {f"{name}.{table}": v
+                                for (name, table), v in
+                                self._table_meters.items()},
+                "timers": {k: {"count": t.count,
+                               "totalMs": round(t.total_ms, 3),
+                               "minMs": round(t.min_ms, 3) if t.count else 0.0,
+                               "maxMs": round(t.max_ms, 3),
+                               "p50Ms": round(t.quantile(0.50), 3),
+                               "p95Ms": round(t.quantile(0.95), 3),
+                               "p99Ms": round(t.quantile(0.99), 3)}
+                           for k, t in self._timers.items()},
             }
             gauges = dict(self._gauges)
-        out["gauges"] = {k: float(v()) for k, v in gauges.items()}
+        vals = {}
+        for k, v in gauges.items():
+            try:
+                vals[k] = float(v())
+            except Exception:
+                pass
+        out["gauges"] = vals
         return out
+
+
+def _prom_name(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def render_prometheus(registry: MetricsRegistry, role: str) -> str:
+    """Render a registry in Prometheus text exposition format 0.0.4:
+    meters as counters, gauges as gauges, timer histograms as summaries
+    with p50/p95/p99 quantile labels."""
+    snap = registry.snapshot()
+    base = f'role="{role}"'
+    lines = []
+    for name in sorted(snap["meters"]):
+        pn = f"pinot_{_prom_name(name)}_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn}{{{base}}} {snap['meters'][name]}")
+    by_name: dict[str, list] = defaultdict(list)
+    for key, v in snap["tableMeters"].items():
+        name, table = key.split(".", 1)
+        by_name[name].append((table, v))
+    for name in sorted(by_name):
+        pn = f"pinot_{_prom_name(name)}_total"
+        for table, v in sorted(by_name[name]):
+            lines.append(f'{pn}{{{base},table="{table}"}} {v}')
+    for name in sorted(snap["gauges"]):
+        pn = f"pinot_{_prom_name(name)}"
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn}{{{base}}} {snap['gauges'][name]}")
+    for name in sorted(snap["timers"]):
+        t = snap["timers"][name]
+        pn = f"pinot_{_prom_name(name)}"
+        lines.append(f"# TYPE {pn} summary")
+        for q, key in ((0.5, "p50Ms"), (0.95, "p95Ms"), (0.99, "p99Ms")):
+            lines.append(f'{pn}{{{base},quantile="{q}"}} {t[key]}')
+        lines.append(f"{pn}_count{{{base}}} {t['count']}")
+        lines.append(f"{pn}_sum{{{base}}} {t['totalMs']}")
+    return "\n".join(lines) + "\n"
 
 
 _FACTORY: Callable[[], MetricsRegistry] = MetricsRegistry

@@ -1,4 +1,4 @@
-"""Tracing SPI: pluggable per-query tracers + phase timing.
+"""Tracing SPI: pluggable per-query tracers + hierarchical phase spans.
 
 Reference analogue: pinot-spi/.../spi/trace/Tracing.java:45 (registerable
 Tracer, InvocationScope recordings, per-request registration in
@@ -6,15 +6,21 @@ ServerQueryExecutorV1Impl.execute:143-156) and the phase timers
 (pinot-common/.../metrics/ServerQueryPhase.java:29-36). Traces attach to
 the broker response when the `trace` query option is set, exactly like the
 reference's `trace=true`.
+
+Spans form a tree (broker reduce -> server execution -> per-family device
+dispatch) but `to_json()` stays a FLAT list — consumers that only care
+about phase names/durations keep working — with `spanId`/`parentId`
+conveying the hierarchy and an `attributes` dict carrying device-phase
+detail (compileMs, deviceExecMs, transferBytes, HBM snapshot).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Optional
 
 
 class ServerQueryPhase:
@@ -26,26 +32,115 @@ class ServerQueryPhase:
     QUERY_PLAN_EXECUTION = "QUERY_PLAN_EXECUTION"
     RESPONSE_SERIALIZATION = "RESPONSE_SERIALIZATION"
     QUERY_PROCESSING = "QUERY_PROCESSING"
+    SERVER_COMBINE = "SERVER_COMBINE"
 
 
-@dataclass
+# Process-wide span-allocation counter: the tracing-off perf guard asserts
+# this does not move when `trace` is unset (tests/test_tracing_perf_guard).
+_SPAN_ALLOCS = 0
+
+
+def span_allocations() -> int:
+    return _SPAN_ALLOCS
+
+
+class Span:
+    """One recorded scope: a node in the query's span tree."""
+
+    __slots__ = ("name", "start_ms", "duration_ms", "span_id", "parent_id",
+                 "seq", "attributes")
+
+    def __init__(self, name: str, start_ms: float, span_id: int,
+                 parent_id: Optional[int], seq: int):
+        global _SPAN_ALLOCS
+        _SPAN_ALLOCS += 1
+        self.name = name
+        self.start_ms = start_ms
+        self.duration_ms = 0.0
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.seq = seq
+        self.attributes: dict[str, Any] = {}
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def to_json(self) -> dict:
+        out = {"operator": self.name, "startMs": self.start_ms,
+               "durationMs": self.duration_ms, "spanId": self.span_id}
+        if self.parent_id is not None:
+            out["parentId"] = self.parent_id
+        if self.attributes:
+            out["attributes"] = dict(self.attributes)
+        return out
+
+
 class Trace:
-    """One query's recorded scopes: [(name, start_ms_rel, duration_ms)]."""
+    """One query's recorded spans (flat store; tree via parentId)."""
 
-    query_id: str
-    scopes: list = field(default_factory=list)
-    _t0: float = field(default_factory=time.perf_counter)
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.spans: list[Span] = []
+        self._t0 = time.perf_counter()
+        # list.append and itertools.count.__next__ are GIL-atomic, so
+        # combine workers on adopted traces need no lock here
+        self._ids = itertools.count(1)
+        self._seq = itertools.count()
 
-    def record(self, name: str, start: float, end: float) -> None:
-        self.scopes.append((name, round((start - self._t0) * 1000, 3),
-                            round((end - start) * 1000, 3)))
+    def new_span(self, name: str, start: float,
+                 parent: Optional[Span] = None) -> Span:
+        span = Span(name, round((start - self._t0) * 1000, 3),
+                    next(self._ids),
+                    None if parent is None else parent.span_id,
+                    next(self._seq))
+        self.spans.append(span)
+        return span
+
+    def record(self, name: str, start: float, end: float,
+               parent: Optional[Span] = None) -> Span:
+        """Record a completed scope in one shot."""
+        span = self.new_span(name, start, parent)
+        span.duration_ms = round((end - start) * 1000, 3)
+        return span
 
     def to_json(self) -> list:
-        return [{"operator": n, "startMs": s, "durationMs": d}
-                for n, s, d in self.scopes]
+        # combine workers append from multiple threads, so raw list order
+        # is interleave-dependent: sort by startMs, ties by record order
+        return [s.to_json()
+                for s in sorted(self.spans, key=lambda s: (s.start_ms, s.seq))]
+
+    def to_tree(self) -> list:
+        """Nested form: children grouped under their parent span."""
+        nodes = {s.span_id: dict(s.to_json(), children=[])
+                 for s in sorted(self.spans,
+                                 key=lambda s: (s.start_ms, s.seq))}
+        roots = []
+        for node in nodes.values():
+            parent = nodes.get(node.get("parentId"))
+            (parent["children"] if parent else roots).append(node)
+        return roots
 
     def phase_ms(self, name: str) -> float:
-        return sum(d for n, _, d in self.scopes if n == name)
+        return sum(s.duration_ms for s in self.spans if s.name == name)
+
+
+def phase_breakdown(trace_json: list) -> dict:
+    """Roll a flat span list up into the device-phase totals bench.py
+    emits: compile vs device-execute vs host-combine time and host->device
+    transfer volume (keys sum over every span carrying the attribute)."""
+    out = {"compileMs": 0.0, "deviceExecMs": 0.0, "hostCombineMs": 0.0,
+           "transferBytes": 0}
+    for span in trace_json:
+        attrs = span.get("attributes") or {}
+        out["compileMs"] += attrs.get("compileMs", 0.0)
+        out["deviceExecMs"] += attrs.get("deviceExecMs", 0.0)
+        out["transferBytes"] += attrs.get("transferBytes", 0)
+        if span.get("operator") in (ServerQueryPhase.SERVER_COMBINE,
+                                    "BROKER_REDUCE"):
+            out["hostCombineMs"] += span.get("durationMs", 0.0)
+    for k in ("compileMs", "deviceExecMs", "hostCombineMs"):
+        out[k] = round(out[k], 3)
+    return out
 
 
 class Tracer:
@@ -68,34 +163,51 @@ class _Tracing:
     def start_trace(self, query_id: str) -> Trace:
         trace = self._tracer.new_trace(query_id)
         self._local.trace = trace
+        self._local.stack = []
         return trace
 
     def active_trace(self) -> Optional[Trace]:
         return getattr(self._local, "trace", None)
 
-    def adopt(self, trace: Optional[Trace]) -> None:
+    def current_span(self) -> Optional[Span]:
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else None
+
+    def adopt(self, trace: Optional[Trace],
+              parent: Optional[Span] = None) -> None:
         """Make another thread's trace active here (worker-pool fan-out:
-        the reference's per-thread registration in combine workers)."""
+        the reference's per-thread registration in combine workers).
+        ``parent`` seeds the span stack so worker scopes nest under the
+        caller's span instead of floating at the root."""
         self._local.trace = trace
+        self._local.stack = [] if parent is None else [parent]
 
     def end_trace(self) -> Optional[Trace]:
         trace = self.active_trace()
         self._local.trace = None
+        self._local.stack = []
         return trace
 
     @contextmanager
     def scope(self, name: str):
-        """Records into the active trace; no-op when tracing is off —
-        the hot path pays one thread-local read."""
+        """Records a span into the active trace, nested under the current
+        span; yields the Span so callers can attach attributes. No-op when
+        tracing is off — the hot path pays one thread-local read and
+        yields None (zero Span allocations)."""
         trace = self.active_trace()
         if trace is None:
-            yield
+            yield None
             return
         start = time.perf_counter()
+        span = trace.new_span(name, start, self.current_span())
+        stack = self._local.stack
+        stack.append(span)
         try:
-            yield
+            yield span
         finally:
-            trace.record(name, start, time.perf_counter())
+            span.duration_ms = round((time.perf_counter() - start) * 1000, 3)
+            if stack and stack[-1] is span:
+                stack.pop()
 
 
 TRACING = _Tracing()

@@ -1,0 +1,95 @@
+"""CI perf-structure guard: tracing OFF must cost nothing on the hot path.
+
+Call-count instrumentation, not wall-clock, so it can't flake: after the
+query is warm (compile guard satisfied, fused validation settled, planes
+resident in HBM), an untraced run must perform ZERO extra
+``jax.block_until_ready`` / ``jax.device_get`` calls and allocate ZERO
+trace spans — the only tracing cost allowed is the single thread-local
+read in ``TRACING.scope``/``active_trace``. A traced run of the same query
+is then required to increment both counters, proving the guard actually
+watches the instrumented sites.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.trace import span_allocations
+
+SQL = "SELECT pgk, SUM(pgv) FROM perfguard GROUP BY pgk"
+
+
+@pytest.fixture(scope="module")
+def warm_engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("perfguard")
+    # unique column names -> fresh Program -> this module owns its own
+    # compile-guard entries regardless of what other tests compiled
+    schema = Schema.build("perfguard", dimensions=[("pgk", "INT")],
+                         metrics=[("pgv", "INT")])
+    rng = np.random.default_rng(7)
+    segs = []
+    for i in range(4):
+        cols = {"pgk": rng.integers(0, 20, 2000).astype(np.int32),
+                "pgv": rng.integers(0, 100, 2000).astype(np.int32)}
+        SegmentBuilder(schema, segment_name=f"pg_{i}").build(cols, d / f"s{i}")
+        segs.append(load_segment(d / f"s{i}"))
+    qe = QueryExecutor()
+    qe.add_table(schema, segs)
+    # warm: first run compiles, second proves the steady state
+    for _ in range(2):
+        r = qe.execute_sql(SQL)
+        assert not r.exceptions, r.exceptions
+    return qe
+
+
+class _CountingSync:
+    """Counting wrappers over jax's host-sync entry points."""
+
+    def __init__(self, monkeypatch):
+        self.block_calls = 0
+        self.device_get_calls = 0
+        real_block = jax.block_until_ready
+        real_get = jax.device_get
+
+        def counting_block(x):
+            self.block_calls += 1
+            return real_block(x)
+
+        def counting_get(x):
+            self.device_get_calls += 1
+            return real_get(x)
+
+        monkeypatch.setattr(jax, "block_until_ready", counting_block)
+        monkeypatch.setattr(jax, "device_get", counting_get)
+
+
+def test_tracing_off_adds_zero_syncs_and_zero_spans(warm_engine, monkeypatch):
+    sync = _CountingSync(monkeypatch)
+    spans_before = span_allocations()
+    r = warm_engine.execute_sql(SQL)
+    assert not r.exceptions, r.exceptions
+    assert r.trace_info is None
+    assert sync.block_calls == 0, (
+        "tracing-off dispatch must not add block_until_ready syncs")
+    assert sync.device_get_calls == 0, (
+        "tracing-off dispatch must not add device_get syncs")
+    assert span_allocations() == spans_before, (
+        "tracing-off path must allocate zero Span objects")
+
+
+def test_traced_run_does_sync_and_allocate(warm_engine, monkeypatch):
+    """Sanity: the guard watches live sites — tracing ON must trip both."""
+    sync = _CountingSync(monkeypatch)
+    spans_before = span_allocations()
+    r = warm_engine.execute_sql("SET trace = true; " + SQL)
+    assert not r.exceptions, r.exceptions
+    assert r.trace_info
+    assert sync.block_calls > 0
+    assert span_allocations() > spans_before
