@@ -1,0 +1,12 @@
+"""Multi-tier result cache (new subsystem, PR 5).
+
+Three tiers, all keyed off segment immutability — any per-segment partial
+is a pure function of (compiled plan, segment content):
+
+- ``keys.py``     canonical plan fingerprints + segment identity tokens
+- ``partial.py``  server-side (program_fp, segment_token) → partial result
+- ``results.py``  broker-side full-response cache + table lineage epochs
+
+Device-resident sparse group tables register against the HBM budget in
+``segment/device_cache.py`` (their own eviction class, evicted first).
+"""

@@ -1,0 +1,177 @@
+"""Canonical plan fingerprints + segment identity tokens (cache tier 1).
+
+A per-segment partial result is a pure function of (compiled plan, segment
+content), so the cache key must be *process-stable*: two fresh planner
+instances compiling the same SQL must produce byte-identical fingerprints,
+and any change that can alter the partial (a filter literal, an agg, a SET
+option that affects results) must change them.
+
+The encoder below is deliberately closed-world: it walks frozen IR
+dataclasses, containers, numpy values and primitives, and RAISES on
+anything else. There is no ``repr()``/``id()`` fallback — that is how
+object identity (memory addresses, insertion order of unhashed sets)
+leaks into keys and silently breaks cross-process stability. If a new
+node type shows up in a Program, fingerprinting fails loudly and the
+executor just skips the cache for that query.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import hashlib
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+# SET options that change HOW a query executes but never WHAT it returns.
+# Everything not listed here is conservatively folded into the fingerprint
+# (numGroupsLimit, enableNullHandling, trim options, ... all affect rows).
+# Compared lowercase so spelling variants can't split cache entries.
+EXECUTION_ONLY_OPTIONS = frozenset({
+    "segmentbatch", "devicecombine", "segmentcache", "resultcache",
+    "trace", "timeoutms", "usemultistageengine",
+})
+
+# Lifetime fingerprint computations in this process — the perf guard
+# (tests/test_cache_perf_guard.py) pins that ``SET segmentCache=false``
+# performs ZERO of these on the hot path. A plain list cell keeps the
+# counter GIL-atomic without a lock on every increment.
+_FP_COUNT = [0]
+_FP_LOCK = threading.Lock()
+
+
+def fingerprint_computations() -> int:
+    return _FP_COUNT[0]
+
+
+class UnfingerprintableError(TypeError):
+    """A value with no canonical byte encoding reached the key encoder."""
+
+
+def _enc(obj, out: list) -> None:
+    """Append a canonical, type-tagged byte encoding of ``obj``. Tags keep
+    distinct types with equal payloads apart (1 vs 1.0 vs "1" vs True)."""
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, enum.Enum):
+        out.append(b"E")
+        _enc(type(obj).__qualname__, out)
+        _enc(obj.name, out)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(b"s%d:" % len(b))
+        out.append(b)
+    elif isinstance(obj, bytes):
+        out.append(b"b%d:" % len(obj))
+        out.append(obj)
+    elif isinstance(obj, int):
+        out.append(b"i%d;" % obj)
+    elif isinstance(obj, float):
+        out.append(b"f")
+        out.append(struct.pack("<d", obj))
+    elif isinstance(obj, (np.generic, np.ndarray)):
+        a = np.asarray(obj)
+        out.append(b"a")
+        _enc(a.dtype.str, out)
+        _enc(tuple(int(d) for d in a.shape), out)
+        out.append(a.tobytes())
+    elif dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        # frozen IR nodes: qualname + every field in declaration order
+        out.append(b"D")
+        _enc(type(obj).__qualname__, out)
+        for f in dataclasses.fields(obj):
+            _enc(f.name, out)
+            _enc(getattr(obj, f.name), out)
+    elif isinstance(obj, (tuple, list)):
+        out.append(b"l%d:" % len(obj))
+        for item in obj:
+            _enc(item, out)
+    elif isinstance(obj, dict):
+        items = []
+        for k, v in obj.items():
+            kb: list = []
+            _enc(k, kb)
+            items.append((b"".join(kb), v))
+        items.sort(key=lambda kv: kv[0])
+        out.append(b"d%d:" % len(items))
+        for kb, v in items:
+            out.append(kb)
+            _enc(v, out)
+    else:
+        raise UnfingerprintableError(
+            f"no canonical encoding for {type(obj).__qualname__}")
+
+
+def canonical_bytes(obj) -> bytes:
+    buf: list = []
+    _enc(obj, buf)
+    return b"".join(buf)
+
+
+def _result_options(query) -> dict:
+    return {str(k): str(v) for k, v in query.query_options.items()
+            if str(k).lower() not in EXECUTION_ONLY_OPTIONS}
+
+
+def program_fingerprint(plan, query) -> Optional[str]:
+    """Fingerprint of a compiled per-segment plan: the Program IR (filter
+    tree with param slot references), runtime param VALUES (the literals),
+    slot layout, and the canonical query text. ``str(query)`` is included
+    because structurally identical Programs can decode differently (AVG vs
+    SUM+COUNT share a kernel; finalizers live in lowered_aggs, which holds
+    callables and is covered by the query text instead). Returns None when
+    any component has no canonical encoding — callers bypass the cache."""
+    try:
+        payload = (
+            "pfp1",
+            canonical_bytes(plan.program),
+            tuple(plan.slots),
+            bool(plan.fused_ok),
+            tuple(canonical_bytes(np.asarray(p)) for p in plan.params),
+            str(query),
+            _result_options(query),
+        )
+        digest = hashlib.sha256(canonical_bytes(payload)).hexdigest()
+    except UnfingerprintableError:
+        return None
+    with _FP_LOCK:
+        _FP_COUNT[0] += 1
+    return digest
+
+
+def query_fingerprint(query) -> Optional[str]:
+    """Broker-tier fingerprint: canonical SQL text + result-affecting SET
+    options. QueryContext.__str__ is deterministic canonical SQL (filter /
+    expression __str__ are all value-based), so two parses of the same
+    request collide here by construction."""
+    try:
+        payload = ("qfp1", str(query), _result_options(query))
+        digest = hashlib.sha256(canonical_bytes(payload)).hexdigest()
+    except UnfingerprintableError:
+        return None
+    with _FP_LOCK:
+        _FP_COUNT[0] += 1
+    return digest
+
+
+def segment_token(segment) -> Optional[tuple]:
+    """Content identity of an immutable segment: (name, crc). Returns None
+    for realtime/mutable snapshots (content changes between queries) and
+    for segments without a crc — those always bypass the cache. The crc is
+    part of the key, so a replaced segment reusing its name can never
+    serve stale partials even before eager invalidation runs."""
+    if getattr(segment, "is_mutable", False):
+        return None
+    meta = getattr(segment, "metadata", None)
+    name = getattr(segment, "name", None) or getattr(meta, "segment_name", None)
+    crc = getattr(meta, "crc", None)
+    if not name or not crc:
+        return None
+    return (str(name), str(crc))

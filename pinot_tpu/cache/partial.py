@@ -1,0 +1,131 @@
+"""Segment partial-result cache (cache tier 2, host side).
+
+Server-side map from ``(program_fp, segment_token)`` → the per-segment
+partial (dense agg state vector or group table). Because segments are
+immutable and the fingerprint folds in every result-affecting input
+(cache/keys.py), a hit is exactly the value the device would recompute —
+the executor skips the dispatch entirely and feeds the combine.
+
+Values are deep-copied on BOTH put and get: the combine functions merge
+agg states IN PLACE (engine/combine.py mutates lists/sets/digests of the
+first intermediate), so sharing a cached object across queries would
+corrupt it on the second merge.
+
+Device-resident sparse tables live in segment/device_cache.py against the
+HBM budget; this tier holds host objects under its own byte budget
+(``PINOT_TPU_PARTIAL_CACHE_MB``, default 256).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+
+def partial_cache_enabled() -> bool:
+    """Segment partial caching defaults ON; PINOT_TPU_SEGMENT_CACHE=0
+    disables it process-wide (per query: ``SET segmentCache = false``)."""
+    return os.environ.get("PINOT_TPU_SEGMENT_CACHE", "1") \
+        not in ("0", "false", "")
+
+
+def _default_budget() -> int:
+    return int(float(os.environ.get("PINOT_TPU_PARTIAL_CACHE_MB", 256))
+               * (1 << 20))
+
+
+def _estimate_partial_bytes(inter) -> int:
+    """Footprint estimate for the byte budget — same container heuristics
+    as the scheduler accountant (engine/query_executor._estimate_bytes),
+    inlined here so the cache never imports the engine (cycle)."""
+    from ..engine.results import (AggIntermediate, GroupArrays,
+                                  GroupByIntermediate)
+
+    if isinstance(inter, GroupArrays):
+        return (sum(k.nbytes for k in inter.key_cols)
+                + sum(c.nbytes for comps in inter.state_cols for c in comps)
+                + 64)
+    if isinstance(inter, GroupByIntermediate):
+        width = 1 + max((len(v) for v in inter.groups.values()), default=0)
+        return 64 * width * max(1, len(inter.groups))
+    if isinstance(inter, AggIntermediate):
+        return 64 * max(1, len(inter.states))
+    return 256
+
+
+class SegmentPartialCache:
+    """LRU, byte-budgeted map of (program_fp, segment_token) → partial.
+    Thread-safe: cluster servers run concurrent queries over one process-
+    global instance. Entries remember which segment names fed them so
+    lineage events (replace/delete/commit) can evict eagerly by name."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = _default_budget() if max_bytes is None else max_bytes
+        # key → (value, nbytes, segment_names)
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: tuple):
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            value = ent[0]
+        return copy.deepcopy(value)
+
+    def put(self, key: tuple, value, segment_names: tuple) -> None:
+        try:
+            stored = copy.deepcopy(value)
+        except Exception:
+            return  # uncopyable state (open handles etc.): skip, never fail
+        nbytes = _estimate_partial_bytes(stored)
+        with self._lock:
+            if nbytes > self.max_bytes:
+                return
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (stored, nbytes, tuple(segment_names))
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, (_, freed, _) = self._entries.popitem(last=False)
+                self._bytes -= freed
+                self.evictions += 1
+                SERVER_METRICS.add_meter(ServerMeter.SEGMENT_CACHE_EVICTIONS)
+
+    def invalidate_segment(self, segment_name: str) -> int:
+        """Drop every entry derived from ``segment_name`` (lineage event:
+        replace/delete/realtime commit). Content-addressed keys make stale
+        hits impossible anyway; this frees the bytes eagerly."""
+        with self._lock:
+            stale = [k for k, ent in self._entries.items()
+                     if segment_name in ent[2]]
+            for k in stale:
+                self._bytes -= self._entries.pop(k)[1]
+            return len(stale)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "maxBytes": self.max_bytes, "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions}
+
+
+GLOBAL_PARTIAL_CACHE = SegmentPartialCache()

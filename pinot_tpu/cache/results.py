@@ -1,0 +1,126 @@
+"""Broker full-response cache + table lineage epochs (cache tier 3).
+
+A full BrokerResponse is reusable only while the table's segment lineage
+is unchanged, so cache keys embed a **lineage epoch**: a counter in the
+property store (``/CACHEEPOCH/{tableNameWithType}``) bumped on every
+segment upload/replace/delete (cluster/controller.py, cluster/periodic.py
+— which also covers minion refresh/merge tasks, since those land through
+the controller) and on realtime segment commit (realtime/completion.py).
+A bumped epoch changes every key for the table; stale entries simply stop
+being addressable and age out by TTL/LRU.
+
+Entries expire by TTL (``PINOT_TPU_RESULT_CACHE_TTL_S``, default 300) and
+by a byte budget (``PINOT_TPU_RESULT_CACHE_MB``, default 64). The clock is
+injectable for tests.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from ..spi.metrics import BROKER_METRICS, BrokerMeter
+
+EPOCH_PREFIX = "/CACHEEPOCH"
+
+
+def result_cache_enabled() -> bool:
+    """Broker result caching defaults ON; PINOT_TPU_RESULT_CACHE=0
+    disables it process-wide (per query: ``SET resultCache = false``)."""
+    return os.environ.get("PINOT_TPU_RESULT_CACHE", "1") \
+        not in ("0", "false", "")
+
+
+def lineage_epoch(store, name_with_type: str) -> int:
+    """Current lineage epoch for a table (0 = never bumped)."""
+    return int(store.get(f"{EPOCH_PREFIX}/{name_with_type}") or 0)
+
+
+def bump_lineage_epoch(store, name_with_type: str) -> None:
+    """Advance the table's epoch — every broker result-cache key derived
+    from the old epoch becomes unreachable atomically."""
+    store.update(f"{EPOCH_PREFIX}/{name_with_type}",
+                 lambda cur: int(cur or 0) + 1)
+
+
+def _estimate_response_bytes(resp) -> int:
+    rt = getattr(resp, "result_table", None)
+    if rt is None:
+        return 512
+    width = max(1, len(getattr(rt, "rows", None) and rt.rows[0] or ()))
+    return 512 + 48 * width * len(rt.rows)
+
+
+class BrokerResultCache:
+    """TTL + byte-budgeted LRU of query_fp-keyed BrokerResponses."""
+
+    def __init__(self, max_bytes: Optional[int] = None,
+                 ttl_s: Optional[float] = None, clock=time.monotonic):
+        if max_bytes is None:
+            max_bytes = int(float(os.environ.get(
+                "PINOT_TPU_RESULT_CACHE_MB", 64)) * (1 << 20))
+        if ttl_s is None:
+            ttl_s = float(os.environ.get("PINOT_TPU_RESULT_CACHE_TTL_S", 300))
+        self.max_bytes = max_bytes
+        self.ttl_s = ttl_s
+        self._clock = clock
+        # key → (response, nbytes, inserted_at)
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: tuple):
+        """Shallow copy on hit: callers restamp per-request fields
+        (time_used_ms, requestId) without touching the cached object.
+        result_table/rows are shared read-only — the REST layer only
+        serializes them."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and \
+                    self._clock() - ent[2] > self.ttl_s:
+                self._entries.pop(key)
+                self._bytes -= ent[1]
+                ent = None
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return copy.copy(ent[0])
+
+    def put(self, key: tuple, resp) -> None:
+        nbytes = _estimate_response_bytes(resp)
+        with self._lock:
+            if nbytes > self.max_bytes:
+                return
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (copy.copy(resp), nbytes, self._clock())
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, (_, freed, _) = self._entries.popitem(last=False)
+                self._bytes -= freed
+                self.evictions += 1
+                BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_EVICTIONS)
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "maxBytes": self.max_bytes, "ttlS": self.ttl_s,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}

@@ -29,6 +29,9 @@ from ..query.expressions import ExpressionContext
 from ..query.filter import FilterContext, Predicate, PredicateType
 from ..query.parser.sql import SqlParseError, parse_sql
 from ..spi.data_types import Schema
+from ..spi.metrics import BROKER_METRICS, BrokerMeter
+from ..cache.results import BrokerResultCache, lineage_epoch, \
+    result_cache_enabled
 from .controller import ONLINE, raw_table_name, table_name_with_type
 from .quota import QueryQuotaExceededError, QueryQuotaManager, ResponseStore
 from .store import PropertyStore
@@ -99,6 +102,10 @@ class Broker:
         from .querylog import QueryLogger
 
         self.query_logger = QueryLogger()
+        # full-response cache (cache/results.py): keyed on canonical query
+        # fingerprint + table lineage epoch, so any segment upload/replace/
+        # delete or realtime commit makes old entries unreachable
+        self.result_cache = BrokerResultCache()
         self._server_stats: dict[str, _ServerStats] = {}
         self._clients: dict[str, RpcClient] = {}
         self._rr = 0  # round-robin cursor for replica selection
@@ -237,13 +244,57 @@ class Broker:
                 exceptions=[f"QueryQuotaExceededError: {e}"])
             resp._log_table = query.table_name
             return resp
+        ck = self._result_cache_key(query, segments)
+        if ck is not None:
+            cached = self.result_cache.get(ck)
+            if cached is not None:
+                BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_HITS)
+                cached.cache_outcome = "hit"
+                cached.time_used_ms = (time.perf_counter() - t0) * 1000
+                cached._log_table = query.table_name
+                return cached
         try:
             resp = self._execute(query, only_segments=segments)
         except Exception as e:
             resp = BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         resp._log_table = query.table_name
+        resp.cache_outcome = "miss" if ck is not None else "bypass"
+        if ck is not None and not resp.exceptions \
+                and resp.result_table is not None:
+            BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_MISSES)
+            self.result_cache.put(ck, resp)
         return resp
+
+    def _result_cache_key(self, query: QueryContext,
+                          only_segments: Optional[dict]) -> Optional[tuple]:
+        """Cacheability decision tree (README "Result caching"): no explicit
+        segment restriction, no trace, no SET resultCache=false, no
+        non-deterministic functions, and no REALTIME half (a consuming
+        snapshot's rows advance without any lineage event). Returns the
+        (query_fp, table, lineage epoch) key, or None → bypass."""
+        if only_segments is not None or not result_cache_enabled():
+            return None
+        opt = query.query_options.get("resultCache")
+        if opt is not None and str(opt).lower() in ("false", "0", "off"):
+            return None
+        if query.query_options.get("trace") in (True, "true", 1):
+            return None
+        text = str(query).lower()
+        if "now(" in text or "rand(" in text or "ago(" in text:
+            return None
+        raw = raw_table_name(query.table_name)
+        if self.store.get(
+                f"/CONFIGS/TABLE/{table_name_with_type(raw, 'REALTIME')}") \
+                is not None:
+            return None
+        from ..cache.keys import query_fingerprint
+
+        fp = query_fingerprint(query)
+        if fp is None:
+            return None
+        offline = table_name_with_type(raw, "OFFLINE")
+        return (fp, offline, lineage_epoch(self.store, offline))
 
     def execute_sql_stream(self, sql: str):
         """Streaming query: a generator of ResultTable pages (reference:
@@ -400,6 +451,9 @@ class Broker:
         all_results = []
         stats_sum = {"total_docs": 0, "num_segments_processed": 0,
                      "num_segments_pruned": 0, "num_segments_queried": 0,
+                     "num_device_dispatches": 0, "num_compiles": 0,
+                     "num_segments_cache_hit": 0,
+                     "num_segments_cache_miss": 0,
                      "server_traces": []}
         try:
             for name_with_type, extra_filter in halves:
@@ -436,6 +490,10 @@ class Broker:
             num_segments_pruned=stats_sum["num_segments_pruned"],
             num_groups_limit_reached=getattr(combined, "groups_trimmed",
                                              False),
+            num_device_dispatches=stats_sum["num_device_dispatches"],
+            num_compiles=stats_sum["num_compiles"],
+            num_segments_cache_hit=stats_sum["num_segments_cache_hit"],
+            num_segments_cache_miss=stats_sum["num_segments_cache_miss"],
         )
         if trace_info is not None:
             resp.trace_info = trace_info
@@ -452,6 +510,9 @@ class Broker:
         for _ in range(3):
             local = {"total_docs": 0, "num_segments_processed": 0,
                      "num_segments_pruned": 0, "num_segments_queried": 0,
+                     "num_device_dispatches": 0, "num_compiles": 0,
+                     "num_segments_cache_hit": 0,
+                     "num_segments_cache_miss": 0,
                      "server_traces": []}
             try:
                 results = self._scatter_gather_once(
@@ -538,6 +599,9 @@ class Broker:
             stats_sum["total_docs"] += st["total_docs"]
             stats_sum["num_segments_processed"] += st["num_segments_processed"]
             stats_sum["num_segments_pruned"] += st["num_segments_pruned"]
+            for k in ("num_device_dispatches", "num_compiles",
+                      "num_segments_cache_hit", "num_segments_cache_miss"):
+                stats_sum[k] += st.get(k, 0)
             for s in st.get("missing_segments", []):
                 missing_sink.setdefault(inst, []).append(s)
 

@@ -116,6 +116,12 @@ class ClusterController:
             return ideal
 
         self.store.update(f"/IDEALSTATES/{name_with_type}", upd)
+        # lineage epoch bump (cache/results.py): every upload/refresh —
+        # including minion refresh/merge tasks, which land here — makes
+        # broker result-cache entries for this table unreachable
+        from ..cache.results import bump_lineage_epoch
+
+        bump_lineage_epoch(self.store, name_with_type)
         return assigned
 
     def drop_segment(self, name_with_type: str, segment_name: str) -> None:
@@ -126,6 +132,9 @@ class ClusterController:
 
         self.store.update(f"/IDEALSTATES/{name_with_type}", upd)
         self.store.delete(f"/SEGMENTS/{name_with_type}/{segment_name}")
+        from ..cache.results import bump_lineage_epoch
+
+        bump_lineage_epoch(self.store, name_with_type)
 
     def segment_metadata(self, name_with_type: str, segment_name: str) -> Optional[dict]:
         return self.store.get(f"/SEGMENTS/{name_with_type}/{segment_name}")

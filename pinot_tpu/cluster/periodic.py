@@ -196,6 +196,11 @@ class SegmentLineageManager:
         # (periodic LineageCleanupTask) finishes idempotently.
         self.store.update(f"/LINEAGE/{table}", lambda cur: {
             **(cur or {}), lineage_id: {**entry, "state": "COMPLETED"}})
+        # the routing switch just happened — cached broker results built on
+        # the FROM set are stale from this instant (cache/results.py)
+        from ..cache.results import bump_lineage_epoch
+
+        bump_lineage_epoch(self.store, table)
         self._finish_completed(table, lineage_id, entry)
 
     def _finish_completed(self, table: str, lineage_id: str,
@@ -242,6 +247,9 @@ class SegmentLineageManager:
             self.store.delete(f"/SEGMENTS/{table}/{seg}")
         self.store.update(f"/LINEAGE/{table}", lambda cur: {
             **(cur or {}), lineage_id: {**entry, "state": "REVERTED"}})
+        from ..cache.results import bump_lineage_epoch
+
+        bump_lineage_epoch(self.store, table)
 
     def routable_segments(self, table: str, all_segments: set) -> set:
         """Filter by lineage (reference: the broker's lineage-based segment

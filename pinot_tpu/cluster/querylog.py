@@ -63,6 +63,12 @@ class QueryLogger:
             "timestamp": time.time(),
             "sql": sql_part,
         }
+        outcome = getattr(response, "cache_outcome", None)
+        if outcome:
+            # a "slow but cached" query is an anomaly worth seeing: the
+            # result cache answered yet the request still crossed the
+            # slow threshold (serialization? lock contention?)
+            entry["cacheOutcome"] = outcome
         trace_info = getattr(response, "trace_info", None)
         if trace_info:
             from ..spi.trace import phase_breakdown

@@ -197,6 +197,7 @@ class BrokerRestServer(_RestServer):
                 (r"/health", lambda h, m, q: (200, {"status": "OK"})),
                 (r"/metrics", lambda h, m, q: srv._metrics()),
                 (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
+                (r"/debug/cache", lambda h, m, q: srv._debug_cache()),
                 # cursor ids are not table names: no group-based table check
                 (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
                     m.group(1), int(q.get("offset", ["0"])[0]),
@@ -214,6 +215,7 @@ class BrokerRestServer(_RestServer):
                 (r"/resultStore/([^/]+)",
                  lambda h, m, q: srv._cursor_delete(m.group(1), h.principal),
                  "READ"),
+                (r"/cache", lambda h, m, q: srv._cache_clear(), "WRITE"),
             ]
 
         Handler.access_control = access_control
@@ -235,6 +237,30 @@ class BrokerRestServer(_RestServer):
         ql = self.broker.query_logger
         return 200, {"slowThresholdMs": ql.slow_threshold_ms,
                      "slowQueries": ql.slow_queries()}
+
+    def _debug_cache(self):
+        """All three cache tiers' live stats: the broker result cache plus
+        (same process in this build) the server-side segment partial cache
+        and device-resident partial residency (cache/ package)."""
+        from ..cache.partial import GLOBAL_PARTIAL_CACHE
+        from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+
+        return 200, {"resultCache": self.broker.result_cache.stats(),
+                     "segmentPartialCache": GLOBAL_PARTIAL_CACHE.stats(),
+                     "devicePartials": GLOBAL_DEVICE_CACHE.hbm_stats()}
+
+    def _cache_clear(self):
+        """DELETE /cache — drop every tier (operator hammer for debugging
+        staleness or reclaiming memory; lineage invalidation is automatic)."""
+        from ..cache.partial import GLOBAL_PARTIAL_CACHE
+        from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+
+        dropped = self.broker.result_cache.clear()
+        GLOBAL_PARTIAL_CACHE.clear()
+        device_dropped = GLOBAL_DEVICE_CACHE.drop_partials()
+        return 200, {"resultEntriesDropped": dropped,
+                     "devicePartialsDropped": device_dropped,
+                     "status": "cleared"}
 
     def _query(self, body: dict, principal=None):
         sql = body.get("sql")

@@ -107,8 +107,18 @@ class ServerInstance:
                     # without get built at load (SegmentPreProcessor)
                     segment.backfill_indexes(indexing)
                 self.segments.setdefault(table, {})[seg] = segment
+            if to_drop:
+                # dropped/replaced segments invalidate their cached partial
+                # results (host + device tiers) and release device planes —
+                # the server-side half of lineage-driven invalidation
+                from ..cache.partial import GLOBAL_PARTIAL_CACHE
+                from ..segment.device_cache import GLOBAL_DEVICE_CACHE
             for seg in to_drop:
-                self.segments.get(table, {}).pop(seg, None)
+                segment = self.segments.get(table, {}).pop(seg, None)
+                GLOBAL_PARTIAL_CACHE.invalidate_segment(seg)
+                GLOBAL_DEVICE_CACHE.drop_partials(segment_name=seg)
+                if segment is not None:
+                    GLOBAL_DEVICE_CACHE.drop(segment)
             self._register_table(table)
             loaded = set(self.segments.get(table, {}))
         # advertise only what actually loaded — a skipped/failed load must

@@ -221,6 +221,8 @@ class QueryExecutor:
                                              False),
             num_device_dispatches=stats.get("num_device_dispatches", 0),
             num_compiles=stats.get("num_compiles", 0),
+            num_segments_cache_hit=stats.get("num_segments_cache_hit", 0),
+            num_segments_cache_miss=stats.get("num_segments_cache_miss", 0),
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
         if owns_trace:
@@ -342,8 +344,9 @@ class QueryExecutor:
         timeout_ms = query.query_options.get("timeoutMs")
         if timeout_ms is not None:
             deadline = time.perf_counter() + float(timeout_ms) / 1000
+        cstats = {"hit": 0, "miss": 0}
         intermediates = self._run_segments(query, kept, tracker, deadline,
-                                           timeout_ms)
+                                           timeout_ms, cstats)
         with TRACING.scope(ServerQueryPhase.SERVER_COMBINE):
             combined = self._combine(query, intermediates)
         num_dispatches, num_compiles = dispatch_counters()
@@ -361,16 +364,22 @@ class QueryExecutor:
         SERVER_METRICS.add_meter(ServerMeter.NUM_DEVICE_DISPATCHES,
                                  num_dispatches)
         SERVER_METRICS.add_meter(ServerMeter.NUM_COMPILES, num_compiles)
+        SERVER_METRICS.add_meter(ServerMeter.SEGMENT_CACHE_HITS,
+                                 cstats["hit"])
+        SERVER_METRICS.add_meter(ServerMeter.SEGMENT_CACHE_MISSES,
+                                 cstats["miss"])
         return combined, {
             "total_docs": total_docs,
             "num_segments_processed": len(kept),
             "num_segments_pruned": num_pruned,
             "num_device_dispatches": num_dispatches,
             "num_compiles": num_compiles,
+            "num_segments_cache_hit": cstats["hit"],
+            "num_segments_cache_miss": cstats["miss"],
         }
 
     def _run_segments(self, query: QueryContext, kept: list, tracker,
-                      deadline, timeout_ms) -> list:
+                      deadline, timeout_ms, cstats=None) -> list:
         """Two-phase multi-segment execution: dispatch every device kernel
         first (async — the device queue fills and runs back-to-back), run
         host-fallback segments while the device works, then collect. This
@@ -388,7 +397,7 @@ class QueryExecutor:
 
         if len(kept) > 1 and self.backend != "host":
             merged = self._try_sparse_device_combine(query, kept, tracker,
-                                                     check)
+                                                     check, cstats)
             if merged is not None:
                 return merged
 
@@ -411,6 +420,38 @@ class QueryExecutor:
                 if self.backend == "tpu":
                     raise
                 host_work.append((idx, run_query, run_segment, rewrite))
+
+        # segment partial-result cache (cache/partial.py): a hit fills the
+        # intermediate directly and the segment never reaches dispatch; a
+        # miss is remembered so the collected result is inserted below.
+        # Traced runs bypass — the dispatch spans ARE the observability
+        # product and must describe real device work.
+        cache_on = device_entries and self._segment_cache_enabled(query)
+        if cache_on and TRACING.active_trace() is not None:
+            with TRACING.scope("SEGMENT_CACHE(bypass:trace)"):
+                cache_on = False
+        cache_inserts: list = []  # (idx, cache key, segment name)
+        if cache_on:
+            from ..cache.partial import GLOBAL_PARTIAL_CACHE
+
+            uncached = []
+            for e in device_entries:
+                idx, run_query, run_segment, rewrite, plan = e
+                key = self._partial_cache_key(run_query, run_segment,
+                                              rewrite, plan)
+                hit = None if key is None else GLOBAL_PARTIAL_CACHE.get(key)
+                if hit is not None:
+                    intermediates[idx] = hit
+                    if cstats is not None:
+                        cstats["hit"] += 1
+                    continue
+                if key is not None:
+                    if cstats is not None:
+                        cstats["miss"] += 1
+                    cache_inserts.append(
+                        (idx, key, getattr(run_segment, "name", "?")))
+                uncached.append(e)
+            device_entries = uncached
 
         # stacked segment batching: one vmapped dispatch per batch FAMILY
         # (equal host-side family key → identical plane shapes), single-
@@ -581,7 +622,46 @@ class QueryExecutor:
             intermediates[idx] = (
                 self._remap_star_tree(rewrite, inter) if rewrite else inter)
             done += 1
+        if cache_inserts:
+            from ..cache.partial import GLOBAL_PARTIAL_CACHE
+
+            for idx, key, seg_name in cache_inserts:
+                inter = intermediates[idx]
+                # selections bypass (LIMIT makes row sets order-dependent
+                # across segments and the payoff is row materialization,
+                # not device work); agg/group partials are pure merges
+                if isinstance(inter, (AggIntermediate, GroupByIntermediate)):
+                    GLOBAL_PARTIAL_CACHE.put(key, inter, (seg_name,))
         return intermediates
+
+    def _segment_cache_enabled(self, query: QueryContext) -> bool:
+        """Segment partial-result caching is ON by default for the device
+        path; ``SET segmentCache = false`` opts a query out and
+        PINOT_TPU_SEGMENT_CACHE=0 disables it process-wide. The option is
+        checked FIRST so opted-out queries never touch fingerprinting."""
+        opt = query.query_options.get("segmentCache")
+        if opt is not None and str(opt).lower() in ("false", "0", "off"):
+            return False
+        from ..cache.partial import partial_cache_enabled
+
+        return partial_cache_enabled()
+
+    def _partial_cache_key(self, run_query, run_segment, rewrite, plan):
+        """(program_fp, segment_token) for one routed segment, or None when
+        this segment can't participate: star-tree rewrites (the cached
+        partial would be pre-remap against a derived view), mutable/
+        crc-less segments, or plans with unfingerprintable state."""
+        if rewrite is not None:
+            return None
+        from ..cache.keys import program_fingerprint, segment_token
+
+        token = segment_token(run_segment)
+        if token is None:
+            return None
+        fp = program_fingerprint(plan, run_query)
+        if fp is None:
+            return None
+        return (fp, token)
 
     def _segment_batch_enabled(self, query: QueryContext) -> bool:
         """Stacked segment batching is ON by default; SET segmentBatch =
@@ -614,7 +694,7 @@ class QueryExecutor:
                              "min": "min", "max": "max"}
 
     def _try_sparse_device_combine(self, query: QueryContext, kept, tracker,
-                                   check):
+                                   check, cstats=None):
         """Server-level merge ON DEVICE for multi-segment single-key sparse
         group-bys: dispatch every segment's kernel, translate each key
         column to dictionary VALUE space on device (dictionaries are
@@ -669,13 +749,50 @@ class QueryExecutor:
                         np.integer)
                     and all(la.vec is not None for la in pl.lowered_aggs)):
                 return None
+        # two cache tiers for this path (cache/partial.py): the fully
+        # merged host GroupArrays keyed by the ORDERED per-segment keys —
+        # a hit is the whole warm repeat with ZERO device dispatches — and
+        # per-segment value-space tables kept DEVICE-resident against the
+        # HBM budget, so partial overlap still skips member dispatches and
+        # feeds the device combine directly.
+        cache_on = self._segment_cache_enabled(query) \
+            and TRACING.active_trace() is None
+        keys = None
+        merged_key = None
+        if cache_on:
+            keys = [self._partial_cache_key(query, seg, None, pl)
+                    for seg, pl in zip(segs, plans)]
+            if all(k is not None for k in keys):
+                from ..cache.partial import GLOBAL_PARTIAL_CACHE
+
+                # sorted: the sort/edge-reduce merge is order-insensitive,
+                # so any segment ordering of the same set may hit
+                merged_key = ("sparse_merged",) + tuple(sorted(keys))
+                hit = GLOBAL_PARTIAL_CACHE.get(merged_key)
+                if hit is not None:
+                    if cstats is not None:
+                        cstats["hit"] += len(segs)
+                    if tracker is not None:
+                        GLOBAL_ACCOUNTANT.on_allocation(
+                            tracker, _estimate_bytes(hit))
+                    return [hit]
         try:
             # one vmapped dispatch per batch family; members pull lazy
             # device-side rows from the batched outputs (never fetched —
             # the merged table below is the only D2H transfer)
             member_outs: list = [None] * len(segs)
+            cached_tabs: dict = {}
+            if cache_on and keys is not None:
+                for i, k in enumerate(keys):
+                    if k is not None:
+                        tab = self.tpu.cache.get_partial(("sparse_tab",) + k)
+                        if tab is not None:
+                            cached_tabs[i] = tab
             for fkey, positions in self._batch_families(
                     query, list(zip(segs, plans))):
+                positions = [i for i in positions if i not in cached_tabs]
+                if not positions:
+                    continue
                 if fkey is not None and len(positions) > 1:
                     try:
                         # same batched-OOM discipline as _run_segments: a
@@ -702,11 +819,28 @@ class QueryExecutor:
             seg_keys, seg_counts, seg_states = [], [], []
             for done, (segment, pl) in enumerate(zip(segs, plans)):
                 check(done)
-                outs, view = member_outs[done]
-                seg_keys.append(kernels.ids_to_values_i64(
-                    outs[-1], view.dict_values(pl.group_dims[0].column)))
-                seg_counts.append(outs[0])
-                seg_states.append(tuple(outs[1:-1]))
+                tab = cached_tabs.get(done)
+                if tab is not None:
+                    keys64, cnt, states = tab[0], tab[1], tuple(tab[2:])
+                    if cstats is not None:
+                        cstats["hit"] += 1
+                else:
+                    outs, view = member_outs[done]
+                    keys64 = kernels.ids_to_values_i64(
+                        outs[-1], view.dict_values(pl.group_dims[0].column))
+                    cnt = outs[0]
+                    states = tuple(outs[1:-1])
+                    if cache_on and keys is not None \
+                            and keys[done] is not None:
+                        self.tpu.cache.put_partial(
+                            ("sparse_tab",) + keys[done],
+                            (keys64, cnt) + states,
+                            segment_name=getattr(segment, "name", "?"))
+                        if cstats is not None:
+                            cstats["miss"] += 1
+                seg_keys.append(keys64)
+                seg_counts.append(cnt)
+                seg_states.append(states)
             merged = kernels.combine_sparse_group_tables(
                 tuple(seg_keys), tuple(seg_counts), tuple(seg_states),
                 kinds)
@@ -733,6 +867,12 @@ class QueryExecutor:
             [la.vec.fin_tag for la in las],
             num_docs_scanned=int(counts.sum()) + trash,
             groups_trimmed=trash > 0 and not p0.exact_trim)
+        if merged_key is not None:
+            from ..cache.partial import GLOBAL_PARTIAL_CACHE
+
+            GLOBAL_PARTIAL_CACHE.put(
+                merged_key, ga,
+                tuple(getattr(s, "name", "?") for s in segs))
         if tracker is not None:
             GLOBAL_ACCOUNTANT.on_allocation(tracker, _estimate_bytes(ga))
         return [ga]

@@ -57,6 +57,10 @@ class BrokerResponse:
     # not segments — these make the win visible per query
     num_device_dispatches: int = 0
     num_compiles: int = 0
+    # segment partial-result cache outcome for this query (cache/partial.py):
+    # kept segments served from cache vs actually executed
+    num_segments_cache_hit: int = 0
+    num_segments_cache_miss: int = 0
 
     def to_json(self) -> dict:
         out = {
@@ -81,6 +85,9 @@ class BrokerResponse:
         if self.num_device_dispatches:
             out["numDeviceDispatches"] = self.num_device_dispatches
             out["numCompiles"] = self.num_compiles
+        if self.num_segments_cache_hit or self.num_segments_cache_miss:
+            out["numSegmentsCacheHit"] = self.num_segments_cache_hit
+            out["numSegmentsCacheMiss"] = self.num_segments_cache_miss
         return out
 
 

@@ -168,6 +168,12 @@ class SegmentCompletionManager:
                           status="DONE", committer=instance,
                           commitTimeMs=int(time.time() * 1000))
             self.store.set(f"/SEGMENTS/{table}/{segment}", record)
+            # a realtime commit changes the table's served content: bump
+            # the lineage epoch so broker result-cache entries keyed on the
+            # old epoch become unreachable (cache/results.py)
+            from ..cache.results import bump_lineage_epoch
+
+            bump_lineage_epoch(self.store, table)
             # prune: the store DONE record (checked first in
             # segment_consumed/fsm_state) answers late polls; keeping every
             # finished FSM would leak for the life of the controller

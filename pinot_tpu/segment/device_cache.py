@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import OrderedDict
 from typing import Optional
 
 import jax
@@ -263,6 +264,13 @@ class DeviceSegmentCache:
         self._order: list[int] = []  # LRU
         self._stacks: dict[tuple, StackedSegmentView] = {}
         self._stack_order: list[tuple] = []  # LRU over stacked views
+        # device-resident cached partial results (cache/partial.py tier 2:
+        # sparse group tables kept in HBM so a warm repeat query feeds the
+        # device combine with zero dispatches). key → (arrays, nbytes,
+        # segment_name); insertion order doubles as LRU via move-to-end.
+        self._partials: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.partial_hits = 0
+        self.partial_misses = 0
         # lifetime pressure-eviction count (budget LRU + OOM relief),
         # surfaced in hbm_stats() / dispatch-span HBM snapshots
         self.evictions = 0
@@ -336,10 +344,53 @@ class DeviceSegmentCache:
                     n += 1
         return n
 
+    # -- device-resident cached partials (cache tier 2) ---------------------
+    def put_partial(self, key: tuple, arrays: tuple,
+                    segment_name: str) -> None:
+        """Register a cached partial result (tuple of device arrays — e.g.
+        a sparse group table's key/count/state columns) against the HBM
+        budget. Partials are DERIVED data like stacks: under pressure they
+        evict before any column plane, newest included."""
+        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in arrays)
+        with self._lock:
+            if self.budget_bytes is not None and nbytes > self.budget_bytes:
+                return
+            old = self._partials.pop(key, None)
+            self._partials[key] = (tuple(arrays), nbytes, str(segment_name))
+            if old is None:
+                self._maybe_evict()
+
+    def get_partial(self, key: tuple) -> Optional[tuple]:
+        with self._lock:
+            ent = self._partials.get(key)
+            if ent is None:
+                self.partial_misses += 1
+                return None
+            self._partials.move_to_end(key)
+            self.partial_hits += 1
+            return ent[0]
+
+    def drop_partials(self, segment_name: Optional[str] = None) -> int:
+        """Evict cached partials — all of them, or only those derived from
+        ``segment_name`` (lineage events: segment replace/delete)."""
+        with self._lock:
+            if segment_name is None:
+                n = len(self._partials)
+                self._partials.clear()
+            else:
+                stale = [k for k, ent in self._partials.items()
+                         if ent[2] == str(segment_name)]
+                for k in stale:
+                    del self._partials[k]
+                n = len(stale)
+            self.evictions += n
+            return n
+
     def drop(self, segment: ImmutableSegment) -> None:
         """Release a retired segment's device planes (call on segment drop —
         reference: segment replace/delete in BaseTableDataManager)."""
         key = id(segment)
+        name = getattr(segment, "name", None)
         with self._lock:
             v = self._views.pop(key, None)
             if v is not None:
@@ -350,6 +401,10 @@ class DeviceSegmentCache:
             for skey in [k for k in self._stacks if key in k]:
                 self._stacks.pop(skey).evict()
                 self._stack_order.remove(skey)
+            if name is not None:
+                for pkey in [k for k, ent in self._partials.items()
+                             if ent[2] == str(name)]:
+                    del self._partials[pkey]
 
     def evict_all_except(self, keep_segment=None) -> tuple[int, int]:
         """HBM-pressure relief (engine/oom.py): evict every cached view
@@ -357,7 +412,11 @@ class DeviceSegmentCache:
         keep_key = id(keep_segment) if keep_segment is not None else None
         freed = victims = 0
         with self._lock:
-            # stacks first: derived [S, N] copies, always safe to rebuild
+            # cached partials are pure derived data — cheapest to shed
+            for pkey in list(self._partials):
+                freed += self._partials.pop(pkey)[1]
+                victims += 1
+            # stacks next: derived [S, N] copies, always safe to rebuild
             for skey in list(self._stacks):
                 freed += self._stacks[skey].nbytes()
                 self._stacks.pop(skey).evict()
@@ -381,7 +440,15 @@ class DeviceSegmentCache:
             return
         total = sum(v.nbytes() for v in self._views.values())
         total += sum(s.nbytes() for s in self._stacks.values())
-        # stacks evict first: they duplicate member planes, so dropping a
+        total += sum(ent[1] for ent in self._partials.values())
+        # cached partials evict first (pure derived data, a miss only costs
+        # a re-dispatch), LRU order and ALL of them evictable — unlike the
+        # loops below, nothing here is load-bearing for an in-flight call
+        while total > self.budget_bytes and self._partials:
+            _, (_, freed, _) = self._partials.popitem(last=False)
+            total -= freed
+            self.evictions += 1
+        # stacks next: they duplicate member planes, so dropping a
         # stack frees bytes without costing a host→device re-upload. Like
         # the views loop below, the most-recently-touched entry survives —
         # stacked_view() must not lose the stack it just registered.
@@ -403,11 +470,15 @@ class DeviceSegmentCache:
         Sums plane bytes under the lock — call from traced paths, not the
         tracing-off hot path."""
         with self._lock:
+            partial_bytes = sum(ent[1] for ent in self._partials.values())
             used = sum(v.nbytes() for v in self._views.values())
             used += sum(s.nbytes() for s in self._stacks.values())
+            used += partial_bytes
             return {"hbmBytesUsed": used,
                     "hbmBudgetBytes": self.budget_bytes,
-                    "hbmEvictions": self.evictions}
+                    "hbmEvictions": self.evictions,
+                    "hbmPartialEntries": len(self._partials),
+                    "hbmPartialBytes": partial_bytes}
 
 
 # Default budget keeps headroom on a 16GB v5e; override via env.

@@ -39,6 +39,9 @@ class ServerMeter:
     HBM_OOM_EVENTS = "hbmOomEvents"
     HBM_OOM_EVICTIONS = "hbmOomEvictions"
     HBM_OOM_QUERY_FAILURES = "hbmOomQueryFailures"
+    SEGMENT_CACHE_HITS = "segmentCacheHits"
+    SEGMENT_CACHE_MISSES = "segmentCacheMisses"
+    SEGMENT_CACHE_EVICTIONS = "segmentCacheEvictions"
 
 
 class BrokerMeter:
@@ -46,6 +49,9 @@ class BrokerMeter:
     BROKER_RESPONSES_WITH_EXCEPTIONS = "brokerResponsesWithExceptions"
     REQUEST_FAILURES = "requestFailures"
     NO_SERVING_HOST_FOR_SEGMENT = "noServingHostForSegment"
+    RESULT_CACHE_HITS = "resultCacheHits"
+    RESULT_CACHE_MISSES = "resultCacheMisses"
+    RESULT_CACHE_EVICTIONS = "resultCacheEvictions"
 
 
 class ServerTimer:

@@ -41,6 +41,17 @@ MIXED_SIZES = [6000, 9000, 3000]
 NO_BATCH = "SET segmentBatch = false; "
 
 
+@pytest.fixture(autouse=True)
+def _no_segment_cache(monkeypatch):
+    # the segment partial-result cache (cache/partial.py) would satisfy
+    # repeat queries with zero dispatches — and since segmentBatch is an
+    # execution-only option, the NO_BATCH "solo" runs share the batched
+    # runs' fingerprints and would hit their cached partials, turning every
+    # parity oracle and dispatch-count guard here into a self-comparison.
+    # This module tests the dispatcher, so caching is off throughout.
+    monkeypatch.setenv("PINOT_TPU_SEGMENT_CACHE", "0")
+
+
 def _gen(rng, n):
     return {
         "k": rng.integers(0, N_KEYS, n).astype(np.int32),
