@@ -268,16 +268,18 @@ def _probe_accelerator():
 
     Retries failed (errored) probes with backoff across the probe budget
     (round-1 failure: ONE transient init error killed the bench). A HUNG
-    probe is waited on up to the probe budget and then ABANDONED, never
-    killed: killing a process mid-lease-acquisition is what wedged the
-    round-2 tunnel. stderr goes to a temp FILE, not a pipe, so a wedged
-    tunnel's helper child can't block us by inheriting the pipe fd.
+    probe is ABANDONED after an explicit per-attempt timeout
+    (BENCH_PROBE_ATTEMPT_S, default half the budget so one hang leaves
+    room for exactly one retry) — never killed: killing a process
+    mid-lease-acquisition is what wedged the round-2 tunnel. stderr goes
+    to a temp FILE, not a pipe, so a wedged tunnel's helper child can't
+    block us by inheriting the pipe fd.
     """
     import subprocess
     import tempfile
 
     report = {"status": "skipped", "attempts": []}
-    # probe budget sized so a DEAD tunnel (one hung attempt consumes the
+    # probe budget sized so a DEAD tunnel (two hung attempts consume the
     # whole budget) still leaves room for all nine cpu-fallback configs:
     # observed init latencies are ~30s when the tunnel is healthy, and
     # fail-fast errors retry with backoff well inside 360s
@@ -285,31 +287,42 @@ def _probe_accelerator():
         "BENCH_INIT_PROBE_S", min(360.0, TIME_BUDGET_S * 0.25)))
     if budget <= 0:
         return True, report
-    deadline = time.monotonic() + min(budget, max(_remaining() - 120, 30))
+    budget = min(budget, max(_remaining() - 120, 30))
+    # per-attempt cap: budget/2 means a hung first attempt still leaves
+    # budget for ONE retry (a transiently wedged tunnel often recovers)
+    attempt_s = float(os.environ.get("BENCH_PROBE_ATTEMPT_S", budget / 2))
+    deadline = time.monotonic() + budget
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     attempt = 0
+    hung_attempts = 0
     while time.monotonic() < deadline:
         attempt += 1
+        attempt_deadline = min(deadline, time.monotonic() + attempt_s)
         with tempfile.TemporaryFile() as ef:
             proc = subprocess.Popen(
                 [sys.executable, "-c",
                  "import jax; jax.numpy.zeros(8).block_until_ready()"],
                 stdout=subprocess.DEVNULL, stderr=ef, env=env,
                 start_new_session=True)
-            while time.monotonic() < deadline and proc.poll() is None:
+            while time.monotonic() < attempt_deadline and proc.poll() is None:
                 time.sleep(1.0)
             rc = proc.poll()
             if rc == 0:
                 report["status"] = "ok"
                 return True, report
             if rc is None:  # hung: abandon (no kill — lease-wedge hazard)
+                hung_attempts += 1
                 print(f"[bench] probe attempt {attempt} still hung after "
-                      f"{budget:.0f}s budget; abandoning it", file=sys.stderr)
+                      f"{attempt_s:.0f}s per-attempt timeout; abandoning it",
+                      file=sys.stderr)
                 report["status"] = "hung"
                 report["attempts"].append(
                     {"rc": None, "stderr_tail":
-                     f"hung past the {budget:.0f}s probe budget; abandoned"})
-                return False, report
+                     f"hung past the {attempt_s:.0f}s per-attempt timeout; "
+                     f"abandoned"})
+                if hung_attempts >= 2:  # one retry after a hang, then give up
+                    return False, report
+                continue
             ef.seek(0)
             tail = ef.read()[-2000:].decode(errors="replace").strip()
             print(f"[bench] probe attempt {attempt} failed (rc={rc}):\n{tail}",

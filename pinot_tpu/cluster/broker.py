@@ -10,8 +10,10 @@ and BrokerReduceService.reduceOnDataTable:61.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
@@ -35,7 +37,7 @@ from ..cache.results import BrokerResultCache, lineage_epoch, \
 from .controller import ONLINE, raw_table_name, table_name_with_type
 from .quota import QueryQuotaExceededError, QueryQuotaManager, ResponseStore
 from .store import PropertyStore
-from .transport import RpcClient, TransportError
+from .transport import RemoteError, RpcClient, TransportError
 
 
 class _StaleRoutingError(Exception):
@@ -71,6 +73,13 @@ class _FailureDetector:
             until, _ = entry
             return time.monotonic() >= until  # retry window open
 
+    def down_count(self) -> int:
+        """Servers currently inside their backoff window (the
+        serversUnhealthy gauge)."""
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for until, _ in self._down.values() if until > now)
+
 
 class _ServerStats:
     """Per-server latency EWMA + in-flight count for adaptive selection
@@ -91,11 +100,40 @@ class _ServerStats:
                         if self.ewma_ms else latency_ms)
 
 
+class _QueryBudget:
+    """Per-query deadline + failure-degradation context, threaded through
+    scatter/gather so every RPC is stamped with the REMAINING time budget
+    and every degradation decision (failover exhausted, deadline expired)
+    can consult allowPartialResults."""
+
+    __slots__ = ("deadline", "query_id", "partial_ok")
+
+    def __init__(self, timeout_ms: float, partial_ok: bool):
+        self.deadline = time.monotonic() + timeout_ms / 1000.0
+        self.query_id = uuid.uuid4().hex[:12]
+        self.partial_ok = partial_ok
+
+    def remaining_s(self) -> float:
+        return self.deadline - time.monotonic()
+
+
 class Broker:
     def __init__(self, store: PropertyStore, num_scatter_threads: int = 8,
-                 adaptive_selection: bool = True):
+                 adaptive_selection: bool = True,
+                 allow_partial_default: Optional[bool] = None):
         self.store = store
         self.failure_detector = _FailureDetector()
+        # broker-level default for graceful degradation; per-query
+        # SET allowPartialResults=... always wins
+        if allow_partial_default is None:
+            allow_partial_default = os.environ.get(
+                "PINOT_TPU_ALLOW_PARTIAL", "").lower() in ("1", "true", "on")
+        self.allow_partial_default = allow_partial_default
+        # default end-to-end budget when the query carries no timeoutMs
+        self.default_timeout_ms = float(os.environ.get(
+            "PINOT_TPU_BROKER_TIMEOUT_MS", 60000))
+        BROKER_METRICS.set_gauge("serversUnhealthy",
+                                 self.failure_detector.down_count)
         self.quota = QueryQuotaManager()
         self.response_store = ResponseStore()
         self.adaptive_selection = adaptive_selection
@@ -153,9 +191,13 @@ class Broker:
                 self._clients[instance] = c
             return c
 
-    def _select_instances(self, routing: dict[str, list[str]]) -> dict[str, list[str]]:
+    def _select_instances(self, routing: dict[str, list[str]],
+                          unavailable_sink: Optional[list] = None
+                          ) -> dict[str, list[str]]:
         """instance → segments, balanced round-robin over healthy replicas
-        (reference: BalancedInstanceSelector)."""
+        (reference: BalancedInstanceSelector). With ``unavailable_sink``
+        (partial-results mode), segments with no online replica are
+        appended to the sink instead of failing the query."""
         plan: dict[str, list[str]] = {}
         unavailable = []
         with self._lock:
@@ -176,7 +218,11 @@ class Broker:
                 pick = candidates[rr % len(candidates)]
             plan.setdefault(pick, []).append(seg)
         if unavailable:
-            raise TransportError(f"no online replica for segments {unavailable}")
+            if unavailable_sink is not None:
+                unavailable_sink.extend(unavailable)
+            else:
+                raise TransportError(
+                    f"no online replica for segments {unavailable}")
         return plan
 
     # -- query --------------------------------------------------------------
@@ -261,6 +307,7 @@ class Broker:
         resp._log_table = query.table_name
         resp.cache_outcome = "miss" if ck is not None else "bypass"
         if ck is not None and not resp.exceptions \
+                and not resp.partial_result \
                 and resp.result_table is not None:
             BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_MISSES)
             self.result_cache.put(ck, resp)
@@ -448,20 +495,31 @@ class Broker:
                 and TRACING.active_trace() is None:
             trace = TRACING.start_trace(f"broker:{raw}")
 
+        budget = _QueryBudget(self._timeout_ms(query),
+                              self._partial_allowed(query))
         all_results = []
         stats_sum = {"total_docs": 0, "num_segments_processed": 0,
                      "num_segments_pruned": 0, "num_segments_queried": 0,
                      "num_device_dispatches": 0, "num_compiles": 0,
                      "num_segments_cache_hit": 0,
                      "num_segments_cache_miss": 0,
-                     "server_traces": []}
+                     "server_traces": [],
+                     "servers_queried": [], "servers_responded": [],
+                     "partial_exceptions": []}
         try:
-            for name_with_type, extra_filter in halves:
-                sub = _with_filter(query, name_with_type, extra_filter)
-                results = self._scatter_gather(
-                    name_with_type, sub, stats_sum,
-                    only_segments=(only_segments or {}).get(name_with_type))
-                all_results.extend(results)
+            try:
+                for name_with_type, extra_filter in halves:
+                    sub = _with_filter(query, name_with_type, extra_filter)
+                    results = self._scatter_gather(
+                        name_with_type, sub, stats_sum, budget,
+                        only_segments=(only_segments or {}).get(name_with_type))
+                    all_results.extend(results)
+            except TimeoutError:
+                # broker abandons the query: best-effort cancel so server
+                # device work stops (lands on ResourceAccountant.kill_query)
+                BROKER_METRICS.add_meter(BrokerMeter.DEADLINE_EXCEEDED)
+                self._broadcast_cancel(budget, stats_sum)
+                raise
 
             with TRACING.scope("BROKER_REDUCE"):
                 combined = self._merge(query, all_results)
@@ -481,6 +539,9 @@ class Broker:
                     else:
                         s["server"] = inst
                     trace_info.append(s)
+        queried = sorted(set(stats_sum["servers_queried"]))
+        responded = sorted(set(stats_sum["servers_responded"]))
+        partial_notes = stats_sum["partial_exceptions"]
         resp = BrokerResponse(
             result_table=result,
             num_docs_scanned=getattr(combined, "num_docs_scanned", 0),
@@ -494,18 +555,63 @@ class Broker:
             num_compiles=stats_sum["num_compiles"],
             num_segments_cache_hit=stats_sum["num_segments_cache_hit"],
             num_segments_cache_miss=stats_sum["num_segments_cache_miss"],
+            num_servers_queried=len(queried),
+            num_servers_responded=len(responded),
         )
+        if partial_notes:
+            # degraded gather: merged answer of the responding servers only,
+            # flagged partial with per-server exceptions — and never cached
+            resp.partial_result = True
+            resp.exceptions = list(partial_notes)
+            BROKER_METRICS.add_meter(BrokerMeter.PARTIAL_RESULTS)
+            if any(n.startswith("TimeoutError") for n in partial_notes):
+                BROKER_METRICS.add_meter(BrokerMeter.DEADLINE_EXCEEDED)
+                self._broadcast_cancel(budget, stats_sum)
         if trace_info is not None:
             resp.trace_info = trace_info
         return resp
 
+    def _timeout_ms(self, query: QueryContext) -> float:
+        opt = query.query_options.get("timeoutMs")
+        if opt is not None:
+            try:
+                return float(opt)
+            except (TypeError, ValueError):
+                pass
+        return self.default_timeout_ms
+
+    def _partial_allowed(self, query: QueryContext) -> bool:
+        opt = query.query_options.get("allowPartialResults")
+        if opt is None:
+            return self.allow_partial_default
+        return opt in (True, 1) or str(opt).lower() in ("true", "1", "on")
+
+    def _broadcast_cancel(self, budget: _QueryBudget, stats_sum: dict) -> None:
+        """Best-effort cancel to every server that was sent a shard of the
+        query but never responded; the server resolves queryId through the
+        accountant so the segment loop's check_cancel stops device work."""
+        pending = set(stats_sum.get("servers_queried", [])) - \
+            set(stats_sum.get("servers_responded", []))
+        for inst in pending:
+            try:
+                self._client(inst).call(
+                    {"type": "cancel", "queryId": budget.query_id,
+                     "reason": "broker deadline exceeded"},
+                    retry=False, timeout=2.0)
+            except Exception:
+                pass  # cancel is advisory; the server may already be gone
+
     def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict,
+                        budget: _QueryBudget,
                         only_segments: Optional[list] = None):
         """Scatter with a bounded whole-query restart: when a routed segment
         vanishes from routing mid-flight (an atomic lineage swap committed —
         merge/compaction replaced it), per-segment retry would double-count
         or under-count, so re-snapshot the routing and re-run (reference:
-        broker re-executing on stale routing generation)."""
+        broker re-executing on stale routing generation). Per-attempt
+        accounting (incl. the partial/server lists) lives in ``local`` and
+        merges only on success, so a discarded stale attempt can't leak
+        failure records into the final response."""
         last: Exception | None = None
         for _ in range(3):
             local = {"total_docs": 0, "num_segments_processed": 0,
@@ -513,10 +619,12 @@ class Broker:
                      "num_device_dispatches": 0, "num_compiles": 0,
                      "num_segments_cache_hit": 0,
                      "num_segments_cache_miss": 0,
-                     "server_traces": []}
+                     "server_traces": [],
+                     "servers_queried": [], "servers_responded": [],
+                     "partial_exceptions": []}
             try:
                 results = self._scatter_gather_once(
-                    table, query, local, only_segments)
+                    table, query, local, budget, only_segments)
             except _StaleRoutingError as e:
                 last = e
                 continue
@@ -529,7 +637,7 @@ class Broker:
         raise RuntimeError(f"routing kept changing mid-query: {last}")
 
     def _scatter_gather_once(self, table: str, query: QueryContext,
-                             stats_sum: dict,
+                             stats_sum: dict, budget: _QueryBudget,
                              only_segments: Optional[list] = None):
         routing = self.routing_table(table)
         if only_segments is not None:
@@ -544,48 +652,93 @@ class Broker:
         if not routing:
             return []
         stats_sum["num_segments_queried"] += len(routing)
-        plan = self._select_instances(routing)
+        unavailable: list[str] = []
+        plan = self._select_instances(
+            routing,
+            unavailable_sink=unavailable if budget.partial_ok else None)
+        if unavailable:
+            stats_sum["partial_exceptions"].append(
+                f"TransportError: no online replica for segments "
+                f"{sorted(unavailable)}")
 
         def call(inst_segs):
             inst, segs = inst_segs
+            remaining = budget.remaining_s()
+            if remaining <= 0:
+                return inst, segs, None, TimeoutError(
+                    f"deadline exceeded before dispatch to {inst}")
+            # deadline propagation: the server clamps its scheduler wait
+            # and per-segment loop to this remaining budget; the socket
+            # timeout gets a little slack so the server-side timeout
+            # (which carries a real error message) fires first
             request = {"type": "query", "table": table, "segments": segs,
-                       "query": query}
+                       "query": query, "deadlineMs": remaining * 1000.0,
+                       "queryId": budget.query_id}
+            stats_sum["servers_queried"].append(inst)
             with self._lock:
                 stats = self._server_stats.setdefault(inst, _ServerStats())
                 stats.inflight += 1
             t0 = time.perf_counter()
             try:
-                out = self._client(inst).call(request)
+                out = self._client(inst).call(request,
+                                              timeout=remaining + 2.0)
                 self.failure_detector.mark_healthy(inst)
                 with self._lock:
                     stats.record((time.perf_counter() - t0) * 1000)
                 return inst, segs, out, None
+            except RemoteError as e:
+                # the server is alive — its handler raised. A replica
+                # retry would deterministically fail the same way, so no
+                # failover and no health-marking.
+                return inst, segs, None, e
             except TransportError as e:
                 self.failure_detector.mark_failed(inst)
                 with self._lock:
                     self._clients.pop(inst, None)
+                if time.monotonic() >= budget.deadline:
+                    # a slow server is indistinguishable from a dead one
+                    # once the budget is gone — classify as deadline, not
+                    # failover fodder
+                    return inst, segs, None, TimeoutError(
+                        f"deadline exceeded waiting on {inst}: {e}")
                 return inst, segs, None, e
             finally:
                 with self._lock:
                     stats.inflight -= 1
 
+        def degrade(inst, segs, err) -> None:
+            stats_sum["partial_exceptions"].append(
+                f"{type(err).__name__}: {inst}: "
+                f"segments {sorted(segs)}: {err}")
+
         results = []
         retry: list[str] = []
         for inst, segs, out, err in self._pool.map(call, plan.items()):
-            if err is not None:
-                retry.extend(segs)
-            else:
+            if err is None:
                 results.append((inst, out))
+            elif isinstance(err, (TimeoutError, RemoteError)):
+                # never failover these: the budget is spent, or the error
+                # is deterministic — degrade (if allowed) or fail now
+                if not budget.partial_ok:
+                    raise err
+                degrade(inst, segs, err)
+            else:
+                retry.extend(segs)
         if retry:
             # failover: re-route failed segments to remaining replicas
             # (reference: query-time replica failover via routing)
             sub_routing = {s: routing[s] for s in retry}
             sub_plan = self._select_instances(sub_routing)
             for inst, segs, out, err in self._pool.map(call, sub_plan.items()):
-                if err is not None:
-                    raise TransportError(
+                if err is None:
+                    results.append((inst, out))
+                    continue
+                if not isinstance(err, (TimeoutError, RemoteError)):
+                    err = TransportError(
                         f"segments {segs} unreachable on all replicas")
-                results.append((inst, out))
+                if not budget.partial_ok:
+                    raise err
+                degrade(inst, segs, err)
         from .datatable import decode
 
         combineds = []
@@ -593,6 +746,7 @@ class Broker:
         def absorb(inst, r, missing_sink):
             combined, st = decode(r["datatable"])
             combineds.append(combined)
+            stats_sum["servers_responded"].append(inst)
             if r.get("trace"):
                 stats_sum.setdefault("server_traces", []).append(
                     (inst, r["trace"]))
@@ -622,46 +776,71 @@ class Broker:
                         # the segment left the routing table entirely: a
                         # lineage swap (or drop) committed under us — the
                         # whole snapshot is stale, restart the query
+                        # (always, even in partial mode: a restart gives a
+                        # FULL answer on the new routing generation)
                         raise _StaleRoutingError(
                             f"segment {s} replaced mid-query")
                     replicas = [i for i in fresh[s] if i != inst]
                     if not replicas:
+                        if budget.partial_ok:
+                            degrade(inst, [s], RuntimeError(
+                                "no remaining replicas"))
+                            continue
                         raise RuntimeError(
                             f"segment {s} has no remaining replicas")
                     sub_routing[s] = replicas
             still_missing: dict[str, list[str]] = {}
-            failed: list[tuple[str, list[str]]] = []
+            failed: list[tuple[str, list[str], Exception]] = []
             for inst, segs, out, err in self._pool.map(
                     call, self._select_instances(sub_routing).items()):
                 if err is not None:
-                    failed.append((inst, segs))
+                    failed.append((inst, segs, err))
                 else:
                     absorb(inst, out, still_missing)
             if failed:
                 # the retry pass keeps replica failover too: a transient
                 # connection failure re-routes once more to the segment's
-                # remaining replicas before the query fails
+                # remaining replicas before the query fails — unless the
+                # error is terminal (deadline / deterministic remote error)
                 fo_routing = {}
-                for inst, segs in failed:
+                for inst, segs, err in failed:
+                    if isinstance(err, (TimeoutError, RemoteError)):
+                        if not budget.partial_ok:
+                            raise err
+                        degrade(inst, segs, err)
+                        continue
                     for s in segs:
                         replicas = [i for i in sub_routing.get(s, [])
                                     if i != inst]
                         if not replicas:
+                            if budget.partial_ok:
+                                degrade(inst, [s], TransportError(
+                                    "unreachable on retry"))
+                                continue
                             raise TransportError(
                                 f"segment {s} unreachable on retry")
                         fo_routing[s] = replicas
                 for inst, segs, out, err in self._pool.map(
                         call, self._select_instances(fo_routing).items()):
                     if err is not None:
+                        if budget.partial_ok:
+                            degrade(inst, segs, err)
+                            continue
                         raise TransportError(
                             f"segments {segs} unreachable on retry")
                     absorb(inst, out, still_missing)
             if still_missing:
-                # twice-missing → genuinely gone; fail loudly rather than
-                # silently dropping rows
-                raise RuntimeError(
-                    f"servers missing routed segments after retry: "
-                    f"{sorted(s for v in still_missing.values() for s in v)}")
+                # twice-missing → genuinely gone; fail loudly (or degrade)
+                # rather than silently dropping rows
+                gone = sorted(s for v in still_missing.values() for s in v)
+                if budget.partial_ok:
+                    stats_sum["partial_exceptions"].append(
+                        f"RuntimeError: servers missing routed segments "
+                        f"after retry: {gone}")
+                else:
+                    raise RuntimeError(
+                        f"servers missing routed segments after retry: "
+                        f"{gone}")
         return combineds
 
     def _merge(self, query: QueryContext, per_server: list):

@@ -63,6 +63,17 @@ class QueryLogger:
             "timestamp": time.time(),
             "sql": sql_part,
         }
+        if getattr(response, "partial_result", False):
+            entry["partialResult"] = True
+        if getattr(response, "num_servers_queried", 0):
+            entry["numServersQueried"] = response.num_servers_queried
+            entry["numServersResponded"] = response.num_servers_responded
+        from ..spi import faults
+
+        if faults.ACTIVE:
+            # chaos runs: stamp the cumulative injected-fault count so a
+            # slow entry can be correlated with the fault schedule
+            entry["injectedFaults"] = faults.FAULTS.total_fired()
         outcome = getattr(response, "cache_outcome", None)
         if outcome:
             # a "slow but cached" query is an anomaly worth seeing: the

@@ -11,16 +11,20 @@ exactly Helix's contract.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
 from ..engine.query_executor import QueryExecutor
 from ..segment.loader import load_segment
+from ..spi import faults
 from ..spi.data_types import Schema
 from .controller import ONLINE, raw_table_name
 from .store import PropertyStore
 from ..engine.scheduler import QueryScheduler
 from .transport import RpcServer
+
+log = logging.getLogger(__name__)
 
 
 class ServerInstance:
@@ -101,11 +105,23 @@ class ServerInstance:
                 meta = self.store.get(f"/SEGMENTS/{table}/{seg}")
                 if meta is None:
                     continue
-                segment = load_segment(self._fetch(meta["location"]))
-                if indexing is not None:
-                    # config-requested indexes the segment was written
-                    # without get built at load (SegmentPreProcessor)
-                    segment.backfill_indexes(indexing)
+                try:
+                    if faults.ACTIVE:
+                        faults.FAULTS.fire("segment.load", table=table,
+                                           segment=seg)
+                    segment = load_segment(self._fetch(meta["location"]))
+                    if indexing is not None:
+                        # config-requested indexes the segment was written
+                        # without get built at load (SegmentPreProcessor)
+                        segment.backfill_indexes(indexing)
+                except Exception:
+                    # a failed load must not abort convergence of the other
+                    # segments — and since the external-view update below
+                    # advertises only want & loaded, the broker routes this
+                    # segment's replicas elsewhere (or reports it partial)
+                    log.exception("%s: failed to load segment %s/%s",
+                                  self.instance_id, table, seg)
+                    continue
                 self.segments.setdefault(table, {})[seg] = segment
             if to_drop:
                 # dropped/replaced segments invalidate their cached partial
@@ -200,6 +216,13 @@ class ServerInstance:
             return self._handle_scan_arrow(request)
         if kind == "ping":
             return "pong"
+        if kind == "cancel":
+            # broker abandon/timeout: flag the tracker so the segment loop's
+            # check_cancel stops device work (reference: the /query/{id}
+            # DELETE path into the accountant interrupt)
+            return {"cancelled": self.scheduler.accountant.kill_query(
+                request.get("queryId", ""),
+                reason=request.get("reason", "cancelled by broker"))}
         if isinstance(kind, str) and kind.startswith("mse_"):
             return self.mse_worker.handle(request)
         raise ValueError(f"unknown request type {kind}")
@@ -230,6 +253,22 @@ class ServerInstance:
         table = request["table"]
         names = request["segments"]
         query = request["query"]
+        if faults.ACTIVE:
+            faults.FAULTS.fire("server.query", table=table,
+                               instance=self.instance_id)
+        # deadline propagation: the broker stamps its remaining budget on
+        # the request; it bounds the scheduler queue wait AND clamps the
+        # per-segment loop's timeoutMs (the request is unpickled fresh per
+        # RPC, so mutating query_options here is private to this call)
+        deadline_ms = request.get("deadlineMs")
+        query_id = request.get("queryId")
+        timeout_s = 60.0
+        if deadline_ms is not None:
+            timeout_s = max(0.05, min(60.0, float(deadline_ms) / 1000.0))
+            cur = query.query_options.get("timeoutMs")
+            query.query_options["timeoutMs"] = (
+                float(deadline_ms) if cur is None
+                else min(float(cur), float(deadline_ms)))
         with self._lock:
             hosted = self.segments.get(table, {})
             segs = [hosted[n] for n in names if n in hosted]
@@ -249,7 +288,8 @@ class ServerInstance:
                 and TRACING.active_trace() is None:
             trace = TRACING.start_trace(f"server:{self.instance_id}")
         try:
-            combined, stats = self.scheduler.submit(run, group=table)
+            combined, stats = self.scheduler.submit(
+                run, group=table, timeout_s=timeout_s, query_id=query_id)
         finally:
             if trace is not None:
                 TRACING.end_trace()

@@ -15,6 +15,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..spi import faults
+
 
 class StoreError(Exception):
     pass
@@ -44,6 +46,8 @@ class PropertyStore:
             ephemeral_owner: Optional[str] = None) -> int:
         """Set value; expected_version ≥ 0 makes it a compare-and-set.
         Returns the new version."""
+        if faults.ACTIVE:
+            faults.FAULTS.fire("store.write", path=path)
         json.dumps(value)  # enforce JSON-serializable (ZK stores bytes)
         with self._lock:
             cur = self._data.get(path)
@@ -61,6 +65,8 @@ class PropertyStore:
                          ephemeral_owner: Optional[str] = None) -> bool:
         """Atomic exclusive create (ZK create with EPHEMERAL flag): True if
         this call created the entry, False if it already existed."""
+        if faults.ACTIVE:
+            faults.FAULTS.fire("store.write", path=path)
         json.dumps(value)
         with self._lock:
             if path in self._data:

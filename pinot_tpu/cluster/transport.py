@@ -15,12 +15,15 @@ the framing keeps the transport swappable for a real codec later.
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import ssl
 import struct
 import threading
 from typing import Callable, Optional
+
+from ..spi import faults
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
@@ -89,9 +92,16 @@ class RpcServer:
 
     def __init__(self, handler: Callable, host: str = "127.0.0.1", port: int = 0,
                  ssl_context: Optional[ssl.SSLContext] = None,
-                 max_inflight_bytes: Optional[int] = None):
+                 max_inflight_bytes: Optional[int] = None,
+                 handshake_timeout_s: Optional[float] = None):
         self.handler = handler
         self._ssl = ssl_context
+        # TLS-handshake ceiling: constructor arg wins, then the
+        # PINOT_TPU_RPC_HANDSHAKE_S env knob, then the historical 10s
+        if handshake_timeout_s is None:
+            handshake_timeout_s = float(
+                os.environ.get("PINOT_TPU_RPC_HANDSHAKE_S", 10.0))
+        self._handshake_s = handshake_timeout_s
         # request-memory guard (reference: DirectOOMHandler — shed load
         # instead of dying when request buffers exceed the direct-memory
         # budget): frames beyond the budget are drained and refused
@@ -125,7 +135,7 @@ class RpcServer:
         not block other connections) and under a timeout."""
         if self._ssl is None:
             return conn
-        conn.settimeout(10.0)
+        conn.settimeout(self._handshake_s)
         try:
             conn = self._ssl.wrap_socket(conn, server_side=True)
             conn.settimeout(None)
@@ -256,36 +266,76 @@ class RpcClient:
     """Pooled single connection per target with reconnect-on-failure."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 ssl_context: Optional[ssl.SSLContext] = None):
+                 ssl_context: Optional[ssl.SSLContext] = None,
+                 connect_timeout: Optional[float] = None):
         self.host = host
         self.port = port
         self.timeout = timeout
+        # connect timeout decoupled from the request (recv) timeout:
+        # constructor arg, then PINOT_TPU_RPC_CONNECT_S, then ``timeout``
+        if connect_timeout is None:
+            env = os.environ.get("PINOT_TPU_RPC_CONNECT_S")
+            connect_timeout = float(env) if env else timeout
+        self.connect_timeout = connect_timeout
         self._ssl = ssl_context
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
-        s = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.connect_timeout)
+        # create_connection's timeout persists on the socket (it would be
+        # the recv timeout too) — restore the request timeout explicitly
+        s.settimeout(self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if self._ssl is not None:
             s = self._ssl.wrap_socket(s, server_hostname=self.host)
         return s
 
-    def call(self, request, retry: bool = True):
+    def _fire_fault(self, point: str) -> None:
+        """Injection seam: an InjectedDrop kills the pooled socket (the
+        peer 'hung up'); any injected fault surfaces as TransportError —
+        the connection-level failure shape, so callers exercise their real
+        failover/retry paths."""
+        try:
+            faults.FAULTS.fire(point, host=self.host, port=self.port)
+        except faults.InjectedDrop as e:
+            self.close()
+            raise TransportError(
+                f"rpc to {self.host}:{self.port} failed: {e}") from None
+        except faults.InjectedFault as e:
+            raise TransportError(
+                f"rpc to {self.host}:{self.port} failed: {e}") from None
+
+    def call(self, request, retry: bool = True,
+             timeout: Optional[float] = None):
         """``retry`` re-sends once on a connection failure (the pooled
         connection may have gone stale between calls). Callers whose
         requests are NOT idempotent — e.g. an mse_stage dispatch, where a
         re-run would consume mailboxes twice — pass retry=False; mailbox
         block deliveries stay retryable because the receiver dedups on
-        (sender, seq)."""
+        (sender, seq). ``timeout`` bounds THIS call only (deadline
+        propagation: the broker passes its remaining budget) by temporarily
+        tightening the socket timeout."""
+        if faults.ACTIVE:
+            self._fire_fault("transport.call")
         attempts = (0, 1) if retry else (1,)
         with self._lock:
             for attempt in attempts:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
-                    _send_frame(self._sock, request)
-                    status, payload = _recv_frame(self._sock)
+                    if timeout is not None:
+                        self._sock.settimeout(timeout)
+                    try:
+                        _send_frame(self._sock, request)
+                        status, payload = _recv_frame(self._sock)
+                    finally:
+                        if timeout is not None and self._sock is not None:
+                            try:
+                                self._sock.settimeout(self.timeout)
+                            except OSError:
+                                pass
                     break
                 except (TransportError, OSError, EOFError):
                     self.close_nolock()
@@ -302,6 +352,8 @@ class RpcClient:
         DEDICATED connection (not the pooled one) so an abandoned or
         long-lived stream never blocks concurrent unary calls — the
         per-stream-channel behavior of the gRPC analogue."""
+        if faults.ACTIVE:
+            self._fire_fault("transport.stream")
         try:
             sock = self._connect()
         except OSError:

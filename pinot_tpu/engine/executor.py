@@ -29,6 +29,7 @@ from ..segment.device_cache import (
     transfer_stats,
 )
 from ..segment.loader import ImmutableSegment
+from ..spi import faults
 from ..spi.trace import TRACING
 from .plan import SegmentPlan, SegmentPlanner
 from .results import (
@@ -230,6 +231,10 @@ class TpuSegmentExecutor:
         which costs the async overlap, so traced runs are NOT perf runs),
         per-slot transfer bytes, and an HBM snapshot. Tracing off takes the
         first branch: one thread-local read, no spans, no added syncs."""
+        if faults.ACTIVE:
+            # kind="hbm_oom" specs raise RESOURCE_EXHAUSTED here and are
+            # absorbed by the caller's with_oom_retry — the real OOM path
+            faults.FAULTS.fire("device.dispatch", segment=segment.name)
         if TRACING.active_trace() is None:
             return self._dispatch_plan(segment, plan, None)
         with TRACING.scope("family_dispatch") as span:
@@ -335,6 +340,8 @@ class TpuSegmentExecutor:
         query_executor._try_sparse_device_combine) rather than fetching
         them. Sparse programs never take the fused path, so the fused
         negotiation is skipped."""
+        if faults.ACTIVE:
+            faults.FAULTS.fire("device.dispatch", segment=segment.name)
         if TRACING.active_trace() is None:
             return self._dispatch_plan_raw(segment, plan, None)
         with TRACING.scope("family_dispatch") as span:
@@ -425,6 +432,10 @@ class TpuSegmentExecutor:
         return views, tuple(stacked), tuple(params_b), packed, num_docs
 
     def _dispatch_batch(self, segments: list, plans: list):
+        if faults.ACTIVE:
+            faults.FAULTS.fire("device.dispatch",
+                               segment=segments[0].name,
+                               batch_size=len(segments))
         if TRACING.active_trace() is None:
             return self._dispatch_batch_inner(segments, plans, None)
         with TRACING.scope("family_dispatch") as span:

@@ -61,6 +61,10 @@ class BrokerResponse:
     # kept segments served from cache vs actually executed
     num_segments_cache_hit: int = 0
     num_segments_cache_miss: int = 0
+    # scatter/gather accounting (reference: numServersQueried/Responded in
+    # BrokerResponseNative) — responded < queried implies a degraded path
+    num_servers_queried: int = 0
+    num_servers_responded: int = 0
 
     def to_json(self) -> dict:
         out = {
@@ -88,6 +92,9 @@ class BrokerResponse:
         if self.num_segments_cache_hit or self.num_segments_cache_miss:
             out["numSegmentsCacheHit"] = self.num_segments_cache_hit
             out["numSegmentsCacheMiss"] = self.num_segments_cache_miss
+        if self.num_servers_queried:
+            out["numServersQueried"] = self.num_servers_queried
+            out["numServersResponded"] = self.num_servers_responded
         return out
 
 

@@ -22,7 +22,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..spi.metrics import SERVER_METRICS, ServerTimer
+from ..spi.metrics import SERVER_METRICS, ServerMeter, ServerTimer
 
 
 class QueryKilledError(Exception):
@@ -53,6 +53,7 @@ class QueryResourceTracker:
 
     def check_cancel(self) -> None:
         if self._kill_reason is not None:
+            SERVER_METRICS.add_meter(ServerMeter.QUERIES_KILLED)
             raise QueryKilledError(self._kill_reason)
 
     @property
@@ -142,16 +143,22 @@ class QueryScheduler:
         self.wait_ms_total = 0.0
 
     def submit(self, fn: Callable, *args, group: str = "default",
-               timeout_s: float = 60.0, **kwargs):
-        """Run fn(tracker, *args) under admission control."""
+               timeout_s: float = 60.0, query_id: Optional[str] = None,
+               **kwargs):
+        """Run fn(tracker, *args) under admission control. ``timeout_s``
+        bounds queue wait (deadline propagation: the server passes the
+        query's remaining budget); ``query_id`` names the tracker so a
+        broker-sent cancel can find it via ``kill_query``."""
         with self._lock:
             if self._pending >= self.max_pending:
+                SERVER_METRICS.add_meter(ServerMeter.QUERIES_REJECTED)
                 raise QueryRejectedError(
                     f"scheduler queue full ({self.max_pending} pending)")
             self._pending += 1
         t0 = time.perf_counter()
         try:
             if not self._sem.acquire(timeout=timeout_s):
+                SERVER_METRICS.add_meter(ServerMeter.QUERIES_REJECTED)
                 raise QueryRejectedError("scheduler wait timeout")
         finally:
             with self._lock:
@@ -161,7 +168,7 @@ class QueryScheduler:
         # reference ServerQueryPhase.SCHEDULER_WAIT: admission-control
         # latency into the server timer histogram
         SERVER_METRICS.update_timer(ServerTimer.SCHEDULER_WAIT_MS, wait_ms)
-        tracker = self.accountant.start_query(group=group)
+        tracker = self.accountant.start_query(query_id=query_id, group=group)
         try:
             return fn(tracker, *args, **kwargs)
         finally:
@@ -184,11 +191,13 @@ class PriorityQueryScheduler(QueryScheduler):
         self._running = 0
 
     def submit(self, fn: Callable, *args, group: str = "default",
-               timeout_s: float = 60.0, **kwargs):
+               timeout_s: float = 60.0, query_id: Optional[str] = None,
+               **kwargs):
         deadline = time.monotonic() + timeout_s
         t_wait = time.perf_counter()
         with self._cv:
             if self._pending >= self.max_pending:
+                SERVER_METRICS.add_meter(ServerMeter.QUERIES_REJECTED)
                 raise QueryRejectedError("scheduler queue full")
             self._pending += 1
             self._waiting[group] = self._waiting.get(group, 0) + 1
@@ -197,6 +206,7 @@ class PriorityQueryScheduler(QueryScheduler):
                         self._my_turn(group):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
+                        SERVER_METRICS.add_meter(ServerMeter.QUERIES_REJECTED)
                         raise QueryRejectedError("scheduler wait timeout")
                     self._cv.wait(min(remaining, 0.05))
                 self._running += 1
@@ -208,7 +218,7 @@ class PriorityQueryScheduler(QueryScheduler):
         wait_ms = (time.perf_counter() - t_wait) * 1000
         self.wait_ms_total += wait_ms
         SERVER_METRICS.update_timer(ServerTimer.SCHEDULER_WAIT_MS, wait_ms)
-        tracker = self.accountant.start_query(group=group)
+        tracker = self.accountant.start_query(query_id=query_id, group=group)
         t0 = time.perf_counter()
         try:
             return fn(tracker, *args, **kwargs)

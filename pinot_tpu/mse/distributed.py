@@ -46,6 +46,7 @@ import numpy as np
 from ..engine.aggregation import UnsupportedQueryError
 from ..engine.reduce import BrokerReducer
 from ..engine.results import BrokerResponse
+from ..spi import faults
 from ..query.converter import filter_from_expression
 from ..query.expressions import ExpressionContext
 from .executor import _block_to_result
@@ -108,7 +109,21 @@ class MailboxStore:
         # before the append actually happened.
         self._last_seq: dict[tuple, int] = {}
         self._inflight_seq: set = set()
+        # query_id → absolute monotonic deadline: every wait clamps to the
+        # query's REMAINING budget instead of the flat MAILBOX_WAIT_S
+        # ceiling (deadline propagation across the shuffle plane)
+        self._deadlines: dict[str, float] = {}
         self._cond = threading.Condition()
+
+    def set_deadline(self, query_id: str, deadline: float) -> None:
+        """Register the query's absolute (monotonic) deadline."""
+        with self._cond:
+            self._deadlines[query_id] = deadline
+            self._cond.notify_all()
+
+    def _deadline_for(self, query_id: str) -> float:
+        return min(time.monotonic() + MAILBOX_WAIT_S,
+                   self._deadlines.get(query_id, float("inf")))
 
     def _check(self, query_id: str) -> None:
         if query_id in self._cancelled:
@@ -127,7 +142,7 @@ class MailboxStore:
                         or skey in self._inflight_seq:
                     return  # duplicate delivery (retried RPC)
                 self._inflight_seq.add(skey)
-            deadline = time.monotonic() + MAILBOX_WAIT_S
+            deadline = self._deadline_for(query_id)
             try:
                 while (key in self._streaming
                        and self._buffered[key] + nbytes > MAILBOX_BUFFER_BYTES
@@ -175,14 +190,14 @@ class MailboxStore:
                  partition: int, expected_senders: int) -> list[Block]:
         """Materializing receive: all senders' chunks, after every EOS."""
         key = (query_id, from_stage, to_stage, partition)
-        deadline = time.monotonic() + MAILBOX_WAIT_S
+        deadline = self._deadline_for(query_id)
         with self._cond:
             while len(self._eos[key]) < expected_senders:
                 self._check(query_id)
                 if not self._cond.wait(1.0) and time.monotonic() > deadline:
                     raise TimeoutError(
                         f"mailbox {key}: {len(self._eos[key])}/"
-                        f"{expected_senders} senders after {MAILBOX_WAIT_S}s")
+                        f"{expected_senders} senders at deadline")
             self._check(query_id)
             chunks = self._chunks.pop(key, [])
             self._buffered[key] = 0
@@ -196,7 +211,7 @@ class MailboxStore:
         key = (query_id, from_stage, to_stage, partition)
         with self._cond:
             self._streaming.add(key)
-        deadline = time.monotonic() + MAILBOX_WAIT_S
+        deadline = self._deadline_for(query_id)
         try:
             while True:
                 with self._cond:
@@ -214,7 +229,7 @@ class MailboxStore:
                     else:
                         return
                 yield chunk
-                deadline = time.monotonic() + MAILBOX_WAIT_S
+                deadline = self._deadline_for(query_id)
         finally:
             with self._cond:
                 self._streaming.discard(key)
@@ -240,6 +255,7 @@ class MailboxStore:
                                   if k[0][0] != query_id}
             self._total_bytes.pop(query_id, None)
             self._peak_bytes.pop(query_id, None)
+            self._deadlines.pop(query_id, None)
             self._cancelled.discard(query_id)
             self._cond.notify_all()
 
@@ -410,6 +426,10 @@ class MseWorkerService:
     def handle(self, request: dict):
         kind = request["type"]
         if kind == "mse_mailbox":
+            if faults.ACTIVE:
+                # safe to fail-and-retry: the store dedups on (sender, seq)
+                faults.FAULTS.fire("mailbox.deliver",
+                                   query_id=request.get("query_id"))
             self.boxes.deliver(request)
             return True
         if kind == "mse_cancel":
@@ -432,6 +452,13 @@ class MseWorkerService:
                    for p, a in request["routing"].items()}
         # halves: raw table → [(name_with_type, [segment], extra_filter_json)]
         halves = request.get("tables", {})
+        # deadline propagation: the dispatcher ships its remaining budget;
+        # this worker's mailbox waits and leaf executions clamp to it
+        deadline = None
+        deadline_ms = request.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline = time.monotonic() + float(deadline_ms) / 1000.0
+            self.boxes.set_deadline(query_id, deadline)
 
         mailbox = RoutedMailbox(
             self.boxes, query_id, routing, self.server.address,
@@ -439,7 +466,7 @@ class MseWorkerService:
             expected={int(k): int(v) for k, v in
                       (request.get("child_workers") or {}).items()})
         runner = StageRunner([stage], request.get("parallelism", 1),
-                             self._make_execute_query(halves),
+                             self._make_execute_query(halves, deadline),
                              self._make_read_table(halves),
                              query_options=request.get("options") or {})
         runner.mailbox = mailbox
@@ -486,11 +513,14 @@ class MseWorkerService:
                 f"table {table} not assigned to this worker")
         return entry
 
-    def _make_execute_query(self, halves: dict) -> Callable:
+    def _make_execute_query(self, halves: dict,
+                            deadline: Optional[float] = None) -> Callable:
         """Leaf SSQE entry: run the compiled QueryContext over this worker's
         assigned segments (per hybrid half), reduce each half table-locally,
         and concatenate — the parent stage's final aggregation phase merges
-        partials across halves and workers."""
+        partials across halves and workers. ``deadline`` (absolute
+        monotonic) clamps each half's per-segment timeoutMs to the query's
+        remaining budget."""
 
         def execute_query(qc) -> BrokerResponse:
             from ..query.filter import FilterContext
@@ -502,6 +532,17 @@ class MseWorkerService:
                 segs = [hosted[n] for n in seg_names if n in hosted]
                 q2 = copy.deepcopy(qc)
                 q2.table_name = nwt
+                if deadline is not None:
+                    remaining_ms = max(
+                        50.0, (deadline - time.monotonic()) * 1000.0)
+                    cur = q2.query_options.get("timeoutMs")
+                    try:
+                        cur = float(cur) if cur is not None else None
+                    except (TypeError, ValueError):
+                        cur = None
+                    q2.query_options["timeoutMs"] = (
+                        remaining_ms if cur is None
+                        else min(cur, remaining_ms))
                 if extra is not None:
                     fc = filter_from_expression(expr_from_json(extra))
                     q2.filter = fc if q2.filter is None else \
@@ -597,6 +638,9 @@ class DistributedMseDispatcher:
 
     def _handle(self, request: dict):
         if request.get("type") == "mse_mailbox":
+            if faults.ACTIVE:
+                faults.FAULTS.fire("mailbox.deliver",
+                                   query_id=request.get("query_id"))
             self.boxes.deliver(request)
             return True
         raise ValueError("broker mailbox accepts only mse_mailbox")
@@ -805,6 +849,20 @@ class DistributedMseDispatcher:
             raise UnsupportedQueryError("no live servers")
         query_id = f"q{next(self._qid)}_{id(self):x}"
 
+        # deadline propagation: only when the query EXPLICITLY sets
+        # timeoutMs (no default MSE budget — long analytical joins own
+        # their wall time); the budget clamps the broker-side final
+        # receive, every worker's mailbox waits, and the leaf timeoutMs
+        deadline = None
+        opt = (query.options or {}).get("timeoutMs")
+        if opt is not None:
+            try:
+                deadline = time.monotonic() + float(opt) / 1000.0
+            except (TypeError, ValueError):
+                deadline = None
+        if deadline is not None:
+            self.boxes.set_deadline(query_id, deadline)
+
         # choose workers per stage: leaf stages follow segment placement,
         # intermediate stages round-robin over live servers
         workers: dict[int, list[dict]] = {}
@@ -858,16 +916,22 @@ class DistributedMseDispatcher:
             # the worker's own mailbox-wait ceiling, and must NOT retry
             # (a re-sent mse_stage would re-run the stage against
             # already-consumed mailboxes)
-            client = RpcClient(*w["addr"], timeout=MAILBOX_WAIT_S + 30)
+            wait_s = MAILBOX_WAIT_S
+            if deadline is not None:
+                wait_s = min(wait_s, max(0.05, deadline - time.monotonic()))
+            client = RpcClient(*w["addr"], timeout=wait_s + 30)
+            req = {"type": "mse_stage", "query_id": query_id,
+                   "stage": sj, "worker": w_idx,
+                   "parent_workers": len(parent_addrs),
+                   "routing": routing, "tables": w["tables"],
+                   "child_workers": child_workers,
+                   "parallelism": self.parallelism,
+                   "options": dict(query.options)}
+            if deadline is not None:
+                req["deadline_ms"] = max(
+                    50.0, (deadline - time.monotonic()) * 1000.0)
             try:
-                return client.call({
-                    "type": "mse_stage", "query_id": query_id,
-                    "stage": sj, "worker": w_idx,
-                    "parent_workers": len(parent_addrs),
-                    "routing": routing, "tables": w["tables"],
-                    "child_workers": child_workers,
-                    "parallelism": self.parallelism,
-                    "options": dict(query.options)}, retry=False)
+                return client.call(req, retry=False)
             finally:
                 client.close()
 

@@ -29,6 +29,7 @@ from typing import Callable, Optional
 from ..ingestion.transform import build_transform_pipeline
 from ..segment.loader import ImmutableSegment, load_segment
 from ..segment.mutable import MutableSegment
+from ..spi import faults
 from ..spi.stream import (
     LongMsgOffset,
     StreamConfig,
@@ -141,9 +142,24 @@ class RealtimeSegmentDataManager:
     # -- the consume loop (reference PartitionConsumer.run:717-880) --------
     def _run(self):
         try:
+            fetch_errors = 0
             while not self._stop.is_set():
-                batch = self.consumer.fetch_messages(
-                    self.current_offset, self.stream_config.fetch_timeout_ms)
+                try:
+                    batch = self._fetch()
+                except Exception:
+                    # transient stream hiccup (broker rebalance, network
+                    # blip) must not kill the consumer: back off and retry;
+                    # only persistent failure drops to ERROR (reference:
+                    # the consumer's transient-exception handling in
+                    # RealtimeSegmentDataManager.consumeLoop)
+                    fetch_errors += 1
+                    if fetch_errors > 5:
+                        raise
+                    log.warning("consumer %s: fetch failed (%d/5), retrying",
+                                self.segment.segment_name, fetch_errors)
+                    time.sleep(self.poll_idle_s)
+                    continue
+                fetch_errors = 0
                 if batch.message_count:
                     self._index_batch(batch)
                     self.current_offset = batch.offset_of_next_batch
@@ -159,6 +175,15 @@ class RealtimeSegmentDataManager:
         except Exception:  # noqa: BLE001 — consumer thread must not die silently
             log.exception("consumer %s failed", self.segment.segment_name)
             self.state = ERROR
+
+    def _fetch(self):
+        """One consumer fetch — the stream.fetch injection point."""
+        if faults.ACTIVE:
+            faults.FAULTS.fire("stream.fetch",
+                               segment=self.segment.segment_name,
+                               offset=self.current_offset.offset)
+        return self.consumer.fetch_messages(
+            self.current_offset, self.stream_config.fetch_timeout_ms)
 
     def _index_batch(self, batch):
         for msg in batch.messages:
@@ -270,8 +295,7 @@ class RealtimeSegmentDataManager:
         commits the identical row set (reference: CatchingUp state)."""
         while (not self._stop.is_set()
                and self.current_offset.offset < target_offset):
-            batch = self.consumer.fetch_messages(
-                self.current_offset, self.stream_config.fetch_timeout_ms)
+            batch = self._fetch()
             if not batch.message_count:
                 time.sleep(self.poll_idle_s)
                 continue

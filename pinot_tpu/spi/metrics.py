@@ -52,6 +52,8 @@ class BrokerMeter:
     RESULT_CACHE_HITS = "resultCacheHits"
     RESULT_CACHE_MISSES = "resultCacheMisses"
     RESULT_CACHE_EVICTIONS = "resultCacheEvictions"
+    PARTIAL_RESULTS = "partialResults"
+    DEADLINE_EXCEEDED = "deadlineExceededCancellations"
 
 
 class ServerTimer:

@@ -355,12 +355,22 @@ def soak_sql(seconds: float = 60.0, seed: int = 0, rows: int = 1600,
 
 def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
                replication: int = 2, n_segments: int = 6,
-               rows_per_segment: int = 400, progress=None) -> dict:
+               rows_per_segment: int = 400, fault_rate: float = 0.0,
+               progress=None) -> dict:
     """ChaosMonkey soak: continuous exact-result broker queries while
     servers die/restart, RebalanceChecker heals, and minion merge-rollup
-    compacts concurrently. Returns counters."""
+    compacts concurrently. Returns counters.
+
+    With ``fault_rate`` > 0 a seeded fault-injection schedule is armed on
+    top of the kill/restart churn (transport.call, server.query,
+    device.dispatch — see pinot_tpu.spi.faults). Queries then run with
+    allowPartialResults=true and the invariant relaxes from "exact,
+    always" to "exact OR well-formed partial/error, never silent
+    corruption": a full (non-partial, non-error) response must still
+    match the oracle bit-for-bit."""
     from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
                                    ServerInstance)
+    from pinot_tpu.spi import faults
     from pinot_tpu.cluster.periodic import RebalanceChecker
     from pinot_tpu.minion import MinionInstance, PinotTaskManager
     from pinot_tpu.segment.builder import SegmentBuilder
@@ -412,13 +422,32 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
     sql = "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 20"
     stats = {"queries": 0, "kills": 0, "restarts": 0, "rebalances": 0,
              "compactions": 0}
+    if fault_rate > 0:
+        armed = faults.seed_schedule(
+            seed, fault_rate,
+            points=("transport.call", "server.query", "device.dispatch"))
+        # resultCache off: the soak repeats one statement, and a broker
+        # cache hit would short-circuit every armed transport/server fault
+        # point after the first query
+        sql = ("SET allowPartialResults=true; SET resultCache=false; "
+               + sql)
+        stats["faulted_queries"] = 0
+        if progress:
+            progress(f"chaos: armed fault schedule on {sorted(armed)} "
+                     f"(rate={fault_rate}, seed={seed})")
     down: list[str] = []
     t0 = time.time()
     try:
         while time.time() - t0 < seconds:
-            # the soak invariant: EXACT results, always
+            # the soak invariant: EXACT results, always — relaxed under
+            # --fault-rate to exact-or-degraded (partial/error), never a
+            # silently wrong full answer
             resp = broker.execute_sql(sql)
             if resp.exceptions:
+                if fault_rate > 0:
+                    stats["faulted_queries"] += 1
+                    stats["queries"] += 1
+                    continue
                 raise SoakFailure(f"query error during chaos: {resp.exceptions}")
             got = {r[0]: r[1] for r in resp.result_table.rows}
             if got != expected:
@@ -454,6 +483,9 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
             if progress and stats["queries"] % 500 == 0:
                 progress(f"chaos: {stats}")
     finally:
+        if fault_rate > 0:
+            stats["injected_faults"] = faults.FAULTS.total_fired()
+            faults.FAULTS.reset()
         for s in servers.values():
             try:
                 s.stop()
@@ -595,6 +627,14 @@ def main(argv=None) -> int:
                    help="fuzz table rows for the sql suite")
     p.add_argument("--no-device-parity", action="store_true",
                    help="skip device-vs-host parity checks in the sql suite")
+    p.add_argument("--fault-rate", type=float, default=0.0,
+                   help="chaos suite: probability (0..1) of a seeded "
+                        "injected fault per call at transport.call, "
+                        "server.query and device.dispatch; queries run "
+                        "with allowPartialResults=true and degraded "
+                        "(partial/error) responses are counted as "
+                        "faulted_queries instead of failing the soak — "
+                        "full responses must still match exactly")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -611,7 +651,8 @@ def main(argv=None) -> int:
                 device_parity=not args.no_device_parity, progress=progress))
         if args.suite in ("chaos", "all"):
             results.append(soak_chaos(
-                seconds=args.seconds, seed=args.seed, progress=progress))
+                seconds=args.seconds, seed=args.seed,
+                fault_rate=args.fault_rate, progress=progress))
         if args.suite in ("realtime", "all"):
             results.append(soak_realtime(
                 rounds=args.rounds, seed=args.seed, progress=progress))

@@ -28,6 +28,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 gate")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,596 @@
+"""Chaos matrix for the fault-injection subsystem (spi/faults.py).
+
+Reference pattern: ChaosMonkeyIntegrationTest kills whole components; the
+fault registry goes finer — a scheduled failure at any single hop
+(transport, server admission, device dispatch, segment load, stream
+fetch, MSE mailbox, store write). The invariant under test at every cell:
+the query either converges to the bit-identical healthy answer (fault
+absorbed by retry/failover/OOM-retry) or degrades to a WELL-FORMED
+partial/error response — and never hangs past its deadline.
+
+Companion guard: test_fault_perf_guard.py pins the disabled-injection
+cost to a single module-attribute read per call site.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                               ServerInstance)
+from pinot_tpu.cluster.transport import RpcClient, RpcServer, TransportError
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "fistats",
+    dimensions=[("team", "STRING"), ("year", "INT")],
+    metrics=[("runs", "INT")])
+DIM_SCHEMA = Schema.build(
+    "fidim", dimensions=[("dyear", "INT"), ("era", "STRING")])
+
+TEAMS = ["BOS", "NYA", "SFN", "LAN", "CHC", "HOU"]
+N_SEGMENTS = 16
+ROWS_PER_SEGMENT = 120
+
+# no-cache prefix: every run must actually cross transport/server/device,
+# or an armed fault would be masked by a result- or segment-cache hit
+NOCACHE = "SET resultCache = false; SET segmentCache = false; "
+
+AGG_SQL = "SELECT SUM(runs), COUNT(*) FROM fistats"
+GROUPBY_SQL = "SELECT team, SUM(runs) FROM fistats GROUP BY team LIMIT 20"
+SELECT_SQL = "SELECT team, year, runs FROM fistats LIMIT 5000"
+JOIN_SQL = ("SELECT fidim.era, SUM(fistats.runs) FROM fistats "
+            "JOIN fidim ON fistats.year = fidim.dyear "
+            "GROUP BY fidim.era ORDER BY fidim.era")
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def chaos_cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fault_injection")
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="auto")
+               for i in range(3)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    controller.add_schema(DIM_SCHEMA.to_json())
+    table = controller.create_table({"tableName": "fistats",
+                                     "replication": 2})
+    dim_table = controller.create_table({"tableName": "fidim",
+                                         "replication": 2})
+
+    rng = np.random.default_rng(20260805)
+    team_sums: dict[str, int] = {}
+    era_sums: dict[str, int] = {}
+    rows = []
+    for i in range(N_SEGMENTS):
+        n = ROWS_PER_SEGMENT
+        cols = {
+            "team": np.asarray(TEAMS, dtype=object)[
+                rng.integers(0, len(TEAMS), n)],
+            "year": rng.integers(2000, 2010, n).astype(np.int32),
+            "runs": rng.integers(0, 100, n).astype(np.int32),
+        }
+        name = f"fistats_{i}"
+        SegmentBuilder(SCHEMA, segment_name=name).build(cols, d / name)
+        controller.add_segment(table, name,
+                               {"location": str(d / name), "numDocs": n})
+        for t, y, r in zip(cols["team"], cols["year"], cols["runs"]):
+            team_sums[t] = team_sums.get(t, 0) + int(r)
+            era = "early" if y < 2005 else "late"
+            era_sums[era] = era_sums.get(era, 0) + int(r)
+            rows.append((t, int(y), int(r)))
+    dim = {"dyear": np.arange(2000, 2010, dtype=np.int32),
+           "era": np.asarray(["early" if y < 2005 else "late"
+                              for y in range(2000, 2010)], dtype=object)}
+    SegmentBuilder(DIM_SCHEMA, segment_name="fidim_0").build(dim, d / "dim0")
+    controller.add_segment(dim_table, "fidim_0",
+                           {"location": str(d / "dim0"), "numDocs": 10})
+
+    truth = {
+        "team_sums": team_sums,
+        "era_sums": era_sums,
+        "rows": sorted(rows),
+        "total_runs": sum(team_sums.values()),
+        "total_rows": N_SEGMENTS * ROWS_PER_SEGMENT,
+    }
+    # warm once per shape: compile guard + healthy-path sanity
+    for sql in (AGG_SQL, GROUPBY_SQL, SELECT_SQL, JOIN_SQL):
+        resp = broker.execute_sql(NOCACHE + sql)
+        assert not resp.exceptions, (sql, resp.exceptions)
+    yield store, controller, servers, broker, truth
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    if hasattr(broker, "_mse_dispatcher"):
+        broker._mse_dispatcher.close()
+
+
+def _check_healthy(sql_key, resp, truth):
+    """Bit-identical healthy answer per query shape."""
+    rt = resp.result_table
+    assert rt is not None
+    if sql_key == "agg":
+        assert rt.rows[0][0] == truth["total_runs"]
+        assert rt.rows[0][1] == truth["total_rows"]
+    elif sql_key == "groupby":
+        assert {r[0]: r[1] for r in rt.rows} == truth["team_sums"]
+    elif sql_key == "select":
+        assert sorted(tuple(r) for r in rt.rows) == truth["rows"]
+    else:  # join
+        assert {r[0]: r[1] for r in rt.rows} == truth["era_sums"]
+
+
+# -- the matrix: fault at each on-path hop × each query shape ----------------
+# (off-path points — transport.stream, segment.load, stream.fetch,
+# store.write — have targeted tests below; firing them here would be a
+# no-op since no call site is reached during a plain scatter/gather)
+
+_SSE_POINTS = ("transport.call", "server.query", "device.dispatch")
+_MSE_POINTS = ("transport.call", "mailbox.deliver", "device.dispatch")
+MATRIX = ([("agg", AGG_SQL, p) for p in _SSE_POINTS]
+          + [("groupby", GROUPBY_SQL, p) for p in _SSE_POINTS]
+          + [("select", SELECT_SQL, p) for p in _SSE_POINTS]
+          + [("join", JOIN_SQL, p) for p in _MSE_POINTS])
+
+
+@pytest.mark.parametrize("sql_key,sql,point",
+                         MATRIX, ids=[f"{k}-{p}" for k, _, p in MATRIX])
+def test_chaos_matrix(chaos_cluster, sql_key, sql, point):
+    _, _, _, broker, truth = chaos_cluster
+    full = "SET timeoutMs = 8000; SET allowPartialResults = true; " \
+        + NOCACHE + sql
+    with faults.injected(point, kind="error", times=2):
+        t0 = time.monotonic()
+        resp = broker.execute_sql(full)
+        elapsed = time.monotonic() - t0
+    # never a hang: bounded by the 8s deadline plus retry/socket slack
+    assert elapsed < 60.0, f"{point} on {sql_key} took {elapsed:.1f}s"
+    if resp.exceptions:
+        # well-formed degradation: a partial carries a merged table and
+        # accurate server accounting; a hard error carries no silent rows
+        if resp.partial_result:
+            assert resp.result_table is not None
+            assert resp.num_servers_queried >= resp.num_servers_responded
+    else:
+        _check_healthy(sql_key, resp, truth)
+
+
+# -- absorbed faults: retry/failover must converge bit-identically -----------
+
+
+def test_transport_drop_absorbed_by_failover(chaos_cluster):
+    """One dropped connection → replica failover → full exact answer."""
+    _, _, _, broker, truth = chaos_cluster
+    with faults.injected("transport.call", kind="drop", times=1):
+        resp = broker.execute_sql(NOCACHE + GROUPBY_SQL)
+    assert not resp.exceptions, resp.exceptions
+    assert not resp.partial_result
+    _check_healthy("groupby", resp, truth)
+    assert faults.FAULTS.fired("transport.call") == 1
+
+
+def test_injected_hbm_oom_absorbed_by_oom_retry(chaos_cluster):
+    """A simulated RESOURCE_EXHAUSTED during device dispatch rides the
+    real with_oom_retry path: evict + re-dispatch → exact answer."""
+    _, _, _, broker, truth = chaos_cluster
+    with faults.injected("device.dispatch", kind="hbm_oom", times=1):
+        resp = broker.execute_sql(NOCACHE + AGG_SQL)
+    assert not resp.exceptions, resp.exceptions
+    _check_healthy("agg", resp, truth)
+    assert faults.FAULTS.fired("device.dispatch") == 1
+
+
+# -- partial-result semantics ------------------------------------------------
+
+
+def test_server_fault_fails_query_without_partial_optin(chaos_cluster):
+    _, _, _, broker, _ = chaos_cluster
+    with faults.injected("server.query", kind="error", times=1):
+        resp = broker.execute_sql(NOCACHE + GROUPBY_SQL)
+    # RemoteError is deterministic — no failover, and without the opt-in
+    # no degradation either: the query fails loudly
+    assert resp.exceptions, "expected an error response"
+    assert not resp.partial_result
+    assert resp.exceptions[0].startswith("RemoteError")
+
+
+def test_server_fault_degrades_to_partial_with_optin(chaos_cluster):
+    _, _, _, broker, truth = chaos_cluster
+    with faults.injected("server.query", kind="error", times=1):
+        resp = broker.execute_sql(
+            "SET allowPartialResults = true; " + NOCACHE + GROUPBY_SQL)
+    assert resp.partial_result
+    assert resp.exceptions and "RemoteError" in resp.exceptions[0]
+    assert resp.result_table is not None
+    assert resp.num_servers_queried > resp.num_servers_responded
+    # the surviving groups are a subset of the truth with sums ≤ truth
+    got = {r[0]: r[1] for r in resp.result_table.rows}
+    for team, s in got.items():
+        assert s <= truth["team_sums"][team]
+    j = resp.to_json()
+    assert j["partialResult"] is True
+    assert j["numServersQueried"] == resp.num_servers_queried
+
+
+def test_unreachable_replicas_partial_vs_error(tmp_path):
+    """Replication 1 + a dead server: allowPartialResults returns the
+    responding servers' merge; the default fails the query."""
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"S{i}", backend="host")
+               for i in range(2)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    table = controller.create_table({"tableName": "fistats",
+                                     "replication": 1})
+    rng = np.random.default_rng(5)
+    for i in range(4):
+        cols = {"team": np.asarray(TEAMS, dtype=object)[
+                    rng.integers(0, len(TEAMS), 100)],
+                "year": rng.integers(2000, 2010, 100).astype(np.int32),
+                "runs": rng.integers(0, 100, 100).astype(np.int32)}
+        SegmentBuilder(SCHEMA, segment_name=f"u{i}").build(
+            cols, tmp_path / f"u{i}")
+        controller.add_segment(table, f"u{i}",
+                               {"location": str(tmp_path / f"u{i}"),
+                                "numDocs": 100})
+    try:
+        servers[0].stop()
+        resp = broker.execute_sql(
+            "SET allowPartialResults = true; " + GROUPBY_SQL)
+        assert resp.partial_result
+        assert any("no online replica" in x for x in resp.exceptions)
+        assert resp.result_table is not None
+        assert resp.num_segments_queried == 4
+
+        resp2 = broker.execute_sql(GROUPBY_SQL)
+        assert resp2.exceptions and not resp2.partial_result
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+# -- deadline propagation + cancellation -------------------------------------
+
+
+def test_deadline_bounds_slow_server(chaos_cluster):
+    """A server stalled past the query budget must surface TimeoutError
+    within deadline + socket/retry slack — not the flat 30s/60s floors."""
+    _, _, _, broker, _ = chaos_cluster
+    with faults.injected("server.query", kind="delay", delay_s=3.0,
+                         times=None):
+        t0 = time.monotonic()
+        resp = broker.execute_sql(
+            "SET timeoutMs = 400; " + NOCACHE + AGG_SQL)
+        elapsed = time.monotonic() - t0
+    assert resp.exceptions, "expected a deadline error"
+    assert any("TimeoutError" in x or "deadline" in x
+               for x in resp.exceptions), resp.exceptions
+    # 0.4s budget + (remaining+2s) socket timeout × one client retry
+    assert elapsed < 15.0, f"deadline not enforced: {elapsed:.1f}s"
+
+
+def test_cancel_rpc_lands_on_accountant(chaos_cluster):
+    """The broker's cancel RPC resolves queryId → kill flag, and the
+    cooperative check raises between segments."""
+    from pinot_tpu.engine.scheduler import QueryKilledError
+
+    _, _, servers, broker, _ = chaos_cluster
+    server = servers[0]
+    tracker = server.scheduler.accountant.start_query(query_id="fi_kill_1")
+    try:
+        out = broker._client("Server_0").call(
+            {"type": "cancel", "queryId": "fi_kill_1", "reason": "test"})
+        assert out == {"cancelled": True}
+        with pytest.raises(QueryKilledError):
+            tracker.check_cancel()
+    finally:
+        server.scheduler.accountant.end_query(tracker)
+    # unknown query id: advisory no-op
+    out = broker._client("Server_0").call(
+        {"type": "cancel", "queryId": "no_such_query"})
+    assert out == {"cancelled": False}
+
+
+def test_mailbox_deadline_clamps_to_query_budget():
+    """An MSE receive with a registered deadline must stop waiting at the
+    query budget, not the flat MAILBOX_WAIT_S ceiling."""
+    from pinot_tpu.mse.distributed import MailboxStore
+
+    boxes = MailboxStore()
+    boxes.set_deadline("q_clamp", time.monotonic() + 0.3)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        boxes.wait_all("q_clamp", 0, 1, 0, expected_senders=1)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_mailbox_delivery_fault_degrades_mse_not_hangs(chaos_cluster):
+    """Every mailbox delivery failing must surface an error within the
+    query budget — a crashed shuffle can't wedge the join."""
+    _, _, _, broker, _ = chaos_cluster
+    with faults.injected("mailbox.deliver", kind="error", times=None):
+        t0 = time.monotonic()
+        resp = broker.execute_sql("SET timeoutMs = 6000; " + JOIN_SQL)
+        elapsed = time.monotonic() - t0
+    assert resp.exceptions, "expected a degraded MSE response"
+    assert elapsed < 30.0, f"MSE hung {elapsed:.1f}s under mailbox faults"
+
+
+# -- remaining injection points: targeted coverage ---------------------------
+
+
+def test_transport_stream_fault_surfaces(chaos_cluster):
+    _, _, _, broker, _ = chaos_cluster
+    with faults.injected("transport.stream", kind="error", times=1):
+        with pytest.raises((TransportError, RuntimeError)):
+            for _ in broker.execute_sql_stream(SELECT_SQL):
+                pass
+
+
+def test_segment_load_fault_keeps_replica_unadvertised(chaos_cluster,
+                                                       tmp_path):
+    """A failed OFFLINE→ONLINE load is logged and skipped: the replica
+    never advertises the segment, queries run off the healthy replica."""
+    store, controller, _, broker, _ = chaos_cluster
+    extra_schema = Schema.build("fiextra", dimensions=[("k", "INT")],
+                                metrics=[("v", "INT")])
+    controller.add_schema(extra_schema.to_json())
+    table = controller.create_table({"tableName": "fiextra",
+                                     "replication": 2})
+    cols = {"k": np.arange(50, dtype=np.int32),
+            "v": np.arange(50, dtype=np.int32)}
+    SegmentBuilder(extra_schema, segment_name="fiextra_0").build(
+        cols, tmp_path / "fiextra_0")
+    with faults.injected("segment.load", kind="error", times=1,
+                         match=lambda ctx: ctx.get("table") == table):
+        controller.add_segment(table, "fiextra_0",
+                               {"location": str(tmp_path / "fiextra_0"),
+                                "numDocs": 50})
+    assert faults.FAULTS.fired("segment.load") == 1
+    view = store.get(f"/EXTERNALVIEW/{table}") or {}
+    assert len(view.get("fiextra_0", {})) == 1  # one replica failed to load
+    resp = broker.execute_sql("SELECT SUM(v), COUNT(*) FROM fiextra")
+    assert not resp.exceptions, resp.exceptions
+    assert resp.result_table.rows[0] == [sum(range(50)), 50]
+
+
+def test_store_write_fault_raises_and_recovers():
+    ps = PropertyStore()
+    with faults.injected("store.write", kind="error", times=1,
+                         match=lambda ctx: ctx.get("path") == "/FI_X"):
+        with pytest.raises(faults.InjectedFault):
+            ps.set("/FI_X", {"a": 1})
+    ps.set("/FI_X", {"a": 1})
+    assert ps.get("/FI_X") == {"a": 1}
+
+
+@pytest.mark.slow
+def test_stream_fetch_transient_faults_survived(tmp_path):
+    """≤5 consecutive consumer fetch failures are retried in place; the
+    segment still commits every published row."""
+    from pinot_tpu.cluster.store import PropertyStore as PS
+    from pinot_tpu.realtime.completion import SegmentCompletionManager
+    from pinot_tpu.realtime.manager import RealtimeTableDataManager
+    from pinot_tpu.spi.stream import GLOBAL_STREAM_REGISTRY
+    from pinot_tpu.spi.table_config import (IngestionConfig,
+                                            SegmentsValidationConfig,
+                                            TableConfig, TableType)
+
+    schema = Schema.build(
+        "fievents",
+        dimensions=[("user", "STRING"), ("ts", "LONG")],
+        metrics=[("n", "INT")])
+    topic = f"fi_ev_{uuid.uuid4().hex[:8]}"
+    GLOBAL_STREAM_REGISTRY.create_topic(topic, num_partitions=1)
+    store = PS()
+    completion = SegmentCompletionManager(store, num_replicas=1,
+                                          commit_lease_s=1.0,
+                                          decision_wait_s=2)
+    cfg = TableConfig(
+        table_name="fievents",
+        table_type=TableType.REALTIME,
+        validation=SegmentsValidationConfig(time_column_name="ts"),
+        ingestion=IngestionConfig(stream_configs={
+            "streamType": "inmemory",
+            "stream.inmemory.topic.name": topic,
+            "realtime.segment.flush.threshold.rows": 40,
+        }))
+    faults.FAULTS.arm("stream.fetch", kind="error", times=3)
+    mgr = RealtimeTableDataManager(schema, cfg, tmp_path / "rt",
+                                   completion=completion, instance_id="A")
+    mgr.start()
+    try:
+        GLOBAL_STREAM_REGISTRY.publish(topic, [
+            {"user": f"u{i % 5}", "ts": 1_600_000_000_000 + i, "n": 1}
+            for i in range(50)])
+        deadline = time.time() + 30.0
+        seg = None
+        while time.time() < deadline:
+            kids = store.children("/SEGMENTS/fievents")
+            if kids:
+                rec = store.get(f"/SEGMENTS/fievents/{kids[0]}")
+                if rec and rec["status"] == "DONE":
+                    seg = kids[0]
+                    break
+            time.sleep(0.05)
+        assert seg is not None, "segment never committed under fetch faults"
+        assert faults.FAULTS.fired("stream.fetch") == 3
+    finally:
+        mgr.stop()
+
+
+# -- cache-poisoning regression (satellite) ----------------------------------
+
+
+def test_partial_results_never_poison_result_cache(chaos_cluster):
+    """A degraded (partial) run must bypass the broker result cache: the
+    next healthy run is a cache MISS and bit-identical to truth, and only
+    THEN does the cache serve hits."""
+    store, _, _, _, truth = chaos_cluster
+    broker = Broker(store, allow_partial_default=True)  # fresh, empty cache
+    sql = "SELECT team, COUNT(*), SUM(runs) FROM fistats GROUP BY team " \
+          "LIMIT 20"
+    try:
+        with faults.injected("server.query", kind="error", times=1):
+            r1 = broker.execute_sql(sql)
+        assert r1.partial_result and r1.exceptions
+        r2 = broker.execute_sql(sql)
+        assert not r2.exceptions, r2.exceptions
+        assert r2.cache_outcome == "miss", \
+            "partial response leaked into the result cache"
+        assert {r[0]: r[2] for r in r2.result_table.rows} \
+            == truth["team_sums"]
+        r3 = broker.execute_sql(sql)
+        assert r3.cache_outcome == "hit"
+        assert r3.result_table.rows == r2.result_table.rows
+    finally:
+        if hasattr(broker, "_mse_dispatcher"):
+            broker._mse_dispatcher.close()
+
+
+# -- observability (satellite) -----------------------------------------------
+
+
+def test_fault_and_partial_metrics_exposed(chaos_cluster):
+    from pinot_tpu.spi.metrics import (BROKER_METRICS, BrokerMeter,
+                                       render_prometheus)
+
+    _, _, _, broker, _ = chaos_cluster
+    with faults.injected("server.query", kind="error", times=1):
+        resp = broker.execute_sql(
+            "SET allowPartialResults = true; " + NOCACHE + GROUPBY_SQL)
+    assert resp.partial_result
+    # register-at-zero so the exposition check doesn't depend on another
+    # test having tripped a deadline first
+    BROKER_METRICS.add_meter(BrokerMeter.DEADLINE_EXCEEDED, 0)
+    text = render_prometheus(BROKER_METRICS, "broker")
+    assert "partialResults" in text
+    assert "serversUnhealthy" in text
+    assert "deadlineExceededCancellations" in text
+    assert "injectedFaults" in text  # registered on first arm
+
+
+# -- transport hardening (satellite) -----------------------------------------
+
+
+def test_stalled_prehandshake_client_does_not_wedge_server(tmp_path):
+    """A client that connects and never speaks TLS is dropped by the
+    handshake timeout while real clients keep being served."""
+    import subprocess
+
+    from pinot_tpu.cluster.transport import (make_client_ssl_context,
+                                             make_server_ssl_context)
+
+    cert, key = tmp_path / "cert.pem", tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    server = RpcServer(lambda req: ("echo", req),
+                       ssl_context=make_server_ssl_context(str(cert),
+                                                           str(key)),
+                       handshake_timeout_s=0.5)
+    stalled = socket.create_connection(("127.0.0.1", server.port))
+    try:
+        # while the stalled socket sits silent pre-handshake, a real
+        # client must connect, handshake, and get served
+        client = RpcClient("127.0.0.1", server.port,
+                           ssl_context=make_client_ssl_context(str(cert)))
+        t0 = time.monotonic()
+        assert client.call({"x": 1}) == ("echo", {"x": 1})
+        assert time.monotonic() - t0 < 5.0
+        client.close()
+    finally:
+        stalled.close()
+        server.close()
+
+
+def test_rpc_timeout_env_knobs(monkeypatch):
+    monkeypatch.setenv("PINOT_TPU_RPC_HANDSHAKE_S", "0.25")
+    monkeypatch.setenv("PINOT_TPU_RPC_CONNECT_S", "1.5")
+    server = RpcServer(lambda req: req)
+    try:
+        assert server._handshake_s == 0.25
+        client = RpcClient("127.0.0.1", server.port)
+        assert client.connect_timeout == 1.5
+        assert client.call("ping") == "ping"
+        client.close()
+    finally:
+        server.close()
+    # constructor args win over the env
+    server2 = RpcServer(lambda req: req, handshake_timeout_s=2.0)
+    try:
+        assert server2._handshake_s == 2.0
+        client2 = RpcClient("127.0.0.1", server2.port, connect_timeout=3.0)
+        assert client2.connect_timeout == 3.0
+        client2.close()
+    finally:
+        server2.close()
+
+
+# -- registry semantics ------------------------------------------------------
+
+
+def test_registry_scheduling_is_deterministic():
+    """Scripted schedules fire on exact per-point call indices; seeded
+    probability schedules replay identically for the same seed."""
+    faults.FAULTS.reset()
+    faults.FAULTS.arm("transport.call", kind="error", times=None,
+                      schedule={1, 3})
+    fired = []
+    for i in range(5):
+        try:
+            faults.FAULTS.fire("transport.call")
+            fired.append(False)
+        except faults.InjectedFault:
+            fired.append(True)
+    assert fired == [False, True, False, True, False]
+    faults.FAULTS.reset()
+
+    def run(seed):
+        faults.FAULTS.reset()
+        faults.seed_schedule(seed, 0.4, points=("server.query",))
+        out = []
+        for _ in range(30):
+            try:
+                faults.FAULTS.fire("server.query")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        faults.FAULTS.reset()
+        return out
+
+    a, b = run(7), run(7)
+    assert a == b and any(a), "seeded schedule must replay identically"
+    assert run(8) != a  # and actually depend on the seed
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError):
+        faults.FAULTS.arm("no.such.point", kind="error")

@@ -1,0 +1,110 @@
+"""CI perf-structure guard: fault injection OFF must cost nothing.
+
+Same discipline as test_tracing_perf_guard.py, same instrumentation (call
+counts, not wall-clock, so it can't flake): with nothing armed, a warm
+query must never enter ``FaultRegistry.fire`` (the ``fire_count`` pin —
+call sites pay exactly one module-attribute read of ``faults.ACTIVE``)
+and must add ZERO ``jax.block_until_ready`` / ``jax.device_get`` syncs.
+An armed run of the same query is then required to move the counters,
+proving the guard watches live injection sites.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+
+# segmentCache off so every run actually reaches the dispatch injection
+# point instead of short-circuiting on a warm partial-result cache hit
+SQL = "SET segmentCache = false; " \
+      "SELECT fpk, SUM(fpv) FROM faultperf GROUP BY fpk"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def warm_engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("faultperf")
+    # unique column names -> fresh Program -> this module owns its own
+    # compile-guard entries regardless of what other tests compiled
+    schema = Schema.build("faultperf", dimensions=[("fpk", "INT")],
+                          metrics=[("fpv", "INT")])
+    rng = np.random.default_rng(11)
+    segs = []
+    for i in range(4):
+        cols = {"fpk": rng.integers(0, 20, 2000).astype(np.int32),
+                "fpv": rng.integers(0, 100, 2000).astype(np.int32)}
+        SegmentBuilder(schema, segment_name=f"fp_{i}").build(cols, d / f"s{i}")
+        segs.append(load_segment(d / f"s{i}"))
+    qe = QueryExecutor()
+    qe.add_table(schema, segs)
+    for _ in range(2):
+        r = qe.execute_sql(SQL)
+        assert not r.exceptions, r.exceptions
+    return qe
+
+
+class _CountingSync:
+    """Counting wrappers over jax's host-sync entry points."""
+
+    def __init__(self, monkeypatch):
+        self.block_calls = 0
+        self.device_get_calls = 0
+        real_block = jax.block_until_ready
+        real_get = jax.device_get
+
+        def counting_block(x):
+            self.block_calls += 1
+            return real_block(x)
+
+        def counting_get(x):
+            self.device_get_calls += 1
+            return real_get(x)
+
+        monkeypatch.setattr(jax, "block_until_ready", counting_block)
+        monkeypatch.setattr(jax, "device_get", counting_get)
+
+
+def test_disarmed_injection_adds_zero_cost(warm_engine, monkeypatch):
+    assert faults.ACTIVE is False
+    sync = _CountingSync(monkeypatch)
+    fires_before = faults.FAULTS.fire_count()
+    r = warm_engine.execute_sql(SQL)
+    assert not r.exceptions, r.exceptions
+    assert faults.FAULTS.fire_count() == fires_before, (
+        "disarmed call sites must never enter FaultRegistry.fire — the "
+        "only allowed cost is the faults.ACTIVE attribute read")
+    assert sync.block_calls == 0, (
+        "disarmed injection must not add block_until_ready syncs")
+    assert sync.device_get_calls == 0, (
+        "disarmed injection must not add device_get syncs")
+
+
+def test_armed_fault_moves_the_counters(warm_engine):
+    """Sanity: the guard watches live sites — an armed zero-delay fault
+    on the dispatch point must be consulted and fire."""
+    fires_before = faults.FAULTS.fire_count()
+    with faults.injected("device.dispatch", kind="delay", delay_s=0.0,
+                         times=None):
+        r = warm_engine.execute_sql(SQL)
+    assert not r.exceptions, r.exceptions
+    assert faults.FAULTS.fire_count() > fires_before
+    assert faults.FAULTS.fired("device.dispatch") >= 1
+
+
+def test_armed_error_fault_surfaces_in_response(warm_engine):
+    with faults.injected("device.dispatch", kind="error", times=1):
+        r = warm_engine.execute_sql(SQL)
+    assert r.exceptions and "injected fault" in r.exceptions[0], r.exceptions
