@@ -1,0 +1,222 @@
+"""Per-server circuit breakers for the broker routing table.
+
+Reference analogue: ConnectionFailureDetector marks a server unhealthy
+behind an exponential-backoff retry window; a circuit breaker is the
+stronger contract the retry/hedge layer needs — a dead server must stop
+eating retry budget the moment it trips, and must be re-admitted through
+a bounded probe, not a thundering herd.
+
+State machine per server (the classic closed → open → half-open cycle):
+
+  closed     all traffic; ``failure_threshold`` CONSECUTIVE transport
+             failures — or, when ``error_rate_threshold`` is configured,
+             that failure ratio over a recent-outcome window — trips it.
+  open       no traffic for ``cooldown_s`` (doubles on every re-trip,
+             capped at ``max_cooldown_s``); selection skips the server.
+  half-open  exactly one probe RPC is admitted; success closes the
+             breaker (cooldown resets), failure re-opens it with a
+             longer cooldown. A probe that never resolves (hung socket)
+             releases the probe slot after another cooldown.
+
+All transitions are pure call-count/clock bookkeeping — no background
+thread — so the deterministic fault schedules in spi/faults.py drive the
+full lifecycle from tests.
+
+Env knobs:
+  PINOT_TPU_BREAKER_FAILURES    consecutive-failure trip threshold (3)
+  PINOT_TPU_BREAKER_COOLDOWN_S  initial open→half-open cooldown (2.0)
+  PINOT_TPU_BREAKER_ERROR_RATE  failure-ratio trip threshold over the
+                                outcome window (unset/0 = disabled)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from ..spi.metrics import BROKER_METRICS, BrokerMeter
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    """One server's breaker. Not thread-safe on its own — the owning
+    CircuitBreakerTable serializes access."""
+
+    __slots__ = ("state", "consecutive_failures", "cooldown_s", "open_until",
+                 "probe_inflight_since", "outcomes", "opened_count")
+
+    def __init__(self, base_cooldown_s: float):
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.cooldown_s = base_cooldown_s
+        self.open_until = 0.0
+        self.probe_inflight_since: float | None = None
+        # recent (timestamp, ok) outcomes for the error-rate trip
+        self.outcomes: deque = deque(maxlen=64)
+        self.opened_count = 0
+
+
+class CircuitBreakerTable:
+    """Breaker per server instance, consulted by replica selection
+    (``allow``) and fed by scatter-RPC outcomes (``record_success`` /
+    ``record_failure``). API-compatible with the _FailureDetector it
+    replaces (``mark_failed`` / ``mark_healthy`` / ``is_healthy`` /
+    ``down_count``)."""
+
+    def __init__(self, failure_threshold: int | None = None,
+                 cooldown_s: float | None = None,
+                 error_rate_threshold: float | None = None,
+                 max_cooldown_s: float = 30.0,
+                 error_rate_min_volume: int = 8,
+                 error_rate_window_s: float = 30.0,
+                 metrics=BROKER_METRICS):
+        if failure_threshold is None:
+            failure_threshold = int(os.environ.get(
+                "PINOT_TPU_BREAKER_FAILURES", 3))
+        if cooldown_s is None:
+            cooldown_s = float(os.environ.get(
+                "PINOT_TPU_BREAKER_COOLDOWN_S", 2.0))
+        if error_rate_threshold is None:
+            rate = float(os.environ.get("PINOT_TPU_BREAKER_ERROR_RATE", 0.0))
+            error_rate_threshold = rate if rate > 0 else None
+        self.failure_threshold = max(1, failure_threshold)
+        self.base_cooldown_s = cooldown_s
+        self.max_cooldown_s = max_cooldown_s
+        self.error_rate_threshold = error_rate_threshold
+        self.error_rate_min_volume = error_rate_min_volume
+        self.error_rate_window_s = error_rate_window_s
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def _breaker_locked(self, instance: str) -> CircuitBreaker:
+        b = self._breakers.get(instance)
+        if b is None:
+            b = CircuitBreaker(self.base_cooldown_s)
+            self._breakers[instance] = b
+            if self.metrics is not None:
+                # per-server breaker state gauge (0=closed, 1=half-open,
+                # 2=open) for GET /metrics
+                self.metrics.set_gauge(
+                    f"circuitBreakerState.{instance}",
+                    lambda b=b: _STATE_VALUE[b.state])
+        return b
+
+    # -- selection side ------------------------------------------------------
+    def allow(self, instance: str) -> bool:
+        """May the next RPC go to this server? Open breakers whose cooldown
+        has elapsed transition to half-open and admit ONE probe; open
+        breakers inside the cooldown (and half-open breakers with a live
+        probe) refuse."""
+        now = time.monotonic()
+        with self._lock:
+            b = self._breakers.get(instance)
+            if b is None or b.state == CLOSED:
+                return True
+            if b.state == OPEN:
+                if now < b.open_until:
+                    return False
+                b.state = HALF_OPEN
+                b.probe_inflight_since = now
+                return True  # this caller carries the probe
+            # half-open: one probe at a time; a probe stuck longer than
+            # the cooldown is presumed lost — hand out another
+            if b.probe_inflight_since is None or \
+                    now - b.probe_inflight_since >= b.cooldown_s:
+                b.probe_inflight_since = now
+                return True
+            return False
+
+    def is_healthy(self, instance: str) -> bool:  # _FailureDetector compat
+        return self.allow(instance)
+
+    # -- outcome side --------------------------------------------------------
+    def record_success(self, instance: str) -> None:
+        with self._lock:
+            # create on first success too: the error-rate trip needs the
+            # success side of the outcome window, not just failures
+            b = self._breaker_locked(instance)
+            b.consecutive_failures = 0
+            b.outcomes.append((time.monotonic(), True))
+            b.probe_inflight_since = None
+            if b.state != CLOSED:
+                b.state = CLOSED
+                b.cooldown_s = self.base_cooldown_s
+
+    def record_failure(self, instance: str) -> None:
+        opened = False
+        with self._lock:
+            b = self._breaker_locked(instance)
+            now = time.monotonic()
+            b.consecutive_failures += 1
+            b.outcomes.append((now, False))
+            if b.state == HALF_OPEN:
+                # failed probe: re-open with a longer cooldown
+                b.probe_inflight_since = None
+                b.cooldown_s = min(b.cooldown_s * 2, self.max_cooldown_s)
+                opened = self._open_locked(b, now)
+            elif b.state == CLOSED and (
+                    b.consecutive_failures >= self.failure_threshold
+                    or self._error_rate_tripped_locked(b, now)):
+                opened = self._open_locked(b, now)
+        if opened and self.metrics is not None:
+            self.metrics.add_meter(BrokerMeter.CIRCUIT_OPEN)
+
+    def _open_locked(self, b: CircuitBreaker, now: float) -> bool:
+        b.state = OPEN
+        b.open_until = now + b.cooldown_s
+        b.opened_count += 1
+        return True
+
+    def _error_rate_tripped_locked(self, b: CircuitBreaker,
+                                   now: float) -> bool:
+        if self.error_rate_threshold is None:
+            return False
+        recent = [ok for ts, ok in b.outcomes
+                  if now - ts <= self.error_rate_window_s]
+        if len(recent) < self.error_rate_min_volume:
+            return False
+        failures = sum(1 for ok in recent if not ok)
+        return failures / len(recent) >= self.error_rate_threshold
+
+    def mark_failed(self, instance: str) -> None:  # _FailureDetector compat
+        self.record_failure(instance)
+
+    def mark_healthy(self, instance: str) -> None:  # _FailureDetector compat
+        self.record_success(instance)
+
+    # -- observability -------------------------------------------------------
+    def state(self, instance: str) -> str:
+        with self._lock:
+            b = self._breakers.get(instance)
+            if b is None:
+                return CLOSED
+            if b.state == OPEN and time.monotonic() >= b.open_until:
+                return HALF_OPEN  # next allow() will hand out the probe
+            return b.state
+
+    def down_count(self) -> int:
+        """Servers with an OPEN breaker still inside cooldown (the
+        serversUnhealthy gauge)."""
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for b in self._breakers.values()
+                       if b.state == OPEN and b.open_until > now)
+
+    def snapshot(self) -> dict:
+        """Breaker table for GET /debug/servers."""
+        out = {}
+        with self._lock:
+            items = list(self._breakers.items())
+        for inst, b in items:
+            out[inst] = {
+                "state": self.state(inst),
+                "consecutiveFailures": b.consecutive_failures,
+                "cooldownS": round(b.cooldown_s, 3),
+                "timesOpened": b.opened_count,
+            }
+        return out

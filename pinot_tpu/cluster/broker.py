@@ -10,11 +10,13 @@ and BrokerReduceService.reduceOnDataTable:61.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
 import uuid
-from concurrent.futures import ThreadPoolExecutor
+import zlib
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Optional
 
 from ..engine.combine import combine_aggregation, combine_group_by, combine_selection
@@ -30,12 +32,20 @@ from ..query.context import QueryContext
 from ..query.expressions import ExpressionContext
 from ..query.filter import FilterContext, Predicate, PredicateType
 from ..query.parser.sql import SqlParseError, parse_sql
+from ..spi import faults
 from ..spi.data_types import Schema
-from ..spi.metrics import BROKER_METRICS, BrokerMeter
+from ..spi.metrics import BROKER_METRICS, BrokerMeter, BrokerTimer
 from ..cache.results import BrokerResultCache, lineage_epoch, \
     result_cache_enabled
+from .breaker import CircuitBreakerTable
 from .controller import ONLINE, raw_table_name, table_name_with_type
-from .quota import QueryQuotaExceededError, QueryQuotaManager, ResponseStore
+from .quota import (
+    AdmissionController,
+    AdmissionRejectedError,
+    QueryQuotaExceededError,
+    QueryQuotaManager,
+    ResponseStore,
+)
 from .store import PropertyStore
 from .transport import RemoteError, RpcClient, TransportError
 
@@ -43,42 +53,6 @@ from .transport import RemoteError, RpcClient, TransportError
 class _StaleRoutingError(Exception):
     """A routed segment vanished mid-query (atomic lineage swap committed);
     the scatter must restart on a fresh routing snapshot."""
-
-
-class _FailureDetector:
-    """Unhealthy-server book-keeping with exponential backoff retry
-    (reference: ConnectionFailureDetector)."""
-
-    def __init__(self, base_backoff_s: float = 1.0, max_backoff_s: float = 30.0):
-        self._lock = threading.Lock()
-        self._down: dict[str, tuple[float, float]] = {}  # inst → (until, backoff)
-        self.base = base_backoff_s
-        self.max = max_backoff_s
-
-    def mark_failed(self, instance: str) -> None:
-        with self._lock:
-            _, backoff = self._down.get(instance, (0.0, self.base / 2))
-            backoff = min(backoff * 2, self.max)
-            self._down[instance] = (time.monotonic() + backoff, backoff)
-
-    def mark_healthy(self, instance: str) -> None:
-        with self._lock:
-            self._down.pop(instance, None)
-
-    def is_healthy(self, instance: str) -> bool:
-        with self._lock:
-            entry = self._down.get(instance)
-            if entry is None:
-                return True
-            until, _ = entry
-            return time.monotonic() >= until  # retry window open
-
-    def down_count(self) -> int:
-        """Servers currently inside their backoff window (the
-        serversUnhealthy gauge)."""
-        now = time.monotonic()
-        with self._lock:
-            return sum(1 for until, _ in self._down.values() if until > now)
 
 
 class _ServerStats:
@@ -106,23 +80,37 @@ class _QueryBudget:
     and every degradation decision (failover exhausted, deadline expired)
     can consult allowPartialResults."""
 
-    __slots__ = ("deadline", "query_id", "partial_ok")
+    __slots__ = ("deadline", "query_id", "partial_ok", "_shard_seq")
 
     def __init__(self, timeout_ms: float, partial_ok: bool):
         self.deadline = time.monotonic() + timeout_ms / 1000.0
         self.query_id = uuid.uuid4().hex[:12]
         self.partial_ok = partial_ok
+        self._shard_seq = itertools.count()
 
     def remaining_s(self) -> float:
         return self.deadline - time.monotonic()
+
+    def next_shard_id(self) -> str:
+        """One id per scatter RPC (``<query_id>:<n>``): a hedged duplicate
+        can be cancelled individually without killing the sibling shards,
+        while a broadcast cancel kills the whole ``<query_id>`` prefix."""
+        return f"{self.query_id}:{next(self._shard_seq)}"
 
 
 class Broker:
     def __init__(self, store: PropertyStore, num_scatter_threads: int = 8,
                  adaptive_selection: bool = True,
-                 allow_partial_default: Optional[bool] = None):
+                 allow_partial_default: Optional[bool] = None,
+                 scatter_retries: Optional[int] = None,
+                 hedge_ms: Optional[float] = None,
+                 hedge_quantile: Optional[float] = None):
         self.store = store
-        self.failure_detector = _FailureDetector()
+        # per-server circuit breakers drive both replica selection and the
+        # serversUnhealthy gauge; kept under the historical attribute name
+        # too (is_healthy/mark_failed/mark_healthy are API-compatible)
+        self.breakers = CircuitBreakerTable()
+        self.failure_detector = self.breakers
         # broker-level default for graceful degradation; per-query
         # SET allowPartialResults=... always wins
         if allow_partial_default is None:
@@ -132,8 +120,37 @@ class Broker:
         # default end-to-end budget when the query carries no timeoutMs
         self.default_timeout_ms = float(os.environ.get(
             "PINOT_TPU_BROKER_TIMEOUT_MS", 60000))
+        # replica retry: how many re-scatter rounds a failed segment gets
+        # before the broker degrades (partial) or fails the query
+        if scatter_retries is None:
+            scatter_retries = int(os.environ.get(
+                "PINOT_TPU_SCATTER_RETRIES", 2))
+        self.max_scatter_retries = max(0, scatter_retries)
+        self.backoff_base_s = float(os.environ.get(
+            "PINOT_TPU_SCATTER_BACKOFF_MS", 50)) / 1000.0
+        self.backoff_cap_s = float(os.environ.get(
+            "PINOT_TPU_SCATTER_BACKOFF_CAP_MS", 1000)) / 1000.0
+        # hedging is OPT-IN (a fixed PINOT_TPU_HEDGE_MS, or a
+        # PINOT_TPU_HEDGE_QUANTILE over the scatterRpcMs histogram): a
+        # duplicate RPC changes the cluster's call pattern, which must
+        # never happen behind the back of a deterministic fault schedule
+        if hedge_ms is None:
+            env = os.environ.get("PINOT_TPU_HEDGE_MS")
+            hedge_ms = float(env) if env else None
+        self.hedge_fixed_ms = hedge_ms
+        if hedge_quantile is None:
+            env = os.environ.get("PINOT_TPU_HEDGE_QUANTILE")
+            hedge_quantile = float(env) if env else 0.0
+        self.hedge_quantile = hedge_quantile
+        self.hedge_min_samples = 20
         BROKER_METRICS.set_gauge("serversUnhealthy",
-                                 self.failure_detector.down_count)
+                                 self.breakers.down_count)
+        # broker-wide admission gate (PINOT_TPU_MAX_INFLIGHT_QUERIES);
+        # disabled by default — then admit() is a plain yield
+        self.admission = AdmissionController()
+        BROKER_METRICS.set_gauge("brokerQueriesInflight",
+                                 self.admission.inflight)
+        BROKER_METRICS.set_gauge("brokerQueriesQueued", self.admission.queued)
         self.quota = QueryQuotaManager()
         self.response_store = ResponseStore()
         self.adaptive_selection = adaptive_selection
@@ -156,6 +173,9 @@ class Broker:
         """segment → online instances, from the external view (reference:
         BrokerRoutingManager watching ExternalView)."""
         from .periodic import hidden_from_lineage
+
+        if faults.ACTIVE:
+            faults.FAULTS.fire("broker.route", table=name_with_type)
 
         # lineage is read BEFORE and AFTER the ideal-state read: if a
         # replacement committed in between (entry state changed/vanished),
@@ -204,7 +224,11 @@ class Broker:
             self._rr += 1
             rr = self._rr
         for seg, replicas in routing.items():
-            healthy = [i for i in replicas if self.failure_detector.is_healthy(i)]
+            # breaker-gated: open breakers are skipped; a half-open breaker
+            # admits exactly one probe here. If EVERY replica is tripped the
+            # query still goes out (last-resort traffic beats a guaranteed
+            # failure — and doubles as extra probing).
+            healthy = [i for i in replicas if self.breakers.allow(i)]
             candidates = healthy or replicas
             if not candidates:
                 unavailable.append(seg)
@@ -262,7 +286,7 @@ class Broker:
             # shapes the single-stage grammar rejects (joins, subqueries,
             # set ops) route to the multi-stage dispatcher — the reference's
             # cross-engine fallback at the broker request handler
-            resp = self.execute_sql_mse(sql)
+            resp = self._admitted_mse(sql)
             if resp.exceptions and any(
                     x.startswith(("SqlParseError", "PlanError", "ParseError"))
                     for x in resp.exceptions):
@@ -271,7 +295,7 @@ class Broker:
                 return BrokerResponse(exceptions=[f"SqlParseError: {e}"])
             return resp
         if query.query_options.get("useMultistageEngine") in (True, "true", 1):
-            resp = self.execute_sql_mse(sql)
+            resp = self._admitted_mse(sql)
             resp._log_table = query.table_name
             return resp
         if getattr(query, "explain", False):
@@ -299,8 +323,18 @@ class Broker:
                 cached.time_used_ms = (time.perf_counter() - t0) * 1000
                 cached._log_table = query.table_name
                 return cached
+        # admission control (load shedding): the budget starts ticking NOW,
+        # so time spent queued for a broker slot comes out of the query's
+        # own deadline — an overloaded broker sheds with a 429-style
+        # rejection instead of stacking unbounded work
+        budget = _QueryBudget(self._timeout_ms(query),
+                              self._partial_allowed(query))
         try:
-            resp = self._execute(query, only_segments=segments)
+            with self.admission.admit(timeout_s=budget.remaining_s()):
+                resp = self._execute(query, only_segments=segments,
+                                     budget=budget)
+        except AdmissionRejectedError as e:
+            resp = self._rejected_response(e)
         except Exception as e:
             resp = BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
@@ -411,6 +445,23 @@ class Broker:
         QueryDispatcher.submitAndReduce)."""
         return self.mse_dispatcher.execute_sql(sql)
 
+    def _admitted_mse(self, sql: str) -> BrokerResponse:
+        """MSE dispatch behind the same broker admission gate as the
+        single-stage path."""
+        try:
+            with self.admission.admit(
+                    timeout_s=self.default_timeout_ms / 1000.0):
+                return self.execute_sql_mse(sql)
+        except AdmissionRejectedError as e:
+            return self._rejected_response(e)
+
+    def _rejected_response(self, e: Exception) -> BrokerResponse:
+        BROKER_METRICS.add_meter(BrokerMeter.QUERIES_REJECTED)
+        resp = BrokerResponse(
+            exceptions=[f"QueryRejectedError: {e}"])
+        resp.query_rejected = True
+        return resp
+
     @property
     def mse_dispatcher(self):
         if not hasattr(self, "_mse_dispatcher"):
@@ -457,7 +508,8 @@ class Broker:
             exceptions=[f"table {raw} not found or has no routable segments"])
 
     def _execute(self, query: QueryContext,
-                 only_segments: Optional[dict] = None) -> BrokerResponse:
+                 only_segments: Optional[dict] = None,
+                 budget: Optional[_QueryBudget] = None) -> BrokerResponse:
         raw = raw_table_name(query.table_name)
         offline = table_name_with_type(raw, "OFFLINE")
         realtime = table_name_with_type(raw, "REALTIME")
@@ -495,14 +547,17 @@ class Broker:
                 and TRACING.active_trace() is None:
             trace = TRACING.start_trace(f"broker:{raw}")
 
-        budget = _QueryBudget(self._timeout_ms(query),
-                              self._partial_allowed(query))
+        if budget is None:
+            budget = _QueryBudget(self._timeout_ms(query),
+                                  self._partial_allowed(query))
         all_results = []
         stats_sum = {"total_docs": 0, "num_segments_processed": 0,
                      "num_segments_pruned": 0, "num_segments_queried": 0,
                      "num_device_dispatches": 0, "num_compiles": 0,
                      "num_segments_cache_hit": 0,
                      "num_segments_cache_miss": 0,
+                     "scatter_retries": 0, "hedged_requests": 0,
+                     "hedge_wins": 0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -557,6 +612,9 @@ class Broker:
             num_segments_cache_miss=stats_sum["num_segments_cache_miss"],
             num_servers_queried=len(queried),
             num_servers_responded=len(responded),
+            num_scatter_retries=stats_sum["scatter_retries"],
+            num_hedged_requests=stats_sum["hedged_requests"],
+            num_hedge_wins=stats_sum["hedge_wins"],
         )
         if partial_notes:
             # degraded gather: merged answer of the responding servers only,
@@ -588,18 +646,35 @@ class Broker:
 
     def _broadcast_cancel(self, budget: _QueryBudget, stats_sum: dict) -> None:
         """Best-effort cancel to every server that was sent a shard of the
-        query but never responded; the server resolves queryId through the
-        accountant so the segment loop's check_cancel stops device work."""
+        query but never responded; the server resolves the queryId PREFIX
+        through the accountant (each scatter RPC carries its own
+        ``<query_id>:<n>`` shard id) so the segment loop's check_cancel
+        stops device work — and a shard that hasn't registered yet dies on
+        arrival via the accountant's tombstone."""
         pending = set(stats_sum.get("servers_queried", [])) - \
             set(stats_sum.get("servers_responded", []))
         for inst in pending:
             try:
                 self._client(inst).call(
                     {"type": "cancel", "queryId": budget.query_id,
-                     "reason": "broker deadline exceeded"},
+                     "prefix": True, "reason": "broker deadline exceeded"},
                     retry=False, timeout=2.0)
             except Exception:
                 pass  # cancel is advisory; the server may already be gone
+
+    def _cancel_shard(self, inst: str, shard_qid: str) -> None:
+        """Cancel one hedging loser, off-thread (the loser's server is
+        usually the slow or dead one — never block the winner on it)."""
+        def _send():
+            try:
+                self._client(inst).call(
+                    {"type": "cancel", "queryId": shard_qid,
+                     "reason": "hedged duplicate superseded"},
+                    retry=False, timeout=2.0)
+            except Exception:
+                pass
+        threading.Thread(target=_send, daemon=True,
+                         name="broker-hedge-cancel").start()
 
     def _scatter_gather(self, table: str, query: QueryContext, stats_sum: dict,
                         budget: _QueryBudget,
@@ -619,6 +694,8 @@ class Broker:
                      "num_device_dispatches": 0, "num_compiles": 0,
                      "num_segments_cache_hit": 0,
                      "num_segments_cache_miss": 0,
+                     "scatter_retries": 0, "hedged_requests": 0,
+                     "hedge_wins": 0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -628,6 +705,14 @@ class Broker:
             except _StaleRoutingError as e:
                 last = e
                 continue
+            except TimeoutError:
+                # the deadline path needs the attempt's servers_queried /
+                # servers_responded so _broadcast_cancel knows which
+                # servers still hold a shard — merge just those before the
+                # discard (counters stay attempt-local as on any failure)
+                for k in ("servers_queried", "servers_responded"):
+                    stats_sum.setdefault(k, []).extend(local[k])
+                raise
             for k, v in local.items():
                 if isinstance(v, list):
                     stats_sum.setdefault(k, []).extend(v)
@@ -661,84 +746,70 @@ class Broker:
                 f"TransportError: no online replica for segments "
                 f"{sorted(unavailable)}")
 
-        def call(inst_segs):
-            inst, segs = inst_segs
-            remaining = budget.remaining_s()
-            if remaining <= 0:
-                return inst, segs, None, TimeoutError(
-                    f"deadline exceeded before dispatch to {inst}")
-            # deadline propagation: the server clamps its scheduler wait
-            # and per-segment loop to this remaining budget; the socket
-            # timeout gets a little slack so the server-side timeout
-            # (which carries a real error message) fires first
-            request = {"type": "query", "table": table, "segments": segs,
-                       "query": query, "deadlineMs": remaining * 1000.0,
-                       "queryId": budget.query_id}
-            stats_sum["servers_queried"].append(inst)
-            with self._lock:
-                stats = self._server_stats.setdefault(inst, _ServerStats())
-                stats.inflight += 1
-            t0 = time.perf_counter()
-            try:
-                out = self._client(inst).call(request,
-                                              timeout=remaining + 2.0)
-                self.failure_detector.mark_healthy(inst)
-                with self._lock:
-                    stats.record((time.perf_counter() - t0) * 1000)
-                return inst, segs, out, None
-            except RemoteError as e:
-                # the server is alive — its handler raised. A replica
-                # retry would deterministically fail the same way, so no
-                # failover and no health-marking.
-                return inst, segs, None, e
-            except TransportError as e:
-                self.failure_detector.mark_failed(inst)
-                with self._lock:
-                    self._clients.pop(inst, None)
-                if time.monotonic() >= budget.deadline:
-                    # a slow server is indistinguishable from a dead one
-                    # once the budget is gone — classify as deadline, not
-                    # failover fodder
-                    return inst, segs, None, TimeoutError(
-                        f"deadline exceeded waiting on {inst}: {e}")
-                return inst, segs, None, e
-            finally:
-                with self._lock:
-                    stats.inflight -= 1
-
         def degrade(inst, segs, err) -> None:
             stats_sum["partial_exceptions"].append(
                 f"{type(err).__name__}: {inst}: "
                 f"segments {sorted(segs)}: {err}")
 
-        results = []
-        retry: list[str] = []
-        for inst, segs, out, err in self._pool.map(call, plan.items()):
-            if err is None:
-                results.append((inst, out))
-            elif isinstance(err, (TimeoutError, RemoteError)):
-                # never failover these: the budget is spent, or the error
-                # is deterministic — degrade (if allowed) or fail now
-                if not budget.partial_ok:
-                    raise err
-                degrade(inst, segs, err)
-            else:
-                retry.extend(segs)
-        if retry:
-            # failover: re-route failed segments to remaining replicas
-            # (reference: query-time replica failover via routing)
-            sub_routing = {s: routing[s] for s in retry}
-            sub_plan = self._select_instances(sub_routing)
-            for inst, segs, out, err in self._pool.map(call, sub_plan.items()):
-                if err is None:
-                    results.append((inst, out))
+        results, failed = self._dispatch_round(
+            plan, table, query, budget, stats_sum, routing)
+
+        # replica-aware retry (self-healing): a shard that failed at the
+        # connection level re-scatters to replicas not yet tried, under
+        # capped exponential backoff, for as long as the query's own budget
+        # allows. Terminal errors never retry: a RemoteError would fail the
+        # same way on any replica, a TimeoutError means the budget is gone
+        # — both degrade (partial mode) or fail the query now.
+        tried: dict[str, set] = {}
+        for inst, segs in plan.items():
+            for s in segs:
+                tried.setdefault(s, set()).add(inst)
+        attempt = 0
+        while failed:
+            retry_routing: dict[str, list[str]] = {}
+            last_err: dict[str, tuple[str, Exception]] = {}
+            for inst, segs, err in failed:
+                if isinstance(err, (TimeoutError, RemoteError)):
+                    if not budget.partial_ok:
+                        raise err
+                    degrade(inst, segs, err)
                     continue
-                if not isinstance(err, (TimeoutError, RemoteError)):
-                    err = TransportError(
-                        f"segments {segs} unreachable on all replicas")
-                if not budget.partial_ok:
-                    raise err
-                degrade(inst, segs, err)
+                for s in segs:
+                    replicas = [i for i in routing.get(s, [])
+                                if i not in tried.get(s, ())]
+                    if replicas:
+                        retry_routing[s] = replicas
+                        last_err[s] = (inst, err)
+                    else:
+                        exhausted = TransportError(
+                            f"segment {s} unreachable on all replicas: "
+                            f"{err}")
+                        if not budget.partial_ok:
+                            raise exhausted
+                        degrade(inst, [s], exhausted)
+            if not retry_routing:
+                break
+            if attempt >= self.max_scatter_retries:
+                for s, (inst, err) in last_err.items():
+                    exhausted = TransportError(
+                        f"segment {s}: scatter retries exhausted "
+                        f"({self.max_scatter_retries}): {err}")
+                    if not budget.partial_ok:
+                        raise exhausted
+                    degrade(inst, [s], exhausted)
+                break
+            self._backoff_sleep(attempt, budget)
+            retry_plan = self._select_instances(retry_routing)
+            stats_sum["scatter_retries"] += len(retry_plan)
+            BROKER_METRICS.add_meter(BrokerMeter.SCATTER_RETRIES,
+                                     len(retry_plan))
+            for inst, segs in retry_plan.items():
+                for s in segs:
+                    tried.setdefault(s, set()).add(inst)
+            more, failed = self._dispatch_round(
+                retry_plan, table, query, budget, stats_sum, retry_routing)
+            results.extend(more)
+            attempt += 1
         from .datatable import decode
 
         combineds = []
@@ -790,13 +861,11 @@ class Broker:
                             f"segment {s} has no remaining replicas")
                     sub_routing[s] = replicas
             still_missing: dict[str, list[str]] = {}
-            failed: list[tuple[str, list[str], Exception]] = []
-            for inst, segs, out, err in self._pool.map(
-                    call, self._select_instances(sub_routing).items()):
-                if err is not None:
-                    failed.append((inst, segs, err))
-                else:
-                    absorb(inst, out, still_missing)
+            more, failed = self._dispatch_round(
+                self._select_instances(sub_routing), table, query, budget,
+                stats_sum, sub_routing)
+            for inst, out in more:
+                absorb(inst, out, still_missing)
             if failed:
                 # the retry pass keeps replica failover too: a transient
                 # connection failure re-routes once more to the segment's
@@ -820,15 +889,17 @@ class Broker:
                             raise TransportError(
                                 f"segment {s} unreachable on retry")
                         fo_routing[s] = replicas
-                for inst, segs, out, err in self._pool.map(
-                        call, self._select_instances(fo_routing).items()):
-                    if err is not None:
-                        if budget.partial_ok:
-                            degrade(inst, segs, err)
-                            continue
-                        raise TransportError(
-                            f"segments {segs} unreachable on retry")
+                fo_more, fo_failed = self._dispatch_round(
+                    self._select_instances(fo_routing), table, query,
+                    budget, stats_sum, fo_routing)
+                for inst, out in fo_more:
                     absorb(inst, out, still_missing)
+                for inst, segs, err in fo_failed:
+                    if budget.partial_ok:
+                        degrade(inst, segs, err)
+                        continue
+                    raise TransportError(
+                        f"segments {segs} unreachable on retry")
             if still_missing:
                 # twice-missing → genuinely gone; fail loudly (or degrade)
                 # rather than silently dropping rows
@@ -842,6 +913,218 @@ class Broker:
                         f"servers missing routed segments after retry: "
                         f"{gone}")
         return combineds
+
+    def _call_one(self, inst: str, segs: list, table: str,
+                  query: QueryContext, budget: _QueryBudget,
+                  stats_sum: dict, shard_qid: str):
+        """One scatter RPC shard. Returns ``(inst, segs, out, err)`` —
+        never raises — and feeds the circuit breaker and the scatterRpcMs
+        histogram (which drives the hedge delay)."""
+        remaining = budget.remaining_s()
+        if remaining <= 0:
+            return inst, segs, None, TimeoutError(
+                f"deadline exceeded before dispatch to {inst}")
+        # deadline propagation: the server clamps its scheduler wait
+        # and per-segment loop to this remaining budget; the socket
+        # timeout gets a little slack so the server-side timeout
+        # (which carries a real error message) fires first
+        request = {"type": "query", "table": table, "segments": segs,
+                   "query": query, "deadlineMs": remaining * 1000.0,
+                   "queryId": shard_qid}
+        stats_sum["servers_queried"].append(inst)
+        with self._lock:
+            stats = self._server_stats.setdefault(inst, _ServerStats())
+            stats.inflight += 1
+        t0 = time.perf_counter()
+        try:
+            out = self._client(inst).call(request,
+                                          timeout=remaining + 2.0)
+            self.breakers.record_success(inst)
+            latency_ms = (time.perf_counter() - t0) * 1000
+            BROKER_METRICS.update_timer(BrokerTimer.SCATTER_RPC_MS,
+                                        latency_ms)
+            with self._lock:
+                stats.record(latency_ms)
+            return inst, segs, out, None
+        except RemoteError as e:
+            # the server is alive — its handler raised. A replica
+            # retry would deterministically fail the same way, so no
+            # failover and no breaker signal.
+            return inst, segs, None, e
+        except TransportError as e:
+            self.breakers.record_failure(inst)
+            with self._lock:
+                self._clients.pop(inst, None)
+            if time.monotonic() >= budget.deadline:
+                # a slow server is indistinguishable from a dead one
+                # once the budget is gone — classify as deadline, not
+                # failover fodder
+                return inst, segs, None, TimeoutError(
+                    f"deadline exceeded waiting on {inst}: {e}")
+            return inst, segs, None, e
+        finally:
+            with self._lock:
+                stats.inflight -= 1
+
+    def _dispatch_round(self, plan: dict, table: str, query: QueryContext,
+                        budget: _QueryBudget, stats_sum: dict,
+                        routing: dict):
+        """One scatter round with hedging: each (instance, segments) shard
+        goes out as one RPC; a shard in flight past the hedge delay (fixed
+        PINOT_TPU_HEDGE_MS, or the scatterRpcMs histogram quantile) gets a
+        duplicate on another full-coverage replica. First complete
+        response wins — exactly one response per shard enters the merge,
+        in shard submission order, so a hedged run stays bit-identical to
+        an unhedged one — and the loser is cancelled by its shard id.
+
+        Returns ``(results, failed)``: ``results`` = [(instance, out)] in
+        plan order, ``failed`` = [(instance, segments, error)], one entry
+        per shard whose every attempt failed."""
+        hedge_delay = self._hedge_delay_s()
+        shards = []
+        pending: dict = {}  # future → (shard, inst, shard_qid)
+        for idx, (inst, segs) in enumerate(plan.items()):
+            sh = {"idx": idx, "primary": inst, "segs": segs,
+                  "t0": time.monotonic(), "resolved": False,
+                  "hedged": hedge_delay is None, "outstanding": 1,
+                  "errors": []}
+            qid = budget.next_shard_id()
+            fut = self._pool.submit(self._call_one, inst, segs, table,
+                                    query, budget, stats_sum, qid)
+            pending[fut] = (sh, inst, qid)
+            shards.append(sh)
+        out_by_idx: dict[int, tuple] = {}
+        failed: list[tuple[str, list, Exception]] = []
+        while pending:
+            timeout = None
+            if hedge_delay is not None:
+                due = [sh["t0"] + hedge_delay for sh in shards
+                       if not sh["resolved"] and not sh["hedged"]]
+                if due:
+                    timeout = max(0.0, min(due) - time.monotonic())
+            done, _ = wait(set(pending), timeout=timeout,
+                           return_when=FIRST_COMPLETED)
+            if not done:
+                # a straggler crossed the hedge delay: duplicate its RPC
+                # onto another replica (at most one hedge per shard)
+                now = time.monotonic()
+                for sh in shards:
+                    if sh["resolved"] or sh["hedged"] or \
+                            now - sh["t0"] < hedge_delay:
+                        continue
+                    sh["hedged"] = True
+                    target = self._hedge_target(sh, routing)
+                    if target is None or budget.remaining_s() <= 0:
+                        continue
+                    BROKER_METRICS.add_meter(BrokerMeter.HEDGED_REQUESTS)
+                    stats_sum["hedged_requests"] += 1
+                    qid = budget.next_shard_id()
+                    fut = self._pool.submit(
+                        self._call_one, target, sh["segs"], table, query,
+                        budget, stats_sum, qid)
+                    pending[fut] = (sh, target, qid)
+                    sh["outstanding"] += 1
+                continue
+            for fut in done:
+                entry = pending.pop(fut, None)
+                if entry is None:
+                    # its shard already resolved in this same batch and the
+                    # winner's cleanup dropped this duplicate
+                    continue
+                sh, inst, qid = entry
+                sh["outstanding"] -= 1
+                if sh["resolved"]:
+                    continue  # a duplicate of an already-won shard
+                _i, _s, out, err = fut.result()
+                if err is None:
+                    sh["resolved"] = True
+                    if inst != sh["primary"]:
+                        BROKER_METRICS.add_meter(BrokerMeter.HEDGE_WINS)
+                        stats_sum["hedge_wins"] += 1
+                    out_by_idx[sh["idx"]] = (inst, out)
+                    # first-complete-wins: drop + cancel the outstanding
+                    # duplicate so it stops burning server/device time
+                    for ofut, (osh, oinst, oqid) in list(pending.items()):
+                        if osh is sh:
+                            del pending[ofut]
+                            self._cancel_shard(oinst, oqid)
+                else:
+                    sh["errors"].append((inst, err))
+                    if sh["outstanding"] == 0:
+                        # every attempt failed: classify on the primary's
+                        # error when it is among them (the hedge may have
+                        # failed differently)
+                        pick = next((p for p in sh["errors"]
+                                     if p[0] == sh["primary"]),
+                                    sh["errors"][0])
+                        failed.append((pick[0], sh["segs"], pick[1]))
+        results = [out_by_idx[i] for i in sorted(out_by_idx)]
+        return results, failed
+
+    def _hedge_delay_s(self) -> Optional[float]:
+        """Straggler threshold before a duplicate RPC goes out. A fixed
+        PINOT_TPU_HEDGE_MS wins ("0" disables); otherwise the configured
+        quantile of the scatterRpcMs histogram, once it has enough samples
+        to mean something. None = hedging off (the default)."""
+        if self.hedge_fixed_ms is not None:
+            return self.hedge_fixed_ms / 1000.0 \
+                if self.hedge_fixed_ms > 0 else None
+        if self.hedge_quantile <= 0:
+            return None
+        count, _total = BROKER_METRICS.timer_stats(BrokerTimer.SCATTER_RPC_MS)
+        if count < self.hedge_min_samples:
+            return None
+        q_ms = BROKER_METRICS.timer_quantile(BrokerTimer.SCATTER_RPC_MS,
+                                             self.hedge_quantile)
+        return max(q_ms / 1000.0, 0.001)
+
+    def _hedge_target(self, sh: dict, routing: dict) -> Optional[str]:
+        """Another replica hosting EVERY segment of the straggling shard
+        (never the primary, breaker permitting); None when the shard has
+        no full-coverage alternative."""
+        candidates: Optional[set] = None
+        for s in sh["segs"]:
+            replicas = set(routing.get(s, ()))
+            candidates = replicas if candidates is None \
+                else candidates & replicas
+        picks = [i for i in (candidates or ())
+                 if i != sh["primary"] and self.breakers.allow(i)]
+        if not picks:
+            return None
+        with self._lock:
+            return min(picks, key=lambda i: (
+                self._server_stats.setdefault(i, _ServerStats()).score(),
+                i))
+
+    def _backoff_sleep(self, attempt: int, budget: _QueryBudget) -> None:
+        """Capped exponential backoff before a retry round, never past the
+        remaining budget. Jitter is deterministic (hashed from query id +
+        attempt) so fault-schedule tests replay identically while
+        concurrent queries still decorrelate."""
+        delay = min(self.backoff_base_s * (2 ** attempt), self.backoff_cap_s)
+        frac = zlib.crc32(f"{budget.query_id}:{attempt}".encode()) % 1000
+        delay *= 0.5 + frac / 2000.0  # jitter in [0.5, 1.0)
+        remaining = budget.remaining_s()
+        if delay > 0 and remaining > 0:
+            time.sleep(min(delay, remaining))
+
+    def server_health(self) -> dict:
+        """Breaker + adaptive-selection state per server, for
+        GET /debug/servers."""
+        breakers = self.breakers.snapshot()
+        with self._lock:
+            stats = {i: {"ewmaLatencyMs": round(s.ewma_ms, 3),
+                         "inflight": s.inflight}
+                     for i, s in self._server_stats.items()}
+        out = {}
+        for inst in sorted(set(breakers) | set(stats)):
+            entry = dict(breakers.get(inst) or {
+                "state": "closed", "consecutiveFailures": 0,
+                "cooldownS": self.breakers.base_cooldown_s,
+                "timesOpened": 0})
+            entry.update(stats.get(inst, {}))
+            out[inst] = entry
+        return out
 
     def _merge(self, query: QueryContext, per_server: list):
         semantics = [semantics_for(a) for a in query.aggregations]

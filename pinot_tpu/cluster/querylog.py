@@ -68,6 +68,15 @@ class QueryLogger:
         if getattr(response, "num_servers_queried", 0):
             entry["numServersQueried"] = response.num_servers_queried
             entry["numServersResponded"] = response.num_servers_responded
+        # self-healing scatter/gather: a slow query that healed (retried or
+        # hedged its way to a full answer) says so in the log
+        if getattr(response, "num_scatter_retries", 0):
+            entry["scatterRetries"] = response.num_scatter_retries
+        if getattr(response, "num_hedged_requests", 0):
+            entry["hedgedRequests"] = response.num_hedged_requests
+            entry["hedgeWins"] = response.num_hedge_wins
+        if getattr(response, "query_rejected", False):
+            entry["queryRejected"] = True
         from ..spi import faults
 
         if faults.ACTIVE:

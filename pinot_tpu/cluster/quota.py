@@ -1,9 +1,12 @@
-"""Query quota + cursors (paginated results).
+"""Query quota, broker admission control + cursors (paginated results).
 
 Reference analogues:
 - HelixExternalViewBasedQueryQuotaManager (pinot-broker/.../queryquota/):
   per-table QPS quotas from table config, enforced with a hit counter over
   a sliding window.
+- The broker's maxConcurrentQueries admission gate: a semaphore over
+  query execution that sheds load with a well-formed 429-style rejection
+  instead of letting an overloaded broker collapse.
 - Cursors/response store (pinot-broker/.../cursors/FsResponseStore.java +
   pinot-spi/.../cursors/): a query's full result spools once, pages are
   served by cursor id.
@@ -11,15 +14,103 @@ Reference analogues:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
 from collections import deque
+from contextlib import contextmanager
 from typing import Optional
 
 
 class QueryQuotaExceededError(Exception):
     pass
+
+
+class AdmissionRejectedError(Exception):
+    """Broker admission control shed this query (queue full, or the queue
+    wait would outlive the query's deadline)."""
+
+
+class AdmissionController:
+    """Broker-wide in-flight query gate (load shedding under overload).
+
+    ``PINOT_TPU_MAX_INFLIGHT_QUERIES`` (or the ctor arg) bounds concurrent
+    query executions; unset/0 disables the gate entirely — the warm path
+    then pays a single attribute check. Waiters queue on the semaphore,
+    but only for as long as the query's own deadline allows (queue-wait is
+    bounded by the budget, never an unbounded pile-up), and the queue
+    depth itself is capped (``PINOT_TPU_MAX_QUEUED_QUERIES``, default
+    2×max-inflight) so a burst fails fast instead of accumulating."""
+
+    def __init__(self, max_inflight: Optional[int] = None,
+                 max_queued: Optional[int] = None):
+        if max_inflight is None:
+            max_inflight = int(os.environ.get(
+                "PINOT_TPU_MAX_INFLIGHT_QUERIES", 0)) or None
+        if max_queued is None:
+            env = os.environ.get("PINOT_TPU_MAX_QUEUED_QUERIES")
+            max_queued = int(env) if env is not None else (
+                2 * max_inflight if max_inflight else 0)
+        self.max_inflight = max_inflight
+        self.max_queued = max_queued
+        self._sem = (threading.Semaphore(max_inflight)
+                     if max_inflight else None)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._queued = 0
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def queued(self) -> int:
+        with self._lock:
+            return self._queued
+
+    @contextmanager
+    def admit(self, timeout_s: float = 0.0):
+        """Hold one in-flight slot for the duration of the block; raises
+        AdmissionRejectedError when the queue is full or no slot frees up
+        within ``timeout_s`` (the query's remaining deadline)."""
+        if self._sem is None:
+            yield
+            return
+        # fast path: a free slot means no queueing at all — the queue-depth
+        # cap only applies to queries that would actually have to wait
+        ok = self._sem.acquire(blocking=False)
+        if not ok:
+            with self._lock:
+                if self._queued >= self.max_queued:
+                    raise AdmissionRejectedError(
+                        f"broker admission queue full "
+                        f"({self._queued} queued, "
+                        f"{self.max_inflight} in flight)")
+                self._queued += 1
+            t0 = time.perf_counter()
+            try:
+                ok = self._sem.acquire(timeout=max(0.0, timeout_s))
+            finally:
+                with self._lock:
+                    self._queued -= 1
+            wait_ms = (time.perf_counter() - t0) * 1000
+            from ..spi.metrics import BROKER_METRICS, BrokerTimer
+
+            BROKER_METRICS.update_timer(BrokerTimer.ADMISSION_WAIT_MS,
+                                        wait_ms)
+            if not ok:
+                raise AdmissionRejectedError(
+                    f"no broker capacity within deadline "
+                    f"(waited {wait_ms:.0f}ms for one of "
+                    f"{self.max_inflight} slots)")
+        with self._lock:
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._inflight -= 1
+            self._sem.release()
 
 
 class QueryQuotaManager:

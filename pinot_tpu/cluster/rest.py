@@ -198,6 +198,7 @@ class BrokerRestServer(_RestServer):
                 (r"/metrics", lambda h, m, q: srv._metrics()),
                 (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
                 (r"/debug/cache", lambda h, m, q: srv._debug_cache()),
+                (r"/debug/servers", lambda h, m, q: srv._debug_servers()),
                 # cursor ids are not table names: no group-based table check
                 (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
                     m.group(1), int(q.get("offset", ["0"])[0]),
@@ -249,6 +250,12 @@ class BrokerRestServer(_RestServer):
                      "segmentPartialCache": GLOBAL_PARTIAL_CACHE.stats(),
                      "devicePartials": GLOBAL_DEVICE_CACHE.hbm_stats()}
 
+    def _debug_servers(self):
+        """Per-server circuit-breaker + adaptive-selection state (the
+        broker's routing health table)."""
+        return 200, {"servers": self.broker.server_health(),
+                     "unhealthy": self.broker.breakers.down_count()}
+
     def _cache_clear(self):
         """DELETE /cache — drop every tier (operator hammer for debugging
         staleness or reclaiming memory; lineage invalidation is automatic)."""
@@ -287,6 +294,9 @@ class BrokerRestServer(_RestServer):
                 self._cursor_owners[out["cursorId"]] = principal.name
             return (200 if not out.get("exceptions") else 500), out
         resp = self.broker.execute_sql(sql)
+        if getattr(resp, "query_rejected", False):
+            # admission control shed the query — 429, not a server error
+            return 429, resp.to_json()
         return (200 if not resp.exceptions else 500), resp.to_json()
 
     def _cursor_owned(self, cursor_id: str, principal) -> bool:

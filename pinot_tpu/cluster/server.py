@@ -219,10 +219,17 @@ class ServerInstance:
         if kind == "cancel":
             # broker abandon/timeout: flag the tracker so the segment loop's
             # check_cancel stops device work (reference: the /query/{id}
-            # DELETE path into the accountant interrupt)
+            # DELETE path into the accountant interrupt). A prefix cancel
+            # kills every shard of the query (`<query_id>:<n>` ids) and
+            # tombstones the prefix so a shard that lost the race to this
+            # cancel still dies on arrival.
+            reason = request.get("reason", "cancelled by broker")
+            qid = request.get("queryId", "")
+            if request.get("prefix"):
+                return {"cancelled": self.scheduler.accountant.kill_prefix(
+                    qid, reason=reason) > 0}
             return {"cancelled": self.scheduler.accountant.kill_query(
-                request.get("queryId", ""),
-                reason=request.get("reason", "cancelled by broker"))}
+                qid, reason=reason)}
         if isinstance(kind, str) and kind.startswith("mse_"):
             return self.mse_worker.handle(request)
         raise ValueError(f"unknown request type {kind}")

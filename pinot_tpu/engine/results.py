@@ -65,6 +65,14 @@ class BrokerResponse:
     # BrokerResponseNative) — responded < queried implies a degraded path
     num_servers_queried: int = 0
     num_servers_responded: int = 0
+    # self-healing scatter/gather accounting (cluster/broker.py): RPCs
+    # re-scattered to another replica, straggler RPCs duplicated after the
+    # hedge delay, and hedges that beat their primary
+    num_scatter_retries: int = 0
+    num_hedged_requests: int = 0
+    num_hedge_wins: int = 0
+    # broker admission control shed this query (429-style rejection)
+    query_rejected: bool = False
 
     def to_json(self) -> dict:
         out = {
@@ -95,6 +103,13 @@ class BrokerResponse:
         if self.num_servers_queried:
             out["numServersQueried"] = self.num_servers_queried
             out["numServersResponded"] = self.num_servers_responded
+        if self.num_scatter_retries:
+            out["numScatterRetries"] = self.num_scatter_retries
+        if self.num_hedged_requests:
+            out["numHedgedRequests"] = self.num_hedged_requests
+            out["numHedgeWins"] = self.num_hedge_wins
+        if self.query_rejected:
+            out["queryRejected"] = True
         return out
 
 

@@ -69,17 +69,46 @@ class ResourceAccountant:
     path). Budget is an explicit byte budget for query intermediates —
     there is no JVM heap to watch."""
 
-    def __init__(self, memory_budget_bytes: Optional[int] = None):
+    def __init__(self, memory_budget_bytes: Optional[int] = None,
+                 tombstone_ttl_s: float = 10.0):
         self.memory_budget_bytes = memory_budget_bytes
+        self.tombstone_ttl_s = tombstone_ttl_s
         self._lock = threading.Lock()
         self._inflight: dict[str, QueryResourceTracker] = {}
+        # cancel-before-register race: a cancel that arrives before the
+        # query registers leaves a short-TTL tombstone — id (or shard-id
+        # prefix) → (reason, expiry, is_prefix) — so the late-registering
+        # query is killed on arrival instead of running to completion
+        self._tombstones: dict[str, tuple[str, float, bool]] = {}
 
     def start_query(self, query_id: Optional[str] = None,
                     group: str = "default") -> QueryResourceTracker:
         t = QueryResourceTracker(query_id or uuid.uuid4().hex[:12], group)
+        reason = None
         with self._lock:
+            if self._tombstones:
+                reason = self._tombstone_match_locked(t.query_id)
             self._inflight[t.query_id] = t
+        if reason is not None:
+            t.kill(reason)
         return t
+
+    def _tombstone_match_locked(self, query_id: str) -> Optional[str]:
+        now = time.monotonic()
+        expired = [k for k, (_r, exp, _p) in self._tombstones.items()
+                   if exp <= now]
+        for k in expired:
+            del self._tombstones[k]
+        for key, (reason, _exp, is_prefix) in self._tombstones.items():
+            if query_id == key or (
+                    is_prefix and query_id.startswith(key + ":")):
+                return reason
+        return None
+
+    def _tombstone_locked(self, key: str, reason: str,
+                          is_prefix: bool) -> None:
+        self._tombstones[key] = (
+            reason, time.monotonic() + self.tombstone_ttl_s, is_prefix)
 
     def end_query(self, tracker: QueryResourceTracker) -> None:
         with self._lock:
@@ -115,10 +144,28 @@ class ResourceAccountant:
     def kill_query(self, query_id: str, reason: str = "killed by admin") -> bool:
         with self._lock:
             t = self._inflight.get(query_id)
+            if t is None:
+                # not registered (yet): tombstone the id so a query that
+                # lost the race to the cancel RPC still dies on arrival
+                self._tombstone_locked(query_id, reason, is_prefix=False)
         if t is None:
             return False
         t.kill(reason)
         return True
+
+    def kill_prefix(self, prefix: str,
+                    reason: str = "killed by admin") -> int:
+        """Kill every in-flight query whose id is ``prefix`` or a shard of
+        it (``prefix:<n>`` — the broker stamps one shard id per scatter
+        RPC), and tombstone the prefix so late-registering shards die on
+        arrival. Returns the number of live trackers killed."""
+        with self._lock:
+            victims = [t for qid, t in self._inflight.items()
+                       if qid == prefix or qid.startswith(prefix + ":")]
+            self._tombstone_locked(prefix, reason, is_prefix=True)
+        for t in victims:
+            t.kill(reason)
+        return len(victims)
 
     def inflight(self) -> list[str]:
         with self._lock:

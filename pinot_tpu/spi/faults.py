@@ -52,6 +52,7 @@ POINTS = (
     "stream.fetch",      # realtime consumer fetch_messages
     "mailbox.deliver",   # MSE mse_mailbox chunk delivery
     "store.write",       # PropertyStore.set / create_if_absent
+    "broker.route",      # Broker.routing_table snapshot read
 )
 
 

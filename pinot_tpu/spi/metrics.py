@@ -54,6 +54,12 @@ class BrokerMeter:
     RESULT_CACHE_EVICTIONS = "resultCacheEvictions"
     PARTIAL_RESULTS = "partialResults"
     DEADLINE_EXCEEDED = "deadlineExceededCancellations"
+    # self-healing scatter/gather (cluster/broker.py retry/hedge layer)
+    SCATTER_RETRIES = "scatterRetries"
+    HEDGED_REQUESTS = "hedgedRequests"
+    HEDGE_WINS = "hedgeWins"
+    CIRCUIT_OPEN = "circuitOpenCount"
+    QUERIES_REJECTED = "queriesRejected"
 
 
 class ServerTimer:
@@ -63,6 +69,10 @@ class ServerTimer:
 
 class BrokerTimer:
     QUERY_PROCESSING_TIME_MS = "queryProcessingTimeMs"
+    # per scatter-RPC latency — the p95 source for the hedge delay
+    SCATTER_RPC_MS = "scatterRpcMs"
+    # broker admission-control queue wait (cluster/quota.py)
+    ADMISSION_WAIT_MS = "admissionWaitMs"
 
 
 class ServerGauge:

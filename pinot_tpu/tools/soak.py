@@ -498,6 +498,178 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
 
 
 # ════════════════════════════════════════════════════════════════════════════
+# Suite 2b: QPS mode — concurrent load, latency-under-load, self-healing
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
+             concurrency: int = 8, n_servers: int = 3, replication: int = 2,
+             n_segments: int = 6, rows_per_segment: int = 400,
+             fault_rate: float = 0.0, max_inflight: int = 0,
+             progress=None) -> dict:
+    """Closed-loop QPS soak: ``concurrency`` workers pace an aggregate
+    ``qps`` arrival rate of exact-result queries against an embedded
+    cluster, reporting p50/p99 latency under load, achieved QPS, and the
+    self-healing counters (retried / hedged / rejected queries).
+
+    The invariant matches the chaos suite's: every full response must be
+    exact; with ``fault_rate`` > 0 (seeded schedule over transport.call +
+    server.query) a response may instead be a WELL-FORMED partial/error —
+    never silently wrong. ``max_inflight`` > 0 additionally arms broker
+    admission control, so overload sheds as queryRejected=true responses
+    (counted, not failed)."""
+    import threading
+
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+    from pinot_tpu.cluster.quota import AdmissionController
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi import faults
+    from pinot_tpu.spi.data_types import Schema
+    from pinot_tpu.spi.metrics import BROKER_METRICS, BrokerMeter
+
+    schema = Schema.build(
+        "stats",
+        dimensions=[("team", "STRING"), ("year", "INT")],
+        metrics=[("runs", "INT")])
+    teams = ["BOS", "NYA", "SFN", "LAN", "CHC", "HOU"]
+    rng = np.random.default_rng(seed)
+
+    tmp = tempfile.TemporaryDirectory(prefix="pinot_soak_qps_")
+    d = Path(tmp.name)
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = []
+    for i in range(n_servers):
+        s = ServerInstance(store, f"Server_{i}", backend="host")
+        s.start()
+        servers.append(s)
+    broker = Broker(store)
+    if max_inflight > 0:
+        broker.admission = AdmissionController(max_inflight=max_inflight)
+    controller.add_schema(schema.to_json())
+    table = controller.create_table({"tableName": "stats",
+                                     "replication": replication})
+    expected = {}
+    for i in range(n_segments):
+        n = rows_per_segment
+        cols = {
+            "team": np.asarray(teams, dtype=object)[
+                rng.integers(0, len(teams), n)],
+            "year": rng.integers(2000, 2020, n).astype(np.int32),
+            "runs": rng.integers(0, 100, n).astype(np.int32),
+        }
+        name = f"stats_{i}"
+        SegmentBuilder(schema, segment_name=name).build(cols, d / name)
+        controller.add_segment(table, name,
+                               {"location": str(d / name), "numDocs": n})
+        for t, r in zip(cols["team"], cols["runs"]):
+            expected[t] = expected.get(t, 0) + int(r)
+
+    sql = ("SET resultCache=false; "
+           "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 20")
+    if fault_rate > 0:
+        faults.seed_schedule(seed, fault_rate,
+                             points=("transport.call", "server.query"))
+        sql = "SET allowPartialResults=true; " + sql
+    meters0 = {m: BROKER_METRICS.meter_count(m) for m in (
+        BrokerMeter.SCATTER_RETRIES, BrokerMeter.HEDGED_REQUESTS,
+        BrokerMeter.HEDGE_WINS, BrokerMeter.QUERIES_REJECTED,
+        BrokerMeter.CIRCUIT_OPEN)}
+
+    lock = threading.Lock()
+    state = {"next": 0, "ok": 0, "degraded": 0, "rejected": 0}
+    latencies: list[float] = []
+    failures: list[str] = []
+    t0 = time.time()
+    deadline = t0 + seconds
+
+    def worker():
+        while True:
+            with lock:
+                i = state["next"]
+                state["next"] += 1
+            target = t0 + i / qps  # open-loop pacing: i-th arrival time
+            now = time.time()
+            if target >= deadline or failures:
+                return
+            if target > now:
+                time.sleep(target - now)
+            q0 = time.perf_counter()
+            resp = broker.execute_sql(sql)
+            lat_ms = (time.perf_counter() - q0) * 1000
+            if getattr(resp, "query_rejected", False):
+                with lock:
+                    state["rejected"] += 1
+                continue
+            if resp.exceptions and not resp.partial_result:
+                if fault_rate > 0:
+                    with lock:
+                        state["degraded"] += 1
+                        latencies.append(lat_ms)
+                    continue
+                with lock:
+                    failures.append(f"query error: {resp.exceptions}")
+                return
+            if resp.partial_result:
+                with lock:
+                    state["degraded"] += 1
+                    latencies.append(lat_ms)
+                continue
+            got = {r[0]: r[1] for r in resp.result_table.rows}
+            if got != expected:
+                with lock:
+                    failures.append(
+                        f"wrong FULL results under load (seed {seed}): "
+                        f"got {got} want {expected}")
+                return
+            with lock:
+                state["ok"] += 1
+                latencies.append(lat_ms)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        if fault_rate > 0:
+            faults.FAULTS.reset()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        tmp.cleanup()
+    if failures:
+        raise SoakFailure(failures[0])
+    elapsed = time.time() - t0
+    done = state["ok"] + state["degraded"]
+    lat = sorted(latencies)
+    meters = {m: BROKER_METRICS.meter_count(m) - v
+              for m, v in meters0.items()}
+    out = {
+        "suite": "qps", "seed": seed, "elapsed_s": round(elapsed, 1),
+        "target_qps": qps, "concurrency": concurrency,
+        "queries_ok": state["ok"], "queries_degraded": state["degraded"],
+        "queries_rejected": state["rejected"],
+        "achieved_qps": round(done / elapsed, 1) if elapsed > 0 else 0.0,
+        "p50_ms": round(float(np.percentile(lat, 50)), 2) if lat else None,
+        "p99_ms": round(float(np.percentile(lat, 99)), 2) if lat else None,
+        "scatter_retries": meters[BrokerMeter.SCATTER_RETRIES],
+        "hedged_requests": meters[BrokerMeter.HEDGED_REQUESTS],
+        "hedge_wins": meters[BrokerMeter.HEDGE_WINS],
+        "rejected_meter": meters[BrokerMeter.QUERIES_REJECTED],
+        "circuit_opened": meters[BrokerMeter.CIRCUIT_OPEN],
+    }
+    if progress:
+        progress(f"qps: {out}")
+    return out
+
+
+# ════════════════════════════════════════════════════════════════════════════
 # Suite 3: realtime committer-crash rounds
 # ════════════════════════════════════════════════════════════════════════════
 
@@ -616,10 +788,20 @@ def soak_realtime(rounds: int = 3, seed: int = 0, rows_per_round: int = 50,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="pinot_tpu soak/chaos harness (committed, reproducible)")
-    p.add_argument("--suite", choices=["sql", "chaos", "realtime", "all"],
+    p.add_argument("--suite", choices=["sql", "chaos", "qps", "realtime",
+                                       "all"],
                    default="all")
     p.add_argument("--seconds", type=float, default=45.0,
-                   help="wall-clock budget per time-based suite (sql, chaos)")
+                   help="wall-clock budget per time-based suite "
+                        "(sql, chaos, qps)")
+    p.add_argument("--qps", type=float, default=50.0,
+                   help="qps suite: aggregate target arrival rate")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="qps suite: number of concurrent query workers")
+    p.add_argument("--max-inflight", type=int, default=0,
+                   help="qps suite: arm broker admission control at this "
+                        "many in-flight queries (0 = disabled); overload "
+                        "then sheds as counted queryRejected responses")
     p.add_argument("--rounds", type=int, default=3,
                    help="committer-crash rounds for the realtime suite")
     p.add_argument("--seed", type=int, default=20260731)
@@ -653,6 +835,11 @@ def main(argv=None) -> int:
             results.append(soak_chaos(
                 seconds=args.seconds, seed=args.seed,
                 fault_rate=args.fault_rate, progress=progress))
+        if args.suite == "qps":
+            results.append(soak_qps(
+                seconds=args.seconds, seed=args.seed, qps=args.qps,
+                concurrency=args.concurrency, fault_rate=args.fault_rate,
+                max_inflight=args.max_inflight, progress=progress))
         if args.suite in ("realtime", "all"):
             results.append(soak_realtime(
                 rounds=args.rounds, seed=args.seed, progress=progress))

@@ -108,3 +108,86 @@ def test_armed_error_fault_surfaces_in_response(warm_engine):
     with faults.injected("device.dispatch", kind="error", times=1):
         r = warm_engine.execute_sql(SQL)
     assert r.exceptions and "injected fault" in r.exceptions[0], r.exceptions
+
+
+# -- self-healing machinery must be free while idle ---------------------------
+
+
+@pytest.fixture(scope="module")
+def warm_cluster(tmp_path_factory):
+    """Single-replica warm cluster: with one replica there is nothing to
+    retry onto or hedge against, so the healing layer must be pure
+    bookkeeping-free control flow."""
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+
+    d = tmp_path_factory.mktemp("healperf")
+    schema = Schema.build("healperf", dimensions=[("hpk", "INT")],
+                          metrics=[("hpv", "INT")])
+    store = PropertyStore()
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="host")
+    server.start()
+    controller.add_schema(schema.to_json())
+    table = controller.create_table({"tableName": "healperf",
+                                     "replication": 1})
+    rng = np.random.default_rng(13)
+    for i in range(3):
+        cols = {"hpk": rng.integers(0, 20, 500).astype(np.int32),
+                "hpv": rng.integers(0, 100, 500).astype(np.int32)}
+        SegmentBuilder(schema, segment_name=f"hp_{i}").build(cols, d / f"s{i}")
+        controller.add_segment(table, f"hp_{i}",
+                               {"location": str(d / f"s{i}"), "numDocs": 500})
+    broker = Broker(store)
+    csql = "SET resultCache = false; SET segmentCache = false; " \
+           "SELECT hpk, SUM(hpv) FROM healperf GROUP BY hpk"
+    for _ in range(2):
+        r = broker.execute_sql(csql)
+        assert not r.exceptions, r.exceptions
+    yield broker, csql
+    server.stop()
+
+
+def test_idle_healing_layer_adds_no_rpcs_and_no_syncs(warm_cluster,
+                                                      monkeypatch):
+    """Breaker + hedge + retry + admission machinery, all disarmed/idle,
+    on the warm single-replica path: the RPC count per query is pinned
+    (no hedge duplicates, no retry re-scatters, no breaker probes), the
+    broker adds zero host syncs, and the fault registry is never
+    entered."""
+    from pinot_tpu.cluster.transport import RpcClient
+    from pinot_tpu.spi.metrics import BROKER_METRICS, BrokerMeter
+
+    broker, csql = warm_cluster
+    assert faults.ACTIVE is False
+    calls = {"n": 0}
+    real_call = RpcClient.call
+
+    def counting_call(self, request, *a, **kw):
+        calls["n"] += 1
+        return real_call(self, request, *a, **kw)
+
+    monkeypatch.setattr(RpcClient, "call", counting_call)
+    r = broker.execute_sql(csql)
+    assert not r.exceptions, r.exceptions
+    baseline = calls["n"]
+    assert baseline >= 1
+
+    sync = _CountingSync(monkeypatch)
+    fires_before = faults.FAULTS.fire_count()
+    retries_before = BROKER_METRICS.meter_count(BrokerMeter.SCATTER_RETRIES)
+    hedges_before = BROKER_METRICS.meter_count(BrokerMeter.HEDGED_REQUESTS)
+    calls["n"] = 0
+    r = broker.execute_sql(csql)
+    assert not r.exceptions, r.exceptions
+    assert calls["n"] == baseline, (
+        "idle self-healing machinery must not add RPCs on the warm path "
+        f"(expected {baseline}, saw {calls['n']})")
+    assert sync.block_calls == 0 and sync.device_get_calls == 0, (
+        "broker-side healing bookkeeping must never host-sync")
+    assert faults.FAULTS.fire_count() == fires_before
+    assert BROKER_METRICS.meter_count(
+        BrokerMeter.SCATTER_RETRIES) == retries_before
+    assert BROKER_METRICS.meter_count(
+        BrokerMeter.HEDGED_REQUESTS) == hedges_before
+    assert r.num_scatter_retries == 0 and r.num_hedged_requests == 0

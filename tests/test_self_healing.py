@@ -1,0 +1,633 @@
+"""Self-healing scatter/gather: replica retries with backoff, hedged
+requests, per-server circuit breakers, and broker admission control.
+
+Every cluster-level scenario is driven by the deterministic fault
+registry (spi/faults.py) — explicit times=N / call-index schedules, no
+sleep-and-hope — and asserts the PR invariant ladder:
+
+    retry → hedge → breaker → partial → reject
+
+A healable fault must heal to the bit-identical full answer
+(partialResult=false); only replica exhaustion degrades exactly like the
+graceful-degradation layer; overload sheds with a well-formed 429-style
+rejection, never a pile-up.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                               ServerInstance)
+from pinot_tpu.cluster.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                       CircuitBreakerTable)
+from pinot_tpu.cluster.quota import (AdmissionController,
+                                     AdmissionRejectedError)
+from pinot_tpu.engine.scheduler import (QueryKilledError, ResourceAccountant)
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.metrics import BROKER_METRICS, SERVER_METRICS, \
+    BrokerMeter, ServerMeter
+
+SCHEMA = Schema.build(
+    "shstats",
+    dimensions=[("team", "STRING")],
+    metrics=[("runs", "INT")])
+TEAMS = ["BOS", "NYA", "SFN", "LAN"]
+N_SEGMENTS = 4
+ROWS = 80
+
+# faults must reach transport/server on every run — no cache shortcuts
+NOCACHE = "SET resultCache = false; SET segmentCache = false; "
+SQL = NOCACHE + "SELECT team, SUM(runs) FROM shstats GROUP BY team LIMIT 20"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("self_healing")
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="host")
+               for i in range(2)]
+    for s in servers:
+        s.start()
+    controller.add_schema(SCHEMA.to_json())
+    table = controller.create_table({"tableName": "shstats",
+                                     "replication": 2})
+    rng = np.random.default_rng(20260805)
+    expected: dict[str, int] = {}
+    for i in range(N_SEGMENTS):
+        cols = {
+            "team": np.asarray(TEAMS, dtype=object)[
+                rng.integers(0, len(TEAMS), ROWS)],
+            "runs": rng.integers(0, 100, ROWS).astype(np.int32),
+        }
+        name = f"shstats_{i}"
+        SegmentBuilder(SCHEMA, segment_name=name).build(cols, d / name)
+        controller.add_segment(table, name,
+                               {"location": str(d / name), "numDocs": ROWS})
+        for t, r in zip(cols["team"], cols["runs"]):
+            expected[t] = expected.get(t, 0) + int(r)
+    yield store, servers, expected
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _fresh_broker(store, **kw) -> Broker:
+    """Each test that arms faults or trips breakers gets its own broker:
+    breaker state is per-broker and must not leak across tests."""
+    b = Broker(store, **kw)
+    b.backoff_base_s = 0.001  # keep retry tests fast; bound tests override
+    return b
+
+
+def _exact(resp, expected):
+    assert resp.result_table is not None, resp.exceptions
+    assert {r[0]: r[1] for r in resp.result_table.rows} == expected
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# circuit breaker unit lifecycle
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_breaker_opens_after_consecutive_failures():
+    t = CircuitBreakerTable(failure_threshold=3, cooldown_s=60.0,
+                            metrics=None)
+    for _ in range(2):
+        t.record_failure("s1")
+    assert t.state("s1") == CLOSED and t.allow("s1")
+    t.record_failure("s1")
+    assert t.state("s1") == OPEN
+    assert not t.allow("s1")
+    assert t.down_count() == 1
+
+
+def test_breaker_half_open_admits_single_probe_then_closes():
+    t = CircuitBreakerTable(failure_threshold=1, cooldown_s=0.05,
+                            metrics=None)
+    t.record_failure("s1")
+    assert not t.allow("s1")
+    time.sleep(0.06)
+    assert t.state("s1") == HALF_OPEN
+    assert t.allow("s1")          # this caller carries the probe
+    assert not t.allow("s1")      # one probe at a time
+    t.record_success("s1")
+    assert t.state("s1") == CLOSED
+    assert t.allow("s1") and t.down_count() == 0
+
+
+def test_breaker_failed_probe_reopens_with_doubled_cooldown():
+    t = CircuitBreakerTable(failure_threshold=1, cooldown_s=0.05,
+                            metrics=None)
+    t.record_failure("s1")
+    time.sleep(0.06)
+    assert t.allow("s1")  # probe
+    t.record_failure("s1")  # probe failed
+    assert t.state("s1") == OPEN
+    snap = t.snapshot()["s1"]
+    assert snap["cooldownS"] == pytest.approx(0.1, rel=0.01)
+    assert snap["timesOpened"] == 2
+    # a later success closes AND resets the cooldown to base
+    time.sleep(0.11)
+    assert t.allow("s1")
+    t.record_success("s1")
+    assert t.snapshot()["s1"]["cooldownS"] == pytest.approx(0.05, rel=0.01)
+
+
+def test_breaker_success_resets_consecutive_count():
+    t = CircuitBreakerTable(failure_threshold=3, cooldown_s=60.0,
+                            metrics=None)
+    t.record_failure("s1")
+    t.record_failure("s1")
+    t.record_success("s1")
+    t.record_failure("s1")
+    t.record_failure("s1")
+    assert t.state("s1") == CLOSED  # never 3 consecutive
+
+
+def test_breaker_error_rate_trip():
+    t = CircuitBreakerTable(failure_threshold=100, cooldown_s=60.0,
+                            error_rate_threshold=0.5,
+                            error_rate_min_volume=8, metrics=None)
+    # interleave so consecutive-failure never trips: 4 ok, then 4 fail
+    for _ in range(4):
+        t.record_success("s1")
+    for _ in range(3):
+        t.record_failure("s1")
+    assert t.state("s1") == CLOSED  # 3/7 < 0.5 (and below min volume)
+    t.record_failure("s1")
+    assert t.state("s1") == OPEN  # 4/8 >= 0.5 at min volume
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# admission control + tombstones (unit)
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_admission_queue_full_rejects_immediately():
+    a = AdmissionController(max_inflight=1, max_queued=0)
+    ctx = a.admit(timeout_s=5.0)
+    ctx.__enter__()
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(AdmissionRejectedError, match="queue full"):
+            with a.admit(timeout_s=5.0):
+                pass
+        assert time.perf_counter() - t0 < 1.0  # no deadline-long wait
+    finally:
+        ctx.__exit__(None, None, None)
+
+
+def test_admission_queue_wait_bounded_by_deadline():
+    a = AdmissionController(max_inflight=1, max_queued=4)
+    ctx = a.admit(timeout_s=5.0)
+    ctx.__enter__()
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(AdmissionRejectedError, match="deadline"):
+            with a.admit(timeout_s=0.1):
+                pass
+        elapsed = time.perf_counter() - t0
+        assert 0.08 <= elapsed < 1.0
+    finally:
+        ctx.__exit__(None, None, None)
+    # slot free again: admission proceeds
+    with a.admit(timeout_s=0.1):
+        assert a.inflight() == 1
+    assert a.inflight() == 0
+
+
+def test_admission_disabled_is_a_noop():
+    a = AdmissionController(max_inflight=None)
+    with a.admit(timeout_s=0.0):
+        pass  # never rejects
+
+
+def test_tombstone_cancel_before_register():
+    acc = ResourceAccountant()
+    # the cancel arrives FIRST (lost race): unknown id → False, but
+    # tombstoned
+    assert acc.kill_query("late_q", reason="deadline") is False
+    t = acc.start_query("late_q")
+    with pytest.raises(QueryKilledError, match="deadline"):
+        t.check_cancel()
+    acc.end_query(t)
+
+
+def test_tombstone_expires():
+    acc = ResourceAccountant(tombstone_ttl_s=0.05)
+    acc.kill_query("q_exp")
+    time.sleep(0.08)
+    t = acc.start_query("q_exp")
+    t.check_cancel()  # no raise: tombstone expired
+    acc.end_query(t)
+
+
+def test_kill_prefix_kills_live_shards_and_late_arrivals():
+    acc = ResourceAccountant()
+    t0 = acc.start_query("abc:0")
+    t1 = acc.start_query("abc:1")
+    other = acc.start_query("abcd:0")  # NOT a shard of "abc"
+    assert acc.kill_prefix("abc", reason="broker gave up") == 2
+    for t in (t0, t1):
+        with pytest.raises(QueryKilledError):
+            t.check_cancel()
+    other.check_cancel()  # unaffected
+    # a shard that registers after the prefix cancel dies on arrival
+    late = acc.start_query("abc:7")
+    with pytest.raises(QueryKilledError):
+        late.check_cancel()
+    for t in (t0, t1, other, late):
+        acc.end_query(t)
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# replica retry with backoff (cluster)
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_retry_heals_transport_error_full_result(cluster):
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store)
+    resp = broker.execute_sql(SQL)
+    assert not resp.exceptions
+    m0 = BROKER_METRICS.meter_count(BrokerMeter.SCATTER_RETRIES)
+    faults.FAULTS.arm("transport.call", faults.FaultSpec(kind="error",
+                                                         times=1))
+    resp = broker.execute_sql(SQL)
+    assert not resp.exceptions
+    assert resp.partial_result is False  # healed, NOT degraded
+    assert resp.num_scatter_retries >= 1
+    assert resp.to_json()["numScatterRetries"] == resp.num_scatter_retries
+    assert BROKER_METRICS.meter_count(BrokerMeter.SCATTER_RETRIES) > m0
+    _exact(resp, expected)
+
+
+def test_retry_heals_dropped_connection(cluster):
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store)
+    faults.FAULTS.arm("transport.call", faults.FaultSpec(kind="drop",
+                                                         times=1))
+    resp = broker.execute_sql(SQL)
+    assert not resp.exceptions and resp.partial_result is False
+    assert resp.num_scatter_retries >= 1
+    _exact(resp, expected)
+
+
+def test_all_replicas_exhausted_fails_loudly_without_partial(cluster):
+    store, _servers, _expected = cluster
+    broker = _fresh_broker(store)
+    faults.FAULTS.arm("transport.call", faults.FaultSpec(kind="error",
+                                                         times=20))
+    resp = broker.execute_sql(SQL)
+    assert resp.exceptions
+    assert "unreachable on all replicas" in resp.exceptions[0]
+    assert resp.result_table is None and not resp.partial_result
+
+
+def test_all_replicas_exhausted_degrades_like_pr6_partial(cluster):
+    store, _servers, _expected = cluster
+    broker = _fresh_broker(store)
+    faults.FAULTS.arm("transport.call", faults.FaultSpec(kind="error",
+                                                         times=20))
+    resp = broker.execute_sql("SET allowPartialResults=true; " + SQL)
+    # the PR 6 contract, unchanged: well-formed partial with per-server
+    # exceptions, never a silent wrong answer
+    assert resp.partial_result is True
+    assert resp.exceptions and resp.result_table is not None
+    assert resp.to_json()["partialResult"] is True
+
+
+def test_backoff_is_bounded_by_deadline(cluster):
+    store, _servers, _expected = cluster
+    broker = _fresh_broker(store)
+    broker.backoff_base_s = 30.0  # pathological backoff…
+    broker.backoff_cap_s = 30.0
+    faults.FAULTS.arm("transport.call", faults.FaultSpec(kind="error",
+                                                         times=20))
+    t0 = time.perf_counter()
+    resp = broker.execute_sql("SET timeoutMs=400; " + SQL)
+    elapsed = time.perf_counter() - t0
+    assert resp.exceptions  # …but the query still fails within its budget
+    assert elapsed < 5.0, f"backoff ignored the deadline: {elapsed:.1f}s"
+
+
+def test_healthy_path_bit_identical_with_zero_healing_counters(cluster):
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store)
+    a = broker.execute_sql(SQL)
+    b = broker.execute_sql(SQL)
+    assert not a.exceptions and not b.exceptions
+    assert [list(r) for r in a.result_table.rows] == \
+        [list(r) for r in b.result_table.rows]
+    for resp in (a, b):
+        assert resp.num_scatter_retries == 0
+        assert resp.num_hedged_requests == 0
+        assert resp.num_hedge_wins == 0
+        j = resp.to_json()
+        for k in ("numScatterRetries", "numHedgedRequests", "queryRejected"):
+            assert k not in j
+        _exact(resp, expected)
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# hedged requests
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_hedge_beats_straggler(cluster):
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store, hedge_ms=40.0)
+    resp = broker.execute_sql(SQL)  # warm (compile) before timing
+    assert not resp.exceptions
+    m0 = BROKER_METRICS.meter_count(BrokerMeter.HEDGE_WINS)
+    # first server.query of the next query stalls 1.5s — the hedge fires
+    # at 40ms on the other replica and wins
+    faults.FAULTS.arm("server.query", faults.FaultSpec(
+        kind="delay", delay_s=1.5, schedule=frozenset({0})))
+    t0 = time.perf_counter()
+    resp = broker.execute_sql(SQL)
+    elapsed = time.perf_counter() - t0
+    assert not resp.exceptions and resp.partial_result is False
+    assert resp.num_hedged_requests >= 1
+    assert resp.num_hedge_wins >= 1
+    assert BROKER_METRICS.meter_count(BrokerMeter.HEDGE_WINS) > m0
+    assert elapsed < 1.2, f"hedge did not rescue the straggler: {elapsed:.2f}s"
+    _exact(resp, expected)
+    j = resp.to_json()
+    assert j["numHedgedRequests"] == resp.num_hedged_requests
+    assert j["numHedgeWins"] == resp.num_hedge_wins
+
+
+def test_hedge_dedupe_is_bit_identical_to_unhedged(cluster):
+    store, _servers, expected = cluster
+    plain = _fresh_broker(store)
+    oracle = plain.execute_sql(SQL)
+    assert not oracle.exceptions
+    # hedge virtually every shard (1µs delay): duplicates race the
+    # primaries, first-complete-wins must still merge exactly one response
+    # per shard, in shard order
+    hedgy = _fresh_broker(store, hedge_ms=0.001)
+    for _ in range(3):
+        resp = hedgy.execute_sql(SQL)
+        assert not resp.exceptions and resp.partial_result is False
+        assert [list(r) for r in resp.result_table.rows] == \
+            [list(r) for r in oracle.result_table.rows]
+        _exact(resp, expected)
+    assert resp.num_hedged_requests >= 1
+
+
+def test_hedge_disabled_by_default(cluster):
+    store, _servers, _expected = cluster
+    broker = _fresh_broker(store)
+    assert broker._hedge_delay_s() is None
+    # quantile mode stays off until the histogram has enough samples
+    broker.hedge_quantile = 0.95
+    assert broker.hedge_fixed_ms is None
+    # (may or may not be None here depending on global histogram volume —
+    # just must not crash); fixed "0" always disables
+    broker.hedge_fixed_ms = 0.0
+    assert broker._hedge_delay_s() is None
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# circuit breaker integration
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_tripped_breaker_reroutes_all_traffic(cluster):
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store)
+    m0 = BROKER_METRICS.meter_count(BrokerMeter.CIRCUIT_OPEN)
+    for _ in range(3):  # default threshold
+        broker.breakers.record_failure("Server_0")
+    assert broker.breakers.state("Server_0") == OPEN
+    assert BROKER_METRICS.meter_count(BrokerMeter.CIRCUIT_OPEN) == m0 + 1
+    assert broker.breakers.down_count() == 1
+    assert BROKER_METRICS.gauge_value("serversUnhealthy") == 1
+    assert BROKER_METRICS.gauge_value("circuitBreakerState.Server_0") == 2
+    resp = broker.execute_sql(SQL)
+    assert not resp.exceptions
+    assert resp.num_servers_queried == 1  # everything routed to Server_1
+    _exact(resp, expected)
+
+
+def test_breaker_closes_after_successful_probe_traffic(cluster):
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store)
+    broker.breakers.base_cooldown_s = 0.05
+    b = broker.breakers._breaker_locked("Server_0")
+    b.cooldown_s = 0.05
+    for _ in range(3):
+        broker.breakers.record_failure("Server_0")
+    time.sleep(0.06)
+    assert broker.breakers.state("Server_0") == HALF_OPEN
+    # the server is actually fine: the next scatter probes it and the
+    # success closes the breaker
+    resp = broker.execute_sql(SQL)
+    assert not resp.exceptions
+    _exact(resp, expected)
+    deadline = time.monotonic() + 2.0
+    while broker.breakers.state("Server_0") != CLOSED \
+            and time.monotonic() < deadline:
+        broker.execute_sql(SQL)
+    assert broker.breakers.state("Server_0") == CLOSED
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# admission control (broker + REST)
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_admission_rejection_under_synthetic_overload(cluster):
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store)
+    broker.admission = AdmissionController(max_inflight=1, max_queued=0)
+    m0 = BROKER_METRICS.meter_count(BrokerMeter.QUERIES_REJECTED)
+    # stall the first query inside the cluster for 0.6s so it holds the
+    # only admission slot
+    faults.FAULTS.arm("server.query", faults.FaultSpec(
+        kind="delay", delay_s=0.6, times=1))
+    results = {}
+
+    def slow_query():
+        results["slow"] = broker.execute_sql(SQL)
+
+    t = threading.Thread(target=slow_query)
+    t.start()
+    time.sleep(0.2)  # let the slow query take the slot
+    rejected = broker.execute_sql(SQL)
+    t.join()
+    assert rejected.query_rejected is True
+    assert rejected.exceptions
+    assert rejected.exceptions[0].startswith("QueryRejectedError")
+    assert rejected.to_json()["queryRejected"] is True
+    assert BROKER_METRICS.meter_count(BrokerMeter.QUERIES_REJECTED) == m0 + 1
+    # the admitted query still completed exactly
+    assert not results["slow"].exceptions
+    _exact(results["slow"], expected)
+
+
+def test_rest_returns_429_and_debug_servers(cluster):
+    from pinot_tpu.cluster.rest import BrokerRestServer
+
+    store, _servers, expected = cluster
+    broker = _fresh_broker(store)
+    broker.admission = AdmissionController(max_inflight=1, max_queued=0)
+    broker.breakers.record_failure("Server_0")  # visible in /debug/servers
+    rest = BrokerRestServer(broker)
+    try:
+        held = broker.admission.admit(timeout_s=5.0)
+        held.__enter__()
+        try:
+            req = urllib.request.Request(
+                rest.url + "/query/sql",
+                data=json.dumps({"sql": SQL}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 429
+            body = json.loads(ei.value.read())
+            assert body["queryRejected"] is True
+            # breaker table visible while nothing has healed it yet
+            with urllib.request.urlopen(rest.url + "/debug/servers") as r:
+                dbg = json.loads(r.read())
+            assert dbg["servers"]["Server_0"]["consecutiveFailures"] == 1
+            assert dbg["servers"]["Server_0"]["state"] == "closed"
+        finally:
+            held.__exit__(None, None, None)
+        # freed: same query now succeeds over REST
+        with urllib.request.urlopen(urllib.request.Request(
+                rest.url + "/query/sql",
+                data=json.dumps({"sql": SQL}).encode(),
+                headers={"Content-Type": "application/json"})) as r:
+            body = json.loads(r.read())
+        assert {x[0]: x[1] for x in body["resultTable"]["rows"]} == expected
+        with urllib.request.urlopen(rest.url + "/metrics") as r:
+            text = r.read().decode()
+        assert "circuitBreakerState_Server_0" in text
+    finally:
+        rest.close()
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# cancel-before-register (cluster) + broker.route + querylog
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_prefix_cancel_rpc_kills_shards(cluster):
+    store, servers, _expected = cluster
+    broker = _fresh_broker(store)
+    acc = servers[0].scheduler.accountant
+    t0 = acc.start_query("pfx:0")
+    t1 = acc.start_query("pfx:1")
+    out = broker._client("Server_0").call(
+        {"type": "cancel", "queryId": "pfx", "prefix": True,
+         "reason": "test cancel"})
+    assert out == {"cancelled": True}
+    for t in (t0, t1):
+        with pytest.raises(QueryKilledError):
+            t.check_cancel()
+        acc.end_query(t)
+    # exact-id cancel of an unknown query still reports False (and
+    # tombstones it server-side)
+    out = broker._client("Server_0").call(
+        {"type": "cancel", "queryId": "nosuch"})
+    assert out == {"cancelled": False}
+
+
+def test_deadline_cancel_lands_before_shard_registers(cluster):
+    """The cancel-before-register race, end to end: both shard handlers
+    stall (explicit call-index fault schedule) past the broker deadline,
+    the broker's prefix cancel arrives while NOTHING is registered yet,
+    and the tombstone still kills the shards when they finally register."""
+    store, _servers, _expected = cluster
+    broker = _fresh_broker(store)
+    killed0 = SERVER_METRICS.meter_count(ServerMeter.QUERIES_KILLED)
+    # the server.query fault fires BEFORE scheduler.submit registers the
+    # tracker, so the delay opens the race window deterministically; it
+    # must outlast the broker's socket timeout (remaining + 2s slack) so
+    # the broker abandons the query and fires the prefix cancel while the
+    # handlers are still asleep — i.e. before anything registered
+    faults.FAULTS.arm("server.query", faults.FaultSpec(
+        kind="delay", delay_s=3.0, times=None, schedule=frozenset({0, 1})))
+    t0 = time.perf_counter()
+    resp = broker.execute_sql("SET timeoutMs=250; " + SQL)
+    assert resp.exceptions  # deadline exceeded
+    assert any("TimeoutError" in x or "deadline" in x
+               for x in resp.exceptions), resp.exceptions
+    assert time.perf_counter() - t0 < 10.0
+    # handlers wake AFTER the cancel: the tombstone must kill them at
+    # their first segment boundary
+    deadline = time.monotonic() + 4.0
+    while SERVER_METRICS.meter_count(ServerMeter.QUERIES_KILLED) <= killed0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert SERVER_METRICS.meter_count(ServerMeter.QUERIES_KILLED) > killed0
+
+
+def test_broker_route_fault_point(cluster):
+    store, _servers, expected = cluster
+    assert "broker.route" in faults.POINTS
+    broker = _fresh_broker(store)
+    faults.FAULTS.arm("broker.route", faults.FaultSpec(kind="error",
+                                                       times=1))
+    resp = broker.execute_sql(SQL)
+    assert resp.exceptions
+    assert "injected fault at broker.route" in resp.exceptions[0]
+    assert faults.FAULTS.fired("broker.route") == 1
+    # next routing read is clean
+    resp = broker.execute_sql(SQL)
+    assert not resp.exceptions
+    _exact(resp, expected)
+
+
+def test_querylog_records_healing_fields(cluster):
+    store, _servers, _expected = cluster
+    broker = _fresh_broker(store)
+    broker.query_logger.slow_threshold_ms = 0.0  # capture everything
+    faults.FAULTS.arm("transport.call", faults.FaultSpec(kind="error",
+                                                         times=1))
+    resp = broker.execute_sql(SQL)
+    assert not resp.exceptions and resp.num_scatter_retries >= 1
+    entries = broker.query_logger.slow_queries()
+    assert entries
+    assert entries[-1]["scatterRetries"] == resp.num_scatter_retries
+    assert "hedgedRequests" not in entries[-1]
+
+
+# ════════════════════════════════════════════════════════════════════════════
+# soak --qps smoke
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def test_soak_qps_smoke():
+    from pinot_tpu.tools.soak import soak_qps
+
+    out = soak_qps(seconds=3.0, seed=7, qps=20.0, concurrency=3,
+                   n_servers=2, n_segments=3, rows_per_segment=60,
+                   fault_rate=0.02)
+    assert out["suite"] == "qps"
+    assert out["queries_ok"] > 0
+    assert out["p50_ms"] is not None and out["p99_ms"] >= out["p50_ms"]
+    assert out["achieved_qps"] > 0
+    # the armed schedule produced work for the healing layer (retries) —
+    # and every full answer was exact (soak_qps raises otherwise)
+    assert out["scatter_retries"] + out["queries_degraded"] >= 0
