@@ -262,7 +262,12 @@ def _probe_accelerator():
     ``report`` distinguishes the two failure modes round reports kept
     conflating ("no TPU available" vs "our code broke on TPU"):
       {"status": "ok" | "hung" | "errored" | "skipped",
-       "attempts": [{"rc": int, "stderr_tail": str}, ...]}
+       "env": {"JAX_PLATFORMS": ..., "PJRT_DEVICE": ...},
+       "devices": str,    # jax.devices() of the successful probe
+       "attempts": [{"rc": int, "stderr_tail": str, "stderr": str}, ...]}
+    ``stderr`` is the subprocess's FULL stderr (the ..._tail truncation
+    kept discarding the one line that named the real init failure);
+    ``env`` records the probe's effective platform-selection variables.
     It rides into the BENCH json (probe field + warning) and is persisted
     to PROBE_REPORT_PATH for the multichip dryrun to pick up.
 
@@ -293,22 +298,28 @@ def _probe_accelerator():
     attempt_s = float(os.environ.get("BENCH_PROBE_ATTEMPT_S", budget / 2))
     deadline = time.monotonic() + budget
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    report["env"] = {"JAX_PLATFORMS": env.get("JAX_PLATFORMS"),
+                     "PJRT_DEVICE": env.get("PJRT_DEVICE")}
     attempt = 0
     hung_attempts = 0
     while time.monotonic() < deadline:
         attempt += 1
         attempt_deadline = min(deadline, time.monotonic() + attempt_s)
-        with tempfile.TemporaryFile() as ef:
+        with tempfile.TemporaryFile() as ef, tempfile.TemporaryFile() as of:
             proc = subprocess.Popen(
                 [sys.executable, "-c",
-                 "import jax; jax.numpy.zeros(8).block_until_ready()"],
-                stdout=subprocess.DEVNULL, stderr=ef, env=env,
+                 "import jax; jax.numpy.zeros(8).block_until_ready(); "
+                 "print(jax.devices())"],
+                stdout=of, stderr=ef, env=env,
                 start_new_session=True)
             while time.monotonic() < attempt_deadline and proc.poll() is None:
                 time.sleep(1.0)
             rc = proc.poll()
             if rc == 0:
                 report["status"] = "ok"
+                of.seek(0)
+                report["devices"] = of.read()[-2000:].decode(
+                    errors="replace").strip()
                 return True, report
             if rc is None:  # hung: abandon (no kill — lease-wedge hazard)
                 hung_attempts += 1
@@ -324,11 +335,13 @@ def _probe_accelerator():
                     return False, report
                 continue
             ef.seek(0)
-            tail = ef.read()[-2000:].decode(errors="replace").strip()
+            full = ef.read().decode(errors="replace").strip()
+            tail = full[-2000:]
             print(f"[bench] probe attempt {attempt} failed (rc={rc}):\n{tail}",
                   file=sys.stderr)
             report["status"] = "errored"
-            report["attempts"].append({"rc": rc, "stderr_tail": tail[-500:]})
+            report["attempts"].append({"rc": rc, "stderr_tail": tail[-500:],
+                                       "stderr": full})
         time.sleep(min(5 * 2 ** (attempt - 1), 60))
     return False, report
 

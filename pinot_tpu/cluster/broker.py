@@ -39,6 +39,8 @@ from ..cache.results import BrokerResultCache, lineage_epoch, \
     result_cache_enabled
 from .breaker import CircuitBreakerTable
 from .controller import ONLINE, raw_table_name, table_name_with_type
+from .datatable import DataTableError
+from .datatable import decode as decode_datatable
 from .quota import (
     AdmissionController,
     AdmissionRejectedError,
@@ -200,11 +202,20 @@ class Broker:
         return out
 
     def _client(self, instance: str) -> RpcClient:
+        cfg = self.store.get(f"/LIVEINSTANCES/{instance}") or \
+            self.store.get(f"/INSTANCECONFIGS/{instance}")
         with self._lock:
             c = self._clients.get(instance)
+            # a restarted server re-registers under a new address; a cached
+            # client pointing at the old one must not linger — an open
+            # breaker can shield it from traffic long enough that the
+            # failure-eviction path never fires, and a later query then
+            # burns ALL of a shard's replicas on stale connections at once
+            if c is not None and cfg is not None and \
+                    (c.host, c.port) != (cfg["host"], cfg["port"]):
+                self._clients.pop(instance, None)
+                c = None
             if c is None:
-                cfg = self.store.get(f"/LIVEINSTANCES/{instance}") or \
-                    self.store.get(f"/INSTANCECONFIGS/{instance}")
                 if cfg is None:
                     raise TransportError(f"no address for {instance}")
                 c = RpcClient(cfg["host"], cfg["port"])
@@ -557,7 +568,7 @@ class Broker:
                      "num_segments_cache_hit": 0,
                      "num_segments_cache_miss": 0,
                      "scatter_retries": 0, "hedged_requests": 0,
-                     "hedge_wins": 0,
+                     "hedge_wins": 0, "corrupt_shards_retried": 0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -615,6 +626,7 @@ class Broker:
             num_scatter_retries=stats_sum["scatter_retries"],
             num_hedged_requests=stats_sum["hedged_requests"],
             num_hedge_wins=stats_sum["hedge_wins"],
+            num_corrupt_shards_retried=stats_sum["corrupt_shards_retried"],
         )
         if partial_notes:
             # degraded gather: merged answer of the responding servers only,
@@ -695,7 +707,7 @@ class Broker:
                      "num_segments_cache_hit": 0,
                      "num_segments_cache_miss": 0,
                      "scatter_retries": 0, "hedged_requests": 0,
-                     "hedge_wins": 0,
+                     "hedge_wins": 0, "corrupt_shards_retried": 0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -810,12 +822,14 @@ class Broker:
                 retry_plan, table, query, budget, stats_sum, retry_routing)
             results.extend(more)
             attempt += 1
-        from .datatable import decode
-
         combineds = []
 
         def absorb(inst, r, missing_sink):
-            combined, st = decode(r["datatable"])
+            # decoded at the scatter edge (_call_one) where a bad payload
+            # can still fail over; hitting the fallback means the result
+            # bypassed that gate somehow
+            combined, st = r["decoded"] if "decoded" in r \
+                else decode_datatable(r["datatable"])
             combineds.append(combined)
             stats_sum["servers_responded"].append(inst)
             if r.get("trace"):
@@ -939,6 +953,29 @@ class Broker:
         try:
             out = self._client(inst).call(request,
                                           timeout=remaining + 2.0)
+            blob = out.get("datatable") if isinstance(out, dict) else None
+            if blob is not None:
+                try:
+                    # decode at the edge: the crc trailer catches damaged
+                    # bytes, the structural parse catches truncation and
+                    # framing garbage — the gather stage reuses this
+                    # result, so the happy path decodes exactly once
+                    out["decoded"] = decode_datatable(blob)
+                except DataTableError as e:
+                    # wire-integrity failure: the RPC completed but the
+                    # payload doesn't hold together. Reclassified as a
+                    # connection-level failure so the replica-retry
+                    # machinery re-dispatches the shard — the corrupt
+                    # response never enters the merge, and the final
+                    # answer stays exact.
+                    BROKER_METRICS.add_meter(
+                        BrokerMeter.DATATABLE_CORRUPTIONS)
+                    self.breakers.record_failure(inst)
+                    with self._lock:
+                        stats_sum["corrupt_shards_retried"] += 1
+                        self._clients.pop(inst, None)
+                    return inst, segs, None, TransportError(
+                        f"corrupt DataTable from {inst}: {e}")
             self.breakers.record_success(inst)
             latency_ms = (time.perf_counter() - t0) * 1000
             BROKER_METRICS.update_timer(BrokerTimer.SCATTER_RPC_MS,

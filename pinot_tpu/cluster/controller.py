@@ -27,6 +27,10 @@ from .store import PropertyStore
 ONLINE = "ONLINE"
 OFFLINE = "OFFLINE"
 CONSUMING = "CONSUMING"
+# external-view-only state: the replica failed integrity verification and
+# is quarantined — never advertised ONLINE, excluded from broker routing
+# (reference: Helix ERROR state on a failed state transition)
+ERROR = "ERROR"
 
 
 def table_name_with_type(name: str, table_type: str = "OFFLINE") -> str:

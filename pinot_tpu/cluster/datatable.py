@@ -16,6 +16,14 @@ Layout (little-endian):
     u8     kind    (GroupArrays | GroupByDict | Agg | Selection)
     u32    metadata JSON length, then the JSON (stats map)
     ...    kind-specific payload built from the tagged value encoding
+    u32    crc32 of everything above   ┐ integrity trailer, tagged by the
+    4s     b"PTcs" trailer magic       ┘ magic (see below)
+
+The integrity trailer is deliberately NOT a header version bump: the
+body is self-delimiting, so pre-trailer readers parse it and never look
+at the trailing 8 bytes — a new server's payload stays readable by a
+previous-release broker mid-rolling-upgrade (tests/test_upgrade_matrix).
+New readers detect the trailer by its magic and verify the crc.
 """
 
 from __future__ import annotations
@@ -23,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import struct
+import zlib
 from typing import Any
 
 import numpy as np
@@ -36,7 +45,15 @@ from ..engine.results import (
 from ..utils import sketches
 
 MAGIC = b"PTDT"
-VERSION = 2  # v2: groups_trimmed flag on group intermediates
+# v2: groups_trimmed flag on group intermediates
+VERSION = 2
+# wire-integrity trailer: little-endian crc32 over everything before it,
+# tagged by a trailing magic so old readers (which ignore trailing bytes)
+# stay compatible. Checked at broker decode — a corrupt payload surfaces
+# as DataTableCorruptionError, which the broker reclassifies as a
+# connection-level shard failure so replica retry heals it.
+TRAILER_MAGIC = b"PTcs"
+_TRAILER = struct.Struct("<I4s")
 
 KIND_GROUP_ARRAYS = 0
 KIND_GROUP_DICT = 1
@@ -62,6 +79,11 @@ _OBJECT_IDS = {cls: tid for tid, cls in OBJECT_TYPES.items()}
 
 class DataTableError(ValueError):
     pass
+
+
+class DataTableCorruptionError(DataTableError):
+    """The payload's crc32 trailer (or framing) does not match its bytes:
+    wire/memory corruption, not a version or encoding problem."""
 
 
 # -- tagged value encoding ----------------------------------------------------
@@ -155,7 +177,7 @@ class _Reader:
     def take(self, n: int) -> bytes:
         b = self.buf[self.pos:self.pos + n]
         if len(b) != n:
-            raise DataTableError("truncated DataTable")
+            raise DataTableCorruptionError("truncated DataTable")
         self.pos += n
         return b
 
@@ -267,20 +289,57 @@ def encode(combined, stats: dict) -> bytes:
         _w_value(out, list(combined.columns))
         _w_value(out, list(combined.rows))
         _w_value(out, combined.num_docs_scanned)
+    # integrity trailer: crc32 of every byte before it, plus the magic
+    # that lets new readers tell trailered from legacy payloads
+    out += _TRAILER.pack(zlib.crc32(out), TRAILER_MAGIC)
     return bytes(out)
+
+
+def _blob_version(blob: bytes) -> int:
+    if blob[:4] != MAGIC:
+        raise DataTableError("not a PTDT DataTable")
+    if len(blob) < 6:
+        raise DataTableCorruptionError("truncated DataTable header")
+    return struct.unpack_from("<H", blob, 4)[0]
+
+
+def _has_trailer(blob: bytes) -> bool:
+    return len(blob) >= 6 + _TRAILER.size and blob[-4:] == TRAILER_MAGIC
+
+
+def verify_blob(blob: bytes) -> bool:
+    """Cheap wire-integrity check: True iff the blob frames as a PTDT
+    payload whose crc32 trailer (when present) matches — legacy payloads
+    without the trailer magic pass, they carry no checksum to verify.
+    The broker runs a full decode per scatter RPC before counting the
+    response; this is the standalone check for everything else."""
+    try:
+        _blob_version(blob)
+    except DataTableError:
+        return False
+    if not _has_trailer(blob):
+        return True
+    want, _ = _TRAILER.unpack_from(blob, len(blob) - _TRAILER.size)
+    return zlib.crc32(blob[:-_TRAILER.size]) == want
 
 
 def decode(blob: bytes):
     """→ (combined_intermediate, stats dict)."""
-    if blob[:4] != MAGIC:
-        raise DataTableError("not a PTDT DataTable")
-    r = _Reader(blob, 4)
-    (version,) = r.unpack("<H")
+    version = _blob_version(blob)
     if not 1 <= version <= VERSION:
         # a NEWER writer (rolling upgrade, new server → old broker) fails
         # loudly; OLDER versions decode below (old server → new broker —
         # the compatibility-verifier guarantee, compCheck.sh analogue)
         raise DataTableError(f"unsupported DataTable version {version}")
+    if _has_trailer(blob):
+        want, _ = _TRAILER.unpack_from(blob, len(blob) - _TRAILER.size)
+        body = blob[:-_TRAILER.size]
+        if zlib.crc32(body) != want:
+            raise DataTableCorruptionError(
+                f"DataTable checksum mismatch (crc32 "
+                f"{zlib.crc32(body):08x} != trailer {want:08x})")
+        blob = body
+    r = _Reader(blob, 6)
     kind = r.u8()
     (mlen,) = r.unpack("<I")
     stats = json.loads(r.take(mlen).decode())

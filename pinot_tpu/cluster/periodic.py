@@ -19,7 +19,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from .controller import ONLINE, ClusterController
+from .controller import ERROR, ONLINE, ClusterController
 from .store import PropertyStore
 
 
@@ -302,6 +302,79 @@ def hidden_from_lineage(entries: Optional[dict]) -> set:
     return hidden
 
 
+# -- data integrity ----------------------------------------------------------
+
+
+class SegmentIntegrityChecker:
+    """Notices replicas quarantined by load-verify failures (ERROR state in
+    the external view) and drives self-repair: writes a
+    /REPAIRS/{table}/{seg} nudge that the owning servers watch and answer
+    with a fresh deep-store fetch + re-verify. Nudges are bounded
+    (max_repair_triggers per replica); a replica still ERROR after that is
+    flagged unrepairable in the /INTEGRITY/{table} report — the operator's
+    signal that the deep-store copy itself may be bad. Healthy-again
+    replicas get their nudge + trigger counters cleaned up.
+
+    Reference analogue: SegmentStatusChecker's ERROR-replica accounting +
+    RealtimeSegmentValidationManager-style repair kicks."""
+
+    def __init__(self, store: PropertyStore, controller: ClusterController,
+                 max_repair_triggers: int = 3):
+        self.store = store
+        self.controller = controller
+        self.max_repair_triggers = max_repair_triggers
+        # (table, seg, instance) → nudges issued so far
+        self._triggers: dict[tuple, int] = {}
+
+    def __call__(self) -> dict:
+        report = {}
+        for table in self.store.children("/IDEALSTATES"):
+            view = self.store.get(f"/EXTERNALVIEW/{table}") or {}
+            errored = {seg: sorted(i for i, st in m.items() if st == ERROR)
+                       for seg, m in view.items()
+                       if any(st == ERROR for st in m.values())}
+            # forget healthy replicas so a future quarantine gets a fresh
+            # trigger budget
+            for key in [k for k in self._triggers if k[0] == table
+                        and k[2] not in errored.get(k[1], ())]:
+                self._triggers.pop(key)
+            if not errored and self.store.get(f"/INTEGRITY/{table}") is None:
+                continue
+            nudged, unrepairable = [], []
+            for seg, instances in sorted(errored.items()):
+                for inst in instances:
+                    key = (table, seg, inst)
+                    n = self._triggers.get(key, 0)
+                    if n >= self.max_repair_triggers:
+                        unrepairable.append({"segment": seg,
+                                             "instance": inst,
+                                             "triggers": n})
+                        continue
+                    self._triggers[key] = n + 1
+                    nudged.append({"segment": seg, "instance": inst})
+            for seg in {e["segment"] for e in nudged}:
+                # the nonce makes every nudge a distinct write so the
+                # store's watch fires even for a repeat nudge
+                self.store.set(f"/REPAIRS/{table}/{seg}",
+                               {"requestedAtMs": int(time.time() * 1000),
+                                "nonce": self._triggers.get(
+                                    (table, seg, errored[seg][0]), 0)})
+            for seg in self.store.children(f"/REPAIRS/{table}"):
+                if seg not in errored:  # repaired (or dropped): clear nudge
+                    self.store.delete(f"/REPAIRS/{table}/{seg}")
+            integrity = {
+                "erroredReplicas": {s: i for s, i in sorted(errored.items())},
+                "unrepairable": unrepairable,
+                "checkedAtMs": int(time.time() * 1000),
+            }
+            if errored:
+                self.store.set(f"/INTEGRITY/{table}", integrity)
+            else:
+                self.store.delete(f"/INTEGRITY/{table}")
+            report[table] = integrity
+        return report
+
+
 # -- tier relocation ---------------------------------------------------------
 
 
@@ -346,6 +419,8 @@ def build_default_scheduler(store: PropertyStore, controller: ClusterController,
                    lambda: controller.run_retention())
     sched.register("SegmentStatusChecker", interval_s,
                    SegmentStatusChecker(store, controller))
+    sched.register("SegmentIntegrityChecker", interval_s,
+                   SegmentIntegrityChecker(store, controller))
     sched.register("RebalanceChecker", interval_s, RebalanceChecker(controller))
     sched.register("SegmentRelocator", interval_s, SegmentRelocator(controller))
 

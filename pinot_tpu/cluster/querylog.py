@@ -75,6 +75,10 @@ class QueryLogger:
         if getattr(response, "num_hedged_requests", 0):
             entry["hedgedRequests"] = response.num_hedged_requests
             entry["hedgeWins"] = response.num_hedge_wins
+        # wire-integrity healing: shards whose DataTable failed its
+        # checksum and were re-dispatched to another replica
+        if getattr(response, "num_corrupt_shards_retried", 0):
+            entry["corruptShardsRetried"] = response.num_corrupt_shards_retried
         if getattr(response, "query_rejected", False):
             entry["queryRejected"] = True
         from ..spi import faults

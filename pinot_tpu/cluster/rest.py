@@ -548,6 +548,7 @@ class ServerRestServer(_RestServer):
                  lambda h, m, q: srv._segment_metadata(m.group(1), m.group(2))),
                 (r"/debug/tables/([^/]+)",
                  lambda h, m, q: srv._debug_table(m.group(1))),
+                (r"/debug/segments", lambda h, m, q: srv._debug_segments()),
                 (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
             ]
             routes_post = [
@@ -643,6 +644,13 @@ class ServerRestServer(_RestServer):
                      "idealSegments": sorted(want),
                      "missing": sorted(want - hosted),
                      "unexpected": sorted(hosted - want)}
+
+    def _debug_segments(self):
+        """Served vs quarantined inventory across every hosted table —
+        quarantine entries carry the verify-failure reason, damaged
+        columns, and repair-attempt count (reference:
+        DebugResource.getSegmentsDebugInfo error surface)."""
+        return 200, {"tables": self.server.debug_segments()}
 
     def _debug_queries(self):
         from ..engine.scheduler import GLOBAL_ACCOUNTANT

@@ -12,14 +12,17 @@ exactly Helix's contract.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from typing import Optional
 
 from ..engine.query_executor import QueryExecutor
-from ..segment.loader import load_segment
+from ..segment.loader import SegmentIntegrityError, load_segment
 from ..spi import faults
 from ..spi.data_types import Schema
-from .controller import ONLINE, raw_table_name
+from ..spi.metrics import SERVER_METRICS, ServerMeter
+from .controller import ERROR, ONLINE, raw_table_name
 from .store import PropertyStore
 from ..engine.scheduler import QueryScheduler
 from .transport import RpcServer
@@ -41,6 +44,16 @@ class ServerInstance:
         self.scheduler = QueryScheduler(max_concurrent=max_concurrent_queries)
         # tableNameWithType → {segment_name: ImmutableSegment}
         self.segments: dict[str, dict[str, object]] = {}
+        # integrity quarantine: tableNameWithType → {segment_name → entry}
+        # (a replica that failed load-verify; advertised ERROR, never
+        # routed, owned by the repair path until it re-verifies)
+        self.quarantined: dict[str, dict[str, dict]] = {}
+        # transient (non-integrity) load failures: (table, seg) → attempts;
+        # bounded so one flaky deep-store read doesn't loop a converge hot,
+        # reset by a repair nudge, a successful load, or a drop
+        self._load_failures: dict[tuple, int] = {}
+        self.max_load_retries = int(
+            os.environ.get("PINOT_TPU_LOAD_RETRIES", "5"))
         self._lock = threading.RLock()
         self._rpc = RpcServer(self._handle)
         self._started = False
@@ -54,6 +67,7 @@ class ServerInstance:
                        {"host": self._rpc.host, "port": self._rpc.port},
                        ephemeral_owner=self.instance_id)
         self.store.watch("/IDEALSTATES/", self._on_ideal_state)
+        self.store.watch("/REPAIRS/", self._on_repair_request)
         self._started = True
         # replay current ideal states (Helix replays pending transitions on join)
         for table in self.store.children("/IDEALSTATES"):
@@ -69,6 +83,7 @@ class ServerInstance:
         # memmap fd — unbounded fd/memory growth under server churn
         try:
             self.store.unwatch(self._on_ideal_state)
+            self.store.unwatch(self._on_repair_request)
         except AttributeError:
             pass  # store impls without unwatch (older remote protocol)
         self.store.expire_session(self.instance_id)
@@ -101,28 +116,43 @@ class ServerInstance:
                     from ..spi.table_config import TableConfig
 
                     indexing = TableConfig.from_json(cfg_json).indexing
+            repair_kicks = []
             for seg in to_load:
                 meta = self.store.get(f"/SEGMENTS/{table}/{seg}")
                 if meta is None:
                     continue
+                if seg in self.quarantined.get(table, {}):
+                    # the local copy failed verification — reloading it
+                    # would just fail again; the repair path owns it until
+                    # a fresh deep-store fetch verifies
+                    continue
+                if self._load_failures.get((table, seg), 0) \
+                        >= self.max_load_retries:
+                    continue  # transient retries exhausted; needs a nudge
                 try:
-                    if faults.ACTIVE:
-                        faults.FAULTS.fire("segment.load", table=table,
-                                           segment=seg)
-                    segment = load_segment(self._fetch(meta["location"]))
-                    if indexing is not None:
-                        # config-requested indexes the segment was written
-                        # without get built at load (SegmentPreProcessor)
-                        segment.backfill_indexes(indexing)
+                    segment = self._load_segment_verified(
+                        table, seg, meta, indexing)
+                except SegmentIntegrityError as e:
+                    # integrity failure: quarantine (ERROR in the external
+                    # view, excluded from routing) and hand off to repair
+                    self._quarantine(table, seg, e)
+                    repair_kicks.append(seg)
+                    continue
                 except Exception:
                     # a failed load must not abort convergence of the other
                     # segments — and since the external-view update below
                     # advertises only want & loaded, the broker routes this
-                    # segment's replicas elsewhere (or reports it partial)
-                    log.exception("%s: failed to load segment %s/%s",
-                                  self.instance_id, table, seg)
+                    # segment's replicas elsewhere (or reports it partial).
+                    # Transient (non-integrity) failures retry on the NEXT
+                    # converge, bounded by max_load_retries.
+                    n = self._load_failures.get((table, seg), 0) + 1
+                    self._load_failures[(table, seg)] = n
+                    log.exception("%s: failed to load segment %s/%s "
+                                  "(attempt %d/%d)", self.instance_id, table,
+                                  seg, n, self.max_load_retries)
                     continue
                 self.segments.setdefault(table, {})[seg] = segment
+                self._load_failures.pop((table, seg), None)
             if to_drop:
                 # dropped/replaced segments invalidate their cached partial
                 # results (host + device tiers) and release device planes —
@@ -135,25 +165,202 @@ class ServerInstance:
                 GLOBAL_DEVICE_CACHE.drop_partials(segment_name=seg)
                 if segment is not None:
                     GLOBAL_DEVICE_CACHE.drop(segment)
+            # segments dropped from the ideal state release their quarantine
+            # entry and transient-failure counters — nothing left to repair
+            for seg in set(self.quarantined.get(table, ())) - want:
+                self.quarantined[table].pop(seg, None)
+            for key in [k for k in self._load_failures
+                        if k[0] == table and k[1] not in want]:
+                self._load_failures.pop(key, None)
             self._register_table(table)
             loaded = set(self.segments.get(table, {}))
         # advertise only what actually loaded — a skipped/failed load must
         # not appear ONLINE or the broker would silently lose its rows
         self._update_external_view(table, want & loaded)
+        for seg in repair_kicks:
+            self._kick_repair(table, seg)
 
-    def _fetch(self, location: str) -> str:
+    def _fetch(self, location: str, fresh: bool = False) -> str:
         """Deep-store fetch: tarred segments download + untar to a local
         work dir (reference: SegmentFetcherFactory on OFFLINE→ONLINE);
-        plain directories load in place."""
+        plain directories load in place. ``fresh`` untars into a new work
+        dir so a repair never reuses a possibly-damaged local copy."""
         if location.endswith((".tar.gz", ".tgz")):
             import tempfile
 
             from ..ingestion.batch import untar_segment
 
+            if fresh:
+                dest = tempfile.mkdtemp(
+                    prefix=f"{self.instance_id}_repair_")
+                return untar_segment(location, dest)
             if not hasattr(self, "_untar_dir"):
                 self._untar_dir = tempfile.mkdtemp(prefix=f"{self.instance_id}_seg_")
             return untar_segment(location, self._untar_dir)
         return location
+
+    def _load_segment_verified(self, table: str, seg: str, meta: dict,
+                               indexing, fresh: bool = False):
+        """Fetch + load + verify one segment. The ``segment.load`` fault
+        point fires here; an injected ``corrupt`` fault damages a local COPY
+        of the fetched directory (the deep store stays pristine, so repair
+        can heal) and the verifying loader is expected to catch it."""
+        corruption = None
+        if faults.ACTIVE:
+            try:
+                faults.FAULTS.fire("segment.load", table=table, segment=seg)
+            except faults.InjectedCorruption as c:
+                corruption = c
+        local = self._fetch(meta["location"], fresh=fresh)
+        if corruption is not None:
+            local = self._corrupt_local_copy(local, corruption)
+        segment = load_segment(local)
+        if indexing is not None:
+            # config-requested indexes the segment was written
+            # without get built at load (SegmentPreProcessor)
+            segment.backfill_indexes(indexing)
+        return segment
+
+    def _corrupt_local_copy(self, local: str, c) -> str:
+        """Copy the fetched segment dir and damage the copy's data file —
+        models on-disk/local-FS corruption without touching the source."""
+        import shutil
+        import tempfile
+        from pathlib import Path
+
+        from ..segment.format import DATA_FILE
+
+        src = Path(local)
+        dst = Path(tempfile.mkdtemp(
+            prefix=f"{self.instance_id}_corrupt_")) / src.name
+        shutil.copytree(src, dst)
+        data = dst / DATA_FILE
+        data.write_bytes(faults.corrupt_bytes(
+            data.read_bytes(), c.mode, c.seed, c.index))
+        return str(dst)
+
+    # -- integrity quarantine + repair --------------------------------------
+    def _quarantine(self, table: str, seg: str, err) -> None:
+        """Record an integrity failure: the replica is advertised ERROR
+        (excluded from broker routing) with the reason kept for
+        /debug/segments, and the repair path takes ownership."""
+        entry = {
+            "reason": str(err),
+            "columns": list(getattr(err, "columns", []) or []),
+            "sinceMs": int(time.time() * 1000),
+            "repairAttempts": 0,
+            "unrepairable": False,
+        }
+        with self._lock:
+            self.quarantined.setdefault(table, {})[seg] = entry
+        SERVER_METRICS.add_meter(ServerMeter.SEGMENTS_QUARANTINED)
+        log.error("%s: quarantined segment %s/%s: %s",
+                  self.instance_id, table, seg, err)
+
+    def _kick_repair(self, table: str, seg: str) -> None:
+        """Schedule a background repair unless auto-repair is disabled
+        (tests disable it to drive repair deterministically)."""
+        if os.environ.get("PINOT_TPU_AUTO_REPAIR", "true").lower() \
+                in ("false", "0", "off", "no"):
+            return
+        threading.Thread(target=self.repair_segment, args=(table, seg),
+                         daemon=True, name=f"repair-{seg}").start()
+
+    def repair_segment(self, table: str, seg: str) -> bool:
+        """Self-repair a quarantined segment: re-fetch a FRESH copy from
+        deep store, re-verify, and rejoin the external view. Bounded
+        retries with exponential backoff (PINOT_TPU_REPAIR_RETRIES /
+        PINOT_TPU_REPAIR_BACKOFF_MS); exhaustion flags the replica
+        unrepairable so the controller's SegmentIntegrityChecker can
+        surface it instead of re-nudging forever."""
+        retries = max(1, int(os.environ.get("PINOT_TPU_REPAIR_RETRIES", "3")))
+        backoff_s = float(
+            os.environ.get("PINOT_TPU_REPAIR_BACKOFF_MS", "50")) / 1000.0
+        for attempt in range(retries):
+            if attempt:
+                time.sleep(min(backoff_s * (2 ** (attempt - 1)), 2.0))
+            meta = self.store.get(f"/SEGMENTS/{table}/{seg}")
+            ideal = self.store.get(f"/IDEALSTATES/{table}") or {}
+            assigned = (ideal.get(seg) or {}).get(self.instance_id) == ONLINE
+            if meta is None or not assigned:
+                # dropped or moved away while quarantined — nothing to heal
+                with self._lock:
+                    self.quarantined.get(table, {}).pop(seg, None)
+                return False
+            indexing = None
+            cfg_json = self.store.get(f"/CONFIGS/TABLE/{table}")
+            if cfg_json and "tableName" in cfg_json:
+                from ..spi.table_config import TableConfig
+
+                indexing = TableConfig.from_json(cfg_json).indexing
+            with self._lock:
+                ent = self.quarantined.get(table, {}).get(seg)
+                if ent is not None:
+                    ent["repairAttempts"] += 1
+            try:
+                segment = self._load_segment_verified(
+                    table, seg, meta, indexing, fresh=True)
+            except Exception as e:
+                log.warning("%s: repair attempt %d/%d for %s/%s failed: %s",
+                            self.instance_id, attempt + 1, retries, table,
+                            seg, e)
+                continue
+            with self._lock:
+                self.segments.setdefault(table, {})[seg] = segment
+                self.quarantined.get(table, {}).pop(seg, None)
+                self._load_failures.pop((table, seg), None)
+                self._register_table(table)
+                want = {s for s, m in ideal.items()
+                        if m.get(self.instance_id) == ONLINE}
+                online = want & set(self.segments.get(table, {}))
+            SERVER_METRICS.add_meter(ServerMeter.SEGMENT_REPAIRS)
+            self._update_external_view(table, online)
+            log.info("%s: repaired segment %s/%s from deep store "
+                     "(attempt %d)", self.instance_id, table, seg,
+                     attempt + 1)
+            return True
+        with self._lock:
+            ent = self.quarantined.get(table, {}).get(seg)
+            if ent is not None:
+                ent["unrepairable"] = True
+        log.error("%s: segment %s/%s unrepairable after %d attempts",
+                  self.instance_id, table, seg, retries)
+        return False
+
+    def _on_repair_request(self, path: str, value) -> None:
+        """Controller nudge via /REPAIRS/{table}/{seg} (the
+        SegmentIntegrityChecker noticed degraded replication): retry a
+        quarantined replica's repair — synchronously, and even when
+        auto-repair is off, because an explicit nudge IS the operator
+        asking — or re-converge a transient failure whose bounded retries
+        were exhausted."""
+        if not self._started or value is None:
+            return
+        parts = path.strip("/").split("/")
+        if len(parts) != 3:
+            return
+        _, table, seg = parts
+        with self._lock:
+            ent = self.quarantined.get(table, {}).get(seg)
+            if ent is not None:
+                ent["unrepairable"] = False
+            self._load_failures.pop((table, seg), None)
+        if ent is not None:
+            self.repair_segment(table, seg)
+        else:
+            self._converge(table, self.store.get(f"/IDEALSTATES/{table}"))
+
+    def debug_segments(self) -> dict:
+        """Hosted-vs-quarantined segment inventory for GET /debug/segments."""
+        with self._lock:
+            out = {}
+            for table in sorted(set(self.segments) | set(self.quarantined)):
+                q = self.quarantined.get(table, {})
+                out[table] = {
+                    "served": sorted(self.segments.get(table, {})),
+                    "quarantined": {s: dict(e) for s, e in sorted(q.items())},
+                }
+            return out
 
     def _register_table(self, table: str) -> None:
         raw = raw_table_name(table)
@@ -191,6 +398,9 @@ class ServerInstance:
         self.executor.add_table(schema, segments, name=table)
 
     def _update_external_view(self, table: str, online: set) -> None:
+        with self._lock:
+            error = set(self.quarantined.get(table, ())) - set(online)
+
         def upd(view):
             view = view or {}
             for seg in list(view):
@@ -199,6 +409,11 @@ class ServerInstance:
                     del view[seg]
             for seg in online:
                 view.setdefault(seg, {})[self.instance_id] = ONLINE
+            # quarantined replicas are advertised ERROR (reference: Helix
+            # ERROR state) — visible to the controller's integrity checker,
+            # invisible to broker routing (which selects ONLINE only)
+            for seg in error:
+                view.setdefault(seg, {})[self.instance_id] = ERROR
             return view
 
         self.store.update(f"/EXTERNALVIEW/{table}", upd)
@@ -305,7 +520,13 @@ class ServerInstance:
         # pickled Python objects (reference: DataTableImplV4 on the wire)
         from .datatable import encode
 
-        out = {"datatable": encode(combined, stats)}
+        blob = encode(combined, stats)
+        if faults.ACTIVE:
+            # the "datatable.encode" corrupt fault damages the encoded
+            # payload — the broker's checksum must catch it downstream
+            blob = faults.corrupt_at("datatable.encode", blob, table=table,
+                                     instance=self.instance_id)
+        out = {"datatable": blob}
         if trace is not None:
             out["trace"] = trace.to_json()
         return out

@@ -85,6 +85,26 @@ def _recv_frame(sock: socket.socket):
     return pickle.loads(_recv_exact(sock, n))
 
 
+def _corrupt_payload(payload, c):
+    """Apply an injected wire corruption to a decoded response: garble its
+    byte fields (integrity checksums downstream must catch it — e.g. the
+    DataTable trailer at the broker). Responses with no byte fields degrade
+    to a garbled-frame TransportError so the fault is never a silent no-op."""
+    if isinstance(payload, (bytes, bytearray)):
+        return faults.corrupt_bytes(bytes(payload), c.mode, c.seed, c.index)
+    if isinstance(payload, dict):
+        hit = False
+        out = dict(payload)
+        for k, v in payload.items():
+            if isinstance(v, (bytes, bytearray)):
+                out[k] = faults.corrupt_bytes(bytes(v), c.mode, c.seed,
+                                              c.index)
+                hit = True
+        if hit:
+            return out
+    raise TransportError(f"garbled response frame: {c}")
+
+
 class RpcServer:
     """Thread-per-connection request/response server.
     handler(request_obj) → response_obj. Bind to port 0 for an ephemeral
@@ -292,13 +312,17 @@ class RpcClient:
             s = self._ssl.wrap_socket(s, server_hostname=self.host)
         return s
 
-    def _fire_fault(self, point: str) -> None:
+    def _fire_fault(self, point: str):
         """Injection seam: an InjectedDrop kills the pooled socket (the
-        peer 'hung up'); any injected fault surfaces as TransportError —
-        the connection-level failure shape, so callers exercise their real
-        failover/retry paths."""
+        peer 'hung up'); an InjectedCorruption is RETURNED — the RPC itself
+        proceeds and the caller garbles the response payload, so integrity
+        checksums (not connection errors) must catch it; any other injected
+        fault surfaces as TransportError — the connection-level failure
+        shape, so callers exercise their real failover/retry paths."""
         try:
             faults.FAULTS.fire(point, host=self.host, port=self.port)
+        except faults.InjectedCorruption as c:
+            return c
         except faults.InjectedDrop as e:
             self.close()
             raise TransportError(
@@ -306,6 +330,7 @@ class RpcClient:
         except faults.InjectedFault as e:
             raise TransportError(
                 f"rpc to {self.host}:{self.port} failed: {e}") from None
+        return None
 
     def call(self, request, retry: bool = True,
              timeout: Optional[float] = None):
@@ -316,9 +341,15 @@ class RpcClient:
         block deliveries stay retryable because the receiver dedups on
         (sender, seq). ``timeout`` bounds THIS call only (deadline
         propagation: the broker passes its remaining budget) by temporarily
-        tightening the socket timeout."""
+        tightening the socket timeout.
+
+        An armed ``corrupt`` fault on transport.call lets the RPC complete
+        and then garbles the response's byte fields — models in-flight wire
+        corruption below the app layer; the DataTable checksum at the
+        broker must catch it."""
+        corruption = None
         if faults.ACTIVE:
-            self._fire_fault("transport.call")
+            corruption = self._fire_fault("transport.call")
         attempts = (0, 1) if retry else (1,)
         with self._lock:
             for attempt in attempts:
@@ -344,6 +375,8 @@ class RpcClient:
                             f"rpc to {self.host}:{self.port} failed")
         if status == "error":
             raise RemoteError(payload)
+        if corruption is not None:
+            payload = _corrupt_payload(payload, corruption)
         return payload
 
     def call_stream(self, request):
@@ -353,7 +386,12 @@ class RpcClient:
         long-lived stream never blocks concurrent unary calls — the
         per-stream-channel behavior of the gRPC analogue."""
         if faults.ACTIVE:
-            self._fire_fault("transport.stream")
+            c = self._fire_fault("transport.stream")
+            if c is not None:
+                # streams have no payload-level checksum yet — degrade a
+                # corrupt fault to the connection-failure shape
+                raise TransportError(
+                    f"stream from {self.host}:{self.port} garbled: {c}")
         try:
             sock = self._connect()
         except OSError:

@@ -71,6 +71,10 @@ class BrokerResponse:
     num_scatter_retries: int = 0
     num_hedged_requests: int = 0
     num_hedge_wins: int = 0
+    # wire-integrity healing: scatter shards whose DataTable failed its
+    # checksum and re-dispatched to another replica (the final answer is
+    # still exact — the corrupt response never entered the merge)
+    num_corrupt_shards_retried: int = 0
     # broker admission control shed this query (429-style rejection)
     query_rejected: bool = False
 
@@ -108,6 +112,8 @@ class BrokerResponse:
         if self.num_hedged_requests:
             out["numHedgedRequests"] = self.num_hedged_requests
             out["numHedgeWins"] = self.num_hedge_wins
+        if self.num_corrupt_shards_retried:
+            out["numCorruptShardsRetried"] = self.num_corrupt_shards_retried
         if self.query_rejected:
             out["queryRejected"] = True
         return out

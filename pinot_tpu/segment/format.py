@@ -109,6 +109,12 @@ class SegmentMetadata:
     end_time: Optional[int] = None
     format_version: int = FORMAT_VERSION
     crc: Optional[str] = None
+    # integrity fingerprints finer than the whole-segment crc: per-buffer
+    # crc32 of the bytes as written (compressed form for PTCC buffers) and
+    # per-column crc32 chained over that column's buffers in write order —
+    # the loader verifies on load and names the damaged column(s)
+    buffer_crcs: dict = field(default_factory=dict)   # buffer name -> crc hex
+    column_crcs: dict = field(default_factory=dict)   # column name -> crc hex
     creation_time_ms: int = 0
     star_trees: list = field(default_factory=list)  # build_star_tree meta dicts
     # ingestion-order metadata (builder._compute_sort_order): longest
@@ -127,6 +133,8 @@ class SegmentMetadata:
             "startTime": self.start_time,
             "endTime": self.end_time,
             "crc": self.crc,
+            "bufferCrcs": self.buffer_crcs,
+            "columnCrcs": self.column_crcs,
             "creationTimeMs": self.creation_time_ms,
             "columns": {k: v.to_json() for k, v in self.columns.items()},
             "buffers": self.buffers,
@@ -145,6 +153,8 @@ class SegmentMetadata:
             start_time=d.get("startTime"),
             end_time=d.get("endTime"),
             crc=d.get("crc"),
+            buffer_crcs=d.get("bufferCrcs", {}),
+            column_crcs=d.get("columnCrcs", {}),
             creation_time_ms=d.get("creationTimeMs", 0),
             columns={k: ColumnMetadata.from_json(v) for k, v in d.get("columns", {}).items()},
             buffers=d.get("buffers", {}),
@@ -190,6 +200,8 @@ class SegmentWriter:
         self.directory.mkdir(parents=True, exist_ok=True)
         offset = 0
         crc = 0
+        col_crcs: dict[str, int] = {}
+        columns = sorted(metadata.columns, key=len, reverse=True)
         with open(self.directory / DATA_FILE, "wb") as f:
             for name, data in self._buffers:
                 codec = self.compress_on_write.get(name)
@@ -200,9 +212,19 @@ class SegmentWriter:
                 else:
                     metadata.buffers[name] = [offset, len(data)]
                 f.write(data)
+                metadata.buffer_crcs[name] = format(zlib.crc32(data), "08x")
+                # chain this buffer into its owning column's checksum
+                # (buffer names are "<column>.<kind>"; longest match wins
+                # for column names that themselves contain dots)
+                owner = next((c for c in columns
+                              if name == c or name.startswith(c + ".")), None)
+                if owner is not None:
+                    col_crcs[owner] = zlib.crc32(data, col_crcs.get(owner, 0))
                 crc = zlib.crc32(data, crc)
                 offset += len(data)
         metadata.crc = format(crc, "08x")
+        metadata.column_crcs = {c: format(v, "08x")
+                                for c, v in col_crcs.items()}
         with open(self.directory / METADATA_FILE, "w") as f:
             json.dump(metadata.to_json(), f, indent=1, default=str)
 

@@ -10,6 +10,8 @@ single DMA to HBM via device_cache.SegmentDeviceCache.
 
 from __future__ import annotations
 
+import os
+import zlib
 from pathlib import Path
 from typing import Optional
 
@@ -19,6 +21,33 @@ from ..spi.data_types import DataType
 from . import bitpack
 from .dictionary import Dictionary, deserialize_dictionary
 from .format import DATA_FILE, ColumnMetadata, SegmentMetadata, read_metadata
+
+# load-time verifications performed (pinned by the integrity perf guard:
+# verification is LOAD-time only — warm queries must never move this)
+VERIFY_CALLS = 0
+
+
+def verify_enabled() -> bool:
+    """CRC verification on load is ON unless PINOT_TPU_VERIFY_CRC opts out."""
+    return os.environ.get("PINOT_TPU_VERIFY_CRC", "true").lower() \
+        not in ("false", "0", "off", "no")
+
+
+class SegmentIntegrityError(RuntimeError):
+    """A loaded segment's bytes do not match its build-time checksums
+    (bit rot, truncation, torn copy). Carries enough structure for the
+    server to quarantine the replica and name the damaged columns."""
+
+    def __init__(self, segment_name: str, directory, reason: str,
+                 columns: Optional[list] = None):
+        detail = f" (columns: {', '.join(columns)})" if columns else ""
+        super().__init__(
+            f"segment {segment_name} failed integrity check: "
+            f"{reason}{detail} [{directory}]")
+        self.segment_name = segment_name
+        self.directory = str(directory)
+        self.reason = reason
+        self.columns = columns or []
 
 
 class ImmutableSegment:
@@ -35,6 +64,65 @@ class ImmutableSegment:
         self._nulls: dict[str, Optional[np.ndarray]] = {}
         self._mv_offsets: dict[str, np.ndarray] = {}
         self._indexes: dict[tuple, object] = {}
+
+    # -- integrity ----------------------------------------------------------
+    def verify_integrity(self) -> None:
+        """Recompute checksums over data.bin and compare with the ones the
+        builder stamped into metadata.json; raise SegmentIntegrityError on
+        any mismatch, naming the damaged column(s) when the per-buffer
+        crcs localize it. One full sequential pass at load time — nothing
+        on the query path re-verifies (the memmap pages it touches are the
+        ones queries would fault in anyway)."""
+        global VERIFY_CALLS
+        VERIFY_CALLS += 1
+        meta = self.metadata
+        expected_end = max(
+            (off + size for off, size, *_ in meta.buffers.values()),
+            default=0)
+        if len(self._data) < expected_end:
+            self._integrity_failure(
+                f"data.bin truncated: {len(self._data)} bytes, "
+                f"buffers extend to {expected_end}",
+                self._damaged_columns())
+        if meta.crc is not None:
+            crc = zlib.crc32(self._data[:expected_end])
+            if format(crc, "08x") != meta.crc:
+                self._integrity_failure(
+                    f"segment crc mismatch: computed {format(crc, '08x')}, "
+                    f"metadata {meta.crc}", self._damaged_columns())
+        elif meta.buffer_crcs:
+            # no whole-segment crc (older metadata) but per-buffer crcs
+            # present: verify buffer by buffer
+            bad = self._damaged_columns()
+            if bad:
+                self._integrity_failure("buffer crc mismatch", bad)
+
+    def _damaged_columns(self) -> list:
+        """Per-buffer re-check to localize damage: returns the owning
+        column names (or raw buffer names) whose stored crc disagrees."""
+        meta = self.metadata
+        columns = sorted(meta.columns, key=len, reverse=True)
+        bad = []
+        for name, want in meta.buffer_crcs.items():
+            entry = meta.buffers.get(name)
+            if entry is None:
+                continue
+            off, size = entry[0], entry[1]
+            chunk = self._data[off:off + size]
+            if len(chunk) != size or format(zlib.crc32(chunk), "08x") != want:
+                owner = next((c for c in columns
+                              if name == c or name.startswith(c + ".")),
+                             name)
+                if owner not in bad:
+                    bad.append(owner)
+        return bad
+
+    def _integrity_failure(self, reason: str, columns: list):
+        from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+        SERVER_METRICS.add_meter(ServerMeter.SEGMENT_CRC_MISMATCH)
+        raise SegmentIntegrityError(self.metadata.segment_name,
+                                    self.directory, reason, columns)
 
     # -- schema evolution ---------------------------------------------------
     def apply_schema(self, schema) -> None:
@@ -484,5 +572,12 @@ class ImmutableSegment:
         self._data = None
 
 
-def load_segment(directory: str | Path) -> ImmutableSegment:
-    return ImmutableSegment(directory)
+def load_segment(directory: str | Path,
+                 verify: Optional[bool] = None) -> ImmutableSegment:
+    """Load (and by default verify) a segment directory. ``verify=None``
+    follows PINOT_TPU_VERIFY_CRC (default on); verification happens ONCE
+    here — load/reload time — never per query."""
+    seg = ImmutableSegment(directory)
+    if verify if verify is not None else verify_enabled():
+        seg.verify_integrity()
+    return seg

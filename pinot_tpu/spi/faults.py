@@ -53,6 +53,7 @@ POINTS = (
     "mailbox.deliver",   # MSE mse_mailbox chunk delivery
     "store.write",       # PropertyStore.set / create_if_absent
     "broker.route",      # Broker.routing_table snapshot read
+    "datatable.encode",  # ServerInstance._handle_query DataTable encode
 )
 
 
@@ -66,10 +67,28 @@ class InjectedDrop(InjectedFault):
     shape), so failover and client-retry paths are exercised."""
 
 
+class InjectedCorruption(InjectedFault):
+    """Data-corruption fault: the call site catches this and mutates its
+    byte payload with ``corrupt_bytes`` (seeded bit-flip or truncation)
+    instead of raising, so detection paths — segment CRC verify, the
+    DataTable wire checksum — see genuinely wrong bytes. Call sites that
+    carry no byte payload treat it like any InjectedFault (it subclasses
+    it), so a corrupt spec armed at a payload-free point degrades to an
+    error fault rather than silently doing nothing."""
+
+    def __init__(self, point: str, mode: str, seed: int, index: int,
+                 message: Optional[str] = None):
+        super().__init__(message or f"injected {mode} corruption at {point}")
+        self.point = point
+        self.mode = mode
+        self.seed = seed
+        self.index = index
+
+
 class FaultSpec:
     """One armed fault at one injection point.
 
-    kind:        "error" | "drop" | "delay" | "hbm_oom"
+    kind:        "error" | "drop" | "delay" | "hbm_oom" | "corrupt"
     times:       fire on the next N matching calls then expire (None =
                  every matching call, never expires)
     delay_s:     sleep length for kind="delay"
@@ -80,17 +99,22 @@ class FaultSpec:
     schedule:    explicit set of per-point 0-based call indices to fire on
                  (scripted schedule; overrides probability)
     match:       optional predicate over the call-site context kwargs
+    corrupt_mode: "bitflip" | "truncate" — how a kind="corrupt" spec
+                 mutates the call site's bytes (see corrupt_bytes)
     """
 
-    KINDS = ("error", "drop", "delay", "hbm_oom")
+    KINDS = ("error", "drop", "delay", "hbm_oom", "corrupt")
 
     def __init__(self, kind: str = "error", times: Optional[int] = 1,
                  delay_s: float = 0.0, message: Optional[str] = None,
                  probability: Optional[float] = None, seed: int = 0,
                  schedule: Optional[Iterable[int]] = None,
-                 match: Optional[Callable[[dict], bool]] = None):
+                 match: Optional[Callable[[dict], bool]] = None,
+                 corrupt_mode: str = "bitflip"):
         if kind not in self.KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {self.KINDS})")
+        if corrupt_mode not in ("bitflip", "truncate"):
+            raise ValueError(f"unknown corrupt_mode {corrupt_mode!r}")
         self.kind = kind
         self.remaining = times  # None = unlimited
         self.delay_s = float(delay_s)
@@ -98,6 +122,8 @@ class FaultSpec:
         self.probability = probability
         self.schedule = frozenset(schedule) if schedule is not None else None
         self.match = match
+        self.corrupt_mode = corrupt_mode
+        self.seed = seed
         self._rng = random.Random(seed) if probability is not None else None
 
     def triggers(self, call_index: int, ctx: dict) -> bool:
@@ -123,6 +149,7 @@ class FaultRegistry:
         self._specs: dict[str, list[FaultSpec]] = {}
         self._calls: dict[str, int] = {}   # per-point call index
         self._fired: dict[str, int] = {}   # per-point fault count
+        self._fired_kinds: dict[str, int] = {}  # per-kind fault count
         self._fire_calls = 0               # total fire() entries (perf guard)
         self._gauges_registered = False
 
@@ -154,6 +181,7 @@ class FaultRegistry:
             self._specs.clear()
             self._calls.clear()
             self._fired.clear()
+            self._fired_kinds.clear()
         _set_active(False)
 
     # -- observability ------------------------------------------------------
@@ -162,6 +190,12 @@ class FaultRegistry:
             if point is not None:
                 return self._fired.get(point, 0)
             return sum(self._fired.values())
+
+    def fired_kind(self, kind: str) -> int:
+        """Faults fired with this kind across all points (the soak summary
+        separates corruptions injected from error/drop/delay faults)."""
+        with self._lock:
+            return self._fired_kinds.get(kind, 0)
 
     def total_fired(self) -> int:
         return self.fired()
@@ -209,7 +243,10 @@ class FaultRegistry:
             if spec.remaining is not None:
                 spec.remaining -= 1
             self._fired[point] = self._fired.get(point, 0) + 1
+            self._fired_kinds[spec.kind] = \
+                self._fired_kinds.get(spec.kind, 0) + 1
             kind, delay_s, message = spec.kind, spec.delay_s, spec.message
+            corrupt_mode, corrupt_seed = spec.corrupt_mode, spec.seed
         # apply OUTSIDE the lock: a delay must not serialize other points
         if kind == "delay":
             time.sleep(delay_s)
@@ -217,6 +254,12 @@ class FaultRegistry:
         if kind == "drop":
             raise InjectedDrop(message or
                                f"injected connection drop at {point}")
+        if kind == "corrupt":
+            # the call site catches this and applies corrupt_bytes to its
+            # payload; idx makes each strike of one spec mutate different
+            # deterministic bytes
+            raise InjectedCorruption(point, corrupt_mode, corrupt_seed, idx,
+                                     message)
         if kind == "hbm_oom":
             # RESOURCE_EXHAUSTED text → engine/oom.py is_hbm_oom() classifies
             # it and with_oom_retry absorbs it through the REAL eviction+retry
@@ -261,3 +304,36 @@ def seed_schedule(seed: int, rate: float,
                    seed=seed ^ zlib.crc32(point.encode()))
         armed.append(point)
     return armed
+
+
+# -- corruption helpers -------------------------------------------------------
+
+
+def corrupt_bytes(data: bytes, mode: str = "bitflip", seed: int = 0,
+                  index: int = 0) -> bytes:
+    """Deterministically damage ``data``: flip one random bit (bitflip) or
+    cut the tail (truncate). Pure function of (data length, mode, seed,
+    index) — two runs with the same schedule corrupt identical bytes, so
+    detection/repair behavior is reproducible from the seed alone."""
+    if not data:
+        return data
+    rng = random.Random((seed << 20) ^ (index * 0x9E3779B1) ^ len(data))
+    if mode == "truncate":
+        # keep at least 1 byte and drop at least 1: always a REAL mutation
+        keep = rng.randrange(1, len(data)) if len(data) > 1 else 0
+        return bytes(data[:keep])
+    buf = bytearray(data)
+    pos = rng.randrange(len(buf))
+    buf[pos] ^= 1 << rng.randrange(8)
+    return bytes(buf)
+
+
+def corrupt_at(point: str, data: bytes, **ctx) -> bytes:
+    """Fire ``point``; if a corrupt fault strikes, return damaged bytes,
+    else return ``data`` unchanged. Non-corrupt faults armed at the point
+    propagate as usual. Only call behind ``if faults.ACTIVE``."""
+    try:
+        FAULTS.fire(point, **ctx)
+    except InjectedCorruption as c:
+        return corrupt_bytes(data, c.mode, c.seed, c.index)
+    return data

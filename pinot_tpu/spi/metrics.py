@@ -42,6 +42,10 @@ class ServerMeter:
     SEGMENT_CACHE_HITS = "segmentCacheHits"
     SEGMENT_CACHE_MISSES = "segmentCacheMisses"
     SEGMENT_CACHE_EVICTIONS = "segmentCacheEvictions"
+    # data-integrity pipeline (segment verify → quarantine → repair)
+    SEGMENT_CRC_MISMATCH = "segmentCrcMismatch"
+    SEGMENTS_QUARANTINED = "segmentsQuarantined"
+    SEGMENT_REPAIRS = "segmentRepairs"
 
 
 class BrokerMeter:
@@ -60,6 +64,9 @@ class BrokerMeter:
     HEDGE_WINS = "hedgeWins"
     CIRCUIT_OPEN = "circuitOpenCount"
     QUERIES_REJECTED = "queriesRejected"
+    # wire-integrity: scatter responses whose DataTable checksum failed
+    # (each one is reclassified as a connection failure and retried)
+    DATATABLE_CORRUPTIONS = "datatableCorruptions"
 
 
 class ServerTimer:

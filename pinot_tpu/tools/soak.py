@@ -356,7 +356,7 @@ def soak_sql(seconds: float = 60.0, seed: int = 0, rows: int = 1600,
 def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
                replication: int = 2, n_segments: int = 6,
                rows_per_segment: int = 400, fault_rate: float = 0.0,
-               progress=None) -> dict:
+               corrupt_rate: float = 0.0, progress=None) -> dict:
     """ChaosMonkey soak: continuous exact-result broker queries while
     servers die/restart, RebalanceChecker heals, and minion merge-rollup
     compacts concurrently. Returns counters.
@@ -367,10 +367,18 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
     allowPartialResults=true and the invariant relaxes from "exact,
     always" to "exact OR well-formed partial/error, never silent
     corruption": a full (non-partial, non-error) response must still
-    match the oracle bit-for-bit."""
+    match the oracle bit-for-bit.
+
+    ``corrupt_rate`` > 0 additionally arms a seeded ``corrupt`` schedule
+    (segment.load, transport.call, datatable.encode): bit-flips that MUST
+    be detected by the integrity layer — the summary reports corruptions
+    injected vs detected vs repaired, and the same exact-or-degraded
+    invariant holds (a silently wrong full answer is a failure)."""
     from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
                                    ServerInstance)
     from pinot_tpu.spi import faults
+    from pinot_tpu.spi.metrics import (BROKER_METRICS, SERVER_METRICS,
+                                       BrokerMeter, ServerMeter)
     from pinot_tpu.cluster.periodic import RebalanceChecker
     from pinot_tpu.minion import MinionInstance, PinotTaskManager
     from pinot_tpu.segment.builder import SegmentBuilder
@@ -435,6 +443,28 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
         if progress:
             progress(f"chaos: armed fault schedule on {sorted(armed)} "
                      f"(rate={fault_rate}, seed={seed})")
+    integrity0 = None
+    if corrupt_rate > 0:
+        # distinct derived seed: corruption strikes stay decorrelated from
+        # the error/drop schedule above while both reproduce from --seed
+        armed_c = faults.seed_schedule(
+            seed + 0x5EED, corrupt_rate, kind="corrupt",
+            points=("segment.load", "transport.call", "datatable.encode"))
+        if fault_rate <= 0:
+            sql = ("SET allowPartialResults=true; SET resultCache=false; "
+                   + sql)
+            stats["faulted_queries"] = 0
+        integrity0 = {
+            "crc": SERVER_METRICS.meter_count(
+                ServerMeter.SEGMENT_CRC_MISMATCH),
+            "wire": BROKER_METRICS.meter_count(
+                BrokerMeter.DATATABLE_CORRUPTIONS),
+            "repairs": SERVER_METRICS.meter_count(
+                ServerMeter.SEGMENT_REPAIRS),
+        }
+        if progress:
+            progress(f"chaos: armed corrupt schedule on {sorted(armed_c)} "
+                     f"(rate={corrupt_rate}, seed={seed})")
     down: list[str] = []
     t0 = time.time()
     try:
@@ -444,7 +474,7 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
             # silently wrong full answer
             resp = broker.execute_sql(sql)
             if resp.exceptions:
-                if fault_rate > 0:
+                if fault_rate > 0 or corrupt_rate > 0:
                     stats["faulted_queries"] += 1
                     stats["queries"] += 1
                     continue
@@ -483,7 +513,22 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
             if progress and stats["queries"] % 500 == 0:
                 progress(f"chaos: {stats}")
     finally:
-        if fault_rate > 0:
+        if corrupt_rate > 0 and integrity0 is not None:
+            # the integrity ledger: every injected corruption must show up
+            # as a detection (load-verify or wire checksum), and repairs +
+            # replica retries say how many healed
+            stats["corruptions"] = {
+                "injected": faults.FAULTS.fired_kind("corrupt"),
+                "detected": (SERVER_METRICS.meter_count(
+                                 ServerMeter.SEGMENT_CRC_MISMATCH)
+                             - integrity0["crc"])
+                            + (BROKER_METRICS.meter_count(
+                                   BrokerMeter.DATATABLE_CORRUPTIONS)
+                               - integrity0["wire"]),
+                "repaired": SERVER_METRICS.meter_count(
+                    ServerMeter.SEGMENT_REPAIRS) - integrity0["repairs"],
+            }
+        if fault_rate > 0 or corrupt_rate > 0:
             stats["injected_faults"] = faults.FAULTS.total_fired()
             faults.FAULTS.reset()
         for s in servers.values():
@@ -505,8 +550,8 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
 def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
              concurrency: int = 8, n_servers: int = 3, replication: int = 2,
              n_segments: int = 6, rows_per_segment: int = 400,
-             fault_rate: float = 0.0, max_inflight: int = 0,
-             progress=None) -> dict:
+             fault_rate: float = 0.0, corrupt_rate: float = 0.0,
+             max_inflight: int = 0, progress=None) -> dict:
     """Closed-loop QPS soak: ``concurrency`` workers pace an aggregate
     ``qps`` arrival rate of exact-result queries against an embedded
     cluster, reporting p50/p99 latency under load, achieved QPS, and the
@@ -515,9 +560,12 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
     The invariant matches the chaos suite's: every full response must be
     exact; with ``fault_rate`` > 0 (seeded schedule over transport.call +
     server.query) a response may instead be a WELL-FORMED partial/error —
-    never silently wrong. ``max_inflight`` > 0 additionally arms broker
-    admission control, so overload sheds as queryRejected=true responses
-    (counted, not failed)."""
+    never silently wrong. ``corrupt_rate`` > 0 arms a seeded ``corrupt``
+    schedule on the wire points (transport.call, datatable.encode) — every
+    strike must be absorbed by the DataTable checksum + replica retry, so
+    full answers stay bit-exact under corruption. ``max_inflight`` > 0
+    additionally arms broker admission control, so overload sheds as
+    queryRejected=true responses (counted, not failed)."""
     import threading
 
     from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
@@ -572,10 +620,17 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
         faults.seed_schedule(seed, fault_rate,
                              points=("transport.call", "server.query"))
         sql = "SET allowPartialResults=true; " + sql
+    if corrupt_rate > 0:
+        # wire points only: this suite never restarts servers, so a
+        # segment.load strike would have nothing to hit
+        faults.seed_schedule(seed + 0x5EED, corrupt_rate, kind="corrupt",
+                             points=("transport.call", "datatable.encode"))
+        if fault_rate <= 0:
+            sql = "SET allowPartialResults=true; " + sql
     meters0 = {m: BROKER_METRICS.meter_count(m) for m in (
         BrokerMeter.SCATTER_RETRIES, BrokerMeter.HEDGED_REQUESTS,
         BrokerMeter.HEDGE_WINS, BrokerMeter.QUERIES_REJECTED,
-        BrokerMeter.CIRCUIT_OPEN)}
+        BrokerMeter.CIRCUIT_OPEN, BrokerMeter.DATATABLE_CORRUPTIONS)}
 
     lock = threading.Lock()
     state = {"next": 0, "ok": 0, "degraded": 0, "rejected": 0}
@@ -603,7 +658,7 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
                     state["rejected"] += 1
                 continue
             if resp.exceptions and not resp.partial_result:
-                if fault_rate > 0:
+                if fault_rate > 0 or corrupt_rate > 0:
                     with lock:
                         state["degraded"] += 1
                         latencies.append(lat_ms)
@@ -635,7 +690,8 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
         for t in threads:
             t.join()
     finally:
-        if fault_rate > 0:
+        corruptions_injected = faults.FAULTS.fired_kind("corrupt")
+        if fault_rate > 0 or corrupt_rate > 0:
             faults.FAULTS.reset()
         for s in servers:
             try:
@@ -664,6 +720,12 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
         "rejected_meter": meters[BrokerMeter.QUERIES_REJECTED],
         "circuit_opened": meters[BrokerMeter.CIRCUIT_OPEN],
     }
+    if corrupt_rate > 0:
+        out["corruptions"] = {
+            "injected": corruptions_injected,
+            "detected": meters[BrokerMeter.DATATABLE_CORRUPTIONS],
+            "retried": meters[BrokerMeter.DATATABLE_CORRUPTIONS],
+        }
     if progress:
         progress(f"qps: {out}")
     return out
@@ -817,6 +879,13 @@ def main(argv=None) -> int:
                         "(partial/error) responses are counted as "
                         "faulted_queries instead of failing the soak — "
                         "full responses must still match exactly")
+    p.add_argument("--corrupt-rate", type=float, default=0.0,
+                   help="chaos/qps suites: probability (0..1) of a seeded "
+                        "data CORRUPTION per call (segment.load, "
+                        "transport.call, datatable.encode). The integrity "
+                        "layer must detect every strike — the summary "
+                        "reports corruptions injected/detected/repaired, "
+                        "and a silently wrong full answer fails the soak")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -834,11 +903,13 @@ def main(argv=None) -> int:
         if args.suite in ("chaos", "all"):
             results.append(soak_chaos(
                 seconds=args.seconds, seed=args.seed,
-                fault_rate=args.fault_rate, progress=progress))
+                fault_rate=args.fault_rate,
+                corrupt_rate=args.corrupt_rate, progress=progress))
         if args.suite == "qps":
             results.append(soak_qps(
                 seconds=args.seconds, seed=args.seed, qps=args.qps,
                 concurrency=args.concurrency, fault_rate=args.fault_rate,
+                corrupt_rate=args.corrupt_rate,
                 max_inflight=args.max_inflight, progress=progress))
         if args.suite in ("realtime", "all"):
             results.append(soak_realtime(

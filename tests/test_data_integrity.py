@@ -1,0 +1,494 @@
+"""End-to-end data integrity: detect → quarantine → repair.
+
+The pipeline under test (PAPER.md robustness goals; reference analogues:
+Pinot's segment CRC validation on load + Helix ERROR state +
+RealtimeSegmentValidationManager repair kicks):
+
+  1. builders stamp per-buffer/per-column crcs next to the whole-segment
+     crc; loaders verify ONCE at load (opt-out PINOT_TPU_VERIFY_CRC);
+  2. the DataTable wire format carries a magic-tagged crc32 trailer
+     (header version unchanged — old readers ignore it) checked at
+     broker decode — a corrupt shard is reclassified as a connection
+     failure so the replica-retry layer heals it transparently;
+  3. a server failing load-verify quarantines the replica (ERROR in the
+     external view, excluded from routing) and self-repairs from deep
+     store; the controller's SegmentIntegrityChecker nudges stragglers.
+
+The invariant everywhere: a query result is exact or well-formed
+degraded — never silently wrong.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import time
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                               ServerInstance)
+from pinot_tpu.cluster import datatable as dt
+from pinot_tpu.cluster.controller import ERROR, ONLINE
+from pinot_tpu.cluster.periodic import SegmentIntegrityChecker
+from pinot_tpu.engine.results import AggIntermediate
+from pinot_tpu.segment import loader as seg_loader
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.format import DATA_FILE, SegmentMetadata
+from pinot_tpu.segment.loader import (SegmentIntegrityError, load_segment,
+                                      verify_enabled)
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.metrics import (BROKER_METRICS, SERVER_METRICS,
+                                   BrokerMeter, ServerMeter)
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "distats",
+    dimensions=[("team", "STRING"), ("year", "INT")],
+    metrics=[("runs", "INT")])
+TEAMS = ["BOS", "NYA", "SFN", "LAN"]
+N_SEGMENTS = 6
+ROWS = 80
+NOCACHE = "SET resultCache = false; SET segmentCache = false; "
+SQL = "SELECT team, SUM(runs) FROM distats GROUP BY team LIMIT 20"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.FAULTS.reset()
+
+
+def _build_segment(d: Path, name: str, rng) -> tuple[Path, dict]:
+    cols = {
+        "team": np.asarray(TEAMS, dtype=object)[
+            rng.integers(0, len(TEAMS), ROWS)],
+        "year": rng.integers(2000, 2010, ROWS).astype(np.int32),
+        "runs": rng.integers(0, 100, ROWS).astype(np.int32),
+    }
+    SegmentBuilder(SCHEMA, segment_name=name).build(cols, d / name)
+    sums: dict[str, int] = {}
+    for t, r in zip(cols["team"], cols["runs"]):
+        sums[t] = sums.get(t, 0) + int(r)
+    return d / name, sums
+
+
+# ══════════════════════════════════════════════════════════════════════════
+# layer 1: build-time checksums + load-time verification
+# ══════════════════════════════════════════════════════════════════════════
+
+
+def test_builder_stamps_buffer_and_column_crcs(tmp_path):
+    seg_dir, _ = _build_segment(tmp_path, "s0", np.random.default_rng(1))
+    meta = SegmentMetadata.from_json_file(seg_dir / "metadata.json") \
+        if hasattr(SegmentMetadata, "from_json_file") else None
+    if meta is None:
+        import json
+
+        meta = SegmentMetadata.from_json(
+            json.loads((seg_dir / "metadata.json").read_text()))
+    assert meta.crc is not None
+    # every buffer carries its own crc, every column a rolled-up one
+    assert set(meta.buffer_crcs) == set(meta.buffers)
+    assert set(meta.column_crcs) == {"team", "year", "runs"}
+    data = (seg_dir / DATA_FILE).read_bytes()
+    for name, (off, size, *_rest) in meta.buffers.items():
+        assert format(zlib.crc32(data[off:off + size]), "08x") \
+            == meta.buffer_crcs[name]
+    # round-trip through to_json preserves the new fields
+    again = SegmentMetadata.from_json(meta.to_json())
+    assert again.buffer_crcs == meta.buffer_crcs
+    assert again.column_crcs == meta.column_crcs
+    # and the verified load succeeds
+    seg = load_segment(seg_dir)
+    assert seg.num_docs == ROWS
+
+
+def test_bitflip_detected_and_damaged_column_named(tmp_path):
+    seg_dir, _ = _build_segment(tmp_path, "s1", np.random.default_rng(2))
+    import json
+
+    meta = json.loads((seg_dir / "metadata.json").read_text())
+    # flip one bit inside the runs forward buffer specifically
+    target = next(n for n in meta["buffers"] if n.startswith("runs."))
+    off, size = meta["buffers"][target][:2]
+    raw = bytearray((seg_dir / DATA_FILE).read_bytes())
+    raw[off + size // 2] ^= 0x01
+    (seg_dir / DATA_FILE).write_bytes(bytes(raw))
+
+    with pytest.raises(SegmentIntegrityError) as ei:
+        load_segment(seg_dir)
+    assert "runs" in ei.value.columns
+    assert "crc mismatch" in str(ei.value)
+
+
+def test_truncation_detected(tmp_path):
+    seg_dir, _ = _build_segment(tmp_path, "s2", np.random.default_rng(3))
+    raw = (seg_dir / DATA_FILE).read_bytes()
+    (seg_dir / DATA_FILE).write_bytes(raw[: len(raw) // 2])
+    with pytest.raises(SegmentIntegrityError, match="truncated"):
+        load_segment(seg_dir)
+
+
+def test_verify_opt_out_env(tmp_path, monkeypatch):
+    seg_dir, _ = _build_segment(tmp_path, "s3", np.random.default_rng(4))
+    raw = bytearray((seg_dir / DATA_FILE).read_bytes())
+    raw[0] ^= 0xFF
+    (seg_dir / DATA_FILE).write_bytes(bytes(raw))
+    with pytest.raises(SegmentIntegrityError):
+        load_segment(seg_dir)
+    monkeypatch.setenv("PINOT_TPU_VERIFY_CRC", "false")
+    assert not verify_enabled()
+    load_segment(seg_dir)  # opt-out: the damaged segment loads
+    # explicit verify flag overrides the env in both directions
+    with pytest.raises(SegmentIntegrityError):
+        load_segment(seg_dir, verify=True)
+
+
+# ══════════════════════════════════════════════════════════════════════════
+# layer 2: DataTable wire checksum (magic-tagged trailer)
+# ══════════════════════════════════════════════════════════════════════════
+
+
+def test_datatable_trailer_roundtrip_and_detection():
+    import struct
+
+    blob = dt.encode(AggIntermediate(states=[42]), {"total_docs": 7})
+    # rolling-upgrade invariant: the trailer rides on an UNCHANGED header
+    # version, tagged by its own magic — old readers (which ignore
+    # trailing bytes) keep decoding new payloads (test_upgrade_matrix)
+    assert struct.unpack_from("<H", blob, 4)[0] == dt.VERSION
+    assert blob.endswith(dt.TRAILER_MAGIC)
+    assert dt.verify_blob(blob)
+    combined, stats = dt.decode(blob)
+    assert combined.states == [42] and stats["total_docs"] == 7
+
+    # any flipped bit in the body breaks the trailer check
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0x10
+    assert not dt.verify_blob(bytes(bad))
+    with pytest.raises(dt.DataTableCorruptionError, match="checksum"):
+        dt.decode(bytes(bad))
+    # mid-body truncation loses the trailer magic, so it frames as a
+    # legacy payload — the structural parse catches it instead (which is
+    # why the broker decodes at the scatter edge, not just crc-checks)
+    with pytest.raises(dt.DataTableCorruptionError, match="truncated"):
+        dt.decode(blob[: len(blob) // 2])
+
+
+def test_datatable_legacy_blob_still_decodes():
+    """Old-writer/new-reader: a pre-trailer blob (rolling upgrade)
+    decodes and passes verify_blob (nothing to check)."""
+    blob = dt.encode(AggIntermediate(states=[5]), {"total_docs": 1})
+    legacy = blob[:-8]  # strip the tagged trailer
+    assert dt.verify_blob(legacy)
+    combined, stats = dt.decode(legacy)
+    assert combined.states == [5]
+
+
+def test_corrupt_bytes_deterministic():
+    data = bytes(range(256)) * 4
+    a = faults.corrupt_bytes(data, "bitflip", seed=9, index=2)
+    b = faults.corrupt_bytes(data, "bitflip", seed=9, index=2)
+    assert a == b and a != data and len(a) == len(data)
+    c = faults.corrupt_bytes(data, "bitflip", seed=9, index=3)
+    assert c != a  # strike index varies the damage
+    t = faults.corrupt_bytes(data, "truncate", seed=9, index=2)
+    assert len(t) < len(data) and data.startswith(t)
+
+
+# ══════════════════════════════════════════════════════════════════════════
+# layers 3+4 e2e: cluster with a tar deep store
+# ══════════════════════════════════════════════════════════════════════════
+
+
+@pytest.fixture(scope="module")
+def integrity_cluster(tmp_path_factory):
+    # auto-repair off: tests drive repair explicitly (deterministic order)
+    saved = {k: os.environ.get(k) for k in
+             ("PINOT_TPU_AUTO_REPAIR", "PINOT_TPU_REPAIR_BACKOFF_MS")}
+    os.environ["PINOT_TPU_AUTO_REPAIR"] = "false"
+    os.environ["PINOT_TPU_REPAIR_BACKOFF_MS"] = "1"
+    d = tmp_path_factory.mktemp("integrity")
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = {f"Server_{i}": ServerInstance(store, f"Server_{i}",
+                                             backend="host")
+               for i in range(3)}
+    for s in servers.values():
+        s.start()
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    table = controller.create_table({"tableName": "distats",
+                                     "replication": 2})
+    rng = np.random.default_rng(20260805)
+    truth: dict[str, int] = {}
+    for i in range(N_SEGMENTS):
+        name = f"distats_{i}"
+        seg_dir, sums = _build_segment(d, name, rng)
+        # tar deep store: repair re-fetches a FRESH copy from the tar —
+        # with a plain-dir location there would be nothing to heal from
+        tar = d / f"{name}.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(seg_dir, arcname=name)
+        controller.add_segment(table, name,
+                               {"location": str(tar), "numDocs": ROWS})
+        for t, v in sums.items():
+            truth[t] = truth.get(t, 0) + v
+    resp = broker.execute_sql(NOCACHE + SQL)
+    assert not resp.exceptions
+    assert {r[0]: r[1] for r in resp.result_table.rows} == truth
+    yield store, controller, servers, broker, table, truth
+    for s in servers.values():
+        try:
+            s.stop()
+        except Exception:
+            pass
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _exact(broker, truth):
+    resp = broker.execute_sql(NOCACHE + SQL)
+    assert not resp.exceptions, resp.exceptions
+    assert {r[0]: r[1] for r in resp.result_table.rows} == truth
+    return resp
+
+
+def test_wire_corruption_heals_bit_identical(integrity_cluster):
+    """A corrupt DataTable (damaged at encode) is caught by the broker's
+    checksum, reclassified as a connection failure, and the shard retries
+    on another replica — final answer bit-identical to the fault-free
+    run, with the healing visible on the response."""
+    _, _, _, broker, _, truth = integrity_cluster
+    wire0 = BROKER_METRICS.meter_count(BrokerMeter.DATATABLE_CORRUPTIONS)
+    faults.FAULTS.arm("datatable.encode", kind="corrupt", times=1)
+    resp = _exact(broker, truth)
+    assert faults.FAULTS.fired("datatable.encode") == 1
+    assert resp.num_corrupt_shards_retried == 1
+    assert resp.to_json()["numCorruptShardsRetried"] == 1
+    assert BROKER_METRICS.meter_count(BrokerMeter.DATATABLE_CORRUPTIONS) \
+        == wire0 + 1
+
+
+def test_transport_corruption_heals_bit_identical(integrity_cluster):
+    """Same invariant when the damage happens in flight (transport.call):
+    the RPC completes, the payload bytes are garbled, the checksum
+    catches it."""
+    _, _, _, broker, _, truth = integrity_cluster
+    faults.FAULTS.arm("transport.call", kind="corrupt", times=1)
+    resp = _exact(broker, truth)
+    assert faults.FAULTS.fired("transport.call") == 1
+    assert resp.num_corrupt_shards_retried == 1
+
+
+def test_truncate_mode_on_the_wire_also_heals(integrity_cluster):
+    _, _, _, broker, _, truth = integrity_cluster
+    faults.FAULTS.arm("datatable.encode", kind="corrupt",
+                      corrupt_mode="truncate", times=1)
+    resp = _exact(broker, truth)
+    assert resp.num_corrupt_shards_retried == 1
+
+
+def test_restart_reload_quarantines_then_repairs(integrity_cluster):
+    """The restart-reload scenario end-to-end: a server restarts onto a
+    corrupted local segment copy → it rejoins advertising only VERIFIED
+    segments (the bad one is ERROR, not ONLINE), queries stay exact off
+    the healthy replica, then repair re-fetches from deep store and the
+    segment reappears ONLINE."""
+    store, _, servers, broker, table, truth = integrity_cluster
+    _exact(broker, truth)  # before
+
+    victim = "Server_0"
+    servers[victim].stop()
+    _exact(broker, truth)  # down: the other replica covers every segment
+
+    crc0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENT_CRC_MISMATCH)
+    q0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENTS_QUARANTINED)
+    r0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENT_REPAIRS)
+    faults.FAULTS.arm("segment.load", kind="corrupt", times=1)
+    s = ServerInstance(store, victim, backend="host")
+    s.start()
+    servers[victim] = s
+    assert faults.FAULTS.fired("segment.load") == 1
+    assert SERVER_METRICS.meter_count(ServerMeter.SEGMENT_CRC_MISMATCH) \
+        == crc0 + 1
+    assert SERVER_METRICS.meter_count(ServerMeter.SEGMENTS_QUARANTINED) \
+        == q0 + 1
+
+    # exactly one quarantined replica, advertised ERROR (never ONLINE)
+    dbg = s.debug_segments()[table]
+    assert len(dbg["quarantined"]) == 1
+    bad_seg, entry = next(iter(dbg["quarantined"].items()))
+    assert "integrity" in entry["reason"]
+    assert bad_seg not in dbg["served"]
+    view = store.get(f"/EXTERNALVIEW/{table}")
+    assert view[bad_seg][victim] == ERROR
+    online = {seg for seg, m in view.items() if m.get(victim) == ONLINE}
+    assert bad_seg not in online and len(online) > 0
+
+    _exact(broker, truth)  # during: healthy replica serves the bad segment
+
+    # repair: fresh deep-store fetch, re-verify, rejoin
+    assert s.repair_segment(table, bad_seg) is True
+    assert SERVER_METRICS.meter_count(ServerMeter.SEGMENT_REPAIRS) == r0 + 1
+    view = store.get(f"/EXTERNALVIEW/{table}")
+    assert view[bad_seg][victim] == ONLINE
+    assert not s.debug_segments()[table]["quarantined"]
+    _exact(broker, truth)  # after
+
+
+def test_integrity_checker_nudges_repair(integrity_cluster):
+    """The controller periodic task notices the ERROR replica and writes a
+    /REPAIRS nudge; the owning server answers it (even with auto-repair
+    off — an explicit nudge IS the ask) and the view heals."""
+    store, controller, servers, broker, table, truth = integrity_cluster
+    victim = "Server_1"
+    servers[victim].stop()
+    faults.FAULTS.arm("segment.load", kind="corrupt", times=1)
+    s = ServerInstance(store, victim, backend="host")
+    s.start()
+    servers[victim] = s
+    bad_seg = next(iter(s.debug_segments()[table]["quarantined"]))
+    faults.FAULTS.reset()  # repair must see a clean deep store
+
+    checker = SegmentIntegrityChecker(store, controller)
+    report = checker()
+    assert report[table]["erroredReplicas"] == {bad_seg: [victim]}
+    # the nudge repaired synchronously through the server's /REPAIRS watch
+    view = store.get(f"/EXTERNALVIEW/{table}")
+    assert view[bad_seg][victim] == ONLINE
+    assert not s.debug_segments()[table]["quarantined"]
+    _exact(broker, truth)
+    # a follow-up sweep cleans the nudge and the integrity report
+    report = checker()
+    assert not report[table]["erroredReplicas"]
+    assert store.children(f"/REPAIRS/{table}") == []
+    assert store.get(f"/INTEGRITY/{table}") is None
+
+
+def test_unrepairable_flag_and_recovery(integrity_cluster):
+    """Repair retries are bounded: when every re-fetch keeps failing
+    verification (deep-store copy itself bad), the replica is flagged
+    unrepairable instead of looping — and a later clean repair clears
+    it."""
+    store, _, servers, broker, table, truth = integrity_cluster
+    victim = "Server_2"
+    servers[victim].stop()
+    faults.FAULTS.arm("segment.load", kind="corrupt", times=1)
+    s = ServerInstance(store, victim, backend="host")
+    s.start()
+    servers[victim] = s
+    bad_seg = next(iter(s.debug_segments()[table]["quarantined"]))
+
+    # keep corrupting: every repair attempt fails its re-verify
+    faults.FAULTS.reset()
+    faults.FAULTS.arm("segment.load", kind="corrupt", times=None,
+                      probability=1.0, seed=7)
+    assert s.repair_segment(table, bad_seg) is False
+    entry = s.debug_segments()[table]["quarantined"][bad_seg]
+    assert entry["unrepairable"] is True
+    assert entry["repairAttempts"] >= 3
+    _exact(broker, truth)  # still exact off the healthy replica
+
+    faults.FAULTS.reset()
+    assert s.repair_segment(table, bad_seg) is True
+    assert store.get(f"/EXTERNALVIEW/{table}")[bad_seg][victim] == ONLINE
+    _exact(broker, truth)
+
+
+def test_verification_pinned_to_load_time(integrity_cluster):
+    """Perf guard: the warm query path does ZERO segment re-verification —
+    loader.VERIFY_CALLS must not move across queries (verification cost
+    is paid once, at load)."""
+    _, _, _, broker, _, truth = integrity_cluster
+    _exact(broker, truth)  # warm
+    before = seg_loader.VERIFY_CALLS
+    for _ in range(3):
+        _exact(broker, truth)
+    assert seg_loader.VERIFY_CALLS == before, (
+        "segment verification ran on the warm query path — it must be "
+        "load-time only")
+
+
+def test_degraded_table_falls_back_to_partial(tmp_path):
+    """Replication 1 + an unrepairable quarantined segment: queries with
+    allowPartialResults=true degrade to a well-formed partial (the other
+    segments' exact rows + an exception naming the hole) — never a
+    silently wrong full answer."""
+    os.environ["PINOT_TPU_AUTO_REPAIR"] = "false"
+    try:
+        store = PropertyStore()
+        controller = ClusterController(store)
+        broker = Broker(store)
+        controller.add_schema(SCHEMA.to_json())
+        s = ServerInstance(store, "Server_0", backend="host")
+        s.start()
+        try:
+            table = controller.create_table({"tableName": "distats",
+                                             "replication": 1})
+            rng = np.random.default_rng(5)
+            sums_by_seg = {}
+            faults.FAULTS.arm("segment.load", kind="corrupt", times=1)
+            for i in range(2):
+                name = f"distats_{i}"
+                _, sums = _build_segment(tmp_path, name, rng)
+                controller.add_segment(
+                    table, name,
+                    {"location": str(tmp_path / name), "numDocs": ROWS})
+                sums_by_seg[name] = sums
+            dbg = s.debug_segments()[table]
+            assert len(dbg["quarantined"]) == 1
+            bad_seg = next(iter(dbg["quarantined"]))
+            resp = broker.execute_sql(
+                "SET allowPartialResults=true; " + NOCACHE + SQL)
+            assert resp.partial_result is True
+            assert any(bad_seg in e for e in resp.exceptions)
+            good = next(n for n in sums_by_seg if n != bad_seg)
+            assert {r[0]: r[1] for r in resp.result_table.rows} \
+                == sums_by_seg[good]
+            # without partial consent the query fails loudly instead
+            resp = broker.execute_sql("SET allowPartialResults=false; "
+                                      + NOCACHE + SQL)
+            assert resp.exceptions and resp.result_table is None
+        finally:
+            s.stop()
+    finally:
+        os.environ.pop("PINOT_TPU_AUTO_REPAIR", None)
+
+
+def test_load_fault_transient_vs_integrity_paths(tmp_path):
+    """A transient (non-integrity) load failure must NOT quarantine: the
+    segment simply stays unadvertised and retries on the next converge —
+    while an integrity failure goes to ERROR + quarantine."""
+    store = PropertyStore()
+    controller = ClusterController(store)
+    broker = Broker(store)
+    controller.add_schema(SCHEMA.to_json())
+    s = ServerInstance(store, "Server_0", backend="host")
+    s.start()
+    try:
+        table = controller.create_table({"tableName": "distats",
+                                         "replication": 1})
+        name = "distats_0"
+        _build_segment(tmp_path, name, np.random.default_rng(6))
+        faults.FAULTS.arm("segment.load", kind="error", times=1)
+        controller.add_segment(table, name,
+                               {"location": str(tmp_path / name),
+                                "numDocs": ROWS})
+        # transient: no quarantine, no ERROR entry, nothing advertised
+        assert not s.debug_segments().get(table, {}).get("quarantined")
+        view = store.get(f"/EXTERNALVIEW/{table}") or {}
+        assert name not in view
+        # next converge (here: the controller nudge path) retries and loads
+        s._converge(table, store.get(f"/IDEALSTATES/{table}"))
+        view = store.get(f"/EXTERNALVIEW/{table}")
+        assert view[name]["Server_0"] == ONLINE
+    finally:
+        s.stop()
