@@ -164,6 +164,10 @@ class Broker:
         # delete or realtime commit makes old entries unreachable
         self.result_cache = BrokerResultCache()
         self._server_stats: dict[str, _ServerStats] = {}
+        # last successfully computed routing per table: a control-plane
+        # outage (store restarting, routing read glitching) must degrade to
+        # serving the last external view, not to a dead broker
+        self._last_routing: dict[str, dict[str, list[str]]] = {}
         self._clients: dict[str, RpcClient] = {}
         self._rr = 0  # round-robin cursor for replica selection
         self._pool = ThreadPoolExecutor(max_workers=num_scatter_threads,
@@ -173,7 +177,24 @@ class Broker:
     # -- routing ------------------------------------------------------------
     def routing_table(self, name_with_type: str) -> dict[str, list[str]]:
         """segment → online instances, from the external view (reference:
-        BrokerRoutingManager watching ExternalView)."""
+        BrokerRoutingManager watching ExternalView). A failed routing read
+        falls back to the last successful snapshot for the table (brokers
+        keep serving through a control-plane outage on the last external
+        view); with no snapshot yet the failure propagates."""
+        try:
+            out = self._routing_table_uncached(name_with_type)
+        except Exception:
+            with self._lock:
+                last = self._last_routing.get(name_with_type)
+            if last is None:
+                raise
+            BROKER_METRICS.add_meter(BrokerMeter.ROUTING_FROM_LAST_VIEW)
+            return {seg: list(insts) for seg, insts in last.items()}
+        with self._lock:
+            self._last_routing[name_with_type] = out
+        return out
+
+    def _routing_table_uncached(self, name_with_type: str) -> dict[str, list[str]]:
         from .periodic import hidden_from_lineage
 
         if faults.ACTIVE:

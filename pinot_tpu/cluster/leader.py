@@ -59,9 +59,13 @@ class LeadControllerManager:
             was = self._is_leader
             self._is_leader = False
         if was:
-            cur = self.store.get(LEADER_PATH)
-            if cur and cur.get("instance") == self.instance_id:
-                self.store.delete(LEADER_PATH)
+            # atomic conditional delete: a plain get→check→delete races
+            # with a concurrent session expiry + standby claim — the
+            # delete would land on the NEW leader's entry
+            self.store.delete_if(
+                LEADER_PATH,
+                lambda cur: isinstance(cur, dict)
+                and cur.get("instance") == self.instance_id)
             self._notify(False)
 
     @property

@@ -413,7 +413,10 @@ def build_default_scheduler(store: PropertyStore, controller: ClusterController,
                             leader=None) -> ControllerPeriodicTaskScheduler:
     """The standard job set (reference BaseControllerStarter wiring). Pass
     a LeadControllerManager so only the elected controller runs the jobs
-    when several controllers share a cluster."""
+    when several controllers share a cluster; defaults to the controller's
+    own elector when it was built with an instance_id."""
+    if leader is None:
+        leader = getattr(controller, "leader", None)
     sched = ControllerPeriodicTaskScheduler(leader=leader)
     sched.register("RetentionManager", interval_s,
                    lambda: controller.run_retention())

@@ -364,6 +364,7 @@ class ControllerRestServer(_RestServer):
                     "instances": srv.controller.list_instances(),
                     "live": srv.controller.live_instances()})),
                 (r"/cluster/summary", lambda h, m, q: srv._summary()),
+                (r"/debug/store", lambda h, m, q: srv._debug_store()),
                 (r"/tables/([^/]+)/rebalanceStatus",
                  lambda h, m, q: srv._rebalance_status(m.group(1))),
                 (r"/tables/([^/]+)/instancePartitions",
@@ -442,6 +443,20 @@ class ControllerRestServer(_RestServer):
     def _drop_segment(self, table: str, segment: str):
         self.controller.drop_segment(table_name_with_type(table), segment)
         return 200, {"status": f"segment {segment} dropped"}
+
+    def _debug_store(self):
+        """Control-plane durability introspection: journal/snapshot/recovery
+        state of the property store plus the current leader seat."""
+        from .leader import LEADER_PATH
+
+        store = self.controller.store
+        out = dict(store.durability_stats())
+        leader = store.get(LEADER_PATH)
+        out["leaderInstance"] = (leader or {}).get("instance")
+        out["thisInstance"] = getattr(self.controller, "instance_id", None)
+        out["isLeader"] = self.controller.is_leader() \
+            if hasattr(self.controller, "is_leader") else True
+        return 200, out
 
     def _rebalance_status(self, table: str):
         st = self.controller.rebalance_status(table_name_with_type(table))

@@ -416,7 +416,23 @@ class ServerInstance:
                 view.setdefault(seg, {})[self.instance_id] = ERROR
             return view
 
-        self.store.update(f"/EXTERNALVIEW/{table}", upd)
+        # a glitching control plane (injected store.write fault, CAS
+        # contention burst) must not abort convergence: retry briefly, then
+        # leave the old advertisement — the next converge republishes
+        from .store import StoreError
+
+        for attempt in range(4):
+            try:
+                self.store.update(f"/EXTERNALVIEW/{table}", upd)
+                return
+            except (StoreError, faults.InjectedFault):
+                if attempt == 3:
+                    log.warning("%s: external-view update for %s kept "
+                                "failing; serving stale view until next "
+                                "converge", self.instance_id, table,
+                                exc_info=True)
+                else:
+                    time.sleep(0.01 * (attempt + 1))
 
     # -- query plane --------------------------------------------------------
     def _handle(self, request):

@@ -160,6 +160,17 @@ class SegmentCompletionManager:
         with self._lock:
             fsm = self._fsms.get((table, segment))
             if fsm is None or fsm.state == COMMITTED:
+                # idempotent across controller failover: if the previous
+                # leader durably wrote THIS committer's DONE record but died
+                # before acking, the retried commit_end on the new leader
+                # (which has no FSM) must succeed, not fail — the outcome is
+                # decided by the store record, not by in-memory state
+                rec = self.store.get(f"/SEGMENTS/{table}/{segment}")
+                if (rec is not None and rec.get("status") == "DONE"
+                        and rec.get("committer") == instance
+                        and int(rec.get("endOffset", -1)) == offset):
+                    return CompletionResponse(COMMIT_SUCCESS, offset=offset,
+                                              location=rec.get("location"))
                 return CompletionResponse(FAILED)
             if instance != fsm.committer or offset != fsm.target_offset:
                 return CompletionResponse(FAILED)
@@ -194,3 +205,52 @@ class SegmentCompletionManager:
 
     def committed_record(self, table: str, segment: str) -> Optional[dict]:
         return self.store.get(f"/SEGMENTS/{table}/{segment}")
+
+
+class NoControllerLeaderError(Exception):
+    """No controller currently holds the leader seat (or the leader is not
+    resolvable to a live controller). Completion clients retry with capped
+    backoff — consumers HOLD through a controller outage, never ERROR."""
+
+
+class LeaderCompletionClient:
+    """Server-side completion stub that routes every protocol call to
+    whichever controller currently leads.
+
+    Reference: ServerSegmentCompletionProtocolHandler resolves the lead
+    controller per request (LeadControllerManager on the server side) and
+    raises/retries when no leader is up. ``resolver`` maps a leader
+    instance id to its live ``ClusterController`` (None when that
+    controller is dead — e.g. killed before its ephemeral leader entry
+    expired), standing in for the HTTP hop to the leader's REST port."""
+
+    def __init__(self, store, resolver):
+        self.store = store
+        self.resolver = resolver
+
+    def _manager(self):
+        from ..cluster.leader import LEADER_PATH
+
+        cur = self.store.get(LEADER_PATH)
+        if not isinstance(cur, dict) or not cur.get("instance"):
+            raise NoControllerLeaderError("no controller leader claimed")
+        inst = cur["instance"]
+        controller = self.resolver(inst)
+        if controller is None:
+            raise NoControllerLeaderError(f"leader {inst} not reachable")
+        mgr = controller.completion_manager()
+        if mgr is None:
+            raise NoControllerLeaderError(f"{inst} lost leadership")
+        return mgr
+
+    def segment_consumed(self, *args, **kw) -> CompletionResponse:
+        return self._manager().segment_consumed(*args, **kw)
+
+    def segment_commit_start(self, *args, **kw) -> CompletionResponse:
+        return self._manager().segment_commit_start(*args, **kw)
+
+    def extend_build_time(self, *args, **kw) -> bool:
+        return self._manager().extend_build_time(*args, **kw)
+
+    def segment_commit_end(self, *args, **kw) -> CompletionResponse:
+        return self._manager().segment_commit_end(*args, **kw)

@@ -230,6 +230,31 @@ class RealtimeSegmentDataManager:
             log.exception("commit of %s failed", self.segment.segment_name)
             self.state = ERROR
 
+    def _completion_call(self, fn):
+        """Run one completion-protocol call, retrying with capped backoff
+        through controller outages: a vacant leader seat
+        (NoControllerLeaderError) or a glitching store write keeps the
+        consumer HOLDing — never ERROR — until leadership is claimable
+        again (reference: ServerSegmentCompletionProtocolHandler retries
+        NOT_LEADER responses). Returns None only when stopped mid-wait."""
+        from ..cluster.store import StoreError
+        from .completion import NoControllerLeaderError
+
+        delay = 0.02
+        while not self._stop.is_set():
+            try:
+                return fn()
+            except NoControllerLeaderError:
+                from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+                SERVER_METRICS.add_meter(ServerMeter.COMPLETION_HOLDS_NO_LEADER)
+            except (StoreError, faults.InjectedFault):
+                log.warning("completion call failed transiently; retrying",
+                            exc_info=True)
+            self._stop.wait(delay)
+            delay = min(delay * 2, 2.0)
+        return None
+
     def _commit_via_protocol(self):
         """Replica-aware commit: segmentConsumed → HOLD/CATCHUP until the
         controller elects a committer; the winner builds + commits, losers
@@ -240,14 +265,20 @@ class RealtimeSegmentDataManager:
         table = self.table_config.table_name
         name = self.segment.segment_name
         while not self._stop.is_set():
-            resp = self.completion.segment_consumed(
-                table, name, self.instance_id, self.current_offset.offset)
+            resp = self._completion_call(lambda: self.completion.segment_consumed(
+                table, name, self.instance_id, self.current_offset.offset))
+            if resp is None:
+                break
             if resp.status == CATCHUP:
                 self._catchup(resp.offset)
                 continue
             if resp.status == COMMIT:
-                start = self.completion.segment_commit_start(
-                    table, name, self.instance_id, self.current_offset.offset)
+                start = self._completion_call(
+                    lambda: self.completion.segment_commit_start(
+                        table, name, self.instance_id,
+                        self.current_offset.offset))
+                if start is None:
+                    break
                 if start.status != CONTINUE:
                     continue
                 if self.on_elected is not None:
@@ -269,10 +300,13 @@ class RealtimeSegmentDataManager:
                 # (falling back from the name-with-type namespace to this
                 # completion-protocol one) to place colocated workers next
                 # to realtime segments
-                end = self.completion.segment_commit_end(
-                    table, name, self.instance_id,
-                    self.current_offset.offset, location,
-                    metadata=partition_push_metadata(location))
+                end = self._completion_call(
+                    lambda: self.completion.segment_commit_end(
+                        table, name, self.instance_id,
+                        self.current_offset.offset, location,
+                        metadata=partition_push_metadata(location)))
+                if end is None:
+                    break
                 if end.status == COMMIT_SUCCESS:
                     self.on_commit_success(self, location)
                     self.state = COMMITTED

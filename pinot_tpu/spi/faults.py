@@ -54,6 +54,8 @@ POINTS = (
     "store.write",       # PropertyStore.set / create_if_absent
     "broker.route",      # Broker.routing_table snapshot read
     "datatable.encode",  # ServerInstance._handle_query DataTable encode
+    "store.journal",     # PropertyStore WAL append (error = crash after
+                         # append before notify; corrupt = torn write)
 )
 
 

@@ -46,6 +46,9 @@ class ServerMeter:
     SEGMENT_CRC_MISMATCH = "segmentCrcMismatch"
     SEGMENTS_QUARANTINED = "segmentsQuarantined"
     SEGMENT_REPAIRS = "segmentRepairs"
+    # realtime completion protocol stalled on a vacant controller seat:
+    # each retry-while-no-leader backoff sleep bumps this (consumers HOLD)
+    COMPLETION_HOLDS_NO_LEADER = "completionHoldsNoLeader"
 
 
 class BrokerMeter:
@@ -67,6 +70,9 @@ class BrokerMeter:
     # wire-integrity: scatter responses whose DataTable checksum failed
     # (each one is reclassified as a connection failure and retried)
     DATATABLE_CORRUPTIONS = "datatableCorruptions"
+    # routing read failed; the query was served from the last good
+    # external-view snapshot (control-plane outage tolerance)
+    ROUTING_FROM_LAST_VIEW = "routingServedFromLastView"
 
 
 class ServerTimer:
@@ -86,6 +92,18 @@ class ServerGauge:
     DOCUMENT_COUNT = "documentCount"
     SEGMENT_COUNT = "segmentCount"
     UPSERT_PRIMARY_KEYS_COUNT = "upsertPrimaryKeysCount"
+
+
+class ControllerMeter:
+    # control-plane durability + failover (cluster/store.py, leader.py)
+    LEADER_CHANGES = "controllerLeaderChanges"
+    STORE_RECOVERIES = "storeRecoveries"
+    STORE_JOURNAL_TRUNCATIONS = "storeJournalTruncations"
+    STORE_SNAPSHOTS = "storeSnapshots"
+
+
+class ControllerGauge:
+    STORE_JOURNAL_BYTES = "storeJournalBytes"
 
 
 # log-bucketed histogram resolution: 4 buckets per power of two keeps the

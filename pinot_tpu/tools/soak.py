@@ -15,6 +15,11 @@ Three suites, each a pure function returning a stats dict, plus a CLI:
   realtime  repeated committer-crash/re-election rounds with zero row loss
             (reference: pinot-controller/src/test/.../realtime/
             SegmentCompletionTest.java, pauseless/LLC FSM).
+  failover  controller kills/restarts (leader handoff + leaderless
+            windows) over a durable property store mid qps+realtime
+            ingest: exact-or-degraded responses throughout, consumers
+            HOLD through outages, zero lost or duplicated committed
+            segments afterward.
 
 Default profile is a ~2-minute smoke across all suites:
 
@@ -843,6 +848,222 @@ def soak_realtime(rounds: int = 3, seed: int = 0, rows_per_round: int = 50,
 
 
 # ════════════════════════════════════════════════════════════════════════════
+# Suite 4: failover — controller kills/restarts mid qps+ingest
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def soak_failover(seconds: float = 30.0, seed: int = 0,
+                  rows_per_segment: int = 40, progress=None) -> dict:
+    """Controller chaos: continuous exact-result broker queries plus a
+    two-replica realtime ingest while the lead controller is killed and
+    restarted (including windows with NO claimable leader). Invariants:
+    exact-or-degraded-never-silently-wrong query responses throughout,
+    consumers HOLD (never ERROR) through leaderless windows, and zero lost
+    or duplicated committed segments at the end — every (partition, seq)
+    has exactly one DONE record and the committed doc total equals the
+    published row total."""
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+    from pinot_tpu.realtime.completion import LeaderCompletionClient
+    from pinot_tpu.realtime.manager import RealtimeTableDataManager
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi.data_types import Schema
+    from pinot_tpu.spi.stream import GLOBAL_STREAM_REGISTRY
+    from pinot_tpu.spi.table_config import (IngestionConfig,
+                                            SegmentsValidationConfig,
+                                            TableConfig, TableType)
+
+    rng = np.random.default_rng(seed)
+    t0 = time.time()
+    stats = {"queries": 0, "leader_kills": 0, "leader_restarts": 0,
+             "leaderless_windows": 0}
+    tmp = tempfile.TemporaryDirectory(prefix="pinot_soak_failover_")
+    d = Path(tmp.name)
+
+    # durable store: controller deaths must never cost control-plane state
+    store = PropertyStore(data_dir=str(d / "store"), fsync="off")
+    completion_cfg = {"num_replicas": 2, "commit_lease_s": 1.0,
+                      "decision_wait_s": 1.0}
+    live: dict[str, ClusterController] = {}
+    for cid in ("Ctrl_0", "Ctrl_1"):
+        live[cid] = ClusterController(store, instance_id=cid,
+                                      completion_config=completion_cfg)
+    controller = live["Ctrl_0"]  # any live one works for lifecycle calls
+
+    # offline query plane (controller death must not perturb it)
+    offline_schema = Schema.build(
+        "stats", dimensions=[("team", "STRING")], metrics=[("runs", "INT")])
+    controller.add_schema(offline_schema.to_json())
+    table = controller.create_table({"tableName": "stats", "replication": 2})
+    servers = [ServerInstance(store, f"Server_{i}", backend="host")
+               for i in range(3)]
+    for s in servers:
+        s.start()
+    broker = Broker(store)
+    teams = ["BOS", "NYA", "SFN", "LAN"]
+    expected = {}
+    for i in range(4):
+        n = 300
+        cols = {"team": np.asarray(teams, dtype=object)[
+                    rng.integers(0, len(teams), n)],
+                "runs": rng.integers(0, 100, n).astype(np.int32)}
+        SegmentBuilder(offline_schema, segment_name=f"stats_{i}").build(
+            cols, d / f"stats_{i}")
+        controller.add_segment(table, f"stats_{i}",
+                               {"location": str(d / f"stats_{i}"),
+                                "numDocs": n})
+        for t, r in zip(cols["team"], cols["runs"]):
+            expected[t] = expected.get(t, 0) + int(r)
+    sql = "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 20"
+
+    # realtime ingest through the leader-resolving completion client
+    rt_schema = Schema.build(
+        "events", dimensions=[("user", "STRING"), ("ts", "LONG")],
+        metrics=[("n", "INT")])
+    topic = f"soak_fo_{seed}_{int(t0 * 1000) % 100_000_000}"
+    GLOBAL_STREAM_REGISTRY.create_topic(topic, num_partitions=1)
+    rt_cfg = TableConfig(
+        table_name="events", table_type=TableType.REALTIME,
+        validation=SegmentsValidationConfig(time_column_name="ts"),
+        ingestion=IngestionConfig(stream_configs={
+            "streamType": "inmemory",
+            "stream.inmemory.topic.name": topic,
+            "realtime.segment.flush.threshold.rows": rows_per_segment,
+            # time-based flush sweeps up sub-threshold leftovers (the last
+            # partial segment after publishing stops would otherwise never
+            # commit); mid-run it just makes extra, smaller segments
+            "realtime.segment.flush.threshold.time.ms": 2000,
+        }))
+    client = LeaderCompletionClient(store, resolver=live.get)
+    rt_a = RealtimeTableDataManager(rt_schema, rt_cfg, d / "rt_a",
+                                    completion=client, instance_id="A")
+    rt_b = RealtimeTableDataManager(rt_schema, rt_cfg, d / "rt_b",
+                                    completion=client, instance_id="B")
+    rt_a.start()
+    rt_b.start()
+
+    def kill(cid: str) -> None:
+        """Crash, not resignation: the seat frees via session expiry."""
+        c = live.pop(cid)
+        c.leader.disconnect()
+        store.expire_session(cid)
+        c.leader.stop()  # release the watch; was-leader already cleared
+        stats["leader_kills"] += 1
+
+    def wait_until(pred, timeout=60.0):
+        t = time.time()
+        while time.time() - t < timeout:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False
+
+    published = 0
+    try:
+        while time.time() - t0 < seconds:
+            resp = broker.execute_sql(sql)
+            if resp.exceptions:
+                raise SoakFailure(
+                    f"query error during failover chaos (seed {seed}): "
+                    f"{resp.exceptions}")
+            got = {r[0]: r[1] for r in resp.result_table.rows}
+            if got != expected:
+                raise SoakFailure(
+                    f"wrong results during failover chaos (seed {seed}): "
+                    f"got {got} want {expected}")
+            stats["queries"] += 1
+
+            GLOBAL_STREAM_REGISTRY.publish(topic, [
+                {"user": f"u{(published + i) % 7}",
+                 "ts": 1_600_000_000_000 + published + i, "n": 1}
+                for i in range(10)])
+            published += 10
+
+            r = rng.random()
+            from pinot_tpu.cluster.leader import LEADER_PATH
+            leader = (store.get(LEADER_PATH) or {}).get("instance")
+            if r < 0.15 and leader in live:
+                kill(leader)
+                if not live:
+                    stats["leaderless_windows"] += 1
+                if rng.random() < 0.5 and len(live) == 1:
+                    # occasionally take the standby down too: a real
+                    # no-leader outage — consumers must HOLD through it
+                    kill(next(iter(live)))
+                    stats["leaderless_windows"] += 1
+                    time.sleep(0.2)
+            elif r < 0.30 and len(live) < 2:
+                cid = next(c for c in ("Ctrl_0", "Ctrl_1") if c not in live)
+                live[cid] = ClusterController(store, instance_id=cid,
+                                              completion_config=completion_cfg)
+                stats["leader_restarts"] += 1
+            time.sleep(0.02)
+
+        # drain: a leader must exist for the final flushes to finish
+        if not live:
+            live["Ctrl_0"] = ClusterController(store, instance_id="Ctrl_0",
+                                               completion_config=completion_cfg)
+            stats["leader_restarts"] += 1
+
+        def drained(mgr):
+            return sum(s.num_docs for s in mgr._committed) == published
+
+        if not (wait_until(lambda: drained(rt_a))
+                and wait_until(lambda: drained(rt_b))):
+            raise SoakFailure(
+                f"failover (seed {seed}): row loss — A committed "
+                f"{sum(s.num_docs for s in rt_a._committed)}, B committed "
+                f"{sum(s.num_docs for s in rt_b._committed)} of {published}")
+
+        # zero lost or duplicated committed segments: DONE records cover
+        # exactly seq 0..k-1 for partition 0 (a gap is a lost segment),
+        # every record is DONE, and each replica's committed list matches
+        # the store's DONE set one-to-one (a duplicate commit would show up
+        # as a repeated name, a lost one as a hole). Doc conservation
+        # (sum committed == published, checked above) rules out the same
+        # rows landing in two segments — segments flush at >= the row
+        # threshold, catching up past a leaderless window can legally
+        # overshoot it.
+        segs = sorted(store.children("/SEGMENTS/events"))
+        seqs = sorted(int(s.split("__")[2]) for s in segs)
+        if seqs != list(range(len(segs))):
+            raise SoakFailure(
+                f"failover (seed {seed}): committed seqs {seqs} have gaps "
+                "or duplicates")
+        for s in segs:
+            rec = store.get(f"/SEGMENTS/events/{s}")
+            if rec.get("status") != "DONE":
+                raise SoakFailure(f"failover (seed {seed}): {s} not DONE")
+        for tag, mgr in (("A", rt_a), ("B", rt_b)):
+            names = sorted(seg.name for seg in mgr._committed)
+            if names != segs:
+                raise SoakFailure(
+                    f"failover (seed {seed}): replica {tag} committed "
+                    f"{names}, store has {segs}")
+        for tag, mgr in (("A", rt_a), ("B", rt_b)):
+            if any(m.state == "ERROR" for m in mgr._consuming.values()):
+                raise SoakFailure(
+                    f"failover (seed {seed}): consumer {tag} reached ERROR "
+                    "— outages must HOLD, never ERROR")
+    finally:
+        rt_a.stop()
+        rt_b.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        for c in list(live.values()):
+            c.stop()
+        stats["store"] = store.durability_stats()
+        store.close()
+        tmp.cleanup()
+    stats.update({"suite": "failover", "published_rows": published,
+                  "elapsed_s": round(time.time() - t0, 1), "seed": seed})
+    return stats
+
+
+# ════════════════════════════════════════════════════════════════════════════
 # CLI
 # ════════════════════════════════════════════════════════════════════════════
 
@@ -851,7 +1072,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="pinot_tpu soak/chaos harness (committed, reproducible)")
     p.add_argument("--suite", choices=["sql", "chaos", "qps", "realtime",
-                                       "all"],
+                                       "failover", "all"],
                    default="all")
     p.add_argument("--seconds", type=float, default=45.0,
                    help="wall-clock budget per time-based suite "
@@ -914,6 +1135,9 @@ def main(argv=None) -> int:
         if args.suite in ("realtime", "all"):
             results.append(soak_realtime(
                 rounds=args.rounds, seed=args.seed, progress=progress))
+        if args.suite == "failover":
+            results.append(soak_failover(
+                seconds=args.seconds, seed=args.seed, progress=progress))
     except SoakFailure as e:
         failed = str(e)
 

@@ -1,0 +1,368 @@
+"""Controller leader failover: election, restart, kill-mid-commit e2e.
+
+Reference analogue: Helix controller failover — LeadControllerManager hands
+the seat to a standby when the leader's ZK session dies, periodic tasks and
+segment completion move with the seat, and in-flight segment commits finish
+exactly once because the durable DONE record (not the leader's in-memory
+FSM) is the idempotency anchor.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pinot_tpu.cluster.controller import ClusterController
+from pinot_tpu.cluster.leader import LEADER_PATH, LeadControllerManager
+from pinot_tpu.cluster.store import PropertyStore
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.realtime.completion import LeaderCompletionClient
+from pinot_tpu.realtime.manager import RealtimeTableDataManager
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.metrics import (
+    CONTROLLER_METRICS,
+    SERVER_METRICS,
+    ControllerMeter,
+    ServerMeter,
+)
+from pinot_tpu.spi.stream import InMemoryStreamRegistry
+from pinot_tpu.spi.table_config import (
+    IngestionConfig,
+    SegmentsValidationConfig,
+    TableConfig,
+    TableType,
+)
+
+SCHEMA = Schema.build(
+    "events",
+    dimensions=[("user", "STRING"), ("ts", "LONG")],
+    metrics=[("n", "INT")])
+
+COMPLETION_CFG = {"num_replicas": 2, "commit_lease_s": 2.0,
+                  "decision_wait_s": 1.0}
+
+
+def table_config(topic, flush_rows=40):
+    return TableConfig(
+        table_name="events",
+        table_type=TableType.REALTIME,
+        validation=SegmentsValidationConfig(time_column_name="ts"),
+        ingestion=IngestionConfig(stream_configs={
+            "streamType": "inmemory",
+            "stream.inmemory.topic.name": topic,
+            "realtime.segment.flush.threshold.rows": flush_rows,
+        }))
+
+
+def rows(n, start=0):
+    return [{"user": f"u{(start + i) % 5}", "ts": 1_600_000_000_000 + i,
+             "n": 1} for i in range(n)]
+
+
+def wait_until(pred, timeout=25.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def registry(monkeypatch):
+    reg = InMemoryStreamRegistry()
+    import pinot_tpu.spi.stream as stream_mod
+
+    monkeypatch.setattr(stream_mod, "GLOBAL_STREAM_REGISTRY", reg)
+    return reg
+
+
+def _kill(live, store, cid):
+    """Crash-death of a controller process: it vanishes from the resolver,
+    stops reacting to watches, and its ZK session expires."""
+    ctrl = live.pop(cid)
+    ctrl.leader.disconnect()
+    store.expire_session(cid)
+
+
+# -- election + leadership-gated hosting --------------------------------------
+
+
+def test_standby_claims_after_leader_death():
+    store = PropertyStore()
+    c1 = ClusterController(store, instance_id="c1",
+                           completion_config=COMPLETION_CFG)
+    c2 = ClusterController(store, instance_id="c2",
+                           completion_config=COMPLETION_CFG)
+    assert c1.is_leader() and not c2.is_leader()
+    assert store.get(LEADER_PATH) == {"instance": "c1"}
+
+    before = CONTROLLER_METRICS.meter_count(ControllerMeter.LEADER_CHANGES)
+    c1.leader.disconnect()
+    store.expire_session("c1")
+    assert c2.is_leader()
+    assert store.get(LEADER_PATH) == {"instance": "c2"}
+    assert CONTROLLER_METRICS.meter_count(
+        ControllerMeter.LEADER_CHANGES) > before
+    c2.stop()
+
+
+def test_completion_manager_is_leader_gated():
+    store = PropertyStore()
+    c1 = ClusterController(store, instance_id="c1",
+                           completion_config=COMPLETION_CFG)
+    c2 = ClusterController(store, instance_id="c2",
+                           completion_config=COMPLETION_CFG)
+    m1 = c1.completion_manager()
+    assert m1 is not None
+    assert c2.completion_manager() is None  # standby never hosts it
+
+    c1.leader.disconnect()
+    store.expire_session("c1")
+    m2 = c2.completion_manager()
+    assert m2 is not None
+    assert m2 is not m1  # the seat's FSMs don't follow the old process
+    assert c1.completion_manager() is None
+    c2.stop()
+
+
+def test_stop_resignation_does_not_delete_new_leaders_entry():
+    """The race delete_if closes: c1's graceful stop() runs AFTER its
+    session already expired and c2 claimed — a plain get→check→delete
+    would land on c2's fresh entry and dethrone the new leader."""
+    store = PropertyStore()
+    l1 = LeadControllerManager(store, "c1")
+    l1.start()
+    l2 = LeadControllerManager(store, "c2")
+    l2.start()
+    assert l1.is_leader
+
+    # the seat changes hands underneath l1 (session death + standby claim)
+    l1.disconnect()
+    store.expire_session("c1")
+    assert l2.is_leader
+    # ...but l1's shutdown path still carries the stale leader flag — the
+    # delete_if predicate, not that flag, must decide what gets deleted
+    with l1._lock:
+        l1._is_leader = True
+    l1.stop()
+    assert store.get(LEADER_PATH) == {"instance": "c2"}
+    assert l2.is_leader
+    l2.stop()
+
+
+def test_periodic_scheduler_follows_controller_leader():
+    from pinot_tpu.cluster.periodic import build_default_scheduler
+
+    store = PropertyStore()
+    c1 = ClusterController(store, instance_id="c1")
+    sched = build_default_scheduler(store, c1)
+    assert sched.leader is c1.leader
+    c1.stop()
+
+
+# -- durable restart ----------------------------------------------------------
+
+
+def test_controller_restart_recovers_control_plane_state(tmp_path):
+    store = PropertyStore(data_dir=str(tmp_path), fsync="off")
+    c1 = ClusterController(store, instance_id="c1",
+                           completion_config=COMPLETION_CFG)
+    store.set("/CONFIGS/TABLE/t_REALTIME", {"tableName": "t"})
+    store.set("/IDEALSTATES/t_REALTIME",
+              {"t__0__0__x": {"Server_0": "ONLINE"}})
+    store.set("/SEGMENTS/t/t__0__0__x",
+              {"status": "DONE", "committer": "A", "endOffset": "40",
+               "location": "/deep/t__0__0__x"})
+    store.set("/LIVEINSTANCES/Server_0", {"host": "h"},
+              ephemeral_owner="Server_0")
+    c1.stop()
+    store.close()
+
+    # process restart: fresh store from the same data_dir, fresh controller
+    store2 = PropertyStore(data_dir=str(tmp_path), fsync="off")
+    assert store2.get("/CONFIGS/TABLE/t_REALTIME") == {"tableName": "t"}
+    assert store2.get("/IDEALSTATES/t_REALTIME") == \
+        {"t__0__0__x": {"Server_0": "ONLINE"}}
+    rec = store2.get("/SEGMENTS/t/t__0__0__x")
+    assert rec["status"] == "DONE" and rec["endOffset"] == "40"
+    # session-scoped state did NOT survive: instances re-register, the
+    # leader seat is re-claimed by whoever starts first
+    assert store2.get("/LIVEINSTANCES/Server_0") is None
+    assert store2.get(LEADER_PATH) is None
+    c2 = ClusterController(store2, instance_id="c2",
+                           completion_config=COMPLETION_CFG)
+    assert c2.is_leader()
+    # the durable DONE record keeps commit_end idempotent across restart
+    mgr = c2.completion_manager()
+    end = mgr.segment_commit_end("t", "t__0__0__x", "A", 40,
+                                 "/deep/t__0__0__x")
+    from pinot_tpu.realtime.completion import COMMIT_SUCCESS
+
+    assert end.status == COMMIT_SUCCESS
+    assert store2.get("/SEGMENTS/t/t__0__0__x")["endOffset"] == "40"
+    c2.stop()
+    store2.close()
+
+
+# -- the acceptance e2e: controller dies between commit_start and commit_end --
+
+
+class _KillLeaderAfterCommitStart(LeaderCompletionClient):
+    """Routes completion calls to the current leader, and crashes that
+    leader exactly once — right after it told a committer CONTINUE, i.e.
+    between segment_commit_start and segment_commit_end."""
+
+    def __init__(self, store, resolver, kill):
+        super().__init__(store, resolver)
+        self.kill = kill
+        self.killed = False
+
+    def segment_commit_start(self, *args, **kw):
+        from pinot_tpu.realtime.completion import CONTINUE
+
+        resp = super().segment_commit_start(*args, **kw)
+        if resp.status == CONTINUE and not self.killed:
+            self.killed = True
+            self.kill()
+        return resp
+
+
+def test_kill_controller_mid_commit_exactly_once(registry, tmp_path):
+    registry.create_topic("fo", num_partitions=1)
+    store = PropertyStore(data_dir=str(tmp_path / "store"), fsync="off")
+    live = {}
+    for cid in ("c1", "c2"):
+        live[cid] = ClusterController(store, instance_id=cid,
+                                      completion_config=COMPLETION_CFG)
+
+    def kill_current_leader():
+        holder = store.get(LEADER_PATH)["instance"]
+        _kill(live, store, holder)
+
+    client = _KillLeaderAfterCommitStart(store, live.get,
+                                         kill_current_leader)
+    cfg = table_config("fo")
+    a = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
+                                 completion=client, instance_id="A")
+    b = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "b",
+                                 completion=client, instance_id="B")
+    published = rows(40)
+    expected = sorted((r["user"], r["ts"], r["n"]) for r in published)
+
+    def visible_rows(mgr):
+        ex = QueryExecutor(backend="auto")
+        ex.add_table(SCHEMA, list(mgr.segments), name="events")
+        r = ex.execute_sql("SELECT user, ts, n FROM events LIMIT 1000")
+        return sorted(tuple(row) for row in r.result_table.rows)
+
+    a.start()
+    b.start()
+    try:
+        registry.publish("fo", published)
+        # before the crash: both replicas see every published row
+        assert wait_until(lambda: sum(
+            s.num_docs for s in a.segments) == 40)
+        assert visible_rows(a) == expected
+
+        # the commit runs into the kill: leader dies holding CONTINUE
+        assert wait_until(lambda: client.killed)
+        # during the failover window the data is still bit-identical
+        assert visible_rows(a) == expected
+
+        # standby takes over and the segment commits exactly once
+        assert wait_until(lambda: store.children("/SEGMENTS/events"))
+        segs = store.children("/SEGMENTS/events")
+        assert len(segs) == 1
+        rec = store.get(f"/SEGMENTS/events/{segs[0]}")
+        assert rec["status"] == "DONE"
+        assert wait_until(lambda: a._committed and b._committed)
+        assert visible_rows(a) == expected  # after: same rows, now durable
+        assert visible_rows(b) == expected
+        assert a._committed[0].num_docs == 40
+        assert b._committed[0].num_docs == 40
+        # the surviving controller is the one that sealed the commit
+        (survivor,) = live
+        assert store.get(LEADER_PATH) == {"instance": survivor}
+        for m in (a, b):
+            for c in m._consuming.values():
+                assert c.state != "ERROR"
+    finally:
+        a.stop()
+        b.stop()
+        for c in live.values():
+            c.stop()
+        store.close()
+
+
+def test_consumers_hold_through_leaderless_window(registry, tmp_path):
+    """Total controller outage mid-ingestion: completion calls back off on
+    NoControllerLeaderError (the holds meter moves), consumers never go
+    ERROR, and the commit completes once a controller comes back."""
+    registry.create_topic("lw", num_partitions=1)
+    store = PropertyStore()
+    live = {"c1": ClusterController(store, instance_id="c1",
+                                    completion_config=COMPLETION_CFG)}
+    client = LeaderCompletionClient(store, live.get)
+    cfg = table_config("lw")
+    a = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "a",
+                                 completion=client, instance_id="A")
+    b = RealtimeTableDataManager(SCHEMA, cfg, tmp_path / "b",
+                                 completion=client, instance_id="B")
+    _kill(live, store, "c1")  # no leader BEFORE the flush is reached
+    before = SERVER_METRICS.meter_count(
+        ServerMeter.COMPLETION_HOLDS_NO_LEADER)
+    a.start()
+    b.start()
+    try:
+        registry.publish("lw", rows(40))
+        assert wait_until(lambda: SERVER_METRICS.meter_count(
+            ServerMeter.COMPLETION_HOLDS_NO_LEADER) > before)
+        assert not store.children("/SEGMENTS/events")
+        for m in (a, b):
+            for c in m._consuming.values():
+                assert c.state != "ERROR"
+        # a controller returns: the held commit drains
+        live["c3"] = ClusterController(store, instance_id="c3",
+                                       completion_config=COMPLETION_CFG)
+        assert wait_until(lambda: store.children("/SEGMENTS/events"))
+        rec = store.get("/SEGMENTS/events/"
+                        + store.children("/SEGMENTS/events")[0])
+        assert rec["status"] == "DONE"
+    finally:
+        a.stop()
+        b.stop()
+        for c in live.values():
+            c.stop()
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_debug_store_endpoint(tmp_path):
+    from pinot_tpu.cluster.rest import ControllerRestServer
+
+    store = PropertyStore(data_dir=str(tmp_path), fsync="off")
+    ctrl = ClusterController(store, instance_id="c1",
+                             completion_config=COMPLETION_CFG)
+    rest = ControllerRestServer(ctrl)
+    try:
+        with urllib.request.urlopen(rest.url + "/debug/store") as r:
+            out = json.loads(r.read())
+        assert out["durable"] is True
+        assert out["fsyncPolicy"] == "off"
+        assert out["leaderInstance"] == "c1"
+        assert out["thisInstance"] == "c1"
+        assert out["isLeader"] is True
+
+        with urllib.request.urlopen(rest.url + "/metrics") as r:
+            text = r.read().decode()
+        assert "controllerLeaderChanges" in text
+        assert "storeJournalBytes" in text
+    finally:
+        rest.close()
+        ctrl.stop()
+        store.close()

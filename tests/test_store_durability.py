@@ -1,0 +1,336 @@
+"""Crash-consistent property store: WAL, snapshots, recovery, fault matrix.
+
+Reference analogue: ZooKeeper transaction log + snapshot durability — the
+control-plane state Pinot keeps in ZK (ideal states, segment DONE records,
+lineage epochs) must survive controller/process restarts. The matrix here
+mirrors PR-8's wire-framing tests at the storage layer: length+crc32 frame
+per record, torn tails truncated at the first bad frame, bitflips detected
+by CRC — all deterministic from the seed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+import pytest
+
+from pinot_tpu.cluster import store as store_mod
+from pinot_tpu.cluster.store import BadVersionError, PropertyStore, StoreError
+from pinot_tpu.spi import faults
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.FAULTS.reset()
+
+
+def _reopen(d, **kw):
+    return PropertyStore(data_dir=str(d), fsync="off", **kw)
+
+
+# -- WAL round-trip -----------------------------------------------------------
+
+
+def test_journal_roundtrip_preserves_values_and_versions(tmp_path):
+    s = _reopen(tmp_path)
+    s.set("/IDEALSTATES/t", {"seg": {"Server_0": "ONLINE"}})
+    s.set("/IDEALSTATES/t", {"seg": {"Server_0": "ONLINE"},
+                             "seg2": {"Server_1": "ONLINE"}})
+    s.create_if_absent("/CONFIGS/TABLE/t", {"tableName": "t"})
+    s.set("/SEGMENTS/t/seg", {"status": "DONE"})
+    s.delete("/SEGMENTS/t/seg")
+    s.close()
+
+    s2 = _reopen(tmp_path)
+    val, version = s2.get_with_version("/IDEALSTATES/t")
+    assert val == {"seg": {"Server_0": "ONLINE"},
+                   "seg2": {"Server_1": "ONLINE"}}
+    assert version == 1  # CAS versions survive the restart
+    assert s2.get("/CONFIGS/TABLE/t") == {"tableName": "t"}
+    assert s2.get("/SEGMENTS/t/seg") is None
+    assert s2.recoveries == 1
+    # CAS against the recovered version must behave as before the crash
+    s2.set("/IDEALSTATES/t", {}, expected_version=1)
+    with pytest.raises(BadVersionError):
+        s2.set("/IDEALSTATES/t", {}, expected_version=1)
+    s2.close()
+
+
+def test_ephemeral_entries_never_persisted(tmp_path):
+    s = _reopen(tmp_path)
+    s.set("/LIVEINSTANCES/Server_0", {"host": "h"},
+          ephemeral_owner="Server_0")
+    s.create_if_absent("/CONTROLLER/LEADER", {"instance": "c1"},
+                       ephemeral_owner="c1")
+    s.set("/CONFIGS/TABLE/t", {"tableName": "t"})
+    s.close()
+    s2 = _reopen(tmp_path)
+    assert s2.get("/LIVEINSTANCES/Server_0") is None
+    assert s2.get("/CONTROLLER/LEADER") is None
+    assert s2.get("/CONFIGS/TABLE/t") == {"tableName": "t"}
+    s2.close()
+
+
+def test_persistent_entry_shadowed_by_ephemeral_is_forgotten(tmp_path):
+    """set(ephemeral) over a journaled persistent path must journal a
+    delete, or restart would resurrect the stale persistent value."""
+    s = _reopen(tmp_path)
+    s.set("/X", "persistent")
+    s.set("/X", "ephemeral", ephemeral_owner="sess")
+    s.close()
+    s2 = _reopen(tmp_path)
+    assert s2.get("/X") is None
+    s2.close()
+
+
+def test_delete_if_atomic_and_journaled(tmp_path):
+    s = _reopen(tmp_path)
+    s.set("/L", {"instance": "c1"})
+    assert not s.delete_if("/L", lambda v: v.get("instance") == "other")
+    assert s.get("/L") == {"instance": "c1"}
+    assert s.delete_if("/L", lambda v: v.get("instance") == "c1")
+    assert s.get("/L") is None
+    assert not s.delete_if("/L", lambda v: True)  # already gone
+    s.close()
+    s2 = _reopen(tmp_path)
+    assert s2.get("/L") is None
+    s2.close()
+
+
+def test_delete_if_notifies_watchers(tmp_path):
+    s = PropertyStore()
+    events = []
+    s.watch("/L", lambda p, v: events.append((p, v)))
+    s.set("/L", {"instance": "c1"})
+    s.delete_if("/L", lambda v: True)
+    assert events == [("/L", {"instance": "c1"}), ("/L", None)]
+
+
+# -- snapshot + compaction ----------------------------------------------------
+
+
+def test_snapshot_compaction_and_recovery(tmp_path):
+    s = _reopen(tmp_path, snapshot_threshold_bytes=256)
+    for i in range(50):
+        s.set("/K", {"i": i})
+    assert s.snapshots > 0
+    assert s.durability_stats()["journalBytes"] < 256
+    s.close()
+    s2 = _reopen(tmp_path)
+    val, version = s2.get_with_version("/K")
+    assert val == {"i": 49}
+    assert version == 49
+    s2.close()
+
+
+def test_corrupt_snapshot_fails_loudly(tmp_path):
+    s = _reopen(tmp_path, snapshot_threshold_bytes=64)
+    for i in range(10):
+        s.set("/K", {"i": i})
+    assert s.snapshots > 0
+    s.close()
+    snap = tmp_path / "store.snapshot"
+    blob = bytearray(snap.read_bytes())
+    blob[len(blob) // 2] ^= 0x40
+    snap.write_bytes(bytes(blob))
+    # snapshot writes are atomic (tmp+replace): damage is real corruption,
+    # not a torn tail — guessing at state would be worse than failing
+    with pytest.raises(StoreError):
+        _reopen(tmp_path)
+
+
+# -- torn tails and the seeded corruption matrix ------------------------------
+
+
+def test_torn_tail_truncated_at_first_bad_frame(tmp_path):
+    s = _reopen(tmp_path)
+    s.set("/A", 1)
+    s.set("/B", 2)
+    s.close()
+    jp = tmp_path / "store.journal"
+    good_len = jp.stat().st_size
+    with open(jp, "ab") as f:
+        f.write(struct.pack("<II", 9999, 0xDEAD))  # header of a torn frame
+        f.write(b"\x01\x02")
+    s2 = _reopen(tmp_path)
+    assert (s2.get("/A"), s2.get("/B")) == (1, 2)
+    assert s2.truncations == 1
+    assert jp.stat().st_size == good_len  # tail physically truncated
+    s2.close()
+    s3 = _reopen(tmp_path)  # second recovery is clean
+    assert s3.truncations == 0
+    s3.close()
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+@pytest.mark.parametrize("seed", [1, 7, 42])
+def test_recovery_matrix_seeded_frame_corruption(tmp_path, mode, seed):
+    """Deterministic matrix: corrupt frame k of n with corrupt_bytes(seed);
+    recovery keeps exactly the records before k and truncates the rest —
+    and two recoveries from identical damage agree bit-for-bit."""
+    n = 12
+    s = _reopen(tmp_path / "a")
+    frames = []
+    for i in range(n):
+        rec = json.dumps({"op": "set", "path": f"/P/{i}", "value": i,
+                          "version": 0}, separators=(",", ":")).encode()
+        frames.append(struct.pack("<II", len(rec), zlib.crc32(rec)) + rec)
+        s.set(f"/P/{i}", i)
+    s.close()
+
+    k = seed % n
+    jp = tmp_path / "a" / "store.journal"
+    blob = jp.read_bytes()
+    off = sum(len(f) for f in frames[:k])
+    damaged = faults.corrupt_bytes(blob[off:off + len(frames[k])],
+                                   mode=mode, seed=seed, index=k)
+    jp.write_bytes(blob[:off] + damaged + blob[off + len(frames[k]):])
+
+    recovered = []
+    for _ in range(2):
+        s2 = _reopen(tmp_path / "a")
+        recovered.append({p: s2.get(p) for p in s2.list_paths("/P")})
+        s2.close()
+        # restore identical damage for the second pass (the first pass
+        # truncated the file)
+        jp.write_bytes(blob[:off] + damaged + blob[off + len(frames[k]):])
+    assert recovered[0] == recovered[1]
+    assert recovered[0] == {f"/P/{i}": i for i in range(k)}
+
+
+# -- fsync policy -------------------------------------------------------------
+
+
+def test_fsync_policy_always_vs_off(tmp_path):
+    before = store_mod.FSYNC_CALLS
+    s = PropertyStore(data_dir=str(tmp_path / "always"), fsync="always")
+    s.set("/A", 1)
+    s.set("/A", 2)
+    assert store_mod.FSYNC_CALLS - before >= 2  # one per append
+    s.close()
+    before = store_mod.FSYNC_CALLS
+    s = PropertyStore(data_dir=str(tmp_path / "off"), fsync="off")
+    for i in range(5):
+        s.set("/A", i)
+    s.close()
+    assert store_mod.FSYNC_CALLS == before  # off never fsyncs
+
+    with pytest.raises(StoreError):
+        PropertyStore(data_dir=str(tmp_path / "bad"), fsync="bogus")
+
+
+def test_fsync_policy_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PINOT_TPU_STORE_FSYNC", "always")
+    s = PropertyStore(data_dir=str(tmp_path))
+    assert s.durability_stats()["fsyncPolicy"] == "always"
+    s.close()
+
+
+# -- store.journal fault point ------------------------------------------------
+
+
+def test_journal_error_fault_is_crash_after_append(tmp_path):
+    """An error fault at store.journal models death AFTER the WAL append
+    but BEFORE apply/notify: the caller sees a failure, memory is
+    unchanged, yet recovery replays the record — the durable outcome wins
+    (exactly the idempotency segment commits rely on)."""
+    s = _reopen(tmp_path)
+    s.set("/A", "before")
+    with faults.injected("store.journal", kind="error", times=1):
+        with pytest.raises(faults.InjectedFault):
+            s.set("/A", "after")
+    assert s.get("/A") == "before"  # not applied in memory
+    s.close()
+    s2 = _reopen(tmp_path)
+    assert s2.get("/A") == "after"  # but durably journaled
+    s2.close()
+
+
+def test_journal_corrupt_fault_is_torn_write(tmp_path):
+    """A corrupt fault at store.journal damages the on-disk frame while the
+    in-memory write proceeds — the torn-write shape. Recovery truncates at
+    the damaged frame and keeps everything before it."""
+    s = _reopen(tmp_path)
+    s.set("/A", 1)
+    with faults.injected("store.journal", kind="corrupt", times=1, seed=3):
+        s.set("/B", 2)  # acked in memory, torn on disk
+    s.set("/C", 3)  # lands after the torn frame — also lost to truncation
+    assert (s.get("/A"), s.get("/B"), s.get("/C")) == (1, 2, 3)
+    s.close()
+    s2 = _reopen(tmp_path)
+    assert s2.get("/A") == 1
+    assert s2.get("/B") is None
+    assert s2.get("/C") is None
+    assert s2.truncations == 1
+    s2.close()
+
+
+def test_store_write_fault_fires_before_journal(tmp_path):
+    """The pre-existing store.write error fault stays crash-BEFORE-append:
+    nothing reaches memory or the journal."""
+    s = _reopen(tmp_path)
+    with faults.injected("store.write", kind="error", times=1):
+        with pytest.raises(faults.InjectedFault):
+            s.set("/A", 1)
+    assert s.get("/A") is None
+    s.close()
+    s2 = _reopen(tmp_path)
+    assert s2.get("/A") is None
+    s2.close()
+
+
+# -- lineage epoch regression (broker result cache) ---------------------------
+
+
+def test_cache_epoch_survives_restart(tmp_path):
+    """/CACHEEPOCH/{nwt} must survive a controller restart: a reset to 0
+    would let the broker result cache serve stale pre-replace results
+    keyed on a reused (fingerprint, epoch) pair — bit-for-bit staleness."""
+    from pinot_tpu.cache.results import bump_lineage_epoch, lineage_epoch
+
+    s = _reopen(tmp_path)
+    for _ in range(3):
+        bump_lineage_epoch(s, "stats_OFFLINE")
+    epoch = lineage_epoch(s, "stats_OFFLINE")
+    assert epoch >= 3
+    s.close()
+    s2 = _reopen(tmp_path)
+    assert lineage_epoch(s2, "stats_OFFLINE") == epoch
+    bump_lineage_epoch(s2, "stats_OFFLINE")  # and keeps moving forward
+    assert lineage_epoch(s2, "stats_OFFLINE") == epoch + 1
+    s2.close()
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_durability_stats_and_journal_bytes_gauge(tmp_path):
+    from pinot_tpu.spi.metrics import CONTROLLER_METRICS, ControllerGauge
+
+    s = _reopen(tmp_path)
+    s.set("/A", 1)
+    stats = s.durability_stats()
+    assert stats["durable"] is True
+    assert stats["journalBytes"] > 0
+    assert stats["fsyncPolicy"] == "off"
+    assert CONTROLLER_METRICS.gauge_value(
+        ControllerGauge.STORE_JOURNAL_BYTES) == stats["journalBytes"]
+    s.close()
+
+    mem = PropertyStore()
+    st = mem.durability_stats()
+    assert st["durable"] is False and st["journalBytes"] == 0
+
+
+def test_in_memory_store_unchanged(tmp_path):
+    """No data_dir → exactly the old semantics, no journal file anywhere."""
+    s = PropertyStore()
+    s.set("/A", 1)
+    s.set("/A", 2, expected_version=0)
+    assert s.get_with_version("/A") == (2, 1)
+    assert not os.path.exists(str(tmp_path / "store.journal"))
+    s.close()  # harmless no-op

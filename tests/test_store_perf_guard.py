@@ -1,0 +1,131 @@
+"""CI perf-structure guard: control-plane durability must be free where
+it isn't used.
+
+Same discipline as test_fault_perf_guard.py (call counts, not wall-clock):
+with an in-memory store, a warm query must add ZERO journal appends and
+ZERO fsyncs — the WAL machinery may not leak into the non-durable path.
+With a durable store, the warm query READ path must add zero journal
+appends: queries read routing/external-view state, they never write the
+store, so durability costs nothing per query. Armed runs then prove the
+module-level counters watch the live write path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import Broker, ClusterController, ServerInstance
+from pinot_tpu.cluster import store as store_mod
+from pinot_tpu.cluster.store import PropertyStore
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi.data_types import Schema
+
+SQL = "SET resultCache = false; SET segmentCache = false; " \
+      "SELECT spk, SUM(spv) FROM storeperf GROUP BY spk"
+
+
+def _build_cluster(store, d):
+    schema = Schema.build("storeperf", dimensions=[("spk", "INT")],
+                          metrics=[("spv", "INT")])
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="host")
+    server.start()
+    controller.add_schema(schema.to_json())
+    table = controller.create_table({"tableName": "storeperf",
+                                     "replication": 1})
+    rng = np.random.default_rng(17)
+    for i in range(3):
+        cols = {"spk": rng.integers(0, 20, 500).astype(np.int32),
+                "spv": rng.integers(0, 100, 500).astype(np.int32)}
+        SegmentBuilder(schema, segment_name=f"sp_{i}").build(cols, d / f"s{i}")
+        controller.add_segment(table, f"sp_{i}",
+                               {"location": str(d / f"s{i}"), "numDocs": 500})
+    broker = Broker(store)
+    for _ in range(2):
+        r = broker.execute_sql(SQL)
+        assert not r.exceptions, r.exceptions
+    return broker, server
+
+
+@pytest.fixture(scope="module")
+def warm_memory_cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("storeperf_mem")
+    broker, server = _build_cluster(PropertyStore(), d)
+    yield broker
+    server.stop()
+
+
+@pytest.fixture(scope="module")
+def warm_durable_cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("storeperf_wal")
+    store = PropertyStore(data_dir=str(d / "store"), fsync="always")
+    broker, server = _build_cluster(store, d)
+    yield broker, store
+    server.stop()
+    store.close()
+
+
+def test_durability_off_warm_query_zero_journal_cost(warm_memory_cluster):
+    appends = store_mod.JOURNAL_APPENDS
+    fsyncs = store_mod.FSYNC_CALLS
+    r = warm_memory_cluster.execute_sql(SQL)
+    assert not r.exceptions, r.exceptions
+    assert store_mod.JOURNAL_APPENDS == appends, (
+        "an in-memory store must never reach the WAL append path")
+    assert store_mod.FSYNC_CALLS == fsyncs, (
+        "an in-memory store must never fsync")
+
+
+def test_durability_on_warm_read_path_zero_store_writes(warm_durable_cluster):
+    """Queries only READ the control plane — with fsync=always, a single
+    stray store write on the query path would cost a disk flush per query.
+    Pin the whole write path to zero."""
+    broker, _store = warm_durable_cluster
+    appends = store_mod.JOURNAL_APPENDS
+    fsyncs = store_mod.FSYNC_CALLS
+    for _ in range(3):
+        r = broker.execute_sql(SQL)
+        assert not r.exceptions, r.exceptions
+    assert store_mod.JOURNAL_APPENDS == appends, (
+        "warm queries must not write the property store")
+    assert store_mod.FSYNC_CALLS == fsyncs, (
+        "warm queries must not trigger journal fsyncs")
+
+
+def test_armed_write_moves_the_counters(warm_durable_cluster):
+    """Sanity: the guard watches the live WAL — a real store write must
+    append exactly one frame and (fsync=always) exactly one fsync."""
+    _broker, store = warm_durable_cluster
+    appends = store_mod.JOURNAL_APPENDS
+    fsyncs = store_mod.FSYNC_CALLS
+    store.set("/perf/guard", {"touch": 1})
+    assert store_mod.JOURNAL_APPENDS == appends + 1
+    assert store_mod.FSYNC_CALLS == fsyncs + 1
+
+
+def test_fsync_off_write_appends_without_fsync(tmp_path):
+    s = PropertyStore(data_dir=str(tmp_path), fsync="off")
+    appends = store_mod.JOURNAL_APPENDS
+    fsyncs = store_mod.FSYNC_CALLS
+    s.set("/perf/guard", {"touch": 1})
+    assert store_mod.JOURNAL_APPENDS == appends + 1
+    assert store_mod.FSYNC_CALLS == fsyncs
+    s.close()
+
+
+def test_ephemeral_writes_skip_the_journal(tmp_path):
+    """Session-scoped churn (live instances, leader seat) is the hottest
+    write class — none of it may touch the WAL."""
+    s = PropertyStore(data_dir=str(tmp_path), fsync="always")
+    appends = store_mod.JOURNAL_APPENDS
+    fsyncs = store_mod.FSYNC_CALLS
+    for i in range(5):
+        s.set(f"/LIVEINSTANCES/Server_{i}", {"host": "h"},
+              ephemeral_owner=f"Server_{i}")
+    s.create_if_absent("/CONTROLLER/LEADER", {"instance": "c1"},
+                       ephemeral_owner="c1")
+    s.expire_session("c1")
+    assert store_mod.JOURNAL_APPENDS == appends
+    assert store_mod.FSYNC_CALLS == fsyncs
+    s.close()
