@@ -106,8 +106,19 @@ class Broker:
                  allow_partial_default: Optional[bool] = None,
                  scatter_retries: Optional[int] = None,
                  hedge_ms: Optional[float] = None,
-                 hedge_quantile: Optional[float] = None):
+                 hedge_quantile: Optional[float] = None,
+                 broker_id: Optional[str] = None):
         self.store = store
+        self.broker_id = broker_id or f"Broker_{uuid.uuid4().hex[:8]}"
+        # brokers are store CLIENTS (never in /LIVEINSTANCES — the MSE
+        # worker placement enumerates that), so breaker/load state reaches
+        # the controller's health rollup via /BROKERSTATE beacons instead
+        # of a scrape. Publication is opt-in (PINOT_TPU_BROKER_STATE_S > 0)
+        # and rate-limited to one store write per interval — the query
+        # thread's common case stays a single monotonic comparison.
+        self._state_publish_s = float(os.environ.get(
+            "PINOT_TPU_BROKER_STATE_S", 0.0))
+        self._state_published_at = 0.0
         # per-server circuit breakers drive both replica selection and the
         # serversUnhealthy gauge; kept under the historical attribute name
         # too (is_healthy/mark_failed/mark_healthy are API-compatible)
@@ -157,8 +168,12 @@ class Broker:
         self.response_store = ResponseStore()
         self.adaptive_selection = adaptive_selection
         from .querylog import QueryLogger
+        from .workload import WorkloadTracker
 
         self.query_logger = QueryLogger()
+        # per-query cost accounting → decaying per-table/client rollups
+        # (GET /debug/workload); also the admission cost-hint source
+        self.workload = WorkloadTracker()
         # full-response cache (cache/results.py): keyed on canonical query
         # fingerprint + table lineage epoch, so any segment upload/replace/
         # delete or realtime commit makes old entries unreachable
@@ -173,6 +188,53 @@ class Broker:
         self._pool = ThreadPoolExecutor(max_workers=num_scatter_threads,
                                         thread_name_prefix="broker-scatter")
         self._lock = threading.Lock()
+
+    # -- health -------------------------------------------------------------
+    def is_ready(self) -> bool:
+        """Readiness = at least one materialized routing snapshot: before
+        the first successful routing read every query would fail routing,
+        so orchestrators should not send traffic yet. Serves the REST
+        GET /health[/readiness] (liveness is unconditional)."""
+        with self._lock:
+            if self._last_routing:
+                return True
+        # no query has warmed routing yet: try to materialize one now so a
+        # freshly-started broker over a healthy store turns ready without
+        # needing traffic first
+        tables = self.store.children("/CONFIGS/TABLE")
+        if not tables:
+            return True  # nothing to route — vacuously ready
+        for nwt in tables:
+            try:
+                self.routing_table(nwt)
+                return True
+            except Exception:
+                continue
+        return False
+
+    def publish_state(self) -> dict:
+        """Write this broker's health beacon to /BROKERSTATE/{id} for the
+        controller's ClusterHealthChecker (breaker states feed its
+        breaker-flap rule). Called opportunistically from the query return
+        path when PINOT_TPU_BROKER_STATE_S is set, or directly by
+        harnesses (tools/soak.py) and tests."""
+        state = {
+            "brokerId": self.broker_id,
+            "publishedAtMs": int(time.time() * 1000),
+            "breakers": self.breakers.snapshot(),
+            "inflight": self.admission.inflight(),
+            "queued": self.admission.queued(),
+            "queryP50Ms": round(BROKER_METRICS.timer_quantile(
+                BrokerTimer.QUERY_PROCESSING_TIME_MS, 0.5), 3),
+            "queryP99Ms": round(BROKER_METRICS.timer_quantile(
+                BrokerTimer.QUERY_PROCESSING_TIME_MS, 0.99), 3),
+            "resultCacheHits": BROKER_METRICS.meter_count(
+                BrokerMeter.RESULT_CACHE_HITS),
+            "resultCacheMisses": BROKER_METRICS.meter_count(
+                BrokerMeter.RESULT_CACHE_MISSES),
+        }
+        self.store.set(f"/BROKERSTATE/{self.broker_id}", state)
+        return state
 
     # -- routing ------------------------------------------------------------
     def routing_table(self, name_with_type: str) -> dict[str, list[str]]:
@@ -307,6 +369,14 @@ class Broker:
 
             BROKER_METRICS.add_table_meter(table, BrokerMeter.QUERIES)
         self.query_logger.log(sql, resp, table=table)
+        self.workload.note_response(sql, resp, table=table)
+        if self._state_publish_s and time.monotonic() \
+                - self._state_published_at >= self._state_publish_s:
+            self._state_published_at = time.monotonic()
+            try:
+                self.publish_state()
+            except Exception:
+                pass  # a glitching store must not fail the query
         return resp
 
     def _execute_sql_impl(self, sql: str,
@@ -328,6 +398,15 @@ class Broker:
             return resp
         if query.query_options.get("useMultistageEngine") in (True, "true", 1):
             resp = self._admitted_mse(sql)
+            resp._log_table = query.table_name
+            return resp
+        if getattr(query, "explain", False) == "analyze":
+            # EXPLAIN ANALYZE: run the scatter for real with tracing armed
+            # (caches live) and render ONE merged broker-side tree
+            try:
+                resp = self._execute_analyze(query, segments, t0)
+            except Exception as e:
+                resp = BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
             resp._log_table = query.table_name
             return resp
         if getattr(query, "explain", False):
@@ -362,7 +441,10 @@ class Broker:
         budget = _QueryBudget(self._timeout_ms(query),
                               self._partial_allowed(query))
         try:
-            with self.admission.admit(timeout_s=budget.remaining_s()):
+            with self.admission.admit(
+                    timeout_s=budget.remaining_s(),
+                    cost_hint_ms=self.workload.expected_cost_ms(
+                        raw_table_name(query.table_name))):
                 resp = self._execute(query, only_segments=segments,
                                      budget=budget)
         except AdmissionRejectedError as e:
@@ -378,6 +460,64 @@ class Broker:
             BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_MISSES)
             self.result_cache.put(ck, resp)
         return resp
+
+    def _execute_analyze(self, query: QueryContext,
+                         segments: Optional[dict],
+                         t0: float) -> BrokerResponse:
+        """EXPLAIN ANALYZE at the broker: consult the result cache first
+        (a warm hit renders as a RESULT_CACHE node with zero dispatches),
+        otherwise scatter the real query with an analyze-flagged trace and
+        render the merged cross-server span tree as the annotated plan."""
+        import copy
+
+        from ..engine.explain import analyze_table
+
+        raw = raw_table_name(query.table_name)
+        ck = self._result_cache_key(query, segments)
+        if ck is not None:
+            cached = self.result_cache.get(ck)
+            if cached is not None:
+                BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_HITS)
+                base = copy.copy(cached)
+                base.cache_outcome = "hit"
+                base.time_used_ms = (time.perf_counter() - t0) * 1000
+                out = copy.copy(base)
+                out.result_table = analyze_table(
+                    base.trace_info or [], base, table_name=raw)
+                return out
+        sub = copy.copy(query)
+        sub.explain = False
+        sub.query_options = dict(query.query_options)
+        sub.query_options["trace"] = True
+        # the analyze marker rides the query to every server so their
+        # traces keep the cache tiers live (spi/trace.py analyze flag)
+        sub.query_options["analyze"] = True
+        budget = _QueryBudget(self._timeout_ms(query),
+                              self._partial_allowed(query))
+        try:
+            with self.admission.admit(
+                    timeout_s=budget.remaining_s(),
+                    cost_hint_ms=self.workload.expected_cost_ms(raw)):
+                resp = self._execute(sub, only_segments=segments,
+                                     budget=budget)
+        except AdmissionRejectedError as e:
+            return self._rejected_response(e)
+        resp.time_used_ms = (time.perf_counter() - t0) * 1000
+        if resp.exceptions:
+            return resp
+        resp.cache_outcome = "miss" if ck is not None else "bypass"
+        if ck is not None and not resp.partial_result \
+                and resp.result_table is not None:
+            # cache the PLAIN result (trace scrubbed): the next run — plain
+            # or ANALYZE — hits, and ANALYZE then reports cache: hit
+            BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_MISSES)
+            plain = copy.copy(resp)
+            plain.trace_info = None
+            self.result_cache.put(ck, plain)
+        out = copy.copy(resp)
+        out.result_table = analyze_table(resp.trace_info or [], resp,
+                                         table_name=raw)
+        return out
 
     def _result_cache_key(self, query: QueryContext,
                           only_segments: Optional[dict]) -> Optional[tuple]:
@@ -577,7 +717,10 @@ class Broker:
         trace = None
         if query.query_options.get("trace") in (True, "true", 1) \
                 and TRACING.active_trace() is None:
-            trace = TRACING.start_trace(f"broker:{raw}")
+            trace = TRACING.start_trace(
+                f"broker:{raw}",
+                analyze=query.query_options.get("analyze") in
+                (True, "true", 1))
 
         if budget is None:
             budget = _QueryBudget(self._timeout_ms(query),
@@ -617,12 +760,22 @@ class Broker:
         trace_info = None
         if trace is not None:
             trace_info = trace.to_json()
+            # span ids are namespaced per (instance, shard ordinal), not per
+            # instance alone: a hedge win lands a second shard on an
+            # instance that already answered one, and a bare per-instance
+            # prefix would collide both traces' ids — any id-keyed consumer
+            # (to_tree, the ANALYZE renderer) then silently drops the
+            # winning shard's spans
+            shard_ordinal: dict[str, int] = {}
             for inst, server_spans in stats_sum["server_traces"]:
+                n = shard_ordinal.get(inst, 0)
+                shard_ordinal[inst] = n + 1
+                prefix = inst if n == 0 else f"{inst}#{n}"
                 for s in server_spans:
                     s = dict(s)
-                    s["spanId"] = f"{inst}:{s['spanId']}"
+                    s["spanId"] = f"{prefix}:{s['spanId']}"
                     if s.get("parentId") is not None:
-                        s["parentId"] = f"{inst}:{s['parentId']}"
+                        s["parentId"] = f"{prefix}:{s['parentId']}"
                     else:
                         s["server"] = inst
                     trace_info.append(s)
@@ -697,15 +850,30 @@ class Broker:
 
     def _cancel_shard(self, inst: str, shard_qid: str) -> None:
         """Cancel one hedging loser, off-thread (the loser's server is
-        usually the slow or dead one — never block the winner on it)."""
+        usually the slow or dead one — never block the winner on it).
+
+        Uses a DEDICATED connection, never the pooled per-instance client:
+        the pool serializes calls per target, and the connection's lock is
+        held right now by the losing RPC itself — a pooled cancel would
+        queue behind the very call it is trying to kill and only land
+        after the loser finished on its own."""
+        cfg = self.store.get(f"/LIVEINSTANCES/{inst}") or \
+            self.store.get(f"/INSTANCECONFIGS/{inst}") or {}
+        host, port = cfg.get("host"), cfg.get("port")
+        if port is None:
+            return  # instance gone; nothing left to cancel
+
         def _send():
+            client = RpcClient(host, port, timeout=2.0, connect_timeout=2.0)
             try:
-                self._client(inst).call(
+                client.call(
                     {"type": "cancel", "queryId": shard_qid,
                      "reason": "hedged duplicate superseded"},
                     retry=False, timeout=2.0)
             except Exception:
                 pass
+            finally:
+                client.close()
         threading.Thread(target=_send, daemon=True,
                          name="broker-hedge-cancel").start()
 

@@ -13,12 +13,15 @@ Reference analogues (SURVEY.md §2.6):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..spi.metrics import (CONTROLLER_METRICS, ControllerGauge,
+                           ControllerMeter)
 from .controller import ERROR, ONLINE, ClusterController
 from .store import PropertyStore
 
@@ -408,6 +411,249 @@ class SegmentRelocator:
         return moves
 
 
+# -- cluster health rollup ---------------------------------------------------
+
+
+# where the leader materializes the fleet snapshot; GET /debug/cluster on
+# any controller serves this key (standbys serve the leader's last scrape)
+HEALTH_REPORT_PATH = "/HEALTH/cluster"
+
+# fewest latency samples before an instance participates in straggler math
+# (the absolute stragglerMinMs floor already filters small-sample noise)
+_MIN_LATENCY_SAMPLES = 3
+# fewest segment-cache lookups in a scrape window before the fleet hit
+# rate is judged at all (a near-idle window says nothing about the cache)
+_MIN_CACHE_LOOKUPS = 32
+
+
+class ClusterHealthChecker:
+    """Leader-side fleet scrape + anomaly detection (the tentpole of the
+    observability PR). Each run RPCs every live server's ``status``
+    endpoint (per-instance latency quantiles, HBM residency, cache
+    counters, quarantine inventory), folds in any broker state published
+    at ``/BROKERSTATE/*`` (cluster/broker.py publish_state), and
+    materializes one fleet snapshot at ``HEALTH_REPORT_PATH`` — the body
+    of ``GET /debug/cluster``.
+
+    Anomaly rules (each flagged entry ticks the clusterHealthAnomalies
+    meter; thresholds are env knobs, documented in the README operating
+    guide):
+
+    - ``straggler``            a server's p99 is ≥ PINOT_TPU_STRAGGLER_RATIO
+                               × the fleet median p99 AND at least
+                               PINOT_TPU_STRAGGLER_MIN_MS above it
+    - ``hbm-pressure``         HBM used/budget ≥ PINOT_TPU_HBM_PRESSURE_RATIO,
+                               or new hbmOomEvents since the last scrape
+    - ``cache-collapse``       fleet segment-cache hit rate over the scrape
+                               window fell below PINOT_TPU_CACHE_COLLAPSE_RATE
+                               after a previously healthy (≥50%) window
+    - ``breaker-flap``         a broker's breakers re-opened ≥
+                               PINOT_TPU_BREAKER_FLAP_COUNT times in one window
+    - ``instance-unreachable`` a live-instance entry did not answer the scrape
+
+    All scrape work runs on the controller's periodic thread — never on a
+    query thread — and only on the elected leader (double-gated: the
+    scheduler loop skips standbys, and __call__ re-checks so a stray
+    run_once on a standby stays a no-op)."""
+
+    def __init__(self, store: PropertyStore, controller: ClusterController,
+                 straggler_ratio: Optional[float] = None,
+                 straggler_min_ms: Optional[float] = None,
+                 hbm_pressure_ratio: Optional[float] = None,
+                 cache_collapse_rate: Optional[float] = None,
+                 breaker_flap_count: Optional[int] = None,
+                 scrape_timeout_s: float = 2.0):
+        self.store = store
+        self.controller = controller
+        self.straggler_ratio = straggler_ratio if straggler_ratio is not None \
+            else float(os.environ.get("PINOT_TPU_STRAGGLER_RATIO", 3.0))
+        self.straggler_min_ms = straggler_min_ms \
+            if straggler_min_ms is not None \
+            else float(os.environ.get("PINOT_TPU_STRAGGLER_MIN_MS", 50.0))
+        self.hbm_pressure_ratio = hbm_pressure_ratio \
+            if hbm_pressure_ratio is not None \
+            else float(os.environ.get("PINOT_TPU_HBM_PRESSURE_RATIO", 0.9))
+        self.cache_collapse_rate = cache_collapse_rate \
+            if cache_collapse_rate is not None \
+            else float(os.environ.get("PINOT_TPU_CACHE_COLLAPSE_RATE", 0.2))
+        self.breaker_flap_count = breaker_flap_count \
+            if breaker_flap_count is not None \
+            else int(os.environ.get("PINOT_TPU_BREAKER_FLAP_COUNT", 3))
+        self.scrape_timeout_s = scrape_timeout_s
+        # previous-scrape counters for windowed (delta) rules
+        self._prev_counters: dict[str, dict] = {}
+        self._prev_breaker_opens: dict[str, int] = {}
+        self._prev_window_hit_rate: Optional[float] = None
+        self._last_reachable = 0
+        CONTROLLER_METRICS.set_gauge(
+            ControllerGauge.CLUSTER_SERVERS_REACHABLE,
+            lambda: self._last_reachable)
+
+    def __call__(self) -> dict:
+        leader = getattr(self.controller, "leader", None)
+        if leader is not None and not leader.is_leader:
+            return {"skipped": "standby controller does not scrape"}
+        t0 = time.perf_counter()
+        servers, anomalies = self._scrape_servers()
+        brokers = self._collect_brokers(anomalies)
+        fleet = self._fleet_rollup(servers, anomalies)
+        self._last_reachable = fleet["serversReachable"]
+        snapshot = {
+            "checkedAtMs": int(time.time() * 1000),
+            "scrapeMs": round((time.perf_counter() - t0) * 1000, 3),
+            "fleet": fleet,
+            "servers": servers,
+            "brokers": brokers,
+            "anomalies": anomalies,
+            "thresholds": {
+                "stragglerRatio": self.straggler_ratio,
+                "stragglerMinMs": self.straggler_min_ms,
+                "hbmPressureRatio": self.hbm_pressure_ratio,
+                "cacheCollapseRate": self.cache_collapse_rate,
+                "breakerFlapCount": self.breaker_flap_count,
+            },
+        }
+        if anomalies:
+            CONTROLLER_METRICS.add_meter(
+                ControllerMeter.CLUSTER_HEALTH_ANOMALIES, len(anomalies))
+        self.store.set(HEALTH_REPORT_PATH, snapshot)
+        return snapshot
+
+    # -- scrape side ---------------------------------------------------------
+    def _scrape_servers(self) -> tuple[dict, list]:
+        from .transport import RemoteError, RpcClient, TransportError
+
+        servers: dict[str, dict] = {}
+        anomalies: list[dict] = []
+        for inst in sorted(self.store.children("/LIVEINSTANCES")):
+            cfg = self.store.get(f"/LIVEINSTANCES/{inst}") or {}
+            if "port" not in cfg:
+                continue  # minions and other non-query instances
+            client = RpcClient(cfg.get("host", "127.0.0.1"), cfg["port"],
+                               timeout=self.scrape_timeout_s,
+                               connect_timeout=self.scrape_timeout_s)
+            try:
+                status = client.call({"type": "status"}, retry=False)
+                servers[inst] = dict(status, reachable=True)
+            except (TransportError, RemoteError, OSError) as e:
+                servers[inst] = {"instanceId": inst, "reachable": False,
+                                 "error": str(e)}
+                anomalies.append({
+                    "type": "instance-unreachable", "instance": inst,
+                    "detail": f"health scrape failed: {e}"})
+            finally:
+                client.close()
+        return servers, anomalies
+
+    def _collect_brokers(self, anomalies: list) -> dict:
+        brokers: dict[str, dict] = {}
+        for bid in sorted(self.store.children("/BROKERSTATE")):
+            state = self.store.get(f"/BROKERSTATE/{bid}") or {}
+            brokers[bid] = state
+            opens = sum(int((b or {}).get("timesOpened", 0))
+                        for b in (state.get("breakers") or {}).values())
+            prev = self._prev_breaker_opens.get(bid)
+            if prev is not None and opens - prev >= self.breaker_flap_count:
+                anomalies.append({
+                    "type": "breaker-flap", "instance": bid,
+                    "detail": f"circuit breakers opened {opens - prev} "
+                              f"times since the last scrape "
+                              f"(threshold {self.breaker_flap_count})"})
+            self._prev_breaker_opens[bid] = opens
+        return brokers
+
+    # -- anomaly math --------------------------------------------------------
+    def _fleet_rollup(self, servers: dict, anomalies: list) -> dict:
+        reachable = {i: s for i, s in servers.items() if s.get("reachable")}
+        # straggler: per-server p99 vs the fleet median p99
+        p99s = {i: s["queryLatencyMs"]["p99"] for i, s in reachable.items()
+                if s.get("queryLatencyMs", {}).get("count", 0)
+                >= _MIN_LATENCY_SAMPLES}
+        median_p99 = _median(list(p99s.values()))
+        if len(p99s) >= 2:
+            for inst, p99 in sorted(p99s.items()):
+                # leave-one-out median: with small fleets the overall
+                # median is dragged toward the straggler itself, hiding it
+                rest = _median([v for i, v in p99s.items() if i != inst])
+                if rest > 0 and p99 >= self.straggler_ratio * rest \
+                        and p99 - rest >= self.straggler_min_ms:
+                    anomalies.append({
+                        "type": "straggler", "instance": inst,
+                        "detail": f"p99 {p99:.1f}ms vs rest-of-fleet "
+                                  f"median {rest:.1f}ms (ratio "
+                                  f"{p99 / rest:.1f}x >= "
+                                  f"{self.straggler_ratio}x)"})
+        # hbm pressure: residency vs budget, plus fresh OOM events
+        window_hits = window_misses = 0
+        for inst, s in sorted(reachable.items()):
+            hbm = s.get("hbm") or {}
+            used = int(hbm.get("hbmBytesUsed", 0) or 0)
+            budget = hbm.get("hbmBudgetBytes")
+            if budget and used / budget >= self.hbm_pressure_ratio:
+                anomalies.append({
+                    "type": "hbm-pressure", "instance": inst,
+                    "detail": f"HBM {used}/{budget} bytes "
+                              f"({used / budget:.0%} >= "
+                              f"{self.hbm_pressure_ratio:.0%} of budget)"})
+            prev = self._prev_counters.get(inst, {})
+            oom_delta = s.get("hbmOomEvents", 0) - prev.get("oom", 0)
+            if prev and oom_delta > 0:
+                anomalies.append({
+                    "type": "hbm-pressure", "instance": inst,
+                    "detail": f"{oom_delta} hbmOomEvents since the last "
+                              f"scrape"})
+            cache = s.get("segmentCache") or {}
+            window_hits += cache.get("hits", 0) - prev.get("hits", 0) \
+                if prev else 0
+            window_misses += cache.get("misses", 0) - prev.get("misses", 0) \
+                if prev else 0
+            self._prev_counters[inst] = {
+                "hits": cache.get("hits", 0),
+                "misses": cache.get("misses", 0),
+                "oom": s.get("hbmOomEvents", 0),
+            }
+        # cache collapse: fleet hit rate over THIS window, judged only
+        # against a previously healthy window with real traffic
+        lookups = window_hits + window_misses
+        window_rate = window_hits / lookups if lookups else None
+        if lookups >= _MIN_CACHE_LOOKUPS and window_rate is not None:
+            prev_rate = self._prev_window_hit_rate
+            if prev_rate is not None and prev_rate >= 0.5 \
+                    and window_rate < self.cache_collapse_rate:
+                anomalies.append({
+                    "type": "cache-collapse", "instance": "",
+                    "detail": f"fleet segment-cache hit rate fell to "
+                              f"{window_rate:.0%} (was {prev_rate:.0%}) "
+                              f"over {lookups} lookups"})
+            self._prev_window_hit_rate = window_rate
+        quarantined = sum(len(segs)
+                          for s in reachable.values()
+                          for segs in (s.get("quarantined") or {}).values())
+        return {
+            "serversTotal": len(servers),
+            "serversReachable": len(reachable),
+            "medianP50Ms": _median([s["queryLatencyMs"]["p50"]
+                                    for s in reachable.values()
+                                    if s.get("queryLatencyMs", {}).get(
+                                        "count", 0)]),
+            "medianP99Ms": median_p99,
+            "maxP99Ms": max(p99s.values()) if p99s else 0.0,
+            "windowCacheHitRate": round(window_rate, 4)
+            if window_rate is not None else None,
+            "quarantinedSegments": quarantined,
+        }
+
+
+def _median(values: list) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    mid = len(values) // 2
+    if len(values) % 2:
+        return float(values[mid])
+    return (values[mid - 1] + values[mid]) / 2.0
+
+
 def build_default_scheduler(store: PropertyStore, controller: ClusterController,
                             interval_s: float = 10.0,
                             leader=None) -> ControllerPeriodicTaskScheduler:
@@ -432,4 +678,9 @@ def build_default_scheduler(store: PropertyStore, controller: ClusterController,
         return {t: mgr.cleanup(t) for t in store.children("/LINEAGE")}
 
     sched.register("LineageCleanupTask", interval_s, _lineage_cleanup)
+    # fleet scrape can run on its own cadence (operators tune how fresh
+    # GET /debug/cluster is, independent of segment housekeeping)
+    scrape_s = float(os.environ.get("PINOT_TPU_HEALTH_SCRAPE_S", interval_s))
+    sched.register("ClusterHealthChecker", scrape_s,
+                   ClusterHealthChecker(store, controller))
     return sched

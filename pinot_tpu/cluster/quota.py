@@ -44,7 +44,8 @@ class AdmissionController:
     2×max-inflight) so a burst fails fast instead of accumulating."""
 
     def __init__(self, max_inflight: Optional[int] = None,
-                 max_queued: Optional[int] = None):
+                 max_queued: Optional[int] = None,
+                 heavy_query_ms: Optional[float] = None):
         if max_inflight is None:
             max_inflight = int(os.environ.get(
                 "PINOT_TPU_MAX_INFLIGHT_QUERIES", 0)) or None
@@ -52,8 +53,17 @@ class AdmissionController:
             env = os.environ.get("PINOT_TPU_MAX_QUEUED_QUERIES")
             max_queued = int(env) if env is not None else (
                 2 * max_inflight if max_inflight else 0)
+        if heavy_query_ms is None:
+            heavy_query_ms = float(os.environ.get(
+                "PINOT_TPU_HEAVY_QUERY_MS", 0.0))
         self.max_inflight = max_inflight
         self.max_queued = max_queued
+        # cost-aware shedding (fed by cluster/workload.py): once the broker
+        # is saturated, a query whose expected cost — the decayed mean
+        # wall-time of its table's recent traffic — crosses this threshold
+        # is rejected immediately instead of queueing, so cheap queries
+        # keep their queue slots. 0 disables (count-only admission).
+        self.heavy_query_ms = heavy_query_ms
         self._sem = (threading.Semaphore(max_inflight)
                      if max_inflight else None)
         self._lock = threading.Lock()
@@ -69,10 +79,13 @@ class AdmissionController:
             return self._queued
 
     @contextmanager
-    def admit(self, timeout_s: float = 0.0):
+    def admit(self, timeout_s: float = 0.0, cost_hint_ms: float = 0.0):
         """Hold one in-flight slot for the duration of the block; raises
         AdmissionRejectedError when the queue is full or no slot frees up
-        within ``timeout_s`` (the query's remaining deadline)."""
+        within ``timeout_s`` (the query's remaining deadline).
+        ``cost_hint_ms`` — the caller's expected cost for this query (the
+        workload tracker's decayed per-table mean) — lets a saturated
+        broker shed expensive queries without queueing them."""
         if self._sem is None:
             yield
             return
@@ -80,6 +93,12 @@ class AdmissionController:
         # cap only applies to queries that would actually have to wait
         ok = self._sem.acquire(blocking=False)
         if not ok:
+            if self.heavy_query_ms and cost_hint_ms \
+                    and cost_hint_ms >= self.heavy_query_ms:
+                raise AdmissionRejectedError(
+                    f"broker saturated and query's expected cost "
+                    f"{cost_hint_ms:.0f}ms >= heavy threshold "
+                    f"{self.heavy_query_ms:.0f}ms (cost-aware shedding)")
             with self._lock:
                 if self._queued >= self.max_queued:
                     raise AdmissionRejectedError(

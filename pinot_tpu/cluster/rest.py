@@ -194,11 +194,14 @@ class BrokerRestServer(_RestServer):
 
         class Handler(_JsonHandler):
             routes_get = [
-                (r"/health", lambda h, m, q: (200, {"status": "OK"})),
+                (r"/health/liveness",
+                 lambda h, m, q: (200, {"status": "OK"})),
+                (r"/health(/readiness)?", lambda h, m, q: srv._readiness()),
                 (r"/metrics", lambda h, m, q: srv._metrics()),
                 (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
                 (r"/debug/cache", lambda h, m, q: srv._debug_cache()),
                 (r"/debug/servers", lambda h, m, q: srv._debug_servers()),
+                (r"/debug/workload", lambda h, m, q: srv._debug_workload()),
                 # cursor ids are not table names: no group-based table check
                 (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
                     m.group(1), int(q.get("offset", ["0"])[0]),
@@ -231,6 +234,19 @@ class BrokerRestServer(_RestServer):
         from ..spi.metrics import BROKER_METRICS, render_prometheus
 
         return 200, RawText(render_prometheus(BROKER_METRICS, role="broker"))
+
+    def _readiness(self):
+        """A broker is ready once it has materialized at least one routing
+        snapshot — before that every query would fail routing anyway
+        (reference: BrokerResourceOnlineOfflineStateModel readiness)."""
+        ok = self.broker.is_ready()
+        return (200 if ok else 503), {"status": "OK" if ok else "STARTING"}
+
+    def _debug_workload(self):
+        """Per-table/per-client decaying cost rollups + recent cost
+        reports (cluster/workload.py) — the recommender-input section is
+        POST /recommender body-compatible."""
+        return 200, self.broker.workload.snapshot()
 
     def _debug_queries(self):
         """Slow-query ring buffer (worst traced queries over the
@@ -354,9 +370,15 @@ class ControllerRestServer(_RestServer):
 
         class Handler(_JsonHandler):
             routes_get = [
+                (r"/health/liveness",
+                 lambda h, m, q: (200, {"status": "OK"})),
+                (r"/health/readiness", lambda h, m, q: srv._health()),
+                # bare /health keeps the minimal LB-probe payload;
+                # readiness above adds the seat (leader|standby)
                 (r"/health", lambda h, m, q: (200, {"status": "OK"})),
                 (r"/metrics", lambda h, m, q: srv._metrics()),
                 (r"/tables", lambda h, m, q: srv._list_tables()),
+                (r"/debug/cluster", lambda h, m, q: srv._debug_cluster()),
                 (r"/tables/([^/]+)", lambda h, m, q: srv._get_table(m.group(1))),
                 (r"/schemas/([^/]+)", lambda h, m, q: srv._get_schema(m.group(1))),
                 (r"/segments/([^/]+)", lambda h, m, q: srv._list_segments(m.group(1))),
@@ -405,6 +427,27 @@ class ControllerRestServer(_RestServer):
 
         return 200, RawText(
             render_prometheus(CONTROLLER_METRICS, role="controller"))
+
+    def _health(self):
+        """Controller health names its seat: the leader serves writes, a
+        standby is healthy but deliberately idle (leader-gated periodic
+        tasks do not run there)."""
+        is_leader = self.controller.is_leader() \
+            if hasattr(self.controller, "is_leader") else True
+        return 200, {"status": "OK",
+                     "role": "leader" if is_leader else "standby"}
+
+    def _debug_cluster(self):
+        """Fleet health rollup materialized by the leader's
+        ClusterHealthChecker periodic task (cluster/periodic.py); a
+        standby serves the leader-written snapshot from the store."""
+        from .periodic import HEALTH_REPORT_PATH
+
+        snap = self.controller.store.get(HEALTH_REPORT_PATH)
+        if snap is None:
+            return 503, {"error": "no health snapshot yet "
+                                  "(leader scrape has not run)"}
+        return 200, snap
 
     def _list_tables(self):
         return 200, {"tables": self.controller.store.children("/CONFIGS/TABLE")}
@@ -565,6 +608,8 @@ class ServerRestServer(_RestServer):
                  lambda h, m, q: srv._debug_table(m.group(1))),
                 (r"/debug/segments", lambda h, m, q: srv._debug_segments()),
                 (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
+                (r"/debug/status",
+                 lambda h, m, q: (200, srv.server.health_status())),
             ]
             routes_post = [
                 (r"/queries/([^/]+)/kill",
@@ -582,9 +627,11 @@ class ServerRestServer(_RestServer):
         return 200, RawText(render_prometheus(SERVER_METRICS, role="server"))
 
     def _readiness(self):
-        """Readiness gates on Helix join + converged state (reference:
-        ServiceStatus consumption/ideal-state checkers)."""
-        ok = bool(getattr(self.server, "_started", False))
+        """Readiness gates on Helix join + the FIRST converge pass having
+        completed (reference: ServiceStatus ideal-state checkers) — a
+        joined-but-unconverged server would serve missing-segment errors."""
+        ok = bool(getattr(self.server, "_started", False)) \
+            and bool(getattr(self.server, "_converged", True))
         return (200 if ok else 503), {"status": "OK" if ok else "STARTING"}
 
     def _instance(self):

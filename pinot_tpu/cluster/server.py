@@ -15,6 +15,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from ..engine.query_executor import QueryExecutor
@@ -28,6 +29,14 @@ from ..engine.scheduler import QueryScheduler
 from .transport import RpcServer
 
 log = logging.getLogger(__name__)
+
+
+def _quantile(sorted_ms: list, q: float) -> float:
+    """Nearest-rank quantile over an already-sorted latency sample."""
+    if not sorted_ms:
+        return 0.0
+    idx = min(len(sorted_ms) - 1, int(q * len(sorted_ms)))
+    return round(float(sorted_ms[idx]), 3)
 
 
 class ServerInstance:
@@ -57,6 +66,16 @@ class ServerInstance:
         self._lock = threading.RLock()
         self._rpc = RpcServer(self._handle)
         self._started = False
+        # readiness (GET /health/readiness) gates on the FIRST converge
+        # pass completing, not on mere registration: a server that joined
+        # but has not loaded its ideal-state segments would answer queries
+        # with missing-segment errors
+        self._converged = False
+        # per-INSTANCE wall-ms of recent query RPCs — the straggler signal
+        # for the controller's ClusterHealthChecker (the metrics-registry
+        # timers are process-wide singletons, indistinguishable between
+        # co-hosted instances)
+        self._query_ms: deque = deque(maxlen=256)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -72,11 +91,13 @@ class ServerInstance:
         # replay current ideal states (Helix replays pending transitions on join)
         for table in self.store.children("/IDEALSTATES"):
             self._converge(table, self.store.get(f"/IDEALSTATES/{table}"))
+        self._converged = True
 
     def stop(self) -> None:
         """Simulates process death: ephemeral live-instance entry expires.
         Instance config stays (reference: ZK session expiry vs config)."""
         self._started = False
+        self._converged = False
         self._rpc.close()
         # unregister the ideal-state watcher: a dead server left in the
         # store's watch list is pinned alive with every loaded segment's
@@ -350,6 +371,45 @@ class ServerInstance:
         else:
             self._converge(table, self.store.get(f"/IDEALSTATES/{table}"))
 
+    def health_status(self) -> dict:
+        """Per-instance health beacon: answered over RPC (`status`) to the
+        controller's ClusterHealthChecker and over GET /debug/status. Reads
+        only instance-local state plus the process metric singletons — no
+        device syncs, no query-path locks beyond the instance lock."""
+        from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+
+        lat = sorted(self._query_ms)
+        with self._lock:
+            quarantined = {t: sorted(q) for t, q in self.quarantined.items()
+                           if q}
+            num_segments = sum(len(s) for s in self.segments.values())
+            num_docs = sum(int(getattr(seg, "num_docs", 0))
+                           for table in self.segments.values()
+                           for seg in table.values())
+        return {
+            "instanceId": self.instance_id,
+            "started": self._started,
+            "converged": self._converged,
+            "queryLatencyMs": {
+                "count": len(lat),
+                "p50": _quantile(lat, 0.50),
+                "p95": _quantile(lat, 0.95),
+                "p99": _quantile(lat, 0.99),
+            },
+            "hbm": GLOBAL_DEVICE_CACHE.hbm_stats(),
+            "segmentCache": {
+                "hits": SERVER_METRICS.meter_count(
+                    ServerMeter.SEGMENT_CACHE_HITS),
+                "misses": SERVER_METRICS.meter_count(
+                    ServerMeter.SEGMENT_CACHE_MISSES),
+            },
+            "hbmOomEvents": SERVER_METRICS.meter_count(
+                ServerMeter.HBM_OOM_EVENTS),
+            "quarantined": quarantined,
+            "numSegments": num_segments,
+            "numDocs": num_docs,
+        }
+
     def debug_segments(self) -> dict:
         """Hosted-vs-quarantined segment inventory for GET /debug/segments."""
         with self._lock:
@@ -438,7 +498,16 @@ class ServerInstance:
     def _handle(self, request):
         kind = request.get("type")
         if kind == "query":
-            return self._handle_query(request)
+            t0 = time.perf_counter()
+            try:
+                return self._handle_query(request)
+            finally:
+                # timed here (not in _handle_query) so scheduler waits and
+                # injected server.query delays both land in the ring — the
+                # health checker must see the latency the broker sees
+                self._query_ms.append((time.perf_counter() - t0) * 1000.0)
+        if kind == "status":
+            return self.health_status()
         if kind == "query_stream":
             return self._handle_query_stream(request)
         if kind == "explain":
@@ -524,7 +593,12 @@ class ServerInstance:
         trace = None
         if query.query_options.get("trace") in (True, "true", 1) \
                 and TRACING.active_trace() is None:
-            trace = TRACING.start_trace(f"server:{self.instance_id}")
+            # the analyze marker keeps cache tiers live under this trace
+            # (EXPLAIN ANALYZE must observe real cache behaviour)
+            trace = TRACING.start_trace(
+                f"server:{self.instance_id}",
+                analyze=query.query_options.get("analyze") in
+                (True, "true", 1))
         try:
             combined, stats = self.scheduler.submit(
                 run, group=table, timeout_s=timeout_s, query_id=query_id)

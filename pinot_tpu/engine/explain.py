@@ -185,6 +185,94 @@ def explain_plan(query, table, pruner, backend: str = "auto",
     return _table(rows)
 
 
+# span attributes rendered on EXPLAIN ANALYZE nodes, in display order;
+# everything else (HBM gauge snapshots, internals) stays in trace_info
+_ANALYZE_ATTRS = ("segment", "numSegments", "segments", "mode", "padded",
+                  "fused", "workers", "leaf_pushdown", "rows_in", "rows_out",
+                  "shuffled_rows", "shuffled_bytes", "compileMs",
+                  "deviceExecMs", "transferBytes", "cache")
+
+
+def _cache_outcome(resp) -> str:
+    """One word for the run's cache behaviour: broker result-cache outcome
+    when known, else the segment-cache hit/miss counters."""
+    outcome = getattr(resp, "cache_outcome", None)
+    if outcome == "hit":
+        return "hit"
+    hits = getattr(resp, "num_segments_cache_hit", 0)
+    misses = getattr(resp, "num_segments_cache_miss", 0)
+    if hits and not misses and not getattr(resp, "num_device_dispatches", 0):
+        return "hit"
+    if hits and misses:
+        return "partial"
+    if misses:
+        return "miss"
+    if hits:
+        return "hit"
+    # no segment-cache traffic at all: report the broker result-cache
+    # outcome (a cacheable run that missed is "miss", bypass is "off")
+    return "miss" if outcome == "miss" else "off"
+
+
+def analyze_table(trace_json: list, resp, table_name: str = "") -> ResultTable:
+    """Render an executed run's span tree as the (Operator, Operator_Id,
+    Parent_Id) plan table, each node annotated with its observed stats —
+    the EXPLAIN ANALYZE product. Works on both the engine-local trace
+    (integer span ids) and the broker's merged cross-server trace
+    (ids namespaced ``instance:id``); spans whose parent is missing attach
+    to the root so a partial trace still renders one connected tree."""
+    rows: list[list] = []
+    next_id = [0]
+
+    def add(op: str, parent: int) -> int:
+        oid = next_id[0]
+        next_id[0] += 1
+        rows.append([op, oid, parent])
+        return oid
+
+    n_rows = len(resp.result_table.rows) if getattr(
+        resp, "result_table", None) is not None else 0
+    parts = [f"table:{table_name}"] if table_name else []
+    parts += [f"rows:{n_rows}",
+              f"timeMs:{round(getattr(resp, 'time_used_ms', 0.0), 3)}",
+              f"docsScanned:{getattr(resp, 'num_docs_scanned', 0)}",
+              f"segments:{getattr(resp, 'num_segments_processed', 0)}",
+              f"dispatches:{getattr(resp, 'num_device_dispatches', 0)}",
+              f"compiles:{getattr(resp, 'num_compiles', 0)}",
+              f"cacheHit:{getattr(resp, 'num_segments_cache_hit', 0)}",
+              f"cacheMiss:{getattr(resp, 'num_segments_cache_miss', 0)}",
+              f"cache:{_cache_outcome(resp)}"]
+    if getattr(resp, "num_hedged_requests", 0):
+        parts.append(f"hedged:{resp.num_hedged_requests}")
+    if getattr(resp, "num_scatter_retries", 0):
+        parts.append(f"retries:{resp.num_scatter_retries}")
+    root = add("EXPLAIN_ANALYZE(" + ", ".join(parts) + ")", -1)
+
+    by_span: dict = {}  # trace spanId -> plan row id
+    for s in trace_json:
+        label = s.get("operator", "?")
+        bits = []
+        attrs = s.get("attributes") or {}
+        for k in _ANALYZE_ATTRS:
+            if k in attrs:
+                bits.append(f"{k}:{attrs[k]}")
+        bits.append(f"ms:{s.get('durationMs', 0.0)}")
+        server = s.get("server")
+        if server:
+            label = f"{server}/{label}"
+        parent = by_span.get(s.get("parentId"), root)
+        by_span[s.get("spanId")] = add(
+            label + "(" + ", ".join(bits) + ")", parent)
+    if not trace_json:
+        if getattr(resp, "cache_outcome", None) == "hit":
+            # broker result-cache hit: nothing executed, no spans — the
+            # whole answer came from the cache tier
+            add(f"RESULT_CACHE(hit, rows:{n_rows}, dispatches:0)", root)
+        else:
+            add("NO_TRACE(execution recorded no spans)", root)
+    return _table(rows)
+
+
 def _walk_filter(node, parent: int, add) -> None:
     if isinstance(node, ir.FAnd):
         oid = add("AND", parent)

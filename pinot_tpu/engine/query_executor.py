@@ -170,6 +170,9 @@ class QueryExecutor:
         if table is None:
             return BrokerResponse(exceptions=[f"table {query.table_name} not found"])
 
+        if getattr(query, "explain", False) == "analyze":
+            return self._execute_analyze(query, tracker=tracker)
+
         if getattr(query, "explain", False):
             from .explain import explain_plan
 
@@ -229,6 +232,40 @@ class QueryExecutor:
             TRACING.end_trace()
             resp.trace_info = trace.to_json()
         return resp
+
+    def _execute_analyze(self, query: QueryContext,
+                         tracker=None) -> BrokerResponse:
+        """EXPLAIN ANALYZE: run the query for real with an analyze-flagged
+        trace (caches stay live) and return the span tree rendered as the
+        annotated plan table, counters carried over from the actual run."""
+        import copy
+
+        from .explain import analyze_table
+
+        sub = copy.copy(query)
+        sub.explain = False
+        sub.query_options = dict(query.query_options)
+        sub.query_options["trace"] = True
+        owns = TRACING.active_trace() is None
+        if owns:
+            trace = TRACING.start_trace(
+                f"analyze:{query.table_name}", analyze=True)
+        else:
+            trace = TRACING.active_trace()
+        try:
+            resp = self.execute(sub, tracker=tracker)
+        finally:
+            if owns:
+                TRACING.end_trace()
+        if resp.exceptions:
+            return resp
+        trace_json = resp.trace_info if resp.trace_info is not None \
+            else trace.to_json()
+        out = copy.copy(resp)
+        out.result_table = analyze_table(trace_json, resp,
+                                         table_name=query.table_name)
+        out.trace_info = trace_json
+        return out
 
     def execute_selection_columnar(self, query: QueryContext):
         """Columnar leaf for MSE scan+filter stages: device filter mask →
@@ -425,9 +462,11 @@ class QueryExecutor:
         # intermediate directly and the segment never reaches dispatch; a
         # miss is remembered so the collected result is inserted below.
         # Traced runs bypass — the dispatch spans ARE the observability
-        # product and must describe real device work.
+        # product and must describe real device work. EXPLAIN ANALYZE is
+        # the exception: it must report the cache behaviour of a real run.
         cache_on = device_entries and self._segment_cache_enabled(query)
-        if cache_on and TRACING.active_trace() is not None:
+        if cache_on and TRACING.active_trace() is not None \
+                and not TRACING.analyze_active():
             with TRACING.scope("SEGMENT_CACHE(bypass:trace)"):
                 cache_on = False
         cache_inserts: list = []  # (idx, cache key, segment name)
@@ -756,7 +795,7 @@ class QueryExecutor:
         # HBM budget, so partial overlap still skips member dispatches and
         # feeds the device combine directly.
         cache_on = self._segment_cache_enabled(query) \
-            and TRACING.active_trace() is None
+            and (TRACING.active_trace() is None or TRACING.analyze_active())
         keys = None
         merged_key = None
         if cache_on:
@@ -775,6 +814,12 @@ class QueryExecutor:
                     if tracker is not None:
                         GLOBAL_ACCOUNTANT.on_allocation(
                             tracker, _estimate_bytes(hit))
+                    with TRACING.scope("SEGMENT_CACHE(hit:merged)") as sp:
+                        if sp is not None:
+                            sp.set_attribute("segments", len(segs))
+                            sp.set_attribute("cache", "hit")
+                            sp.set_attribute("cacheHitBytes",
+                                             int(_estimate_bytes(hit)))
                     return [hit]
         try:
             # one vmapped dispatch per batch family; members pull lazy

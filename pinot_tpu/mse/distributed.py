@@ -47,6 +47,7 @@ from ..engine.aggregation import UnsupportedQueryError
 from ..engine.reduce import BrokerReducer
 from ..engine.results import BrokerResponse
 from ..spi import faults
+from ..spi.trace import TRACING
 from ..query.converter import filter_from_expression
 from ..query.expressions import ExpressionContext
 from .executor import _block_to_result
@@ -444,6 +445,24 @@ class MseWorkerService:
 
     # -- stage execution ---------------------------------------------------
     def _run_stage(self, request: dict) -> dict:
+        # trace ships back in the stats payload so the dispatcher can merge
+        # every worker's spans into one broker-side tree (the scatter/gather
+        # path in cluster/broker.py does the same for leaf queries)
+        opts = request.get("options") or {}
+        if opts.get("trace") not in (True, "true", 1) \
+                or TRACING.active_trace() is not None:
+            return self._run_stage_inner(request)
+        trace = TRACING.start_trace(
+            f"mse:{self.server.instance_id}",
+            analyze=opts.get("analyze") in (True, "true", 1))
+        try:
+            stats = self._run_stage_inner(request)
+            stats["trace"] = trace.to_json()
+            return stats
+        finally:
+            TRACING.end_trace()
+
+    def _run_stage_inner(self, request: dict) -> dict:
         stage = stage_from_json(request["stage"])
         query_id = request["query_id"]
         worker = request["worker"]
@@ -477,26 +496,36 @@ class MseWorkerService:
         runner.stats["exec_start_ts"] = time.monotonic()
         sstat = runner._sstat(stage.stage_id)
         t0 = time.perf_counter()
-        pushed = runner._try_ssqe(stage) if stage.is_leaf else None
-        if pushed is not None:
-            runner.stats["leaf_ssqe_pushdowns"] += 1
-            sstat["leaf_pushdown"] = True
-            block = pushed
-        else:
-            if stage.is_leaf and runner._null_handling_requested():
-                raise UnsupportedQueryError(
-                    "enableNullHandling requires this leaf stage to push "
-                    "down to the single-stage engine")
-            block = runner._exec(stage.root, stage, worker)
-        sstat["workers"] = 1  # this worker's share; the dispatcher sums
-        sstat["rows_out"] += block_len(block)
-        mailbox.send_partitioned(stage.stage_id, stage.parent_stage,
-                                 runner._trim_to_send(stage, block),
-                                 stage.send_dist, stage.send_keys,
-                                 parent_workers, pfunc=stage.send_pfunc)
-        sstat["wall_ms"] += (time.perf_counter() - t0) * 1000
-        sstat["shuffled_rows"] = mailbox.sent_rows[stage.stage_id]
-        sstat["shuffled_bytes"] = mailbox.sent_bytes[stage.stage_id]
+        with TRACING.scope(f"mse_stage:{stage.stage_id}") as span:
+            pushed = runner._try_ssqe(stage) if stage.is_leaf else None
+            if pushed is not None:
+                runner.stats["leaf_ssqe_pushdowns"] += 1
+                sstat["leaf_pushdown"] = True
+                block = pushed
+            else:
+                if stage.is_leaf and runner._null_handling_requested():
+                    raise UnsupportedQueryError(
+                        "enableNullHandling requires this leaf stage to push "
+                        "down to the single-stage engine")
+                block = runner._exec(stage.root, stage, worker)
+            sstat["workers"] = 1  # this worker's share; the dispatcher sums
+            sstat["rows_out"] += block_len(block)
+            mailbox.send_partitioned(stage.stage_id, stage.parent_stage,
+                                     runner._trim_to_send(stage, block),
+                                     stage.send_dist, stage.send_keys,
+                                     parent_workers, pfunc=stage.send_pfunc)
+            sstat["wall_ms"] += (time.perf_counter() - t0) * 1000
+            sstat["shuffled_rows"] = mailbox.sent_rows[stage.stage_id]
+            sstat["shuffled_bytes"] = mailbox.sent_bytes[stage.stage_id]
+            if span is not None:
+                span.set_attribute("worker", worker)
+                span.set_attribute("rows_out", int(sstat["rows_out"]))
+                span.set_attribute("shuffled_rows",
+                                   int(sstat["shuffled_rows"]))
+                span.set_attribute("shuffled_bytes",
+                                   int(sstat["shuffled_bytes"]))
+                if sstat.get("leaf_pushdown"):
+                    span.set_attribute("leaf_pushdown", True)
         runner.stats["join_overflow"] = (
             pop_join_overflow() or bool(runner.stats.get("join_overflow")))
         runner.stats["first_send_ts"] = mailbox.first_send_ts
@@ -816,6 +845,11 @@ class DistributedMseDispatcher:
         except Exception as e:
             resp = BrokerResponse(exceptions=[f"{type(e).__name__}: {e}"])
         resp.time_used_ms = (_time.perf_counter() - t0) * 1000
+        if getattr(resp, "_analyze_pending", False):
+            from ..engine.explain import analyze_table
+
+            resp._analyze_pending = False
+            resp.result_table = analyze_table(resp.trace_info or [], resp)
         return resp
 
     def _execute(self, sql: str) -> BrokerResponse:
@@ -828,7 +862,8 @@ class DistributedMseDispatcher:
         plan = push_filters(plan)
         prune_columns(plan)
         stages = fragment(plan)
-        if query.explain:
+        analyze = query.explain == "analyze"
+        if query.explain and not analyze:
             text = explain_stages(stages)
             return BrokerResponse(result_table=ResultTable(
                 DataSchema(["plan"], ["STRING"]),
@@ -903,6 +938,25 @@ class DistributedMseDispatcher:
         # deadlock the dispatch of its own children to the same instance.
         from ..cluster.transport import RpcClient
 
+        # EXPLAIN ANALYZE (or an explicit trace option) arms a dispatcher
+        # trace; workers see trace/analyze in their options and ship spans
+        # back for the merge in the gather loop. Armed here — after worker
+        # placement, which can raise — so the finally below always unwinds
+        # the thread-local.
+        trace = None
+        own_trace = False
+        if (analyze or (query.options or {}).get("trace") in
+                (True, "true", 1)) and TRACING.active_trace() is None:
+            trace = TRACING.start_trace(f"mse:{query_id}", analyze=analyze)
+            own_trace = True
+        else:
+            trace = TRACING.active_trace()
+        if trace is not None:
+            query.options = dict(query.options or {})
+            query.options["trace"] = True
+            if getattr(trace, "analyze", False):
+                query.options["analyze"] = True
+
         stats_agg = {"num_docs_scanned": 0, "total_docs": 0,
                      "leaf_ssqe_pushdowns": 0, "stages": len(stages),
                      "num_device_dispatches": 0, "num_compiles": 0,
@@ -931,7 +985,7 @@ class DistributedMseDispatcher:
                 req["deadline_ms"] = max(
                     50.0, (deadline - time.monotonic()) * 1000.0)
             try:
-                return client.call(req, retry=False)
+                return w["instance"], client.call(req, retry=False)
             finally:
                 client.close()
 
@@ -955,8 +1009,11 @@ class DistributedMseDispatcher:
                         child_workers))
 
             stage_stats_agg: dict[int, dict] = {}
+            worker_traces: list[tuple[str, list]] = []
             for f in futures:
-                st = f.result()
+                inst, st = f.result()
+                if st.get("trace"):
+                    worker_traces.append((inst, st["trace"]))
                 for k in ("num_docs_scanned", "total_docs",
                           "leaf_ssqe_pushdowns", "num_device_dispatches",
                           "num_compiles"):
@@ -984,7 +1041,7 @@ class DistributedMseDispatcher:
                                     len(workers.get(final_sid, []))),
                 stages[0].root.schema)
             result = _block_to_result(block, stages[0].root.schema)
-            return BrokerResponse(
+            resp = BrokerResponse(
                 result_table=result,
                 num_docs_scanned=stats_agg["num_docs_scanned"],
                 total_docs=stats_agg["total_docs"],
@@ -993,6 +1050,30 @@ class DistributedMseDispatcher:
                 num_device_dispatches=stats_agg["num_device_dispatches"],
                 num_compiles=stats_agg["num_compiles"],
                 mse_stage_stats=stage_stats_agg)
+            if trace is not None:
+                trace_info = trace.to_json()
+                # namespace per (instance, dispatch ordinal): one instance
+                # can serve several stage workers, and bare instance
+                # prefixes would collide their span ids
+                ordinal: dict[str, int] = {}
+                for inst, spans in worker_traces:
+                    n = ordinal.get(inst, 0)
+                    ordinal[inst] = n + 1
+                    prefix = inst if n == 0 else f"{inst}#{n}"
+                    for s in spans:
+                        s = dict(s)
+                        s["spanId"] = f"{prefix}:{s['spanId']}"
+                        if s.get("parentId") is not None:
+                            s["parentId"] = f"{prefix}:{s['parentId']}"
+                        else:
+                            s["server"] = inst
+                        trace_info.append(s)
+                resp.trace_info = trace_info
+                # the annotated-plan render is deferred to execute_sql so
+                # the root row carries the real wall time (time_used_ms is
+                # only stamped there)
+                resp._analyze_pending = analyze
+            return resp
         except Exception:
             # a failed worker must not hang its peers in receive/backpressure:
             # stop still-queued dispatches (they'd land on instances the
@@ -1015,6 +1096,8 @@ class DistributedMseDispatcher:
                     pass
             raise
         finally:
+            if own_trace:
+                TRACING.end_trace()
             self.boxes.cleanup(query_id)
             for inst in touched:
                 try:

@@ -96,10 +96,14 @@ class MultistageExecutor:
         try:
             query = parse_relational(sql)
             # the MSE entry owns the span tree: stage spans (runtime.py)
-            # and nested leaf-engine dispatch spans all join this trace
-            if query.options.get("trace") in (True, "true", 1) \
+            # and nested leaf-engine dispatch spans all join this trace.
+            # EXPLAIN ANALYZE arms it unconditionally (analyze-flagged so
+            # cache layers stay live) — the annotated plan IS the trace.
+            analyze = query.explain == "analyze"
+            if (analyze or query.options.get("trace") in (True, "true", 1)) \
                     and TRACING.active_trace() is None:
-                trace = TRACING.start_trace(f"mse:{id(query):x}")
+                trace = TRACING.start_trace(f"mse:{id(query):x}",
+                                            analyze=analyze)
             planner = LogicalPlanner(query, self._catalog(),
                                      partition_catalog=self._partition_catalog)
             plan = planner.plan()
@@ -148,6 +152,11 @@ class MultistageExecutor:
                 time_used_ms=(time.perf_counter() - t0) * 1000)
             if trace is not None:
                 resp.trace_info = trace.to_json()
+            if analyze:
+                from ..engine.explain import analyze_table
+
+                resp.result_table = analyze_table(
+                    resp.trace_info or [], resp)
             return resp
         except Exception as e:
             return BrokerResponse(

@@ -56,8 +56,14 @@ class _RelationalParser(_Parser):
             # EXPLAIN IMPLEMENTATION [PLAN] [FOR]: execute the query and
             # annotate each stage with its runtime stats (rows in/out,
             # shuffled bytes, wall time)
-            explain = "implementation" if self.accept_kw("IMPLEMENTATION") \
-                else True
+            if self.accept_kw("IMPLEMENTATION"):
+                explain = "implementation"
+            elif self.accept_kw("ANALYZE"):
+                # EXPLAIN ANALYZE: run with tracing armed and annotate each
+                # stage with observed rows, shuffle volume, and phase ms
+                explain = "analyze"
+            else:
+                explain = True
             self.accept_kw("PLAN")
             self.accept_kw("FOR")
         stmt = self._parse_statement()

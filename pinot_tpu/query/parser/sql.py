@@ -139,6 +139,11 @@ class _Parser:
             # same contract as the MSE parser (mse/parser.py)
             if self.accept_kw("IMPLEMENTATION"):
                 explain = "implementation"
+            elif self.accept_kw("ANALYZE"):
+                # EXPLAIN ANALYZE runs the query for real (tracing armed,
+                # caches live) and annotates the plan with observed rows,
+                # dispatches, and phase timings
+                explain = "analyze"
             else:
                 self.accept_kw("PLAN")
                 explain = True

@@ -100,10 +100,16 @@ class ControllerMeter:
     STORE_RECOVERIES = "storeRecoveries"
     STORE_JOURNAL_TRUNCATIONS = "storeJournalTruncations"
     STORE_SNAPSHOTS = "storeSnapshots"
+    # cluster-health rollup (cluster/periodic.py ClusterHealthChecker):
+    # one tick per anomaly flagged in a scrape (straggler, hbm-pressure,
+    # cache-collapse, breaker-flap, instance-unreachable)
+    CLUSTER_HEALTH_ANOMALIES = "clusterHealthAnomalies"
 
 
 class ControllerGauge:
     STORE_JOURNAL_BYTES = "storeJournalBytes"
+    # servers that answered the last health scrape (leader only)
+    CLUSTER_SERVERS_REACHABLE = "clusterServersReachable"
 
 
 # log-bucketed histogram resolution: 4 buckets per power of two keeps the

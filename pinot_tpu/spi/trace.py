@@ -81,6 +81,10 @@ class Trace:
     def __init__(self, query_id: str):
         self.query_id = query_id
         self.spans: list[Span] = []
+        # EXPLAIN ANALYZE arms tracing but must observe the REAL execution,
+        # caches included — cache layers consult this flag instead of
+        # unconditionally bypassing when a trace is active
+        self.analyze = False
         self._t0 = time.perf_counter()
         # list.append and itertools.count.__next__ are GIL-atomic, so
         # combine workers on adopted traces need no lock here
@@ -129,17 +133,21 @@ def phase_breakdown(trace_json: list) -> dict:
     emits: compile vs device-execute vs host-combine time and host->device
     transfer volume (keys sum over every span carrying the attribute)."""
     out = {"compileMs": 0.0, "deviceExecMs": 0.0, "hostCombineMs": 0.0,
-           "transferBytes": 0}
+           "transferBytes": 0, "shuffledBytes": 0}
     for span in trace_json:
         attrs = span.get("attributes") or {}
         out["compileMs"] += attrs.get("compileMs", 0.0)
         out["deviceExecMs"] += attrs.get("deviceExecMs", 0.0)
         out["transferBytes"] += attrs.get("transferBytes", 0)
+        out["shuffledBytes"] += attrs.get("shuffled_bytes", 0)
         if span.get("operator") in (ServerQueryPhase.SERVER_COMBINE,
                                     "BROKER_REDUCE"):
             out["hostCombineMs"] += span.get("durationMs", 0.0)
     for k in ("compileMs", "deviceExecMs", "hostCombineMs"):
         out[k] = round(out[k], 3)
+    if not out["shuffledBytes"]:
+        # MSE-only phase: single-stage queries keep the classic four-key shape
+        del out["shuffledBytes"]
     return out
 
 
@@ -160,14 +168,22 @@ class _Tracing:
     def register_tracer(self, tracer: Tracer) -> None:
         self._tracer = tracer
 
-    def start_trace(self, query_id: str) -> Trace:
+    def start_trace(self, query_id: str, analyze: bool = False) -> Trace:
         trace = self._tracer.new_trace(query_id)
+        trace.analyze = analyze
         self._local.trace = trace
         self._local.stack = []
         return trace
 
     def active_trace(self) -> Optional[Trace]:
         return getattr(self._local, "trace", None)
+
+    def analyze_active(self) -> bool:
+        """True when the active trace belongs to an EXPLAIN ANALYZE run —
+        cache layers stay ON (the annotated plan must show the cache
+        behaviour a real run would have)."""
+        trace = self.active_trace()
+        return trace is not None and getattr(trace, "analyze", False)
 
     def current_span(self) -> Optional[Span]:
         stack = getattr(self._local, "stack", None)

@@ -100,6 +100,20 @@ class SoakFailure(AssertionError):
     pass
 
 
+def _capture_cluster_report(store, controller, broker) -> dict:
+    """Pre-teardown capture for ``--report``: the broker's per-table cost
+    aggregates plus one cluster-health scrape (anomaly list + fleet
+    rollup) taken while the servers are still live."""
+    from pinot_tpu.cluster.periodic import ClusterHealthChecker
+
+    out = {"workload": broker.workload.snapshot()}
+    health = ClusterHealthChecker(store, controller)()
+    out["anomalies"] = health.get("anomalies", [])
+    if health.get("fleet"):
+        out["fleet"] = health["fleet"]
+    return out
+
+
 # ════════════════════════════════════════════════════════════════════════════
 # Suite 1: randomized SQL vs sqlite oracle
 # ════════════════════════════════════════════════════════════════════════════
@@ -361,7 +375,8 @@ def soak_sql(seconds: float = 60.0, seed: int = 0, rows: int = 1600,
 def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
                replication: int = 2, n_segments: int = 6,
                rows_per_segment: int = 400, fault_rate: float = 0.0,
-               corrupt_rate: float = 0.0, progress=None) -> dict:
+               corrupt_rate: float = 0.0, progress=None,
+               capture_report: bool = False) -> dict:
     """ChaosMonkey soak: continuous exact-result broker queries while
     servers die/restart, RebalanceChecker heals, and minion merge-rollup
     compacts concurrently. Returns counters.
@@ -518,6 +533,15 @@ def soak_chaos(seconds: float = 60.0, seed: int = 0, n_servers: int = 3,
             if progress and stats["queries"] % 500 == 0:
                 progress(f"chaos: {stats}")
     finally:
+        if capture_report:
+            # must run before teardown: the broker's workload tracker and
+            # a health scrape of still-live servers feed the --report
+            # artifact; never let capture mask a soak failure
+            try:
+                stats.update(_capture_cluster_report(store, controller,
+                                                     broker))
+            except Exception:
+                pass
         if corrupt_rate > 0 and integrity0 is not None:
             # the integrity ledger: every injected corruption must show up
             # as a detection (load-verify or wire checksum), and repairs +
@@ -556,7 +580,8 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
              concurrency: int = 8, n_servers: int = 3, replication: int = 2,
              n_segments: int = 6, rows_per_segment: int = 400,
              fault_rate: float = 0.0, corrupt_rate: float = 0.0,
-             max_inflight: int = 0, progress=None) -> dict:
+             max_inflight: int = 0, progress=None,
+             capture_report: bool = False) -> dict:
     """Closed-loop QPS soak: ``concurrency`` workers pace an aggregate
     ``qps`` arrival rate of exact-result queries against an embedded
     cluster, reporting p50/p99 latency under load, achieved QPS, and the
@@ -696,6 +721,13 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
             t.join()
     finally:
         corruptions_injected = faults.FAULTS.fired_kind("corrupt")
+        report_extra: dict = {}
+        if capture_report:
+            try:
+                report_extra = _capture_cluster_report(store, controller,
+                                                       broker)
+            except Exception:
+                pass
         if fault_rate > 0 or corrupt_rate > 0:
             faults.FAULTS.reset()
         for s in servers:
@@ -725,6 +757,7 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
         "rejected_meter": meters[BrokerMeter.QUERIES_REJECTED],
         "circuit_opened": meters[BrokerMeter.CIRCUIT_OPEN],
     }
+    out.update(report_extra)
     if corrupt_rate > 0:
         out["corruptions"] = {
             "injected": corruptions_injected,
@@ -853,7 +886,8 @@ def soak_realtime(rounds: int = 3, seed: int = 0, rows_per_round: int = 50,
 
 
 def soak_failover(seconds: float = 30.0, seed: int = 0,
-                  rows_per_segment: int = 40, progress=None) -> dict:
+                  rows_per_segment: int = 40, progress=None,
+                  capture_report: bool = False) -> dict:
     """Controller chaos: continuous exact-result broker queries plus a
     two-replica realtime ingest while the lead controller is killed and
     restarted (including windows with NO claimable leader). Invariants:
@@ -1046,6 +1080,17 @@ def soak_failover(seconds: float = 30.0, seed: int = 0,
                     f"failover (seed {seed}): consumer {tag} reached ERROR "
                     "— outages must HOLD, never ERROR")
     finally:
+        if capture_report:
+            # scrape through whichever live controller holds the leader
+            # seat — a standby's checker correctly refuses to scrape
+            try:
+                ctrl = next((c for c in live.values()
+                             if c.leader.is_leader), None)
+                if ctrl is not None:
+                    stats.update(_capture_cluster_report(store, ctrl,
+                                                         broker))
+            except Exception:
+                pass
         rt_a.stop()
         rt_b.stop()
         for s in servers:
@@ -1107,6 +1152,11 @@ def main(argv=None) -> int:
                         "layer must detect every strike — the summary "
                         "reports corruptions injected/detected/repaired, "
                         "and a silently wrong full answer fails the soak")
+    p.add_argument("--report", metavar="PATH", default=None,
+                   help="write a machine-readable run artifact (JSON) to "
+                        "PATH: per-suite results, final per-role metrics "
+                        "snapshots, broker cost-report aggregates, and the "
+                        "anomaly list from a closing cluster-health scrape")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -1125,25 +1175,54 @@ def main(argv=None) -> int:
             results.append(soak_chaos(
                 seconds=args.seconds, seed=args.seed,
                 fault_rate=args.fault_rate,
-                corrupt_rate=args.corrupt_rate, progress=progress))
+                corrupt_rate=args.corrupt_rate, progress=progress,
+                capture_report=bool(args.report)))
         if args.suite == "qps":
             results.append(soak_qps(
                 seconds=args.seconds, seed=args.seed, qps=args.qps,
                 concurrency=args.concurrency, fault_rate=args.fault_rate,
                 corrupt_rate=args.corrupt_rate,
-                max_inflight=args.max_inflight, progress=progress))
+                max_inflight=args.max_inflight, progress=progress,
+                capture_report=bool(args.report)))
         if args.suite in ("realtime", "all"):
             results.append(soak_realtime(
                 rounds=args.rounds, seed=args.seed, progress=progress))
         if args.suite == "failover":
             results.append(soak_failover(
-                seconds=args.seconds, seed=args.seed, progress=progress))
+                seconds=args.seconds, seed=args.seed, progress=progress,
+                capture_report=bool(args.report)))
     except SoakFailure as e:
         failed = str(e)
 
     summary = {"ok": failed is None, "results": results}
     if failed:
         summary["failure"] = failed
+    if args.report:
+        from pinot_tpu.spi.metrics import (BROKER_METRICS,
+                                           CONTROLLER_METRICS,
+                                           SERVER_METRICS)
+        anomalies = []
+        cost_reports = {}
+        for r in results:
+            for a in r.get("anomalies", ()):
+                anomalies.append(dict(a, suite=r.get("suite")))
+            if r.get("workload"):
+                cost_reports[r["suite"]] = r["workload"]
+        report = {
+            "schemaVersion": 1,
+            "generatedAtMs": int(time.time() * 1000),
+            "ok": failed is None,
+            "failure": failed,
+            "config": vars(args),
+            "results": results,
+            "metrics": {"server": SERVER_METRICS.snapshot(),
+                        "broker": BROKER_METRICS.snapshot(),
+                        "controller": CONTROLLER_METRICS.snapshot()},
+            "costReports": cost_reports,
+            "anomalies": anomalies,
+        }
+        Path(args.report).write_text(json.dumps(report, indent=2))
+        progress(f"report written to {args.report}")
     print(json.dumps(summary))
     return 0 if failed is None else 1
 

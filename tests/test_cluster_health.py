@@ -1,0 +1,183 @@
+"""Cluster health rollup: /health endpoints, the leader-gated
+ClusterHealthChecker scrape, and named anomaly detection.
+
+Acceptance shape: an injected straggler (delay fault on one server's
+query path) and injected HBM pressure must both surface as NAMED
+anomalies in GET /debug/cluster within ONE scrape, standby controllers
+must not scrape, and armed scrapes must move the new controller
+metrics (`clusterHealthAnomalies` meter, `clusterServersReachable`
+gauge).
+"""
+
+from __future__ import annotations
+
+import json
+import tempfile
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                               ServerInstance)
+from pinot_tpu.cluster.periodic import (HEALTH_REPORT_PATH,
+                                        ClusterHealthChecker,
+                                        build_default_scheduler)
+from pinot_tpu.cluster.rest import (BrokerRestServer, ControllerRestServer,
+                                    ServerRestServer)
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.metrics import (CONTROLLER_METRICS, ControllerGauge,
+                                   ControllerMeter)
+
+SCHEMA = Schema.build("hlt", dimensions=[("team", "STRING")],
+                      metrics=[("runs", "INT")])
+SQL = "SELECT team, SUM(runs) FROM hlt GROUP BY team"
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    d = Path(tempfile.mkdtemp(prefix="hlt_cluster_"))
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="host")
+               for i in range(2)]
+    for s in servers:
+        s.start()
+    controller.add_schema(SCHEMA.to_json())
+    controller.create_table({"tableName": "hlt", "replication": 2})
+    rng = np.random.default_rng(3)
+    for i in range(3):
+        cols = {"team": np.asarray(["a", "b", "c", "d"], dtype=object)[
+                    rng.integers(0, 4, 60)],
+                "runs": rng.integers(0, 100, 60).astype(np.int32)}
+        name = f"hlt_{i}"
+        SegmentBuilder(SCHEMA, segment_name=name).build(cols, d / name)
+        controller.add_segment("hlt_OFFLINE", name,
+                               {"location": str(d / name), "numDocs": 60})
+    broker = Broker(store, broker_id="Broker_hlt", adaptive_selection=False)
+    broker.backoff_base_s = 0.001
+    # readiness + latency samples on BOTH servers (any single query may
+    # route its whole shard plan to one instance)
+    for i in range(5):
+        resp = broker.execute_sql(f"SET resultCache = false; {SQL} "
+                                  f"LIMIT {30 + i}")
+        assert not resp.exceptions, resp.exceptions
+    yield store, controller, servers, broker
+    for s in servers:
+        s.stop()
+
+
+def test_health_endpoints_all_roles(cluster):
+    store, controller, servers, broker = cluster
+    rests = [ServerRestServer(servers[0]), BrokerRestServer(broker),
+             ControllerRestServer(controller)]
+    try:
+        for rest in rests:
+            code, body = _get(rest.url + "/health/liveness")
+            assert code == 200 and body["status"] == "OK"
+            code, body = _get(rest.url + "/health")
+            assert code == 200, body
+            assert body["status"] == "OK"
+        # readiness alias answers too, and the controller names its seat
+        code, body = _get(rests[2].url + "/health/readiness")
+        assert code == 200 and body["role"] == "leader"
+        code, status = _get(rests[0].url + "/debug/status")
+        assert code == 200
+        assert status["instanceId"] == "Server_0"
+        assert status["queryLatencyMs"]["count"] >= 1
+        assert "hbm" in status and "segmentCache" in status
+    finally:
+        for rest in rests:
+            rest.close()
+
+
+def test_scheduler_registers_health_checker(cluster):
+    store, controller, _, _ = cluster
+    sched = build_default_scheduler(store, controller, interval_s=10.0)
+    assert "ClusterHealthChecker" in sched.tasks
+
+
+def test_straggler_and_hbm_pressure_named_within_one_scrape(cluster):
+    store, _, servers, broker = cluster
+    c1 = ClusterController(store, instance_id="hc1")
+    c2 = ClusterController(store, instance_id="hc2")
+    rest = ControllerRestServer(c2)  # standby serves the leader's snapshot
+    meter0 = CONTROLLER_METRICS.meter_count(
+        ControllerMeter.CLUSTER_HEALTH_ANOMALIES)
+    try:
+        assert c1.is_leader() and not c2.is_leader()
+        checker = ClusterHealthChecker(store, c1)
+
+        # build the latency skew: every Server_0 query eats a 0.25 s delay
+        faults.FAULTS.arm("server.query", faults.FaultSpec(
+            kind="delay", delay_s=0.25, times=None,
+            match=lambda ctx: ctx.get("instance") == "Server_0"))
+        try:
+            for i in range(10):
+                resp = broker.execute_sql(
+                    f"SET resultCache = false; {SQL} LIMIT {10 + i}")
+                assert not resp.exceptions, resp.exceptions
+        finally:
+            faults.FAULTS.reset()
+
+        # inject HBM pressure: 95% of budget used, threshold is 90%
+        from pinot_tpu.segment.device_cache import GLOBAL_DEVICE_CACHE
+        orig = GLOBAL_DEVICE_CACHE.hbm_stats
+        GLOBAL_DEVICE_CACHE.hbm_stats = lambda: {
+            "hbmBytesUsed": 950, "hbmBudgetBytes": 1000, "hbmEvictions": 0,
+            "hbmPartialEntries": 0, "hbmPartialBytes": 0}
+        try:
+            snap = checker()  # ONE scrape sees both
+        finally:
+            GLOBAL_DEVICE_CACHE.hbm_stats = orig
+
+        kinds = {a["type"] for a in snap["anomalies"]}
+        assert "straggler" in kinds, snap["anomalies"]
+        assert "hbm-pressure" in kinds, snap["anomalies"]
+        stragglers = [a for a in snap["anomalies"]
+                      if a["type"] == "straggler"]
+        assert stragglers[0]["instance"] == "Server_0", stragglers
+
+        # the snapshot is served over REST from ANY controller
+        code, body = _get(rest.url + "/debug/cluster")
+        assert code == 200
+        assert {a["type"] for a in body["anomalies"]} == kinds
+        assert body["fleet"]["serversReachable"] == 2
+
+        # armed scrapes move the new controller metrics
+        assert CONTROLLER_METRICS.meter_count(
+            ControllerMeter.CLUSTER_HEALTH_ANOMALIES) - meter0 >= 2
+        assert CONTROLLER_METRICS.gauge_value(
+            ControllerGauge.CLUSTER_SERVERS_REACHABLE) == 2.0
+
+        # standby controllers do NOT scrape: the checker refuses and the
+        # leader-written snapshot stays untouched
+        before = store.get(HEALTH_REPORT_PATH)["checkedAtMs"]
+        out = ClusterHealthChecker(store, c2)()
+        assert out.get("skipped"), out
+        assert store.get(HEALTH_REPORT_PATH)["checkedAtMs"] == before
+    finally:
+        rest.close()
+        c1.stop()
+        c2.stop()
+
+
+def test_broker_state_beacon_reaches_rollup(cluster):
+    store, controller, _, broker = cluster
+    broker.publish_state()
+    snap = ClusterHealthChecker(store, controller)()
+    assert "Broker_hlt" in snap["brokers"], snap["brokers"]
+    b = snap["brokers"]["Broker_hlt"]
+    assert "breakers" in b and "queryP99Ms" in b

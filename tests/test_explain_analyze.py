@@ -1,0 +1,269 @@
+"""EXPLAIN ANALYZE oracle tests + e2e distributed span-tree checks.
+
+The oracle property: the annotated counts on the EXPLAIN ANALYZE tree
+must match what the same query actually returns — `rows:N` on the root
+equals the real result size for dense group-by, multi-segment sparse
+group-by, and cached-warm runs, and a warm broker-cache repeat renders
+`RESULT_CACHE(hit, …, dispatches:0)` because nothing executed.
+
+The distributed half runs a traced MSE join over a two-server embedded
+cluster and asserts the merged trace is ONE connected tree — every
+shipped span's parent resolves after per-(instance, shard) id
+namespacing, including on the hedge-win path where two shards from the
+same query land on overlapping instances (the PR-7 trace-loss
+regression).
+"""
+
+from __future__ import annotations
+
+import re
+import tempfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                               ServerInstance)
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.metrics import BROKER_METRICS, BrokerMeter
+
+DENSE = Schema.build("ead", dimensions=[("k", "INT")], metrics=[("v", "INT")])
+SPARSE = Schema.build("eas", dimensions=[("sk", "INT")],
+                      metrics=[("sv", "INT")])
+
+
+def _tree_rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    rows = resp.result_table.rows
+    assert resp.result_table.schema.column_names == [
+        "Operator", "Operator_Id", "Parent_Id"]
+    return rows
+
+
+def _assert_connected(rows):
+    """Plan-table invariant: exactly one root, every parent a prior id."""
+    ids = set()
+    roots = 0
+    for op, oid, parent in rows:
+        if parent == -1:
+            roots += 1
+        else:
+            assert parent in ids, f"{op!r} parent {parent} undefined"
+        ids.add(oid)
+    assert roots == 1, f"expected one root, got {roots}"
+
+
+def _root_stat(rows, key: str) -> int:
+    m = re.search(rf"\b{key}:(\d+)", rows[0][0])
+    assert m, f"{key} missing from root: {rows[0][0]}"
+    return int(m.group(1))
+
+
+# -- engine-level oracle ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ea")
+    rng = np.random.default_rng(11)
+    qe = QueryExecutor(backend="host")
+    for schema, key, card in ((DENSE, "k", 12), (SPARSE, "sk", 50_000)):
+        segs = []
+        vcol = "v" if schema is DENSE else "sv"
+        for i in range(3):
+            cols = {key: rng.integers(0, card, 2000).astype(np.int32),
+                    vcol: rng.integers(0, 100, 2000).astype(np.int32)}
+            name = f"{schema.schema_name}_{i}"
+            SegmentBuilder(schema, segment_name=name).build(cols, d / name)
+            segs.append(load_segment(d / name))
+        qe.add_table(schema, segs)
+    return qe
+
+
+def test_analyze_dense_group_by_row_oracle(engine):
+    sql = "SELECT k, SUM(v) FROM ead GROUP BY k LIMIT 100"
+    plain = engine.execute_sql(sql)
+    assert not plain.exceptions, plain.exceptions
+    rows = _tree_rows(engine.execute_sql("EXPLAIN ANALYZE " + sql))
+    _assert_connected(rows)
+    assert _root_stat(rows, "rows") == len(plain.result_table.rows)
+    assert _root_stat(rows, "docsScanned") == 6000
+    assert _root_stat(rows, "segments") == 3
+
+
+def test_analyze_sparse_group_by_multi_segment_oracle(engine):
+    # 50k key space over 6k docs forces the sparse group-by path; three
+    # segments prove the per-segment spans merge under one root
+    sql = "SELECT sk, SUM(sv) FROM eas GROUP BY sk LIMIT 20000"
+    plain = engine.execute_sql(sql)
+    assert not plain.exceptions, plain.exceptions
+    assert len(plain.result_table.rows) > 1000  # actually sparse
+    rows = _tree_rows(engine.execute_sql("EXPLAIN ANALYZE " + sql))
+    _assert_connected(rows)
+    assert _root_stat(rows, "rows") == len(plain.result_table.rows)
+    assert _root_stat(rows, "segments") == 3
+    txt = "\n".join(r[0] for r in rows)
+    assert "segment:" in txt, txt
+
+
+def test_analyze_selection_row_oracle(engine):
+    sql = "SELECT k, v FROM ead WHERE k < 4 LIMIT 50"
+    plain = engine.execute_sql(sql)
+    rows = _tree_rows(engine.execute_sql("EXPLAIN ANALYZE " + sql))
+    _assert_connected(rows)
+    assert _root_stat(rows, "rows") == len(plain.result_table.rows)
+
+
+# -- cluster-level: scatter merge, warm cache, MSE join, span tree ------------
+
+FACT = Schema.build("eafact", dimensions=[("team", "STRING")],
+                    metrics=[("runs", "INT")])
+DIM = Schema.build("eadim", dimensions=[("team", "STRING"),
+                                        ("city", "STRING")], metrics=[])
+TEAMS = ["BOS", "NYA", "SFN", "LAN"]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    d = Path(tempfile.mkdtemp(prefix="ea_cluster_"))
+    store = PropertyStore()
+    controller = ClusterController(store)
+    servers = [ServerInstance(store, f"Server_{i}", backend="host")
+               for i in range(2)]
+    for s in servers:
+        s.start()
+    controller.add_schema(FACT.to_json())
+    controller.add_schema(DIM.to_json())
+    t1 = controller.create_table({"tableName": "eafact", "replication": 2})
+    t2 = controller.create_table({"tableName": "eadim", "replication": 2})
+    rng = np.random.default_rng(5)
+    for i in range(3):
+        cols = {"team": np.asarray(TEAMS, dtype=object)[
+                    rng.integers(0, 4, 60)],
+                "runs": rng.integers(0, 100, 60).astype(np.int32)}
+        name = f"eafact_{i}"
+        SegmentBuilder(FACT, segment_name=name).build(cols, d / name)
+        controller.add_segment(t1, name, {"location": str(d / name),
+                                          "numDocs": 60})
+    cols = {"team": np.asarray(TEAMS, dtype=object),
+            "city": np.asarray(["Boston", "NewYork", "SF", "LA"],
+                               dtype=object)}
+    SegmentBuilder(DIM, segment_name="eadim_0").build(cols, d / "eadim_0")
+    controller.add_segment(t2, "eadim_0", {"location": str(d / "eadim_0"),
+                                           "numDocs": 4})
+    yield store, servers
+    for s in servers:
+        s.stop()
+
+
+def test_analyze_scatter_merges_server_spans(cluster):
+    store, _ = cluster
+    broker = Broker(store)
+    broker.backoff_base_s = 0.001
+    sql = "SELECT team, SUM(runs) FROM eafact GROUP BY team LIMIT 17"
+    plain = broker.execute_sql("SET resultCache = false; " + sql)
+    assert not plain.exceptions, plain.exceptions
+    rows = _tree_rows(broker.execute_sql("EXPLAIN ANALYZE " + sql))
+    _assert_connected(rows)
+    assert _root_stat(rows, "rows") == len(plain.result_table.rows)
+    txt = "\n".join(r[0] for r in rows)
+    # spans shipped from the servers render with their instance prefix
+    assert "Server_0/" in txt or "Server_1/" in txt, txt
+    assert "cache:miss" in rows[0][0], rows[0][0]
+
+
+def test_analyze_warm_cache_hit_zero_dispatches(cluster):
+    store, _ = cluster
+    broker = Broker(store)
+    broker.backoff_base_s = 0.001
+    sql = "SELECT team, SUM(runs) FROM eafact GROUP BY team LIMIT 18"
+    plain = broker.execute_sql(sql)  # seeds the broker result cache
+    assert not plain.exceptions, plain.exceptions
+    n = len(plain.result_table.rows)
+    rows = _tree_rows(broker.execute_sql("EXPLAIN ANALYZE " + sql))
+    _assert_connected(rows)
+    txt = "\n".join(r[0] for r in rows)
+    assert "cache:hit" in rows[0][0], rows[0][0]
+    assert f"RESULT_CACHE(hit, rows:{n}, dispatches:0)" in txt, txt
+    assert _root_stat(rows, "rows") == n
+    assert _root_stat(rows, "dispatches") == 0
+
+
+def test_analyze_mse_join_row_oracle(cluster):
+    store, _ = cluster
+    broker = Broker(store)
+    broker.backoff_base_s = 0.001
+    sql = ("SELECT eadim.city, SUM(eafact.runs) FROM eafact "
+           "JOIN eadim ON eafact.team = eadim.team GROUP BY eadim.city")
+    plain = broker.execute_sql(sql)
+    assert not plain.exceptions, plain.exceptions
+    rows = _tree_rows(broker.execute_sql("EXPLAIN ANALYZE " + sql))
+    _assert_connected(rows)
+    assert _root_stat(rows, "rows") == len(plain.result_table.rows)
+    txt = "\n".join(r[0] for r in rows)
+    assert "mse_stage" in txt, txt
+
+
+def _assert_one_connected_trace(trace_info):
+    """Merged cross-server trace invariant: no orphan spanIds — every
+    parentId resolves to a span in the same list (or is absent: a root)."""
+    assert trace_info, "traced run recorded no spans"
+    ids = {s["spanId"] for s in trace_info}
+    assert len(ids) == len(trace_info), "duplicate spanIds after merge"
+    orphans = [s for s in trace_info
+               if s.get("parentId") is not None
+               and s["parentId"] not in ids]
+    assert not orphans, f"orphan spans after merge: {orphans[:3]}"
+
+
+def test_traced_mse_join_yields_one_connected_tree(cluster):
+    store, _ = cluster
+    broker = Broker(store)
+    broker.backoff_base_s = 0.001
+    resp = broker.execute_sql(
+        "SET trace = true; "
+        "SELECT eadim.city, SUM(eafact.runs) FROM eafact "
+        "JOIN eadim ON eafact.team = eadim.team GROUP BY eadim.city")
+    assert not resp.exceptions, resp.exceptions
+    _assert_one_connected_trace(resp.trace_info)
+    ops = [s["operator"] for s in resp.trace_info]
+    assert any(op.startswith("mse_stage") for op in ops), ops
+
+
+def test_hedge_win_keeps_trace_and_querylog(cluster):
+    """PR-7 regression: when a hedged duplicate beats a slow shard, the
+    winning shard's spans must still merge (span ids are namespaced per
+    (instance, shard ordinal)) and the loser's cancel must not wedge —
+    the cancel rides a dedicated connection, not the pooled client the
+    in-flight RPC holds locked."""
+    store, _ = cluster
+    broker = Broker(store, adaptive_selection=False, hedge_ms=40.0)
+    broker.backoff_base_s = 0.001
+    wins0 = BROKER_METRICS.meter_count(BrokerMeter.HEDGE_WINS)
+    faults.FAULTS.arm("server.query", faults.FaultSpec(
+        kind="delay", delay_s=0.6, times=None,
+        match=lambda ctx: ctx.get("instance") == "Server_0"))
+    try:
+        # routing may hand the whole shard plan to the fast server on any
+        # given query; retry until a shard lands on the delayed one
+        for _ in range(8):
+            resp = broker.execute_sql(
+                "SET trace = true; SET resultCache = false; "
+                "SELECT team, SUM(runs) FROM eafact GROUP BY team LIMIT 16")
+            assert not resp.exceptions, resp.exceptions
+            if resp.num_hedged_requests:
+                break
+    finally:
+        faults.FAULTS.reset()
+    assert resp.num_hedged_requests >= 1
+    assert BROKER_METRICS.meter_count(BrokerMeter.HEDGE_WINS) > wins0
+    _assert_one_connected_trace(resp.trace_info)
+    # the winner's server-shipped spans survived the merge
+    servers_in_trace = {s.get("server") for s in resp.trace_info
+                        if s.get("server")}
+    assert servers_in_trace, resp.trace_info

@@ -1,0 +1,96 @@
+"""README "Metrics catalog" lint: the table and the code may not drift.
+
+Two directions:
+  code → README: every metric name the runtime can register (the
+  per-role enum classes plus the dynamic set_gauge sites) must appear
+  in the catalog table.
+  README → code: every name the catalog lists must still exist in the
+  code, so stale rows fail the build too.
+
+A third, runtime-grounded pass snapshots the live process-global
+registries and checks every observed name against the catalog — this
+catches names minted outside the enums (the lint that would have
+caught `circuitBreakerState.{instance}` and the realtime ingestion
+gauges being undocumented).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from pinot_tpu.spi import metrics as m
+
+README = Path(__file__).resolve().parent.parent / "README.md"
+
+# dynamic names registered via set_gauge with computed suffixes; the
+# catalog documents them with a {placeholder}
+_DYNAMIC = {
+    "serversUnhealthy",                      # cluster/broker.py
+    "brokerQueriesInflight",                 # cluster/broker.py
+    "brokerQueriesQueued",                   # cluster/broker.py
+    "circuitBreakerState.{instance}",        # cluster/breaker.py
+    "realtimeIngestionDelayMs.{table}",      # realtime/manager.py
+    "realtimeIngestionOffsetLag.{table}",    # realtime/manager.py
+    "injectedFaults",                        # spi/faults.py
+}
+
+_ENUMS = (m.ServerMeter, m.BrokerMeter, m.ServerTimer, m.BrokerTimer,
+          m.ServerGauge, m.ControllerMeter, m.ControllerGauge)
+
+
+def _code_names() -> set:
+    names = set(_DYNAMIC)
+    for cls in _ENUMS:
+        for attr, value in vars(cls).items():
+            if attr.isupper() and isinstance(value, str):
+                names.add(value)
+    return names
+
+
+def _catalog_names() -> set:
+    text = README.read_text()
+    mobj = re.search(r"## Metrics catalog\n(.*?)\n## ", text, re.S)
+    assert mobj, "README is missing the '## Metrics catalog' section"
+    rows = re.findall(r"^\| \w+ \| \w+ \| `([^`]+)` \|", mobj.group(1),
+                      re.M)
+    assert rows, "Metrics catalog table has no parseable rows"
+    return set(rows)
+
+
+def _matches(name: str, catalog: set) -> bool:
+    if name in catalog:
+        return True
+    return any(name.startswith(entry.split("{")[0])
+               for entry in catalog if "{" in entry)
+
+
+def test_every_code_name_is_cataloged():
+    missing = _code_names() - _catalog_names()
+    assert not missing, (
+        f"metric names missing from the README Metrics catalog: "
+        f"{sorted(missing)}")
+
+
+def test_every_cataloged_name_exists_in_code():
+    stale = _catalog_names() - _code_names()
+    assert not stale, (
+        f"README Metrics catalog lists names the code no longer "
+        f"registers: {sorted(stale)}")
+
+
+def test_runtime_registered_names_are_cataloged():
+    """Ground truth: whatever the live registries actually hold right now
+    (this process has run real queries by this point in the suite) must
+    be documented, including dynamic per-instance/per-table names."""
+    catalog = _catalog_names()
+    undocumented = []
+    for reg in (m.SERVER_METRICS, m.BROKER_METRICS, m.CONTROLLER_METRICS):
+        snap = reg.snapshot()
+        observed = (set(snap["meters"]) | set(snap["timers"])
+                    | set(snap["gauges"])
+                    | {k.split(".", 1)[0] for k in snap["tableMeters"]})
+        undocumented += [n for n in observed if not _matches(n, catalog)]
+    assert not undocumented, (
+        f"runtime-registered metric names missing from the README "
+        f"Metrics catalog: {sorted(set(undocumented))}")

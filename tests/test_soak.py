@@ -43,3 +43,29 @@ def test_soak_cli_smoke(capsys):
     import json
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["ok"] is True
+
+
+def test_soak_report_artifact(tmp_path, capsys):
+    """--report writes the machine-readable run artifact: per-suite
+    results, final per-role metrics snapshots, cost-report aggregates
+    from the broker's workload tracker, and the closing anomaly list."""
+    import json
+
+    from pinot_tpu.tools.soak import main
+
+    out = tmp_path / "soak_report.json"
+    rc = main(["--suite", "chaos", "--seconds", "4", "--quiet",
+               "--report", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    assert report["schemaVersion"] == 1
+    assert set(report["metrics"]) == {"server", "broker", "controller"}
+    assert report["metrics"]["broker"]["timers"][
+        "queryProcessingTimeMs"]["count"] > 0
+    # the chaos suite's broker workload rollup made it into the artifact
+    assert "stats" in report["costReports"]["chaos"]["tables"]
+    assert isinstance(report["anomalies"], list)
+    chaos = report["results"][0]
+    assert chaos["fleet"]["serversReachable"] >= 1

@@ -93,3 +93,87 @@ def test_traced_run_does_sync_and_allocate(warm_engine, monkeypatch):
     assert r.trace_info
     assert sync.block_calls > 0
     assert span_allocations() > spans_before
+
+
+# -- cluster-path guard: cost accounting + health rollup stay off the hot
+# -- path (observability PR discipline: with tracing off and no ANALYZE,
+# -- a broker query does zero span allocations, zero extra syncs, and
+# -- zero store writes — no beacon publish, no scrape work)
+
+
+CSQL = "SET resultCache = false; SELECT pck, SUM(pcv) FROM pgclu GROUP BY pck"
+
+
+@pytest.fixture(scope="module")
+def warm_cluster(tmp_path_factory):
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+    from pinot_tpu.segment.builder import SegmentBuilder as SB
+
+    d = tmp_path_factory.mktemp("pg_cluster")
+    store = PropertyStore()
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="host")
+    server.start()
+    schema = Schema.build("pgclu", dimensions=[("pck", "INT")],
+                          metrics=[("pcv", "INT")])
+    controller.add_schema(schema.to_json())
+    controller.create_table({"tableName": "pgclu", "replication": 1})
+    rng = np.random.default_rng(9)
+    for i in range(2):
+        cols = {"pck": rng.integers(0, 16, 1500).astype(np.int32),
+                "pcv": rng.integers(0, 100, 1500).astype(np.int32)}
+        name = f"pgclu_{i}"
+        SB(schema, segment_name=name).build(cols, d / name)
+        controller.add_segment("pgclu_OFFLINE", name,
+                               {"location": str(d / name), "numDocs": 1500})
+    broker = Broker(store)
+    broker.backoff_base_s = 0.001
+    for _ in range(2):
+        r = broker.execute_sql(CSQL)
+        assert not r.exceptions, r.exceptions
+    yield store, broker, server
+    server.stop()
+
+
+def test_cluster_off_path_zero_spans_zero_store_writes(warm_cluster,
+                                                       monkeypatch):
+    store, broker, _ = warm_cluster
+    writes = {"n": 0}
+    real_set = store.set
+
+    def counting_set(path, value, *a, **kw):
+        writes["n"] += 1
+        return real_set(path, value, *a, **kw)
+
+    monkeypatch.setattr(store, "set", counting_set)
+    spans_before = span_allocations()
+    r = broker.execute_sql(CSQL)
+    assert not r.exceptions, r.exceptions
+    assert r.trace_info is None
+    assert span_allocations() == spans_before, (
+        "untraced broker query must allocate zero Span objects")
+    assert writes["n"] == 0, (
+        "untraced broker query must do zero store writes — no state "
+        "beacon, no scrape work on the query thread")
+
+
+def test_analyze_and_beacon_move_the_new_counters(warm_cluster):
+    """Sanity for the guard above: an armed run DOES move the new
+    observability counters — ANALYZE allocates spans, the workload
+    tracker folds the query in, and an explicit beacon publish writes
+    broker state to the store."""
+    store, broker, _ = warm_cluster
+    spans_before = span_allocations()
+    q0 = broker.workload.snapshot()["tables"].get("pgclu", {})
+    r = broker.execute_sql(
+        "EXPLAIN ANALYZE SELECT pck, SUM(pcv) FROM pgclu GROUP BY pck "
+        "LIMIT 7")
+    assert not r.exceptions, r.exceptions
+    assert span_allocations() > spans_before
+    q1 = broker.workload.snapshot()["tables"]["pgclu"]
+    assert q1["queries"] > q0.get("queries", 0.0)
+    assert q1["tracedQueries"] > q0.get("tracedQueries", 0.0)
+    broker.publish_state()
+    beacon = store.get(f"/BROKERSTATE/{broker.broker_id}")
+    assert beacon and beacon["brokerId"] == broker.broker_id
