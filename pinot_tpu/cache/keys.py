@@ -175,3 +175,24 @@ def segment_token(segment) -> Optional[tuple]:
     if not name or not crc:
         return None
     return (str(name), str(crc))
+
+
+def family_fingerprint(program, padded: int, fused: str = "",
+                       lut_meta: tuple = (),
+                       batch_size: int = 0) -> Optional[str]:
+    """Fingerprint of one COMPILED EXECUTABLE FAMILY: the Program IR plus
+    the shape/variant axes jit actually specializes on (padded bucket,
+    fused variant, LUT run metadata, batch size) — and nothing that is a
+    runtime argument (param values, literals, query text). This is the
+    stable cross-process identity of a compiled artifact: the compile
+    telemetry registry keys on it, and it is the key an AOT executable
+    cache would persist under. Deliberately does NOT bump
+    ``fingerprint_computations()`` — it is compile telemetry, not a
+    result-cache key, and it is only computed on compile-guard misses
+    (cold path), so the hot-path perf guards stay meaningful."""
+    try:
+        payload = ("ffp1", canonical_bytes(program), int(padded),
+                   str(fused), tuple(lut_meta), int(batch_size))
+        return hashlib.sha256(canonical_bytes(payload)).hexdigest()
+    except UnfingerprintableError:
+        return None

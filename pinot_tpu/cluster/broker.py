@@ -168,9 +168,23 @@ class Broker:
         self.response_store = ResponseStore()
         self.adaptive_selection = adaptive_selection
         from .querylog import QueryLogger
+        from .tracestore import TraceStore
         from .workload import WorkloadTracker
 
-        self.query_logger = QueryLogger()
+        # flight recorder: retained traces (head-sampled + tail-captured
+        # slow/partial/failed) served at GET /debug/traces[/{queryId}];
+        # the query logger links its slow entries to retained trace ids
+        self.trace_store = TraceStore()
+        # supplier gauges: polled only when /metrics snapshots — the
+        # query path never pays for them
+        BROKER_METRICS.set_gauge("traceStoreTraces",
+                                 lambda: self.trace_store.stats()["traces"])
+        BROKER_METRICS.set_gauge("traceStoreBytes",
+                                 lambda: self.trace_store.stats()["bytes"])
+        BROKER_METRICS.set_gauge(
+            "traceStoreEvictions",
+            lambda: self.trace_store.stats()["evictions"])
+        self.query_logger = QueryLogger(trace_store=self.trace_store)
         # per-query cost accounting → decaying per-table/client rollups
         # (GET /debug/workload); also the admission cost-hint source
         self.workload = WorkloadTracker()
@@ -368,8 +382,15 @@ class Broker:
             from ..spi.metrics import BrokerMeter
 
             BROKER_METRICS.add_table_meter(table, BrokerMeter.QUERIES)
+        # flight-recorder retention BEFORE the query log so slow entries
+        # can link the retained trace id they just minted
+        self._retain_trace(resp, table)
         self.query_logger.log(sql, resp, table=table)
         self.workload.note_response(sql, resp, table=table)
+        if getattr(resp, "trace_sampled", False):
+            # the client never asked for this trace: the store and the
+            # query log took their copies above — the response ships plain
+            resp.trace_info = None
         if self._state_publish_s and time.monotonic() \
                 - self._state_published_at >= self._state_publish_s:
             self._state_published_at = time.monotonic()
@@ -378,6 +399,39 @@ class Broker:
             except Exception:
                 pass  # a glitching store must not fail the query
         return resp
+
+    def _retain_trace(self, resp: BrokerResponse, table: str) -> None:
+        """Flight-recorder retention: every traced completion — head-sampled
+        or client-requested — is offered to the broker TraceStore under its
+        queryId. Tail-based capture PINS the traces that matter most (slow,
+        partial, failed): pinned entries outlive healthy samples when the
+        byte budget evicts. Runs before the query log so slow entries link
+        the retained id instead of embedding a second copy of the spans."""
+        trace_info = getattr(resp, "trace_info", None)
+        qid = getattr(resp, "query_id", None)
+        if not trace_info or not qid:
+            return
+        time_ms = getattr(resp, "time_used_ms", 0) or 0
+        n_exc = len(getattr(resp, "exceptions", []) or [])
+        partial = bool(getattr(resp, "partial_result", False))
+        slow = time_ms >= self.query_logger.slow_threshold_ms
+        if n_exc:
+            reason = "failed"
+        elif partial:
+            reason = "partial"
+        elif slow:
+            reason = "slow"
+        elif getattr(resp, "trace_sampled", False):
+            reason = "sampled"
+        else:
+            reason = "traced"
+        try:
+            resp.trace_id = self.trace_store.offer(
+                qid, trace_info, reason=reason,
+                pinned=bool(n_exc or partial or slow), table=table,
+                time_ms=time_ms, exceptions=n_exc, partial=partial)
+        except Exception:
+            pass  # retention is best-effort; never fail the query for it
 
     def _execute_sql_impl(self, sql: str,
                           segments: Optional[dict]) -> BrokerResponse:
@@ -458,7 +512,18 @@ class Broker:
                 and not resp.partial_result \
                 and resp.result_table is not None:
             BROKER_METRICS.add_meter(BrokerMeter.RESULT_CACHE_MISSES)
-            self.result_cache.put(ck, resp)
+            if getattr(resp, "trace_sampled", False) and resp.trace_info:
+                # a head-sampled query is cacheable (the CLIENT never asked
+                # for a trace) — but the cached copy must be plain, or the
+                # next client's hit replays a stale trace
+                import copy
+
+                plain = copy.copy(resp)
+                plain.trace_info = None
+                plain.trace_sampled = False
+                self.result_cache.put(ck, plain)
+            else:
+                self.result_cache.put(ck, resp)
         return resp
 
     def _execute_analyze(self, query: QueryContext,
@@ -709,22 +774,33 @@ class Broker:
         schema_json = self.store.get(f"/SCHEMAS/{raw}")
         schema = Schema.from_json(schema_json) if schema_json else None
 
-        # trace option: the broker owns the root trace; each server ships
-        # its own span list back next to the datatable and they are merged
-        # (ids namespaced per instance) into one response trace_info
-        from ..spi.trace import TRACING
-
-        trace = None
-        if query.query_options.get("trace") in (True, "true", 1) \
-                and TRACING.active_trace() is None:
-            trace = TRACING.start_trace(
-                f"broker:{raw}",
-                analyze=query.query_options.get("analyze") in
-                (True, "true", 1))
-
         if budget is None:
             budget = _QueryBudget(self._timeout_ms(query),
                                   self._partial_allowed(query))
+
+        # trace option: the broker owns the root trace; each server ships
+        # its own span list back next to the datatable and they are merged
+        # (ids namespaced per instance) into one response trace_info.
+        # Flight recorder: with PINOT_TPU_TRACE_SAMPLE set, the broker also
+        # head-samples production queries deterministically on the queryId
+        # hash — every server strips its ``:<n>`` shard suffix and makes
+        # the SAME decision, so sampled queries trace end to end without
+        # any option riding the wire. Sampled traces arm analyze=True so
+        # the cache tiers stay live (a sampled query must behave exactly
+        # like its unsampled twin).
+        from ..spi.trace import TRACING, sample_decision, trace_sample_rate
+
+        trace = None
+        sampled = False
+        if TRACING.active_trace() is None:
+            if query.query_options.get("trace") in (True, "true", 1):
+                trace = TRACING.start_trace(
+                    f"broker:{raw}",
+                    analyze=query.query_options.get("analyze") in
+                    (True, "true", 1))
+            elif sample_decision(budget.query_id, trace_sample_rate()):
+                sampled = True
+                trace = TRACING.start_trace(f"broker:{raw}", analyze=True)
         all_results = []
         stats_sum = {"total_docs": 0, "num_segments_processed": 0,
                      "num_segments_pruned": 0, "num_segments_queried": 0,
@@ -738,12 +814,16 @@ class Broker:
                      "partial_exceptions": []}
         try:
             try:
-                for name_with_type, extra_filter in halves:
-                    sub = _with_filter(query, name_with_type, extra_filter)
-                    results = self._scatter_gather(
-                        name_with_type, sub, stats_sum, budget,
-                        only_segments=(only_segments or {}).get(name_with_type))
-                    all_results.extend(results)
+                # BROKER_SCATTER is the exporter's flow anchor: shard
+                # timelines re-base here and scatter flows fan out from it
+                with TRACING.scope("BROKER_SCATTER"):
+                    for name_with_type, extra_filter in halves:
+                        sub = _with_filter(query, name_with_type, extra_filter)
+                        results = self._scatter_gather(
+                            name_with_type, sub, stats_sum, budget,
+                            only_segments=(only_segments or {}).get(
+                                name_with_type))
+                        all_results.extend(results)
             except TimeoutError:
                 # broker abandons the query: best-effort cancel so server
                 # device work stops (lands on ResourceAccountant.kill_query)
@@ -813,6 +893,12 @@ class Broker:
                 self._broadcast_cancel(budget, stats_sum)
         if trace_info is not None:
             resp.trace_info = trace_info
+        # retention metadata the execute_sql funnel consumes: the queryId
+        # is the /debug/traces/{id} handle, trace_sampled marks traces the
+        # client never asked for (stripped from the response after the
+        # trace store and query log take their copies)
+        resp.query_id = budget.query_id
+        resp.trace_sampled = sampled
         return resp
 
     def _timeout_ms(self, query: QueryContext) -> float:

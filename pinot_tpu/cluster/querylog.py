@@ -28,9 +28,14 @@ class QueryLogger:
     throttled: the worst queries are exactly the ones a drop would hide."""
 
     def __init__(self, max_lines_per_s: float = 10.0, max_sql_len: int = 200,
-                 slow_threshold_ms: float = None, slow_buffer_size: int = 50):
+                 slow_threshold_ms: float = None, slow_buffer_size: int = 50,
+                 trace_store=None):
         self.rate = float(max_lines_per_s)
         self.max_sql_len = max_sql_len
+        # flight-recorder linkage: when the broker wires its TraceStore in,
+        # slow entries reference the retained trace by id instead of
+        # embedding the span list (one copy of the bytes, in the store)
+        self.trace_store = trace_store
         self.slow_threshold_ms = float(
             os.environ.get("PINOT_TPU_SLOW_QUERY_MS", 500.0)
             if slow_threshold_ms is None else slow_threshold_ms)
@@ -98,7 +103,14 @@ class QueryLogger:
             from ..spi.trace import phase_breakdown
 
             entry["phases"] = phase_breakdown(trace_info)
-            entry["trace"] = trace_info
+            trace_id = getattr(response, "trace_id", None)
+            if trace_id and self.trace_store is not None \
+                    and self.trace_store.get(trace_id) is not None:
+                # the broker retained this trace already (sampled or
+                # tail-captured): link it — GET /debug/traces/{traceId}
+                entry["traceId"] = trace_id
+            else:
+                entry["trace"] = trace_info
         with self._lock:
             self._slow.append(entry)
 

@@ -202,6 +202,10 @@ class BrokerRestServer(_RestServer):
                 (r"/debug/cache", lambda h, m, q: srv._debug_cache()),
                 (r"/debug/servers", lambda h, m, q: srv._debug_servers()),
                 (r"/debug/workload", lambda h, m, q: srv._debug_workload()),
+                (r"/debug/traces", lambda h, m, q: srv._debug_traces()),
+                (r"/debug/traces/([^/]+)",
+                 lambda h, m, q: srv._debug_trace(m.group(1), q)),
+                (r"/debug/compiles", lambda h, m, q: srv._debug_compiles()),
                 # cursor ids are not table names: no group-based table check
                 (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
                     m.group(1), int(q.get("offset", ["0"])[0]),
@@ -271,6 +275,40 @@ class BrokerRestServer(_RestServer):
         broker's routing health table)."""
         return 200, {"servers": self.broker.server_health(),
                      "unhealthy": self.broker.breakers.down_count()}
+
+    def _debug_traces(self):
+        """Flight-recorder inventory: retention stats + newest-first
+        summaries of every retained trace (cluster/tracestore.py)."""
+        ts = self.broker.trace_store
+        return 200, {"stats": ts.stats(), "traces": ts.summaries()}
+
+    def _debug_trace(self, query_id: str, q: dict):
+        """One retained trace — the raw merged span list, or Chrome Trace
+        Event JSON via ``?format=chrome`` (open in ui.perfetto.dev or
+        chrome://tracing; spi/traceexport.py)."""
+        ent = self.broker.trace_store.get(query_id)
+        if ent is None:
+            return 404, {"error": f"no retained trace for {query_id}"}
+        fmt = (q.get("format", ["json"])[0] or "json").lower()
+        if fmt == "chrome":
+            from ..spi.traceexport import to_chrome_trace
+
+            return 200, to_chrome_trace(ent["spans"], query_id=query_id)
+        return 200, ent
+
+    def _debug_compiles(self):
+        """Compile & HBM telemetry (engine/compile_registry.py +
+        segment/device_cache.py): executable families ranked by cumulative
+        compile cost — the AOT-persist priority list — plus device-memory
+        high-water marks and eviction attribution. Served from the broker
+        because this build co-locates broker and servers in one process;
+        the server REST exposes the same payload per instance."""
+        from ..engine.compile_registry import COMPILE_REGISTRY
+        from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+
+        out = COMPILE_REGISTRY.snapshot()
+        out["hbm"] = GLOBAL_DEVICE_CACHE.hbm_telemetry()
+        return 200, out
 
     def _cache_clear(self):
         """DELETE /cache — drop every tier (operator hammer for debugging
@@ -608,6 +646,7 @@ class ServerRestServer(_RestServer):
                  lambda h, m, q: srv._debug_table(m.group(1))),
                 (r"/debug/segments", lambda h, m, q: srv._debug_segments()),
                 (r"/debug/queries", lambda h, m, q: srv._debug_queries()),
+                (r"/debug/compiles", lambda h, m, q: srv._debug_compiles()),
                 (r"/debug/status",
                  lambda h, m, q: (200, srv.server.health_status())),
             ]
@@ -719,6 +758,17 @@ class ServerRestServer(_RestServer):
 
         return 200, {"inflight": GLOBAL_ACCOUNTANT.inflight(),
                      "allocatedBytes": GLOBAL_ACCOUNTANT.total_allocated()}
+
+    def _debug_compiles(self):
+        """Per-instance compile & HBM telemetry — same payload shape as
+        the broker's GET /debug/compiles (this build shares the process,
+        so the registries are the same objects)."""
+        from ..engine.compile_registry import COMPILE_REGISTRY
+        from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+
+        out = COMPILE_REGISTRY.snapshot()
+        out["hbm"] = GLOBAL_DEVICE_CACHE.hbm_telemetry()
+        return 200, out
 
     def _kill_query(self, query_id: str):
         from ..engine.scheduler import GLOBAL_ACCOUNTANT

@@ -65,6 +65,28 @@ class ServerInstance:
             os.environ.get("PINOT_TPU_LOAD_RETRIES", "5"))
         self._lock = threading.RLock()
         self._rpc = RpcServer(self._handle)
+        # compile/HBM telemetry: supplier gauges polled only at /metrics
+        # scrape time (spi/metrics.py evaluates suppliers in snapshot()),
+        # so the dispatch hot path never pays for them
+        from ..engine.compile_registry import COMPILE_REGISTRY
+        from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+        from ..spi.metrics import ServerGauge
+
+        SERVER_METRICS.set_gauge(
+            ServerGauge.COMPILE_FAMILIES,
+            lambda: COMPILE_REGISTRY.totals()["families"])
+        SERVER_METRICS.set_gauge(
+            ServerGauge.COMPILE_MS_TOTAL,
+            lambda: COMPILE_REGISTRY.totals()["compileMs"])
+        SERVER_METRICS.set_gauge(
+            ServerGauge.HBM_BYTES_USED,
+            lambda: GLOBAL_DEVICE_CACHE.hbm_telemetry()["bytesUsed"])
+        SERVER_METRICS.set_gauge(
+            ServerGauge.HBM_BYTES_HIGH_WATER,
+            lambda: GLOBAL_DEVICE_CACHE.hbm_telemetry()["highWater"]["total"])
+        SERVER_METRICS.set_gauge(
+            ServerGauge.HBM_EVICTIONS,
+            lambda: GLOBAL_DEVICE_CACHE.hbm_telemetry()["evictions"])
         self._started = False
         # readiness (GET /health/readiness) gates on the FIRST converge
         # pass completing, not on mere registration: a server that joined
@@ -588,17 +610,28 @@ class ServerInstance:
         # (scheduler.submit runs `run` on this thread, so the thread-local
         # trace covers execute_segments and its family dispatches); the span
         # list rides back next to the datatable for the broker to merge
-        from ..spi.trace import TRACING
+        from ..spi.trace import TRACING, sample_decision, trace_sample_rate
 
         trace = None
-        if query.query_options.get("trace") in (True, "true", 1) \
-                and TRACING.active_trace() is None:
-            # the analyze marker keeps cache tiers live under this trace
-            # (EXPLAIN ANALYZE must observe real cache behaviour)
-            trace = TRACING.start_trace(
-                f"server:{self.instance_id}",
-                analyze=query.query_options.get("analyze") in
-                (True, "true", 1))
+        if TRACING.active_trace() is None:
+            if query.query_options.get("trace") in (True, "true", 1):
+                # the analyze marker keeps cache tiers live under this trace
+                # (EXPLAIN ANALYZE must observe real cache behaviour)
+                trace = TRACING.start_trace(
+                    f"server:{self.instance_id}",
+                    analyze=query.query_options.get("analyze") in
+                    (True, "true", 1))
+            elif query_id:
+                # flight-recorder head sampling: hash the broker queryId
+                # PREFIX (each scatter RPC carries ``<query_id>:<n>``) so
+                # every shard reaches the broker's own sample decision
+                # without an option riding the wire; analyze=True keeps the
+                # cache tiers live — a sampled query must behave exactly
+                # like its unsampled twin
+                root_qid = str(query_id).split(":", 1)[0]
+                if sample_decision(root_qid, trace_sample_rate()):
+                    trace = TRACING.start_trace(
+                        f"server:{self.instance_id}", analyze=True)
         try:
             combined, stats = self.scheduler.submit(
                 run, group=table, timeout_s=timeout_s, query_id=query_id)

@@ -1,0 +1,137 @@
+"""Per-process compile & dispatch telemetry registry.
+
+Reference analogue: none in Pinot — this is the evidence feed the
+ROADMAP's "compile-free cold starts" item needs: which compiled
+executable families exist in this process, what each cost to compile,
+and how often each is dispatched. Entries are keyed by the PR-5 family
+fingerprint (cache/keys.py ``family_fingerprint``: Program IR + padded
+bucket + fused/LUT variant + batch size — the identity an AOT executable
+cache would persist under), so ``GET /debug/compiles`` literally names
+the fingerprints worth AOT-persisting, ranked by compile cost × reuse.
+
+Cost discipline (pinned by tests/test_tracing_perf_guard.py): the
+fingerprint — a canonical-bytes walk of the Program IR — is computed only
+on compile-guard MISSES (cold path). Warm dispatches pay one dict lookup
+on the guard key tuple the executor already built, plus two counter
+bumps: no span allocations, no device syncs, no env reads.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+# registry size tracks the compile-cache guard's own limit: an entry per
+# live executable family plus headroom for evicted-then-recompiled ones
+_MAX_ENTRIES = int(os.environ.get("PINOT_TPU_COMPILE_REGISTRY_MAX", 4096))
+
+
+class CompileRegistry:
+    """fingerprint → {compiles, compileMs, dispatches, family, lastUsed}."""
+
+    def __init__(self, max_entries: int = _MAX_ENTRIES):
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        # guard key tuple → fingerprint: the warm-path lookup table. The
+        # key is the exact tuple _CompileCacheGuard.note() consumed, so
+        # the warm dispatch never re-walks the Program IR.
+        self._by_key: dict = {}
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()  # LRU
+
+    def note_compile(self, guard_key, compile_ms: float,
+                     fingerprint: Optional[str], family: dict) -> None:
+        """Record a compile-guard miss: a fresh executable was (or is
+        about to be) compiled for ``guard_key``. ``fingerprint`` is None
+        when the Program has no canonical encoding — the family is still
+        counted under a key-local pseudo id so the totals stay honest."""
+        fp = fingerprint or f"unfingerprintable:{abs(hash(guard_key)):x}"
+        now = time.time()
+        with self._lock:
+            self._by_key[guard_key] = fp
+            ent = self._entries.get(fp)
+            if ent is None:
+                ent = self._entries[fp] = {
+                    "compiles": 0, "compileMsTotal": 0.0,
+                    "compileMsLast": 0.0, "dispatches": 0,
+                    "firstSeen": round(now, 3), "family": family,
+                }
+            ent["compiles"] += 1
+            ent["compileMsTotal"] = round(
+                ent["compileMsTotal"] + float(compile_ms), 3)
+            ent["compileMsLast"] = round(float(compile_ms), 3)
+            ent["dispatches"] += 1
+            ent["lastUsed"] = round(now, 3)
+            self._entries.move_to_end(fp)
+            while len(self._entries) > self.max_entries:
+                victim, _ = self._entries.popitem(last=False)
+                self._by_key = {k: v for k, v in self._by_key.items()
+                                if v != victim}
+
+    def note_dispatch(self, guard_key) -> None:
+        """Warm-path hit: the executable family already exists. One dict
+        lookup + two bumps; silently ignores keys the registry no longer
+        knows (entry evicted, or compiled before the registry loaded) —
+        the next guard-cache clear re-registers them."""
+        with self._lock:
+            fp = self._by_key.get(guard_key)
+            if fp is None:
+                return
+            ent = self._entries.get(fp)
+            if ent is None:
+                return
+            ent["dispatches"] += 1
+            ent["lastUsed"] = round(time.time(), 3)
+            self._entries.move_to_end(fp)
+
+    def snapshot(self) -> dict:
+        """The GET /debug/compiles payload: per-fingerprint entries ranked
+        by cumulative compile cost (the AOT-persist priority order), plus
+        process totals for /metrics."""
+        with self._lock:
+            entries = {fp: dict(ent, family=dict(ent["family"]))
+                       for fp, ent in self._entries.items()}
+        ranked = sorted(entries.items(),
+                        key=lambda kv: -kv[1]["compileMsTotal"])
+        return {
+            "families": len(entries),
+            "totalCompiles": sum(e["compiles"] for e in entries.values()),
+            "totalCompileMs": round(sum(e["compileMsTotal"]
+                                        for e in entries.values()), 3),
+            "totalDispatches": sum(e["dispatches"]
+                                   for e in entries.values()),
+            "compiles": [dict(ent, fingerprint=fp) for fp, ent in ranked],
+        }
+
+    def totals(self) -> dict:
+        """Cheap rollup for scrape-time /metrics gauges."""
+        with self._lock:
+            return {
+                "families": len(self._entries),
+                "compiles": sum(e["compiles"]
+                                for e in self._entries.values()),
+                "compileMs": round(sum(e["compileMsTotal"]
+                                       for e in self._entries.values()), 3),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._by_key.clear()
+            self._entries.clear()
+
+
+COMPILE_REGISTRY = CompileRegistry()
+
+
+def describe_family(program, padded: int, fused: str = "",
+                    lut_meta: tuple = (), batch_size: int = 0) -> dict:
+    """Human-readable family shape for the registry entry."""
+    return {
+        "mode": getattr(program, "mode", "?"),
+        "padded": int(padded),
+        "fused": str(fused),
+        "lutRuns": len(lut_meta) if lut_meta else 0,
+        "batchSize": int(batch_size),
+    }

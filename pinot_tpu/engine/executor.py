@@ -94,6 +94,30 @@ class _CompileCacheGuard:
 
 _GUARD = _CompileCacheGuard()
 
+
+def _register_compile(gkey, compile_ms: float, program, padded: int,
+                      fused: str = "", lut_meta: tuple = (),
+                      batch_size: int = 0) -> None:
+    """Cold-path half of the compile telemetry registry: fingerprint the
+    freshly-compiled family (a canonical-bytes IR walk — only ever paid
+    on a compile-guard miss, next to an actual XLA compile) and record
+    the compile cost under it."""
+    from ..cache.keys import family_fingerprint
+    from .compile_registry import COMPILE_REGISTRY, describe_family
+
+    fp = family_fingerprint(program, padded, fused, lut_meta, batch_size)
+    COMPILE_REGISTRY.note_compile(
+        gkey, compile_ms, fp,
+        describe_family(program, padded, fused, lut_meta, batch_size))
+
+
+def _register_dispatch(gkey) -> None:
+    """Warm-path half: one dict lookup + counter bumps, no fingerprint
+    walk, no spans, no syncs (tests/test_tracing_perf_guard.py)."""
+    from .compile_registry import COMPILE_REGISTRY
+
+    COMPILE_REGISTRY.note_dispatch(gkey)
+
 # Per-QUERY dispatch/compile counters. Thread-local because concurrent
 # queries share this module: every device dispatch happens on the query's
 # own thread (query_executor's host pool never dispatches), so a
@@ -278,24 +302,34 @@ class TpuSegmentExecutor:
                 fused, lut_meta = "", ()
         # one entry per compiled executable family: padded shape and the
         # fused/lut variants each compile separately
-        new_compile = _GUARD.note((plan.program, view.padded, fused, lut_meta))
+        gkey = (plan.program, view.padded, fused, lut_meta)
+        new_compile = _GUARD.note(gkey)
         _count_dispatch(new_compile)
         if span is not None:
             span.set_attribute("mode", plan.program.mode)
             span.set_attribute("padded", view.padded)
             if fused:
                 span.set_attribute("fused", fused)
+        if span is not None or new_compile:
             t0 = time.perf_counter()
         try:
             outs = run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused=fused,
                                fused_lut_meta=lut_meta)
-            if span is not None:
+            if new_compile:
                 # jit's first call compiles synchronously before the async
                 # dispatch, so host wall of run_program ≈ compile cost on
-                # a guard miss; block_until_ready then isolates execute
+                # a guard miss — measurable WITHOUT a sync, so the compile
+                # registry gets fed on untraced production dispatches too
                 t1 = time.perf_counter()
+                _register_compile(gkey, round((t1 - t0) * 1000, 3),
+                                  plan.program, view.padded, fused, lut_meta)
+            else:
+                _register_dispatch(gkey)
+            if span is not None:
+                if not new_compile:
+                    t1 = time.perf_counter()
                 span.set_attribute(
                     "compileMs",
                     round((t1 - t0) * 1000, 3) if new_compile else 0.0)
@@ -359,21 +393,30 @@ class TpuSegmentExecutor:
         arrays, packed = plan.gather_arrays_packed(view)
         params = tuple(p if isinstance(p, (np.ndarray, np.generic))
                        else np.asarray(p) for p in plan.params)
-        new_compile = _GUARD.note((plan.program, view.padded, "", ()))
+        gkey = (plan.program, view.padded, "", ())
+        new_compile = _GUARD.note(gkey)
         _count_dispatch(new_compile)
-        if span is None:
+        if span is None and not new_compile:
+            _register_dispatch(gkey)
             return run_program(plan.program, arrays, params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused=""), view
-        span.set_attribute("mode", plan.program.mode)
-        span.set_attribute("padded", view.padded)
+        if span is not None:
+            span.set_attribute("mode", plan.program.mode)
+            span.set_attribute("padded", view.padded)
         t0 = time.perf_counter()
         outs = run_program(plan.program, arrays, params,
                            np.int32(segment.num_docs), view.padded,
                            packed=packed, fused="")
         t1 = time.perf_counter()
-        span.set_attribute("compileMs",
-                           round((t1 - t0) * 1000, 3) if new_compile else 0.0)
+        compile_ms = round((t1 - t0) * 1000, 3) if new_compile else 0.0
+        if new_compile:
+            _register_compile(gkey, compile_ms, plan.program, view.padded)
+        else:
+            _register_dispatch(gkey)
+        if span is None:
+            return outs, view
+        span.set_attribute("compileMs", compile_ms)
         jax.block_until_ready(outs)
         span.set_attribute("deviceExecMs",
                            round((time.perf_counter() - t1) * 1000, 3))
@@ -455,22 +498,31 @@ class TpuSegmentExecutor:
         # batch compiles are keyed per FAMILY (program, bucket, slot sig,
         # batch size) — the executable cache scales with families, not S
         asig = tuple((str(a.dtype), tuple(a.shape)) for a in arrays)
-        new_compile = _GUARD.note(
-            ("batch", plan0.program, views[0].padded, packed, asig,
-             len(segments)))
+        gkey = ("batch", plan0.program, views[0].padded, packed, asig,
+                len(segments))
+        new_compile = _GUARD.note(gkey)
         _count_dispatch(new_compile)
-        if span is None:
+        if span is None and not new_compile:
+            _register_dispatch(gkey)
             return run_program_batch(plan0.program, arrays, params_b,
                                      num_docs, views[0].padded,
                                      packed=packed), views
-        span.set_attribute("mode", plan0.program.mode)
-        span.set_attribute("padded", views[0].padded)
+        if span is not None:
+            span.set_attribute("mode", plan0.program.mode)
+            span.set_attribute("padded", views[0].padded)
         t0 = time.perf_counter()
         outs = run_program_batch(plan0.program, arrays, params_b, num_docs,
                                  views[0].padded, packed=packed)
         t1 = time.perf_counter()
-        span.set_attribute("compileMs",
-                           round((t1 - t0) * 1000, 3) if new_compile else 0.0)
+        compile_ms = round((t1 - t0) * 1000, 3) if new_compile else 0.0
+        if new_compile:
+            _register_compile(gkey, compile_ms, plan0.program,
+                              views[0].padded, batch_size=len(segments))
+        else:
+            _register_dispatch(gkey)
+        if span is None:
+            return outs, views
+        span.set_attribute("compileMs", compile_ms)
         jax.block_until_ready(outs)
         span.set_attribute("deviceExecMs",
                            round((time.perf_counter() - t1) * 1000, 3))

@@ -274,6 +274,13 @@ class DeviceSegmentCache:
         # lifetime pressure-eviction count (budget LRU + OOM relief),
         # surfaced in hbm_stats() / dispatch-span HBM snapshots
         self.evictions = 0
+        # flight-recorder attribution: which TIER paid each eviction and
+        # WHY (budget LRU vs OOM relief vs lineage invalidation), plus
+        # per-tier residency high-water marks — the evidence trail for
+        # sizing PINOT_TPU_HBM_BUDGET_BYTES (GET /debug/compiles)
+        self.eviction_stats = {"views": 0, "stacks": 0, "partials": 0,
+                               "budget": 0, "oom": 0, "lineage": 0}
+        self._hwm = {"views": 0, "stacks": 0, "partials": 0, "total": 0}
         # guards _views/_order/_stacks: concurrent queries share this cache,
         # and OOM-relief eviction (engine/oom.py) races view()/_maybe_evict()
         self._lock = threading.Lock()
@@ -384,6 +391,8 @@ class DeviceSegmentCache:
                     del self._partials[k]
                 n = len(stale)
             self.evictions += n
+            self.eviction_stats["partials"] += n
+            self.eviction_stats["lineage"] += n
             return n
 
     def drop(self, segment: ImmutableSegment) -> None:
@@ -416,11 +425,13 @@ class DeviceSegmentCache:
             for pkey in list(self._partials):
                 freed += self._partials.pop(pkey)[1]
                 victims += 1
+                self.eviction_stats["partials"] += 1
             # stacks next: derived [S, N] copies, always safe to rebuild
             for skey in list(self._stacks):
                 freed += self._stacks[skey].nbytes()
                 self._stacks.pop(skey).evict()
                 victims += 1
+                self.eviction_stats["stacks"] += 1
             self._stack_order.clear()
             for key in list(self._views):
                 if key == keep_key:
@@ -431,16 +442,35 @@ class DeviceSegmentCache:
                 if key in self._order:
                     self._order.remove(key)
                 victims += 1
+                self.eviction_stats["views"] += 1
             self.evictions += victims
+            self.eviction_stats["oom"] += victims
         return freed, victims
+
+    def _note_hwm_locked(self, views_b: int, stacks_b: int,
+                         partials_b: int) -> None:
+        h = self._hwm
+        if views_b > h["views"]:
+            h["views"] = views_b
+        if stacks_b > h["stacks"]:
+            h["stacks"] = stacks_b
+        if partials_b > h["partials"]:
+            h["partials"] = partials_b
+        total = views_b + stacks_b + partials_b
+        if total > h["total"]:
+            h["total"] = total
 
     def _maybe_evict(self) -> None:
         # caller holds self._lock
+        views_b = sum(v.nbytes() for v in self._views.values())
+        stacks_b = sum(s.nbytes() for s in self._stacks.values())
+        partials_b = sum(ent[1] for ent in self._partials.values())
+        # every budget check doubles as a high-water sample: the marks
+        # describe true peak residency, not just scrape-time snapshots
+        self._note_hwm_locked(views_b, stacks_b, partials_b)
         if self.budget_bytes is None:
             return
-        total = sum(v.nbytes() for v in self._views.values())
-        total += sum(s.nbytes() for s in self._stacks.values())
-        total += sum(ent[1] for ent in self._partials.values())
+        total = views_b + stacks_b + partials_b
         # cached partials evict first (pure derived data, a miss only costs
         # a re-dispatch), LRU order and ALL of them evictable — unlike the
         # loops below, nothing here is load-bearing for an in-flight call
@@ -448,6 +478,8 @@ class DeviceSegmentCache:
             _, (_, freed, _) = self._partials.popitem(last=False)
             total -= freed
             self.evictions += 1
+            self.eviction_stats["partials"] += 1
+            self.eviction_stats["budget"] += 1
         # stacks next: they duplicate member planes, so dropping a
         # stack frees bytes without costing a host→device re-upload. Like
         # the views loop below, the most-recently-touched entry survives —
@@ -457,12 +489,16 @@ class DeviceSegmentCache:
             total -= self._stacks[victim].nbytes()
             self._stacks.pop(victim).evict()
             self.evictions += 1
+            self.eviction_stats["stacks"] += 1
+            self.eviction_stats["budget"] += 1
         while total > self.budget_bytes and len(self._order) > 1:
             victim = self._order.pop(0)
             total -= self._views[victim].nbytes()
             self._views[victim].evict()
             del self._views[victim]
             self.evictions += 1
+            self.eviction_stats["views"] += 1
+            self.eviction_stats["budget"] += 1
 
     def hbm_stats(self) -> dict:
         """Residency snapshot for dispatch-span attributes and /metrics
@@ -471,14 +507,42 @@ class DeviceSegmentCache:
         tracing-off hot path."""
         with self._lock:
             partial_bytes = sum(ent[1] for ent in self._partials.values())
-            used = sum(v.nbytes() for v in self._views.values())
-            used += sum(s.nbytes() for s in self._stacks.values())
-            used += partial_bytes
+            views_b = sum(v.nbytes() for v in self._views.values())
+            stacks_b = sum(s.nbytes() for s in self._stacks.values())
+            self._note_hwm_locked(views_b, stacks_b, partial_bytes)
+            used = views_b + stacks_b + partial_bytes
             return {"hbmBytesUsed": used,
                     "hbmBudgetBytes": self.budget_bytes,
                     "hbmEvictions": self.evictions,
                     "hbmPartialEntries": len(self._partials),
                     "hbmPartialBytes": partial_bytes}
+
+    def hbm_telemetry(self) -> dict:
+        """Flight-recorder HBM view: live residency per tier, lifetime
+        per-tier high-water marks, and evictions attributed by tier and
+        cause — the GET /debug/compiles HBM section and the scrape-time
+        source for the hbmBytesUsed/hbmBytesHighWater gauges."""
+        with self._lock:
+            partials_b = sum(ent[1] for ent in self._partials.values())
+            views_b = sum(v.nbytes() for v in self._views.values())
+            stacks_b = sum(s.nbytes() for s in self._stacks.values())
+            self._note_hwm_locked(views_b, stacks_b, partials_b)
+            return {
+                "budgetBytes": self.budget_bytes,
+                "bytesUsed": views_b + stacks_b + partials_b,
+                "tiers": {"views": views_b, "stacks": stacks_b,
+                          "partials": partials_b},
+                "highWater": dict(self._hwm),
+                "evictions": self.evictions,
+                "evictionsByTier": {
+                    k: self.eviction_stats[k]
+                    for k in ("views", "stacks", "partials")},
+                "evictionsByCause": {
+                    k: self.eviction_stats[k]
+                    for k in ("budget", "oom", "lineage")},
+                "partialHits": self.partial_hits,
+                "partialMisses": self.partial_misses,
+            }
 
 
 # Default budget keeps headroom on a 16GB v5e; override via env.

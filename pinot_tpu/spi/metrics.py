@@ -92,6 +92,14 @@ class ServerGauge:
     DOCUMENT_COUNT = "documentCount"
     SEGMENT_COUNT = "segmentCount"
     UPSERT_PRIMARY_KEYS_COUNT = "upsertPrimaryKeysCount"
+    # compile telemetry registry (engine/compile_registry.py): supplier
+    # gauges polled only at scrape time — the query path never pays
+    COMPILE_FAMILIES = "compileFamilies"
+    COMPILE_MS_TOTAL = "compileMsTotal"
+    # HBM residency telemetry (segment/device_cache.py hbm_telemetry)
+    HBM_BYTES_USED = "hbmBytesUsed"
+    HBM_BYTES_HIGH_WATER = "hbmBytesHighWater"
+    HBM_EVICTIONS = "hbmEvictions"
 
 
 class ControllerMeter:

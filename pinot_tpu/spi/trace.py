@@ -17,8 +17,10 @@ detail (compileMs, deviceExecMs, transferBytes, HBM snapshot).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
+import zlib
 from contextlib import contextmanager
 from typing import Any, Optional
 
@@ -42,6 +44,50 @@ _SPAN_ALLOCS = 0
 
 def span_allocations() -> int:
     return _SPAN_ALLOCS
+
+
+# -- sampled trace retention (flight recorder head sampling) ----------------
+#
+# PINOT_TPU_TRACE_SAMPLE ∈ [0, 1] arms probabilistic tracing of production
+# queries (no SET trace, no EXPLAIN ANALYZE). The decision is a
+# deterministic hash of the queryId, NOT a coin flip: the broker stamps one
+# queryId per query and every scatter shard carries a `<queryId>:<n>` id,
+# so broker and servers — each consulting only its own environment — agree
+# on exactly which queries trace and the merged trace is always complete.
+# Rate 0 (the default) keeps the hot path at one thread-local read: the
+# env is consulted only where a trace could be armed (broker/server entry),
+# never per span.
+
+TRACE_SAMPLE_ENV = "PINOT_TPU_TRACE_SAMPLE"
+
+# hash-space denominator: crc32(queryId) % 10000 < rate * 10000 gives a
+# 0.01% sampling granularity, stable across processes and restarts
+_SAMPLE_SPACE = 10000
+
+
+def trace_sample_rate() -> float:
+    """Current head-sampling rate — read per query (not cached) so tests
+    and operators can re-arm a live process via the environment."""
+    raw = os.environ.get(TRACE_SAMPLE_ENV)
+    if not raw:
+        return 0.0
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return 0.0
+
+
+def sample_decision(query_id: str, rate: float) -> bool:
+    """Deterministic per-queryId head-sampling verdict: same id + same
+    rate → same answer in every process. Shard ids (`<queryId>:<n>`) must
+    be stripped to the queryId prefix BY THE CALLER so all shards of one
+    query agree with the broker's decision."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    return zlib.crc32(query_id.encode()) % _SAMPLE_SPACE \
+        < int(rate * _SAMPLE_SPACE)
 
 
 class Span:

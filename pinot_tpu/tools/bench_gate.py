@@ -1,0 +1,208 @@
+"""Perf-regression gate over two bench rounds (``BENCH_*.json``).
+
+Reference analogue: the "compare two benchmark result files, fail CI on
+regression" pattern (pytest-benchmark ``--benchmark-compare-fail``,
+ASV's ``asv compare --factor``). The bench harness (bench.py) emits one
+JSON per round; this gate compares a baseline round against a candidate
+round per config and exits nonzero when the candidate regressed —
+naming exactly which config and by how much.
+
+Round files come in two shapes, both accepted:
+
+- the bench payload itself: ``{"metric": ..., "detail": {config: {...}}}``
+  (``.bench_partial/summary.json``, a freshly captured round);
+- the driver wrapper: ``{"cmd", "rc", "parsed", "tail"}`` where
+  ``parsed`` may be null and the payload JSON is the last line of
+  ``tail`` (BENCH_r04/r05 landed exactly like this).
+
+Checks per config present in the baseline:
+
+- **p50 regression**: candidate ``tpu_p50_s`` > baseline × (1 +
+  ``--threshold``), default 25% — sized above bench noise (repeat rounds
+  on idle hardware move p50 by low single digits) — AND the absolute
+  delta clears ``--min-abs-ms`` so microsecond-scale configs can't trip
+  the ratio on scheduler jitter;
+- **match flip**: baseline ``match`` true → candidate false is a
+  CORRECTNESS regression and always fails, no threshold;
+- **missing config**: a config the baseline measured that the candidate
+  dropped fails (silent coverage loss reads as a pass otherwise).
+
+Platform mismatch (cpu round vs tpu round) downgrades p50 checks to
+warnings: the ratio would measure the machine, not the code.
+
+Usage::
+
+    python -m pinot_tpu.tools.bench_gate BASELINE.json CANDIDATE.json \
+        [--threshold 0.25] [--min-abs-ms 2.0] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def _extract_payload(doc) -> dict:
+    """Accept either a bench payload or a driver wrapper around one."""
+    if not isinstance(doc, dict):
+        raise ValueError("round file is not a JSON object")
+    if isinstance(doc.get("detail"), dict):
+        return doc
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("detail"), dict):
+        return parsed
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        # the payload is the LAST JSON object printed to the tail; scan
+        # candidate start offsets right-to-left so log lines with braces
+        # ahead of it don't break the parse
+        dec = json.JSONDecoder()
+        for i in range(len(tail) - 1, -1, -1):
+            if tail[i] != "{":
+                continue
+            try:
+                obj, _end = dec.raw_decode(tail[i:])
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and isinstance(obj.get("detail"), dict):
+                return obj
+        salvaged = _salvage_configs(tail, dec)
+        if salvaged:
+            return salvaged
+    raise ValueError("no bench payload with a 'detail' section found")
+
+
+def _salvage_configs(tail: str, dec: "json.JSONDecoder") -> dict:
+    """Driver wrappers keep only the LAST ~2000 chars of output, which
+    truncates the payload's head (BENCH_r04/r05 landed like this) — but
+    whole per-config objects usually survive. Recover every complete
+    ``"config_name": {...tpu_p50_s...}`` pair so the gate can still
+    compare the configs both rounds kept."""
+    import re
+
+    detail = {}
+    for m in re.finditer(r'"([A-Za-z_][A-Za-z0-9_]*)":\s*\{', tail):
+        try:
+            obj, _end = dec.raw_decode(tail[m.end() - 1:])
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "tpu_p50_s" in obj:
+            detail[m.group(1)] = obj
+    if not detail:
+        return {}
+    out = {"detail": detail, "salvaged": True}
+    pm = re.search(r'"platform":\s*"([^"]+)"', tail)
+    if pm:
+        out["platform"] = pm.group(1)
+    return out
+
+
+def load_round(path: str) -> dict:
+    return _extract_payload(json.loads(Path(path).read_text()))
+
+
+def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
+            min_abs_ms: float = 2.0) -> dict:
+    """Pure comparison (importable by tests): returns the gate report
+    {pass, failures: [...], rows: [...]} without touching the process."""
+    base_cfg = baseline.get("detail") or {}
+    cand_cfg = candidate.get("detail") or {}
+    base_plat = baseline.get("platform")
+    cand_plat = candidate.get("platform")
+    cross_platform = bool(base_plat and cand_plat and base_plat != cand_plat)
+    rows = []
+    failures = []
+    warnings = []
+    if cross_platform:
+        warnings.append(
+            f"platform mismatch (baseline={base_plat}, "
+            f"candidate={cand_plat}): p50 checks downgraded to warnings")
+    for cfg in base_cfg:
+        b = base_cfg[cfg]
+        c = cand_cfg.get(cfg)
+        if c is None:
+            failures.append(f"{cfg}: missing from candidate round")
+            rows.append({"config": cfg, "verdict": "MISSING",
+                         "baselineP50s": b.get("tpu_p50_s")})
+            continue
+        bp = float(b.get("tpu_p50_s") or 0.0)
+        cp = float(c.get("tpu_p50_s") or 0.0)
+        ratio = (cp / bp) if bp > 0 else float("inf")
+        delta_ms = (cp - bp) * 1000.0
+        row = {"config": cfg, "baselineP50s": round(bp, 6),
+               "candidateP50s": round(cp, 6), "ratio": round(ratio, 4),
+               "deltaMs": round(delta_ms, 3),
+               "baselineMatch": b.get("match"),
+               "candidateMatch": c.get("match")}
+        verdict = "PASS"
+        if b.get("match") is True and c.get("match") is False:
+            verdict = "FAIL"
+            failures.append(f"{cfg}: result match flipped true -> false "
+                            "(correctness regression)")
+        elif bp > 0 and ratio > 1.0 + threshold and delta_ms >= min_abs_ms:
+            if cross_platform:
+                verdict = "WARN"
+                warnings.append(
+                    f"{cfg}: p50 {bp:.4f}s -> {cp:.4f}s "
+                    f"({(ratio - 1) * 100:.1f}% slower) across platforms")
+            else:
+                verdict = "FAIL"
+                failures.append(
+                    f"{cfg}: p50 regressed {bp:.4f}s -> {cp:.4f}s "
+                    f"({(ratio - 1) * 100:.1f}% slower, threshold "
+                    f"{threshold * 100:.0f}%)")
+        row["verdict"] = verdict
+        rows.append(row)
+    return {"pass": not failures, "threshold": threshold,
+            "minAbsMs": min_abs_ms, "configs": len(base_cfg),
+            "failures": failures, "warnings": warnings, "rows": rows}
+
+
+def _render_table(report: dict) -> str:
+    lines = [f"{'config':<24} {'base p50':>12} {'cand p50':>12} "
+             f"{'ratio':>7} {'verdict':>8}"]
+    for r in report["rows"]:
+        lines.append(
+            f"{r['config']:<24} "
+            f"{r.get('baselineP50s', float('nan')):>12.4f} "
+            f"{r.get('candidateP50s', float('nan')):>12.4f} "
+            f"{r.get('ratio', float('nan')):>7.3f} "
+            f"{r['verdict']:>8}")
+    for w in report["warnings"]:
+        lines.append(f"WARN: {w}")
+    for f in report["failures"]:
+        lines.append(f"FAIL: {f}")
+    lines.append("GATE: " + ("PASS" if report["pass"] else "FAIL"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_gate",
+        description="fail when a bench round regressed vs a baseline")
+    ap.add_argument("baseline", help="baseline BENCH_*.json")
+    ap.add_argument("candidate", help="candidate BENCH_*.json")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="p50 ratio slack before failing (default 0.25)")
+    ap.add_argument("--min-abs-ms", type=float, default=2.0,
+                    help="ignore regressions smaller than this many ms")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report instead of a table")
+    args = ap.parse_args(argv)
+    try:
+        baseline = load_round(args.baseline)
+        candidate = load_round(args.candidate)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 2
+    report = compare(baseline, candidate, threshold=args.threshold,
+                     min_abs_ms=args.min_abs_ms)
+    print(json.dumps(report, indent=2) if args.json
+          else _render_table(report))
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,206 @@
+"""Accelerator doctor: supervised device probe with root-cause triage.
+
+Reference analogue: ``jax.print_environment_info()`` plus the triage a
+human does when a TPU job wedges — except rounds r04/r05 of this repo's
+bench landed with nothing but "accelerator probe failed or hung, ran on
+cpu", which names NO cause. The doctor closes that gap two ways:
+
+- **supervised probe**: runs the same one-op device probe bench.py uses,
+  but in a child that arms ``faulthandler.dump_traceback_later`` BEFORE
+  touching jax. A probe that hangs inside PJRT initialization (a C call
+  the main thread never returns from) still produces a stack dump: the
+  faulthandler watchdog thread fires from outside the stuck thread and
+  exits the child, so the parent gets the exact frame the init wedged in
+  instead of an empty timeout.
+- **classification**: child stderr (including the watchdog dump) is
+  matched against the known failure signatures and reduced to one of
+  ``ok | no-libtpu | pjrt-init-failure | device-hang | env-misconfig |
+  import-error | unknown-error``, each with a concrete remedy line.
+
+``--classify-report`` skips the probe and classifies a PERSISTED
+bench probe report (bench.py writes ``.bench_partial/probe_report.json``
+after every round) — the retroactive answer to "why did round N fall
+back to cpu" without re-risking a hang on a wedged device.
+
+Usage::
+
+    python -m pinot_tpu.tools.doctor [--timeout 60] [--report out.json]
+    python -m pinot_tpu.tools.doctor --classify-report \
+        .bench_partial/probe_report.json
+
+Exit codes: 0 probe ok, 3 probe failed/hung (report still written),
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+# the child arms the watchdog FIRST: a hang anywhere after this line —
+# import, PJRT client init, the device op — still yields a stack dump on
+# stderr before the child exits(1). dump_traceback_later runs on its own
+# watchdog thread, so it fires even while the main thread is stuck in a
+# non-returning C extension call.
+PROBE_CODE = """\
+import faulthandler, sys
+faulthandler.dump_traceback_later({timeout}, exit=True, file=sys.stderr)
+import jax
+jax.numpy.zeros(8).block_until_ready()
+print(jax.devices())
+faulthandler.cancel_dump_traceback_later()
+"""
+
+# signature → (classification, remedy); scanned in order, first hit wins
+_SIGNATURES = [
+    (("libtpu.so: cannot open shared object", "libtpu not found",
+      "Unable to find libtpu", "No module named 'libtpu'",
+      "libtpu.so: no such file"),
+     ("no-libtpu",
+      "libtpu is not installed/visible: install the matching libtpu "
+      "wheel or unset JAX_PLATFORMS=tpu to fall back to cpu")),
+    (("Unknown backend", "unknown platform", "invalid platform",
+      "Illegal platform", "JAX_PLATFORMS"),
+     ("env-misconfig",
+      "platform selection env is wrong: check JAX_PLATFORMS / "
+      "PJRT_DEVICE against the devices this host actually has")),
+    (("Unable to initialize backend", "Failed to initialize TPU",
+      "PJRT", "pjrt", "TPU backend setup/compile error",
+      "DEADLINE_EXCEEDED", "UNAVAILABLE", "ABORTED"),
+     ("pjrt-init-failure",
+      "the PJRT runtime errored during init: the device exists but "
+      "could not be acquired — check for a stale process holding the "
+      "TPU lease and for driver/runtime version skew")),
+    (("ModuleNotFoundError", "ImportError"),
+     ("import-error",
+      "the probe could not import jax: the environment is missing or "
+      "mixing installs — check the active venv")),
+]
+
+# faulthandler's dump header — its presence in stderr IS the hang proof
+_HANG_MARKERS = ("Timeout (0:", "dump_traceback_later")
+
+
+def classify(status: str, stderr: str) -> tuple:
+    """(classification, remedy) from a probe status + collected stderr."""
+    if status == "ok":
+        return "ok", ""
+    text = stderr or ""
+    if status == "hung" or any(m in text for m in _HANG_MARKERS):
+        # a hang may still carry a nameable cause in the dump's frames
+        for sigs, (cls, remedy) in _SIGNATURES:
+            if cls != "env-misconfig" and any(s in text for s in sigs):
+                return cls, remedy
+        return ("device-hang",
+                "the device op never returned: the accelerator (or its "
+                "tunnel) is wedged — the stack dump in stderrTail names "
+                "the frame; restart the runtime / reacquire the device")
+    for sigs, (cls, remedy) in _SIGNATURES:
+        if any(s in text for s in sigs):
+            return cls, remedy
+    return ("unknown-error",
+            "probe failed with an unrecognized error; read stderrTail")
+
+
+def classify_report(report: dict) -> dict:
+    """Classify a persisted bench probe report (bench.py PROBE_REPORT_PATH
+    shape: {status, env, attempts: [{rc, stderr_tail, stderr?}, ...]})."""
+    status = report.get("status", "unknown")
+    stderr = "\n".join(
+        str(a.get("stderr") or a.get("stderr_tail") or "")
+        for a in report.get("attempts") or [])
+    cls, remedy = classify("ok" if status == "ok" else
+                           "hung" if status == "hung" else "errored", stderr)
+    return {"status": status, "classification": cls, "remedy": remedy,
+            "env": report.get("env") or {},
+            "attempts": len(report.get("attempts") or []),
+            "stderrTail": stderr[-2000:], "source": "persisted-report"}
+
+
+def run_probe(timeout_s: float = 60.0, probe_code: str = None,
+              env: dict = None) -> dict:
+    """Run the supervised probe child; returns the machine-readable
+    report. ``probe_code`` overrides the child script (tests fake hangs
+    and failures through it); ``{timeout}`` in it is substituted."""
+    code = (probe_code or PROBE_CODE).format(timeout=timeout_s)
+    child_env = dict(os.environ if env is None else env)
+    t0 = time.monotonic()
+    with tempfile.TemporaryFile() as ef, tempfile.TemporaryFile() as of:
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=of, stderr=ef, env=child_env,
+                                start_new_session=True)
+        try:
+            # grace past the watchdog so the child's own dump-and-exit
+            # fires first and the dump reaches stderr; the parent kill is
+            # the backstop for a child too wedged to run its watchdog
+            rc = proc.wait(timeout=timeout_s + 10.0)
+            status = "ok" if rc == 0 else "errored"
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            rc = None
+            status = "hung"
+        elapsed = time.monotonic() - t0
+        ef.seek(0)
+        stderr = ef.read().decode(errors="replace")
+        of.seek(0)
+        stdout = of.read().decode(errors="replace")
+    if status == "errored" and any(m in stderr for m in _HANG_MARKERS):
+        status = "hung"  # the watchdog exit(1): a hang, not an error
+    cls, remedy = classify(status, stderr)
+    return {
+        "status": status,
+        "classification": cls,
+        "remedy": remedy,
+        "rc": rc,
+        "elapsedS": round(elapsed, 3),
+        "timeoutS": timeout_s,
+        "env": {"JAX_PLATFORMS": child_env.get("JAX_PLATFORMS"),
+                "PJRT_DEVICE": child_env.get("PJRT_DEVICE")},
+        "devices": stdout.strip()[-500:],
+        "stderrTail": stderr[-4000:],
+        "source": "supervised-probe",
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor",
+        description="probe the accelerator under supervision and name "
+                    "the failure mode")
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="watchdog seconds before the stack dump fires")
+    ap.add_argument("--report", help="also write the JSON report here")
+    ap.add_argument("--probe-code",
+                    help="override the probe child's code (testing)")
+    ap.add_argument("--classify-report",
+                    help="classify a persisted probe_report.json instead "
+                         "of running a probe")
+    args = ap.parse_args(argv)
+    if args.classify_report:
+        try:
+            persisted = json.loads(Path(args.classify_report).read_text())
+        except (OSError, ValueError) as e:
+            print(f"doctor: cannot read report: {e}", file=sys.stderr)
+            return 2
+        report = classify_report(persisted)
+    else:
+        report = run_probe(timeout_s=args.timeout,
+                           probe_code=args.probe_code)
+    if args.report:
+        try:
+            Path(args.report).write_text(json.dumps(report, indent=2))
+        except OSError as e:
+            print(f"doctor: cannot write report: {e}", file=sys.stderr)
+    print(json.dumps(report, indent=2))
+    return 0 if report["classification"] == "ok" else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""bench_gate: noise-aware perf-regression comparison of two bench rounds.
+
+Tier-1 acceptance: two identical rounds pass with exit 0; a 30% p50
+regression on one config exits nonzero and NAMES the config; a
+correctness match-flag flip always fails regardless of timing.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pinot_tpu.tools.bench_gate import compare, load_round, main
+
+
+def _payload(**overrides):
+    detail = {
+        "q1_filter_sum": {"tpu_p50_s": 0.100, "rows_per_sec": 1e9,
+                          "match": True, "iters": 10},
+        "q2_groupby": {"tpu_p50_s": 0.200, "rows_per_sec": 5e8,
+                       "match": True, "iters": 10},
+        "q3_highcard": {"tpu_p50_s": 1.500, "rows_per_sec": 9e7,
+                        "match": True, "iters": 3},
+    }
+    out = {"metric": "x", "value": 1.0, "platform": "tpu",
+           "detail": detail}
+    out.update(overrides)
+    return out
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_identical_rounds_pass(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", _payload())
+    b = _write(tmp_path, "b.json", _payload())
+    assert main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "GATE: PASS" in out
+
+
+def test_thirty_percent_regression_fails_naming_config(tmp_path, capsys):
+    base = _payload()
+    cand = _payload()
+    cand["detail"]["q2_groupby"]["tpu_p50_s"] = 0.260  # +30%
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "GATE: FAIL" in out
+    assert "q2_groupby" in out and "regressed" in out
+    # the healthy configs still read PASS in the verdict table
+    assert "q1_filter_sum" in out
+
+
+def test_match_flip_fails_even_when_faster(tmp_path, capsys):
+    cand = _payload()
+    cand["detail"]["q1_filter_sum"]["tpu_p50_s"] = 0.050  # 2x faster...
+    cand["detail"]["q1_filter_sum"]["match"] = False      # ...and wrong
+    a = _write(tmp_path, "a.json", _payload())
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    assert "match flipped" in capsys.readouterr().out
+
+
+def test_missing_config_fails(tmp_path, capsys):
+    cand = _payload()
+    del cand["detail"]["q3_highcard"]
+    a = _write(tmp_path, "a.json", _payload())
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    assert "missing from candidate" in capsys.readouterr().out
+
+
+def test_min_abs_floor_absorbs_micro_jitter(tmp_path):
+    """A 100% ratio regression that is still under the absolute floor is
+    scheduler jitter on a microsecond config, not a regression."""
+    base = _payload()
+    base["detail"]["q1_filter_sum"]["tpu_p50_s"] = 0.0004
+    cand = _payload()
+    cand["detail"]["q1_filter_sum"]["tpu_p50_s"] = 0.0008
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+
+
+def test_improvement_passes(tmp_path):
+    cand = _payload()
+    for cfg in cand["detail"].values():
+        cfg["tpu_p50_s"] *= 0.5
+    a = _write(tmp_path, "a.json", _payload())
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+
+
+def test_cross_platform_downgrades_to_warning(tmp_path, capsys):
+    cand = _payload(platform="cpu")
+    cand["detail"]["q2_groupby"]["tpu_p50_s"] = 40.0  # cpu is slower, fine
+    a = _write(tmp_path, "a.json", _payload())
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "platform mismatch" in out and "GATE: PASS" in out
+
+
+def test_wrapper_with_embedded_payload(tmp_path):
+    """Driver wrapper shape: parsed=null, payload as the tail's last
+    JSON object (how BENCH rounds actually land)."""
+    inner = _payload()
+    wrapper = {"cmd": "python bench.py", "rc": 0, "parsed": None,
+               "tail": "[bench] log line {noise}\n" + json.dumps(inner)}
+    p = _write(tmp_path, "w.json", wrapper)
+    assert load_round(p)["detail"] == inner["detail"]
+
+
+def test_wrapper_with_truncated_tail_salvages_configs(tmp_path):
+    """BENCH_r04/r05 regression shape: the tail keeps only the last 2000
+    chars, beheading the payload — whole config objects still recover."""
+    inner = _payload()
+    full = json.dumps(inner)
+    wrapper = {"cmd": "python bench.py", "rc": 0, "parsed": None,
+               "tail": full[len(full) // 2:]}  # behead the payload
+    p = _write(tmp_path, "w.json", wrapper)
+    got = load_round(p)
+    assert got.get("salvaged") is True
+    assert "q3_highcard" in got["detail"]  # the tail-end config survives
+
+
+def test_unparseable_round_is_usage_error(tmp_path, capsys):
+    a = _write(tmp_path, "a.json", {"cmd": "x", "tail": "no json here"})
+    b = _write(tmp_path, "b.json", _payload())
+    assert main([a, b]) == 2
+    assert "bench_gate:" in capsys.readouterr().err
+
+
+def test_compare_is_pure():
+    base = _payload()
+    cand = _payload()
+    cand["detail"]["q1_filter_sum"]["tpu_p50_s"] = 99.0
+    report = compare(base, cand, threshold=0.25)
+    assert report["pass"] is False
+    assert any("q1_filter_sum" in f for f in report["failures"])
+    verdicts = {r["config"]: r["verdict"] for r in report["rows"]}
+    assert verdicts["q1_filter_sum"] == "FAIL"
+    assert verdicts["q2_groupby"] == "PASS"
+
+
+@pytest.mark.parametrize("path_a,path_b", [
+    ("BENCH_r05.json", "BENCH_r05.json"),
+    (".bench_partial/summary.json", ".bench_partial/summary.json"),
+])
+def test_real_artifacts_self_compare_pass(path_a, path_b):
+    """The committed rounds themselves must load (wrapper salvage for the
+    r0X files) and self-compare clean."""
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    a, b = root / path_a, root / path_b
+    if not a.exists():
+        pytest.skip(f"{path_a} not in this checkout")
+    assert main([str(a), str(b)]) == 0

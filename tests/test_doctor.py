@@ -1,0 +1,131 @@
+"""Accelerator doctor: supervised probe, hang stack dumps, classification.
+
+The hang test fakes a wedged probe child through ``--probe-code`` — the
+child arms the same faulthandler watchdog as the real probe, then sleeps
+— so the test proves the supervision mechanics (watchdog fires, stack
+dump reaches the parent, classification says device-hang) without
+needing a real wedged accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+
+from pinot_tpu.tools.doctor import (classify, classify_report, main,
+                                    run_probe)
+
+HANG_CODE = """\
+import faulthandler, sys
+faulthandler.dump_traceback_later({timeout}, exit=True, file=sys.stderr)
+import time
+
+
+def wedged_in_init():
+    time.sleep(600)
+
+
+wedged_in_init()
+"""
+
+PJRT_FAIL_CODE = """\
+import sys
+sys.stderr.write("RuntimeError: Unable to initialize backend 'tpu': "
+                 "UNAVAILABLE: TPU backend setup/compile error\\n")
+sys.exit(1)
+"""
+
+NO_LIBTPU_CODE = """\
+import sys
+sys.stderr.write("ImportError: libtpu.so: cannot open shared object "
+                 "file: No such file or directory\\n")
+sys.exit(1)
+"""
+
+
+def test_faked_hung_probe_dumps_stack_and_classifies():
+    report = run_probe(timeout_s=2.0, probe_code=HANG_CODE)
+    assert report["status"] == "hung"
+    assert report["classification"] == "device-hang"
+    # the watchdog dump names the exact frame the child wedged in
+    assert "Timeout (0:" in report["stderrTail"]
+    assert "wedged_in_init" in report["stderrTail"]
+    assert report["remedy"]
+
+
+def test_pjrt_failure_classified():
+    report = run_probe(timeout_s=10.0, probe_code=PJRT_FAIL_CODE)
+    assert report["status"] == "errored"
+    assert report["classification"] == "pjrt-init-failure"
+
+
+def test_no_libtpu_classified():
+    report = run_probe(timeout_s=10.0, probe_code=NO_LIBTPU_CODE)
+    assert report["classification"] == "no-libtpu"
+
+
+def test_healthy_probe_ok():
+    report = run_probe(timeout_s=30.0,
+                       probe_code="print('[FakeDevice(id=0)]')")
+    assert report["status"] == "ok"
+    assert report["classification"] == "ok"
+    assert "FakeDevice" in report["devices"]
+
+
+def test_classify_signatures_without_subprocess():
+    cls, _ = classify("errored", "Unknown backend 'axon' requested in "
+                                 "JAX_PLATFORMS")
+    assert cls == "env-misconfig"
+    cls, _ = classify("errored", "ModuleNotFoundError: No module named "
+                                 "'jax'")
+    assert cls == "import-error"
+    cls, _ = classify("errored", "something nobody has seen before")
+    assert cls == "unknown-error"
+    assert classify("ok", "") == ("ok", "")
+    # a hang whose dump still names libtpu classifies by the dump
+    cls, _ = classify("hung", "Timeout (0:01:00)!\n ... libtpu.so: cannot "
+                              "open shared object ...")
+    assert cls == "no-libtpu"
+
+
+def test_classify_persisted_bench_report():
+    """The r04/r05 gap: a persisted probe report (bench.py
+    PROBE_REPORT_PATH shape) classifies without re-running a probe."""
+    hung = {"status": "hung",
+            "env": {"JAX_PLATFORMS": None, "PJRT_DEVICE": None},
+            "attempts": [
+                {"rc": None,
+                 "stderr_tail": "hung past the 90s per-attempt timeout; "
+                                "abandoned"}]}
+    out = classify_report(hung)
+    assert out["classification"] == "device-hang"
+    assert out["source"] == "persisted-report"
+
+    errored = {"status": "errored", "attempts": [
+        {"rc": 1, "stderr_tail": "...",
+         "stderr": "RuntimeError: Unable to initialize backend 'tpu': "
+                   "UNAVAILABLE: TPU backend setup/compile error"}]}
+    assert classify_report(errored)["classification"] == "pjrt-init-failure"
+    assert classify_report({"status": "ok"})["classification"] == "ok"
+
+
+def test_main_classify_report_and_exit_codes(tmp_path, capsys):
+    rpt = tmp_path / "probe_report.json"
+    rpt.write_text(json.dumps({"status": "hung", "attempts": [
+        {"rc": None, "stderr_tail": "hung; abandoned"}]}))
+    rc = main(["--classify-report", str(rpt)])
+    assert rc == 3
+    out = json.loads(capsys.readouterr().out)
+    assert out["classification"] == "device-hang"
+
+    missing = main(["--classify-report", str(tmp_path / "nope.json")])
+    assert missing == 2
+
+
+def test_main_probe_writes_report(tmp_path, capsys):
+    dest = tmp_path / "doctor.json"
+    rc = main(["--timeout", "10", "--report", str(dest),
+               "--probe-code", "print('ok-device')"])
+    assert rc == 0
+    on_disk = json.loads(dest.read_text())
+    assert on_disk["classification"] == "ok"
+    assert json.loads(capsys.readouterr().out)["status"] == "ok"

@@ -33,6 +33,9 @@ _DYNAMIC = {
     "realtimeIngestionDelayMs.{table}",      # realtime/manager.py
     "realtimeIngestionOffsetLag.{table}",    # realtime/manager.py
     "injectedFaults",                        # spi/faults.py
+    "traceStoreTraces",                      # cluster/broker.py
+    "traceStoreBytes",                       # cluster/broker.py
+    "traceStoreEvictions",                   # cluster/broker.py
 }
 
 _ENUMS = (m.ServerMeter, m.BrokerMeter, m.ServerTimer, m.BrokerTimer,

@@ -158,6 +158,74 @@ def test_cluster_off_path_zero_spans_zero_store_writes(warm_cluster,
         "beacon, no scrape work on the query thread")
 
 
+def test_sampling_disabled_adds_zero_spans_zero_syncs(warm_cluster,
+                                                      monkeypatch):
+    """Flight recorder off-path guard: with PINOT_TPU_TRACE_SAMPLE unset
+    (and again explicitly 0.0) a broker query allocates zero spans and
+    adds zero device syncs — the sampler must stay a cheap decision, not
+    an armed trace."""
+    _store, broker, _ = warm_cluster
+    sync = _CountingSync(monkeypatch)
+    for env in (None, "0.0"):
+        if env is None:
+            monkeypatch.delenv("PINOT_TPU_TRACE_SAMPLE", raising=False)
+        else:
+            monkeypatch.setenv("PINOT_TPU_TRACE_SAMPLE", env)
+        spans_before = span_allocations()
+        r = broker.execute_sql(CSQL)
+        assert not r.exceptions, r.exceptions
+        assert r.trace_info is None
+        assert getattr(r, "trace_id", None) is None
+        assert span_allocations() == spans_before
+    assert sync.block_calls == 0 and sync.device_get_calls == 0
+
+
+def test_sampled_run_traces_but_ships_plain(warm_cluster, monkeypatch):
+    """Sanity for the guard above: sampling armed DOES allocate spans and
+    retain the trace — while the client response still ships without it."""
+    _store, broker, _ = warm_cluster
+    monkeypatch.setenv("PINOT_TPU_TRACE_SAMPLE", "1.0")
+    spans_before = span_allocations()
+    r = broker.execute_sql(CSQL)
+    assert not r.exceptions, r.exceptions
+    assert span_allocations() > spans_before
+    assert r.trace_info is None, "sampled trace must not ship to the client"
+    assert broker.trace_store.get(r.query_id) is not None
+
+
+def test_warm_dispatch_counts_without_fingerprint_work(warm_engine,
+                                                       monkeypatch):
+    """The compile registry's warm path must be counter bumps only: no
+    span allocations and ZERO family-fingerprint computations (the
+    canonical-bytes IR walk happens exclusively on compile-guard misses).
+    segmentCache is disabled so the dispatch actually runs."""
+    from pinot_tpu.cache import keys as cache_keys
+    from pinot_tpu.engine.compile_registry import COMPILE_REGISTRY
+
+    sql = "SET segmentCache = false; " + SQL
+    r = warm_engine.execute_sql(sql)  # settle the family
+    assert not r.exceptions, r.exceptions
+    # count the IR walk itself: family_fingerprint intentionally does not
+    # bump fingerprint_computations(), so the guard watches canonical_bytes
+    walks = {"n": 0}
+    real_cb = cache_keys.canonical_bytes
+
+    def counting_cb(obj):
+        walks["n"] += 1
+        return real_cb(obj)
+
+    monkeypatch.setattr(cache_keys, "canonical_bytes", counting_cb)
+    spans_before = span_allocations()
+    d_before = COMPILE_REGISTRY.snapshot()["totalDispatches"]
+    r = warm_engine.execute_sql(sql)
+    assert not r.exceptions, r.exceptions
+    assert COMPILE_REGISTRY.snapshot()["totalDispatches"] > d_before, (
+        "warm dispatch must register in the compile registry")
+    assert walks["n"] == 0, (
+        "warm dispatch must not re-walk the Program IR")
+    assert span_allocations() == spans_before
+
+
 def test_analyze_and_beacon_move_the_new_counters(warm_cluster):
     """Sanity for the guard above: an armed run DOES move the new
     observability counters — ANALYZE allocates spans, the workload
