@@ -451,6 +451,18 @@ def orchestrate():
     probe_report = {"status": "skipped", "attempts": []}
     if not platform_req:
         probe_ok, probe_report = _probe_accelerator()
+        if probe_report.get("status") != "skipped":
+            # classify the probe outcome with the doctor's taxonomy so the
+            # bench JSON says WHY the accelerator was unusable (satellite:
+            # tools/doctor.py --classify-report shares this code path)
+            try:
+                from pinot_tpu.tools.doctor import classify_report
+
+                cls = classify_report(probe_report)
+                probe_report["classification"] = cls.get("classification")
+                probe_report["remedy"] = cls.get("remedy")
+            except Exception:
+                pass  # classification is advisory; never block the bench
         _persist_probe_report(probe_report)
         if probe_ok:
             platform_req = ""  # default backend (axon/TPU)
@@ -513,6 +525,18 @@ def orchestrate():
         else:
             env.pop("BENCH_PLATFORM", None)
             env.pop("JAX_PLATFORMS", None)
+        if platform_req == "cpu":
+            # a CPU child can still exercise the mesh-sharded dispatch path
+            # by splitting the host platform into N virtual devices — the
+            # mesh round then measures real cross-chip-combine mechanics
+            try:
+                mesh_n = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+            except ValueError:
+                mesh_n = 8
+            flag = f"--xla_force_host_platform_device_count={mesh_n}"
+            xla = env.get("XLA_FLAGS", "")
+            if mesh_n > 1 and "xla_force_host_platform_device_count" not in xla:
+                env["XLA_FLAGS"] = (xla + " " + flag).strip()
         print(f"[bench] -> {cfg} (budget {share:.0f}s)", file=sys.stderr,
               flush=True)
         proc = subprocess.Popen(
@@ -767,9 +791,22 @@ def run_single(cfg: str, outpath: str):
     # otherwise zero it from the second iteration on). Shapes whose engine
     # rejects the SET (e.g. the MSE join) time the plain SQL instead.
     cold_sql = "SET segmentCache = false; " + sql
+    # MESH mode: with >1 local device the engine shards batch families by
+    # default, so the solo baseline must force meshExecution=false to keep
+    # tpu_p50_s comparable across rounds; the mesh-on variant is timed in
+    # its own loop below and emitted as mesh_p50_s / mesh_speedup.
+    try:
+        mesh_ndev = len(jax.devices())
+    except Exception:
+        mesh_ndev = 1
+    mesh_sql = None
+    if mesh_ndev > 1:
+        mesh_sql = cold_sql
+        cold_sql = "SET meshExecution = false; " + cold_sql
     probe = tpu.execute_sql(cold_sql)
     if probe.exceptions:
         cold_sql = sql
+        mesh_sql = None
     times = []
     while len(times) < target_iters and (
             not times or time.monotonic() + min(times) < deadline):
@@ -779,6 +816,27 @@ def run_single(cfg: str, outpath: str):
     if r.exceptions:
         raise RuntimeError(f"{cold_sql}: {r.exceptions}")
     p50 = float(np.median(times))
+
+    # mesh-on loop: same cold semantics (segmentCache=false), sharded
+    # dispatch across all local devices; match is bit-identity (tol 0.0)
+    mesh_p50 = mesh_match = None
+    if mesh_sql is not None:
+        try:
+            rm = tpu.execute_sql(mesh_sql)
+            if not rm.exceptions:
+                mesh_times = []
+                while len(mesh_times) < min(target_iters, 5) and (
+                        not mesh_times
+                        or time.monotonic() + min(mesh_times) < deadline):
+                    t0 = time.perf_counter()
+                    rm = tpu.execute_sql(mesh_sql)
+                    mesh_times.append(time.perf_counter() - t0)
+                if not rm.exceptions and mesh_times:
+                    mesh_p50 = float(np.median(mesh_times))
+                    mesh_match = _rows_match(r.result_table.rows,
+                                             rm.result_table.rows, 0.0)
+        except Exception:
+            mesh_p50 = None  # mesh numbers are additive; never fail
 
     # WARM repeat loop: default caching on — the first run populates the
     # partial tiers, the timed repeats should hit with zero dispatches.
@@ -898,6 +956,13 @@ def run_single(cfg: str, outpath: str):
             rw, "num_segments_cache_miss", 0)
         payload["warm_num_device_dispatches"] = getattr(
             rw, "num_device_dispatches", 0)
+    if mesh_p50 is not None:
+        # sharded-dispatch round: solo-vs-mesh on the same engine instance,
+        # bit-identity required (mesh_match uses tol 0.0)
+        payload["mesh_devices"] = mesh_ndev
+        payload["mesh_p50_s"] = mesh_p50
+        payload["mesh_match"] = mesh_match
+        payload["mesh_speedup"] = p50 / mesh_p50 if mesh_p50 else None
     if note:
         payload["note"] = note
     if phases is not None:
@@ -934,10 +999,13 @@ def run_single(cfg: str, outpath: str):
                  if host_p50 is not None else "host skipped (deadline)")
     warm_part = (f"warm {warm_p50*1000:.1f}ms ({p50/warm_p50:.1f}x, "
                  f"match={warm_match})" if warm_p50 else "warm skipped")
+    mesh_part = (f"mesh[{mesh_ndev}] {mesh_p50*1000:.1f}ms "
+                 f"({p50/mesh_p50:.2f}x, match={mesh_match}), "
+                 if mesh_p50 else "")
     print(f"[bench] {name}: p50 {p50*1000:.1f}ms "
           f"({ROWS/p50/1e9:.2f}B rows/s; device-est {device_est*1000:.0f}ms "
-          f"after {rtt*1000:.0f}ms tunnel rtt), {warm_part}, {host_part}, "
-          f"match={match}"
+          f"after {rtt*1000:.0f}ms tunnel rtt), {mesh_part}{warm_part}, "
+          f"{host_part}, match={match}"
           + (f", {nbytes/p50/1e9:.0f} GB/s "
              f"({100*(nbytes/p50)/V5E_HBM_PEAK:.0f}% v5e peak; device-est "
              f"{100*(nbytes/max(device_est,1e-9))/V5E_HBM_PEAK:.0f}%)"

@@ -32,7 +32,7 @@ import numpy as np
 # Compared lowercase so spelling variants can't split cache entries.
 EXECUTION_ONLY_OPTIONS = frozenset({
     "segmentbatch", "devicecombine", "segmentcache", "resultcache",
-    "trace", "timeoutms", "usemultistageengine",
+    "trace", "timeoutms", "usemultistageengine", "meshexecution",
 })
 
 # Lifetime fingerprint computations in this process — the perf guard
@@ -179,7 +179,8 @@ def segment_token(segment) -> Optional[tuple]:
 
 def family_fingerprint(program, padded: int, fused: str = "",
                        lut_meta: tuple = (),
-                       batch_size: int = 0) -> Optional[str]:
+                       batch_size: int = 0,
+                       mesh: tuple = ()) -> Optional[str]:
     """Fingerprint of one COMPILED EXECUTABLE FAMILY: the Program IR plus
     the shape/variant axes jit actually specializes on (padded bucket,
     fused variant, LUT run metadata, batch size) — and nothing that is a
@@ -193,6 +194,11 @@ def family_fingerprint(program, padded: int, fused: str = "",
     try:
         payload = ("ffp1", canonical_bytes(program), int(padded),
                    str(fused), tuple(lut_meta), int(batch_size))
+        if mesh:
+            # sharded executables are distinct artifacts; solo families keep
+            # the historical ffp1 digest so registries don't churn
+            payload = ("ffp2",) + payload[1:] + (
+                tuple(int(x) for x in mesh),)
         return hashlib.sha256(canonical_bytes(payload)).hexdigest()
     except UnfingerprintableError:
         return None

@@ -39,6 +39,17 @@ def _quantile(sorted_ms: list, q: float) -> float:
     return round(float(sorted_ms[idx]), 3)
 
 
+def _safe_mesh_devices() -> int:
+    """meshDevices gauge supplier: local chips the segment mesh may span
+    (1 when the device backend is unavailable at scrape time)."""
+    try:
+        from ..parallel.mesh import mesh_device_count
+
+        return mesh_device_count()
+    except Exception:
+        return 1
+
+
 class ServerInstance:
     def __init__(self, store: PropertyStore, instance_id: str,
                  backend: str = "auto", tags: Optional[list[str]] = None,
@@ -87,6 +98,22 @@ class ServerInstance:
         SERVER_METRICS.set_gauge(
             ServerGauge.HBM_EVICTIONS,
             lambda: GLOBAL_DEVICE_CACHE.hbm_telemetry()["evictions"])
+        # mesh execution telemetry: how many local chips the segment-axis
+        # mesh spans, plus per-device HBM residency (one dynamic gauge per
+        # device id — scrape-time shard walks, never on the query path)
+        if backend != "host":
+            SERVER_METRICS.set_gauge(ServerGauge.MESH_DEVICES,
+                                     _safe_mesh_devices)
+            try:
+                import jax
+
+                for d in jax.devices():
+                    SERVER_METRICS.set_gauge(
+                        f"hbmBytesUsedDevice.{d.id}",
+                        lambda did=int(d.id):
+                        GLOBAL_DEVICE_CACHE.hbm_per_device().get(did, 0))
+            except Exception:
+                pass
         self._started = False
         # readiness (GET /health/readiness) gates on the FIRST converge
         # pass completing, not on mere registration: a server that joined

@@ -97,7 +97,7 @@ _GUARD = _CompileCacheGuard()
 
 def _register_compile(gkey, compile_ms: float, program, padded: int,
                       fused: str = "", lut_meta: tuple = (),
-                      batch_size: int = 0) -> None:
+                      batch_size: int = 0, mesh: tuple = ()) -> None:
     """Cold-path half of the compile telemetry registry: fingerprint the
     freshly-compiled family (a canonical-bytes IR walk — only ever paid
     on a compile-guard miss, next to an actual XLA compile) and record
@@ -105,7 +105,8 @@ def _register_compile(gkey, compile_ms: float, program, padded: int,
     from ..cache.keys import family_fingerprint
     from .compile_registry import COMPILE_REGISTRY, describe_family
 
-    fp = family_fingerprint(program, padded, fused, lut_meta, batch_size)
+    fp = family_fingerprint(program, padded, fused, lut_meta, batch_size,
+                            mesh=mesh)
     COMPILE_REGISTRY.note_compile(
         gkey, compile_ms, fp,
         describe_family(program, padded, fused, lut_meta, batch_size))
@@ -117,6 +118,21 @@ def _register_dispatch(gkey) -> None:
     from .compile_registry import COMPILE_REGISTRY
 
     COMPILE_REGISTRY.note_dispatch(gkey)
+
+
+# (program mode, error type) pairs whose mesh-sharded dispatch already
+# failed once — warn once, then fall back quietly to solo batching
+_MESH_WARNED: set = set()
+
+
+def _warn_mesh_fallback(program, err: Exception) -> None:
+    key = (getattr(program, "mode", "?"), type(err).__name__)
+    if key not in _MESH_WARNED:
+        _MESH_WARNED.add(key)
+        logging.getLogger(__name__).warning(
+            "mesh-sharded dispatch failed (%s: %s); falling back to "
+            "single-device batching for %s programs",
+            type(err).__name__, err, key[0])
 
 # Per-QUERY dispatch/compile counters. Thread-local because concurrent
 # queries share this module: every device dispatch happens on the query's
@@ -174,17 +190,21 @@ def _dict_pad(card: int) -> int:
     return b
 
 
-def batch_family_key(segment: ImmutableSegment, plan: SegmentPlan):
+def batch_family_key(segment: ImmutableSegment, plan: SegmentPlan,
+                     mesh: tuple = ()):
     """Host-computable batch family key: segments with equal keys gather
     identically-shaped device planes and params, so their kernel inputs can
     stack into [S, ...] arrays and run as ONE vmapped dispatch.
 
     The key is (program, padded bucket, per-slot dtype/packing signature,
     per-param dtype/shape signature) — derived purely from column METADATA
-    (no device upload), so EXPLAIN and the dispatcher share it. It mirrors
-    what gather_arrays_packed will produce; dispatch_plan_batch re-verifies
-    the real gathered shapes and raises BatchFamilyMismatch if the mirror
-    ever drifts. Returns None when a slot's shape can't be predicted."""
+    (no device upload), so EXPLAIN and the dispatcher share it. When mesh
+    execution is active the mesh shape joins the key so sharded and solo
+    executables cache separately (compile_registry.family_fingerprint gains
+    the same axis). It mirrors what gather_arrays_packed will produce;
+    dispatch_plan_batch re-verifies the real gathered shapes and raises
+    BatchFamilyMismatch if the mirror ever drifts. Returns None when a
+    slot's shape can't be predicted."""
     from ..segment.device_cache import pad_bucket, packed_hbm_enabled
     from ..spi.data_types import DataType
 
@@ -219,7 +239,10 @@ def batch_family_key(segment: ImmutableSegment, plan: SegmentPlan):
                      for p in plan.params)
     except Exception:
         return None
-    return (plan.program, padded, tuple(sig), psig)
+    key = (plan.program, padded, tuple(sig), psig)
+    if mesh:
+        key = key + (("mesh",) + tuple(mesh),)
+    return key
 
 
 class TpuSegmentExecutor:
@@ -422,14 +445,19 @@ class TpuSegmentExecutor:
                            round((time.perf_counter() - t1) * 1000, 3))
         return outs, view
 
-    def _gather_batch(self, segments: list, plans: list):
+    def _gather_batch(self, segments: list, plans: list, ndev: int = 1):
         """Gather + stack a batch family's kernel inputs: per-member planes
         come from the per-segment HBM cache (gather_arrays_packed — upload
         happens at most once per plane), the [S, ...] stacks from the
         cache's stacked-view layer (derived copies under the same byte
-        budget). Raises BatchFamilyMismatch if the members' gathered planes
-        disagree in dtype/shape/packing — the host-side family key should
-        prevent that; the check makes a drift fall back, not corrupt."""
+        budget). With ndev > 1 the stacks are built SHARDED across the
+        segment mesh axis (NamedSharding over the leading dim) and ragged
+        families pad to a multiple of ndev by repeating the last member
+        with num_docs=0 — the kernel's row-validity mask makes pad slots
+        contribute nothing. Raises BatchFamilyMismatch if the members'
+        gathered planes disagree in dtype/shape/packing — the host-side
+        family key should prevent that; the check makes a drift fall back,
+        not corrupt."""
         views = [self.cache.view(s) for s in segments]
         gathered = [pl.gather_arrays_packed(v)
                     for pl, v in zip(plans, views)]
@@ -438,6 +466,9 @@ class TpuSegmentExecutor:
         for arrs, pk in gathered[1:]:
             if pk != packed or len(arrs) != nslots:
                 raise BatchFamilyMismatch("packing/slot-count mismatch")
+        pad = 0
+        if ndev > 1:
+            pad = (-len(segments)) % ndev
         sview = self.cache.stacked_view(segments)
         stacked = []
         for i in range(nslots):
@@ -456,9 +487,23 @@ class TpuSegmentExecutor:
                 raise BatchFamilyMismatch(
                     f"slot {i} ({plans[0].slots[i]}): unequal plane "
                     f"shapes/dtypes across family members")
+            if pad:
+                col = col + [col[-1]] * pad
             pkey = (plans[0].slots[i], str(a0.dtype), tuple(a0.shape))
-            stacked.append(sview.plane(pkey, lambda c=tuple(col):
-                                       jnp.stack(c)))
+            if ndev > 1:
+                from ..parallel import mesh as pmesh
+
+                pkey = pkey + (("mesh", ndev),)
+
+                def build(c=tuple(col), nd=ndev):
+                    stack = jnp.stack(c)
+                    return jax.device_put(
+                        stack, pmesh.segment_sharding(nd, stack.ndim))
+
+                stacked.append(sview.plane(pkey, build))
+            else:
+                stacked.append(sview.plane(pkey, lambda c=tuple(col):
+                                           jnp.stack(c)))
         nparams = len(plans[0].params)
         if any(len(pl.params) != nparams for pl in plans):
             raise BatchFamilyMismatch("param-count mismatch")
@@ -469,29 +514,113 @@ class TpuSegmentExecutor:
             if any(p.shape != p0.shape or p.dtype != p0.dtype
                    for p in ps[1:]):
                 raise BatchFamilyMismatch(f"param {j}: shape/dtype mismatch")
+            if pad:
+                ps = ps + [ps[-1]] * pad
             params_b.append(np.stack(ps))
-        num_docs = np.asarray([s.num_docs for s in segments],
+        num_docs = np.asarray([s.num_docs for s in segments] + [0] * pad,
                               dtype=np.int32)
         return views, tuple(stacked), tuple(params_b), packed, num_docs
 
-    def _dispatch_batch(self, segments: list, plans: list):
+    def _dispatch_batch(self, segments: list, plans: list, mesh: tuple = (),
+                        pack: bool = False):
         if faults.ACTIVE:
             faults.FAULTS.fire("device.dispatch",
                                segment=segments[0].name,
                                batch_size=len(segments))
         if TRACING.active_trace() is None:
-            return self._dispatch_batch_inner(segments, plans, None)
+            return self._dispatch_batch_inner(segments, plans, None,
+                                              mesh=mesh, pack=pack)
         with TRACING.scope("family_dispatch") as span:
             reset_transfer_stats()
             try:
                 span.set_attribute("numSegments", len(segments))
-                return self._dispatch_batch_inner(segments, plans, span)
+                return self._dispatch_batch_inner(segments, plans, span,
+                                                  mesh=mesh, pack=pack)
             finally:
                 _attach_dispatch_stats(span, self.cache)
 
-    def _dispatch_batch_inner(self, segments: list, plans: list, span):
+    def _dispatch_batch_sharded(self, segments: list, plans: list, span,
+                                ndev: int, pack: bool):
+        """ONE sharded dispatch for the whole family: the [S, ...] stacks
+        split across mesh[SEGMENT_AXIS] so every local chip runs S/ndev
+        members concurrently, then results merge ON DEVICE (pack → flat on
+        device 0, or raw gather over ICI) before the query's single host
+        crossing. Per-row math is the solo vmap body — bit-identical."""
+        from ..parallel import mesh as pmesh
+
+        views, arrays, params_b, packed, num_docs = self._gather_batch(
+            segments, plans, ndev=ndev)
+        plan0 = plans[0]
+        asig = tuple((str(a.dtype), tuple(a.shape)) for a in arrays)
+        gkey = ("batchmesh", ndev, plan0.program, views[0].padded, packed,
+                asig, len(segments))
+        new_compile = _GUARD.note(gkey)
+        if span is not None:
+            span.set_attribute("mode", plan0.program.mode)
+            span.set_attribute("padded", views[0].padded)
+            span.set_attribute("meshDevices", ndev)
+        t0 = time.perf_counter()
+        outs = pmesh.run_program_batch_sharded(
+            plan0.program, arrays, params_b, num_docs, views[0].padded,
+            ndev, packed=packed)
+        t1 = time.perf_counter()
+        # counted only after the sharded dispatch succeeded: a trace-time
+        # failure falls back to the solo path, which counts itself — so
+        # numDeviceDispatches stays exactly one per family either way
+        _count_dispatch(new_compile)
+        compile_ms = round((t1 - t0) * 1000, 3) if new_compile else 0.0
+        if new_compile:
+            _register_compile(gkey, compile_ms, plan0.program,
+                              views[0].padded, batch_size=len(segments),
+                              mesh=(ndev,))
+        else:
+            _register_dispatch(gkey)
+        if span is not None:
+            span.set_attribute("compileMs", compile_ms)
+            stamps = pmesh.block_per_device(outs, ndev, t1)
+            span.set_attribute(
+                "deviceExecMs", stamps[-1][1] if stamps else 0.0)
+            for did, ms in stamps:
+                with TRACING.scope(f"mesh_device:{did}") as dspan:
+                    dspan.set_attribute("device", did)
+                    dspan.set_attribute("deviceExecMs", ms)
+        t2 = time.perf_counter()
+        if pack:
+            result = pmesh.pack_outputs_gathered(outs, len(segments))
+            sync_target = result.flat
+        else:
+            result = pmesh.gather_outputs(outs, len(segments))
+            sync_target = result
+        if span is not None:
+            jax.block_until_ready(sync_target)
+            combine_ms = round((time.perf_counter() - t2) * 1000, 3)
+            span.set_attribute("crossChipCombineMs", combine_ms)
+            try:
+                from ..spi.metrics import SERVER_METRICS, ServerTimer
+
+                SERVER_METRICS.update_timer(
+                    ServerTimer.CROSS_CHIP_COMBINE_MS, combine_ms)
+            except Exception:
+                pass
+        return result, views
+
+    def _dispatch_batch_inner(self, segments: list, plans: list, span,
+                              mesh: tuple = (), pack: bool = False):
         from ..ops.kernels import run_program_batch
 
+        ndev = int(mesh[0]) if mesh else 1
+        if ndev > 1 and len(segments) >= ndev:
+            try:
+                return self._dispatch_batch_sharded(segments, plans, span,
+                                                    ndev, pack)
+            except BatchFamilyMismatch:
+                raise
+            except Exception as e:
+                from .oom import HbmExhaustedError
+
+                if isinstance(e, HbmExhaustedError):
+                    raise
+                _warn_mesh_fallback(plans[0].program, e)
         views, arrays, params_b, packed, num_docs = self._gather_batch(
             segments, plans)
         plan0 = plans[0]
@@ -528,24 +657,29 @@ class TpuSegmentExecutor:
                            round((time.perf_counter() - t1) * 1000, 3))
         return outs, views
 
-    def dispatch_plan_batch(self, segments: list, plans: list):
+    def dispatch_plan_batch(self, segments: list, plans: list,
+                            mesh: tuple = ()):
         """ONE vmapped device dispatch for a whole batch family (equal
         batch_family_key). Returns a PackedOuts whose arrays carry a
         leading [S] dim; the caller slices row s for member s and feeds the
         slices through collect() unchanged — bit-for-bit what S separate
         dispatch_plan(..., fused='') calls would return, for one launch and
-        one D2H transfer. Raises BatchFamilyMismatch to request the
-        per-segment fallback."""
-        outs, _ = self._dispatch_batch(segments, plans)
-        return pack_outputs(outs)
+        one D2H transfer. With `mesh=(ndev,)` and S ≥ ndev the stack shards
+        across the local device mesh and the byte-pack happens on device
+        with the flat committed to device 0 — still one launch, one D2H.
+        Raises BatchFamilyMismatch to request the per-segment fallback."""
+        outs, _ = self._dispatch_batch(segments, plans, mesh=mesh, pack=True)
+        return outs if isinstance(outs, PackedOuts) else pack_outputs(outs)
 
-    def dispatch_plan_batch_raw(self, segments: list, plans: list):
+    def dispatch_plan_batch_raw(self, segments: list, plans: list,
+                                mesh: tuple = ()):
         """dispatch_plan_batch without the flat-buffer packing: returns
         (outs, views) with every output carrying a leading [S] dim, for
         callers that keep computing on device (the batched sparse device
         combine slices per-member rows lazily — the slices never leave
-        HBM)."""
-        return self._dispatch_batch(segments, plans)
+        HBM). Mesh-sharded dispatches gather their outputs to device 0
+        over ICI first so downstream device math colocates."""
+        return self._dispatch_batch(segments, plans, mesh=mesh)
 
     def collect(self, query: QueryContext, segment: ImmutableSegment,
                 plan: SegmentPlan, outs):

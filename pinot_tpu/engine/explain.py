@@ -187,10 +187,12 @@ def explain_plan(query, table, pruner, backend: str = "auto",
 
 # span attributes rendered on EXPLAIN ANALYZE nodes, in display order;
 # everything else (HBM gauge snapshots, internals) stays in trace_info
-_ANALYZE_ATTRS = ("segment", "numSegments", "segments", "mode", "padded",
+_ANALYZE_ATTRS = ("segment", "numSegments", "segments", "device",
+                  "meshDevices", "mode", "padded",
                   "fused", "workers", "leaf_pushdown", "rows_in", "rows_out",
                   "shuffled_rows", "shuffled_bytes", "compileMs",
-                  "deviceExecMs", "transferBytes", "cache")
+                  "deviceExecMs", "crossChipCombineMs", "transferBytes",
+                  "cache")
 
 
 def _cache_outcome(resp) -> str:

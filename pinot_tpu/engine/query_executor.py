@@ -498,8 +498,9 @@ class QueryExecutor:
         # kernel). Tokens mark family members: (family key, row in batch).
         fam_packs: dict = {}    # fkey → batched PackedOuts
         fam_inputs: dict = {}   # fkey → (segments, plans) for re-dispatch
+        msig = self._mesh_sig(query)
         for fkey, positions in self._batch_families(
-                query, [(e[2], e[4]) for e in device_entries]):
+                query, [(e[2], e[4]) for e in device_entries], mesh=msig):
             entries = [device_entries[p] for p in positions]
             if fkey is not None and len(entries) > 1:
                 segs_f = [e[2] for e in entries]
@@ -509,7 +510,8 @@ class QueryExecutor:
                     # cached segments once and retry (engine/oom.py — the
                     # DirectOOMHandler analogue). Relief drops whole stacks.
                     pack = with_oom_retry(
-                        lambda: self.tpu.dispatch_plan_batch(segs_f, plans_f),
+                        lambda: self.tpu.dispatch_plan_batch(segs_f, plans_f,
+                                                             mesh=msig),
                         keep_segment=segs_f[0], cache=self.tpu.cache)
                 except BatchFamilyMismatch:
                     pass  # host key over-grouped; per-segment is always valid
@@ -586,7 +588,8 @@ class QueryExecutor:
             # outputs
             def _refetch():
                 packs = [self.tpu.dispatch_plan(p[2], p[4]) for p in solo]
-                packs += [self.tpu.dispatch_plan_batch(*fam_inputs[k])
+                packs += [self.tpu.dispatch_plan_batch(*fam_inputs[k],
+                                                       mesh=msig)
                           for k in fam_keys]
                 return fetch_packed_batch(packs)
 
@@ -708,7 +711,27 @@ class QueryExecutor:
         return str(query.query_options.get("segmentBatch")).lower() \
             not in ("false", "0", "off")
 
-    def _batch_families(self, query: QueryContext, pairs: list) -> list:
+    def _mesh_enabled(self, query: QueryContext) -> bool:
+        """Mesh execution (segment-axis sharding of batch families over the
+        local devices) is ON by default when more than one device exists;
+        ``SET meshExecution = false`` opts a query out and
+        PINOT_TPU_MESH_DEVICES sizes/disables it process-wide."""
+        return str(query.query_options.get("meshExecution")).lower() \
+            not in ("false", "0", "off")
+
+    def _mesh_sig(self, query: QueryContext) -> tuple:
+        """Mesh shape for this query's family dispatches: (ndev,) when the
+        sharded path is active, () for solo batching. Part of the batch
+        family key so sharded and solo executables cache separately."""
+        if self.backend == "host" or not self._mesh_enabled(query):
+            return ()
+        from ..parallel.mesh import mesh_device_count
+
+        ndev = mesh_device_count()
+        return (ndev,) if ndev > 1 else ()
+
+    def _batch_families(self, query: QueryContext, pairs: list,
+                        mesh: tuple = ()) -> list:
         """Group (segment, plan) pairs into batch families by the
         host-side family key (engine/executor.py:batch_family_key).
         Returns ordered (fkey, positions) groups; fkey is None for pairs
@@ -719,7 +742,7 @@ class QueryExecutor:
         groups: dict = {}
         order: list = []
         for pos, (segment, plan) in enumerate(pairs):
-            fkey = batch_family_key(segment, plan)
+            fkey = batch_family_key(segment, plan, mesh)
             k = ("__solo__", pos) if fkey is None else fkey
             if k not in groups:
                 groups[k] = []
@@ -833,8 +856,9 @@ class QueryExecutor:
                         tab = self.tpu.cache.get_partial(("sparse_tab",) + k)
                         if tab is not None:
                             cached_tabs[i] = tab
+            msig = self._mesh_sig(query)
             for fkey, positions in self._batch_families(
-                    query, list(zip(segs, plans))):
+                    query, list(zip(segs, plans)), mesh=msig):
                 positions = [i for i in positions if i not in cached_tabs]
                 if not positions:
                     continue
@@ -845,10 +869,12 @@ class QueryExecutor:
                         # one (or a family-key drift) falls back to the 1x-
                         # footprint per-segment dispatch loop below instead
                         # of abandoning the device combine entirely
+                        # (mesh-sharded dispatches arrive gathered to
+                        # device 0 so the table merge below colocates)
                         outs_b, views_b = with_oom_retry(
                             lambda: self.tpu.dispatch_plan_batch_raw(
                                 [segs[i] for i in positions],
-                                [plans[i] for i in positions]),
+                                [plans[i] for i in positions], mesh=msig),
                             keep_segment=segs[positions[0]],
                             cache=self.tpu.cache)
                     except (BatchFamilyMismatch, HbmExhaustedError):

@@ -273,7 +273,18 @@ def pack_outputs(outs: tuple) -> PackedOuts:
     return PackedOuts(_pack_u8(outs), metas)
 
 
+# lifetime count of device→host materializations at the two packed-output
+# fetch sites; the mesh perf guard pins a warm sharded query to exactly ONE
+_HOST_FETCHES = [0]
+
+
+def host_fetches() -> int:
+    """Process-lifetime device→host fetch count (packed-output sites)."""
+    return _HOST_FETCHES[0]
+
+
 def unpack_outputs(p: PackedOuts) -> list:
+    _HOST_FETCHES[0] += 1
     flat = np.asarray(p.flat)  # the query's single device→host transfer
     return _split_flat(flat, p.metas)
 
@@ -319,6 +330,7 @@ def fetch_packed_batch(packs: list) -> list:
             for i in idxs:
                 out[i] = unpack_outputs(packs[i])
             continue
+        _HOST_FETCHES[0] += 1
         flat = np.asarray(_concat_flats(tuple(packs[i].flat for i in idxs)))
         for j, i in enumerate(idxs):
             out[i] = _split_flat(flat[j * n:(j + 1) * n], packs[i].metas)

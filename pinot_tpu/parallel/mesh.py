@@ -18,7 +18,9 @@ __graft_entry__.dryrun_multichip.
 
 from __future__ import annotations
 
-from functools import partial
+import os
+import time
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine import ir
-from ..ops.kernels import _run_program_impl
+from ..ops.kernels import PackedOuts, _apply_packed, _pack_u8, _run_program_impl
 
 ROW_AXIS = "sp"  # intra-segment row sharding (sequence-parallel analogue)
 SEGMENT_AXIS = "dp"  # across segments (data-parallel analogue)
@@ -182,6 +184,133 @@ def run_program_row_sharded(program: ir.Program, arrays: tuple, params: tuple,
     return _row_sharded_call(program, arrays, params, jnp.int32(num_docs),
                              padded, mesh, kinds, fused=fused,
                              lut_meta=lut_meta)
+
+
+# ---------------------------------------------------------------------------
+# Segment-axis sharding for batch families (ISSUE 12).
+#
+# PR-3 stacks a family's segments into [S, N] planes and vmaps one program
+# over the stack on a single chip. Here the SAME stacked arrays shard across
+# mesh[SEGMENT_AXIS] instead: each device vmaps over its local S/ndev rows,
+# so one dispatch runs the whole family on every local chip concurrently.
+# Per-row math is byte-for-byte the solo vmap body, which is what makes the
+# mesh path bit-identical to `SET meshExecution=false`.
+# ---------------------------------------------------------------------------
+
+
+def mesh_device_count() -> int:
+    """Local devices the segment-axis mesh may span, capped by the
+    PINOT_TPU_MESH_DEVICES env knob (<=1 disables mesh execution)."""
+    try:
+        n = len(jax.devices())
+    except Exception:  # backend init failure → solo execution
+        return 1
+    cap = os.environ.get("PINOT_TPU_MESH_DEVICES")
+    if cap:
+        try:
+            n = min(n, int(cap))
+        except ValueError:
+            pass
+    return max(1, n)
+
+
+@lru_cache(maxsize=None)
+def segment_mesh(ndev: int) -> Mesh:
+    """1-D mesh over the first `ndev` local devices on SEGMENT_AXIS."""
+    return Mesh(np.array(jax.devices()[:ndev]), (SEGMENT_AXIS,))
+
+
+def segment_sharding(ndev: int, ndim: int) -> NamedSharding:
+    """NamedSharding splitting the leading (stack) dim across the mesh."""
+    return NamedSharding(segment_mesh(ndev),
+                         P(SEGMENT_AXIS, *([None] * (ndim - 1))))
+
+
+def mesh_devices(ndev: int) -> list:
+    return list(jax.devices()[:ndev])
+
+
+@partial(jax.jit, static_argnames=("program", "padded", "packed", "ndev"))
+def _batch_sharded_call(program: ir.Program, arrays: tuple, params: tuple,
+                        num_docs, padded: int, packed: tuple, ndev: int):
+    mesh = segment_mesh(ndev)
+
+    def shard_fn(arrays_l, params_l, num_docs_l):
+        # mirror run_program_batch exactly: widen packed planes, then vmap
+        # the per-segment impl over the (local) stack rows
+        arrays_w = _apply_packed(arrays_l, packed)
+
+        def one(arrays_s, params_s, nd):
+            return _run_program_impl(program, arrays_s, params_s, nd, padded)
+
+        return jax.vmap(one)(arrays_w, params_l, num_docs_l)
+
+    fn = shard_map_compat(
+        shard_fn, mesh=mesh,
+        in_specs=(tuple(P(SEGMENT_AXIS) for _ in arrays),
+                  tuple(P(SEGMENT_AXIS) for _ in params),
+                  P(SEGMENT_AXIS)),
+        out_specs=P(SEGMENT_AXIS),
+        # outputs vary per stack row by construction; skip the vma/rep
+        # analysis so every program mode the solo vmap supports shards
+        check_vma=False,
+    )
+    return fn(arrays, params, num_docs)
+
+
+def run_program_batch_sharded(program: ir.Program, arrays: tuple, params: tuple,
+                              num_docs, padded: int, ndev: int,
+                              packed: tuple = ()):
+    """run_program_batch with the stack dim sharded over mesh[SEGMENT_AXIS].
+
+    `arrays`/`params`/`num_docs` are the family stacks padded to a multiple
+    of `ndev` rows (ragged remainders repeat the last member with num_docs=0
+    — the impl's row-validity mask makes those slots contribute nothing).
+    Outputs come back [S_pad, ...] sharded on SEGMENT_AXIS; callers slice or
+    gather on device (`pack_outputs_gathered` / `gather_outputs`).
+    """
+    return _batch_sharded_call(program, tuple(arrays), tuple(params),
+                               num_docs, padded, tuple(packed), ndev)
+
+
+@partial(jax.jit, static_argnames=("s_real",))
+def _pack_sliced(outs: tuple, s_real: int):
+    # drop the ragged pad rows on device, then byte-pack exactly like the
+    # solo path so the host sees identical flat bytes
+    return _pack_u8(tuple(o[:s_real] for o in outs))
+
+
+def pack_outputs_gathered(outs: tuple, s_real: int) -> PackedOuts:
+    """Device-side cross-chip combine for the packed (dense) path: slice the
+    pad rows, byte-pack on device, and commit the flat to device 0 so it
+    concatenates with solo packs and crosses to host exactly once."""
+    metas = [(np.dtype(str(o.dtype)), (s_real,) + tuple(o.shape[1:]))
+             for o in outs]
+    flat = jax.device_put(_pack_sliced(tuple(outs), s_real), jax.devices()[0])
+    return PackedOuts(flat, metas)
+
+
+def gather_outputs(outs: tuple, s_real: int) -> tuple:
+    """Cross-chip gather for the raw path (sparse device combine): commit
+    every [S_pad, ...] output to device 0 over ICI — no host crossing — so
+    downstream per-row slices and `combine_sparse_group_tables` colocate
+    with device-0-resident dictionaries."""
+    dev0 = jax.devices()[0]
+    return tuple(jax.device_put(o[:s_real], dev0) for o in outs)
+
+
+def block_per_device(outs: tuple, ndev: int, t0: float) -> list:
+    """Block each mesh device's output shards in device order; returns
+    [(device_id, ms_since_t0)] — the per-chip deviceExecMs attribution for
+    traced dispatches (monotone: chip i's stamp includes chips 0..i-1)."""
+    stamps = []
+    for d in jax.devices()[:ndev]:
+        for o in outs:
+            for sh in getattr(o, "addressable_shards", ()):
+                if sh.device == d:
+                    sh.data.block_until_ready()
+        stamps.append((d.id, round((time.perf_counter() - t0) * 1000.0, 3)))
+    return stamps
 
 
 def shard_segment_arrays(arrays: tuple, mesh: Mesh, padded: int, slots=None):

@@ -246,11 +246,31 @@ class StackedSegmentView:
 
     def nbytes(self) -> int:
         # same snapshot discipline as SegmentDeviceView.nbytes: plane()
-        # mutates _planes lock-free on every batched gather
-        return sum(p.nbytes for p in list(self._planes.values()))
+        # mutates _planes lock-free on every batched gather. Per-DEVICE
+        # accounting: a mesh-sharded stack costs each chip only its shard,
+        # and the budget models one device's HBM.
+        return sum(device_nbytes(p) for p in list(self._planes.values()))
 
     def evict(self) -> None:
         self._planes.clear()
+
+
+def device_nbytes(arr) -> int:
+    """Budget cost of one cached array against a SINGLE device's HBM: the
+    max bytes any one device holds. Single-device arrays cost their full
+    nbytes; mesh-sharded stacks cost ~nbytes/ndev per chip; replicated
+    arrays still cost full nbytes everywhere."""
+    n = int(getattr(arr, "nbytes", 0))
+    try:
+        if len(arr.sharding.device_set) <= 1:
+            return n
+        per: dict = {}
+        for sh in arr.addressable_shards:
+            did = sh.device.id
+            per[did] = per.get(did, 0) + int(sh.data.nbytes)
+        return max(per.values()) if per else n
+    except Exception:
+        return n
 
 
 class DeviceSegmentCache:
@@ -517,6 +537,33 @@ class DeviceSegmentCache:
                     "hbmPartialEntries": len(self._partials),
                     "hbmPartialBytes": partial_bytes}
 
+    def _per_device_locked(self) -> dict:
+        # caller holds self._lock; scrape-time only (walks every shard)
+        per: dict = {}
+        arrays: list = []
+        for v in self._views.values():
+            arrays.extend(list(v._planes.values()))
+        for s in self._stacks.values():
+            arrays.extend(list(s._planes.values()))
+        for ent in self._partials.values():
+            arrays.extend(ent[0])
+        for a in arrays:
+            try:
+                shards = a.addressable_shards
+            except Exception:
+                per[0] = per.get(0, 0) + int(getattr(a, "nbytes", 0))
+                continue
+            for sh in shards:
+                did = int(sh.device.id)
+                per[did] = per.get(did, 0) + int(sh.data.nbytes)
+        return {k: per[k] for k in sorted(per)}
+
+    def hbm_per_device(self) -> dict:
+        """Resident bytes per device id across every cache tier — the
+        scrape-time source for the hbmBytesUsedDevice.{device} gauges."""
+        with self._lock:
+            return self._per_device_locked()
+
     def hbm_telemetry(self) -> dict:
         """Flight-recorder HBM view: live residency per tier, lifetime
         per-tier high-water marks, and evictions attributed by tier and
@@ -528,6 +575,7 @@ class DeviceSegmentCache:
             stacks_b = sum(s.nbytes() for s in self._stacks.values())
             self._note_hwm_locked(views_b, stacks_b, partials_b)
             return {
+                "perDevice": self._per_device_locked(),
                 "budgetBytes": self.budget_bytes,
                 "bytesUsed": views_b + stacks_b + partials_b,
                 "tiers": {"views": views_b, "stacks": stacks_b,

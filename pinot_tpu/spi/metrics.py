@@ -78,6 +78,9 @@ class BrokerMeter:
 class ServerTimer:
     QUERY_PROCESSING_TIME_MS = "queryProcessingTimeMs"
     SCHEDULER_WAIT_MS = "schedulerWaitMs"
+    # on-device cross-chip result merge for mesh-sharded family dispatches
+    # (engine/executor.py _dispatch_batch_sharded; traced runs only)
+    CROSS_CHIP_COMBINE_MS = "crossChipCombineMs"
 
 
 class BrokerTimer:
@@ -100,6 +103,10 @@ class ServerGauge:
     HBM_BYTES_USED = "hbmBytesUsed"
     HBM_BYTES_HIGH_WATER = "hbmBytesHighWater"
     HBM_EVICTIONS = "hbmEvictions"
+    # mesh execution: local devices the segment-axis mesh spans
+    # (parallel/mesh.py mesh_device_count; per-device HBM residency is
+    # the dynamic hbmBytesUsedDevice.{device} gauge family)
+    MESH_DEVICES = "meshDevices"
 
 
 class ControllerMeter:

@@ -179,21 +179,29 @@ def phase_breakdown(trace_json: list) -> dict:
     emits: compile vs device-execute vs host-combine time and host->device
     transfer volume (keys sum over every span carrying the attribute)."""
     out = {"compileMs": 0.0, "deviceExecMs": 0.0, "hostCombineMs": 0.0,
-           "transferBytes": 0, "shuffledBytes": 0}
+           "crossChipCombineMs": 0.0, "transferBytes": 0, "shuffledBytes": 0}
     for span in trace_json:
         attrs = span.get("attributes") or {}
         out["compileMs"] += attrs.get("compileMs", 0.0)
-        out["deviceExecMs"] += attrs.get("deviceExecMs", 0.0)
+        if not str(span.get("operator", "")).startswith("mesh_device"):
+            # per-chip mesh spans re-attribute the parent family_dispatch's
+            # deviceExecMs per device; only the parent counts toward totals
+            out["deviceExecMs"] += attrs.get("deviceExecMs", 0.0)
+        out["crossChipCombineMs"] += attrs.get("crossChipCombineMs", 0.0)
         out["transferBytes"] += attrs.get("transferBytes", 0)
         out["shuffledBytes"] += attrs.get("shuffled_bytes", 0)
         if span.get("operator") in (ServerQueryPhase.SERVER_COMBINE,
                                     "BROKER_REDUCE"):
             out["hostCombineMs"] += span.get("durationMs", 0.0)
-    for k in ("compileMs", "deviceExecMs", "hostCombineMs"):
+    for k in ("compileMs", "deviceExecMs", "hostCombineMs",
+              "crossChipCombineMs"):
         out[k] = round(out[k], 3)
     if not out["shuffledBytes"]:
         # MSE-only phase: single-stage queries keep the classic four-key shape
         del out["shuffledBytes"]
+    if not out["crossChipCombineMs"]:
+        # mesh-only phase: solo dispatches keep the classic key shape
+        del out["crossChipCombineMs"]
     return out
 
 

@@ -153,6 +153,49 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
                     f"{cfg}: p50 regressed {bp:.4f}s -> {cp:.4f}s "
                     f"({(ratio - 1) * 100:.1f}% slower, threshold "
                     f"{threshold * 100:.0f}%)")
+        # mesh round (multi-device sharded dispatch): compared only when
+        # BOTH rounds measured it — older rounds predate the mesh mode and
+        # a missing side is coverage drift, not a regression. A device-count
+        # mismatch downgrades to WARN (the ratio would measure the mesh
+        # size, not the code), mirroring the cross-platform rule.
+        bm = b.get("mesh_p50_s")
+        cm = c.get("mesh_p50_s")
+        if bm is not None and cm is not None:
+            bmp, cmp_ = float(bm), float(cm)
+            mesh_ratio = (cmp_ / bmp) if bmp > 0 else float("inf")
+            mesh_delta_ms = (cmp_ - bmp) * 1000.0
+            mesh_devices_differ = (b.get("mesh_devices")
+                                   != c.get("mesh_devices"))
+            row.update({"baselineMeshP50s": round(bmp, 6),
+                        "candidateMeshP50s": round(cmp_, 6),
+                        "meshRatio": round(mesh_ratio, 4),
+                        "baselineMeshDevices": b.get("mesh_devices"),
+                        "candidateMeshDevices": c.get("mesh_devices")})
+            if b.get("mesh_match") is True and c.get("mesh_match") is False:
+                verdict = "FAIL"
+                failures.append(
+                    f"{cfg}: mesh result match flipped true -> false "
+                    "(sharded-dispatch correctness regression)")
+            elif bmp > 0 and mesh_ratio > 1.0 + threshold \
+                    and mesh_delta_ms >= min_abs_ms:
+                if cross_platform or mesh_devices_differ:
+                    if verdict == "PASS":
+                        verdict = "WARN"
+                    warnings.append(
+                        f"{cfg}: mesh p50 {bmp:.4f}s -> {cmp_:.4f}s "
+                        f"({(mesh_ratio - 1) * 100:.1f}% slower) across "
+                        + ("platforms" if cross_platform else
+                           f"mesh sizes ({b.get('mesh_devices')} -> "
+                           f"{c.get('mesh_devices')} devices)"))
+                else:
+                    verdict = "FAIL"
+                    failures.append(
+                        f"{cfg}: mesh p50 regressed {bmp:.4f}s -> "
+                        f"{cmp_:.4f}s ({(mesh_ratio - 1) * 100:.1f}% "
+                        f"slower, threshold {threshold * 100:.0f}%)")
+        elif bm is not None and cm is None:
+            warnings.append(f"{cfg}: baseline measured a mesh round but "
+                            "candidate did not (mesh coverage dropped)")
         row["verdict"] = verdict
         rows.append(row)
     return {"pass": not failures, "threshold": threshold,

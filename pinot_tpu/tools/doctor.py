@@ -14,8 +14,9 @@ cpu", which names NO cause. The doctor closes that gap two ways:
   instead of an empty timeout.
 - **classification**: child stderr (including the watchdog dump) is
   matched against the known failure signatures and reduced to one of
-  ``ok | no-libtpu | pjrt-init-failure | device-hang | env-misconfig |
-  import-error | unknown-error``, each with a concrete remedy line.
+  ``ok | not-a-tpu-vm | no-libtpu | pjrt-init-failure | device-hang |
+  env-misconfig | import-error | unknown-error``, each with a concrete
+  remedy line.
 
 ``--classify-report`` skips the probe and classifies a PERSISTED
 bench probe report (bench.py writes ``.bench_partial/probe_report.json``
@@ -59,6 +60,16 @@ faulthandler.cancel_dump_traceback_later()
 
 # signature → (classification, remedy); scanned in order, first hit wins
 _SIGNATURES = [
+    (("Failed to get TPU metadata", "gcp_metadata_utils",
+      "from instance metadata for variable"),
+     ("not-a-tpu-vm",
+      "libtpu is installed but this host is NOT a TPU VM: the TPU "
+      "plugin's init polls the GCP instance metadata server for chip "
+      "topology and that server 403s forever (30 retries per variable), "
+      "so autodetect hangs inside make_tfrt_tpu_c_api_client — set "
+      "JAX_PLATFORMS=cpu on non-TPU hosts instead of letting jax "
+      "autodetect (this is the r04/r05 bench 'probe failed or hung' "
+      "root cause)")),
     (("libtpu.so: cannot open shared object", "libtpu not found",
       "Unable to find libtpu", "No module named 'libtpu'",
       "libtpu.so: no such file"),

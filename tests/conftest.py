@@ -31,6 +31,9 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from the tier-1 gate")
+    config.addinivalue_line(
+        "markers", "mesh: multi-device mesh execution parity/perf tests "
+                   "(need >1 virtual device; see test_mesh_parity.py)")
 
 
 @pytest.fixture(scope="session")

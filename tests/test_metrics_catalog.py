@@ -33,6 +33,7 @@ _DYNAMIC = {
     "realtimeIngestionDelayMs.{table}",      # realtime/manager.py
     "realtimeIngestionOffsetLag.{table}",    # realtime/manager.py
     "injectedFaults",                        # spi/faults.py
+    "hbmBytesUsedDevice.{device}",           # cluster/server.py
     "traceStoreTraces",                      # cluster/broker.py
     "traceStoreBytes",                       # cluster/broker.py
     "traceStoreEvictions",                   # cluster/broker.py
