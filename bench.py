@@ -790,7 +790,9 @@ def run_single(cfg: str, outpath: str):
     # device execution path across rounds (cache/partial.py would
     # otherwise zero it from the second iteration on). Shapes whose engine
     # rejects the SET (e.g. the MSE join) time the plain SQL instead.
-    cold_sql = "SET segmentCache = false; " + sql
+    # resultCache also off: the MSE stage-plan cache would serve every
+    # iteration after the first and zero out the cold p50
+    cold_sql = "SET segmentCache = false; SET resultCache = false; " + sql
     # MESH mode: with >1 local device the engine shards batch families by
     # default, so the solo baseline must force meshExecution=false to keep
     # tpu_p50_s comparable across rounds; the mesh-on variant is timed in
@@ -977,6 +979,11 @@ def run_single(cfg: str, outpath: str):
         # shuffle vs join vs agg
         payload["mse_stage_stats"] = {str(k): v
                                       for k, v in stage_stats.items()}
+        # bytes that actually crossed a stage boundary (device handoffs
+        # count 0); the bench gate fails MSE configs that regress this
+        payload["shuffled_bytes"] = sum(
+            st.get("cross_stage_bytes", st.get("shuffled_bytes", 0))
+            for st in stage_stats.values())
     if kernel_s is not None:
         # measured pure-kernel time for ONE segment's program (all fixed
         # dispatch/tunnel costs cancelled); per-segment bytes give the

@@ -33,6 +33,7 @@ import numpy as np
 EXECUTION_ONLY_OPTIONS = frozenset({
     "segmentbatch", "devicecombine", "segmentcache", "resultcache",
     "trace", "timeoutms", "usemultistageengine", "meshexecution",
+    "devicejoin",
 })
 
 # Lifetime fingerprint computations in this process — the perf guard
@@ -153,6 +154,27 @@ def query_fingerprint(query) -> Optional[str]:
     request collide here by construction."""
     try:
         payload = ("qfp1", str(query), _result_options(query))
+        digest = hashlib.sha256(canonical_bytes(payload)).hexdigest()
+    except UnfingerprintableError:
+        return None
+    with _FP_LOCK:
+        _FP_COUNT[0] += 1
+    return digest
+
+
+def mse_plan_fingerprint(stages, query_options,
+                         parallelism: int) -> Optional[str]:
+    """Fingerprint of a fragmented MSE stage DAG: every Stage dataclass
+    (operator trees, exchange dists/keys, pruned send schemas) plus the
+    result-affecting SET options and the stage parallelism (it shapes
+    BREAK-mode truncation points, so it is result-affecting for overflowing
+    joins). The logical IR is all frozen dataclasses, so the closed-world
+    encoder covers it; any foreign node makes the plan uncacheable (None),
+    never wrongly cacheable."""
+    try:
+        opts = {str(k): str(v) for k, v in (query_options or {}).items()
+                if str(k).lower() not in EXECUTION_ONLY_OPTIONS}
+        payload = ("msefp1", tuple(stages), opts, int(parallelism))
         digest = hashlib.sha256(canonical_bytes(payload)).hexdigest()
     except UnfingerprintableError:
         return None

@@ -190,7 +190,8 @@ def explain_plan(query, table, pruner, backend: str = "auto",
 _ANALYZE_ATTRS = ("segment", "numSegments", "segments", "device",
                   "meshDevices", "mode", "padded",
                   "fused", "workers", "leaf_pushdown", "rows_in", "rows_out",
-                  "shuffled_rows", "shuffled_bytes", "compileMs",
+                  "shuffled_rows", "shuffled_bytes", "join_impl",
+                  "cross_stage_bytes", "device_partition_ms", "compileMs",
                   "deviceExecMs", "crossChipCombineMs", "transferBytes",
                   "cache")
 

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import functools
 import os
+from dataclasses import dataclass, field
+from typing import Optional
 
 import numpy as np
 
@@ -169,3 +171,234 @@ def enabled(ln: int, rn: int) -> bool:
         return backend_platform() != "cpu"
     except Exception:
         return False
+
+
+# -- fused partition→join→aggregate stage ------------------------------------
+#
+# The pair-producing join above still materializes (lidx, ridx) and hands
+# aggregation back to the host. The fused path below never materializes
+# pairs: the whole ``Aggregate ← INNER Join ← 2×hash-receive`` stage runs
+# as three device dispatches (partition left, partition right, join+agg)
+# and only a [n_aggs, G] group table crosses back — see
+# ops/join_pipeline.py for the kernels.
+
+# auto threshold for the fused stage: unlike the pair join it pays off on
+# the CPU backend too (it skips materializing `total_pairs` index/payload
+# arrays entirely), so the gate is on input size alone
+FUSED_AUTO_MIN_ROWS = 500_000
+
+
+def fused_min_rows() -> int:
+    try:
+        return int(os.environ.get("PINOT_TPU_DEVICE_JOIN_MIN_ROWS",
+                                  FUSED_AUTO_MIN_ROWS))
+    except ValueError:
+        return FUSED_AUTO_MIN_ROWS
+
+
+def fused_partitions() -> int:
+    """P of the device hash partition. Pure routing width: every P yields
+    the same result (partition combine is exact), so this only trades
+    plane height against vmap width."""
+    try:
+        return max(1, int(os.environ.get(
+            "PINOT_TPU_DEVICE_JOIN_PARTITIONS", 8)))
+    except ValueError:
+        return 8
+
+
+def env_mode() -> str:
+    return os.environ.get("PINOT_TPU_DEVICE_JOIN", "auto").lower()
+
+
+@dataclass
+class FusedStagePlan:
+    """Shape proof that a stage is ``Aggregate ← INNER equi-Join ← two hash
+    receives`` with aggregates the device kernel can produce. Built once
+    per query by plan_fused_stage; None means the stage keeps the generic
+    host operator tree."""
+    agg_node: object
+    join_node: object
+    receives: tuple            # (left recv, right recv) MailboxReceiveNodes
+    probe_side: str            # "left" | "right": the side the groups live on
+    group_cols: list = field(default_factory=list)   # (schema name, probe col)
+    # (kind, "probe"|"build"|None, value col name|None, out_name) per agg
+    aggs: list = field(default_factory=list)
+
+
+def _match_col(name: str, schema: list) -> Optional[str]:
+    if name in schema:
+        return name
+    suffix = [c for c in schema if c.endswith("." + name)]
+    return suffix[0] if len(suffix) == 1 else None
+
+
+def plan_fused_stage(stage) -> Optional[FusedStagePlan]:
+    from .fragmenter import MailboxReceiveNode
+    from .logical import AggregateNode, JoinNode
+
+    agg = stage.root
+    if not isinstance(agg, AggregateNode) or not agg.group_exprs:
+        return None
+    join = agg.inputs[0]
+    if (not isinstance(join, JoinNode) or join.join_type != "INNER"
+            or join.residual is not None or not join.left_keys
+            or len(join.inputs) != 2):
+        return None
+    recv_l, recv_r = join.inputs
+    if not all(isinstance(r, MailboxReceiveNode) and r.dist == "hash"
+               for r in (recv_l, recv_r)):
+        return None
+    lschema, rschema = list(recv_l.schema), list(recv_r.schema)
+
+    def resolve(name):
+        lc, rc = _match_col(name, lschema), _match_col(name, rschema)
+        if (lc is None) == (rc is None):   # missing or ambiguous
+            return None
+        return ("left", lc) if lc is not None else ("right", rc)
+
+    group_cols, sides = [], set()
+    for out_name, g in zip(agg.schema, agg.group_exprs):
+        if not g.is_identifier:
+            return None
+        got = resolve(g.identifier)
+        if got is None:
+            return None
+        sides.add(got[0])
+        group_cols.append((out_name, got[1]))
+    if len(sides) != 1:
+        # groups split across sides: every probe row would need two group
+        # codes — host path handles it
+        return None
+    probe_side = sides.pop()
+
+    aggs = []
+    for call in agg.agg_calls:
+        if call.condition is not None or call.extra:
+            return None
+        if call.name == "count" and not call.args:
+            aggs.append(("count", None, None, call.out_name))
+            continue
+        if call.name not in ("sum", "min", "max") or len(call.args) != 1 \
+                or not call.args[0].is_identifier:
+            return None
+        got = resolve(call.args[0].identifier)
+        if got is None:
+            return None
+        rel = "probe" if got[0] == probe_side else "build"
+        aggs.append((call.name, rel, got[1], call.out_name))
+    return FusedStagePlan(agg, join, (recv_l, recv_r), probe_side,
+                          group_cols, aggs)
+
+
+def run_fused(left, right, plan: FusedStagePlan, ctx=None):
+    """Execute a fused stage device-resident. Returns (block, info) or
+    None when any gate fails (dtype, empty side, plane overflow, join row
+    limit) — the caller's host fallback owns exact semantics for those."""
+    if _FAILED:
+        return None
+    from . import operators
+    from ..ops import join_pipeline as jp
+    from .mailbox import block_len
+
+    ln, rn = block_len(left), block_len(right)
+    if ln == 0 or rn == 0:
+        return None
+    join = plan.join_node
+    lcodes, rcodes = operators._joint_codes(
+        [np.asarray(left[k]) for k in join.left_keys],
+        [np.asarray(right[k]) for k in join.right_keys], ln, rn, ctx)
+
+    probe, build = (left, right) if plan.probe_side == "left" else (right, left)
+    pcodes, bcodes = ((lcodes, rcodes) if plan.probe_side == "left"
+                      else (rcodes, lcodes))
+    pn, bn = len(pcodes), len(bcodes)
+    # raw int keys ARE their own codes (the int fast path): values at or
+    # above the kernel's pad sentinels would alias padding
+    for c in (pcodes, bcodes):
+        if len(c) and (int(c.max()) >= (1 << 62)
+                       or int(c.min()) <= -(1 << 62)):
+            return None
+    # min build code feeds the partition kernel's packed-sort fast path
+    bmin = int(bcodes.min()) if len(bcodes) else 0
+
+    # bit-identity gate: integer-valued f64 accumulation is exact, hence
+    # reduction-order-free; float args would make partition order visible
+    pv_names = [c for k, s, c, _ in plan.aggs if s == "probe"]
+    bv_names = [c for k, s, c, _ in plan.aggs if s == "build"]
+    for side_block, names in ((probe, pv_names), (build, bv_names)):
+        for nm in dict.fromkeys(names):
+            if not operators._int_like(np.asarray(side_block[nm])):
+                return None
+
+    gcols = [np.asarray(probe[c]) for _, c in plan.group_cols]
+    gcodes, num, first = operators.group_codes(gcols)
+    if num == 0:
+        return None
+
+    P = fused_partitions()
+    Np, Nb = jp.bucket(pn), jp.bucket(bn)
+    # plane caps: the partition mix is pure, so the EXACT per-partition
+    # counts are a ~1ms host bincount — size each plane to the real max
+    # (pow2-bucketed for compile sharing). Tight caps halve every
+    # downstream plane pass vs a fixed headroom factor, and skewed keys
+    # (NULL buckets, heavy hitters) stay on device as long as their
+    # partition fits a plane at all.
+    cap_l = min(Np, jp.bucket(max(
+        64, int(jp.host_partition_counts(pcodes, P).max()))))
+    cap_r = min(Nb, jp.bucket(max(
+        64, int(jp.host_partition_counts(bcodes, P).max()))))
+    Gp = jp.bucket(num)
+
+    def pad1(a, n_to, dtype):
+        out = np.zeros(n_to, dtype=dtype)
+        out[:len(a)] = a
+        return out
+
+    pv_order = list(dict.fromkeys(pv_names))
+    bv_order = list(dict.fromkeys(bv_names))
+    pvals = np.stack([pad1(np.asarray(probe[c], dtype=np.float64), Np,
+                           np.float64) for c in pv_order]) \
+        if pv_order else np.zeros((1, Np))
+    bvals = np.stack([pad1(np.asarray(build[c], dtype=np.float64), Nb,
+                           np.float64) for c in bv_order]) \
+        if bv_order else np.zeros((1, Nb))
+    spec = tuple(
+        ("count", "probe", 0) if k == "count"
+        else (k, s, (pv_order if s == "probe" else bv_order).index(c))
+        for k, s, c, _ in plan.aggs)
+
+    try:
+        pk = pad1(pcodes, Np, np.int64)
+        bk = pad1(bcodes, Nb, np.int64)
+        pg = pad1(gcodes, Np, np.int64)
+        # probe plane only needs partition grouping (cheap one-key sort);
+        # the build plane must come out ascending-key for binary search
+        pplane, pcounts = jp.partition_planes(pk, pn, P, cap_l)
+        bplane, bcounts = jp.partition_planes(bk, bn, P, cap_r,
+                                              key_sorted=True, cmin=bmin)
+        packed = jp.fused_join_agg(pk, pg, pvals, pplane, pcounts,
+                                   bk, bvals, bplane, bcounts,
+                                   pn, bn, spec, P, Gp)
+        out = jp.fetch_packed(packed)
+    except Exception as e:
+        note_failure(e)
+        return None
+
+    n_aggs = len(plan.aggs)
+    meta = out[n_aggs + 1]
+    total_pairs = int(meta[0])
+    if meta[1] != 0.0 or total_pairs > operators.MAX_ROWS_IN_JOIN:
+        # plane overflow (key skew beyond the cap headroom) or the join row
+        # guard: the host path owns THROW/BREAK semantics
+        return None
+    pair_cnt = out[n_aggs][:num]
+    present = pair_cnt > 0
+
+    block = {}
+    for (out_name, col), kv in zip(plan.group_cols, gcols):
+        block[out_name] = kv[first][present]
+    for i, (kind, _s, _c, out_name) in enumerate(plan.aggs):
+        vals = out[i][:num][present]
+        block[out_name] = vals.astype(np.int64) if kind == "count" else vals
+    return block, {"total_pairs": total_pairs, "dispatches": 3}

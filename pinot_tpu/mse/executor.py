@@ -15,6 +15,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..cache.keys import mse_plan_fingerprint, segment_token
+from ..cache.results import BrokerResultCache, result_cache_enabled
 from ..engine.results import BrokerResponse, DataSchema, ResultTable
 from ..spi.trace import TRACING
 from .fragmenter import explain_stages, fragment
@@ -32,6 +34,35 @@ class MultistageExecutor:
     def __init__(self, query_executor, parallelism: int = 2):
         self.qe = query_executor
         self.parallelism = parallelism
+        # stage-plan result cache (the MSE analogue of the broker tier):
+        # keyed by (plan fingerprint, every scanned segment's (name, crc)),
+        # so segment replacement/refresh self-invalidates through the crc
+        # with no epoch plumbing. The executor instance is persistent
+        # (engine/query_executor.py caches it), so warm repeats of a join
+        # query skip the runner entirely.
+        self.result_cache = BrokerResultCache()
+
+    def _cache_key(self, stages, options) -> Optional[tuple]:
+        """None = uncacheable (unfingerprintable plan, missing table,
+        mutable or crc-less segment). Computed AFTER the resultCache
+        option gate so opted-out queries never pay a fingerprint."""
+        fp = mse_plan_fingerprint(stages, options, self.parallelism)
+        if fp is None:
+            return None
+        toks = []
+        for st in stages:
+            if st.root is None:
+                continue
+            for scan in st.scans():
+                t = self.qe.tables.get(scan.table)
+                if t is None:
+                    return None
+                for seg in list(t.segments):
+                    tok = segment_token(seg)
+                    if tok is None:
+                        return None
+                    toks.append((scan.table,) + tok)
+        return (fp, tuple(sorted(toks)))
 
     # -- catalog -----------------------------------------------------------
     def _catalog(self) -> dict[str, list[str]]:
@@ -117,6 +148,21 @@ class MultistageExecutor:
                         DataSchema(["plan"], ["STRING"]),
                         [[line] for line in text.split("\n")]),
                     time_used_ms=(time.perf_counter() - t0) * 1000)
+            cache_key = None
+            if query.explain is False and trace is None \
+                    and result_cache_enabled() \
+                    and not _option_false(query.options, "resultCache"):
+                cache_key = self._cache_key(stages, query.options)
+            if cache_key is not None:
+                cached = self.result_cache.get(cache_key)
+                if cached is not None:
+                    # bit-identical rows, zero dispatches: restamp only the
+                    # per-request fields on the shallow copy
+                    cached.cache_outcome = "hit"
+                    cached.num_device_dispatches = 0
+                    cached.num_compiles = 0
+                    cached.time_used_ms = (time.perf_counter() - t0) * 1000
+                    return cached
             from .operators import pop_join_overflow
 
             pop_join_overflow()  # clear any stale flag on this thread
@@ -150,6 +196,10 @@ class MultistageExecutor:
                 num_compiles=runner.stats.get("num_compiles", 0),
                 mse_stage_stats=runner.stage_stats,
                 time_used_ms=(time.perf_counter() - t0) * 1000)
+            if cache_key is not None:
+                resp.cache_outcome = "miss"
+                if not resp.partial_result:
+                    self.result_cache.put(cache_key, resp)
             if trace is not None:
                 resp.trace_info = trace.to_json()
             if analyze:
@@ -165,6 +215,13 @@ class MultistageExecutor:
         finally:
             if trace is not None:
                 TRACING.end_trace()
+
+
+def _option_false(options: dict, name: str) -> bool:
+    for k, v in (options or {}).items():
+        if str(k).lower() == name.lower():
+            return v is False or str(v).lower() in ("0", "false", "off")
+    return False
 
 
 def _block_to_result(block: Block, schema: list[str]) -> ResultTable:

@@ -124,11 +124,18 @@ def explain_stages(stages: list[Stage],
         lines.append(head)
         st = (stage_stats or {}).get(s.stage_id)
         if st is not None:
-            lines.append(
-                "  [impl] workers={workers} leaf_pushdown={leaf_pushdown} "
-                "rows_in={rows_in} rows_out={rows_out} "
-                "shuffled_rows={shuffled_rows} "
-                "shuffled_bytes={shuffled_bytes} "
-                "wall_ms={wall_ms:.1f}".format(**st))
+            line = ("  [impl] workers={workers} leaf_pushdown={leaf_pushdown} "
+                    "rows_in={rows_in} rows_out={rows_out} "
+                    "shuffled_rows={shuffled_rows} "
+                    "shuffled_bytes={shuffled_bytes} "
+                    "wall_ms={wall_ms:.1f}".format(**st))
+            if st.get("join_impl"):
+                line += (" join={join_impl} "
+                         "cross_stage_bytes={cross_stage_bytes} "
+                         "device_partition_ms={device_partition_ms:.1f}"
+                         .format(**st))
+            elif "cross_stage_bytes" in st:
+                line += " cross_stage_bytes={cross_stage_bytes}".format(**st)
+            lines.append(line)
         lines.extend("  " + ln for ln in s.root.tree_lines())
     return "\n".join(lines)

@@ -138,16 +138,42 @@ def table_partition(block: Block, key: str, pfunc: str,
 class MailboxService:
     """In-memory post office for one query execution."""
 
+    # pseudo-partition for whole-block handoffs (device-resident path):
+    # no hash split, the consumer takes the block as one unit
+    RAW_PARTITION = -1
+
     def __init__(self):
         self._boxes: dict[tuple, list[Block]] = defaultdict(list)
-        # per sending stage, for the stage-stats plane
+        # per sending stage, for the stage-stats plane. sent_bytes is the
+        # LOGICAL payload moved between stages (comparable across the
+        # encode/decode and handoff paths); cross_bytes is what actually
+        # crossed a process/host boundary — zero for raw handoffs.
         self.sent_rows: dict[int, int] = defaultdict(int)
         self.sent_bytes: dict[int, int] = defaultdict(int)
+        self.cross_bytes: dict[int, int] = defaultdict(int)
 
     def send(self, from_stage: int, to_stage: int, partition: int, block: Block) -> None:
         self.sent_rows[from_stage] += block_len(block)
-        self.sent_bytes[from_stage] += block_nbytes(block)
+        nb = block_nbytes(block)
+        self.sent_bytes[from_stage] += nb
+        self.cross_bytes[from_stage] += nb
         self._boxes[(from_stage, to_stage, partition)].append(block)
+
+    def send_raw(self, from_stage: int, to_stage: int, block: Block) -> None:
+        """Same-process device handoff: the block changes hands by
+        reference — no partition split, no encode/decode, nothing crosses
+        a wire. Logical bytes still accrue to sent_bytes so
+        /debug/workload cost rollups stay comparable across join paths;
+        cross_bytes stays untouched (that is the 5x the fused path buys)."""
+        self.sent_rows[from_stage] += block_len(block)
+        self.sent_bytes[from_stage] += block_nbytes(block)
+        self._boxes[(from_stage, to_stage, self.RAW_PARTITION)].append(block)
+
+    def receive_raw(self, from_stage: int, to_stage: int,
+                    schema: Optional[list[str]] = None) -> Block:
+        return concat_blocks(
+            self._boxes.get((from_stage, to_stage, self.RAW_PARTITION), []),
+            schema)
 
     def receive(self, from_stage: int, to_stage: int, partition: int,
                 schema: Optional[list[str]] = None) -> Block:

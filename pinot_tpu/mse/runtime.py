@@ -38,7 +38,14 @@ from .logical import (
     TableScanNode,
     WindowNode,
 )
-from .mailbox import Block, MailboxService, block_len, concat_blocks
+from . import device_join
+from .mailbox import (
+    Block,
+    MailboxService,
+    block_len,
+    concat_blocks,
+    hash_partition,
+)
 from .operators import (
     JoinCtx,
     op_aggregate,
@@ -107,6 +114,14 @@ class StageRunner:
         # shuffled rows/bytes, wall time) — the attribution plane for
         # EXPLAIN IMPLEMENTATION and bench's mse_stage_stats
         self.stage_stats: dict[int, dict] = {}
+        # device-resident join data path: stage_id → FusedStagePlan for
+        # stages that run the fused partition→join→aggregate kernels, and
+        # child stage_id → consumer stage_id for stages whose output stays
+        # a same-process device handoff (mailbox send_raw) instead of a
+        # hash shuffle. Populated by run(); always empty for the
+        # distributed per-stage runners (they never call run()).
+        self._fused: dict[int, object] = {}
+        self._handoff: dict[int, int] = {}
 
     def _sstat(self, stage_id: int) -> dict:
         st = self.stage_stats.get(stage_id)
@@ -114,12 +129,47 @@ class StageRunner:
             st = self.stage_stats[stage_id] = {
                 "workers": 0, "leaf_pushdown": False, "rows_in": 0,
                 "rows_out": 0, "shuffled_rows": 0, "shuffled_bytes": 0,
-                "wall_ms": 0.0}
+                "cross_stage_bytes": 0, "device_partition_ms": 0.0,
+                "join_impl": "", "wall_ms": 0.0}
         return st
 
     def _null_handling_requested(self) -> bool:
         opt = self.query_options.get("enableNullHandling")
         return opt is True or str(opt).lower() == "true"
+
+    def _device_join_option(self) -> Optional[bool]:
+        """SET deviceJoin = true (force) / false (opt out) / unset (auto:
+        size-gated at consume time)."""
+        for k, v in self.query_options.items():
+            if k.lower() == "devicejoin":
+                s = str(v).lower()
+                if s in ("0", "false", "off"):
+                    return False
+                if s in ("1", "true", "on", "force") or v is True:
+                    return True
+        return None
+
+    def _plan_fused(self) -> None:
+        """Mark the stages that take the device-resident join path. Only
+        the in-process mailbox can hand device arrays across a stage
+        boundary by reference; the distributed RoutedMailbox keeps the
+        DataTable wire path (its runners never call run(), so this is
+        also never reached there)."""
+        if type(self.mailbox) is not MailboxService:
+            return
+        if self._device_join_option() is False:
+            return
+        if device_join.env_mode() in ("0", "off", "false"):
+            return
+        for stage in self.stages:
+            if stage.stage_id == 0:
+                continue
+            plan = device_join.plan_fused_stage(stage)
+            if plan is None:
+                continue
+            self._fused[stage.stage_id] = plan
+            for recv in plan.receives:
+                self._handoff[recv.from_stage] = stage.stage_id
 
     # -- topology ----------------------------------------------------------
     def workers_of(self, stage: Stage) -> int:
@@ -133,6 +183,7 @@ class StageRunner:
 
     # -- run ---------------------------------------------------------------
     def run(self) -> Block:
+        self._plan_fused()
         # children have higher ids than parents: run bottom-up
         for stage in sorted(self.stages, key=lambda s: -s.stage_id):
             if stage.stage_id == 0:
@@ -170,8 +221,9 @@ class StageRunner:
             self._run_stage_inner(stage)
             st = self._sstat(stage.stage_id)
             for k in ("workers", "rows_in", "rows_out", "shuffled_rows",
-                      "shuffled_bytes", "leaf_pushdown"):
-                if k in st:
+                      "shuffled_bytes", "cross_stage_bytes",
+                      "device_partition_ms", "join_impl", "leaf_pushdown"):
+                if k in st and st[k] != "":
                     span.set_attribute(k, st[k])
 
     def _run_stage_inner(self, stage: Stage) -> None:
@@ -182,7 +234,10 @@ class StageRunner:
         st = self._sstat(stage.stage_id)
         t0 = time.perf_counter()
         pushed = None
-        if stage.is_leaf:
+        blocks = None
+        if stage.stage_id in self._fused:
+            blocks = self._run_fused_stage(stage, st)
+        elif stage.is_leaf:
             pushed = self._try_ssqe(stage)
             if pushed is None and self._null_handling_requested():
                 # the generic scan path has no null semantics — failing is
@@ -196,7 +251,7 @@ class StageRunner:
             st["workers"] = 1
             st["leaf_pushdown"] = True
             blocks = [pushed]
-        else:
+        elif blocks is None:
             st["workers"] = self.workers_of(stage)
             pool_size = min(st["workers"], _mse_threads())
             if pool_size > 1:
@@ -224,16 +279,83 @@ class StageRunner:
             else:
                 blocks = [self._worker_block(stage, w)
                           for w in range(st["workers"])]
+        # a stage feeding a fused consumer hands its block over whole: the
+        # consumer partitions on device (or re-partitions itself on
+        # fallback), so nothing is encoded or split here
+        handoff = self._handoff.get(stage.stage_id) == parent.stage_id
         for block in blocks:
             st["rows_out"] += block_len(block)
-            self.mailbox.send_partitioned(
-                stage.stage_id, parent.stage_id,
-                self._trim_to_send(stage, block),
-                stage.send_dist, stage.send_keys, parent_workers,
-                pfunc=stage.send_pfunc)
+            trimmed = self._trim_to_send(stage, block)
+            if handoff:
+                self.mailbox.send_raw(stage.stage_id, parent.stage_id,
+                                      trimmed)
+            else:
+                self.mailbox.send_partitioned(
+                    stage.stage_id, parent.stage_id, trimmed,
+                    stage.send_dist, stage.send_keys, parent_workers,
+                    pfunc=stage.send_pfunc)
         st["wall_ms"] += (time.perf_counter() - t0) * 1000
         st["shuffled_rows"] = self.mailbox.sent_rows[stage.stage_id]
         st["shuffled_bytes"] = self.mailbox.sent_bytes[stage.stage_id]
+        st["cross_stage_bytes"] = getattr(
+            self.mailbox, "cross_bytes",
+            self.mailbox.sent_bytes)[stage.stage_id]
+
+    def _run_fused_stage(self, stage: Stage, st: dict) -> list[Block]:
+        """The device-resident join stage: both inputs arrive as raw
+        same-process handoffs; the whole Aggregate←Join subtree runs as
+        three device dispatches (partition ×2, fused join+agg) with one
+        host fetch. Any gate failure re-creates the hash shuffle the
+        handoff skipped and runs the exact host operators per partition —
+        bit-identical to the never-fused plan."""
+        import time
+
+        from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+        plan = self._fused[stage.stage_id]
+        recv_l, recv_r = plan.receives
+        left = self.mailbox.receive_raw(recv_l.from_stage, stage.stage_id,
+                                        recv_l.schema)
+        right = self.mailbox.receive_raw(recv_r.from_stage, stage.stage_id,
+                                         recv_r.schema)
+        st["rows_in"] += block_len(left) + block_len(right)
+        forced = self._device_join_option() is True \
+            or device_join.env_mode() in ("1", "on", "force", "true")
+        eligible = forced or (block_len(left) + block_len(right)
+                              >= device_join.fused_min_rows())
+        ctx = self._join_ctx.for_stage(stage.stage_id)
+        if eligible:
+            t0 = time.perf_counter()
+            result = device_join.run_fused(left, right, plan, ctx)
+            if result is not None:
+                block, info = result
+                st["device_partition_ms"] += (time.perf_counter() - t0) * 1000
+                st["join_impl"] = "device-fused"
+                st["workers"] = 1
+                self.stats["num_device_dispatches"] += info["dispatches"]
+                SERVER_METRICS.add_meter(ServerMeter.MSE_DEVICE_JOINS)
+                return [block]
+            SERVER_METRICS.add_meter(ServerMeter.MSE_DEVICE_JOIN_FALLBACKS)
+        # host fallback: same hash routing the children would have used,
+        # then the exact host join+aggregate operators per partition
+        st["join_impl"] = "host"
+        workers = self.workers_of(stage)
+        st["workers"] = workers
+        lparts = hash_partition(left, recv_l.keys, workers)
+        rparts = hash_partition(right, recv_r.keys, workers)
+        blocks = []
+        for lw, rw in zip(lparts, rparts):
+            joined = op_join(lw, rw, plan.join_node.join_type,
+                             plan.join_node.left_keys,
+                             plan.join_node.right_keys,
+                             plan.join_node.residual,
+                             plan.join_node.schema, ctx=ctx)
+            if pop_join_overflow():
+                self.stats["join_overflow"] = True
+            blocks.append(op_aggregate(
+                joined, plan.agg_node.group_exprs, plan.agg_node.agg_calls,
+                plan.agg_node.schema))
+        return blocks
 
     # -- node execution ----------------------------------------------------
     def _exec(self, node: PlanNode, stage: Stage, worker: int) -> Block:

@@ -49,6 +49,10 @@ class ServerMeter:
     # realtime completion protocol stalled on a vacant controller seat:
     # each retry-while-no-leader backoff sleep bumps this (consumers HOLD)
     COMPLETION_HOLDS_NO_LEADER = "completionHoldsNoLeader"
+    # device-resident MSE join stages: fused kernel runs vs gate failures
+    # (dtype/overflow/empty side) that fell back to the host operators
+    MSE_DEVICE_JOINS = "mseDeviceJoins"
+    MSE_DEVICE_JOIN_FALLBACKS = "mseDeviceJoinFallbacks"
 
 
 class BrokerMeter:

@@ -25,7 +25,11 @@ Checks per config present in the baseline:
 - **match flip**: baseline ``match`` true → candidate false is a
   CORRECTNESS regression and always fails, no threshold;
 - **missing config**: a config the baseline measured that the candidate
-  dropped fails (silent coverage loss reads as a pass otherwise).
+  dropped fails (silent coverage loss reads as a pass otherwise);
+- **shuffled-bytes regression** (MSE configs that record it): candidate
+  ``shuffled_bytes`` > baseline × (1 + ``--threshold``) AND at least
+  4096 bytes more — a plan regression (lost pushdown, widened exchange
+  schema), same WARN-across-platforms downgrade as p50.
 
 Platform mismatch (cpu round vs tpu round) downgrades p50 checks to
 warnings: the ratio would measure the machine, not the code.
@@ -196,6 +200,39 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
         elif bm is not None and cm is None:
             warnings.append(f"{cfg}: baseline measured a mesh round but "
                             "candidate did not (mesh coverage dropped)")
+        # shuffled bytes (MSE configs only — bench.py records the summed
+        # cross-stage logical bytes for join configs): compared only when
+        # BOTH rounds measured it, same missing-side rule as mesh. Bytes
+        # are a plan property, not a wall-clock sample, so the threshold
+        # catches plan regressions (a lost pushdown, a widened exchange
+        # schema) rather than noise; the 4096-byte absolute floor keeps
+        # tiny fixture-sized runs from tripping the ratio on a few rows.
+        bs = b.get("shuffled_bytes")
+        cs = c.get("shuffled_bytes")
+        if bs is not None and cs is not None:
+            bsb, csb = int(bs), int(cs)
+            byte_ratio = (csb / bsb) if bsb > 0 else float("inf")
+            row.update({"baselineShuffledBytes": bsb,
+                        "candidateShuffledBytes": csb,
+                        "shuffledBytesRatio": round(byte_ratio, 4)
+                        if bsb > 0 else None})
+            if csb > bsb * (1.0 + threshold) and csb - bsb >= 4096:
+                if cross_platform:
+                    if verdict == "PASS":
+                        verdict = "WARN"
+                    warnings.append(
+                        f"{cfg}: shuffled bytes {bsb} -> {csb} "
+                        f"({(byte_ratio - 1) * 100:.1f}% more) across "
+                        "platforms")
+                else:
+                    verdict = "FAIL"
+                    failures.append(
+                        f"{cfg}: shuffled bytes regressed {bsb} -> {csb} "
+                        f"({(byte_ratio - 1) * 100:.1f}% more, threshold "
+                        f"{threshold * 100:.0f}%)")
+        elif bs is not None and cs is None:
+            warnings.append(f"{cfg}: baseline recorded shuffled_bytes but "
+                            "candidate did not (exchange telemetry dropped)")
         row["verdict"] = verdict
         rows.append(row)
     return {"pass": not failures, "threshold": threshold,

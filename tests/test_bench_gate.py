@@ -137,6 +137,61 @@ def test_unparseable_round_is_usage_error(tmp_path, capsys):
     assert "bench_gate:" in capsys.readouterr().err
 
 
+def test_shuffled_bytes_regression_fails(tmp_path, capsys):
+    """MSE configs record summed cross-stage bytes; a blow-up (lost
+    pushdown, widened exchange schema) fails even when p50 held steady."""
+    base = _payload()
+    base["detail"]["q2_groupby"]["shuffled_bytes"] = 100_000
+    cand = _payload()
+    cand["detail"]["q2_groupby"]["shuffled_bytes"] = 600_000
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "q2_groupby" in out and "shuffled bytes regressed" in out
+
+
+def test_shuffled_bytes_small_abs_delta_passes(tmp_path):
+    """A big ratio under the 4096-byte absolute floor is a fixture-sized
+    run, not a plan regression."""
+    base = _payload()
+    base["detail"]["q2_groupby"]["shuffled_bytes"] = 1000
+    cand = _payload()
+    cand["detail"]["q2_groupby"]["shuffled_bytes"] = 3000  # 3x but tiny
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+
+
+def test_shuffled_bytes_cross_platform_warns(tmp_path, capsys):
+    base = _payload()
+    base["detail"]["q2_groupby"]["shuffled_bytes"] = 100_000
+    cand = _payload(platform="cpu")
+    cand["detail"]["q2_groupby"]["shuffled_bytes"] = 600_000
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "shuffled bytes" in out and "GATE: PASS" in out
+
+
+def test_shuffled_bytes_missing_sides(tmp_path, capsys):
+    """Improvement passes; candidate dropping the metric only warns
+    (coverage drift, same rule as the mesh round); a baseline without the
+    metric never compares."""
+    base = _payload()
+    base["detail"]["q2_groupby"]["shuffled_bytes"] = 600_000
+    cand = _payload()
+    cand["detail"]["q2_groupby"]["shuffled_bytes"] = 100_000  # 6x better
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+    cand2 = _payload()  # no shuffled_bytes at all
+    c = _write(tmp_path, "c.json", cand2)
+    assert main([a, c]) == 0
+    assert "exchange telemetry dropped" in capsys.readouterr().out
+
+
 def test_compare_is_pure():
     base = _payload()
     cand = _payload()

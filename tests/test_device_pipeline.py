@@ -1,0 +1,403 @@
+"""Device-resident join pipeline: sqlite-oracle parity matrix + structural
+perf guards for the fused partition→join→aggregate stage (ops/join_pipeline
+kernels orchestrated by mse/device_join.run_fused).
+
+The matrix forces the device path (``SET deviceJoin = true`` end-to-end, or
+run_fused directly at the block level) and checks bit-identical rowsets
+against sqlite: NULL keys (object None AND float NaN) never match, empty
+partitions (P=8 > distinct keys), ragged partition sizes, string-key
+factorization, and the ``SET deviceJoin = false`` opt-out. The perf guards
+pin the tentpole's data-movement contract in the style of
+tests/test_mesh_parity.py: a fused stage costs exactly THREE device
+dispatches (partition ×2 + join/agg), ONE host crossing (the packed group
+table), and zero ``jax.device_get`` calls.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.mse import device_join, operators as ops
+from pinot_tpu.mse.device_join import FusedStagePlan, run_fused
+from pinot_tpu.mse.runtime import StageRunner
+from pinot_tpu.ops import join_pipeline, kernels
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+# -- block-level matrix: run_fused vs sqlite ---------------------------------
+
+AGGS = [("count", None, None, "cnt"),
+        ("sum", "probe", "w", "sw"), ("min", "probe", "w", "mw"),
+        ("sum", "build", "v", "sv"), ("min", "build", "v", "nv"),
+        ("max", "build", "v", "xv")]
+OUT_COLS = ["g", "cnt", "sw", "mw", "sv", "nv", "xv"]
+
+
+def _plan():
+    return FusedStagePlan(
+        agg_node=None,
+        join_node=SimpleNamespace(left_keys=["k"], right_keys=["k2"]),
+        receives=(None, None), probe_side="left",
+        group_cols=[("g", "g")], aggs=list(AGGS))
+
+
+def _blocks(key_mode: str):
+    """Probe (k, g, w) and build (k2, v) blocks plus python rows for the
+    oracle. key_mode: "ragged" (41 int keys, uneven partitions) |
+    "sparse" (4 distinct keys < P=8 — most partitions empty; small rows so
+    the co-located keys fit one partition plane) | "string" (factorized
+    object keys) | "null_object" | "null_float" (every NULL key shares one
+    join code, i.e. one partition — sparse enough to fit its plane)."""
+    rng = np.random.default_rng(13)
+    # deliberately not powers of two; sparse stays under the minimum plane
+    # height (64) so even all-keys-in-one-partition skew cannot overflow
+    ln, rn = (61, 53) if key_mode == "sparse" else (4003, 2999)
+    span = 4 if key_mode == "sparse" else 41
+    lk = rng.integers(0, span, ln)
+    rk = rng.integers(0, span, rn)
+    g = rng.integers(0, 6, ln).astype(np.int32)
+    w = rng.integers(0, 100, ln).astype(np.int64)
+    v = rng.integers(0, 100, rn).astype(np.int64)
+    if key_mode == "string":
+        lkeys = [f"k{int(x)}" for x in lk]
+        rkeys = [f"k{int(x)}" for x in rk]
+        left = {"k": np.asarray(lkeys, dtype=object), "g": g, "w": w}
+        right = {"k2": np.asarray(rkeys, dtype=object), "v": v}
+    elif key_mode == "null_object":
+        lkeys = [None if i % 29 == 0 else int(x) for i, x in enumerate(lk)]
+        rkeys = [None if i % 31 == 0 else int(x) for i, x in enumerate(rk)]
+        left = {"k": np.asarray(lkeys, dtype=object), "g": g, "w": w}
+        right = {"k2": np.asarray(rkeys, dtype=object), "v": v}
+    elif key_mode == "null_float":
+        lkeys = [None if i % 29 == 0 else int(x) for i, x in enumerate(lk)]
+        rkeys = [None if i % 31 == 0 else int(x) for i, x in enumerate(rk)]
+        left = {"k": np.asarray([np.nan if x is None else float(x)
+                                 for x in lkeys]), "g": g, "w": w}
+        right = {"k2": np.asarray([np.nan if x is None else float(x)
+                                   for x in rkeys]), "v": v}
+    else:
+        lkeys = [int(x) for x in lk]
+        rkeys = [int(x) for x in rk]
+        left = {"k": lk.astype(np.int64), "g": g, "w": w}
+        right = {"k2": rk.astype(np.int64), "v": v}
+    lrows = [(lkeys[i], int(g[i]), int(w[i])) for i in range(ln)]
+    rrows = [(rkeys[i], int(v[i])) for i in range(rn)]
+    return left, right, lrows, rrows
+
+
+def _oracle(lrows, rrows):
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE L (k, g INT, w INT)")
+    conn.execute("CREATE TABLE R (k2, v INT)")
+    conn.executemany("INSERT INTO L VALUES (?,?,?)", lrows)
+    conn.executemany("INSERT INTO R VALUES (?,?)", rrows)
+    rows = conn.execute(
+        "SELECT g, COUNT(*), SUM(w), MIN(w), SUM(v), MIN(v), MAX(v) "
+        "FROM L JOIN R ON L.k = R.k2 GROUP BY g ORDER BY g").fetchall()
+    conn.close()
+    return [tuple(int(x) for x in r) for r in rows]
+
+
+def _fused_rowset(block):
+    n = len(block["g"])
+    cols = [np.asarray(block[c]) for c in OUT_COLS]
+    return sorted(tuple(int(c[i]) for c in cols) for i in range(n))
+
+
+@pytest.mark.parametrize("key_mode", ["ragged", "sparse", "string",
+                                      "null_object", "null_float"])
+def test_fused_stage_matches_sqlite(key_mode):
+    left, right, lrows, rrows = _blocks(key_mode)
+    got = run_fused(dict(left), dict(right), _plan())
+    assert got is not None, f"fused path refused eligible input ({key_mode})"
+    block, info = got
+    assert info["dispatches"] == 3
+    assert _fused_rowset(block) == _oracle(lrows, rrows)
+
+
+def test_fused_stage_empty_side_and_no_matches():
+    left, right, _, _ = _blocks("ragged")
+    empty = {"k2": np.empty(0, dtype=np.int64), "v": np.empty(0, np.int64)}
+    # empty build side: refuse (host path owns the trivially-empty result)
+    assert run_fused(dict(left), empty, _plan()) is None
+    # disjoint key ranges: eligible, joins to zero rows → zero groups
+    shifted = {"k2": np.asarray(right["k2"]) + 1000, "v": right["v"]}
+    block, _info = run_fused(dict(left), shifted, _plan())
+    assert len(block["g"]) == 0
+
+
+def test_fused_stage_refuses_float_agg_values():
+    """Non-integer f64 values would make partition reduction order visible
+    in the sums — the bit-identity gate must route them to the host."""
+    left, right, _, _ = _blocks("ragged")
+    right = dict(right)
+    right["v"] = right["v"].astype(np.float64) + 0.5
+    assert run_fused(dict(left), right, _plan()) is None
+
+
+def test_fused_stage_refuses_sentinel_aliasing_keys():
+    left, right, _, _ = _blocks("ragged")
+    left, right = dict(left), dict(right)
+    left["k"] = left["k"].astype(np.int64)
+    left["k"][0] = np.int64(1 << 62)   # int fast path: raw key IS the code
+    assert run_fused(left, right, _plan()) is None
+
+
+def test_fused_stage_heavy_skew_sizes_planes_exactly():
+    """4 distinct keys over thousands of rows pile whole key populations
+    into a few partitions. The host-side exact partition counts size the
+    plane cap to the REAL max (not a balanced-distribution guess), so the
+    stage stays on device and stays bit-identical."""
+    rng = np.random.default_rng(13)
+    ln, rn = 4003, 2999
+    lk = rng.integers(0, 4, ln).astype(np.int64)
+    rk = rng.integers(0, 4, rn).astype(np.int64)
+    g = rng.integers(0, 6, ln).astype(np.int32)
+    w = rng.integers(0, 100, ln).astype(np.int64)
+    v = rng.integers(0, 100, rn).astype(np.int64)
+    left = {"k": lk, "g": g, "w": w}
+    right = {"k2": rk, "v": v}
+    got = run_fused(dict(left), dict(right), _plan())
+    assert got is not None, "fused path refused skew it can size planes for"
+    block, info = got
+    assert info["dispatches"] == 3
+    lrows = [(int(lk[i]), int(g[i]), int(w[i])) for i in range(ln)]
+    rrows = [(int(rk[i]), int(v[i])) for i in range(rn)]
+    assert _fused_rowset(block) == _oracle(lrows, rrows)
+
+
+def test_fused_kernel_flags_plane_overflow():
+    """Safety net under the exact caps: a plane too small for its
+    partition must surface through the packed meta row's overflow flag
+    (never silently drop rows). Exercised kernel-level with a cap below
+    the true max partition count."""
+    rng = np.random.default_rng(13)
+    n = 500
+    codes = rng.integers(0, 4, n).astype(np.int64)  # ≥1 partition > 64
+    counts = join_pipeline.host_partition_counts(codes, 8)
+    assert counts.max() > 64
+    N = join_pipeline.bucket(n)
+    pk = np.zeros(N, np.int64)
+    pk[:n] = codes
+    pplane, pcounts = join_pipeline.partition_planes(pk, n, 8, 64)
+    bplane, bcounts = join_pipeline.partition_planes(
+        pk, n, 8, 64, key_sorted=True, cmin=0)
+    packed = join_pipeline.fetch_packed(join_pipeline.fused_join_agg(
+        pk, np.zeros(N, np.int64), np.zeros((1, N)), pplane, pcounts,
+        pk, np.zeros((1, N)), bplane, bcounts, n, n,
+        (("count", "probe", 0),), 8, 8))
+    assert packed[-1, 1] != 0.0  # overflow flagged
+
+
+def test_fused_stage_defers_row_limit_to_host(monkeypatch):
+    """total_pairs beyond MAX_ROWS_IN_JOIN: the host fallback owns the
+    THROW/BREAK overflow semantics, so the kernel result is discarded."""
+    left, right, _, _ = _blocks("ragged")
+    monkeypatch.setattr(ops, "MAX_ROWS_IN_JOIN", 50)
+    assert run_fused(dict(left), dict(right), _plan()) is None
+
+
+# -- end-to-end: forced device stage vs opt-out vs sqlite --------------------
+
+N_ROWS = 5000
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    d = tmp_path_factory.mktemp("devpipe")
+    rng = np.random.default_rng(11)
+    cols = {
+        "lo_orderkey": rng.integers(0, 800, N_ROWS).astype(np.int32),
+        "lo_quantity": rng.integers(1, 10, N_ROWS).astype(np.int32),
+        "lo_discount": rng.integers(0, 4, N_ROWS).astype(np.int32),
+        "lo_revenue": rng.integers(100, 9000, N_ROWS).astype(np.int32),
+        "d_year": (1992 + rng.integers(0, 7, N_ROWS)).astype(np.int32),
+    }
+    schema = Schema.build(
+        "ssb",
+        dimensions=[("lo_orderkey", "INT"), ("lo_quantity", "INT"),
+                    ("lo_discount", "INT"), ("d_year", "INT")],
+        metrics=[("lo_revenue", "INT")])
+    SegmentBuilder(schema, segment_name="s0").build(cols, d / "s0")
+    qe = QueryExecutor(backend="host")
+    qe.add_table(schema, [load_segment(d / "s0")])
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE ssb (lo_orderkey INT, lo_quantity INT, "
+                 "lo_discount INT, lo_revenue INT, d_year INT)")
+    conn.executemany("INSERT INTO ssb VALUES (?,?,?,?,?)", zip(
+        *(cols[c].tolist() for c in ("lo_orderkey", "lo_quantity",
+                                     "lo_discount", "lo_revenue", "d_year"))))
+    yield qe, conn
+    conn.close()
+
+
+Q8_BODY = (
+    "SELECT a.d_year, COUNT(*), SUM(b.lo_revenue) FROM ssb a "
+    "JOIN ssb b ON a.lo_orderkey = b.lo_orderkey "
+    "WHERE a.lo_quantity < 3 AND b.lo_discount = 0 "
+    "GROUP BY a.d_year ORDER BY a.d_year LIMIT 100")
+MSE = "SET useMultistageEngine = true; SET resultCache = false; "
+
+
+def _rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return [tuple(int(v) for v in row) for row in resp.result_table.rows]
+
+
+@pytest.fixture
+def captured_runner(monkeypatch):
+    captured = {}
+    orig = StageRunner.run
+
+    def run(self):
+        captured["runner"] = self
+        return orig(self)
+
+    monkeypatch.setattr(StageRunner, "run", run)
+    return captured
+
+
+def _join_impls(runner):
+    return {st["join_impl"] for st in runner.stage_stats.values()
+            if st.get("join_impl")}
+
+
+def test_forced_fused_matches_optout_and_sqlite(env, captured_runner):
+    qe, conn = env
+    forced = qe.execute_sql(MSE + "SET deviceJoin = true; " + Q8_BODY)
+    runner = captured_runner["runner"]
+    assert _join_impls(runner) == {"device-fused"}
+    assert forced.num_device_dispatches >= 3
+    opted_out = qe.execute_sql(MSE + "SET deviceJoin = false; " + Q8_BODY)
+    assert _rows(forced) == _rows(opted_out)
+    assert _rows(forced) == [tuple(int(x) for x in r)
+                             for r in conn.execute(Q8_BODY).fetchall()]
+    # the raw-handoff children report logical shuffled bytes (the
+    # mse_stage_stats under-reporting fix) but zero cross-stage bytes
+    fused_sid = next(sid for sid, st in runner.stage_stats.items()
+                     if st.get("join_impl") == "device-fused")
+    for sid in runner.stages[fused_sid].child_stages:
+        st = runner.stage_stats[sid]
+        assert st["shuffled_bytes"] > 0
+        assert st["cross_stage_bytes"] == 0
+
+
+def test_auto_mode_below_threshold_runs_host_fallback(env, captured_runner):
+    """5000 rows < fused_min_rows(): the fused stage is PLANNED (raw
+    handoff engaged) but the join itself falls back to the host operators,
+    bit-identical to the never-fused plan."""
+    qe, conn = env
+    auto = qe.execute_sql(MSE + Q8_BODY)
+    assert _join_impls(captured_runner["runner"]) == {"host"}
+    plain = qe.execute_sql(MSE + "SET deviceJoin = false; " + Q8_BODY)
+    assert _rows(auto) == _rows(plain)
+
+
+def test_explain_implementation_renders_join_impl(env):
+    qe, _ = env
+    resp = qe.execute_sql(
+        "SET useMultistageEngine = true; SET deviceJoin = true; "
+        "EXPLAIN IMPLEMENTATION " + Q8_BODY)
+    assert not resp.exceptions, resp.exceptions
+    text = "\n".join(r[0] for r in resp.result_table.rows)
+    assert "join=device-fused" in text
+    assert "cross_stage_bytes=" in text and "device_partition_ms=" in text
+
+
+# -- MSE stage-plan cache + fingerprints -------------------------------------
+
+
+def test_warm_repeat_hits_cache_bit_identical_zero_dispatches(env):
+    qe, _ = env
+    sql = ("SET useMultistageEngine = true; SET deviceJoin = true; "
+           + Q8_BODY.replace("LIMIT 100", "LIMIT 99"))  # unseen cache key
+    cold = qe.execute_sql(sql)
+    assert cold.cache_outcome == "miss"
+    assert cold.num_device_dispatches >= 3
+    warm = qe.execute_sql(sql)
+    assert warm.cache_outcome == "hit"
+    assert warm.num_device_dispatches == 0
+    assert warm.num_compiles == 0
+    assert _rows(warm) == _rows(cold)
+
+
+def _fingerprint(qe, sql):
+    """Mirror the executor's planning pipeline on a FRESH parse so the test
+    proves process-stable fingerprints, not object identity."""
+    from pinot_tpu.cache.keys import mse_plan_fingerprint
+    from pinot_tpu.mse.executor import MultistageExecutor
+    from pinot_tpu.mse.fragmenter import fragment
+    from pinot_tpu.mse.logical import LogicalPlanner, prune_columns
+    from pinot_tpu.mse.optimizer import push_filters
+    from pinot_tpu.mse.parser import parse_relational
+
+    mse = MultistageExecutor(qe)
+    query = parse_relational(sql)
+    planner = LogicalPlanner(query, mse._catalog(),
+                             partition_catalog=mse._partition_catalog)
+    plan = push_filters(planner.plan())
+    prune_columns(plan)
+    return mse_plan_fingerprint(fragment(plan), query.options,
+                                mse.parallelism)
+
+
+def test_mse_plan_fingerprint_stability(env):
+    qe, _ = env
+    base = "SET useMultistageEngine = true; " + Q8_BODY
+    fp = _fingerprint(qe, base)
+    assert fp is not None
+    # stable: a second independent parse+plan of the same SQL collides
+    assert _fingerprint(qe, base) == fp
+    # execution-only knobs (deviceJoin) don't split cache entries
+    assert _fingerprint(
+        qe, "SET useMultistageEngine = true; SET deviceJoin = true; "
+        + Q8_BODY) == fp
+    # result-affecting deltas change the key
+    assert _fingerprint(qe, base.replace("lo_quantity < 3",
+                                         "lo_quantity < 4")) != fp
+    assert _fingerprint(
+        qe, "SET useMultistageEngine = true; SET numGroupsLimit = 3; "
+        + Q8_BODY) != fp
+
+
+# -- perf-structure guards ---------------------------------------------------
+
+
+def test_fused_stage_costs_three_dispatches_one_crossing(env):
+    """The tentpole's data-movement contract: partition(probe) +
+    partition(build) + fused join/agg = 3 dispatches, and only the packed
+    [n_aggs+2, G] table crosses back to the host — no jax.device_get, no
+    per-partition fetches."""
+    import jax
+
+    qe, _ = env
+    sql = MSE + "SET deviceJoin = true; " + Q8_BODY
+    warm = qe.execute_sql(sql)   # compile outside the measured run
+    assert not warm.exceptions, warm.exceptions
+
+    gets = []
+    real_get = jax.device_get
+
+    def _counting_get(*a, **k):
+        gets.append(a)
+        return real_get(*a, **k)
+
+    jax.device_get = _counting_get
+    try:
+        d0 = join_pipeline.dispatches()
+        f0 = kernels.host_fetches()
+        resp = qe.execute_sql(sql)
+    finally:
+        jax.device_get = real_get
+    assert not resp.exceptions, resp.exceptions
+    assert resp.num_device_dispatches == 3
+    assert join_pipeline.dispatches() - d0 == 3
+    assert kernels.host_fetches() - f0 == 1, \
+        "fused stage crossed to host more than once"
+    assert not gets, f"jax.device_get leaked into the fused path: {len(gets)}"

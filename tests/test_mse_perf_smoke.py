@@ -108,13 +108,21 @@ def test_mailbox_receives_only_pruned_columns(qe, monkeypatch):
     send_schema — the filter columns were consumed server-side."""
     sent: list[tuple[int, tuple]] = []
     orig_send = MailboxService.send
+    orig_raw = MailboxService.send_raw
 
     def send(self, from_stage, to_stage, partition, block):
         if block is not None:
             sent.append((from_stage, tuple(sorted(block.keys()))))
         return orig_send(self, from_stage, to_stage, partition, block)
 
+    def send_raw(self, from_stage, to_stage, block):
+        # the device-handoff path must ship the same pruned column set
+        if block is not None:
+            sent.append((from_stage, tuple(sorted(block.keys()))))
+        return orig_raw(self, from_stage, to_stage, block)
+
     monkeypatch.setattr(MailboxService, "send", send)
+    monkeypatch.setattr(MailboxService, "send_raw", send_raw)
     captured = {}
     orig_run = StageRunner.run
 
@@ -123,6 +131,9 @@ def test_mailbox_receives_only_pruned_columns(qe, monkeypatch):
         return orig_run(self)
 
     monkeypatch.setattr(StageRunner, "run", run)
+    # an earlier test ran the same SQL: drop its MSE result-cache entry so
+    # this run actually executes (the structure under test)
+    qe.multistage.result_cache.clear()
     resp = qe.execute_sql(Q8_SHAPED)
     assert not resp.exceptions, resp.exceptions
     runner = captured["runner"]
