@@ -18,5 +18,7 @@ from .store import PropertyStore
 from .controller import ClusterController
 from .server import ServerInstance
 from .broker import Broker
+from .rebalance import RebalanceActuator, SegmentRebalancer
 
-__all__ = ["PropertyStore", "ClusterController", "ServerInstance", "Broker"]
+__all__ = ["PropertyStore", "ClusterController", "ServerInstance", "Broker",
+           "SegmentRebalancer", "RebalanceActuator"]

@@ -246,6 +246,9 @@ class Broker:
                 BrokerMeter.RESULT_CACHE_HITS),
             "resultCacheMisses": BROKER_METRICS.meter_count(
                 BrokerMeter.RESULT_CACHE_MISSES),
+            # per-table decayed query cost (PR-10 rollups): the rebalancer
+            # reads these to spread hot-table segments first
+            "tableCostsMs": self.workload.table_costs(),
         }
         self.store.set(f"/BROKERSTATE/{self.broker_id}", state)
         return state

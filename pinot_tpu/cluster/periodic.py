@@ -146,6 +146,12 @@ class RebalanceChecker:
         fixed = {}
         live = set(self.controller.live_instances())
         for table in self.controller.store.children("/CONFIGS/TABLE"):
+            # a durable rebalance job owns this table's ideal state: the
+            # RebalanceActuator converges it move-by-move, and a concurrent
+            # blocking rebalance here would fight the journaled plan
+            job = self.controller.store.get(f"/REBALANCE/{table}") or {}
+            if job.get("status") in ("IN_PROGRESS", "ABORTING"):
+                continue
             cfg = self.controller.table_config(table) or {}
             replication = int(cfg.get("replication", 1))
             ideal = self.controller.store.get(f"/IDEALSTATES/{table}") or {}
@@ -678,6 +684,21 @@ def build_default_scheduler(store: PropertyStore, controller: ClusterController,
         return {t: mgr.cleanup(t) for t in store.children("/LINEAGE")}
 
     sched.register("LineageCleanupTask", interval_s, _lineage_cleanup)
+
+    def _rebalance_actuator():
+        # built lazily so importing periodic.py never pulls the engine in
+        from .rebalance import RebalanceActuator, SegmentRebalancer
+
+        if not hasattr(_rebalance_actuator, "task"):
+            _rebalance_actuator.task = RebalanceActuator(
+                SegmentRebalancer(controller))
+        return _rebalance_actuator.task()
+
+    # actuation wants a tighter cadence than housekeeping: a move's EV wait
+    # advances at most one step per tick
+    actuate_s = float(os.environ.get("PINOT_TPU_REBALANCE_TICK_S",
+                                     min(1.0, interval_s)))
+    sched.register("RebalanceActuator", actuate_s, _rebalance_actuator)
     # fleet scrape can run on its own cadence (operators tune how fresh
     # GET /debug/cluster is, independent of segment housekeeping)
     scrape_s = float(os.environ.get("PINOT_TPU_HEALTH_SCRAPE_S", interval_s))

@@ -427,6 +427,7 @@ class ControllerRestServer(_RestServer):
                 (r"/debug/store", lambda h, m, q: srv._debug_store()),
                 (r"/tables/([^/]+)/rebalanceStatus",
                  lambda h, m, q: srv._rebalance_status(m.group(1))),
+                (r"/debug/rebalance", lambda h, m, q: srv._debug_rebalance()),
                 (r"/tables/([^/]+)/instancePartitions",
                  lambda h, m, q: srv._instance_partitions(m.group(1))),
                 (r"/", lambda h, m, q: srv._home_page()),
@@ -439,9 +440,11 @@ class ControllerRestServer(_RestServer):
                 (r"/segments/([^/]+)/([^/]+)",
                  lambda h, m, q: srv._add_segment(m.group(1), m.group(2), h._body())),
                 (r"/tables/([^/]+)/rebalance",
-                 lambda h, m, q: (200, srv.controller.rebalance(
-                     table_name_with_type(m.group(1)),
-                     dry_run=q.get("dryRun", ["false"])[0] == "true"))),
+                 lambda h, m, q: srv._rebalance(
+                     m.group(1),
+                     dry_run=q.get("dryRun", ["false"])[0] == "true")),
+                (r"/tables/([^/]+)/rebalance/abort",
+                 lambda h, m, q: srv._rebalance_abort(m.group(1))),
                 (r"/tables/([^/]+)/relocate",
                  lambda h, m, q: (200, srv.controller.relocate_tiers(
                      table_name_with_type(m.group(1)),
@@ -542,6 +545,48 @@ class ControllerRestServer(_RestServer):
     def _rebalance_status(self, table: str):
         st = self.controller.rebalance_status(table_name_with_type(table))
         return (200, st) if st else (404, {"error": "no rebalance recorded"})
+
+    @property
+    def rebalancer(self):
+        """Lazily-built durable rebalance engine (cluster/rebalance.py);
+        shared with the periodic actuator when one is registered."""
+        if getattr(self, "_rebalancer", None) is None:
+            from .rebalance import SegmentRebalancer
+
+            self._rebalancer = SegmentRebalancer(self.controller)
+        return self._rebalancer
+
+    def _rebalance(self, table: str, dry_run: bool = False):
+        """POST /tables/{t}/rebalance — journal a durable, make-before-break
+        move plan and drive it to a terminal status inline (the journal at
+        /REBALANCE/{t} means a crash mid-drive is resumed by any leader's
+        RebalanceActuator rather than lost)."""
+        from .rebalance import RebalanceInProgress
+
+        nwt = table_name_with_type(table)
+        try:
+            if dry_run:
+                return 200, self.rebalancer.plan(nwt, dry_run=True)
+            return 200, self.rebalancer.run(nwt)
+        except RebalanceInProgress as e:
+            return 409, {"error": str(e)}
+        except KeyError:
+            return 404, {"error": f"table {table} not found"}
+        except TimeoutError as e:
+            return 200, {"status": "IN_PROGRESS", "detail": str(e),
+                         "job": self.rebalancer.job(nwt)}
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
+
+    def _rebalance_abort(self, table: str):
+        nwt = table_name_with_type(table)
+        job = self.rebalancer.job(nwt)
+        if not job:
+            return 404, {"error": "no rebalance recorded"}
+        return 200, self.rebalancer.abort(nwt)
+
+    def _debug_rebalance(self):
+        return 200, self.rebalancer.debug()
 
     def _instance_partitions(self, table: str):
         ip = self.controller.instance_partitions(table_name_with_type(table))

@@ -235,6 +235,13 @@ class ServerInstance:
                 GLOBAL_DEVICE_CACHE.drop_partials(segment_name=seg)
                 if segment is not None:
                     GLOBAL_DEVICE_CACHE.drop(segment)
+                else:
+                    # the live object is gone (lost mid-move, repair window,
+                    # prior incarnation of this instance) — id()-keyed views
+                    # and stacked [S, N] batch-family planes can only be
+                    # found by NAME now, and left behind they pin HBM for a
+                    # segment this server no longer serves
+                    GLOBAL_DEVICE_CACHE.drop_named(seg)
             # segments dropped from the ideal state release their quarantine
             # entry and transient-failure counters — nothing left to repair
             for seg in set(self.quarantined.get(table, ())) - want:
@@ -281,6 +288,16 @@ class ServerInstance:
                 faults.FAULTS.fire("segment.load", table=table, segment=seg)
             except faults.InjectedCorruption as c:
                 corruption = c
+            if corruption is None and self._is_move_destination(table, seg):
+                # chaos seam for mid-rebalance failure: this load is the
+                # DESTINATION fetch of an in-flight segment move (the
+                # /REBALANCE journal names this instance as the target)
+                try:
+                    faults.FAULTS.fire("rebalance.move", table=table,
+                                       segment=seg,
+                                       instance=self.instance_id)
+                except faults.InjectedCorruption as c:
+                    corruption = c
         local = self._fetch(meta["location"], fresh=fresh)
         if corruption is not None:
             local = self._corrupt_local_copy(local, corruption)
@@ -290,6 +307,23 @@ class ServerInstance:
             # without get built at load (SegmentPreProcessor)
             segment.backfill_indexes(indexing)
         return segment
+
+    def _is_move_destination(self, table: str, seg: str) -> bool:
+        """True when an active rebalance move targets (table, seg) AT this
+        instance — consulted only under faults.ACTIVE, so the extra store
+        read never taxes a normal load."""
+        try:
+            job = self.store.get(f"/REBALANCE/{table}")
+        except Exception:
+            return False
+        if not job or job.get("status") not in ("IN_PROGRESS", "ABORTING"):
+            return False
+        for move in (job.get("movePlan") or []):
+            if move.get("segment") == seg \
+                    and self.instance_id in (move.get("adds") or {}) \
+                    and move.get("state") in ("PENDING", "ADDING"):
+                return True
+        return False
 
     def _corrupt_local_copy(self, local: str, c) -> str:
         """Copy the fetched segment dir and damage the copy's data file —

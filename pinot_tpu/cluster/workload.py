@@ -231,6 +231,22 @@ class WorkloadTracker:
             q = roll.sums["queries"]
             return roll.sums["timeMs"] / q if q else 0.0
 
+    def table_costs(self) -> dict[str, float]:
+        """Every tracked table's decayed mean wall-time (ms). Published in
+        the broker's /BROKERSTATE beacon so the controller's rebalancer can
+        weight hot tables when ordering segment moves."""
+        with self._lock:
+            now = time.monotonic()
+            out = {}
+            for table, roll in self._tables.items():
+                if table == "(none)":
+                    continue
+                roll._decay(now)
+                q = roll.sums["queries"]
+                if q:
+                    out[table] = round(roll.sums["timeMs"] / q, 3)
+            return out
+
     def recommender_input(self, table: str) -> Optional[dict]:
         """Observed traffic in the exact body shape ``POST /recommender``
         accepts: {queries: [{sql, freq}], qps}."""

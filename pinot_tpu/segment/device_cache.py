@@ -228,8 +228,12 @@ class StackedSegmentView:
     under HBM pressure — rebuilding a stack only needs the (cheaper,
     also-cached) member planes, so relief still converges."""
 
-    def __init__(self, key: tuple):
+    def __init__(self, key: tuple, names: tuple = ()):
         self.key = key  # tuple of member id(segment)s
+        # member segment NAMES ride along so departure-time eviction can
+        # find stale stacks even after the member objects are gone (a
+        # rebalanced-away segment's id() no longer resolves to anything)
+        self.names = frozenset(str(n) for n in names)
         self._planes: dict[tuple, jnp.ndarray] = {}
 
     def plane(self, plane_key: tuple, build) -> jnp.ndarray:
@@ -323,12 +327,13 @@ class DeviceSegmentCache:
         fresh per query, so an id()-keyed cache entry could never be hit
         again and would only pin dead HBM bytes until eviction."""
         key = tuple(id(s) for s in segments)
+        names = tuple(getattr(s, "name", "") for s in segments)
         if any(getattr(s, "is_mutable", False) for s in segments):
-            return StackedSegmentView(key)
+            return StackedSegmentView(key, names)
         with self._lock:
             sv = self._stacks.get(key)
             if sv is None:
-                sv = self._stacks[key] = StackedSegmentView(key)
+                sv = self._stacks[key] = StackedSegmentView(key, names)
             if key in self._stack_order:
                 self._stack_order.remove(key)
             self._stack_order.append(key)
@@ -421,19 +426,77 @@ class DeviceSegmentCache:
         key = id(segment)
         name = getattr(segment, "name", None)
         with self._lock:
+            victims = 0
             v = self._views.pop(key, None)
             if v is not None:
                 v.evict()
+                victims += 1
+                self.eviction_stats["views"] += 1
             if key in self._order:
                 self._order.remove(key)
-            # any stack containing the dropped segment is stale
-            for skey in [k for k in self._stacks if key in k]:
+            # any stack containing the dropped segment is stale — match by
+            # member id AND by member name: a stack built from an earlier
+            # incarnation of this segment (repair replaced the object,
+            # server restart) holds dead ids that only the name resolves
+            for skey in [k for k, s in self._stacks.items()
+                         if key in k
+                         or (name is not None and str(name) in s.names)]:
                 self._stacks.pop(skey).evict()
                 self._stack_order.remove(skey)
+                victims += 1
+                self.eviction_stats["stacks"] += 1
             if name is not None:
                 for pkey in [k for k, ent in self._partials.items()
                              if ent[2] == str(name)]:
                     del self._partials[pkey]
+                    victims += 1
+                    self.eviction_stats["partials"] += 1
+            self.evictions += victims
+            self.eviction_stats["lineage"] += victims
+
+    def drop_named(self, segment_name: str) -> int:
+        """Release device planes for EVERY cached view/stack/partial derived
+        from a segment with this NAME — the departure path when the live
+        object is no longer in hand (the server lost it mid-move, a repair
+        replaced it, or the hosting instance died and a sibling converges).
+        Views and stacks are keyed by id(segment), so without the object
+        only the name can find them; a stacked [S, N] batch-family plane
+        that outlives a moved-away segment would otherwise pin its HBM
+        bytes until budget pressure. Conservative by design: another live
+        copy of the same-named segment just re-uploads on next touch.
+        Returns bytes freed."""
+        name = str(segment_name)
+        freed = victims = 0
+        with self._lock:
+            dead = [k for k, v in self._views.items()
+                    if str(getattr(v.segment, "name", "")) == name]
+            for key in dead:
+                v = self._views.pop(key)
+                freed += v.nbytes()
+                v.evict()
+                if key in self._order:
+                    self._order.remove(key)
+                victims += 1
+                self.eviction_stats["views"] += 1
+            dead_ids = set(dead)
+            for skey in [k for k, s in self._stacks.items()
+                         if name in s.names or dead_ids.intersection(k)]:
+                s = self._stacks.pop(skey)
+                freed += s.nbytes()
+                s.evict()
+                if skey in self._stack_order:
+                    self._stack_order.remove(skey)
+                victims += 1
+                self.eviction_stats["stacks"] += 1
+            for pkey in [k for k, ent in self._partials.items()
+                         if ent[2] == name]:
+                freed += self._partials[pkey][1]
+                del self._partials[pkey]
+                victims += 1
+                self.eviction_stats["partials"] += 1
+            self.evictions += victims
+            self.eviction_stats["lineage"] += victims
+        return freed
 
     def evict_all_except(self, keep_segment=None) -> tuple[int, int]:
         """HBM-pressure relief (engine/oom.py): evict every cached view

@@ -56,6 +56,10 @@ POINTS = (
     "datatable.encode",  # ServerInstance._handle_query DataTable encode
     "store.journal",     # PropertyStore WAL append (error = crash after
                          # append before notify; corrupt = torn write)
+    "rebalance.move",    # ServerInstance destination fetch of an in-flight
+                         # segment move (error/delay stall the move and
+                         # exercise retry/blacklist; corrupt damages the
+                         # fetched copy so quarantine+repair must heal it)
 )
 
 

@@ -123,12 +123,23 @@ class ControllerMeter:
     # one tick per anomaly flagged in a scrape (straggler, hbm-pressure,
     # cache-collapse, breaker-flap, instance-unreachable)
     CLUSTER_HEALTH_ANOMALIES = "clusterHealthAnomalies"
+    # elastic rebalance (cluster/rebalance.py): per-segment move lifecycle
+    SEGMENT_MOVES_STARTED = "segmentMovesStarted"
+    SEGMENT_MOVES_COMPLETED = "segmentMovesCompleted"
+    SEGMENT_MOVES_FAILED = "segmentMovesFailed"
 
 
 class ControllerGauge:
     STORE_JOURNAL_BYTES = "storeJournalBytes"
     # servers that answered the last health scrape (leader only)
     CLUSTER_SERVERS_REACHABLE = "clusterServersReachable"
+    # rebalance jobs currently IN_PROGRESS/ABORTING across all tables
+    REBALANCE_ACTIVE = "rebalanceActive"
+
+
+class ControllerTimer:
+    # wall time of one completed segment move, ADDING start → source drop
+    SEGMENT_MOVE_MS = "segmentMoveMs"
 
 
 # log-bucketed histogram resolution: 4 buckets per power of two keeps the

@@ -20,6 +20,12 @@ Three suites, each a pure function returning a stats dict, plus a CLI:
             ingest: exact-or-degraded responses throughout, consumers
             HOLD through outages, zero lost or duplicated committed
             segments afterward.
+  rebalance elastic capacity under live load: servers are killed and
+            added while the durable rebalance actuation loop
+            (cluster/rebalance.py) rebuilds dead replicas and spreads
+            onto new hosts; queries stay exact-or-degraded, one leader
+            kill mid-job exercises journal resume, and --fault-rate
+            arms the rebalance.move point on in-flight destinations.
 
 Default profile is a ~2-minute smoke across all suites:
 
@@ -1109,6 +1115,247 @@ def soak_failover(seconds: float = 30.0, seed: int = 0,
 
 
 # ════════════════════════════════════════════════════════════════════════════
+# Suite 6: rebalance — elastic capacity under live load
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def soak_rebalance(seconds: float = 30.0, seed: int = 0,
+                   n_segments: int = 8, rows_per_segment: int = 300,
+                   fault_rate: float = 0.0, progress=None,
+                   capture_report: bool = False) -> dict:
+    """Elastic-capacity soak: continuous broker queries while servers are
+    killed and added and the controller's DURABLE rebalance actuation loop
+    (cluster/rebalance.py) heals the cluster — dead-server rebuilds from
+    deep store, server-add spreading, plus one leader kill mid-job so the
+    standby must resume from the /REBALANCE journal.
+
+    Invariants: every response is exact or explicitly degraded
+    (partialResult/exceptions) — never silently wrong; every completed
+    job's final replica sets match its journaled target plan; and at the
+    end every segment holds its full replica count on live servers with
+    zero active jobs left behind.
+
+    With ``fault_rate`` > 0 a seeded schedule is armed on the
+    ``rebalance.move`` point (destination fetch of an in-flight move):
+    errors/delays stall moves into the retry path and the loop must still
+    converge inside the run budget."""
+    import threading
+
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+    from pinot_tpu.cluster.rebalance import (ACTIVE_STATUSES, DONE,
+                                             RebalanceActuator,
+                                             SegmentRebalancer)
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi import faults
+    from pinot_tpu.spi.data_types import Schema
+
+    schema = Schema.build(
+        "stats",
+        dimensions=[("team", "STRING"), ("year", "INT")],
+        metrics=[("runs", "INT")])
+    teams = ["BOS", "NYA", "SFN", "LAN", "CHC", "HOU"]
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.TemporaryDirectory(prefix="pinot_soak_rebalance_")
+    d = Path(tmp.name)
+    store = PropertyStore()
+    live_ctrl = {"Ctrl_0": ClusterController(store, instance_id="Ctrl_0"),
+                 "Ctrl_1": ClusterController(store, instance_id="Ctrl_1")}
+    controller = live_ctrl["Ctrl_0"]
+    replication = 2
+    servers: dict[str, ServerInstance] = {}
+    for i in range(3):
+        s = ServerInstance(store, f"Server_{i}", backend="host")
+        s.start()
+        servers[f"Server_{i}"] = s
+    broker = Broker(store)
+    controller.add_schema(schema.to_json())
+    table = controller.create_table(
+        {"tableName": "stats", "replication": replication})
+
+    expected = {}
+    for i in range(n_segments):
+        n = rows_per_segment
+        cols = {
+            "team": np.asarray(teams, dtype=object)[
+                rng.integers(0, len(teams), n)],
+            "year": rng.integers(2000, 2020, n).astype(np.int32),
+            "runs": rng.integers(0, 100, n).astype(np.int32),
+        }
+        name = f"stats_{i}"
+        SegmentBuilder(schema, segment_name=name).build(cols, d / name)
+        controller.add_segment(table, name,
+                               {"location": str(d / name), "numDocs": n})
+        for t, r in zip(cols["team"], cols["runs"]):
+            expected[t] = expected.get(t, 0) + int(r)
+
+    sql = ("SET allowPartialResults=true; SET resultCache=false; "
+           "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 20")
+    stats = {"queries": 0, "degraded_queries": 0, "server_kills": 0,
+             "server_adds": 0, "leader_kills": 0, "jobs_done": 0,
+             "moves_completed": 0}
+    if fault_rate > 0:
+        armed = faults.seed_schedule(seed, fault_rate,
+                                     points=("rebalance.move",))
+        if progress:
+            progress(f"rebalance: armed fault schedule on {sorted(armed)} "
+                     f"(rate={fault_rate}, seed={seed})")
+
+    # the actuator follows whichever controller holds the leader seat
+    engines = {cid: RebalanceActuator(
+        SegmentRebalancer(c, move_timeout_s=2.0, backoff_ms=50.0,
+                          max_moves=4))
+        for cid, c in live_ctrl.items()}
+
+    failures: list = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                resp = broker.execute_sql(sql)
+            except Exception as e:  # noqa: BLE001 — the soak records it
+                failures.append(f"query raised: {e!r}")
+                return
+            stats["queries"] += 1
+            if resp.exceptions or getattr(resp, "partial_result", False):
+                # degraded is allowed — silently wrong is not
+                stats["degraded_queries"] += 1
+                continue
+            got = {r[0]: r[1] for r in resp.result_table.rows}
+            if got != expected:
+                failures.append(f"silently wrong: got {got} "
+                                f"want {expected}")
+                return
+
+    def tick_actuators():
+        for cid in list(live_ctrl):
+            out = engines[cid]()
+            for val in (out.get("auto") or {}).values():
+                if isinstance(val, str) and ":" in val \
+                        and not val.startswith("skipped"):
+                    trig = val.split(":", 1)[0]
+                    t = stats.setdefault("triggers", {})
+                    t[trig] = t.get(trig, 0) + 1
+
+    def wait_jobs_drained(timeout: float) -> bool:
+        t = time.time()
+        while time.time() - t < timeout:
+            tick_actuators()
+            job = store.get(f"/REBALANCE/{table}")
+            if not job or job.get("status") not in ACTIVE_STATUSES:
+                if job and job.get("status") == DONE:
+                    # the converged ideal state must BE the journaled plan
+                    ideal = store.get(f"/IDEALSTATES/{table}") or {}
+                    want = {s: set(m)
+                            for s, m in (job.get("target") or {}).items()}
+                    got = {s: set(m) for s, m in ideal.items()}
+                    if want and got != want:
+                        failures.append(
+                            f"final assignment diverges from plan "
+                            f"{job.get('jobId')}: {got} != {want}")
+                    stats["jobs_done"] += 1
+                    stats["moves_completed"] += job.get("segmentsDone", 0)
+                    store.delete(f"/REBALANCE/{table}")
+                return True
+            time.sleep(0.02)
+        return False
+
+    next_id = 3
+    killed_leader = False
+    t0 = time.time()
+    threads = [threading.Thread(target=hammer)]
+    for t in threads:
+        t.start()
+    try:
+        while time.time() - t0 < seconds and not failures:
+            act = rng.random()
+            if act < 0.5 and len(servers) > replication:
+                # kill a server: dead-server trigger must rebuild replicas
+                name = str(rng.choice(sorted(servers)))
+                servers.pop(name).stop()
+                stats["server_kills"] += 1
+                if progress:
+                    progress(f"rebalance: killed {name}")
+            else:
+                name = f"Server_{next_id}"
+                next_id += 1
+                s = ServerInstance(store, name, backend="host")
+                s.start()
+                servers[name] = s
+                stats["server_adds"] += 1
+                if progress:
+                    progress(f"rebalance: added {name}")
+            if not killed_leader and stats["jobs_done"] >= 1:
+                # one crash mid-job: the standby resumes from the journal
+                tick_actuators()
+                if (store.get(f"/REBALANCE/{table}") or {}).get(
+                        "status") in ACTIVE_STATUSES:
+                    leader_id = next(c for c in live_ctrl
+                                     if live_ctrl[c].is_leader())
+                    c = live_ctrl.pop(leader_id)
+                    c.leader.disconnect()
+                    store.expire_session(leader_id)
+                    c.leader.stop()
+                    engines.pop(leader_id)
+                    stats["leader_kills"] += 1
+                    killed_leader = True
+                    if progress:
+                        progress(f"rebalance: killed leader {leader_id} "
+                                 "mid-job")
+            if not wait_jobs_drained(timeout=30.0):
+                failures.append(
+                    f"rebalance job stuck: {store.get(f'/REBALANCE/{table}')}")
+                break
+        # settle: drain any straggling job, then check the end state
+        wait_jobs_drained(timeout=30.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        live = set(store.children("/LIVEINSTANCES"))
+        ideal = store.get(f"/IDEALSTATES/{table}") or {}
+        for seg, m in ideal.items():
+            online_live = [i for i in m if i in live]
+            if len(online_live) < replication:
+                failures.append(
+                    f"{seg}: {len(online_live)} live replicas "
+                    f"{online_live} < replication {replication}")
+        if failures:
+            raise SoakFailure(
+                f"rebalance soak (seed {seed}): {failures[0]}")
+        if stats["jobs_done"] == 0:
+            raise SoakFailure(
+                f"rebalance soak (seed {seed}): churned "
+                f"{stats['server_kills']}+{stats['server_adds']} servers "
+                "but completed zero rebalance jobs")
+    finally:
+        stop.set()
+        if capture_report:
+            try:
+                ctrl = next((c for c in live_ctrl.values()
+                             if c.is_leader()), None)
+                if ctrl is not None:
+                    stats.update(_capture_cluster_report(store, ctrl,
+                                                         broker))
+            except Exception:
+                pass
+        if fault_rate > 0:
+            stats["injected_faults"] = faults.FAULTS.total_fired()
+            faults.FAULTS.reset()
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+        for c in live_ctrl.values():
+            c.stop()
+        tmp.cleanup()
+    stats.update({"suite": "rebalance",
+                  "elapsed_s": round(time.time() - t0, 1), "seed": seed})
+    return stats
+
+
+# ════════════════════════════════════════════════════════════════════════════
 # CLI
 # ════════════════════════════════════════════════════════════════════════════
 
@@ -1117,7 +1364,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="pinot_tpu soak/chaos harness (committed, reproducible)")
     p.add_argument("--suite", choices=["sql", "chaos", "qps", "realtime",
-                                       "failover", "all"],
+                                       "failover", "rebalance", "all"],
                    default="all")
     p.add_argument("--seconds", type=float, default=45.0,
                    help="wall-clock budget per time-based suite "
@@ -1140,7 +1387,8 @@ def main(argv=None) -> int:
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="chaos suite: probability (0..1) of a seeded "
                         "injected fault per call at transport.call, "
-                        "server.query and device.dispatch; queries run "
+                        "server.query and device.dispatch (rebalance "
+                        "suite: at rebalance.move); queries run "
                         "with allowPartialResults=true and degraded "
                         "(partial/error) responses are counted as "
                         "faulted_queries instead of failing the soak — "
@@ -1190,6 +1438,11 @@ def main(argv=None) -> int:
         if args.suite == "failover":
             results.append(soak_failover(
                 seconds=args.seconds, seed=args.seed, progress=progress,
+                capture_report=bool(args.report)))
+        if args.suite == "rebalance":
+            results.append(soak_rebalance(
+                seconds=args.seconds, seed=args.seed,
+                fault_rate=args.fault_rate, progress=progress,
                 capture_report=bool(args.report)))
     except SoakFailure as e:
         failed = str(e)

@@ -34,6 +34,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "mesh: multi-device mesh execution parity/perf tests "
                    "(need >1 virtual device; see test_mesh_parity.py)")
+    config.addinivalue_line(
+        "markers", "rebalance: durable segment-rebalance tests (engine, "
+                   "actuator triggers, make-before-break invariants); "
+                   "smoke-speed ones stay in the tier-1 gate")
 
 
 @pytest.fixture(scope="session")

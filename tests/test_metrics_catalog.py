@@ -40,7 +40,8 @@ _DYNAMIC = {
 }
 
 _ENUMS = (m.ServerMeter, m.BrokerMeter, m.ServerTimer, m.BrokerTimer,
-          m.ServerGauge, m.ControllerMeter, m.ControllerGauge)
+          m.ServerGauge, m.ControllerMeter, m.ControllerGauge,
+          m.ControllerTimer)
 
 
 def _code_names() -> set:

@@ -1,0 +1,878 @@
+"""Durable no-downtime segment rebalance (cluster/rebalance.py).
+
+Reference: TableRebalancer's minimum-available-replica stepping with a
+ZK-persisted job context (pinot-controller/.../helix/core/rebalance/),
+RebalanceChecker resuming stuck jobs after controller failover, and the
+make-before-break discipline of Helix ideal-state transitions.
+
+Covers: the per-segment move state machine end to end, leader failover
+resuming mid-rebalance from the journal, retry/backoff with destination
+blacklisting, abort/rollback, the make-before-break and routing
+invariants (bit-identical results through the both-replicas-ONLINE
+window), the rebalance.move fault point (corrupt destination fetch →
+quarantine → repair → move completes), the departure-time HBM eviction
+of stacked batch-family views, and the actuator's dead-server /
+server-add / health-driven triggers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                               ServerInstance)
+from pinot_tpu.cluster.rebalance import (ABORTED, DONE, IN_PROGRESS,
+                                         MOVE_CANCELLED, MOVE_COMPLETED,
+                                         MOVE_FAILED, PARTIAL,
+                                         RebalanceActuator,
+                                         RebalanceInProgress,
+                                         SegmentRebalancer)
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.metrics import (CONTROLLER_METRICS, SERVER_METRICS,
+                                   ControllerGauge, ControllerMeter,
+                                   ControllerTimer, ServerMeter)
+
+pytestmark = pytest.mark.rebalance
+
+SCHEMA = Schema.build(
+    "stats",
+    dimensions=[("team", "STRING"), ("year", "INT")],
+    metrics=[("runs", "INT")])
+
+TEAMS = ["BOS", "NYA", "SFN", "LAN"]
+GROUP_SQL = "SELECT team, SUM(runs) FROM stats GROUP BY team ORDER BY team"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.FAULTS.reset()
+
+
+def _build_segment(tmp, name, seed, n=400):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "team": np.asarray(TEAMS, dtype=object)[rng.integers(0, len(TEAMS), n)],
+        "year": rng.integers(2000, 2010, n).astype(np.int32),
+        "runs": rng.integers(0, 100, n).astype(np.int32),
+    }
+    path = str(tmp / name)
+    SegmentBuilder(SCHEMA, segment_name=name).build(cols, path)
+    return path
+
+
+def _mk_cluster(n_servers, backend="host"):
+    store = PropertyStore()
+    controller = ClusterController(store, instance_id="ctl1")
+    servers = [ServerInstance(store, f"S{i}", backend=backend)
+               for i in range(n_servers)]
+    for s in servers:
+        s.start()
+    controller.add_schema(SCHEMA.to_json())
+    return store, controller, servers
+
+
+def _add_segments(controller, table, tmp_path, n, docs=400):
+    for i in range(n):
+        path = _build_segment(tmp_path, f"s{i}", seed=i, n=docs)
+        controller.add_segment(table, f"s{i}",
+                               {"location": path, "numDocs": docs})
+
+
+def _zombie(store, name):
+    """A registered, live-looking server that never converges anything —
+    the perfect destination for exercising timeout/blacklist paths."""
+    store.set(f"/INSTANCECONFIGS/{name}", {"host": "nowhere", "port": 1,
+                                           "tags": ["DefaultTenant"]})
+    store.set(f"/LIVEINSTANCES/{name}", {"host": "nowhere", "port": 1},
+              ephemeral_owner=name)
+
+
+def _per_instance(ideal):
+    out = {}
+    for seg_map in ideal.values():
+        for inst in seg_map:
+            out[inst] = out.get(inst, 0) + 1
+    return out
+
+
+# -- engine: plan → tick → terminal -------------------------------------------
+
+
+def test_durable_rebalance_completes_and_levels(tmp_path):
+    store, controller, servers = _mk_cluster(2)
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 6)
+        rows_before = broker.execute_sql(GROUP_SQL).result_table.rows
+
+        s_new = ServerInstance(store, "S2", backend="host")
+        s_new.start()
+        servers.append(s_new)
+
+        rb = SegmentRebalancer(controller, move_timeout_s=10.0)
+        started0 = CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_STARTED)
+        done0 = CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_COMPLETED)
+        t_count0, _ = CONTROLLER_METRICS.timer_stats(
+            ControllerTimer.SEGMENT_MOVE_MS)
+        job = rb.run(table)
+
+        assert job["status"] == DONE
+        assert job["segmentsDone"] == job["segmentsTotal"] > 0
+        assert all(m["state"] == MOVE_COMPLETED for m in job["movePlan"])
+        # the converged ideal state IS the journaled target
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert {s: set(m) for s, m in ideal.items()} == \
+            {s: set(m) for s, m in job["target"].items()}
+        per_inst = _per_instance(ideal)
+        assert len(per_inst) == 3 and max(per_inst.values()) <= 3, per_inst
+        # metrics: one start + one completion + one timed sample per move
+        n = job["segmentsTotal"]
+        assert CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_STARTED) == started0 + n
+        assert CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_COMPLETED) == done0 + n
+        t_count, _ = CONTROLLER_METRICS.timer_stats(
+            ControllerTimer.SEGMENT_MOVE_MS)
+        assert t_count == t_count0 + n
+        assert CONTROLLER_METRICS.gauge_value(
+            ControllerGauge.REBALANCE_ACTIVE) == 0
+        # /REBALANCE doubles as the rebalanceStatus payload
+        status = controller.rebalance_status(table)
+        assert status["status"] == DONE
+        assert status["segmentsDone"] == status["segmentsTotal"]
+        # results bit-identical after the shuffle
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == rows_before
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_plan_is_minimal_movement_and_dry_run_writes_nothing(tmp_path):
+    store, controller, servers = _mk_cluster(3)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 6)
+        rb = SegmentRebalancer(controller)
+        # already levelled (2/2/2): nothing to plan
+        dry = rb.plan(table, dry_run=True)
+        assert dry["segmentsTotal"] == 0 and dry["status"] == DONE
+        assert store.get(f"/REBALANCE/{table}") is None
+        # a real no-op plan journals the terminal job immediately
+        job = rb.plan(table)
+        assert job["status"] == DONE
+        assert store.get(f"/REBALANCE/{table}")["status"] == DONE
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_hot_table_segments_move_first(tmp_path):
+    """Broker-published table costs weight the move order: with heat on
+    the table, bigger segments lead the plan (weight = docs x heat)."""
+    store, controller, servers = _mk_cluster(1)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        # s0..s2 small, s3..s5 big — all land on the only server S0
+        for i in range(6):
+            docs = 100 if i < 3 else 1600
+            path = _build_segment(tmp_path, f"s{i}", seed=i, n=docs)
+            controller.add_segment(table, f"s{i}",
+                                   {"location": path, "numDocs": docs})
+        store.set("/BROKERSTATE/b1", {"tableCostsMs": {"stats": 42.0}})
+        for sid in ("S1", "S2"):
+            s_new = ServerInstance(store, sid, backend="host")
+            s_new.start()
+            servers.append(s_new)
+        rb = SegmentRebalancer(controller)
+        assert rb.table_heat() == {"stats": 42.0}
+        # 4 of 6 segments must leave S0; the big ones lead the plan
+        job = rb.plan(table, dry_run=True)
+        assert job["segmentsTotal"] == 4
+        weights = [m["weight"] for m in job["movePlan"]]
+        assert weights == sorted(weights, reverse=True)
+        assert job["movePlan"][0]["weight"] > job["movePlan"][-1]["weight"]
+        big = {"s3", "s4", "s5"}
+        assert {m["segment"] for m in job["movePlan"][:3]} == big
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_second_plan_refused_while_active(tmp_path):
+    store, controller, servers = _mk_cluster(1)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 2)
+        _zombie(store, "Z0")  # destination that never converges
+        rb = SegmentRebalancer(controller, move_timeout_s=60.0)
+        job = rb.plan(table)
+        assert job["status"] == IN_PROGRESS
+        with pytest.raises(RebalanceInProgress):
+            rb.plan(table)
+        rb.abort(table)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- make-before-break + routing window ---------------------------------------
+
+
+def test_no_downtime_replicas_never_dip_under_live_queries(tmp_path):
+    """The acceptance invariant: while the durable engine moves segments,
+    every sampled external view keeps >= 1 ONLINE replica per segment,
+    queries stay bit-identical, and nothing is double-counted."""
+    store, controller, servers = _mk_cluster(2)
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 8)
+        truth = broker.execute_sql(GROUP_SQL).result_table.rows
+        count = broker.execute_sql(
+            "SELECT COUNT(*) FROM stats").result_table.rows[0][0]
+        assert count == 8 * 400
+
+        dips, failures, mismatches = [], [], []
+        stop = threading.Event()
+
+        def watch_views():
+            while not stop.is_set():
+                view = store.get(f"/EXTERNALVIEW/{table}") or {}
+                for seg in store.get(f"/IDEALSTATES/{table}") or {}:
+                    online = sum(1 for st in (view.get(seg) or {}).values()
+                                 if st == "ONLINE")
+                    if online < 1:
+                        dips.append(seg)
+
+        def hammer():
+            while not stop.is_set():
+                r = broker.execute_sql(GROUP_SQL)
+                if r.exceptions:
+                    failures.append(r.exceptions)
+                elif r.result_table.rows != truth:
+                    mismatches.append(r.result_table.rows)
+
+        threads = [threading.Thread(target=watch_views),
+                   threading.Thread(target=hammer)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        s_new = ServerInstance(store, "S2", backend="host")
+        s_new.start()
+        servers.append(s_new)
+        rb = SegmentRebalancer(controller, move_timeout_s=10.0, max_moves=2)
+        job = rb.run(table)
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert job["status"] == DONE and job["segmentsTotal"] > 0
+        assert not dips, dips[:5]
+        assert not failures, failures[:3]
+        assert not mismatches, mismatches[:2]
+    finally:
+        stop.set()
+        for s in servers:
+            s.stop()
+
+
+def test_overlap_window_routes_each_segment_once(tmp_path):
+    """Mid-move both replicas are ONLINE. The broker must pick exactly one
+    server per segment: rows bit-identical, counts never doubled."""
+    store, controller, servers = _mk_cluster(2)
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 4)
+        truth = broker.execute_sql(GROUP_SQL).result_table.rows
+
+        # freeze the make-before-break window: every segment gains its
+        # second replica (the additive phase) and nothing is dropped yet
+        segs = list(store.get(f"/IDEALSTATES/{table}"))
+        other = {"S0": "S1", "S1": "S0"}
+
+        def add_all(ideal):
+            for seg, m in ideal.items():
+                src = next(iter(m))
+                m[other[src]] = "ONLINE"
+            return ideal
+
+        store.update(f"/IDEALSTATES/{table}", add_all)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            view = store.get(f"/EXTERNALVIEW/{table}") or {}
+            if all(len([s for s in (view.get(seg) or {}).values()
+                        if s == "ONLINE"]) == 2 for seg in segs):
+                break
+            time.sleep(0.02)
+        view = store.get(f"/EXTERNALVIEW/{table}")
+        assert all(len(view[seg]) == 2 for seg in segs), view
+
+        # inside the window: exact rows, exact count (a double-routed
+        # segment would double SUM and COUNT), every routed segment on
+        # exactly one server
+        for _ in range(5):
+            r = broker.execute_sql(GROUP_SQL)
+            assert not r.exceptions
+            assert r.result_table.rows == truth
+            c = broker.execute_sql("SELECT COUNT(*) FROM stats")
+            assert c.result_table.rows[0][0] == 4 * 400
+        routed = broker.routing_table(table)
+        assert all(len(hosts) == 2 for hosts in routed.values())
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- failover: the journal IS the rebalance -----------------------------------
+
+
+def test_leader_failover_resumes_mid_rebalance(tmp_path):
+    """Kill the leader mid-rebalance: the standby takes the seat and
+    drives the SAME journaled plan to completion — every move COMPLETED
+    exactly once, results bit-identical before/during/after."""
+    store, c1, servers = _mk_cluster(2)
+    c2 = ClusterController(store, instance_id="ctl2")
+    broker = Broker(store)
+    try:
+        table = c1.create_table({"tableName": "stats", "replication": 1})
+        _add_segments(c1, table, tmp_path, 6)
+        truth = broker.execute_sql(GROUP_SQL).result_table.rows
+        s_new = ServerInstance(store, "S2", backend="host")
+        s_new.start()
+        servers.append(s_new)
+
+        assert c1.is_leader() and not c2.is_leader()
+        rb1 = SegmentRebalancer(c1, max_moves=1, move_timeout_s=10.0)
+        done0 = CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_COMPLETED)
+        job = rb1.plan(table)
+        assert job["segmentsTotal"] >= 2
+        # advance until at least one move completed but the job is open
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            rb1.tick()
+            j = rb1.job(table)
+            states = [m["state"] for m in j["movePlan"]]
+            if MOVE_COMPLETED in states and j["status"] == IN_PROGRESS:
+                break
+            time.sleep(0.02)
+        j = rb1.job(table)
+        assert j["status"] == IN_PROGRESS
+        assert any(m["state"] == MOVE_COMPLETED for m in j["movePlan"])
+
+        # leader dies mid-job (session expiry, not graceful resign)
+        c1.leader.disconnect()
+        store.expire_session("ctl1")
+        c1.leader.stop()
+        assert c2.is_leader()
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == truth  # during
+
+        # the new leader's actuator resumes from the journal
+        actuator = RebalanceActuator(
+            SegmentRebalancer(c2, max_moves=1, move_timeout_s=10.0))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            actuator()
+            final = store.get(f"/REBALANCE/{table}")
+            if final["status"] not in (IN_PROGRESS,):
+                break
+            time.sleep(0.02)
+        final = store.get(f"/REBALANCE/{table}")
+        assert final["status"] == DONE, final["status"]
+        assert final["jobId"] == job["jobId"]  # same journaled job, resumed
+        # every move COMPLETED exactly once: per-move terminal state plus
+        # a global completion-meter delta of exactly segmentsTotal
+        assert all(m["state"] == MOVE_COMPLETED for m in final["movePlan"])
+        assert CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_COMPLETED) \
+            == done0 + final["segmentsTotal"]
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert {s: set(m) for s, m in ideal.items()} == \
+            {s: set(m) for s, m in final["target"].items()}
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == truth  # after
+    finally:
+        for s in servers:
+            s.stop()
+        c2.stop()
+
+
+def test_standby_controller_never_actuates(tmp_path):
+    store, c1, servers = _mk_cluster(1)
+    c2 = ClusterController(store, instance_id="ctl2")
+    try:
+        assert not c2.is_leader()
+        rb2 = SegmentRebalancer(c2)
+        assert rb2.tick() == {"skipped": "standby controller does not actuate"}
+        assert RebalanceActuator(rb2)()["skipped"]
+    finally:
+        for s in servers:
+            s.stop()
+        c2.stop()
+
+
+# -- retry / blacklist / abort ------------------------------------------------
+
+
+def test_dead_destination_blacklisted_then_repicked(tmp_path):
+    """A destination that never converges exhausts its attempts, lands on
+    the blacklist, and the move retries onto a fresh server — the job
+    still finishes DONE."""
+    store, controller, servers = _mk_cluster(2)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        # everything on S0 so the plan spreads to {S1, Z0}
+        store.delete("/LIVEINSTANCES/S1")
+        _add_segments(controller, table, tmp_path, 4)
+        store.set("/LIVEINSTANCES/S1", {"host": "h", "port": 1},
+                  ephemeral_owner="S1")
+        _zombie(store, "Z0")
+
+        rb = SegmentRebalancer(controller, move_timeout_s=0.15,
+                               max_attempts=1, backoff_ms=10.0, max_moves=4)
+        job = rb.drive(table, timeout_s=20.0) if rb.plan(table) else None
+        assert job["status"] == DONE, job
+        assert all(m["state"] == MOVE_COMPLETED for m in job["movePlan"])
+        # at least one move went through the blacklist path
+        blacklisted = [m for m in job["movePlan"] if m["blacklist"]]
+        assert blacklisted and all(m["blacklist"] == ["Z0"]
+                                   for m in blacklisted)
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert all("Z0" not in m for m in ideal.values())
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_move_fails_partial_when_no_replacement(tmp_path):
+    """With no healthy replacement outside the blacklist the move FAILS,
+    the job ends PARTIAL, and the additive phase is fully rolled back —
+    the table keeps serving from its original replicas."""
+    store, controller, servers = _mk_cluster(1)
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 4)
+        truth = broker.execute_sql(GROUP_SQL).result_table.rows
+        _zombie(store, "Z0")
+        failed0 = CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_FAILED)
+
+        rb = SegmentRebalancer(controller, move_timeout_s=0.15,
+                               max_attempts=1, backoff_ms=10.0)
+        rb.plan(table)
+        job = rb.drive(table, timeout_s=20.0)
+        assert job["status"] == PARTIAL
+        failed = [m for m in job["movePlan"] if m["state"] == MOVE_FAILED]
+        assert failed and job["failedSegments"]
+        assert CONTROLLER_METRICS.meter_count(
+            ControllerMeter.SEGMENT_MOVES_FAILED) == failed0 + len(failed)
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert all(set(m) == {"S0"} for m in ideal.values()), ideal
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == truth
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_abort_rolls_back_inflight_additions(tmp_path):
+    store, controller, servers = _mk_cluster(1)
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 4)
+        truth = broker.execute_sql(GROUP_SQL).result_table.rows
+        _zombie(store, "Z0")
+        rb = SegmentRebalancer(controller, move_timeout_s=60.0, max_moves=2)
+        rb.plan(table)
+        rb.tick()  # starts moves: Z0 joins the ideal state additively
+        ideal_mid = store.get(f"/IDEALSTATES/{table}")
+        assert any("Z0" in m for m in ideal_mid.values())
+
+        job = rb.abort(table)
+        assert job["status"] == ABORTED
+        assert all(m["state"] == MOVE_CANCELLED for m in job["movePlan"])
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert all(set(m) == {"S0"} for m in ideal.values()), ideal
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == truth
+        # a fresh plan is allowed after the abort
+        assert rb.plan(table, dry_run=True) is not None
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- rebalance.move fault point (satellite: integrity under movement) ---------
+
+
+def test_corrupt_move_fetch_quarantines_then_move_completes(tmp_path):
+    """faults on rebalance.move: the destination's fetched copy arrives
+    corrupt → PR-8 integrity path quarantines (EV ERROR, never ONLINE)
+    and auto-repair re-fetches fresh — the move then completes and the
+    job ends DONE with exact results throughout."""
+    store, controller, servers = _mk_cluster(1)
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 2)
+        truth = broker.execute_sql(GROUP_SQL).result_table.rows
+        q0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENTS_QUARANTINED)
+        r0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENT_REPAIRS)
+
+        s_new = ServerInstance(store, "S1", backend="host")
+        s_new.start()
+        servers.append(s_new)
+        faults.FAULTS.arm("rebalance.move", kind="corrupt", times=1)
+        rb = SegmentRebalancer(controller, move_timeout_s=10.0)
+        job = rb.run(table, timeout_s=20.0)
+
+        assert faults.FAULTS.fired("rebalance.move") == 1
+        assert job["status"] == DONE
+        assert all(m["state"] == MOVE_COMPLETED for m in job["movePlan"])
+        assert SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENTS_QUARANTINED) == q0 + 1
+        assert SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENT_REPAIRS) == r0 + 1
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == truth
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- HBM hygiene on departure (satellite: stacked-view leak) ------------------
+
+
+def test_drop_named_evicts_views_stacks_and_partials():
+    """Unit regression for the departure-time leak: eviction by NAME must
+    reclaim the per-segment view, any stacked [S, N] batch-family view
+    containing the member, and journaled partials — and hbm_stats must
+    return exactly the freed bytes."""
+    from pinot_tpu.segment.device_cache import DeviceSegmentCache
+
+    class _Seg:
+        num_docs = 64
+
+        def __init__(self, name):
+            self.name = name
+
+    cache = DeviceSegmentCache()
+    a, b = _Seg("segA"), _Seg("segB")
+    va = cache.view(a)
+    va._planes[("c", "ids")] = np.zeros(64, np.int32)
+    vb = cache.view(b)
+    vb._planes[("c", "ids")] = np.ones(64, np.int32)
+    sv = cache.stacked_view([a, b])
+    sv._planes[("c", "ids")] = np.zeros((2, 64), np.int32)
+    cache.put_partial(("fp", "segA"), (np.zeros(8, np.int64),),
+                      segment_name="segA")
+    assert sv.names == {"segA", "segB"}
+    used0 = cache.hbm_stats()["hbmBytesUsed"]
+    assert used0 > 0
+
+    freed = cache.drop_named("segA")
+    assert freed > 0
+    stats = cache.hbm_stats()
+    assert stats["hbmBytesUsed"] == used0 - freed
+    # segA's view, the shared stack, and segA's partial are gone; segB's
+    # own view survives
+    assert not cache._stacks and cache.get_partial(("fp", "segA")) is None
+    assert cache.hbm_stats()["hbmBytesUsed"] == vb.nbytes()
+    assert cache.eviction_stats["views"] >= 1
+    assert cache.eviction_stats["stacks"] >= 1
+    assert cache.eviction_stats["partials"] >= 1
+    # idempotent: a second departure frees nothing
+    assert cache.drop_named("segA") == 0
+
+
+def test_drop_by_object_evicts_name_matched_stacks():
+    """A stack built from a PREVIOUS incarnation of the segment (different
+    object, same name) must still be evicted when the segment departs."""
+    from pinot_tpu.segment.device_cache import DeviceSegmentCache
+
+    class _Seg:
+        num_docs = 64
+
+        def __init__(self, name):
+            self.name = name
+
+    cache = DeviceSegmentCache()
+    old, cur, other = _Seg("segX"), _Seg("segX"), _Seg("segY")
+    sv = cache.stacked_view([old, other])
+    sv._planes[("c", "ids")] = np.zeros((2, 64), np.int32)
+    # the current incarnation is a different object: id()-keyed matching
+    # alone would leak the old stack forever
+    cache.view(cur)._planes[("c", "ids")] = np.zeros(64, np.int32)
+    cache.drop(cur)
+    assert not cache._stacks
+    assert cache.eviction_stats["stacks"] >= 1
+
+
+def test_moved_away_segment_leaves_no_stacked_views(tmp_path):
+    """Integration: warm a stacked batch-family view on the device cache,
+    move one member away via the durable engine, and assert no stack
+    containing the departed segment survives on the source."""
+    from pinot_tpu.segment.device_cache import GLOBAL_DEVICE_CACHE
+
+    store, controller, servers = _mk_cluster(1, backend="tpu")
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 2)
+        r = broker.execute_sql(GROUP_SQL)  # warms views (and stacks when
+        assert not r.exceptions           # the family batches)
+        truth = r.result_table.rows
+
+        s_new = ServerInstance(store, "S1", backend="tpu")
+        s_new.start()
+        servers.append(s_new)
+        rb = SegmentRebalancer(controller, move_timeout_s=10.0)
+        job = rb.run(table, timeout_s=30.0)
+        assert job["status"] == DONE and job["segmentsTotal"] >= 1
+
+        moved = {m["segment"] for m in job["movePlan"]}
+        with GLOBAL_DEVICE_CACHE._lock:
+            stale = [s.names for s in GLOBAL_DEVICE_CACHE._stacks.values()
+                     if s.names & moved]
+        assert not stale, stale
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == truth
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- actuator triggers --------------------------------------------------------
+
+
+def test_actuator_rebuilds_replicas_after_server_death(tmp_path):
+    """Dead-server trigger: replication drops below target → the actuator
+    journals a rebuild job and the survivors re-fetch from deep store."""
+    store, controller, servers = _mk_cluster(3)
+    broker = Broker(store)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 2})
+        _add_segments(controller, table, tmp_path, 4)
+        truth = broker.execute_sql(GROUP_SQL).result_table.rows
+
+        rb = SegmentRebalancer(controller, move_timeout_s=10.0, max_moves=8)
+        actuator = RebalanceActuator(rb)
+        assert actuator()["auto"] == {}  # healthy cluster: no trigger
+
+        victim = servers.pop(2)
+        victim.stop()
+        out = actuator()
+        assert out["auto"].get(table, "").startswith("dead-server:")
+        job = rb.drive(table, timeout_s=20.0)
+        assert job["status"] == DONE and job["trigger"] == "dead-server"
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert all(len(m) == 2 and "S2" not in m for m in ideal.values())
+        r = broker.execute_sql(GROUP_SQL)
+        assert not r.exceptions and r.result_table.rows == truth
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_actuator_spreads_onto_added_server(tmp_path):
+    store, controller, servers = _mk_cluster(2)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 6)
+        rb = SegmentRebalancer(controller, move_timeout_s=10.0, max_moves=8)
+        actuator = RebalanceActuator(rb)
+        assert actuator()["auto"] == {}  # baseline membership observed
+
+        s_new = ServerInstance(store, "S2", backend="host")
+        s_new.start()
+        servers.append(s_new)
+        out = actuator()
+        assert out["auto"].get(table, "").startswith("server-add:")
+        job = rb.drive(table, timeout_s=20.0)
+        assert job["status"] == DONE and job["trigger"] == "server-add"
+        assert "S2" in _per_instance(store.get(f"/IDEALSTATES/{table}"))
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_health_drain_respects_hysteresis_and_cooldown(tmp_path,
+                                                       monkeypatch):
+    """The opt-in health loop drains a straggler only after the anomaly
+    persists across scrapes, and the cooldown stops back-to-back drains
+    (no flapping)."""
+    from pinot_tpu.cluster.periodic import HEALTH_REPORT_PATH
+
+    monkeypatch.setenv("PINOT_TPU_HEALTH_REBALANCE", "1")
+    monkeypatch.setenv("PINOT_TPU_REBALANCE_HYSTERESIS", "2")
+    monkeypatch.setenv("PINOT_TPU_REBALANCE_COOLDOWN_S", "300")
+    store, controller, servers = _mk_cluster(3)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 6)
+        rb = SegmentRebalancer(controller, move_timeout_s=10.0, max_moves=8)
+        actuator = RebalanceActuator(rb)
+
+        def scrape(instance, at_ms):
+            store.set(HEALTH_REPORT_PATH, {
+                "checkedAtMs": at_ms,
+                "anomalies": [{"type": "straggler", "instance": instance,
+                               "detail": "p99 3x fleet"}]})
+
+        scrape("S0", 1000)
+        out = actuator()
+        assert out["health"]["triggered"] == {}  # streak 1 < hysteresis
+        assert store.get(f"/REBALANCE/{table}") is None
+        out = actuator()  # same checkedAtMs: NOT new evidence
+        assert out["health"].get("streaks", {}).get("S0", 1) == 1
+
+        scrape("S0", 2000)
+        out = actuator()
+        assert table in out["health"]["triggered"]  # streak 2 → drain
+        job = rb.drive(table, timeout_s=20.0)
+        assert job["status"] == DONE and job["trigger"] == "health"
+        assert job["excluded"] == ["S0"]
+        assert "S0" not in _per_instance(store.get(f"/IDEALSTATES/{table}"))
+
+        # cooldown: a fresh anomaly (other instance) may not re-trigger
+        scrape("S1", 3000)
+        actuator()
+        scrape("S1", 4000)
+        out = actuator()
+        assert out["health"].get("cooldown") is True
+        assert out["health"]["triggered"] == {}
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_health_drain_refuses_to_break_replication(tmp_path, monkeypatch):
+    from pinot_tpu.cluster.periodic import HEALTH_REPORT_PATH
+
+    monkeypatch.setenv("PINOT_TPU_HEALTH_REBALANCE", "1")
+    monkeypatch.setenv("PINOT_TPU_REBALANCE_HYSTERESIS", "1")
+    store, controller, servers = _mk_cluster(2)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 2})
+        _add_segments(controller, table, tmp_path, 2)
+        rb = SegmentRebalancer(controller)
+        actuator = RebalanceActuator(rb)
+        store.set(HEALTH_REPORT_PATH, {
+            "checkedAtMs": 1000,
+            "anomalies": [{"type": "hbm-pressure", "instance": "S0"}]})
+        out = actuator()
+        # draining S0 would leave 1 < replication 2: refused
+        assert out["health"]["triggered"] == {}
+        assert store.get(f"/REBALANCE/{table}") is None
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_rebalance_checker_defers_to_active_durable_job(tmp_path):
+    from pinot_tpu.cluster.periodic import RebalanceChecker
+
+    store, controller, servers = _mk_cluster(3)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 2})
+        _add_segments(controller, table, tmp_path, 3)
+        # kill a server that hosts something: replication is now broken,
+        # but two live servers remain (>= replication) so repair CAN run
+        hosted = _per_instance(store.get(f"/IDEALSTATES/{table}"))
+        victim = next(s for s in servers if s.instance_id in hosted)
+        servers.remove(victim)
+        victim.stop()
+        store.set(f"/REBALANCE/{table}",
+                  {"jobId": "rb_x", "status": IN_PROGRESS, "movePlan": []})
+        assert RebalanceChecker(controller)() == {}  # defers
+        store.set(f"/REBALANCE/{table}", {"jobId": "rb_x", "status": DONE})
+        fixed = RebalanceChecker(controller)()
+        assert table in fixed  # terminal job: the checker acts again
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- REST surface -------------------------------------------------------------
+
+
+def test_rest_rebalance_abort_and_debug(tmp_path):
+    import json
+    import urllib.request
+
+    from pinot_tpu.cluster.rest import ControllerRestServer
+
+    store, controller, servers = _mk_cluster(1)
+    crest = ControllerRestServer(controller)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 2)
+        _zombie(store, "Z0")
+        crest.rebalancer.move_timeout_s = 60.0
+
+        def post(path):
+            req = urllib.request.Request(crest.url + path, data=b"",
+                                         method="POST")
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        def get(path):
+            with urllib.request.urlopen(crest.url + path) as resp:
+                return json.loads(resp.read())
+
+        # the sync drive cannot finish against a zombie destination: the
+        # handler reports the still-active journaled job instead
+        crest.rebalancer.plan(table)
+        code, body = post("/tables/stats/rebalance")
+        assert code == 409 and "IN_PROGRESS" in body["error"]
+
+        dbg = get("/debug/rebalance")
+        assert table in dbg["active"]
+        assert dbg["knobs"]["maxMoves"] >= 1
+
+        code, body = post("/tables/stats/rebalance/abort")
+        assert code == 200 and body["status"] == ABORTED
+        dbg = get("/debug/rebalance")
+        assert table in dbg["finished"]
+        code, _ = post("/tables/nosuch/rebalance/abort")
+        assert code == 404
+    finally:
+        crest.close()
+        for s in servers:
+            s.stop()

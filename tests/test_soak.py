@@ -9,7 +9,10 @@ scale; the long profiles are the same code with bigger knobs.
 
 from __future__ import annotations
 
-from pinot_tpu.tools.soak import soak_chaos, soak_realtime, soak_sql
+import pytest
+
+from pinot_tpu.tools.soak import (soak_chaos, soak_realtime, soak_rebalance,
+                                  soak_sql)
 
 
 def test_soak_sql_short_profile():
@@ -29,6 +32,21 @@ def test_soak_chaos_short_profile():
     assert out["queries"] >= 10, out
     # chaos actually happened: at least one kill or rebalance or compaction
     assert out["kills"] + out["rebalances"] + out["compactions"] >= 1, out
+
+
+@pytest.mark.rebalance
+def test_soak_rebalance_short_profile():
+    """Elastic-capacity soak at smoke scale, faults armed on the
+    ``rebalance.move`` destination-fetch point: server kill/add churn must
+    drive the durable actuation loop through at least one completed job
+    (dead-server rebuild or server-add spread) while live queries stay
+    exact-or-degraded and the end state holds full replication."""
+    out = soak_rebalance(seconds=6.0, seed=13, n_segments=6,
+                         rows_per_segment=150, fault_rate=0.05)
+    assert out["queries"] >= 10, out
+    assert out["jobs_done"] >= 1, out
+    assert out["server_kills"] + out["server_adds"] >= 1, out
+    assert out["moves_completed"] >= 1, out
 
 
 def test_soak_realtime_one_round():
