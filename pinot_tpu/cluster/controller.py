@@ -430,6 +430,18 @@ class ClusterController:
 
         job_id = f"rb_{int(time.time() * 1000)}"
         job_path = f"/REBALANCE/{name_with_type}"
+        # the durable rebalance engine (cluster/rebalance.py) journals its
+        # move plan at this same path: overwriting an active engine job
+        # would orphan its in-flight moves (leaked ADDING replicas, lost
+        # crash-resume state) while both engines mutate the ideal state
+        existing = self.store.get(job_path)
+        if existing and existing.get("status") in ("IN_PROGRESS",
+                                                   "ABORTING") \
+                and "movePlan" in existing:
+            raise RuntimeError(
+                f"{name_with_type}: durable rebalance job "
+                f"{existing.get('jobId')} is {existing.get('status')}; "
+                "wait for the actuator to finish it or abort it first")
         job = {"jobId": job_id, "status": "IN_PROGRESS",
                "segmentsTotal": len(changed), "segmentsDone": 0,
                "moves": moves, "startedMs": int(time.time() * 1000)}

@@ -146,11 +146,15 @@ class RebalanceChecker:
         fixed = {}
         live = set(self.controller.live_instances())
         for table in self.controller.store.children("/CONFIGS/TABLE"):
-            # a durable rebalance job owns this table's ideal state: the
-            # RebalanceActuator converges it move-by-move, and a concurrent
-            # blocking rebalance here would fight the journaled plan
+            # a durable rebalance job (movePlan journal) owns this table's
+            # ideal state: the RebalanceActuator converges it move-by-move,
+            # and a concurrent blocking rebalance here would fight the
+            # journaled plan. Legacy movePlan-less records are NOT skipped:
+            # an IN_PROGRESS one is a crash leftover of the synchronous
+            # path, and skipping it would wedge healing forever.
             job = self.controller.store.get(f"/REBALANCE/{table}") or {}
-            if job.get("status") in ("IN_PROGRESS", "ABORTING"):
+            if job.get("status") in ("IN_PROGRESS", "ABORTING") \
+                    and "movePlan" in job:
                 continue
             cfg = self.controller.table_config(table) or {}
             replication = int(cfg.get("replication", 1))

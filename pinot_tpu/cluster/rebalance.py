@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Optional
 
@@ -58,11 +59,24 @@ from ..spi.metrics import (CONTROLLER_METRICS, ControllerGauge,
                            ControllerMeter, ControllerTimer)
 from .controller import CONSUMING, ERROR, ONLINE, ClusterController, \
     raw_table_name
-from .store import PropertyStore
+from .store import BadVersionError, PropertyStore
 
 log = logging.getLogger("pinot_tpu.rebalance")
 
 REBALANCE_PREFIX = "/REBALANCE"
+# durable last-seen live-server set for the server-add trigger, so a
+# controller failover/restart still fires for servers added during the
+# outage (deliberately OUTSIDE the job prefix: children(REBALANCE_PREFIX)
+# must only ever yield table names)
+SEEN_SERVERS_PATH = "/REBALANCEMETA/seenServers"
+
+# process-wide per-(store, table) actuation locks, shared by every
+# SegmentRebalancer wrapping the same store (the REST handler and the
+# periodic actuator each build their own engine instance): only one
+# thread may advance a table's move state machine at a time, so inline
+# drive() and the actuator's tick() can't both act on one stale
+# view/journal read
+_LOCKS_GUARD = threading.Lock()
 
 # job statuses
 IN_PROGRESS = "IN_PROGRESS"
@@ -94,6 +108,15 @@ class RebalanceInProgress(RuntimeError):
     """A durable rebalance job for the table is already active."""
 
 
+def _is_engine_job(job: Optional[dict]) -> bool:
+    """True for journal records this engine owns. The legacy blocking
+    rebalance (ClusterController._apply_target_safely) shares the
+    /REBALANCE/{table} path but never writes a movePlan — the engine must
+    neither tick nor finalize those records, and the legacy path must not
+    overwrite an active engine journal (it checks the same predicate)."""
+    return job is not None and "movePlan" in job
+
+
 class SegmentRebalancer:
     """Leader-gated, crash-resumable rebalance engine. Stateless between
     ticks by design: every decision re-reads the journaled job from the
@@ -117,6 +140,16 @@ class SegmentRebalancer:
             _env_float("PINOT_TPU_REBALANCE_BACKOFF_MS", 100.0)
         CONTROLLER_METRICS.set_gauge(ControllerGauge.REBALANCE_ACTIVE,
                                      self.active_jobs)
+
+    def _table_lock(self, nwt: str) -> threading.Lock:
+        """Per-(store, table) actuation lock shared across every engine
+        instance in this process (REST builds one, the actuator another)."""
+        with _LOCKS_GUARD:
+            locks = getattr(self.store, "_rebalance_table_locks", None)
+            if locks is None:
+                locks = {}
+                self.store._rebalance_table_locks = locks
+            return locks.setdefault(nwt, threading.Lock())
 
     # -- observation ---------------------------------------------------------
     def job_path(self, nwt: str) -> str:
@@ -266,7 +299,8 @@ class SegmentRebalancer:
         """Compute and journal a durable rebalance job. Returns None when
         the table is already balanced; raises RebalanceInProgress when an
         active job exists (abort it first)."""
-        existing = self.job(nwt)
+        existing, existing_version = self.store.get_with_version(
+            self.job_path(nwt))
         if existing and existing.get("status") in ACTIVE_STATUSES:
             raise RebalanceInProgress(
                 f"{nwt}: job {existing.get('jobId')} is "
@@ -308,7 +342,19 @@ class SegmentRebalancer:
             job["excluded"] = sorted(exclude)
         if dry_run:
             return job
-        self.store.set(self.job_path(nwt), job)
+        # CAS on the version read above: two planners racing past the
+        # active check (e.g. REST on two controllers) cannot both journal —
+        # the loser would silently overwrite a plan already being actuated
+        try:
+            if existing_version < 0:
+                if not self.store.create_if_absent(self.job_path(nwt), job):
+                    raise BadVersionError(self.job_path(nwt))
+            else:
+                self.store.set(self.job_path(nwt), job,
+                               expected_version=existing_version)
+        except BadVersionError:
+            raise RebalanceInProgress(
+                f"{nwt}: a concurrent plan journaled first") from None
         log.info("%s: journaled rebalance %s (%d segments, trigger=%s)",
                  nwt, job["jobId"], len(changed), trigger)
         return job
@@ -325,8 +371,15 @@ class SegmentRebalancer:
             job = self.store.get(f"{REBALANCE_PREFIX}/{table}")
             if not job or job.get("status") not in ACTIVE_STATUSES:
                 continue
+            if not _is_engine_job(job):
+                # legacy blocking-rebalance record: its owner drives it
+                # synchronously; finalizing or ticking it here would let
+                # both engines mutate the table's ideal state at once
+                continue
             try:
-                report[table] = self._tick_table(table, job)
+                with self._table_lock(table):
+                    report[table] = self._tick_table(
+                        table, self.job(table) or job)
             except Exception as e:  # one stuck table must not wedge others
                 log.exception("%s: rebalance tick failed", table)
                 report[table] = f"{type(e).__name__}: {e}"
@@ -336,13 +389,27 @@ class SegmentRebalancer:
               tick_interval_s: float = 0.02) -> dict:
         """Synchronously tick one table's job to a terminal status (REST
         default mode + tests). The job stays durable throughout — killing
-        the driver mid-way leaves a journal any leader resumes."""
+        the driver mid-way leaves a journal any leader resumes. Leader-only
+        like tick(): a standby driving inline would actuate concurrently
+        with the real leader's periodic actuator."""
+        if not self.controller.is_leader():
+            raise RuntimeError(
+                f"{nwt}: standby controller does not actuate; the leader's "
+                "RebalanceActuator drives the journaled job")
         deadline = time.time() + timeout_s
         while time.time() < deadline:
             job = self.job(nwt)
             if not job or job.get("status") not in ACTIVE_STATUSES:
                 return job or {"status": DONE, "segmentsTotal": 0}
-            self._tick_table(nwt, job)
+            if not _is_engine_job(job):
+                raise RebalanceInProgress(
+                    f"{nwt}: journal holds a legacy blocking-rebalance job "
+                    f"{job.get('jobId')} ({job.get('status')}); the engine "
+                    "cannot drive it")
+            with self._table_lock(nwt):
+                job = self.job(nwt)
+                if job and job.get("status") in ACTIVE_STATUSES:
+                    self._tick_table(nwt, job)
             job = self.job(nwt)
             if job and job.get("status") in ACTIVE_STATUSES:
                 time.sleep(tick_interval_s)
@@ -370,8 +437,15 @@ class SegmentRebalancer:
 
         self.store.update(self.job_path(nwt), to_aborting)
         job = self.job(nwt)
-        if job and job.get("status") == ABORTING:
-            self._tick_table(nwt, job)
+        # marking ABORTING is a durable request any controller may journal;
+        # the rollback itself is actuation and stays leader-only (a standby
+        # returns the ABORTING job and the leader's next tick rolls back)
+        if job and job.get("status") == ABORTING \
+                and self.controller.is_leader():
+            with self._table_lock(nwt):
+                job = self.job(nwt)
+                if job and job.get("status") == ABORTING:
+                    self._tick_table(nwt, job)
             job = self.job(nwt)
         return job
 
@@ -487,15 +561,19 @@ class SegmentRebalancer:
                               backoffUntilMs=now_ms + int(backoff),
                               error=reason)
             return
-        # attempts exhausted: blacklist the destination and repick
+        # attempts exhausted: blacklist the destination and repick —
+        # honouring the job's drained instances (a health-drain job must
+        # never repick the straggler it exists to empty)
         blacklist = sorted(set(move.get("blacklist", [])) | set(adds))
+        excluded = set((self.job(nwt) or {}).get("excluded", ()))
         ideal_now = (self.store.get(f"/IDEALSTATES/{nwt}") or {}).get(seg, {})
         cfg = self.controller.table_config(nwt) or {}
         candidates = sorted(
             set(self.controller.server_instances(cfg.get("serverTag")))
             & set(self.controller.live_instances()))
         fresh = [i for i in candidates
-                 if i not in blacklist and i not in ideal_now]
+                 if i not in blacklist and i not in ideal_now
+                 and i not in excluded]
         if not fresh:
             self._update_move(nwt, idx, state=MOVE_FAILED,
                               blacklist=blacklist,
@@ -586,6 +664,11 @@ class SegmentRebalancer:
         def finalize(job):
             if not job or job.get("status") != IN_PROGRESS:
                 return job
+            if not _is_engine_job(job):
+                # legacy blocking-rebalance record mid-flight: finalizing
+                # it to DONE here would defeat the RebalanceInProgress
+                # guard and let both engines mutate the ideal state
+                return job
             plan = job.get("movePlan") or []
             if any(m["state"] not in MOVE_TERMINAL for m in plan):
                 job["segmentsDone"] = sum(
@@ -661,9 +744,19 @@ class RebalanceActuator:
     # -- membership-driven triggers ------------------------------------------
     def _auto_triggers(self) -> dict:
         live = set(self.controller.live_instances())
+        if self._seen_servers is None:
+            # fresh actuator (controller restart/failover): baseline from
+            # the durable last-seen set, so servers added DURING the outage
+            # still fire a server-add spread on the first leader tick —
+            # only the very first actuator in a cluster's life has nothing
+            # to compare against
+            stored = self.store.get(SEEN_SERVERS_PATH)
+            self._seen_servers = set(stored) if stored is not None else None
         added = set() if self._seen_servers is None \
             else live - self._seen_servers
         self._seen_servers = live
+        if self.store.get(SEEN_SERVERS_PATH) != sorted(live):
+            self.store.set(SEEN_SERVERS_PATH, sorted(live))
         out: dict[str, str] = {}
         for nwt in self.store.children("/CONFIGS/TABLE"):
             job = self.rebalancer.job(nwt)

@@ -25,9 +25,11 @@ import pytest
 
 from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
                                ServerInstance)
-from pinot_tpu.cluster.rebalance import (ABORTED, DONE, IN_PROGRESS,
-                                         MOVE_CANCELLED, MOVE_COMPLETED,
-                                         MOVE_FAILED, PARTIAL,
+from pinot_tpu.cluster.rebalance import (ABORTED, ABORTING, DONE,
+                                         IN_PROGRESS, MOVE_CANCELLED,
+                                         MOVE_COMPLETED, MOVE_FAILED,
+                                         MOVE_PENDING, PARTIAL,
+                                         SEEN_SERVERS_PATH,
                                          RebalanceActuator,
                                          RebalanceInProgress,
                                          SegmentRebalancer)
@@ -874,5 +876,183 @@ def test_rest_rebalance_abort_and_debug(tmp_path):
         assert code == 404
     finally:
         crest.close()
+        for s in servers:
+            s.stop()
+
+
+# -- coexistence with the legacy blocking rebalance path ----------------------
+
+
+def test_legacy_rebalance_refuses_while_engine_job_active(tmp_path):
+    """The synchronous controller.rebalance shares /REBALANCE/{table} with
+    the engine journal: it must refuse (not overwrite) while a movePlan
+    job is mid-flight, or in-flight moves are orphaned."""
+    store, controller, servers = _mk_cluster(2)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 2)
+        engine_job = {
+            "jobId": "rb_engine", "status": IN_PROGRESS,
+            "segmentsTotal": 1, "segmentsDone": 0,
+            "movePlan": [{"segment": "s0", "adds": {"S1": "ONLINE"},
+                          "drops": ["S0"], "state": "ADDING",
+                          "attempts": 1, "blacklist": []}]}
+        store.set(f"/REBALANCE/{table}", engine_job)
+        with pytest.raises(RuntimeError, match="rb_engine"):
+            controller.rebalance(table)
+        # the journal still holds the engine job, untouched
+        assert store.get(f"/REBALANCE/{table}")["jobId"] == "rb_engine"
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_engine_never_ticks_or_finalizes_legacy_job(tmp_path):
+    """A legacy (movePlan-less) IN_PROGRESS record belongs to a
+    synchronous caller: the engine must not tick it, must not finalize it
+    to DONE (that would defeat the RebalanceInProgress guard), and must
+    refuse to drive it."""
+    store, controller, servers = _mk_cluster(1)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 2)
+        legacy = {"jobId": "rb_legacy", "status": IN_PROGRESS,
+                  "segmentsTotal": 2, "segmentsDone": 0}
+        store.set(f"/REBALANCE/{table}", legacy)
+        rb = SegmentRebalancer(controller)
+        assert rb.tick() == {}
+        rb._maybe_finish_job(table)
+        assert store.get(f"/REBALANCE/{table}")["status"] == IN_PROGRESS
+        with pytest.raises(RebalanceInProgress):
+            rb.drive(table, timeout_s=1.0)
+        with pytest.raises(RebalanceInProgress):
+            rb.plan(table)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_rebalance_checker_heals_past_stale_legacy_record(tmp_path):
+    """A crash leftover of the synchronous path (IN_PROGRESS, no movePlan)
+    must not wedge RebalanceChecker healing forever — only engine journals
+    defer it."""
+    from pinot_tpu.cluster.periodic import RebalanceChecker
+
+    store, controller, servers = _mk_cluster(3)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 2})
+        _add_segments(controller, table, tmp_path, 2)
+        hosted = _per_instance(store.get(f"/IDEALSTATES/{table}"))
+        victim = next(s for s in servers if s.instance_id in hosted)
+        servers.remove(victim)
+        victim.stop()
+        store.set(f"/REBALANCE/{table}",
+                  {"jobId": "rb_stale", "status": IN_PROGRESS,
+                   "segmentsTotal": 1, "segmentsDone": 0})
+        fixed = RebalanceChecker(controller)()
+        assert table in fixed
+        live = set(store.children("/LIVEINSTANCES"))
+        ideal = store.get(f"/IDEALSTATES/{table}")
+        assert all(len([i for i in m if i in live]) >= 2
+                   for m in ideal.values())
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_drive_requires_leadership_and_abort_defers_actuation(tmp_path):
+    """drive() on a standby must refuse (the leader's actuator owns
+    actuation); abort() on a standby journals the ABORTING request but
+    leaves the rollback to the leader's next tick."""
+    store, c1, servers = _mk_cluster(1)
+    c2 = ClusterController(store, instance_id="ctl2")
+    try:
+        assert c1.is_leader() and not c2.is_leader()
+        table = c1.create_table({"tableName": "stats", "replication": 1})
+        _add_segments(c1, table, tmp_path, 1)
+        with pytest.raises(RuntimeError, match="standby"):
+            SegmentRebalancer(c2).drive(table, timeout_s=0.5)
+
+        _zombie(store, "Z0")
+        store.update(f"/IDEALSTATES/{table}",
+                     lambda cur: {**cur, "s0": {**cur["s0"],
+                                                "Z0": "ONLINE"}})
+        store.set(f"/REBALANCE/{table}", {
+            "jobId": "rb_mid", "status": IN_PROGRESS,
+            "segmentsTotal": 1, "segmentsDone": 0,
+            "movePlan": [{"segment": "s0", "adds": {"Z0": "ONLINE"},
+                          "drops": ["S0"], "state": "ADDING",
+                          "attempts": 1, "blacklist": []}]})
+        job = SegmentRebalancer(c2).abort(table)
+        assert job["status"] == ABORTING  # marked, NOT rolled back
+        assert "Z0" in store.get(f"/IDEALSTATES/{table}")["s0"]
+        SegmentRebalancer(c1).tick()  # the leader actuates the rollback
+        final = store.get(f"/REBALANCE/{table}")
+        assert final["status"] == ABORTED
+        assert final["movePlan"][0]["state"] == MOVE_CANCELLED
+        assert "Z0" not in store.get(f"/IDEALSTATES/{table}")["s0"]
+    finally:
+        for s in servers:
+            s.stop()
+        c2.stop()
+
+
+def test_blacklist_repick_respects_drained_instances(tmp_path):
+    """A health-drain job journals its excluded instances: the
+    blacklist-exhaustion repick must never choose the very straggler the
+    job exists to empty."""
+    store, controller, servers = _mk_cluster(3)  # S0 S1 S2
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 1)
+        _zombie(store, "Z0")
+        store.set(f"/IDEALSTATES/{table}",
+                  {"s0": {"S0": "ONLINE", "Z0": "ONLINE"}})
+        move = {"segment": "s0", "adds": {"Z0": "ONLINE"}, "drops": ["S0"],
+                "state": "ADDING", "attempts": 1, "blacklist": [],
+                "attemptStartedMs": 0}
+        store.set(f"/REBALANCE/{table}",
+                  {"jobId": "rb_drain", "status": IN_PROGRESS,
+                   "trigger": "health", "excluded": ["S1"],
+                   "segmentsTotal": 1, "segmentsDone": 0,
+                   "movePlan": [dict(move)]})
+        rb = SegmentRebalancer(controller, max_attempts=1, backoff_ms=1.0)
+        rb._retry_move(table, 0, move, now_ms=int(time.time() * 1000),
+                       reason="destination timed out")
+        m = store.get(f"/REBALANCE/{table}")["movePlan"][0]
+        assert m["state"] == MOVE_PENDING
+        assert m["blacklist"] == ["Z0"]
+        assert list(m["adds"]) == ["S2"]  # S1 is being drained: never picked
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_server_add_trigger_survives_controller_restart(tmp_path):
+    """The last-seen live-server set is durable: a server added while no
+    actuator is alive (controller outage/failover) still fires a
+    server-add spread on the replacement actuator's FIRST tick."""
+    store, controller, servers = _mk_cluster(1)
+    try:
+        table = controller.create_table(
+            {"tableName": "stats", "replication": 1})
+        _add_segments(controller, table, tmp_path, 4)
+        RebalanceActuator(SegmentRebalancer(controller))()
+        assert store.get(SEEN_SERVERS_PATH) == ["S0"]
+
+        s1 = ServerInstance(store, "S1", backend="host")
+        s1.start()
+        servers.append(s1)
+        # a FRESH actuator (new controller process) must not re-baseline
+        report = RebalanceActuator(SegmentRebalancer(controller))()
+        assert any(str(v).startswith("server-add:")
+                   for v in report["auto"].values()), report
+        assert store.get(f"/REBALANCE/{table}")["trigger"] == "server-add"
+        assert store.get(SEEN_SERVERS_PATH) == ["S0", "S1"]
+    finally:
         for s in servers:
             s.stop()
