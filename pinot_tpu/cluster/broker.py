@@ -198,6 +198,13 @@ class Broker:
         # serving the last external view, not to a dead broker
         self._last_routing: dict[str, dict[str, list[str]]] = {}
         self._clients: dict[str, RpcClient] = {}
+        # cold-aware routing hints (tiered storage): (instance, segment) →
+        # hint-expiry, learned from cold_segments warming reports; while a
+        # hint is live, selection prefers replicas that hold the segment
+        # resident, falling back to triggering a warm when none do
+        self._cold_hints: dict[tuple, float] = {}
+        self._cold_hint_ttl = float(
+            os.environ.get("PINOT_TPU_COLD_HINT_TTL_S", "15"))
         self._rr = 0  # round-robin cursor for replica selection
         self._pool = ThreadPoolExecutor(max_workers=num_scatter_threads,
                                         thread_name_prefix="broker-scatter")
@@ -334,6 +341,8 @@ class Broker:
         with self._lock:
             self._rr += 1
             rr = self._rr
+        hinted = bool(self._cold_hints)
+        now = time.monotonic() if hinted else 0.0
         for seg, replicas in routing.items():
             # breaker-gated: open breakers are skipped; a half-open breaker
             # admits exactly one probe here. If EVERY replica is tripped the
@@ -341,6 +350,13 @@ class Broker:
             # failure — and doubles as extra probing).
             healthy = [i for i in replicas if self.breakers.allow(i)]
             candidates = healthy or replicas
+            if hinted:
+                # cold-aware routing: prefer a replica NOT recently observed
+                # warming this segment; when every replica is cold, fall
+                # through and let the pick trigger the warm
+                resident = [i for i in candidates
+                            if self._cold_hints.get((i, seg), 0.0) <= now]
+                candidates = resident or candidates
             if not candidates:
                 unavailable.append(seg)
                 continue
@@ -359,6 +375,17 @@ class Broker:
                 raise TransportError(
                     f"no online replica for segments {unavailable}")
         return plan
+
+    def _note_cold(self, inst: str, seg: str) -> None:
+        """A server reported ``seg`` cold (still warming): route the next
+        queries to other replicas for the hint TTL, then forget — the warm
+        completes in the background, so the hint must expire."""
+        now = time.monotonic()
+        with self._lock:
+            if len(self._cold_hints) > 4096:
+                self._cold_hints = {
+                    k: t for k, t in self._cold_hints.items() if t > now}
+            self._cold_hints[(inst, seg)] = now + self._cold_hint_ttl
 
     # -- query --------------------------------------------------------------
     def execute_sql(self, sql: str,
@@ -812,6 +839,7 @@ class Broker:
                      "num_segments_cache_miss": 0,
                      "scatter_retries": 0, "hedged_requests": 0,
                      "hedge_wins": 0, "corrupt_shards_retried": 0,
+                     "cold_segments_warming": 0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -884,6 +912,7 @@ class Broker:
             num_hedged_requests=stats_sum["hedged_requests"],
             num_hedge_wins=stats_sum["hedge_wins"],
             num_corrupt_shards_retried=stats_sum["corrupt_shards_retried"],
+            cold_segments_warming=stats_sum.get("cold_segments_warming", 0),
         )
         if partial_notes:
             # degraded gather: merged answer of the responding servers only,
@@ -986,6 +1015,7 @@ class Broker:
                      "num_segments_cache_miss": 0,
                      "scatter_retries": 0, "hedged_requests": 0,
                      "hedge_wins": 0, "corrupt_shards_retried": 0,
+                     "cold_segments_warming": 0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -1101,6 +1131,7 @@ class Broker:
             results.extend(more)
             attempt += 1
         combineds = []
+        cold_segs: set = set()
 
         def absorb(inst, r, missing_sink):
             # decoded at the scatter edge (_call_one) where a bad payload
@@ -1119,6 +1150,15 @@ class Broker:
             for k in ("num_device_dispatches", "num_compiles",
                       "num_segments_cache_hit", "num_segments_cache_miss"):
                 stats_sum[k] += st.get(k, 0)
+            # tiered storage: segments the server reported COLD (still
+            # warming) ride the missing-segments retry below, but are
+            # counted/hinted so routing and the response reflect the warm
+            for s in st.get("cold_segments", []):
+                cold_segs.add(s)
+                self._note_cold(inst, s)
+            stats_sum["cold_segments_warming"] = \
+                stats_sum.get("cold_segments_warming", 0) \
+                + len(st.get("cold_segments", []))
             for s in st.get("missing_segments", []):
                 missing_sink.setdefault(inst, []).append(s)
 
@@ -1144,6 +1184,12 @@ class Broker:
                         raise _StaleRoutingError(
                             f"segment {s} replaced mid-query")
                     replicas = [i for i in fresh[s] if i != inst]
+                    if not replicas and s in cold_segs:
+                        # the only replica is still WARMING the segment:
+                        # retry the same instance — its background warm
+                        # (bounded by our remaining budget server-side)
+                        # usually lands before the retry does
+                        replicas = [inst]
                     if not replicas:
                         if budget.partial_ok:
                             degrade(inst, [s], RuntimeError(

@@ -708,4 +708,18 @@ def build_default_scheduler(store: PropertyStore, controller: ClusterController,
     scrape_s = float(os.environ.get("PINOT_TPU_HEALTH_SCRAPE_S", interval_s))
     sched.register("ClusterHealthChecker", scrape_s,
                    ClusterHealthChecker(store, controller))
+
+    def _storage_prefetcher():
+        # built lazily so importing periodic.py never pulls the storage
+        # package in; walks broker /BROKERSTATE cost beacons and writes
+        # /PREFETCH/{table} nudges for tables entering the hot set
+        from ..storage.prefetch import StoragePrefetcher
+
+        if not hasattr(_storage_prefetcher, "task"):
+            _storage_prefetcher.task = StoragePrefetcher(store)
+        return _storage_prefetcher.task()
+
+    prefetch_s = float(os.environ.get("PINOT_TPU_PREFETCH_TICK_S",
+                                      interval_s))
+    sched.register("StoragePrefetcher", prefetch_s, _storage_prefetcher)
     return sched

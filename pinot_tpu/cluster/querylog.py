@@ -84,6 +84,10 @@ class QueryLogger:
         # checksum and were re-dispatched to another replica
         if getattr(response, "num_corrupt_shards_retried", 0):
             entry["corruptShardsRetried"] = response.num_corrupt_shards_retried
+        # tiered storage: the query raced a cold segment's warm — slow (or
+        # partial) because the bytes were still on their way up the tiers
+        if getattr(response, "cold_segments_warming", 0):
+            entry["coldSegmentsWarming"] = response.cold_segments_warming
         if getattr(response, "query_rejected", False):
             entry["queryRejected"] = True
         from ..spi import faults

@@ -694,6 +694,8 @@ class ServerRestServer(_RestServer):
                 (r"/debug/compiles", lambda h, m, q: srv._debug_compiles()),
                 (r"/debug/status",
                  lambda h, m, q: (200, srv.server.health_status())),
+                (r"/debug/storage",
+                 lambda h, m, q: (200, srv.server.debug_storage())),
             ]
             routes_post = [
                 (r"/queries/([^/]+)/kill",

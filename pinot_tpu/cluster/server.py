@@ -22,7 +22,8 @@ from ..engine.query_executor import QueryExecutor
 from ..segment.loader import SegmentIntegrityError, load_segment
 from ..spi import faults
 from ..spi.data_types import Schema
-from ..spi.metrics import SERVER_METRICS, ServerMeter
+from ..spi.metrics import SERVER_METRICS, ServerMeter, ServerTimer
+from ..storage.tier import SegmentTierManager
 from .controller import ERROR, ONLINE, raw_table_name
 from .store import PropertyStore
 from ..engine.scheduler import QueryScheduler
@@ -53,12 +54,33 @@ def _safe_mesh_devices() -> int:
 class ServerInstance:
     def __init__(self, store: PropertyStore, instance_id: str,
                  backend: str = "auto", tags: Optional[list[str]] = None,
-                 max_concurrent_queries: int = 8):
+                 max_concurrent_queries: int = 8,
+                 local_storage_mb: Optional[float] = None):
         self.store = store
         self.instance_id = instance_id
         self.tags = tags or ["DefaultTenant"]
         self.backend = backend
         self.executor = QueryExecutor(backend=backend)
+        # tiered storage: the byte-budgeted local disk tier beneath the HBM
+        # plane cache. Every locally materialized segment directory —
+        # converge load, cold lazy load, repair/rebalance re-fetch — goes
+        # through tier.acquire(), so ONE budget accounts for all of them.
+        # ``local_storage_mb`` overrides PINOT_TPU_LOCAL_STORAGE_MB.
+        tier_kwargs = {}
+        if local_storage_mb is not None:
+            tier_kwargs["budget_mb"] = local_storage_mb
+        self._tier = SegmentTierManager(
+            instance_id=instance_id, evict_cb=self._evict_segment,
+            heat_fn=self._broker_table_costs, **tier_kwargs)
+        # cold (metadata-only) segments: advertised ONLINE but not local —
+        # tableNameWithType → {segment_name: /SEGMENTS meta dict}
+        self._cold: dict[str, dict[str, dict]] = {}
+        # catalog meta of RESIDENT segments, kept so eviction can demote
+        # them back to cold without a store read
+        self._seg_meta: dict[tuple, dict] = {}
+        # in-flight cold warms: (table, seg) → completion Event, so
+        # concurrent queries coalesce on one fetch instead of racing
+        self._warming: dict[tuple, threading.Event] = {}
         # admission control in front of execution (reference:
         # QueryScheduler.submit, fcfs default policy)
         self.scheduler = QueryScheduler(max_concurrent=max_concurrent_queries)
@@ -136,6 +158,7 @@ class ServerInstance:
                        ephemeral_owner=self.instance_id)
         self.store.watch("/IDEALSTATES/", self._on_ideal_state)
         self.store.watch("/REPAIRS/", self._on_repair_request)
+        self.store.watch("/PREFETCH/", self._on_prefetch)
         self._started = True
         # replay current ideal states (Helix replays pending transitions on join)
         for table in self.store.children("/IDEALSTATES"):
@@ -154,9 +177,13 @@ class ServerInstance:
         try:
             self.store.unwatch(self._on_ideal_state)
             self.store.unwatch(self._on_repair_request)
+            self.store.unwatch(self._on_prefetch)
         except AttributeError:
             pass  # store impls without unwatch (older remote protocol)
         self.store.expire_session(self.instance_id)
+        # release every tier-local copy (also cleans the work dirs the old
+        # per-instance untar/repair tempdirs used to leak)
+        self._tier.close()
 
     @property
     def address(self) -> tuple[str, int]:
@@ -199,6 +226,13 @@ class ServerInstance:
                 if self._load_failures.get((table, seg), 0) \
                         >= self.max_load_retries:
                     continue  # transient retries exhausted; needs a nudge
+                if self._tier.should_lazy_load():
+                    # the local tier is at budget: register the segment
+                    # COLD — metadata only, no fetch. It still advertises
+                    # ONLINE below; the first query that routes here (or a
+                    # prefetch nudge) warms it lazily
+                    self._cold.setdefault(table, {})[seg] = meta
+                    continue
                 try:
                     segment = self._load_segment_verified(
                         table, seg, meta, indexing)
@@ -222,15 +256,29 @@ class ServerInstance:
                                   seg, n, self.max_load_retries)
                     continue
                 self.segments.setdefault(table, {})[seg] = segment
+                self._seg_meta[(table, seg)] = meta
+                self._cold.get(table, {}).pop(seg, None)
                 self._load_failures.pop((table, seg), None)
-            if to_drop:
+            cold_tbl = self._cold.get(table, {})
+            cold_drop = set(cold_tbl) - want
+            if to_drop or cold_drop:
                 # dropped/replaced segments invalidate their cached partial
                 # results (host + device tiers) and release device planes —
                 # the server-side half of lineage-driven invalidation
                 from ..cache.partial import GLOBAL_PARTIAL_CACHE
                 from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+            for seg in cold_drop:
+                # a departed cold segment has no local bytes or live object,
+                # but name-keyed HBM leftovers from its resident days and
+                # journaled partials must still go
+                cold_tbl.pop(seg, None)
+                GLOBAL_PARTIAL_CACHE.invalidate_segment(seg)
+                GLOBAL_DEVICE_CACHE.drop_partials(segment_name=seg)
+                GLOBAL_DEVICE_CACHE.drop_named(seg)
             for seg in to_drop:
                 segment = self.segments.get(table, {}).pop(seg, None)
+                self._seg_meta.pop((table, seg), None)
+                self._tier.forget(table, seg)
                 GLOBAL_PARTIAL_CACHE.invalidate_segment(seg)
                 GLOBAL_DEVICE_CACHE.drop_partials(segment_name=seg)
                 if segment is not None:
@@ -251,43 +299,55 @@ class ServerInstance:
                 self._load_failures.pop(key, None)
             self._register_table(table)
             loaded = set(self.segments.get(table, {}))
-        # advertise only what actually loaded — a skipped/failed load must
-        # not appear ONLINE or the broker would silently lose its rows
-        self._update_external_view(table, want & loaded)
+            cold = set(self._cold.get(table, ()))
+        # advertise what actually loaded PLUS the cold (metadata-only)
+        # registrations — a cold replica is still routable (the first query
+        # warms it); a skipped/FAILED load must not appear ONLINE or the
+        # broker would silently lose its rows
+        self._update_external_view(table, (want & loaded) | (want & cold))
         for seg in repair_kicks:
             self._kick_repair(table, seg)
 
-    def _fetch(self, location: str, fresh: bool = False) -> str:
-        """Deep-store fetch: tarred segments download + untar to a local
-        work dir (reference: SegmentFetcherFactory on OFFLINE→ONLINE);
-        plain directories load in place. ``fresh`` untars into a new work
-        dir so a repair never reuses a possibly-damaged local copy."""
-        if location.endswith((".tar.gz", ".tgz")):
-            import tempfile
-
-            from ..ingestion.batch import untar_segment
-
-            if fresh:
-                dest = tempfile.mkdtemp(
-                    prefix=f"{self.instance_id}_repair_")
-                return untar_segment(location, dest)
-            if not hasattr(self, "_untar_dir"):
-                self._untar_dir = tempfile.mkdtemp(prefix=f"{self.instance_id}_seg_")
-            return untar_segment(location, self._untar_dir)
-        return location
+    def _fetch(self, location: str, fresh: bool = False,
+               table: str = "", seg: str = "") -> str:
+        """Deep-store fetch THROUGH the storage tier: tarred segments
+        download + untar into the SegmentTierManager's byte-budgeted local
+        cache (reference: SegmentFetcherFactory on OFFLINE→ONLINE), so
+        converge loads, cold lazy loads and repair/rebalance re-fetches all
+        draw from one budget; plain directories load in place. ``fresh``
+        fetches a new copy so a repair never reuses a possibly-damaged
+        local one. The returned path carries one reader ref (``hold``) so
+        a concurrent acquire's eviction pass cannot reclaim the directory
+        before the loader has read it; the caller drops it via
+        ``tier.release()`` once the segment is loaded."""
+        if not seg:
+            seg = os.path.basename(str(location))
+        return self._tier.acquire(table or "_unassigned", seg, location,
+                                  fresh=fresh, hold=True)
 
     def _load_segment_verified(self, table: str, seg: str, meta: dict,
-                               indexing, fresh: bool = False):
+                               indexing, fresh: bool = False,
+                               cold: bool = False):
         """Fetch + load + verify one segment. The ``segment.load`` fault
         point fires here; an injected ``corrupt`` fault damages a local COPY
         of the fetched directory (the deep store stays pristine, so repair
-        can heal) and the verifying loader is expected to catch it."""
+        can heal) and the verifying loader is expected to catch it. Cold
+        lazy loads additionally pass through the ``storage.fetch`` point
+        with the same corrupt→quarantine→repair-fresh contract as
+        ``rebalance.move``."""
         corruption = None
         if faults.ACTIVE:
             try:
                 faults.FAULTS.fire("segment.load", table=table, segment=seg)
             except faults.InjectedCorruption as c:
                 corruption = c
+            if corruption is None and cold:
+                try:
+                    faults.FAULTS.fire("storage.fetch", table=table,
+                                       segment=seg,
+                                       instance=self.instance_id)
+                except faults.InjectedCorruption as c:
+                    corruption = c
             if corruption is None and self._is_move_destination(table, seg):
                 # chaos seam for mid-rebalance failure: this load is the
                 # DESTINATION fetch of an in-flight segment move (the
@@ -298,14 +358,18 @@ class ServerInstance:
                                        instance=self.instance_id)
                 except faults.InjectedCorruption as c:
                     corruption = c
-        local = self._fetch(meta["location"], fresh=fresh)
-        if corruption is not None:
-            local = self._corrupt_local_copy(local, corruption)
-        segment = load_segment(local)
-        if indexing is not None:
-            # config-requested indexes the segment was written
-            # without get built at load (SegmentPreProcessor)
-            segment.backfill_indexes(indexing)
+        local = self._fetch(meta["location"], fresh=fresh,
+                            table=table, seg=seg)
+        try:
+            if corruption is not None:
+                local = self._corrupt_local_copy(local, corruption)
+            segment = load_segment(local, expected_crc=meta.get("crc"))
+            if indexing is not None:
+                # config-requested indexes the segment was written
+                # without get built at load (SegmentPreProcessor)
+                segment.backfill_indexes(indexing)
+        finally:
+            self._tier.release(table or "_unassigned", seg)
         return segment
 
     def _is_move_destination(self, table: str, seg: str) -> bool:
@@ -411,12 +475,15 @@ class ServerInstance:
                 continue
             with self._lock:
                 self.segments.setdefault(table, {})[seg] = segment
+                self._seg_meta[(table, seg)] = meta
+                self._cold.get(table, {}).pop(seg, None)
                 self.quarantined.get(table, {}).pop(seg, None)
                 self._load_failures.pop((table, seg), None)
                 self._register_table(table)
                 want = {s for s, m in ideal.items()
                         if m.get(self.instance_id) == ONLINE}
-                online = want & set(self.segments.get(table, {}))
+                online = (want & set(self.segments.get(table, {}))) \
+                    | (want & set(self._cold.get(table, ())))
             SERVER_METRICS.add_meter(ServerMeter.SEGMENT_REPAIRS)
             self._update_external_view(table, online)
             log.info("%s: repaired segment %s/%s from deep store "
@@ -453,6 +520,213 @@ class ServerInstance:
             self.repair_segment(table, seg)
         else:
             self._converge(table, self.store.get(f"/IDEALSTATES/{table}"))
+
+    # -- tiered storage: evict / warm / prefetch -----------------------------
+    def _evict_segment(self, table: str, seg: str):
+        """Tier evict callback: demote a resident segment to cold
+        (metadata-only) state under budget pressure. The deep-store bytes
+        are unchanged, so this must NOT bump /CACHEEPOCH and does not touch
+        the external view — the replica stays ONLINE and re-fetchable.
+        HBM stacks/partials for the departed copy drop by name (the PR-14
+        departure hygiene path). Returns the live ImmutableSegment so the
+        tier can defer destroy() until in-flight readers drain."""
+        from ..cache.partial import GLOBAL_PARTIAL_CACHE
+        from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+
+        with self._lock:
+            segment = self.segments.get(table, {}).pop(seg, None)
+            meta = self._seg_meta.pop((table, seg), None)
+            if meta is not None:
+                self._cold.setdefault(table, {})[seg] = meta
+            if segment is not None:
+                self._register_table(table)
+        GLOBAL_PARTIAL_CACHE.invalidate_segment(seg)
+        GLOBAL_DEVICE_CACHE.drop_partials(segment_name=seg)
+        if segment is not None:
+            GLOBAL_DEVICE_CACHE.drop(segment)
+        GLOBAL_DEVICE_CACHE.drop_named(seg)
+        SERVER_METRICS.add_meter(ServerMeter.SEGMENT_EVICTIONS)
+        log.info("%s: evicted segment %s/%s to cold (metadata-only)",
+                 self.instance_id, table, seg)
+        return segment
+
+    def _broker_table_costs(self) -> dict:
+        """Fleet-wide decayed per-table query cost from the broker
+        /BROKERSTATE beacons (PR-10 WorkloadTracker) — the tier's eviction
+        heat weighting. Consulted only when the tier must evict, never on
+        the query path."""
+        costs: dict[str, float] = {}
+        try:
+            ids = self.store.children("/BROKERSTATE")
+        except Exception:
+            return costs
+        for bid in ids:
+            state = self.store.get(f"/BROKERSTATE/{bid}") or {}
+            for t, c in (state.get("tableCostsMs") or {}).items():
+                try:
+                    costs[t] = max(costs.get(t, 0.0), float(c))
+                except (TypeError, ValueError):
+                    continue
+        # beacons carry broker-facing table names; tier entries are keyed
+        # by the type-suffixed internal name — project costs onto both
+        for nwt in self._tables_named(list(costs)):
+            raw = nwt.rsplit("_", 1)[0]
+            if raw in costs:
+                costs[nwt] = max(costs.get(nwt, 0.0), costs[raw])
+        return costs
+
+    def _tables_named(self, names) -> list:
+        """Hosted (resident or cold) internal table names matching any of
+        the given broker-facing names — either exactly or modulo the
+        ``_OFFLINE``/``_REALTIME`` type suffix."""
+        wanted = set(names)
+        with self._lock:
+            hosted = set(self.segments) | set(self._cold)
+        return sorted(t for t in hosted
+                      if t in wanted or t.rsplit("_", 1)[0] in wanted)
+
+    def _kick_warm(self, table: str, seg: str) -> threading.Event:
+        """Start (or join) a background warm of one cold segment. Returns
+        the completion event; concurrent callers coalesce on one fetch."""
+        key = (table, seg)
+        with self._lock:
+            if seg in self.segments.get(table, {}):
+                done = threading.Event()
+                done.set()
+                return done
+            ev = self._warming.get(key)
+            if ev is not None:
+                return ev
+            ev = self._warming[key] = threading.Event()
+        threading.Thread(target=self._warm_leader, args=(table, seg, ev),
+                         daemon=True, name=f"warm-{seg}").start()
+        return ev
+
+    def _warm_leader(self, table: str, seg: str, ev: threading.Event) -> None:
+        """Fetch + verify + load one cold segment (the single in-flight
+        warm for its (table, seg) key). An integrity failure quarantines
+        and kicks repair — exactly the rebalance.move contract — so a
+        corrupt deep-store fetch heals with a fresh copy instead of being
+        served or retried in place."""
+        t0 = time.perf_counter()
+        try:
+            with self._lock:
+                meta = self._cold.get(table, {}).get(seg)
+            if meta is None:
+                return
+            indexing = None
+            cfg_json = self.store.get(f"/CONFIGS/TABLE/{table}")
+            if cfg_json and "tableName" in cfg_json:
+                from ..spi.table_config import TableConfig
+
+                indexing = TableConfig.from_json(cfg_json).indexing
+            try:
+                segment = self._load_segment_verified(
+                    table, seg, meta, indexing, cold=True)
+            except SegmentIntegrityError as e:
+                with self._lock:
+                    self._cold.get(table, {}).pop(seg, None)
+                self._quarantine(table, seg, e)
+                self._kick_repair(table, seg)
+                return
+            except Exception:
+                with self._lock:
+                    n = self._load_failures.get((table, seg), 0) + 1
+                    self._load_failures[(table, seg)] = n
+                log.warning("%s: cold load of %s/%s failed (attempt %d)",
+                            self.instance_id, table, seg, n, exc_info=True)
+                return
+            with self._lock:
+                self._cold.get(table, {}).pop(seg, None)
+                self.segments.setdefault(table, {})[seg] = segment
+                self._seg_meta[(table, seg)] = meta
+                self._load_failures.pop((table, seg), None)
+                self._register_table(table)
+            SERVER_METRICS.add_meter(ServerMeter.SEGMENT_COLD_LOADS)
+            SERVER_METRICS.update_timer(
+                ServerTimer.COLD_LOAD_MS, (time.perf_counter() - t0) * 1000.0)
+        finally:
+            with self._lock:
+                self._warming.pop((table, seg), None)
+            ev.set()
+
+    def _warm_cold_segments(self, table: str, cold_names: list,
+                            deadline_ms) -> list:
+        """Deadline-aware lazy warm of cold routed segments: kick all the
+        warms, then wait for each inside the remaining broker budget minus
+        a floor. Returns the names still cold when the budget ran out —
+        they keep warming in the background (next query finds them
+        resident) while THIS response degrades instead of blocking."""
+        floor_s = float(
+            os.environ.get("PINOT_TPU_COLD_SYNC_FLOOR_MS", "25")) / 1000.0
+        deadline = None
+        if deadline_ms is not None:
+            deadline = time.monotonic() + max(0.0, float(deadline_ms) / 1000.0)
+        events = [(seg, self._kick_warm(table, seg)) for seg in cold_names]
+        still = []
+        for seg, ev in events:
+            timeout = None
+            if deadline is not None:
+                timeout = max(0.0, deadline - time.monotonic() - floor_s)
+            ev.wait(timeout)
+            with self._lock:
+                if seg not in self.segments.get(table, {}):
+                    still.append(seg)
+        return still
+
+    def _on_prefetch(self, path: str, value) -> None:
+        """/PREFETCH/{table} nudge from the leader's StoragePrefetcher:
+        mark the table hot (goes last in eviction order for the hot TTL)
+        and warm its cold segments in the background while tier headroom
+        remains, so the hot table is resident before traffic lands."""
+        if not self._started or value is None:
+            return
+        parts = path.strip("/").split("/")
+        if len(parts) != 2:
+            return
+        # the nudge names the broker-facing table; hosted state is keyed
+        # by the type-suffixed internal name
+        table = parts[1]
+        self._tier.note_hot(table)
+        for nwt in self._tables_named([table]):
+            self._tier.note_hot(nwt)
+            with self._lock:
+                cold = sorted(self._cold.get(nwt, {}))
+            if cold:
+                threading.Thread(target=self._prefetch_warm,
+                                 args=(nwt, cold),
+                                 daemon=True,
+                                 name=f"prefetch-{nwt}").start()
+
+    def _prefetch_warm(self, table: str, names: list) -> None:
+        for seg in names:
+            if not self._started or not self._tier.headroom():
+                return  # prefetch warms fill headroom; they never evict
+            self._kick_warm(table, seg).wait(30.0)
+            with self._lock:
+                ok = seg in self.segments.get(table, {})
+            if ok:
+                SERVER_METRICS.add_meter(ServerMeter.PREFETCH_HITS)
+
+    def debug_storage(self) -> dict:
+        """Storage-tier inventory for GET /debug/storage: local-tier
+        budget/usage, resident vs cold (metadata-only) segments per table,
+        and the in-flight warm queue."""
+        with self._lock:
+            tables = sorted(set(self.segments) | set(self._cold))
+            per_table = {
+                t: {"resident": sorted(self.segments.get(t, {})),
+                    "cold": sorted(self._cold.get(t, {}))}
+                for t in tables}
+            warming = sorted(f"{t}/{s}" for t, s in self._warming)
+        return {
+            "localTier": self._tier.stats(),
+            "residentSegments": sum(len(v["resident"])
+                                    for v in per_table.values()),
+            "coldSegments": sum(len(v["cold"]) for v in per_table.values()),
+            "warming": warming,
+            "tables": per_table,
+        }
 
     def health_status(self) -> dict:
         """Per-instance health beacon: answered over RPC (`status`) to the
@@ -652,17 +926,34 @@ class ServerInstance:
         # RPC, so mutating query_options here is private to this call)
         deadline_ms = request.get("deadlineMs")
         query_id = request.get("queryId")
+        t_enter = time.monotonic()
+        # cold (metadata-only) routed segments warm BEFORE admission,
+        # bounded by the remaining broker budget; un-warmable ones ride the
+        # missing-segments machinery (replica retry → degrade) instead of
+        # blocking the response
+        with self._lock:
+            hosted = self.segments.get(table, {})
+            cold_routed = [n for n in names if n not in hosted
+                           and n in self._cold.get(table, {})]
+        still_cold = self._warm_cold_segments(table, cold_routed,
+                                              deadline_ms) \
+            if cold_routed else []
         timeout_s = 60.0
         if deadline_ms is not None:
-            timeout_s = max(0.05, min(60.0, float(deadline_ms) / 1000.0))
+            left_ms = max(50.0, float(deadline_ms)
+                          - (time.monotonic() - t_enter) * 1000.0)
+            timeout_s = max(0.05, min(60.0, left_ms / 1000.0))
             cur = query.query_options.get("timeoutMs")
             query.query_options["timeoutMs"] = (
-                float(deadline_ms) if cur is None
-                else min(float(cur), float(deadline_ms)))
+                left_ms if cur is None else min(float(cur), left_ms))
         with self._lock:
             hosted = self.segments.get(table, {})
             segs = [hosted[n] for n in names if n in hosted]
             missing = [n for n in names if n not in hosted]
+            # refcount-pin the tier-local copies for the scan: an eviction
+            # racing this query defers its directory removal (and the
+            # segment destroy) until the pin releases — no ENOENT mid-scan
+            pins = self._tier.pin(table, [n for n in names if n in hosted])
 
         def run(tracker):
             return self.executor.execute_segments(query, segs, tracker=tracker)
@@ -699,7 +990,12 @@ class ServerInstance:
         finally:
             if trace is not None:
                 TRACING.end_trace()
+            self._tier.unpin(pins)
         stats["missing_segments"] = missing
+        if still_cold:
+            # names the broker both counts (coldSegmentsWarming) and may
+            # retry against this same instance once the warm completes
+            stats["cold_segments"] = [n for n in still_cold if n in missing]
         # intermediates travel as the versioned binary DataTable, not as
         # pickled Python objects (reference: DataTableImplV4 on the wire)
         from .datatable import encode
@@ -728,7 +1024,8 @@ class ServerInstance:
             seg = self.segments.get(table, {}).get(name)
         if seg is None:
             raise ValueError(f"segment {name} not hosted for {table}")
-        ipc = segment_ipc_bytes(seg, request.get("columns"))
+        with self._tier.reading(table, [name]):
+            ipc = segment_ipc_bytes(seg, request.get("columns"))
         return {"ipc": ipc, "numRows": seg.num_docs}
 
     def _handle_explain(self, request):
@@ -768,9 +1065,11 @@ class ServerInstance:
         def stream():
             if missing:
                 raise RuntimeError(f"missing routed segments: {missing}")
-            for name, seg in segs:
-                combined, stats = self.executor.execute_segments(query, [seg])
-                stats["segment"] = name
-                yield encode(combined, stats)
+            with self._tier.reading(table, [n for n, _ in segs]):
+                for name, seg in segs:
+                    combined, stats = self.executor.execute_segments(
+                        query, [seg])
+                    stats["segment"] = name
+                    yield encode(combined, stats)
 
         return stream()

@@ -77,6 +77,9 @@ class BrokerResponse:
     num_corrupt_shards_retried: int = 0
     # broker admission control shed this query (429-style rejection)
     query_rejected: bool = False
+    # tiered storage: cold (metadata-only) segments still warming when the
+    # response was assembled — the answer may be partial, never wrong
+    cold_segments_warming: int = 0
 
     def to_json(self) -> dict:
         out = {
@@ -116,6 +119,8 @@ class BrokerResponse:
             out["numCorruptShardsRetried"] = self.num_corrupt_shards_retried
         if self.query_rejected:
             out["queryRejected"] = True
+        if self.cold_segments_warming:
+            out["coldSegmentsWarming"] = self.cold_segments_warming
         return out
 
 

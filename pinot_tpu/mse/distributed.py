@@ -542,6 +542,32 @@ class MseWorkerService:
                 f"table {table} not assigned to this worker")
         return entry
 
+    def _leaf_segments(self, nwt: str, seg_names,
+                       deadline: Optional[float] = None) -> dict:
+        """Resolve routed segment names to loaded segments, lazily warming
+        cold (metadata-only) registrations within the stage deadline. An
+        MSE leaf has no partial-results channel, so a routed-but-still-cold
+        segment must raise (the broker surfaces a query exception) rather
+        than be skipped into a silently truncated scan."""
+        server = self.server
+        with server._lock:
+            hosted = server.segments.get(nwt, {})
+            cold = [n for n in seg_names if n not in hosted
+                    and n in server._cold.get(nwt, {})]
+        if cold:
+            deadline_ms = None
+            if deadline is not None:
+                deadline_ms = max(
+                    0.0, (deadline - time.monotonic()) * 1000.0)
+            server._warm_cold_segments(nwt, cold, deadline_ms)
+            with server._lock:
+                hosted = server.segments.get(nwt, {})
+                still = [n for n in cold if n not in hosted]
+            if still:
+                raise RuntimeError(
+                    f"cold segments still warming for {nwt}: {still}")
+        return dict(hosted)
+
     def _make_execute_query(self, halves: dict,
                             deadline: Optional[float] = None) -> Callable:
         """Leaf SSQE entry: run the compiled QueryContext over this worker's
@@ -557,7 +583,7 @@ class MseWorkerService:
             out_rows, schema = [], None
             scanned = total = dispatches = compiles = 0
             for nwt, seg_names, extra in self._halves_for(halves, qc.table_name):
-                hosted = self.server.segments.get(nwt, {})
+                hosted = self._leaf_segments(nwt, seg_names, deadline)
                 segs = [hosted[n] for n in seg_names if n in hosted]
                 q2 = copy.deepcopy(qc)
                 q2.table_name = nwt
@@ -576,7 +602,10 @@ class MseWorkerService:
                     fc = filter_from_expression(expr_from_json(extra))
                     q2.filter = fc if q2.filter is None else \
                         FilterContext.and_(q2.filter, fc)
-                combined, stats = self.server.executor.execute_segments(q2, segs)
+                with self.server._tier.reading(
+                        nwt, [n for n in seg_names if n in hosted]):
+                    combined, stats = self.server.executor.execute_segments(
+                        q2, segs)
                 table = self.server.executor.tables.get(nwt)
                 result = BrokerReducer(table.schema if table else None).reduce(
                     q2, combined)
@@ -604,21 +633,25 @@ class MseWorkerService:
         def read_table(table: str, columns: list[str]) -> dict[str, np.ndarray]:
             blocks = []
             for nwt, seg_names, extra in self._halves_for(halves, table):
-                hosted = self.server.segments.get(nwt, {})
+                hosted = self._leaf_segments(nwt, seg_names)
                 extra_ec = expr_from_json(extra) if extra is not None else None
                 need = list(dict.fromkeys(
                     list(columns) + sorted(extra_ec.columns() if extra_ec else [])))
                 parts: dict[str, list] = {c: [] for c in need}
-                for name in seg_names:
-                    seg = hosted.get(name)
-                    if seg is None:
-                        continue
-                    view = seg.snapshot_view() if getattr(seg, "is_mutable", False) else seg
-                    vd = getattr(view, "valid_doc_ids", None)
-                    keep = vd.mask(view.num_docs) if vd is not None else None
-                    for c in need:
-                        vals = np.asarray(view.get_values(c))
-                        parts[c].append(vals if keep is None else vals[keep])
+                with self.server._tier.reading(
+                        nwt, [n for n in seg_names if n in hosted]):
+                    for name in seg_names:
+                        seg = hosted.get(name)
+                        if seg is None:
+                            continue
+                        view = seg.snapshot_view() \
+                            if getattr(seg, "is_mutable", False) else seg
+                        vd = getattr(view, "valid_doc_ids", None)
+                        keep = vd.mask(view.num_docs) if vd is not None else None
+                        for c in need:
+                            vals = np.asarray(view.get_values(c))
+                            parts[c].append(
+                                vals if keep is None else vals[keep])
                 block = {}
                 for c, arrs in parts.items():
                     if not arrs:

@@ -573,11 +573,23 @@ class ImmutableSegment:
 
 
 def load_segment(directory: str | Path,
-                 verify: Optional[bool] = None) -> ImmutableSegment:
+                 verify: Optional[bool] = None,
+                 expected_crc: Optional[str] = None) -> ImmutableSegment:
     """Load (and by default verify) a segment directory. ``verify=None``
     follows PINOT_TPU_VERIFY_CRC (default on); verification happens ONCE
-    here — load/reload time — never per query."""
+    here — load/reload time — never per query.
+
+    ``expected_crc`` cross-checks the loaded segment against the crc the
+    catalog (/SEGMENTS metadata) advertises: a tiered-storage cold fetch
+    that pulls a stale or swapped deep-store copy fails here instead of
+    silently serving different bytes than the catalog promised."""
     seg = ImmutableSegment(directory)
     if verify if verify is not None else verify_enabled():
         seg.verify_integrity()
+    if expected_crc is not None and seg.metadata.crc is not None \
+            and str(expected_crc) != str(seg.metadata.crc):
+        raise SegmentIntegrityError(
+            seg.metadata.segment_name, directory,
+            f"crc {seg.metadata.crc} does not match catalog crc "
+            f"{expected_crc}")
     return seg

@@ -60,6 +60,12 @@ POINTS = (
                          # segment move (error/delay stall the move and
                          # exercise retry/blacklist; corrupt damages the
                          # fetched copy so quarantine+repair must heal it)
+    "storage.fetch",     # SegmentTierManager cold-load fetch of a
+                         # metadata-only segment (error fails the warm so
+                         # the broker retries a resident replica; delay
+                         # stalls it into deadline degradation; corrupt
+                         # damages the local copy so quarantine+repair
+                         # must re-fetch fresh, like rebalance.move)
 )
 
 

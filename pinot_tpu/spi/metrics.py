@@ -53,6 +53,12 @@ class ServerMeter:
     # (dtype/overflow/empty side) that fell back to the host operators
     MSE_DEVICE_JOINS = "mseDeviceJoins"
     MSE_DEVICE_JOIN_FALLBACKS = "mseDeviceJoinFallbacks"
+    # tiered storage (storage/tier.py via cluster/server.py): cold
+    # metadata-only segments fetched on demand, budget-pressure evictions
+    # back to metadata-only, and prefetch-nudge warms that completed
+    SEGMENT_COLD_LOADS = "segmentColdLoads"
+    SEGMENT_EVICTIONS = "segmentEvictions"
+    PREFETCH_HITS = "prefetchHits"
 
 
 class BrokerMeter:
@@ -85,6 +91,8 @@ class ServerTimer:
     # on-device cross-chip result merge for mesh-sharded family dispatches
     # (engine/executor.py _dispatch_batch_sharded; traced runs only)
     CROSS_CHIP_COMBINE_MS = "crossChipCombineMs"
+    # tiered storage: wall time to fetch+verify+load one cold segment
+    COLD_LOAD_MS = "coldLoadMs"
 
 
 class BrokerTimer:

@@ -1,0 +1,13 @@
+"""Tiered storage: a byte-budgeted local segment cache beneath the HBM
+plane cache, so a server can advertise ONLINE for far more segments than
+it holds on local disk.
+
+`SegmentTierManager` (tier.py) owns every locally materialized segment
+directory — converge loads, cold lazy loads, repair and rebalance
+re-fetches all draw from one `PINOT_TPU_LOCAL_STORAGE_MB` budget.
+`StoragePrefetcher` (prefetch.py) runs on the leader's periodic
+scheduler and nudges servers to warm hot tables before traffic lands.
+"""
+
+from .tier import SegmentTierManager, TIER_PROBES  # noqa: F401
+from .prefetch import PREFETCH_PREFIX, StoragePrefetcher  # noqa: F401

@@ -1,0 +1,69 @@
+"""StoragePrefetcher — leader-side workload-driven warm-up nudges.
+
+Runs on the controller's periodic scheduler. Each tick it walks the
+broker ``/BROKERSTATE/*`` cost beacons (the PR-10 WorkloadTracker
+publishes decaying per-table query-cost rollups there), ranks tables by
+observed cost, and writes a ``/PREFETCH/{table}`` nudge for the top-K
+hot tables. Servers watch the prefix: a nudge marks the table hot in
+their SegmentTierManager (pinning it against eviction for the hot TTL)
+and background-warms its cold segments while tier headroom remains — so
+a hot table is resident BEFORE the next query lands, not after.
+
+Nudges are written only when a table ENTERS the hot set (membership
+change), not every tick, so the property store isn't churned and server
+watch storms don't happen under steady load.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+
+PREFETCH_PREFIX = "/PREFETCH"
+
+
+class StoragePrefetcher:
+    def __init__(self, store, top_k: int = None, min_cost_ms: float = None):
+        self.store = store
+        self.top_k = int(top_k if top_k is not None else
+                         os.environ.get("PINOT_TPU_PREFETCH_TOP_K", "3"))
+        self.min_cost_ms = float(
+            min_cost_ms if min_cost_ms is not None else
+            os.environ.get("PINOT_TPU_PREFETCH_MIN_COST_MS", "0.5"))
+        self._nonce = itertools.count(1)
+        self._last_hot: set = set()
+
+    def _table_costs(self) -> dict:
+        costs: dict[str, float] = {}
+        try:
+            brokers = self.store.children("/BROKERSTATE")
+        except Exception:
+            return costs
+        for bid in brokers:
+            state = self.store.get(f"/BROKERSTATE/{bid}") or {}
+            for table, cost in (state.get("tableCostsMs") or {}).items():
+                try:
+                    c = float(cost)
+                except (TypeError, ValueError):
+                    continue
+                costs[table] = max(costs.get(table, 0.0), c)
+        return costs
+
+    def __call__(self) -> dict:
+        costs = self._table_costs()
+        hot = sorted((t for t, c in costs.items() if c >= self.min_cost_ms),
+                     key=lambda t: -costs[t])[:self.top_k]
+        nudged = []
+        for table in hot:
+            if table in self._last_hot:
+                continue
+            self.store.set(f"{PREFETCH_PREFIX}/{table}", {
+                "nonce": next(self._nonce),
+                "costMs": round(costs[table], 3),
+                "atMs": int(time.time() * 1000),
+            })
+            nudged.append(table)
+        self._last_hot = set(hot)
+        return {"hotTables": hot, "nudged": nudged,
+                "tablesSeen": len(costs)}

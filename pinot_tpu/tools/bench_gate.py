@@ -29,7 +29,11 @@ Checks per config present in the baseline:
 - **shuffled-bytes regression** (MSE configs that record it): candidate
   ``shuffled_bytes`` > baseline × (1 + ``--threshold``) AND at least
   4096 bytes more — a plan regression (lost pushdown, widened exchange
-  schema), same WARN-across-platforms downgrade as p50.
+  schema), same WARN-across-platforms downgrade as p50;
+- **tiered cold/warm regression** (configs that record them): candidate
+  ``cold_p50_s`` / ``warm_p50_s`` past the same ratio + ``--min-abs-ms``
+  rules (WARN across platforms); a ``warm_match`` flip true → false
+  always fails — the warm resident path returned different rows.
 
 Platform mismatch (cpu round vs tpu round) downgrades p50 checks to
 warnings: the ratio would measure the machine, not the code.
@@ -233,6 +237,54 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
         elif bs is not None and cs is None:
             warnings.append(f"{cfg}: baseline recorded shuffled_bytes but "
                             "candidate did not (exchange telemetry dropped)")
+        # tiered-storage round (cold-start vs warm-resident p50): compared
+        # only when BOTH rounds measured it, same missing-side rule as
+        # mesh. cold_p50_s times the first-query lazy fetch path;
+        # warm_p50_s times the resident path, so a warm regression is a
+        # hot-path regression no cold-fetch noise can excuse. A
+        # warm_match flip is a correctness regression and always fails.
+        for key, match_key, label in (
+                ("cold_p50_s", None, "cold"),
+                ("warm_p50_s", "warm_match", "warm")):
+            bt = b.get(key)
+            ct = c.get(key)
+            if bt is None and ct is None:
+                continue
+            if bt is not None and ct is None:
+                warnings.append(
+                    f"{cfg}: baseline measured a {label} tiered round but "
+                    f"candidate did not (tiered coverage dropped)")
+                continue
+            if bt is None:
+                continue
+            btp, ctp = float(bt), float(ct)
+            t_ratio = (ctp / btp) if btp > 0 else float("inf")
+            t_delta_ms = (ctp - btp) * 1000.0
+            camel = "Cold" if label == "cold" else "Warm"
+            row.update({f"baseline{camel}P50s": round(btp, 6),
+                        f"candidate{camel}P50s": round(ctp, 6),
+                        f"{label}Ratio": round(t_ratio, 4)})
+            if match_key and b.get(match_key) is True \
+                    and c.get(match_key) is False:
+                verdict = "FAIL"
+                failures.append(
+                    f"{cfg}: {match_key} flipped true -> false "
+                    "(tiered-storage correctness regression)")
+            elif btp > 0 and t_ratio > 1.0 + threshold \
+                    and t_delta_ms >= min_abs_ms:
+                if cross_platform:
+                    if verdict == "PASS":
+                        verdict = "WARN"
+                    warnings.append(
+                        f"{cfg}: {label} p50 {btp:.4f}s -> {ctp:.4f}s "
+                        f"({(t_ratio - 1) * 100:.1f}% slower) across "
+                        "platforms")
+                else:
+                    verdict = "FAIL"
+                    failures.append(
+                        f"{cfg}: {label} p50 regressed {btp:.4f}s -> "
+                        f"{ctp:.4f}s ({(t_ratio - 1) * 100:.1f}% slower, "
+                        f"threshold {threshold * 100:.0f}%)")
         row["verdict"] = verdict
         rows.append(row)
     return {"pass": not failures, "threshold": threshold,

@@ -26,6 +26,16 @@ Three suites, each a pure function returning a stats dict, plus a CLI:
             onto new hosts; queries stay exact-or-degraded, one leader
             kill mid-job exercises journal resume, and --fault-rate
             arms the rebalance.move point on in-flight destinations.
+  tiered    tiered storage: several tables whose total tarred-segment
+            bytes are a small multiple of each server's
+            PINOT_TPU_LOCAL_STORAGE_MB budget, under a randomized query
+            mix (dense agg, sparse group-by, selection ORDER BY, MSE
+            join) that forces continuous cold loads + LRU evictions;
+            every full response must match a fully-resident control
+            cluster bit-for-bit (degraded = partial/coldSegmentsWarming
+            is allowed, silently wrong is not), disk stays inside the
+            byte budget plus in-flight fetches, and a final strict pass
+            over every table must be bit-identical to the control.
 
 Default profile is a ~2-minute smoke across all suites:
 
@@ -1356,6 +1366,297 @@ def soak_rebalance(seconds: float = 30.0, seed: int = 0,
 
 
 # ════════════════════════════════════════════════════════════════════════════
+# Suite 7: tiered storage — byte-budgeted cache under eviction churn
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def soak_tiered(seconds: float = 20.0, seed: int = 0, n_tables: int = 6,
+                segments_per_table: int = 3, rows_per_segment: int = 400,
+                progress=None) -> dict:
+    """Tiered-storage soak: ``n_tables`` tables of tarred deep-store
+    segments whose total extracted bytes are a small multiple of each
+    server's local byte budget, hammered by a randomized query mix
+    (dense aggregation, sparse group-by, selection ORDER BY, MSE join)
+    with occasional tight ``timeoutMs`` overrides so queries race cold
+    warms. Invariants:
+
+    * exact-or-degraded-never-silently-wrong: every FULL response
+      (no exceptions, not partial) must match a fully-resident control
+      cluster bit-for-bit; partial/errored responses are counted as
+      degraded, never compared.
+    * disk stays bounded: each server's tier accounting and a direct
+      walk of its tier directory never exceed the byte budget plus
+      in-flight fetches (one fetch per concurrently warming segment)
+      plus pending-release zombies held by in-flight readers.
+    * churn actually happened: the run must record cold loads AND
+      evictions, or the budget never bit and the soak proves nothing.
+    * final strict pass: with the cluster quiet, every query shape on
+      every table (allowPartialResults OFF) returns bit-identical rows
+      vs the control cluster — evicted data is re-fetchable, always.
+    """
+    import os
+    import tarfile
+
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi.data_types import Schema
+    from pinot_tpu.spi.metrics import SERVER_METRICS, ServerMeter
+
+    teams = ["BOS", "NYA", "SFN", "LAN", "CHC", "HOU"]
+    regions = ["west", "east", "south"]
+    rng = np.random.default_rng(seed)
+    t0 = time.time()
+    tmp = tempfile.TemporaryDirectory(prefix="pinot_soak_tiered_")
+    d = Path(tmp.name)
+
+    # -- build deep store: dirs for the control cluster, tars for the
+    #    tiered one; measure extracted bytes to size the budget ----------
+    def _walk_bytes(path) -> int:
+        total = 0
+        for root, _dirs, files in os.walk(path):
+            for f in files:
+                try:
+                    total += os.stat(os.path.join(root, f)).st_size
+                except OSError:
+                    pass
+        return total
+
+    tables = [f"tier_{i}" for i in range(n_tables)]
+    schemas = {}
+    seg_dirs: dict[str, list] = {}
+    seg_tars: dict[str, list] = {}
+    table_bytes: dict[str, int] = {}
+    max_seg_bytes = 0
+    total_bytes = 0
+    for t in tables:
+        schemas[t] = Schema.build(
+            t, dimensions=[("team", "STRING"), ("year", "INT")],
+            metrics=[("runs", "INT")])
+        seg_dirs[t], seg_tars[t] = [], []
+        table_bytes[t] = 0
+        for i in range(segments_per_table):
+            n = rows_per_segment
+            cols = {
+                "team": np.asarray(teams, dtype=object)[
+                    rng.integers(0, len(teams), n)],
+                "year": rng.integers(2000, 2020, n).astype(np.int32),
+                "runs": rng.integers(0, 100, n).astype(np.int32),
+            }
+            name = f"{t}_{i}"
+            local = d / t / name
+            SegmentBuilder(schemas[t], segment_name=name).build(cols, local)
+            tar = d / t / f"{name}.tar.gz"
+            with tarfile.open(tar, "w:gz") as tf:
+                tf.add(local, arcname=name)
+            nbytes = _walk_bytes(local)
+            max_seg_bytes = max(max_seg_bytes, nbytes)
+            table_bytes[t] += nbytes
+            total_bytes += nbytes
+            seg_dirs[t].append((name, str(local), n))
+            seg_tars[t].append((name, str(tar), n))
+    dim_schema = Schema.build(
+        "tierdim", dimensions=[("dyear", "INT"), ("region", "STRING")])
+    dim_cols = {"dyear": np.arange(2000, 2020, dtype=np.int32),
+                "region": np.asarray([regions[y % 3] for y in range(20)],
+                                     dtype=object)}
+    SegmentBuilder(dim_schema, segment_name="tierdim_0").build(
+        dim_cols, d / "tierdim_0")
+
+    # budget: one table's bytes + slack. Any single table (the per-query
+    # working set) fits resident, but the fleet of tables is ~n_tables/1.2
+    # times over budget, so rotating the query mix across tables forces
+    # continuous evict/refetch churn.
+    budget_bytes = int(max(table_bytes.values()) * 1.2) + 4096
+    budget_mb = budget_bytes / (1024 * 1024)
+
+    def _bootstrap(suffix: str, locations, n_servers: int, storage_mb):
+        store = PropertyStore()
+        controller = ClusterController(store)
+        servers = [ServerInstance(store, f"Server_{suffix}_{i}",
+                                  backend="host",
+                                  local_storage_mb=storage_mb)
+                   for i in range(n_servers)]
+        for s in servers:
+            s.start()
+        broker = Broker(store)
+        for t in tables:
+            controller.add_schema(schemas[t].to_json())
+            handle = controller.create_table({"tableName": t,
+                                              "replication": 1})
+            for name, loc, n in locations[t]:
+                controller.add_segment(handle, name,
+                                       {"location": loc, "numDocs": n})
+        controller.add_schema(dim_schema.to_json())
+        handle = controller.create_table({"tableName": "tierdim",
+                                          "replication": 1})
+        controller.add_segment(handle, "tierdim_0",
+                               {"location": str(d / "tierdim_0"),
+                                "numDocs": 20})
+        return store, controller, servers, broker
+
+    # tiered cluster: tar locations + a byte budget. control cluster:
+    # plain-dir locations, budget explicitly OFF (0 also defeats any
+    # PINOT_TPU_LOCAL_STORAGE_MB in the ambient environment).
+    _, _, tier_servers, tier_broker = _bootstrap(
+        "t", seg_tars, 2, budget_mb)
+    _, _, _ctl_servers, ctl_broker = _bootstrap("c", seg_dirs, 1, 0)
+
+    def _gen(table: str):
+        shape = int(rng.integers(0, 4))
+        cut = int(rng.integers(0, 90))
+        if shape == 0:  # dense aggregation
+            return (f"SELECT COUNT(*), SUM(runs), MIN(runs), MAX(runs) "
+                    f"FROM {table}")
+        if shape == 1:  # sparse group-by
+            return (f"SELECT team, year, SUM(runs), COUNT(*) FROM {table} "
+                    f"WHERE runs > {cut} GROUP BY team, year LIMIT 2000")
+        if shape == 2:  # selection ORDER BY (full tuple is the sort key,
+            # so the LIMIT-truncated multiset is deterministic)
+            return (f"SELECT runs, year, team FROM {table} "
+                    f"WHERE runs >= {cut} "
+                    f"ORDER BY runs, year, team LIMIT 64")
+        return (f"SELECT b.region, SUM(a.runs) FROM {table} a "
+                f"JOIN tierdim b ON a.year = b.dyear "
+                f"GROUP BY b.region LIMIT 20")
+
+    control_cache: dict[str, list] = {}
+
+    def _control_rows(sql: str) -> list:
+        if sql not in control_cache:
+            resp = ctl_broker.execute_sql("SET resultCache=false; " + sql)
+            if resp.exceptions or getattr(resp, "partial_result", False):
+                raise SoakFailure(
+                    f"control cluster degraded (seed {seed}): {sql} "
+                    f"→ {resp.exceptions}")
+            control_cache[sql] = _canon(resp.result_table.rows)
+        return control_cache[sql]
+
+    meters0 = {
+        "cold": SERVER_METRICS.meter_count(ServerMeter.SEGMENT_COLD_LOADS),
+        "evict": SERVER_METRICS.meter_count(ServerMeter.SEGMENT_EVICTIONS),
+    }
+    stats = {"queries": 0, "exact": 0, "degraded": 0,
+             "cold_warming_responses": 0, "disk_checks": 0}
+    max_used = max_walk = 0
+
+    def _check_disk():
+        nonlocal max_used, max_walk
+        for s in tier_servers:
+            st = s._tier.stats()
+            dbg = s.debug_storage()
+            # one in-flight fetch per concurrently warming segment can sit
+            # on disk before eviction catches up; zombies (evicted dirs
+            # pinned by in-flight readers) are accounted separately
+            inflight = max(1, len(dbg.get("warming", ())) + 1)
+            allow = budget_bytes + inflight * max_seg_bytes
+            used = st["bytesUsed"]
+            max_used = max(max_used, used)
+            if used > allow:
+                raise SoakFailure(
+                    f"tier accounting over budget (seed {seed}): "
+                    f"{used} > {allow} on {s.instance_id}: {st}")
+            base = st["baseDir"]
+            if base:
+                walk = _walk_bytes(base)
+                max_walk = max(max_walk, walk)
+                # extra max_seg_bytes of slack: the walk races live
+                # fetch/evict activity between the stats() call and here
+                if walk > allow + st["pendingReleaseBytes"] + max_seg_bytes:
+                    raise SoakFailure(
+                        f"tier DISK over budget (seed {seed}): walked "
+                        f"{walk} > {allow} + pending "
+                        f"{st['pendingReleaseBytes']} on {s.instance_id}")
+        stats["disk_checks"] += 1
+
+    failures: list = []
+    try:
+        # deterministic warm sweep first: one query per table guarantees
+        # cold loads and (past the budget) evictions even at --seconds 0
+        order = list(tables)
+        deadline = t0 + max(0.0, seconds)
+        while order or time.time() < deadline:
+            table = order.pop(0) if order else str(rng.choice(tables))
+            sql = _gen(table)
+            prefix = "SET allowPartialResults=true; SET resultCache=false; "
+            if not order and rng.random() < 0.2:
+                # tight deadline: the query races the cold warms and must
+                # degrade to a flagged partial, never a wrong answer
+                prefix += f"SET timeoutMs={int(rng.integers(40, 140))}; "
+            resp = tier_broker.execute_sql(prefix + sql)
+            stats["queries"] += 1
+            if getattr(resp, "cold_segments_warming", 0):
+                stats["cold_warming_responses"] += 1
+            if resp.exceptions or getattr(resp, "partial_result", False):
+                stats["degraded"] += 1
+            else:
+                got = _canon(resp.result_table.rows)
+                want = _control_rows(sql)
+                if not _rows_equal(got, want):
+                    raise SoakFailure(
+                        f"silently wrong FULL response (seed {seed})\n{sql}\n"
+                        f"got:  {got[:6]}…\nwant: {want[:6]}…")
+                stats["exact"] += 1
+            _check_disk()
+            if progress and stats["queries"] % 200 == 0:
+                progress(f"tiered: {stats}")
+
+        # final strict pass: quiet cluster, partials OFF — every shape on
+        # every table must now be bit-identical to the resident control
+        final_checks = 0
+        for table in tables:
+            for sql in (
+                f"SELECT COUNT(*), SUM(runs), MIN(runs), MAX(runs) "
+                f"FROM {table}",
+                f"SELECT team, year, SUM(runs), COUNT(*) FROM {table} "
+                f"GROUP BY team, year LIMIT 2000",
+                f"SELECT runs, year, team FROM {table} WHERE runs >= 50 "
+                f"ORDER BY runs, year, team LIMIT 64",
+                f"SELECT b.region, SUM(a.runs) FROM {table} a "
+                f"JOIN tierdim b ON a.year = b.dyear "
+                f"GROUP BY b.region LIMIT 20",
+            ):
+                resp = tier_broker.execute_sql(
+                    "SET resultCache=false; " + sql)
+                if resp.exceptions or getattr(resp, "partial_result", False):
+                    raise SoakFailure(
+                        f"final strict pass degraded (seed {seed}): {sql} "
+                        f"→ {resp.exceptions}")
+                if not _rows_equal(_canon(resp.result_table.rows),
+                                   _control_rows(sql)):
+                    raise SoakFailure(
+                        f"final strict pass mismatch (seed {seed}): {sql}")
+                final_checks += 1
+        stats["final_checks"] = final_checks
+
+        cold = SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENT_COLD_LOADS) - meters0["cold"]
+        evict = SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENT_EVICTIONS) - meters0["evict"]
+        if cold == 0 or evict == 0:
+            raise SoakFailure(
+                f"tiered soak never churned (seed {seed}): coldLoads={cold} "
+                f"evictions={evict} — budget {budget_bytes} vs total "
+                f"{total_bytes} bytes never bit")
+        stats.update({"cold_loads": cold, "evictions": evict})
+    finally:
+        for s in tier_servers + _ctl_servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        tmp.cleanup()
+    stats.update({
+        "suite": "tiered", "seed": seed,
+        "elapsed_s": round(time.time() - t0, 1),
+        "budget_bytes": budget_bytes, "total_segment_bytes": total_bytes,
+        "data_to_budget_ratio": round(total_bytes / budget_bytes, 2),
+        "max_tier_bytes_used": max_used, "max_tier_bytes_walked": max_walk,
+    })
+    return stats
+
+
+# ════════════════════════════════════════════════════════════════════════════
 # CLI
 # ════════════════════════════════════════════════════════════════════════════
 
@@ -1364,7 +1665,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="pinot_tpu soak/chaos harness (committed, reproducible)")
     p.add_argument("--suite", choices=["sql", "chaos", "qps", "realtime",
-                                       "failover", "rebalance", "all"],
+                                       "failover", "rebalance", "tiered",
+                                       "all"],
                    default="all")
     p.add_argument("--seconds", type=float, default=45.0,
                    help="wall-clock budget per time-based suite "
@@ -1444,6 +1746,9 @@ def main(argv=None) -> int:
                 seconds=args.seconds, seed=args.seed,
                 fault_rate=args.fault_rate, progress=progress,
                 capture_report=bool(args.report)))
+        if args.suite == "tiered":
+            results.append(soak_tiered(
+                seconds=args.seconds, seed=args.seed, progress=progress))
     except SoakFailure as e:
         failed = str(e)
 

@@ -38,6 +38,10 @@ def pytest_configure(config):
         "markers", "rebalance: durable segment-rebalance tests (engine, "
                    "actuator triggers, make-before-break invariants); "
                    "smoke-speed ones stay in the tier-1 gate")
+    config.addinivalue_line(
+        "markers", "tiered: tiered-storage tests (byte-budgeted local "
+                   "cache, cold lazy loads, eviction lifecycle, prefetch); "
+                   "smoke-speed ones stay in the tier-1 gate")
 
 
 @pytest.fixture(scope="session")

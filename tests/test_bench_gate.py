@@ -218,3 +218,69 @@ def test_real_artifacts_self_compare_pass(path_a, path_b):
     if not a.exists():
         pytest.skip(f"{path_a} not in this checkout")
     assert main([str(a), str(b)]) == 0
+
+
+def test_warm_p50_regression_fails(tmp_path, capsys):
+    """Tiered round: a warm (resident-path) p50 blow-up fails even when
+    the headline cold p50 held steady — the warm path is the hot path."""
+    base = _payload()
+    base["detail"]["q2_groupby"].update(
+        {"cold_p50_s": 0.200, "warm_p50_s": 0.010, "warm_match": True})
+    cand = _payload()
+    cand["detail"]["q2_groupby"].update(
+        {"cold_p50_s": 0.200, "warm_p50_s": 0.040, "warm_match": True})
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "q2_groupby" in out and "warm p50 regressed" in out
+
+
+def test_cold_p50_regression_fails(tmp_path, capsys):
+    base = _payload()
+    base["detail"]["q1_filter_sum"].update(
+        {"cold_p50_s": 0.050, "warm_p50_s": 0.010, "warm_match": True})
+    cand = _payload()
+    cand["detail"]["q1_filter_sum"].update(
+        {"cold_p50_s": 0.500, "warm_p50_s": 0.010, "warm_match": True})
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    assert "cold p50 regressed" in capsys.readouterr().out
+
+
+def test_warm_match_flip_always_fails(tmp_path, capsys):
+    """warm_match true -> false is a correctness regression on the
+    resident path; it fails even when every timing improved."""
+    base = _payload()
+    base["detail"]["q1_filter_sum"].update(
+        {"cold_p50_s": 0.100, "warm_p50_s": 0.020, "warm_match": True})
+    cand = _payload()
+    cand["detail"]["q1_filter_sum"].update(
+        {"cold_p50_s": 0.010, "warm_p50_s": 0.002, "warm_match": False})
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    assert "warm_match flipped" in capsys.readouterr().out
+
+
+def test_tiered_cross_platform_warns_and_missing_side_rules(tmp_path,
+                                                            capsys):
+    """Cross-platform tiered regressions downgrade to WARN (same rule as
+    mesh); a candidate that dropped the tiered round only warns; a
+    baseline without it never compares."""
+    base = _payload()
+    base["detail"]["q2_groupby"].update(
+        {"cold_p50_s": 0.200, "warm_p50_s": 0.010, "warm_match": True})
+    cand = _payload(platform="cpu")
+    cand["detail"]["q2_groupby"].update(
+        {"cold_p50_s": 2.000, "warm_p50_s": 0.100, "warm_match": True})
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "warm p50" in out and "GATE: PASS" in out
+    cand2 = _payload()  # same platform, tiered round dropped entirely
+    c = _write(tmp_path, "c.json", cand2)
+    assert main([a, c]) == 0
+    assert "tiered coverage dropped" in capsys.readouterr().out

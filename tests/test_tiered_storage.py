@@ -1,0 +1,482 @@
+"""Tiered storage: byte-budgeted local segment cache (storage/tier.py),
+cold (metadata-only) registrations with first-query lazy warm, the
+``storage.fetch`` fault point's corrupt→quarantine→repair-fresh contract,
+and the leader-side StoragePrefetcher (storage/prefetch.py).
+
+Reference: Apache Pinot's tiered storage for the cloud (deep store as
+the source of truth, servers holding a bounded local working set) and
+SegmentFetcherFactory's fetch-through-on-OFFLINE→ONLINE discipline.
+
+Covers: cold replicas advertised ONLINE and warmed by the first query;
+evicted segments re-fetched WITH a fresh CRC verify; reader refcounts
+(hold/pin) keeping directories alive under eviction and fresh re-fetch;
+hot-table pins surviving byte pressure; the warm resident path doing
+ZERO disk probes; corrupt and delayed cold fetches degrading loudly
+(quarantine+repair / flagged partial) and never caching a partial; the
+prefetcher's membership-change-only nudges; and a sub-10s tiered soak
+smoke so the full churn loop stays in the tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                               ServerInstance)
+from pinot_tpu.segment import loader as loader_mod
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+from pinot_tpu.spi.metrics import SERVER_METRICS, ServerMeter
+from pinot_tpu.storage import tier as tier_mod
+from pinot_tpu.storage.prefetch import StoragePrefetcher
+from pinot_tpu.storage.tier import SegmentTierManager
+
+pytestmark = pytest.mark.tiered
+
+TEAMS = ["BOS", "NYA", "SFN", "LAN"]
+GROUP_SQL = ("SELECT team, SUM(runs) FROM {t} GROUP BY team ORDER BY team")
+
+# servers key hosted/cold tables by the type-suffixed internal name
+ST = "stats_OFFLINE"
+FL = "filler_OFFLINE"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.FAULTS.reset()
+
+
+def _walk_bytes(path) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.stat(os.path.join(root, f)).st_size
+            except OSError:
+                pass
+    return total
+
+
+def _schema(table: str) -> Schema:
+    return Schema.build(table,
+                        dimensions=[("team", "STRING"), ("year", "INT")],
+                        metrics=[("runs", "INT")])
+
+
+def _build_tar(tmp, table: str, name: str, seed: int, n: int = 250):
+    """Build one segment dir + tarball; returns (tar, extracted_bytes, cols)."""
+    rng = np.random.default_rng(seed)
+    cols = {
+        "team": np.asarray(TEAMS, dtype=object)[rng.integers(0, len(TEAMS), n)],
+        "year": rng.integers(2000, 2010, n).astype(np.int32),
+        "runs": rng.integers(0, 100, n).astype(np.int32),
+    }
+    local = tmp / table / name
+    SegmentBuilder(_schema(table), segment_name=name).build(cols, local)
+    tar = tmp / table / f"{name}.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(local, arcname=name)
+    return str(tar), _walk_bytes(local), cols
+
+
+def _team_sums(cols_list) -> list:
+    agg: dict = {}
+    for cols in cols_list:
+        for team, runs in zip(cols["team"], cols["runs"]):
+            agg[team] = agg.get(team, 0) + int(runs)
+    return [(t, agg[t]) for t in sorted(agg)]
+
+
+def _rows(resp) -> list:
+    return [(r[0], int(r[1])) for r in resp.result_table.rows]
+
+
+def _full(resp) -> bool:
+    return not resp.exceptions and not getattr(resp, "partial_result", False)
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _cold_stats_cluster(tmp_path, stats_segs=1, filler_segs=1, n=250,
+                        extra=0.5):
+    """One server whose budget fits the ``stats`` table plus ``extra``
+    segment-widths of slack. ``stats`` is registered FIRST (loads
+    resident), then ``filler`` — whose eager loads evict the now-LRU
+    stats entries. Deterministic end state: every stats segment cold
+    (metadata-only, still advertised ONLINE), filler resident."""
+    store = PropertyStore()
+    controller = ClusterController(store, instance_id="ctl1")
+    stats_names, stats_cols, max_seg = [], [], 0
+    stats_tars, stats_bytes = [], 0
+    for i in range(stats_segs):
+        name = f"s{i}"
+        tar, nbytes, cols = _build_tar(tmp_path, "stats", name, seed=i, n=n)
+        stats_names.append(name)
+        stats_cols.append(cols)
+        stats_tars.append((name, tar))
+        stats_bytes += nbytes
+        max_seg = max(max_seg, nbytes)
+    filler_names, filler_tars, filler_cols = [], [], []
+    for i in range(filler_segs):
+        name = f"f{i}"
+        tar, nbytes, cols = _build_tar(tmp_path, "filler", name,
+                                       seed=100 + i, n=n)
+        filler_names.append(name)
+        filler_tars.append((name, tar))
+        filler_cols.append(cols)
+        max_seg = max(max_seg, nbytes)
+    budget_bytes = stats_bytes + int(extra * max_seg)
+    server = ServerInstance(store, "S0", backend="host",
+                            local_storage_mb=budget_bytes / (1024 * 1024))
+    server.start()
+    broker = Broker(store)
+    controller.add_schema(_schema("stats").to_json())
+    controller.add_schema(_schema("filler").to_json())
+    h_stats = controller.create_table({"tableName": "stats",
+                                       "replication": 1})
+    h_filler = controller.create_table({"tableName": "filler",
+                                        "replication": 1})
+    for name, tar in stats_tars:
+        controller.add_segment(h_stats, name, {"location": tar, "numDocs": n})
+    _wait(lambda: sorted(server.debug_storage()["tables"]
+                         .get(ST, {}).get("resident", [])) == stats_names,
+          msg="stats resident")
+    for name, tar in filler_tars:
+        controller.add_segment(h_filler, name, {"location": tar, "numDocs": n})
+    _wait(lambda: (
+        sorted(server.debug_storage()["tables"]
+               .get(ST, {}).get("cold", [])) == stats_names
+        and sorted(server.debug_storage()["tables"]
+                   .get(FL, {}).get("resident", [])) == filler_names),
+        msg="stats demoted cold / filler resident")
+    return SimpleNamespace(
+        store=store, controller=controller, server=server, broker=broker,
+        stats_names=stats_names, filler_names=filler_names,
+        stats_cols=stats_cols, filler_cols=filler_cols,
+        budget_bytes=budget_bytes, max_seg=max_seg)
+
+
+# -- cluster: cold registration, lazy warm, evict/re-fetch --------------------
+
+
+def test_cold_replica_routes_and_first_query_warms(tmp_path):
+    c = _cold_stats_cluster(tmp_path, stats_segs=2, filler_segs=2)
+    try:
+        # cold replicas are still advertised ONLINE (metadata-only routing)
+        view = c.store.get(f"/EXTERNALVIEW/{ST}") or {}
+        assert sorted(view) == c.stats_names
+        for seg in c.stats_names:
+            assert view[seg].get("S0") == "ONLINE"
+        dbg = c.server.debug_storage()
+        assert dbg["coldSegments"] == 2
+        assert dbg["residentSegments"] == 2
+        assert sorted(dbg["warming"]) == []
+        for key in ("budgetBytes", "bytesUsed", "residentDirs", "evictions",
+                    "fetches", "pendingRelease", "tierProbes"):
+            assert key in dbg["localTier"], key
+
+        cold0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENT_COLD_LOADS)
+        evict0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENT_EVICTIONS)
+        verify0 = loader_mod.VERIFY_CALLS
+        resp = c.broker.execute_sql(
+            "SET resultCache=false; " + GROUP_SQL.format(t="stats"))
+        assert _full(resp), resp.exceptions
+        assert _rows(resp) == _team_sums(c.stats_cols)
+        # the query lazily warmed both cold stats segments (re-verifying
+        # their CRCs on the way in) and pushed filler out to make room
+        assert SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENT_COLD_LOADS) - cold0 >= 2
+        assert SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENT_EVICTIONS) - evict0 >= 2
+        assert loader_mod.VERIFY_CALLS - verify0 >= 2
+        for seg in c.stats_names:
+            assert c.server._tier.resident(ST, seg)
+        # disk never exceeded budget + one in-flight fetch
+        st = c.server._tier.stats()
+        assert st["bytesUsed"] <= c.budget_bytes + c.max_seg
+    finally:
+        c.server.stop()
+
+
+def test_evict_refetch_ping_pong_stays_exact(tmp_path):
+    """Alternate strict queries between two tables that cannot both fit:
+    every round re-fetches evicted segments and must stay bit-identical —
+    evict → cold → re-fetchable, never evict → gone."""
+    c = _cold_stats_cluster(tmp_path, stats_segs=2, filler_segs=2)
+    want_stats = _team_sums(c.stats_cols)
+    want_filler = _team_sums(c.filler_cols)
+    try:
+        evict0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENT_EVICTIONS)
+        for _round in range(2):
+            for table, want in (("stats", want_stats),
+                                ("filler", want_filler)):
+                resp = c.broker.execute_sql(
+                    "SET resultCache=false; " + GROUP_SQL.format(t=table))
+                assert _full(resp), (table, resp.exceptions)
+                assert _rows(resp) == want, table
+        assert SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENT_EVICTIONS) - evict0 >= 4
+        st = c.server._tier.stats()
+        assert st["bytesUsed"] <= c.budget_bytes + c.max_seg
+    finally:
+        c.server.stop()
+
+
+def test_warm_resident_path_zero_disk_probes(tmp_path):
+    """Once a table is resident, repeat queries touch the tier only in
+    memory: TIER_PROBES (fetch/size-walk/rmtree counter) and CRC verify
+    calls must not move at all."""
+    store = PropertyStore()
+    controller = ClusterController(store, instance_id="ctl1")
+    tars, cols_list = [], []
+    for i in range(2):
+        tar, _nbytes, cols = _build_tar(tmp_path, "stats", f"s{i}", seed=i)
+        tars.append((f"s{i}", tar))
+        cols_list.append(cols)
+    server = ServerInstance(store, "S0", backend="host",
+                            local_storage_mb=100.0)
+    server.start()
+    broker = Broker(store)
+    controller.add_schema(_schema("stats").to_json())
+    handle = controller.create_table({"tableName": "stats", "replication": 1})
+    for name, tar in tars:
+        controller.add_segment(handle, name, {"location": tar, "numDocs": 250})
+    try:
+        sql = "SET resultCache=false; " + GROUP_SQL.format(t="stats")
+        resp = broker.execute_sql(sql)
+        assert _full(resp) and _rows(resp) == _team_sums(cols_list)
+        probes0 = tier_mod.TIER_PROBES
+        verify0 = loader_mod.VERIFY_CALLS
+        for _ in range(3):
+            resp = broker.execute_sql(sql)
+            assert _full(resp) and _rows(resp) == _team_sums(cols_list)
+        assert tier_mod.TIER_PROBES == probes0
+        assert loader_mod.VERIFY_CALLS == verify0
+    finally:
+        server.stop()
+
+
+# -- cluster: storage.fetch fault point ---------------------------------------
+
+
+def test_cold_fetch_corruption_quarantines_then_repairs(tmp_path):
+    """A corrupt cold fetch follows the rebalance.move contract: the
+    replica quarantines (never served), auto-repair re-fetches a FRESH
+    copy, and the next strict query is exact."""
+    c = _cold_stats_cluster(tmp_path, stats_segs=1, filler_segs=1)
+    want = _team_sums(c.stats_cols)
+    try:
+        q0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENTS_QUARANTINED)
+        r0 = SERVER_METRICS.meter_count(ServerMeter.SEGMENT_REPAIRS)
+        faults.FAULTS.arm("storage.fetch", kind="corrupt", times=1)
+        # first touch races quarantine+repair: may degrade, never lie
+        resp = c.broker.execute_sql(
+            "SET allowPartialResults=true; SET resultCache=false; "
+            + GROUP_SQL.format(t="stats"))
+        if _full(resp):
+            assert _rows(resp) == want
+        assert faults.FAULTS.fired("storage.fetch") == 1
+        _wait(lambda: SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENTS_QUARANTINED) > q0, msg="quarantine")
+        _wait(lambda: c.server.debug_storage()["tables"]
+              .get(ST, {}).get("resident", []) == c.stats_names,
+              msg="repair re-fetch")
+        assert SERVER_METRICS.meter_count(
+            ServerMeter.SEGMENT_REPAIRS) - r0 >= 1
+        resp = c.broker.execute_sql(
+            "SET resultCache=false; " + GROUP_SQL.format(t="stats"))
+        assert _full(resp), resp.exceptions
+        assert _rows(resp) == want
+        # satellite check: converge eager loads, the cold warm attempt and
+        # the repair's fresh copy all went through ONE tier (its fetch
+        # counter saw every download)
+        assert c.server._tier.stats()["fetches"] >= 4
+    finally:
+        c.server.stop()
+
+
+def test_delayed_cold_fetch_degrades_and_partial_is_never_cached(tmp_path):
+    """A slow deep store + tight timeoutMs yields a FLAGGED partial
+    (coldSegmentsWarming in the response) and the result cache must not
+    remember it: the re-issued identical query returns full exact rows."""
+    c = _cold_stats_cluster(tmp_path, stats_segs=1, filler_segs=1)
+    want = _team_sums(c.stats_cols)
+    try:
+        faults.FAULTS.arm("storage.fetch", kind="delay", times=1,
+                          delay_s=0.6)
+        sql = ("SET allowPartialResults=true; SET timeoutMs=150; "
+               + GROUP_SQL.format(t="stats"))
+        resp = c.broker.execute_sql(sql)  # result cache stays ON
+        assert getattr(resp, "partial_result", False)
+        assert getattr(resp, "cold_segments_warming", 0) >= 1
+        _wait(lambda: c.server.debug_storage()["tables"]
+              .get(ST, {}).get("resident", []) == c.stats_names,
+              msg="background warm finishing")
+        resp = c.broker.execute_sql(sql)
+        assert _full(resp), resp.exceptions
+        assert _rows(resp) == want
+    finally:
+        c.server.stop()
+
+
+# -- cluster: workload-driven prefetch ----------------------------------------
+
+
+def test_prefetcher_nudges_hot_table_warm(tmp_path):
+    c = _cold_stats_cluster(tmp_path, stats_segs=1, filler_segs=1)
+    try:
+        hits0 = SERVER_METRICS.meter_count(ServerMeter.PREFETCH_HITS)
+        c.store.set("/BROKERSTATE/Broker_pf",
+                    {"tableCostsMs": {"stats": 42.0}})
+        pf = StoragePrefetcher(c.store)
+        out = pf()
+        assert "stats" in out["nudged"]
+        assert c.store.get("/PREFETCH/stats") is not None
+        # the server's /PREFETCH watch marks the table hot and warms it
+        # in the background — before any query lands
+        _wait(lambda: c.server.debug_storage()["tables"]
+              .get(ST, {}).get("resident", []) == c.stats_names,
+              msg="prefetch warm")
+        _wait(lambda: SERVER_METRICS.meter_count(
+            ServerMeter.PREFETCH_HITS) > hits0, msg="prefetch hit meter")
+        assert "stats" in c.server._tier.stats()["hotTables"]
+        # nudges fire on hot-set ENTRY only: a second tick with the same
+        # beacons is silent
+        assert pf()["nudged"] == []
+        resp = c.broker.execute_sql(
+            "SET resultCache=false; " + GROUP_SQL.format(t="stats"))
+        assert _full(resp) and _rows(resp) == _team_sums(c.stats_cols)
+    finally:
+        c.server.stop()
+
+
+# -- tier unit: refcount lifecycle --------------------------------------------
+
+
+def _unit_tar(tmp_path, table: str, name: str, seed: int):
+    tar, nbytes, _cols = _build_tar(tmp_path, table, name, seed, n=120)
+    return tar, nbytes
+
+
+def test_tier_hold_and_zombie_refcounts(tmp_path):
+    """acquire(hold=True) protects the fetch→load window; a fresh
+    re-fetch retires the old copy as a zombie that survives until its
+    readers drain — no ENOENT under a pinned scan, ever."""
+    tar_a, nbytes = _unit_tar(tmp_path, "t", "a", seed=1)
+    tier = SegmentTierManager("unit0",
+                              budget_mb=1.5 * nbytes / (1024 * 1024))
+    try:
+        path1 = tier.acquire("t", "a", tar_a, hold=True)
+        assert os.path.isdir(path1)
+        tier.release("t", "a")
+        assert tier.resident("t", "a")
+        handles = tier.pin("t", ["a"])
+        assert len(handles) == 1
+        # repair-style fresh re-fetch while a reader is on the old copy
+        path2 = tier.acquire("t", "a", tar_a, fresh=True, hold=True)
+        assert path2 != path1
+        assert os.path.isdir(path1) and os.path.isdir(path2)
+        st = tier.stats()
+        assert st["pendingRelease"] == 1
+        assert st["bytesUsed"] == nbytes  # zombie bytes accounted separately
+        assert st["pendingReleaseBytes"] == nbytes
+        tier.release("t", "a")            # drops the NEW copy's load ref
+        assert tier.resident("t", "a") and os.path.isdir(path1)
+        tier.unpin(handles)               # last reader off the zombie
+        assert not os.path.isdir(path1)
+        assert tier.stats()["pendingRelease"] == 0
+        # releasing with no ref outstanding is a no-op, never negative
+        tier.release("t", "a")
+        assert tier.resident("t", "a")
+    finally:
+        tier.close()
+
+
+def test_tier_budget_smaller_than_one_segment_still_loads(tmp_path):
+    """The held load ref means a budget below one segment width degrades
+    to single-slot churn instead of self-evicting the copy being loaded
+    (which would ENOENT every fetch forever)."""
+    tar_a, nbytes = _unit_tar(tmp_path, "t", "a", seed=1)
+    tar_b, _ = _unit_tar(tmp_path, "t", "b", seed=2)
+    tier = SegmentTierManager("unit1",
+                              budget_mb=0.5 * nbytes / (1024 * 1024))
+    evicted = []
+    tier.evict_cb = lambda table, seg: evicted.append((table, seg))
+    try:
+        path_a = tier.acquire("t", "a", tar_a, hold=True)
+        assert os.path.isdir(path_a)      # over budget, but held by loader
+        tier.release("t", "a")
+        assert tier.resident("t", "a")    # release alone never evicts
+        path_b = tier.acquire("t", "b", tar_b, hold=True)
+        assert os.path.isdir(path_b)
+        assert not tier.resident("t", "a")  # LRU slot handed over
+        assert ("t", "a") in evicted
+        tier.release("t", "b")
+        assert tier.stats()["residentDirs"] == 1
+    finally:
+        tier.close()
+
+
+def test_tier_pinned_table_survives_pressure(tmp_path):
+    """Explicitly pinned tables are evicted only as a last resort: under
+    repeated byte pressure the victims are always the cool tables."""
+    tar_a, nbytes = _unit_tar(tmp_path, "A", "a", seed=1)
+    tar_b, _ = _unit_tar(tmp_path, "B", "b", seed=2)
+    tar_c, _ = _unit_tar(tmp_path, "C", "c", seed=3)
+    tier = SegmentTierManager("unit2",
+                              budget_mb=2.5 * nbytes / (1024 * 1024))
+    try:
+        tier.acquire("A", "a", tar_a, hold=True)
+        tier.release("A", "a")
+        tier.pin_table("A")
+        tier.acquire("B", "b", tar_b, hold=True)
+        tier.release("B", "b")            # A+B fit: no eviction yet
+        assert tier.stats()["evictions"] == 0
+        tier.acquire("C", "c", tar_c, hold=True)
+        tier.release("C", "c")            # pressure: cool B goes, not A
+        assert tier.resident("A", "a")
+        assert not tier.resident("B", "b")
+        tier.acquire("B", "b", tar_b, hold=True)
+        tier.release("B", "b")            # pressure again: C goes, not A
+        assert tier.resident("A", "a")
+        assert not tier.resident("C", "c")
+        assert tier.stats()["evictions"] == 2
+        assert tier.stats()["pinnedTables"] == ["A"]
+        tier.unpin_table("A")
+        assert tier.stats()["pinnedTables"] == []
+    finally:
+        tier.close()
+
+
+# -- soak smoke (tier-1) ------------------------------------------------------
+
+
+def test_tiered_soak_smoke():
+    """The full churn loop — tarred deep store, budgeted servers, mixed
+    query shapes racing cold warms, disk-bound checks, final strict
+    bit-identical pass — at a size that stays well under 10 seconds."""
+    from pinot_tpu.tools.soak import soak_tiered
+
+    # 4 tables across 2 budgeted servers: each server hosts ~2 tables of
+    # bytes against a 1.2-table budget, so the run must churn
+    res = soak_tiered(seconds=0.5, seed=1, n_tables=4,
+                      segments_per_table=2, rows_per_segment=120)
+    assert res["exact"] > 0
+    assert res["cold_loads"] > 0 and res["evictions"] > 0
+    assert res["final_checks"] == 16
+    assert res["max_tier_bytes_used"] > 0
