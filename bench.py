@@ -316,10 +316,24 @@ def _probe_accelerator():
                 time.sleep(1.0)
             rc = proc.poll()
             if rc == 0:
-                report["status"] = "ok"
                 of.seek(0)
-                report["devices"] = of.read()[-2000:].decode(
+                devices = of.read()[-2000:].decode(
                     errors="replace").strip()
+                report["devices"] = devices
+                if "CpuDevice" in devices and "TpuDevice" not in devices:
+                    # jax quietly fell back to CPU inside the probe
+                    # (r06 false positive: rc=0, devices=[CpuDevice(id=0)]
+                    # → the bench ran 100M rows with every child burning
+                    # its budget on doomed libtpu init retries). A
+                    # CPU-only device list is a FAILED accelerator probe.
+                    print(f"[bench] probe attempt {attempt} came back "
+                          f"CPU-only ({devices}); no accelerator",
+                          file=sys.stderr)
+                    report["status"] = "cpu_only"
+                    report["attempts"].append(
+                        {"rc": 0, "stderr_tail": f"cpu-only: {devices}"})
+                    return False, report
+                report["status"] = "ok"
                 return True, report
             if rc is None:  # hung: abandon (no kill — lease-wedge hazard)
                 hung_attempts += 1

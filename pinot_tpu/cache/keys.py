@@ -33,7 +33,7 @@ import numpy as np
 EXECUTION_ONLY_OPTIONS = frozenset({
     "segmentbatch", "devicecombine", "segmentcache", "resultcache",
     "trace", "timeoutms", "usemultistageengine", "meshexecution",
-    "devicejoin",
+    "devicejoin", "coalesce",
 })
 
 # Lifetime fingerprint computations in this process — the perf guard

@@ -840,6 +840,7 @@ class Broker:
                      "scatter_retries": 0, "hedged_requests": 0,
                      "hedge_wins": 0, "corrupt_shards_retried": 0,
                      "cold_segments_warming": 0,
+                     "num_coalesced_queries": 0, "coalesce_wait_ms": 0.0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -913,6 +914,8 @@ class Broker:
             num_hedge_wins=stats_sum["hedge_wins"],
             num_corrupt_shards_retried=stats_sum["corrupt_shards_retried"],
             cold_segments_warming=stats_sum.get("cold_segments_warming", 0),
+            num_coalesced_queries=stats_sum.get("num_coalesced_queries", 0),
+            coalesce_wait_ms=stats_sum.get("coalesce_wait_ms", 0.0),
         )
         if partial_notes:
             # degraded gather: merged answer of the responding servers only,
@@ -1016,6 +1019,7 @@ class Broker:
                      "scatter_retries": 0, "hedged_requests": 0,
                      "hedge_wins": 0, "corrupt_shards_retried": 0,
                      "cold_segments_warming": 0,
+                     "num_coalesced_queries": 0, "coalesce_wait_ms": 0.0,
                      "server_traces": [],
                      "servers_queried": [], "servers_responded": [],
                      "partial_exceptions": []}
@@ -1148,7 +1152,8 @@ class Broker:
             stats_sum["num_segments_processed"] += st["num_segments_processed"]
             stats_sum["num_segments_pruned"] += st["num_segments_pruned"]
             for k in ("num_device_dispatches", "num_compiles",
-                      "num_segments_cache_hit", "num_segments_cache_miss"):
+                      "num_segments_cache_hit", "num_segments_cache_miss",
+                      "num_coalesced_queries", "coalesce_wait_ms"):
                 stats_sum[k] += st.get(k, 0)
             # tiered storage: segments the server reported COLD (still
             # warming) ride the missing-segments retry below, but are

@@ -303,11 +303,13 @@ class BrokerRestServer(_RestServer):
         high-water marks and eviction attribution. Served from the broker
         because this build co-locates broker and servers in one process;
         the server REST exposes the same payload per instance."""
+        from ..engine import aot_cache
         from ..engine.compile_registry import COMPILE_REGISTRY
         from ..segment.device_cache import GLOBAL_DEVICE_CACHE
 
         out = COMPILE_REGISTRY.snapshot()
         out["hbm"] = GLOBAL_DEVICE_CACHE.hbm_telemetry()
+        out["aot"] = aot_cache.stats()
         return 200, out
 
     def _cache_clear(self):
@@ -810,11 +812,16 @@ class ServerRestServer(_RestServer):
         """Per-instance compile & HBM telemetry — same payload shape as
         the broker's GET /debug/compiles (this build shares the process,
         so the registries are the same objects)."""
+        from ..engine import aot_cache
         from ..engine.compile_registry import COMPILE_REGISTRY
         from ..segment.device_cache import GLOBAL_DEVICE_CACHE
 
         out = COMPILE_REGISTRY.snapshot()
         out["hbm"] = GLOBAL_DEVICE_CACHE.hbm_telemetry()
+        out["aot"] = aot_cache.stats()
+        coalescer = getattr(getattr(self.server, "executor", None),
+                            "coalescer", None)
+        out["coalesce"] = coalescer.snapshot() if coalescer else {}
         return 200, out
 
     def _kill_query(self, query_id: str):

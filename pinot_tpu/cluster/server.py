@@ -697,6 +697,14 @@ class ServerInstance:
                                  args=(nwt, cold),
                                  daemon=True,
                                  name=f"prefetch-{nwt}").start()
+        # the same nudge pre-warms the table's AOT-persisted executables:
+        # the prefetcher predicts traffic is about to land here, so deserialize
+        # its top family programs off the serving path (engine/aot_cache.py)
+        from ..engine.aot_cache import enabled as _aot_enabled, prewarm_table
+        if _aot_enabled():
+            threading.Thread(target=prewarm_table, args=(table,),
+                             daemon=True,
+                             name=f"aot-prewarm-{table}").start()
 
     def _prefetch_warm(self, table: str, names: list) -> None:
         for seg in names:

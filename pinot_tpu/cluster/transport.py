@@ -283,11 +283,18 @@ class RpcServer:
 
 
 class RpcClient:
-    """Pooled single connection per target with reconnect-on-failure."""
+    """Pooled connections per target with reconnect-on-failure.
+
+    Up to ``pool_size`` sockets (``PINOT_TPU_RPC_POOL``, default 8) may
+    carry in-flight calls to one target concurrently. A single pooled
+    socket would serialize concurrent queries from different broker
+    threads on the wire — the server would only ever see one query at a
+    time, so cross-query coalescing could never form a group."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  ssl_context: Optional[ssl.SSLContext] = None,
-                 connect_timeout: Optional[float] = None):
+                 connect_timeout: Optional[float] = None,
+                 pool_size: Optional[int] = None):
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -297,9 +304,18 @@ class RpcClient:
             env = os.environ.get("PINOT_TPU_RPC_CONNECT_S")
             connect_timeout = float(env) if env else timeout
         self.connect_timeout = connect_timeout
+        if pool_size is None:
+            pool_size = int(os.environ.get("PINOT_TPU_RPC_POOL", 8))
+        self.pool_size = max(1, pool_size)
         self._ssl = ssl_context
-        self._sock: Optional[socket.socket] = None
+        self._free: list = []  # idle sockets, checkout/checkin under _lock
         self._lock = threading.Lock()
+        # caps concurrent in-flight calls at pool_size; excess callers
+        # queue here instead of growing the socket count without bound
+        self._sem = threading.BoundedSemaphore(self.pool_size)
+        # close() bumps the generation: sockets checked out under an
+        # older generation are closed on checkin instead of re-pooled
+        self._gen = 0
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection((self.host, self.port),
@@ -351,28 +367,47 @@ class RpcClient:
         if faults.ACTIVE:
             corruption = self._fire_fault("transport.call")
         attempts = (0, 1) if retry else (1,)
-        with self._lock:
+        self._sem.acquire()
+        try:
+            sock = gen = None
             for attempt in attempts:
                 try:
-                    if self._sock is None:
-                        self._sock = self._connect()
+                    if sock is None:
+                        if attempt == 0:
+                            sock, gen = self._checkout()
+                        else:
+                            # the pooled socket just failed — every idle
+                            # peer from the same era is suspect (server
+                            # restart), so retry on a FRESH connection
+                            with self._lock:
+                                gen = self._gen
+                            sock = self._connect()
                     if timeout is not None:
-                        self._sock.settimeout(timeout)
+                        sock.settimeout(timeout)
                     try:
-                        _send_frame(self._sock, request)
-                        status, payload = _recv_frame(self._sock)
+                        _send_frame(sock, request)
+                        status, payload = _recv_frame(sock)
                     finally:
-                        if timeout is not None and self._sock is not None:
+                        if timeout is not None:
                             try:
-                                self._sock.settimeout(self.timeout)
+                                sock.settimeout(self.timeout)
                             except OSError:
                                 pass
+                    self._checkin(sock, gen)
                     break
                 except (TransportError, OSError, EOFError):
-                    self.close_nolock()
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        sock = None
+                    self._flush_free()
                     if attempt == 1:
                         raise TransportError(
                             f"rpc to {self.host}:{self.port} failed")
+        finally:
+            self._sem.release()
         if status == "error":
             raise RemoteError(payload)
         if corruption is not None:
@@ -417,14 +452,40 @@ class RpcClient:
             except OSError:
                 pass
 
-    def close_nolock(self) -> None:
-        if self._sock is not None:
+    def _checkout(self):
+        """Pop an idle socket (or dial a fresh one) plus the generation
+        it belongs to. May raise OSError from connect."""
+        with self._lock:
+            if self._free:
+                return self._free.pop(), self._gen
+            gen = self._gen
+        return self._connect(), gen
+
+    def _checkin(self, sock: socket.socket, gen) -> None:
+        with self._lock:
+            if gen == self._gen and len(self._free) < self.pool_size:
+                self._free.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _flush_free(self) -> None:
+        with self._lock:
+            free, self._free = self._free, []
+        for s in free:
             try:
-                self._sock.close()
+                s.close()
             except OSError:
                 pass
-            self._sock = None
 
     def close(self) -> None:
         with self._lock:
-            self.close_nolock()
+            self._gen += 1
+            free, self._free = self._free, []
+        for s in free:
+            try:
+                s.close()
+            except OSError:
+                pass

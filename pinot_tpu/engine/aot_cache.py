@@ -1,0 +1,441 @@
+"""AOT executable cache — compile-free cold starts across restarts.
+
+Reference analogue: none in Pinot (JVM servers JIT-warm per process); the
+problem is TPU-specific — the first query of every executable family
+after a restart or traffic shift eats a full XLA compile in its tail.
+This module persists compiled family programs via JAX AOT serialization
+(``jax.export``): on a compile-guard miss the freshly-compiled family is
+exported (StableHLO) and written to a byte-budgeted on-disk cache keyed
+by the PR-11 ``family_fingerprint`` plus an environment tag (jaxlib
+version, device kind/platform, mesh shape). At segment load / prefetch
+time a table's top families are pre-warmed: deserialize → AOT-compile
+off the serving path → install a ready callable the dispatcher picks up
+with one dict lookup, so the first QUERY of the family reports
+``numCompiles == 0``.
+
+Safety contract: a persisted artifact is refused — and the dispatcher
+falls back to a fresh compile — on any mismatch (jaxlib/device/mesh env
+tag, payload checksum, deserialization failure) or runtime call failure.
+Never a wrong answer, never a crash; the worst case is the compile that
+would have happened anyway.
+
+Cost discipline: the hot dispatch path pays one ``if AOT_READY:`` truth
+test (empty dict → falsy) when the cache is cold/disabled, one dict
+lookup when warm. Export/persist work happens only next to a real XLA
+compile; deserialize+compile work happens only at prewarm time.
+
+Knobs: ``PINOT_TPU_AOT_CACHE_DIR`` (unset = disabled),
+``PINOT_TPU_AOT_CACHE_MB`` (byte budget, default 256),
+``PINOT_TPU_AOT_PREWARM_TOP_K`` (families prewarmed per table, default 4).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+
+from ..spi import faults
+
+log = logging.getLogger(__name__)
+
+_FORMAT_VERSION = 1
+
+# gkey → ready callable (an AOT-compiled jax.stages.Compiled). Plain dict:
+# reads are GIL-atomic; all writes happen under _LOCK. The dispatcher
+# (engine/executor.py) guards with `if AOT_READY:` so the disabled/cold
+# case costs a falsy truth test.
+AOT_READY: dict = {}
+
+_LOCK = threading.Lock()
+_WARN_ONCE: set = set()
+
+# thread-local table attribution: execute_segments stamps the current
+# table so persisted artifacts can be prewarmed per table later
+_TLS = threading.local()
+
+
+def set_current_table(table) -> None:
+    _TLS.table = table
+
+
+def current_table():
+    return getattr(_TLS, "table", None)
+
+
+def enabled() -> bool:
+    return bool(os.environ.get("PINOT_TPU_AOT_CACHE_DIR"))
+
+
+def cache_dir():
+    return os.environ.get("PINOT_TPU_AOT_CACHE_DIR")
+
+
+def _budget_bytes() -> int:
+    return int(float(os.environ.get("PINOT_TPU_AOT_CACHE_MB", 256))
+               * 1024 * 1024)
+
+
+def env_tag() -> dict:
+    """The executable-validity environment: a persisted artifact is only
+    ever deserialized under the exact (jax/jaxlib version, device kind,
+    platform, local mesh shape) it was exported under."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    from ..parallel.mesh import mesh_device_count
+
+    return {
+        "jaxlib": f"{jax.__version__}/{jaxlib.__version__}",
+        "deviceKind": str(dev.device_kind),
+        "platform": str(dev.platform),
+        "meshShape": [int(mesh_device_count())],
+    }
+
+
+def _env_hash(tag: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(tag, sort_keys=True).encode()).hexdigest()
+
+
+def _artifact_name(fingerprint: str, tag: dict) -> str:
+    return f"{fingerprint[:24]}-{_env_hash(tag)[:8]}.aot"
+
+
+# -- manifest -----------------------------------------------------------------
+
+
+def _manifest_path(d: str) -> str:
+    return os.path.join(d, "manifest.json")
+
+
+def _load_manifest(d: str) -> dict:
+    try:
+        with open(_manifest_path(d)) as f:
+            m = json.load(f)
+        if isinstance(m, dict) and isinstance(m.get("files"), dict):
+            return m
+    except (OSError, ValueError):
+        pass
+    return {"files": {}}
+
+
+def _save_manifest(d: str, manifest: dict) -> None:
+    tmp = _manifest_path(d) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, _manifest_path(d))
+
+
+# -- persist (cold path, next to a real XLA compile) --------------------------
+
+
+def _specs_of(example) -> tuple:
+    """ShapeDtypeStruct pytree mirroring the (arrays, params, num_docs)
+    example — shapes/dtypes read from attributes, never materializing a
+    device array on host."""
+    import jax
+
+    def spec(a):
+        return jax.ShapeDtypeStruct(tuple(np.shape(a)),
+                                    np.dtype(getattr(a, "dtype", None)
+                                             or np.asarray(a).dtype))
+
+    arrays, params, num_docs = example
+    return (tuple(spec(a) for a in arrays),
+            tuple(spec(p) for p in params),
+            spec(num_docs))
+
+
+def _specs_json(specs) -> list:
+    arrays, params, num_docs = specs
+    enc = lambda s: [list(s.shape), str(np.dtype(s.dtype))]  # noqa: E731
+    return [[enc(s) for s in arrays], [enc(s) for s in params],
+            enc(num_docs)]
+
+
+def _specs_from_json(j) -> tuple:
+    import jax
+
+    dec = lambda e: jax.ShapeDtypeStruct(  # noqa: E731
+        tuple(e[0]), np.dtype(e[1]))
+    return (tuple(dec(e) for e in j[0]), tuple(dec(e) for e in j[1]),
+            dec(j[2]))
+
+
+def _family_fn(kind: str, program, padded: int, packed: bool, fused: str,
+               lut_meta: tuple):
+    """The (arrays, params, num_docs) closure over the family's statics —
+    the exact computation the dispatcher runs, so a deserialized artifact
+    is bit-identical to the fresh-compile path."""
+    from ..ops import kernels
+
+    if kind == "batch":
+        def fn(arrays, params, num_docs):
+            return kernels.run_program_batch(program, arrays, params,
+                                             num_docs, padded, packed=packed)
+    else:
+        def fn(arrays, params, num_docs):
+            return kernels.run_program(program, arrays, params, num_docs,
+                                       padded, packed=packed, fused=fused,
+                                       fused_lut_meta=lut_meta)
+    return fn
+
+
+def on_compile(gkey, fingerprint, compile_ms: float, family: dict,
+               kind: str, program, padded: int, packed: bool = False,
+               fused: str = "", lut_meta: tuple = (),
+               example=None) -> bool:
+    """Persist hook, called from the compile-registry cold path right
+    after a fresh XLA compile. Exports the family executable and writes
+    it to the on-disk cache if the CompileRegistry's cost×reuse ranking
+    (score at compile time: the compile cost itself) wins the byte
+    budget. Returns True when an artifact was written. Never raises."""
+    if not enabled() or fingerprint is None or example is None:
+        return False
+    d = cache_dir()
+    try:
+        tag = env_tag()
+        name = _artifact_name(fingerprint, tag)
+        path = os.path.join(d, name)
+        with _LOCK:
+            manifest = _load_manifest(d)
+            if name in manifest["files"] and os.path.exists(path):
+                return False  # already persisted under this env
+        import jax
+        from jax import export as jax_export
+
+        specs = _specs_of(example)
+        fn = _family_fn(kind, program, padded, packed, fused, lut_meta)
+        exported = jax_export.export(jax.jit(fn))(*specs)
+        payload = exported.serialize()
+        blob = pickle.dumps({
+            "version": _FORMAT_VERSION,
+            "fingerprint": fingerprint,
+            "envTag": tag,
+            "gkey": gkey,
+            "argSpecs": _specs_json(specs),
+            "payload": payload,
+            "payloadSha": hashlib.sha256(payload).hexdigest(),
+            "family": family,
+            "table": current_table(),
+            "score": round(float(compile_ms), 3),
+        })
+        with _LOCK:
+            manifest = _load_manifest(d)
+            if not _make_room(d, manifest, len(blob), float(compile_ms)):
+                return False
+            os.makedirs(d, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+            manifest["files"][name] = {
+                "bytes": len(blob),
+                "table": current_table(),
+                "fingerprint": fingerprint,
+                "score": round(float(compile_ms), 3),
+                "savedAtMs": int(time.time() * 1000),
+            }
+            _save_manifest(d, manifest)
+        return True
+    except Exception as e:
+        _warn_once("persist", "AOT persist failed (%s: %s); family stays "
+                   "jit-only", type(e).__name__, e)
+        return False
+
+
+def _make_room(d: str, manifest: dict, need: int, score: float) -> bool:
+    """Evict lowest-score artifacts until ``need`` bytes fit the budget.
+    Only artifacts scoring BELOW the incoming family are evictable —
+    the CompileRegistry ranking decides what persists. Caller holds
+    _LOCK."""
+    budget = _budget_bytes()
+    if need > budget:
+        return False
+    files = manifest["files"]
+    total = sum(int(m.get("bytes", 0)) for m in files.values())
+    if total + need <= budget:
+        return True
+    evictable = sorted(
+        ((m.get("score", 0.0), name) for name, m in files.items()
+         if float(m.get("score", 0.0)) < score))
+    for _, name in evictable:
+        try:
+            os.unlink(os.path.join(d, name))
+        except OSError:
+            pass
+        total -= int(files.pop(name).get("bytes", 0))
+        if total + need <= budget:
+            return True
+    return total + need <= budget
+
+
+# -- load / prewarm (off the serving path) ------------------------------------
+
+
+def _refuse(reason: str, name: str):
+    from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+    SERVER_METRICS.add_meter(ServerMeter.AOT_CACHE_MISSES)
+    _warn_once(("refuse", reason), "AOT artifact %s refused (%s); falling "
+               "back to fresh compile", name, reason)
+    return None
+
+
+def load_artifact(path: str, expect_tag: dict = None):
+    """Deserialize + AOT-compile one artifact and install its ready
+    callable. Returns the gkey on success, None on any refusal (corrupt
+    file, checksum, env mismatch, deserialization failure). Never
+    raises."""
+    name = os.path.basename(path)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return _refuse("unreadable", name)
+    if faults.ACTIVE:
+        data = faults.corrupt_at("aot.load", data, path=name)
+    try:
+        blob = pickle.loads(data)
+        if blob.get("version") != _FORMAT_VERSION:
+            return _refuse("format version", name)
+        payload = blob["payload"]
+        if hashlib.sha256(payload).hexdigest() != blob["payloadSha"]:
+            return _refuse("payload checksum", name)
+        tag = expect_tag if expect_tag is not None else env_tag()
+        if blob["envTag"] != tag:
+            mism = [k for k in tag if blob["envTag"].get(k) != tag[k]]
+            return _refuse(f"env mismatch ({','.join(mism) or '?'})", name)
+        import jax
+        from jax import export as jax_export
+
+        exported = jax_export.deserialize(bytearray(payload))
+        specs = _specs_from_json(blob["argSpecs"])
+        compiled = jax.jit(exported.call).lower(*specs).compile()
+        gkey = blob["gkey"]
+    except Exception as e:
+        return _refuse(f"{type(e).__name__}: {e}", name)
+    _install(gkey, compiled, blob["fingerprint"], blob.get("family") or {})
+    return gkey
+
+
+def _install(gkey, compiled, fingerprint: str, family: dict) -> None:
+    """Make the family compile-free: ready callable for the dispatcher,
+    compile-guard seeded so the first query counts numCompiles == 0, and
+    the compile registry taught the gkey→fingerprint edge so warm
+    dispatches keep registering without an IR walk."""
+    from .compile_registry import COMPILE_REGISTRY
+    from .executor import _GUARD
+
+    with _LOCK:
+        AOT_READY[gkey] = compiled
+    _GUARD.note(gkey)
+    COMPILE_REGISTRY.note_preloaded(gkey, fingerprint, family)
+
+
+def _raw_table(name) -> str:
+    """Normalize a type-suffixed internal name (``events_OFFLINE``) to the
+    raw broker-facing name artifacts are stamped with, so segment-load
+    prewarm (internal name) finds artifacts persisted at query time (raw
+    name)."""
+    s = str(name)
+    for suffix in ("_OFFLINE", "_REALTIME"):
+        if s.endswith(suffix):
+            return s[: -len(suffix)]
+    return s
+
+
+def prewarm_table(table, top_k: int = None) -> dict:
+    """Deserialize + warm the table's top-scored persisted families
+    (segment-load / prefetch hook). All compile cost lands HERE, off the
+    serving path, timed as aotPrewarmMs."""
+    if not enabled():
+        return {"loaded": 0, "refused": 0}
+    d = cache_dir()
+    k = int(top_k if top_k is not None else
+            os.environ.get("PINOT_TPU_AOT_PREWARM_TOP_K", 4))
+    t0 = time.perf_counter()
+    want = None if table is None else _raw_table(table)
+    with _LOCK:
+        manifest = _load_manifest(d)
+        cand = sorted(
+            ((float(m.get("score", 0.0)), name)
+             for name, m in manifest["files"].items()
+             if want is None or _raw_table(m.get("table")) == want),
+            reverse=True)[:k]
+    loaded = refused = 0
+    tag = env_tag()
+    for _, name in cand:
+        if load_artifact(os.path.join(d, name), expect_tag=tag) is not None:
+            loaded += 1
+        else:
+            refused += 1
+    ms = round((time.perf_counter() - t0) * 1000, 3)
+    if loaded or refused:
+        from ..spi.metrics import SERVER_METRICS, ServerTimer
+
+        SERVER_METRICS.update_timer(ServerTimer.AOT_PREWARM_MS, ms)
+    return {"loaded": loaded, "refused": refused, "prewarmMs": ms}
+
+
+def aot_call(gkey, arrays, params, num_docs):
+    """Hot-path entry: run the family's ready executable if one is
+    installed. Returns the output pytree, or None (caller falls back to
+    the jit path). A runtime failure evicts the callable — the family
+    quietly reverts to jit-compiled dispatch."""
+    fn = AOT_READY.get(gkey)
+    if fn is None:
+        return None
+    try:
+        outs = fn(arrays, params, num_docs)
+    except Exception as e:
+        with _LOCK:
+            AOT_READY.pop(gkey, None)
+        _warn_once(("call", type(e).__name__),
+                   "AOT executable call failed (%s: %s); reverting family "
+                   "to jit dispatch", type(e).__name__, e)
+        return None
+    from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+    SERVER_METRICS.add_meter(ServerMeter.AOT_CACHE_HITS)
+    return outs
+
+
+def stats() -> dict:
+    """Scrape-time rollup for /debug/compiles and tools."""
+    if not enabled():
+        return {"enabled": False, "ready": len(AOT_READY)}
+    d = cache_dir()
+    with _LOCK:
+        manifest = _load_manifest(d)
+    files = manifest["files"]
+    return {
+        "enabled": True,
+        "dir": d,
+        "ready": len(AOT_READY),
+        "artifacts": len(files),
+        "bytes": sum(int(m.get("bytes", 0)) for m in files.values()),
+        "budgetBytes": _budget_bytes(),
+    }
+
+
+def reset() -> None:
+    """Test helper: drop in-memory ready state (disk artifacts stay)."""
+    with _LOCK:
+        AOT_READY.clear()
+        _WARN_ONCE.clear()
+
+
+def _warn_once(key, msg, *args) -> None:
+    if key in _WARN_ONCE:
+        return
+    _WARN_ONCE.add(key)
+    log.warning(msg, *args)

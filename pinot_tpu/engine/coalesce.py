@@ -1,0 +1,294 @@
+"""Cross-query coalescing — continuous batching for concurrent OLAP.
+
+Reference analogue: none in Pinot (the JVM engine scales concurrency
+with threads); the shape comes from ragged paged attention serving
+(PAPERS.md, arxiv 2604.15464): stack heterogeneous concurrent requests
+into one padded device dispatch. Here the batch-family stack axis is
+promoted from "segments of one query" to "(query, segment) slots of many
+concurrent queries": in-flight queries that share a ``batch_family_key``
+AND segment set rendezvous here, the first arrival (the leader) holds
+the family open for an opt-in window, stacks every member's per-segment
+param planes behind ONE vmapped ``run_program_batch`` dispatch, and
+demuxes per-query row slices before combine. vmap gives each [S·Q] slot
+exactly the solo kernel body, so coalesced results are bit-identical to
+solo execution — per-query params (filter literals, limits) ride as
+stacked param planes where the program is param-polymorphic, and
+families that embed params in the IR share a Program (hence a family
+key) only on exact match, so they coalesce only then.
+
+Arming: the hold window (``PINOT_TPU_COALESCE_WINDOW_MS``, default 0 =
+never hold) only arms for (table, family) pairs the traffic tracker has
+seen repeat within its decay window — the PR-10 workload-tracker rollup
+idiom at family granularity — so one-off queries never pay latency.
+Joining an ALREADY-open group is always free and needs no arming. A
+group closes early at ``PINOT_TPU_COALESCE_MAX_QUERIES`` members.
+
+Safety: any leader failure (dispatch error, family mismatch, OOM) marks
+the group failed and every member — leader included — falls back to its
+own normal dispatch path. Never a wrong answer, never a stall beyond
+the follower timeout. ``SET coalesce = false`` opts a query out; traced
+queries never coalesce (spans must describe the query's own device
+work).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+def window_ms() -> float:
+    """The opt-in hold window. 0 disables holds (and therefore group
+    formation) entirely — the default, so single-query workloads and the
+    tier-1 suite see the pre-coalescing serving path bit-for-bit."""
+    try:
+        return float(os.environ.get("PINOT_TPU_COALESCE_WINDOW_MS", 0.0))
+    except ValueError:
+        return 0.0
+
+
+def _max_queries() -> int:
+    try:
+        return max(2, int(os.environ.get(
+            "PINOT_TPU_COALESCE_MAX_QUERIES", 16)))
+    except ValueError:
+        return 16
+
+
+# -- per-query thread-local accounting (mirrors executor dispatch counters) --
+
+_TLS = threading.local()
+
+
+def reset_coalesce_stats() -> None:
+    _TLS.stats = [0, 0.0]  # [peer queries shared with, wait ms]
+
+
+def coalesce_stats() -> tuple:
+    s = getattr(_TLS, "stats", None)
+    return (s[0], round(s[1], 3)) if s else (0, 0.0)
+
+
+def _note_stats(peers: int, wait_ms: float) -> None:
+    s = getattr(_TLS, "stats", None)
+    if s is not None:
+        s[0] += peers
+        s[1] += wait_ms
+
+
+# -- (table, family) traffic nomination --------------------------------------
+
+
+class FamilyTraffic:
+    """Decaying per-(table, family) query counter — the workload-tracker
+    rollup (cluster/workload.py ``_Rollup``) applied at family
+    granularity. ``armed`` nominates pairs whose decayed rate says repeat
+    traffic exists, so the hold window only delays queries that have
+    peers to wait for."""
+
+    def __init__(self, half_life_s: float = None, min_traffic: float = None):
+        self.half_life_s = float(
+            half_life_s if half_life_s is not None else
+            os.environ.get("PINOT_TPU_COALESCE_TRAFFIC_HALFLIFE_S", 10.0))
+        self.min_traffic = float(
+            min_traffic if min_traffic is not None else
+            os.environ.get("PINOT_TPU_COALESCE_MIN_TRAFFIC", 2.0))
+        self._lock = threading.Lock()
+        self._counts: dict = {}  # (table, hash(family)) → [value, t]
+        self._max = 4096
+
+    def _decayed(self, slot, now: float) -> float:
+        value, t = slot
+        dt = now - t
+        return value * (2.0 ** (-dt / self.half_life_s)) if dt > 0 else value
+
+    def note(self, table, family_key) -> float:
+        """Fold one sighting in; returns the decayed count AFTER it (the
+        armed() threshold compares this, so the second query inside the
+        half-life arms the pair)."""
+        key = (table, hash(family_key))
+        now = time.time()
+        with self._lock:
+            slot = self._counts.get(key)
+            value = self._decayed(slot, now) + 1.0 if slot else 1.0
+            self._counts[key] = [value, now]
+            if len(self._counts) > self._max:
+                # decayed-out entries first; bound the table like the
+                # workload tracker does
+                for k in sorted(self._counts,
+                                key=lambda k: self._counts[k][1])[:256]:
+                    del self._counts[k]
+        return value
+
+    def armed(self, table, family_key) -> bool:
+        # threshold is min_traffic - 0.5: a prior sighting still worth
+        # half a query (≤ one half-life old) plus the fresh one arms —
+        # strict >= min_traffic could never trigger at the default 2.0
+        # (the older sighting always decays at least a little)
+        key = (table, hash(family_key))
+        now = time.time()
+        with self._lock:
+            slot = self._counts.get(key)
+        return slot is not None and self._decayed(slot, now) \
+            >= self.min_traffic - 0.5
+
+    def snapshot(self) -> dict:
+        now = time.time()
+        with self._lock:
+            per_table: dict = {}
+            for (table, _), slot in self._counts.items():
+                per_table[table] = per_table.get(table, 0.0) \
+                    + self._decayed(slot, now)
+        return {t: round(v, 3) for t, v in per_table.items()}
+
+
+class CoalesceResult:
+    """What a coalesced member gets back: its own S host-side output
+    rows (zero-copy views of the group's fetched [S·Q, ...] arrays)."""
+
+    __slots__ = ("outs", "peers", "wait_ms")
+
+    def __init__(self, outs, peers: int, wait_ms: float):
+        self.outs = outs
+        self.peers = peers
+        self.wait_ms = wait_ms
+
+
+class _Group:
+    __slots__ = ("key", "segs", "plans_list", "closed", "full", "done",
+                 "outs", "error")
+
+    def __init__(self, key, segs):
+        self.key = key
+        self.segs = segs
+        self.plans_list: list = []
+        self.closed = False
+        self.full = threading.Event()
+        self.done = threading.Event()
+        self.outs = None
+        self.error = None
+
+
+class QueryCoalescer:
+    """One per QueryExecutor. ``offer`` is the only entry point; it
+    returns None whenever the query should take its normal solo path."""
+
+    def __init__(self, traffic: FamilyTraffic = None):
+        self.traffic = traffic if traffic is not None else FamilyTraffic()
+        self._lock = threading.Lock()
+        self._open: dict = {}
+        # observability: lifetime groups/queries coalesced (scrape only)
+        self.groups_formed = 0
+        self.queries_coalesced = 0
+
+    def offer(self, table, fkey, segs, plans, mesh, runner):
+        """Coalesce this query's (family, segment-set) dispatch with
+        concurrent peers. ``runner(segs_all, plans_all)`` must dispatch
+        ONE family batch and return the fetched host arrays (leading
+        [S·Q] axis). Returns a CoalesceResult with this query's row
+        views, or None → caller dispatches normally."""
+        w_ms = window_ms()
+        if w_ms <= 0:
+            return None
+        key = (fkey, tuple(getattr(s, "name", id(s)) for s in segs), mesh)
+        t0 = time.perf_counter()
+        with self._lock:
+            g = self._open.get(key)
+            if g is not None and not g.closed:
+                member = len(g.plans_list)
+                g.plans_list.append(plans)
+                if member + 1 >= _max_queries():
+                    g.full.set()
+                lead = False
+            else:
+                self.traffic.note(table, fkey)
+                if not self.traffic.armed(table, fkey):
+                    return None
+                g = _Group(key, segs)
+                g.plans_list.append(plans)
+                self._open[key] = g
+                lead = True
+        if lead:
+            return self._lead(g, key, len(plans), w_ms, t0, runner)
+        return self._follow(g, member, len(plans), w_ms, t0)
+
+    def _follow(self, g: _Group, member: int, s: int, w_ms: float,
+                t0: float):
+        """Registered under the lock in offer(); wait here, outside it.
+        The generous timeout covers the leader's window + dispatch (a
+        first-of-family compile can take seconds); on leader failure or
+        timeout the member silently reverts to its own dispatch."""
+        ok = g.done.wait(timeout=w_ms / 1000.0 + 60.0)
+        wait_ms = (time.perf_counter() - t0) * 1000
+        if not ok or g.outs is None:
+            return None  # leader failed/timed out → solo fallback
+        row0 = member * s
+        outs = [o[row0:row0 + s] for o in g.outs]
+        peers = len(g.plans_list) - 1
+        self._account(peers, wait_ms)
+        return CoalesceResult(outs, peers, wait_ms)
+
+    def _lead(self, g: _Group, key, s: int, w_ms: float, t0: float,
+              runner):
+        g.full.wait(timeout=w_ms / 1000.0)  # window, or early-full close
+        with self._lock:
+            g.closed = True
+            self._open.pop(key, None)
+            plans_list = list(g.plans_list)
+        q = len(plans_list)
+        wait_ms = (time.perf_counter() - t0) * 1000
+        if q == 1:
+            # nobody joined: hand the slot back to the normal path
+            g.error = TimeoutError("no peers joined the window")
+            g.done.set()
+            self._account(0, wait_ms)
+            return None
+        try:
+            segs_all = list(g.segs) * q
+            plans_all = [p for member in plans_list for p in member]
+            g.outs = runner(segs_all, plans_all)
+        except Exception as e:
+            g.error = e
+            g.done.set()
+            log.warning(
+                "coalesced dispatch failed (%s: %s); %d queries fall "
+                "back to solo dispatch", type(e).__name__, e, q)
+            return None
+        g.done.set()
+        with self._lock:
+            self.groups_formed += 1
+            self.queries_coalesced += q
+        self._account(q - 1, wait_ms)
+        from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+        SERVER_METRICS.add_meter(ServerMeter.COALESCED_QUERIES, q - 1)
+        return CoalesceResult([o[:s] for o in g.outs], q - 1, wait_ms)
+
+    @staticmethod
+    def _account(peers: int, wait_ms: float) -> None:
+        _note_stats(peers, wait_ms)
+        from ..spi.metrics import SERVER_METRICS, ServerTimer
+
+        SERVER_METRICS.update_timer(ServerTimer.COALESCE_WAIT_MS, wait_ms)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            open_groups = len(self._open)
+            groups = self.groups_formed
+            queries = self.queries_coalesced
+        return {"openGroups": open_groups, "groupsFormed": groups,
+                "queriesCoalesced": queries,
+                "windowMs": window_ms(),
+                "tableTraffic": self.traffic.snapshot()}
+
+
+def coalesce_enabled(query) -> bool:
+    """``SET coalesce = false`` opts a query out; ON by default. Traced
+    queries are handled at the call site (they never coalesce — their
+    spans must describe their own dispatches)."""
+    return str(query.query_options.get("coalesce")).lower() \
+        not in ("false", "0", "off")

@@ -28,6 +28,14 @@ from typing import Optional
 # live executable family plus headroom for evicted-then-recompiled ones
 _MAX_ENTRIES = int(os.environ.get("PINOT_TPU_COMPILE_REGISTRY_MAX", 4096))
 
+# recency window for the dispatch-rate term of the AOT-persist ranking:
+# dispatches older than ~2 windows stop contributing, so the priority
+# list tracks CURRENT traffic instead of all-time history. The warm path
+# pays only an integer epoch compare + counter bump for this (no pow/exp,
+# no extra clock read beyond the lastUsed stamp it already takes).
+_RECENT_WINDOW_S = float(os.environ.get(
+    "PINOT_TPU_COMPILE_RECENT_WINDOW_S", 300.0))
+
 
 class CompileRegistry:
     """fingerprint → {compiles, compileMs, dispatches, family, lastUsed}."""
@@ -40,6 +48,21 @@ class CompileRegistry:
         # the warm dispatch never re-walks the Program IR.
         self._by_key: dict = {}
         self._entries: "OrderedDict[str, dict]" = OrderedDict()  # LRU
+
+    @staticmethod
+    def _bump_recent(ent: dict, now: float) -> None:
+        """Two-bucket epoch window: ``recentW`` counts dispatches in the
+        current window, ``recentWPrev`` holds the previous window's count.
+        On an epoch boundary the buckets shift (skipping ≥2 windows zeroes
+        both) — an integer compare + at most two assignments, so the warm
+        path stays counter bumps with no pow/exp work."""
+        epoch = int(now / _RECENT_WINDOW_S)
+        delta = epoch - ent["recentEpoch"]
+        if delta:
+            ent["recentWPrev"] = ent["recentW"] if delta == 1 else 0
+            ent["recentW"] = 0
+            ent["recentEpoch"] = epoch
+        ent["recentW"] += 1
 
     def note_compile(self, guard_key, compile_ms: float,
                      fingerprint: Optional[str], family: dict) -> None:
@@ -57,6 +80,8 @@ class CompileRegistry:
                     "compiles": 0, "compileMsTotal": 0.0,
                     "compileMsLast": 0.0, "dispatches": 0,
                     "firstSeen": round(now, 3), "family": family,
+                    "recentW": 0, "recentWPrev": 0,
+                    "recentEpoch": int(now / _RECENT_WINDOW_S),
                 }
             ent["compiles"] += 1
             ent["compileMsTotal"] = round(
@@ -64,17 +89,38 @@ class CompileRegistry:
             ent["compileMsLast"] = round(float(compile_ms), 3)
             ent["dispatches"] += 1
             ent["lastUsed"] = round(now, 3)
+            self._bump_recent(ent, now)
             self._entries.move_to_end(fp)
             while len(self._entries) > self.max_entries:
                 victim, _ = self._entries.popitem(last=False)
                 self._by_key = {k: v for k, v in self._by_key.items()
                                 if v != victim}
 
+    def note_preloaded(self, guard_key, fingerprint: str,
+                       family: dict) -> None:
+        """An AOT-deserialized executable was installed for ``guard_key``
+        (engine/aot_cache.py prewarm): teach the registry the
+        key→fingerprint edge WITHOUT counting a compile, so later warm
+        dispatches register under the persisted family with no IR walk.
+        compileMsLast stays 0 — a preloaded family never re-persists."""
+        now = time.time()
+        with self._lock:
+            self._by_key[guard_key] = fingerprint
+            if fingerprint not in self._entries:
+                self._entries[fingerprint] = {
+                    "compiles": 0, "compileMsTotal": 0.0,
+                    "compileMsLast": 0.0, "dispatches": 0,
+                    "firstSeen": round(now, 3), "family": dict(family),
+                    "lastUsed": round(now, 3),
+                    "recentW": 0, "recentWPrev": 0,
+                    "recentEpoch": int(now / _RECENT_WINDOW_S),
+                }
+
     def note_dispatch(self, guard_key) -> None:
         """Warm-path hit: the executable family already exists. One dict
-        lookup + two bumps; silently ignores keys the registry no longer
-        knows (entry evicted, or compiled before the registry loaded) —
-        the next guard-cache clear re-registers them."""
+        lookup + counter bumps; silently ignores keys the registry no
+        longer knows (entry evicted, or compiled before the registry
+        loaded) — the next guard-cache clear re-registers them."""
         with self._lock:
             fp = self._by_key.get(guard_key)
             if fp is None:
@@ -82,19 +128,50 @@ class CompileRegistry:
             ent = self._entries.get(fp)
             if ent is None:
                 return
+            now = time.time()
             ent["dispatches"] += 1
-            ent["lastUsed"] = round(time.time(), 3)
+            ent["lastUsed"] = round(now, 3)
+            self._bump_recent(ent, now)
             self._entries.move_to_end(fp)
+
+    @staticmethod
+    def _score(ent: dict, now: float) -> float:
+        """AOT-persist priority: compile cost × recent traffic. The
+        recency term interpolates the two window buckets (prev bucket
+        fades linearly as the current window fills), so a family that
+        stopped dispatching decays to bare compile cost within ~2 windows
+        while a hot family's score tracks its current dispatch rate."""
+        epoch = int(now / _RECENT_WINDOW_S)
+        delta = epoch - ent["recentEpoch"]
+        if delta == 0:
+            frac = (now / _RECENT_WINDOW_S) - epoch
+            recent = ent["recentW"] + (1.0 - frac) * ent["recentWPrev"]
+        elif delta == 1:
+            frac = (now / _RECENT_WINDOW_S) - epoch
+            recent = (1.0 - frac) * ent["recentW"]
+        else:
+            recent = 0.0
+        return float(ent["compileMsLast"]) * (1.0 + recent)
 
     def snapshot(self) -> dict:
         """The GET /debug/compiles payload: per-fingerprint entries ranked
-        by cumulative compile cost (the AOT-persist priority order), plus
-        process totals for /metrics."""
+        by decayed compile-cost × dispatch-recency (the AOT-persist
+        priority order — tracks current traffic, not all-time history),
+        plus process totals for /metrics. Scores are computed here, at
+        scrape time, never on the dispatch path."""
+        now = time.time()
         with self._lock:
-            entries = {fp: dict(ent, family=dict(ent["family"]))
+            entries = {fp: dict(ent, family=dict(ent["family"]),
+                                aotScore=round(self._score(ent, now), 3))
                        for fp, ent in self._entries.items()}
         ranked = sorted(entries.items(),
-                        key=lambda kv: -kv[1]["compileMsTotal"])
+                        key=lambda kv: (-kv[1]["aotScore"],
+                                        -kv[1]["compileMsTotal"]))
+        out = []
+        for fp, ent in ranked:
+            ent = dict(ent, fingerprint=fp)
+            ent.pop("recentEpoch", None)
+            out.append(ent)
         return {
             "families": len(entries),
             "totalCompiles": sum(e["compiles"] for e in entries.values()),
@@ -102,8 +179,20 @@ class CompileRegistry:
                                         for e in entries.values()), 3),
             "totalDispatches": sum(e["dispatches"]
                                    for e in entries.values()),
-            "compiles": [dict(ent, fingerprint=fp) for fp, ent in ranked],
+            "compiles": out,
         }
+
+    def aot_priority(self) -> list:
+        """[(fingerprint, score, family)] best-first — the AOT cache's
+        persist/evict order. Unfingerprintable families are excluded:
+        there is nothing stable to key an on-disk artifact by."""
+        now = time.time()
+        with self._lock:
+            scored = [(fp, self._score(ent, now), dict(ent["family"]))
+                      for fp, ent in self._entries.items()
+                      if not fp.startswith("unfingerprintable:")]
+        scored.sort(key=lambda t: -t[1])
+        return scored
 
     def totals(self) -> dict:
         """Cheap rollup for scrape-time /metrics gauges."""

@@ -20,6 +20,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..ops.kernels import PackedOuts, pack_outputs, run_program, unpack_outputs
+from .aot_cache import AOT_READY, aot_call
 from ..query.context import QueryContext
 from ..segment.device_cache import (
     GLOBAL_DEVICE_CACHE,
@@ -97,19 +98,32 @@ _GUARD = _CompileCacheGuard()
 
 def _register_compile(gkey, compile_ms: float, program, padded: int,
                       fused: str = "", lut_meta: tuple = (),
-                      batch_size: int = 0, mesh: tuple = ()) -> None:
+                      batch_size: int = 0, mesh: tuple = (),
+                      packed: bool = False, aot_example=None) -> None:
     """Cold-path half of the compile telemetry registry: fingerprint the
     freshly-compiled family (a canonical-bytes IR walk — only ever paid
     on a compile-guard miss, next to an actual XLA compile) and record
-    the compile cost under it."""
+    the compile cost under it. When the AOT executable cache is enabled
+    and the caller provided an (arrays, params, num_docs) example, the
+    family is also exported + persisted here — still the cold path, next
+    to the XLA compile that just happened. Mesh-sharded executables
+    never persist (their validity spans device topology)."""
     from ..cache.keys import family_fingerprint
     from .compile_registry import COMPILE_REGISTRY, describe_family
 
     fp = family_fingerprint(program, padded, fused, lut_meta, batch_size,
                             mesh=mesh)
-    COMPILE_REGISTRY.note_compile(
-        gkey, compile_ms, fp,
-        describe_family(program, padded, fused, lut_meta, batch_size))
+    family = describe_family(program, padded, fused, lut_meta, batch_size)
+    COMPILE_REGISTRY.note_compile(gkey, compile_ms, fp, family)
+    if aot_example is not None and not mesh:
+        from . import aot_cache
+
+        if aot_cache.enabled():
+            aot_cache.on_compile(
+                gkey, fp, compile_ms, family,
+                "batch" if batch_size else "solo", program, padded,
+                packed=packed, fused=fused, lut_meta=lut_meta,
+                example=aot_example)
 
 
 def _register_dispatch(gkey) -> None:
@@ -335,11 +349,20 @@ class TpuSegmentExecutor:
                 span.set_attribute("fused", fused)
         if span is not None or new_compile:
             t0 = time.perf_counter()
+        nd = np.int32(segment.num_docs)
         try:
-            outs = run_program(plan.program, arrays, params,
-                               np.int32(segment.num_docs), view.padded,
-                               packed=packed, fused=fused,
-                               fused_lut_meta=lut_meta)
+            # AOT-prewarmed family (engine/aot_cache.py): the persisted
+            # executable serves the dispatch — zero compiles in this
+            # process for the family. Empty/disabled cache costs one
+            # falsy truth test. A failed AOT call returns None and the
+            # jit path below runs (its compile then goes uncounted —
+            # the guard was seeded at prewarm — a deliberate trade in a
+            # corruption-recovery path that should never recur).
+            outs = aot_call(gkey, arrays, params, nd) if AOT_READY else None
+            if outs is None:
+                outs = run_program(plan.program, arrays, params, nd,
+                                   view.padded, packed=packed, fused=fused,
+                                   fused_lut_meta=lut_meta)
             if new_compile:
                 # jit's first call compiles synchronously before the async
                 # dispatch, so host wall of run_program ≈ compile cost on
@@ -347,7 +370,9 @@ class TpuSegmentExecutor:
                 # registry gets fed on untraced production dispatches too
                 t1 = time.perf_counter()
                 _register_compile(gkey, round((t1 - t0) * 1000, 3),
-                                  plan.program, view.padded, fused, lut_meta)
+                                  plan.program, view.padded, fused, lut_meta,
+                                  packed=packed,
+                                  aot_example=(arrays, params, nd))
             else:
                 _register_dispatch(gkey)
             if span is not None:
@@ -633,20 +658,30 @@ class TpuSegmentExecutor:
         _count_dispatch(new_compile)
         if span is None and not new_compile:
             _register_dispatch(gkey)
-            return run_program_batch(plan0.program, arrays, params_b,
-                                     num_docs, views[0].padded,
-                                     packed=packed), views
+            outs = aot_call(gkey, arrays, params_b, num_docs) \
+                if AOT_READY else None
+            if outs is None:
+                outs = run_program_batch(plan0.program, arrays, params_b,
+                                         num_docs, views[0].padded,
+                                         packed=packed)
+            return outs, views
         if span is not None:
             span.set_attribute("mode", plan0.program.mode)
             span.set_attribute("padded", views[0].padded)
         t0 = time.perf_counter()
-        outs = run_program_batch(plan0.program, arrays, params_b, num_docs,
-                                 views[0].padded, packed=packed)
+        outs = aot_call(gkey, arrays, params_b, num_docs) \
+            if AOT_READY else None
+        if outs is None:
+            outs = run_program_batch(plan0.program, arrays, params_b,
+                                     num_docs, views[0].padded,
+                                     packed=packed)
         t1 = time.perf_counter()
         compile_ms = round((t1 - t0) * 1000, 3) if new_compile else 0.0
         if new_compile:
             _register_compile(gkey, compile_ms, plan0.program,
-                              views[0].padded, batch_size=len(segments))
+                              views[0].padded, batch_size=len(segments),
+                              packed=packed,
+                              aot_example=(arrays, params_b, num_docs))
         else:
             _register_dispatch(gkey)
         if span is None:

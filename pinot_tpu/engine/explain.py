@@ -249,6 +249,10 @@ def analyze_table(trace_json: list, resp, table_name: str = "") -> ResultTable:
         parts.append(f"hedged:{resp.num_hedged_requests}")
     if getattr(resp, "num_scatter_retries", 0):
         parts.append(f"retries:{resp.num_scatter_retries}")
+    if getattr(resp, "num_coalesced_queries", 0):
+        parts.append(f"coalescedWith:{resp.num_coalesced_queries}")
+        parts.append(
+            f"coalesceWaitMs:{round(getattr(resp, 'coalesce_wait_ms', 0.0), 3)}")
     root = add("EXPLAIN_ANALYZE(" + ", ".join(parts) + ")", -1)
 
     by_span: dict = {}  # trace spanId -> plan row id

@@ -88,6 +88,11 @@ class QueryExecutor:
         # task per segment on a shared executor)
         self.num_threads = max(1, int(num_threads))
         self._pool = None
+        # cross-query coalescing rendezvous (engine/coalesce.py): shared
+        # by every concurrent query through this executor
+        from .coalesce import QueryCoalescer
+
+        self.coalescer = QueryCoalescer()
 
     def _host_pool(self):
         if self._pool is None:
@@ -109,6 +114,14 @@ class QueryExecutor:
                 seg.apply_schema(schema)
         self.tables[name or schema.schema_name] = Table(
             name or schema.schema_name, schema, segments)
+        # compile-free cold starts: pre-warm the table's top persisted
+        # family executables (engine/aot_cache.py) so the first queries
+        # after a restart skip XLA compiles. No-op unless
+        # PINOT_TPU_AOT_CACHE_DIR is set; refusals fall back silently.
+        from .aot_cache import enabled as aot_enabled, prewarm_table
+
+        if aot_enabled():
+            prewarm_table(name or schema.schema_name)
 
     def add_dimension_table(self, schema: Schema, segments: list,
                             name: Optional[str] = None) -> None:
@@ -226,6 +239,8 @@ class QueryExecutor:
             num_compiles=stats.get("num_compiles", 0),
             num_segments_cache_hit=stats.get("num_segments_cache_hit", 0),
             num_segments_cache_miss=stats.get("num_segments_cache_miss", 0),
+            num_coalesced_queries=stats.get("num_coalesced_queries", 0),
+            coalesce_wait_ms=stats.get("coalesce_wait_ms", 0.0),
             time_used_ms=(time.perf_counter() - t0) * 1000,
         )
         if owns_trace:
@@ -371,6 +386,13 @@ class QueryExecutor:
         # per-query dispatch/compile counters (engine/executor.py): every
         # device dispatch for this query happens on this thread
         reset_dispatch_counters()
+        # table attribution for AOT-persisted executables + per-query
+        # coalescing counters (both thread-local, like the counters above)
+        from .aot_cache import set_current_table
+        from .coalesce import reset_coalesce_stats
+
+        set_current_table(query.table_name)
+        reset_coalesce_stats()
         # snapshot: realtime tables mutate the live list concurrently;
         # consuming segments pin a consistent row-count view per query
         segments = [s.snapshot_view() if getattr(s, "is_mutable", False) else s
@@ -405,6 +427,9 @@ class QueryExecutor:
                                  cstats["hit"])
         SERVER_METRICS.add_meter(ServerMeter.SEGMENT_CACHE_MISSES,
                                  cstats["miss"])
+        from .coalesce import coalesce_stats
+
+        co_peers, co_wait_ms = coalesce_stats()
         return combined, {
             "total_docs": total_docs,
             "num_segments_processed": len(kept),
@@ -413,6 +438,8 @@ class QueryExecutor:
             "num_compiles": num_compiles,
             "num_segments_cache_hit": cstats["hit"],
             "num_segments_cache_miss": cstats["miss"],
+            "num_coalesced_queries": co_peers,
+            "coalesce_wait_ms": co_wait_ms,
         }
 
     def _run_segments(self, query: QueryContext, kept: list, tracker,
@@ -498,13 +525,41 @@ class QueryExecutor:
         # kernel). Tokens mark family members: (family key, row in batch).
         fam_packs: dict = {}    # fkey → batched PackedOuts
         fam_inputs: dict = {}   # fkey → (segments, plans) for re-dispatch
+        fam_hosts: dict = {}    # fkey → HOST arrays from a coalesced group
         msig = self._mesh_sig(query)
+        # cross-query coalescing (engine/coalesce.py): only armed when the
+        # opt-in hold window is set AND the family has repeat traffic;
+        # traced queries never coalesce (their spans must describe their
+        # own device work)
+        from .coalesce import coalesce_enabled
+
+        co_on = coalesce_enabled(query) and TRACING.active_trace() is None
         for fkey, positions in self._batch_families(
                 query, [(e[2], e[4]) for e in device_entries], mesh=msig):
             entries = [device_entries[p] for p in positions]
             if fkey is not None and len(entries) > 1:
                 segs_f = [e[2] for e in entries]
                 plans_f = [e[4] for e in entries]
+                if co_on:
+                    def _co_runner(segs_all, plans_all,
+                                   _keep=segs_f[0], _m=msig):
+                        pack = with_oom_retry(
+                            lambda: self.tpu.dispatch_plan_batch(
+                                segs_all, plans_all, mesh=_m),
+                            keep_segment=_keep, cache=self.tpu.cache)
+                        return fetch_packed_batch([pack])[0]
+
+                    co = self.coalescer.offer(query.table_name, fkey,
+                                              segs_f, plans_f, msig,
+                                              _co_runner)
+                    if co is not None:
+                        # this query's S rows are zero-copy views of the
+                        # group's fetched stack; tokens ride the normal
+                        # family demux below
+                        fam_hosts[fkey] = co.outs
+                        for row, e in enumerate(entries):
+                            pending.append(e + ((fkey, row),))
+                        continue
                 try:
                     # HBM pressure during plane upload/dispatch: evict cold
                     # cached segments once and retry (engine/oom.py — the
@@ -577,7 +632,7 @@ class QueryExecutor:
             done += 1
         solo = [p for p in pending if isinstance(p[5], PackedOuts)]
         fam_keys = list(fam_packs)
-        if fam_keys or len(solo) > 1:
+        if fam_keys or fam_hosts or len(solo) > 1:
             # ONE device→host transfer for the whole multi-segment batch —
             # each batched family is already a single flat buffer, solo
             # packs of equal length concat with it (a tunneled device pays
@@ -593,12 +648,17 @@ class QueryExecutor:
                           for k in fam_keys]
                 return fetch_packed_batch(packs)
 
-            fetched = with_oom_retry(
-                lambda: fetch_packed_batch(
-                    [p[5] for p in solo] + [fam_packs[k] for k in fam_keys]),
-                cache=self.tpu.cache, retry_fn=_refetch)
+            if solo or fam_keys:
+                fetched = with_oom_retry(
+                    lambda: fetch_packed_batch(
+                        [p[5] for p in solo]
+                        + [fam_packs[k] for k in fam_keys]),
+                    cache=self.tpu.cache, retry_fn=_refetch)
+            else:
+                fetched = []  # coalesced families arrive host-side already
             solo_outs = {id(p): raw for p, raw in zip(solo, fetched)}
             fam_outs = dict(zip(fam_keys, fetched[len(solo):]))
+            fam_outs.update(fam_hosts)
             # vectorized family combine (engine/combine.py): dense and
             # un-grouped aggregation families decode all members in one
             # pass over the batched arrays; other modes slice per member
@@ -607,7 +667,7 @@ class QueryExecutor:
                                   combine_batched_dense)
 
             precomputed: dict = {}
-            for fkey in fam_keys:
+            for fkey in fam_outs:
                 members = [p for p in pending
                            if not isinstance(p[5], PackedOuts)
                            and p[5][0] == fkey]

@@ -80,6 +80,11 @@ class BrokerResponse:
     # tiered storage: cold (metadata-only) segments still warming when the
     # response was assembled — the answer may be partial, never wrong
     cold_segments_warming: int = 0
+    # continuous batching (engine/coalesce.py): peer queries whose family
+    # dispatch this query shared (leader + followers all report the group
+    # size minus themselves), and how long this query held for its group
+    num_coalesced_queries: int = 0
+    coalesce_wait_ms: float = 0.0
 
     def to_json(self) -> dict:
         out = {
@@ -121,6 +126,9 @@ class BrokerResponse:
             out["queryRejected"] = True
         if self.cold_segments_warming:
             out["coldSegmentsWarming"] = self.cold_segments_warming
+        if self.num_coalesced_queries:
+            out["numCoalescedQueries"] = self.num_coalesced_queries
+            out["coalesceWindowMs"] = self.coalesce_wait_ms
         return out
 
 

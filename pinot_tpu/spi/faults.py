@@ -66,6 +66,10 @@ POINTS = (
                          # stalls it into deadline degradation; corrupt
                          # damages the local copy so quarantine+repair
                          # must re-fetch fresh, like rebalance.move)
+    "aot.load",          # AotExecutableCache artifact read (corrupt =
+                         # bitflip/truncate the serialized executable —
+                         # the loader must refuse it and fall back to a
+                         # fresh compile, never a wrong answer or crash)
 )
 
 

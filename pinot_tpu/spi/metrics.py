@@ -59,6 +59,13 @@ class ServerMeter:
     SEGMENT_COLD_LOADS = "segmentColdLoads"
     SEGMENT_EVICTIONS = "segmentEvictions"
     PREFETCH_HITS = "prefetchHits"
+    # continuous batching (engine/coalesce.py): queries that rode another
+    # query's family dispatch instead of paying their own
+    COALESCED_QUERIES = "coalescedQueries"
+    # AOT executable cache (engine/aot_cache.py): dispatches served by a
+    # deserialized persisted executable vs fresh-compile fallbacks
+    AOT_CACHE_HITS = "aotCacheHits"
+    AOT_CACHE_MISSES = "aotCacheMisses"
 
 
 class BrokerMeter:
@@ -93,6 +100,12 @@ class ServerTimer:
     CROSS_CHIP_COMBINE_MS = "crossChipCombineMs"
     # tiered storage: wall time to fetch+verify+load one cold segment
     COLD_LOAD_MS = "coldLoadMs"
+    # continuous batching: how long a coalesced query waited in the hold
+    # window before its group dispatched
+    COALESCE_WAIT_MS = "coalesceWaitMs"
+    # AOT cache: wall time spent deserializing + warming a table's top
+    # family executables at segment-load / prefetch time
+    AOT_PREWARM_MS = "aotPrewarmMs"
 
 
 class BrokerTimer:

@@ -596,7 +596,8 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
              concurrency: int = 8, n_servers: int = 3, replication: int = 2,
              n_segments: int = 6, rows_per_segment: int = 400,
              fault_rate: float = 0.0, corrupt_rate: float = 0.0,
-             max_inflight: int = 0, progress=None,
+             max_inflight: int = 0, backend: str = "host",
+             families: int = 0, progress=None,
              capture_report: bool = False) -> dict:
     """Closed-loop QPS soak: ``concurrency`` workers pace an aggregate
     ``qps`` arrival rate of exact-result queries against an embedded
@@ -611,7 +612,18 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
     strike must be absorbed by the DataTable checksum + replica retry, so
     full answers stay bit-exact under corruption. ``max_inflight`` > 0
     additionally arms broker admission control, so overload sheds as
-    queryRejected=true responses (counted, not failed)."""
+    queryRejected=true responses (counted, not failed).
+
+    ``families`` > 0 turns the run into a TRAFFIC SHIFT: the workload
+    rotates through that many distinct query families (different
+    programs → different compile fingerprints), each hot for an equal
+    slice of the run. On the ``tpu`` backend every shift boundary eats
+    the new family's XLA compile in the serving tail — unless a
+    populated ``PINOT_TPU_AOT_CACHE_DIR`` pre-warmed it at table
+    registration — which is exactly the AOT-on/AOT-off p99 comparison.
+    The summary adds ``num_compiles`` (summed off BrokerResponse) so
+    the comparison is mechanical, and every family's full responses are
+    still verified exactly against precomputed aggregates."""
     import threading
 
     from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
@@ -635,7 +647,7 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
     controller = ClusterController(store)
     servers = []
     for i in range(n_servers):
-        s = ServerInstance(store, f"Server_{i}", backend="host")
+        s = ServerInstance(store, f"Server_{i}", backend=backend)
         s.start()
         servers.append(s)
     broker = Broker(store)
@@ -644,7 +656,7 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
     controller.add_schema(schema.to_json())
     table = controller.create_table({"tableName": "stats",
                                      "replication": replication})
-    expected = {}
+    all_cols = {"team": [], "year": [], "runs": []}
     for i in range(n_segments):
         n = rows_per_segment
         cols = {
@@ -657,29 +669,60 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
         SegmentBuilder(schema, segment_name=name).build(cols, d / name)
         controller.add_segment(table, name,
                                {"location": str(d / name), "numDocs": n})
-        for t, r in zip(cols["team"], cols["runs"]):
-            expected[t] = expected.get(t, 0) + int(r)
+        for c in all_cols:
+            all_cols[c].append(cols[c])
 
-    sql = ("SET resultCache=false; "
-           "SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 20")
+    def _fam_list():
+        """The rotation workload: up to five distinct-program families
+        over the stats table, each with its exact expected
+        {group-key: measures} answer (key None = ungrouped)."""
+        team = np.concatenate(all_cols["team"])
+        year = np.concatenate(all_cols["year"])
+        runs = np.concatenate(all_cols["runs"]).astype(np.int64)
+        fams = [
+            ("SELECT team, SUM(runs) FROM stats GROUP BY team LIMIT 20",
+             {t: (int(runs[team == t].sum()),) for t in set(team)}),
+            ("SELECT year, COUNT(*), SUM(runs) FROM stats "
+             "GROUP BY year LIMIT 40",
+             {int(y): (int((year == y).sum()), int(runs[year == y].sum()))
+              for y in set(year.tolist())}),
+            ("SELECT team, MIN(runs), MAX(runs) FROM stats "
+             "GROUP BY team LIMIT 20",
+             {t: (int(runs[team == t].min()), int(runs[team == t].max()))
+              for t in set(team)}),
+            ("SELECT year, SUM(runs) FROM stats WHERE runs >= 50 "
+             "GROUP BY year LIMIT 40",
+             {int(y): (int(runs[(runs >= 50) & (year == y)].sum()),)
+              for y in set(year[runs >= 50].tolist())}),
+            ("SELECT COUNT(*), SUM(runs), MIN(runs) FROM stats",
+             {None: (len(runs), int(runs.sum()), int(runs.min()))}),
+        ]
+        if families <= 0:
+            return fams[:1]
+        return [fams[i % len(fams)] for i in range(families)]
+
+    fam_list = [("SET resultCache=false; " + s, e) for s, e in _fam_list()]
+    prefix = None
     if fault_rate > 0:
         faults.seed_schedule(seed, fault_rate,
                              points=("transport.call", "server.query"))
-        sql = "SET allowPartialResults=true; " + sql
+        prefix = "SET allowPartialResults=true; "
     if corrupt_rate > 0:
         # wire points only: this suite never restarts servers, so a
         # segment.load strike would have nothing to hit
         faults.seed_schedule(seed + 0x5EED, corrupt_rate, kind="corrupt",
                              points=("transport.call", "datatable.encode"))
-        if fault_rate <= 0:
-            sql = "SET allowPartialResults=true; " + sql
+        prefix = prefix or "SET allowPartialResults=true; "
+    if prefix:
+        fam_list = [(prefix + s, e) for s, e in fam_list]
     meters0 = {m: BROKER_METRICS.meter_count(m) for m in (
         BrokerMeter.SCATTER_RETRIES, BrokerMeter.HEDGED_REQUESTS,
         BrokerMeter.HEDGE_WINS, BrokerMeter.QUERIES_REJECTED,
         BrokerMeter.CIRCUIT_OPEN, BrokerMeter.DATATABLE_CORRUPTIONS)}
 
     lock = threading.Lock()
-    state = {"next": 0, "ok": 0, "degraded": 0, "rejected": 0}
+    state = {"next": 0, "ok": 0, "degraded": 0, "rejected": 0,
+             "compiles": 0}
     latencies: list[float] = []
     failures: list[str] = []
     t0 = time.time()
@@ -696,9 +739,16 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
                 return
             if target > now:
                 time.sleep(target - now)
+            # the SCHEDULED arrival time picks the hot family, so the
+            # shift boundaries are deterministic for a given seed/qps
+            fi = min(len(fam_list) - 1,
+                     int((target - t0) / (seconds / len(fam_list))))
+            q_sql, q_exp = fam_list[fi]
             q0 = time.perf_counter()
-            resp = broker.execute_sql(sql)
+            resp = broker.execute_sql(q_sql)
             lat_ms = (time.perf_counter() - q0) * 1000
+            with lock:
+                state["compiles"] += getattr(resp, "num_compiles", 0) or 0
             if getattr(resp, "query_rejected", False):
                 with lock:
                     state["rejected"] += 1
@@ -717,12 +767,17 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
                     state["degraded"] += 1
                     latencies.append(lat_ms)
                 continue
-            got = {r[0]: r[1] for r in resp.result_table.rows}
-            if got != expected:
+            rows = resp.result_table.rows
+            if None in q_exp:  # ungrouped aggregation family
+                got = {None: tuple(int(v) for v in rows[0])} if rows else {}
+            else:
+                got = {(r[0] if isinstance(r[0], str) else int(r[0])):
+                       tuple(int(v) for v in r[1:]) for r in rows}
+            if got != q_exp:
                 with lock:
                     failures.append(
-                        f"wrong FULL results under load (seed {seed}): "
-                        f"got {got} want {expected}")
+                        f"wrong FULL results under load (seed {seed}, "
+                        f"family {fi}): got {got} want {q_exp}")
                 return
             with lock:
                 state["ok"] += 1
@@ -762,6 +817,8 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
     out = {
         "suite": "qps", "seed": seed, "elapsed_s": round(elapsed, 1),
         "target_qps": qps, "concurrency": concurrency,
+        "backend": backend, "families": len(fam_list),
+        "num_compiles": state["compiles"],
         "queries_ok": state["ok"], "queries_degraded": state["degraded"],
         "queries_rejected": state["rejected"],
         "achieved_qps": round(done / elapsed, 1) if elapsed > 0 else 0.0,
@@ -1679,6 +1736,16 @@ def main(argv=None) -> int:
                    help="qps suite: arm broker admission control at this "
                         "many in-flight queries (0 = disabled); overload "
                         "then sheds as counted queryRejected responses")
+    p.add_argument("--backend", choices=["host", "tpu"], default="host",
+                   help="qps suite: server execution backend (tpu = the "
+                        "device engine, required for compile-tail and "
+                        "AOT-cache comparisons)")
+    p.add_argument("--families", type=int, default=0,
+                   help="qps suite: rotate through N distinct query "
+                        "families over the run (a traffic shift — each "
+                        "shift boundary pays the new family's compile "
+                        "unless PINOT_TPU_AOT_CACHE_DIR pre-warmed it); "
+                        "0 = the classic single-family run")
     p.add_argument("--rounds", type=int, default=3,
                    help="committer-crash rounds for the realtime suite")
     p.add_argument("--seed", type=int, default=20260731)
@@ -1732,7 +1799,8 @@ def main(argv=None) -> int:
                 seconds=args.seconds, seed=args.seed, qps=args.qps,
                 concurrency=args.concurrency, fault_rate=args.fault_rate,
                 corrupt_rate=args.corrupt_rate,
-                max_inflight=args.max_inflight, progress=progress,
+                max_inflight=args.max_inflight, backend=args.backend,
+                families=args.families, progress=progress,
                 capture_report=bool(args.report)))
         if args.suite in ("realtime", "all"):
             results.append(soak_realtime(

@@ -42,6 +42,9 @@ def pytest_configure(config):
         "markers", "tiered: tiered-storage tests (byte-budgeted local "
                    "cache, cold lazy loads, eviction lifecycle, prefetch); "
                    "smoke-speed ones stay in the tier-1 gate")
+    config.addinivalue_line(
+        "markers", "gate: perf-gate smoke over the committed BENCH_r*.json "
+                   "rounds (bench_gate verdict; fails on correctness flips)")
 
 
 @pytest.fixture(scope="session")

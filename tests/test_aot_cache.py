@@ -1,0 +1,286 @@
+"""AOT executable cache (ISSUE 16 tentpole B): compile-free cold starts.
+
+  * ROUND TRIP — a compiled family persists to the byte-budgeted disk
+    cache; after a simulated restart (in-proc state cleared) prewarm
+    deserializes it and the next query runs with ``numCompiles == 0``
+    and rows bit-identical to the fresh-compile run.
+
+  * INVALIDATION — a persisted artifact is REFUSED (fresh-compile
+    fallback, never a crash) on: jaxlib version change, device-kind
+    change, platform change, mesh-shape change, payload truncation, and
+    a single flipped bit (both via the ``aot.load`` fault point).
+
+  * RESTART E2E — two real subprocesses share a cache dir; the second
+    process's FIRST query of the prewarmed family reports zero compiles
+    and at least one device dispatch, rows identical to run one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import aot_cache
+from pinot_tpu.engine import executor as executor_mod
+from pinot_tpu.engine.compile_registry import COMPILE_REGISTRY
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "aot",
+    dimensions=[("k", "INT")],
+    metrics=[("v", "LONG")])
+
+SQL = ("SELECT k, COUNT(*), SUM(v) FROM aot "
+       "GROUP BY k ORDER BY k LIMIT 100000")
+
+
+def _build_qe(tmp_path, n_segs=2, rows=2048):
+    rng = np.random.default_rng(7)
+    cols = {
+        "k": rng.integers(0, 20, rows).astype(np.int32),
+        "v": rng.integers(-100, 100, rows).astype(np.int64),
+    }
+    segs = []
+    for i in range(n_segs):
+        SegmentBuilder(SCHEMA, segment_name=f"a{i}").build(
+            cols, tmp_path / f"a{i}")
+        segs.append(load_segment(tmp_path / f"a{i}"))
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(SCHEMA, segs)
+    return qe
+
+
+def _simulate_restart():
+    """Drop every in-process trace of compiled executables; the disk
+    cache survives, exactly like a process restart."""
+    import jax
+
+    aot_cache.reset()
+    executor_mod._GUARD._seen.clear()
+    COMPILE_REGISTRY.reset()
+    jax.clear_caches()
+
+
+@pytest.fixture()
+def aot_dir(tmp_path, monkeypatch):
+    d = tmp_path / "aotcache"
+    d.mkdir()
+    monkeypatch.setenv("PINOT_TPU_AOT_CACHE_DIR", str(d))
+    monkeypatch.setenv("PINOT_TPU_SEGMENT_CACHE", "0")
+    # the compile guard is process-global: earlier tests leave the family
+    # warm, and a warm family never compiles, never persists
+    _simulate_restart()
+    yield d
+    aot_cache.reset()
+
+
+def _artifacts(d):
+    return sorted(p for p in os.listdir(d) if p.endswith(".aot"))
+
+
+def _rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return resp.result_table.rows
+
+
+# -- round trip ---------------------------------------------------------------
+
+
+def test_round_trip_compile_free(tmp_path, aot_dir):
+    qe = _build_qe(tmp_path / "segs")
+    fresh = qe.execute_sql(SQL)
+    assert fresh.num_compiles >= 1
+    names = _artifacts(aot_dir)
+    assert names, "compile did not persist an artifact"
+    manifest = json.load(open(aot_dir / "manifest.json"))
+    assert set(manifest["files"]) == set(names)
+    assert all(m["table"] == "aot" for m in manifest["files"].values())
+
+    _simulate_restart()
+    got = aot_cache.prewarm_table("aot")
+    assert got["loaded"] >= 1 and got["refused"] == 0
+    warm = qe.execute_sql(SQL)
+    assert _rows(warm) == _rows(fresh)
+    assert warm.num_compiles == 0, "prewarmed family still compiled"
+    assert warm.num_device_dispatches >= 1
+    assert COMPILE_REGISTRY.totals()["compileMs"] == 0
+
+
+def test_prewarm_matches_type_suffixed_table_names(tmp_path, aot_dir):
+    qe = _build_qe(tmp_path / "segs")
+    qe.execute_sql(SQL)
+    assert _artifacts(aot_dir)
+    _simulate_restart()
+    # segment-load prewarm passes the internal name; artifacts were
+    # stamped with the raw query-time name — they must still match
+    assert aot_cache.prewarm_table("aot_OFFLINE")["loaded"] >= 1
+
+
+def test_disabled_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("PINOT_TPU_AOT_CACHE_DIR", raising=False)
+    monkeypatch.setenv("PINOT_TPU_SEGMENT_CACHE", "0")
+    assert not aot_cache.enabled()
+    qe = _build_qe(tmp_path / "segs")
+    resp = qe.execute_sql(SQL)
+    assert not resp.exceptions
+    assert aot_cache.stats() == {"enabled": False, "ready": 0}
+    assert aot_cache.prewarm_table("aot") == {"loaded": 0, "refused": 0}
+
+
+# -- invalidation -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mutate", [
+    {"jaxlib": "9.9.9/9.9.9"},
+    {"deviceKind": "TPU v9"},
+    {"platform": "warp"},
+    {"meshShape": [512]},
+], ids=["jaxlib", "deviceKind", "platform", "meshShape"])
+def test_env_tag_mismatch_refuses(tmp_path, aot_dir, mutate):
+    qe = _build_qe(tmp_path / "segs")
+    qe.execute_sql(SQL)
+    (name,) = _artifacts(aot_dir)[:1] or [None]
+    assert name
+    _simulate_restart()
+    doctored = dict(aot_cache.env_tag(), **mutate)
+    assert aot_cache.load_artifact(str(aot_dir / name),
+                                   expect_tag=doctored) is None
+    assert not aot_cache.AOT_READY
+    # the same artifact under the REAL tag still loads — the refusal was
+    # the tag comparison, not file damage
+    assert aot_cache.load_artifact(str(aot_dir / name)) is not None
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+def test_corrupt_artifact_refused_and_query_recovers(tmp_path, aot_dir,
+                                                     mode):
+    qe = _build_qe(tmp_path / "segs")
+    fresh = qe.execute_sql(SQL)
+    assert _artifacts(aot_dir)
+    _simulate_restart()
+    with faults.injected("aot.load", kind="corrupt", corrupt_mode=mode,
+                         times=None):
+        got = aot_cache.prewarm_table("aot")
+    assert got["loaded"] == 0 and got["refused"] >= 1
+    assert not aot_cache.AOT_READY
+    # never wrong, never crashed: the next query simply compiles fresh
+    resp = qe.execute_sql(SQL)
+    assert _rows(resp) == _rows(fresh)
+    assert resp.num_compiles >= 1
+
+
+def test_unreadable_and_garbage_files_refused(aot_dir):
+    missing = aot_dir / "nope.aot"
+    assert aot_cache.load_artifact(str(missing)) is None
+    junk = aot_dir / "junk.aot"
+    junk.write_bytes(b"not a pickle at all")
+    assert aot_cache.load_artifact(str(junk)) is None
+    assert not aot_cache.AOT_READY
+
+
+# -- byte budget / ranking ----------------------------------------------------
+
+
+def test_make_room_evicts_only_lower_scores(tmp_path, monkeypatch):
+    d = tmp_path / "budget"
+    d.mkdir()
+    monkeypatch.setenv("PINOT_TPU_AOT_CACHE_MB", str(1 / 1024))  # 1 KiB
+    manifest = {"files": {}}
+    for name, score in (("low.aot", 10.0), ("mid.aot", 50.0),
+                        ("high.aot", 500.0)):
+        (d / name).write_bytes(b"x" * 300)
+        manifest["files"][name] = {"bytes": 300, "score": score}
+    # an incoming 300-byte family scoring 100 evicts low (10) then mid
+    # (50) — never high (500)
+    assert aot_cache._make_room(str(d), manifest, 300, 100.0)
+    assert "high.aot" in manifest["files"]
+    assert "low.aot" not in manifest["files"]
+    assert not (d / "low.aot").exists()
+    # a family scoring below every survivor cannot claim space
+    manifest2 = {"files": {"high.aot": {"bytes": 900, "score": 500.0}}}
+    assert not aot_cache._make_room(str(d), manifest2, 300, 1.0)
+    # and nothing larger than the whole budget ever fits
+    assert not aot_cache._make_room(str(d), {"files": {}}, 2048, 1e9)
+
+
+# -- restart e2e --------------------------------------------------------------
+
+
+_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import numpy as np
+from pinot_tpu.engine.compile_registry import COMPILE_REGISTRY
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+seg_dir = sys.argv[1]
+SCHEMA = Schema.build("aot", dimensions=[("k", "INT")],
+                      metrics=[("v", "LONG")])
+rng = np.random.default_rng(7)
+cols = {"k": rng.integers(0, 20, 1024).astype(np.int32),
+        "v": rng.integers(-100, 100, 1024).astype(np.int64)}
+segs = []
+for i in range(2):
+    p = os.path.join(seg_dir, f"a{i}")
+    if not os.path.isdir(p):
+        SegmentBuilder(SCHEMA, segment_name=f"a{i}").build(cols, p)
+    segs.append(load_segment(p))
+qe = QueryExecutor(backend="tpu")
+qe.add_table(SCHEMA, segs)  # prewarms from PINOT_TPU_AOT_CACHE_DIR
+resp = qe.execute_sql(
+    "SELECT k, COUNT(*), SUM(v) FROM aot GROUP BY k ORDER BY k LIMIT 1000")
+print(json.dumps({
+    "rows": [[int(c) for c in row] for row in resp.result_table.rows],
+    "numCompiles": resp.num_compiles,
+    "numDeviceDispatches": resp.num_device_dispatches,
+    "compileMs": COMPILE_REGISTRY.totals()["compileMs"],
+    "exceptions": resp.exceptions,
+}))
+"""
+
+
+def _run_child(tmp_path, env):
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(tmp_path / "segs")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_subprocess_restart_first_query_compile_free(tmp_path):
+    """The acceptance scenario, with REAL process isolation: run one
+    compiles and persists; run two (fresh interpreter, same cache dir)
+    prewarm-loads at table registration and its FIRST query reports
+    numCompiles == 0, compileMs == 0, numDeviceDispatches >= 1."""
+    (tmp_path / "segs").mkdir()
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               PINOT_TPU_AOT_CACHE_DIR=str(tmp_path / "aot"),
+               PINOT_TPU_SEGMENT_CACHE="0")
+    env.pop("PINOT_TPU_COALESCE_WINDOW_MS", None)
+    first = _run_child(tmp_path, env)
+    assert not first["exceptions"]
+    assert first["numCompiles"] >= 1
+    assert os.listdir(tmp_path / "aot")
+
+    second = _run_child(tmp_path, env)
+    assert not second["exceptions"]
+    assert second["rows"] == first["rows"]
+    assert second["numCompiles"] == 0
+    assert second["compileMs"] == 0
+    assert second["numDeviceDispatches"] >= 1

@@ -220,6 +220,30 @@ def test_real_artifacts_self_compare_pass(path_a, path_b):
     assert main([str(a), str(b)]) == 0
 
 
+@pytest.mark.gate
+def test_two_most_recent_committed_rounds_no_correctness_flip(capsys):
+    """Tier-1 gate smoke: bench_gate over the two most recent committed
+    rounds. Committed rounds may come from different machines, so pure
+    timing deltas only warn here — but a correctness ``match`` flip (any
+    config returning different rows than sqlite) fails the suite."""
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    rounds = sorted(root.glob("BENCH_r[0-9][0-9].json"))
+    if len(rounds) < 2:
+        pytest.skip("fewer than two committed BENCH rounds")
+    base, cand = load_round(str(rounds[-2])), load_round(str(rounds[-1]))
+    report = compare(base, cand, threshold=0.30)
+    flips = [f for f in report["failures"] if "flip" in f]
+    assert not flips, f"correctness flipped between rounds: {flips}"
+    if not report["pass"]:
+        import warnings
+
+        warnings.warn("bench_gate timing verdict FAIL between committed "
+                      f"rounds (cross-machine noise tolerated): "
+                      f"{report['failures']}")
+
+
 def test_warm_p50_regression_fails(tmp_path, capsys):
     """Tiered round: a warm (resident-path) p50 blow-up fails even when
     the headline cold p50 held steady — the warm path is the hot path."""

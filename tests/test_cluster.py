@@ -210,6 +210,83 @@ def test_drop_table_and_unknown_table(cluster, tmp_path):
         assert not s.segments.get(table)
 
 
+def test_rpc_client_pool_overlaps_concurrent_calls():
+    """Two concurrent call()s on ONE client must be in flight at the
+    server simultaneously (per-target socket pool). A single pooled
+    socket would serialize them on the wire — on the query path that
+    means a server never sees two queries at once, so cross-query
+    coalescing could never form a group."""
+    import threading
+
+    from pinot_tpu.cluster.transport import RpcClient, RpcServer
+
+    rendezvous = threading.Barrier(2)
+
+    def handler(req):
+        if req in (0, 1):  # follow-up calls skip the rendezvous
+            rendezvous.wait(timeout=10)  # passes only if BOTH in flight
+        return req
+
+    server = RpcServer(handler)
+    try:
+        client = RpcClient("127.0.0.1", server.port, timeout=15.0)
+        out = [None, None]
+
+        def call(i):
+            out[i] = client.call(i)
+
+        threads = [threading.Thread(target=call, args=(i,), daemon=True)
+                   for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        assert out == [0, 1]
+        # both sockets returned to the pool: follow-up calls still work
+        assert client.call("again") == "again"
+        client.close()
+        # close() drained the pool; the next call redials transparently
+        assert client.call("redial") == "redial"
+        client.close()
+    finally:
+        server.close()
+
+
+def test_rpc_client_pool_size_caps_inflight():
+    """pool_size bounds concurrent sockets per target: with pool_size=1
+    the client degrades to the old serialized behavior by construction."""
+    import threading
+    import time
+
+    from pinot_tpu.cluster.transport import RpcClient, RpcServer
+
+    lock = threading.Lock()
+    state = {"now": 0, "max": 0}
+
+    def handler(req):
+        with lock:
+            state["now"] += 1
+            state["max"] = max(state["max"], state["now"])
+        time.sleep(0.05)
+        with lock:
+            state["now"] -= 1
+        return req
+
+    server = RpcServer(handler)
+    try:
+        client = RpcClient("127.0.0.1", server.port, pool_size=1)
+        threads = [threading.Thread(target=client.call, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        assert state["max"] == 1
+        client.close()
+    finally:
+        server.close()
+
+
 def test_rpc_connect_refused_is_transport_error():
     """A down server must surface as TransportError so the broker's
     failover/failure-detector path catches it (not a raw OSError)."""

@@ -1,0 +1,363 @@
+"""Cross-query coalescing (ISSUE 16 tentpole A): structure + oracle.
+
+Three families of checks:
+
+  * STRUCTURE — N=8 identical concurrent queries with the hold window
+    armed must execute as ONE device dispatch (the leader's), every
+    response reporting ``numCoalescedQueries == 7``; with the window
+    unset (the default) the same traffic never coalesces.
+
+  * ORACLE — coalesced results are bit-identical to solo execution AND
+    to sqlite on the same rows, across a matrix of queries differing
+    only in filter literals (per-query param planes demuxed from one
+    stacked dispatch) and mixed-shape concurrent traffic.
+
+  * SAFETY — leader dispatch failure falls every member back to its own
+    solo dispatch (correct answers, zero coalescing counted);
+    ``SET coalesce = false`` opts out; un-armed first-sight families
+    never hold.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.coalesce import (FamilyTraffic, QueryCoalescer,
+                                       coalesce_enabled, window_ms)
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.segment.builder import SegmentBuilder
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.spi.data_types import Schema
+
+SCHEMA = Schema.build(
+    "co",
+    dimensions=[("k", "INT"), ("d", "INT")],
+    metrics=[("v", "LONG")])
+
+N_SEGS = 3
+N_ROWS = 4096
+
+
+@pytest.fixture(autouse=True)
+def _no_segment_cache(monkeypatch):
+    # repeat queries must DISPATCH to rendezvous — a partial-cache hit
+    # would satisfy them host-side and no group could ever form
+    monkeypatch.setenv("PINOT_TPU_SEGMENT_CACHE", "0")
+
+
+@pytest.fixture()
+def fresh_coalescer(qe):
+    """Arm-on-first-sight coalescer, reset per test (traffic decay and
+    group counters must not leak between tests)."""
+    qe.coalescer = QueryCoalescer(FamilyTraffic(min_traffic=1.0))
+    return qe.coalescer
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(1106)
+    return {
+        "k": rng.integers(0, 40, N_ROWS).astype(np.int32),
+        "d": rng.integers(0, 16, N_ROWS).astype(np.int32),
+        "v": rng.integers(-500, 500, N_ROWS).astype(np.int64),
+    }
+
+
+@pytest.fixture(scope="module")
+def qe(tmp_path_factory, dataset):
+    """Three segments built from IDENTICAL rows: equal metadata means one
+    batch family by construction, so concurrent queries rendezvous."""
+    d = tmp_path_factory.mktemp("co_segs")
+    segs = []
+    for i in range(N_SEGS):
+        SegmentBuilder(SCHEMA, segment_name=f"c{i}").build(
+            dataset, d / f"c{i}")
+        segs.append(load_segment(d / f"c{i}"))
+    qe = QueryExecutor(backend="tpu")
+    qe.add_table(SCHEMA, segs)
+    return qe
+
+
+@pytest.fixture(scope="module")
+def oracle(dataset):
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE co (k INT, d INT, v INT)")
+    rows = list(zip(map(int, dataset["k"]), map(int, dataset["d"]),
+                    map(int, dataset["v"])))
+    for _ in range(N_SEGS):  # every segment holds the same rows
+        conn.executemany("INSERT INTO co VALUES (?,?,?)", rows)
+    return conn
+
+
+def _rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return resp.result_table.rows
+
+
+def _run_concurrent(qe, sqls, timeout=120.0):
+    """Run the SQLs on one thread each, released together."""
+    barrier = threading.Barrier(len(sqls))
+    results: list = [None] * len(sqls)
+    errors: list = []
+
+    def work(i, sql):
+        try:
+            barrier.wait(timeout=30)
+            results[i] = qe.execute_sql(sql)
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=work, args=(i, s), daemon=True)
+               for i, s in enumerate(sqls)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert not errors, errors
+    assert all(r is not None for r in results), "worker thread hung"
+    return results
+
+
+GROUPBY_SQL = ("SELECT k, COUNT(*), SUM(v), MIN(v), MAX(v) FROM co "
+               "WHERE v > {lit} GROUP BY k ORDER BY k LIMIT 100000")
+ORACLE_SQL = ("SELECT k, COUNT(*), SUM(v), MIN(v), MAX(v) FROM co "
+              "WHERE v > {lit} GROUP BY k ORDER BY k")
+
+
+def _sqlite_rows(conn, lit):
+    return [list(r) for r in conn.execute(ORACLE_SQL.format(lit=lit))]
+
+
+def _int_rows(resp):
+    return [[int(c) for c in row] for row in _rows(resp)]
+
+
+# -- structure ---------------------------------------------------------------
+
+
+def test_eight_identical_queries_one_dispatch(qe, fresh_coalescer,
+                                              monkeypatch):
+    # max_queries == thread count: the group closes on the full event,
+    # deterministically — never on window-expiry racing slow planning
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "5000")
+    monkeypatch.setenv("PINOT_TPU_COALESCE_MAX_QUERIES", "8")
+    sql = GROUPBY_SQL.format(lit=100)
+    solo = qe.execute_sql(sql)  # warm the [S] compile + arm the family
+    results = _run_concurrent(qe, [sql] * 8)
+    assert sum(r.num_device_dispatches for r in results) == 1
+    for r in results:
+        assert _rows(r) == _rows(solo)
+        assert r.num_coalesced_queries == 7
+        assert r.coalesce_wait_ms >= 0.0
+        j = r.to_json()
+        assert j["numCoalescedQueries"] == 7
+        assert "coalesceWindowMs" in j
+    snap = fresh_coalescer.snapshot()
+    assert snap["groupsFormed"] == 1
+    assert snap["queriesCoalesced"] == 8
+
+
+def test_default_window_never_coalesces(qe, monkeypatch):
+    monkeypatch.delenv("PINOT_TPU_COALESCE_WINDOW_MS", raising=False)
+    qe.coalescer = QueryCoalescer(FamilyTraffic(min_traffic=1.0))
+    assert window_ms() == 0.0
+    sql = GROUPBY_SQL.format(lit=100)
+    qe.execute_sql(sql)
+    results = _run_concurrent(qe, [sql] * 4)
+    # each query dispatches its own family batch: 4 total, zero shared
+    assert sum(r.num_device_dispatches for r in results) == 4
+    assert all(r.num_coalesced_queries == 0 for r in results)
+    assert qe.coalescer.snapshot()["groupsFormed"] == 0
+
+
+def test_set_coalesce_false_opts_out(qe, fresh_coalescer, monkeypatch):
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "250")
+    sql = "SET coalesce = false; " + GROUPBY_SQL.format(lit=100)
+    qe.execute_sql(sql)
+    results = _run_concurrent(qe, [sql] * 4)
+    assert sum(r.num_device_dispatches for r in results) == 4
+    assert all(r.num_coalesced_queries == 0 for r in results)
+    assert fresh_coalescer.snapshot()["groupsFormed"] == 0
+
+
+# -- oracle ------------------------------------------------------------------
+
+
+LITERALS = [100, 200, -50, 0, 300, 150, 250, -100]
+
+
+def test_param_plane_matrix_bit_identical(qe, oracle, fresh_coalescer,
+                                          monkeypatch):
+    """Eight concurrent queries differing ONLY in the filter literal —
+    one program, eight param planes — coalesce into one dispatch and
+    each demuxes to exactly its own sqlite answer."""
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "5000")
+    monkeypatch.setenv("PINOT_TPU_COALESCE_MAX_QUERIES", "8")
+    solos = {lit: qe.execute_sql(GROUPBY_SQL.format(lit=lit))
+             for lit in LITERALS}  # warm + arm; also the solo oracle
+    results = _run_concurrent(
+        qe, [GROUPBY_SQL.format(lit=lit) for lit in LITERALS])
+    assert sum(r.num_device_dispatches for r in results) == 1
+    for lit, r in zip(LITERALS, results):
+        # bit-identical to solo execution of the same query...
+        assert _rows(r) == _rows(solos[lit]), f"lit={lit}"
+        # ...and value-equal to sqlite on the same rows
+        assert _int_rows(r) == _sqlite_rows(oracle, lit), f"lit={lit}"
+        assert r.num_coalesced_queries == 7
+
+
+def test_mixed_traffic_matrix(qe, oracle, fresh_coalescer, monkeypatch):
+    """Group-bys and selections in flight together: the group-bys
+    coalesce among themselves, every answer stays correct."""
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "700")
+    sel_sql = "SELECT k, d, v FROM co WHERE v > 450 ORDER BY v, k, d LIMIT 17"
+    gb = [GROUPBY_SQL.format(lit=lit) for lit in (100, 200, -50, 0)]
+    solos = [qe.execute_sql(s) for s in gb]  # warm + arm
+    sel_solo = qe.execute_sql(sel_sql)
+    results = _run_concurrent(qe, gb + [sel_sql] * 2)
+    for i, (s, r) in enumerate(zip(gb, results[:4])):
+        assert _rows(r) == _rows(solos[i]), s
+        lit = (100, 200, -50, 0)[i]
+        assert _int_rows(r) == _sqlite_rows(oracle, lit)
+    for r in results[4:]:
+        assert _rows(r) == _rows(sel_solo)
+
+
+# -- safety ------------------------------------------------------------------
+
+
+def test_leader_dispatch_failure_falls_back_solo(qe, fresh_coalescer,
+                                                 monkeypatch):
+    """A failing coalesced dispatch (here: any stack taller than one
+    query's S segments explodes) must degrade every member to its own
+    normal dispatch — right answers, nothing coalesced."""
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "400")
+    sql = GROUPBY_SQL.format(lit=100)
+    solo = qe.execute_sql(sql)
+    real = qe.tpu.dispatch_plan_batch
+
+    def exploding(segs, plans, mesh=()):
+        if len(segs) > N_SEGS:
+            raise RuntimeError("injected coalesced-dispatch failure")
+        return real(segs, plans, mesh=mesh)
+
+    monkeypatch.setattr(qe.tpu, "dispatch_plan_batch", exploding)
+    results = _run_concurrent(qe, [sql] * 3)
+    for r in results:
+        assert _rows(r) == _rows(solo)
+        assert r.num_coalesced_queries == 0
+    assert fresh_coalescer.snapshot()["groupsFormed"] == 0
+
+
+def test_unarmed_family_never_holds(monkeypatch):
+    """First sighting of a (table, family) with default min_traffic=2
+    returns None immediately — a one-off query pays zero hold latency."""
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "60000")
+    co = QueryCoalescer(FamilyTraffic(half_life_s=10.0, min_traffic=2.0))
+    t0 = __import__("time").perf_counter()
+    out = co.offer("t", ("fam",), ["s1"], ["p1"], (), lambda s, p: [])
+    assert out is None
+    assert (__import__("time").perf_counter() - t0) < 5.0  # no 60s hold
+    # second sighting inside the half-life arms the pair: the offer now
+    # HOLDS (leads) and, with nobody joining, q==1 falls back to None
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "50")
+    out = co.offer("t", ("fam",), ["s1"], ["p1"], (), lambda s, p: [])
+    assert out is None
+    assert co.traffic.armed("t", ("fam",))
+
+
+def test_traffic_decays_below_arming_threshold():
+    clock = [1000.0]
+    tr = FamilyTraffic(half_life_s=10.0, min_traffic=2.0)
+    import pinot_tpu.engine.coalesce as comod
+    real_time = comod.time.time
+    try:
+        comod.time.time = lambda: clock[0]
+        tr.note("t", "f")
+        tr.note("t", "f")
+        assert tr.armed("t", "f")
+        clock[0] += 60.0  # six half-lives: 2.0 → ~0.03
+        assert not tr.armed("t", "f")
+        tr.note("t", "f")  # one fresh sighting alone does not re-arm
+        assert not tr.armed("t", "f")
+    finally:
+        comod.time.time = real_time
+
+
+def test_coalesce_enabled_parsing():
+    class Q:
+        def __init__(self, **opts):
+            self.query_options = opts
+
+    assert coalesce_enabled(Q())
+    assert coalesce_enabled(Q(coalesce="true"))
+    assert not coalesce_enabled(Q(coalesce="false"))
+    assert not coalesce_enabled(Q(coalesce=False))
+    assert not coalesce_enabled(Q(coalesce="off"))
+    assert not coalesce_enabled(Q(coalesce=0))
+
+
+# -- cluster path ------------------------------------------------------------
+
+
+def test_cluster_path_coalesces_across_broker_queries(tmp_path, dataset,
+                                                      monkeypatch):
+    """Concurrent queries through broker → RPC → server rendezvous in the
+    SERVER's coalescer. Regression pin for the transport prerequisite:
+    with a single data-plane socket per broker→server target, scatter
+    calls serialize one-at-a-time on the wire, the server never has two
+    queries in flight, and no group can ever form."""
+    monkeypatch.setenv("PINOT_TPU_COALESCE_WINDOW_MS", "800")
+    monkeypatch.setenv("PINOT_TPU_COALESCE_MIN_TRAFFIC", "1.0")
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+
+    store = PropertyStore()
+    controller = ClusterController(store)
+    server = ServerInstance(store, "Server_0", backend="tpu")
+    server.start()
+    try:
+        controller.add_schema(SCHEMA.to_json())
+        table = controller.create_table({"tableName": "co", "replication": 1})
+        for i in range(N_SEGS):
+            path = tmp_path / f"c{i}"
+            SegmentBuilder(SCHEMA, segment_name=f"c{i}").build(dataset, path)
+            controller.add_segment(
+                table, f"c{i}", {"location": str(path), "numDocs": N_ROWS})
+        broker = Broker(store)
+        sql = "SET resultCache=false; " + GROUPBY_SQL.format(lit=100)
+        solo = broker.execute_sql(sql)
+        broker.execute_sql(sql)  # second sighting arms the family traffic
+
+        n = 6
+        barrier = threading.Barrier(n)
+        results: list = [None] * n
+        errors: list = []
+
+        def work(i):
+            try:
+                barrier.wait(timeout=30)
+                results[i] = broker.execute_sql(sql)
+            except Exception as e:  # pragma: no cover - surfaced via assert
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=work, args=(i,), daemon=True)
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        coalesced = 0
+        for r in results:
+            assert r is not None, "worker thread hung"
+            assert _rows(r) == _rows(solo)
+            coalesced += r.num_coalesced_queries
+        assert coalesced > 0, \
+            "no cluster-path query coalesced under an armed 800ms window"
+    finally:
+        server.stop()

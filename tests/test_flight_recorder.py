@@ -173,6 +173,37 @@ def test_unfingerprintable_family_still_counted():
     assert snap["compiles"][0]["fingerprint"].startswith("unfingerprintable:")
 
 
+def test_compile_registry_ranking_decays_with_traffic(monkeypatch):
+    """AOT-persist priority must track CURRENT traffic: a family whose
+    dispatches all happened windows ago decays to bare compile cost,
+    so a cheaper-but-hot family overtakes it in the ranking."""
+    import pinot_tpu.engine.compile_registry as crmod
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(crmod.time, "time", lambda: clock["t"])
+    reg = CompileRegistry(max_entries=16)
+    # expensive family, heavily dispatched... then traffic stops
+    reg.note_compile(("old",), 100.0, "fp-old", {})
+    for _ in range(50):
+        reg.note_dispatch(("old",))
+    # >2 windows later a cheap family starts taking steady traffic
+    clock["t"] += 3 * crmod._RECENT_WINDOW_S
+    reg.note_compile(("hot",), 10.0, "fp-hot", {})
+    for _ in range(30):
+        reg.note_dispatch(("hot",))
+    pri = reg.aot_priority()
+    assert [fp for fp, _, _ in pri] == ["fp-hot", "fp-old"], pri
+    # the stale family's recency term is fully decayed: bare compile cost
+    assert dict((fp, s) for fp, s, _ in pri)["fp-old"] == 100.0
+    # snapshot ranks by the same decayed score and exposes it
+    snap = reg.snapshot()
+    assert snap["compiles"][0]["fingerprint"] == "fp-hot"
+    assert snap["compiles"][0]["aotScore"] > 100.0
+    # unfingerprintable families never make the AOT list
+    reg.note_compile(("anon",), 999.0, None, {})
+    assert all(not fp.startswith("unfingerprintable:")
+               for fp, _, _ in reg.aot_priority())
+
+
 # -- Chrome Trace Event export: schema + flow validators ----------------------
 
 

@@ -631,3 +631,21 @@ def test_soak_qps_smoke():
     # the armed schedule produced work for the healing layer (retries) —
     # and every full answer was exact (soak_qps raises otherwise)
     assert out["scatter_retries"] + out["queries_degraded"] >= 0
+
+
+def test_soak_qps_family_rotation_exact():
+    """``--families`` traffic-shift mode: the run rotates through
+    distinct query families and verifies EVERY family's full responses
+    against precomputed aggregates (soak_qps raises on any mismatch).
+    Host backend keeps this compile-free and fast; the tpu-backend
+    AOT-on/off comparison is the slow CLI form of the same run."""
+    from pinot_tpu.tools.soak import soak_qps
+
+    out = soak_qps(seconds=4.0, seed=11, qps=25.0, concurrency=4,
+                   n_servers=2, n_segments=3, rows_per_segment=80,
+                   families=5)
+    assert out["families"] == 5
+    assert out["backend"] == "host"
+    assert out["num_compiles"] == 0  # host engine never compiles
+    # enough queries ran that every family's window saw traffic
+    assert out["queries_ok"] >= 5

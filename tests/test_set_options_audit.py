@@ -1,0 +1,84 @@
+"""EXECUTION_ONLY_OPTIONS audit (ISSUE 16 satellite).
+
+``cache/keys.py`` folds every SET option NOT in EXECUTION_ONLY_OPTIONS
+into result-cache fingerprints. That is the safe default — but each
+execution-only option someone forgets to classify silently splits cache
+entries per spelling/value, and each option wrongly classified as
+execution-only can serve stale rows. This test enumerates every SET
+option the codebase actually reads and fails when one appears that is
+in neither the execution-only set nor the deliberately-result-affecting
+list below, forcing new options to be classified on introduction.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from pinot_tpu.cache.keys import EXECUTION_ONLY_OPTIONS
+
+# Options that change WHAT a query returns (or whose effect on returned
+# rows is uncertain enough that conservative fingerprint-folding is the
+# right call). Each entry is a deliberate decision, not a default:
+RESULT_AFFECTING = {
+    # response shape/content:
+    "analyze",             # EXPLAIN ANALYZE renders a plan table
+    "enablenullhandling",  # flips null comparison semantics
+    "numgroupslimit",      # changes which groups survive trimming
+    "allowpartialresults", # permits responses missing shards
+    # conservative (execution strategy, but float reduction order or
+    # trim interplay can alter returned cells in the low bits):
+    "usefusedkernel",
+    "sparsegroupby",
+}
+
+
+def _options_read_in_source() -> set:
+    """Every literal SET-option name the engine reads from
+    query_options, lowercased."""
+    root = Path(__file__).resolve().parent.parent / "pinot_tpu"
+    direct = re.compile(r'query_options(?:\.get\(|\[)\s*"([a-zA-Z]+)"')
+    # the iterate-and-compare idiom (mse/runtime.py deviceJoin): only
+    # counts when query_options is what's being iterated nearby, so
+    # header/dict compares elsewhere don't leak in
+    compared = re.compile(r'k\.lower\(\)\s*==\s*"([a-z]+)"')
+    found = set()
+    for p in root.rglob("*.py"):
+        text = p.read_text()
+        found.update(m.lower() for m in direct.findall(text))
+        for m in compared.finditer(text):
+            if "query_options" in text[max(0, m.start() - 300):m.start()]:
+                found.add(m.group(1).lower())
+    return found
+
+
+def test_every_read_option_is_classified():
+    found = _options_read_in_source()
+    # sanity: the scanner sees the well-known options, so an empty scan
+    # can never masquerade as a clean audit
+    assert {"trace", "timeoutms", "segmentcache", "coalesce"} <= found
+    unclassified = found - EXECUTION_ONLY_OPTIONS - RESULT_AFFECTING
+    assert not unclassified, (
+        f"SET option(s) {sorted(unclassified)} read by the engine but "
+        "classified neither execution-only (cache/keys.py "
+        "EXECUTION_ONLY_OPTIONS) nor deliberately result-affecting "
+        "(RESULT_AFFECTING in this test). Decide which and add it.")
+
+
+def test_classifications_do_not_overlap():
+    both = EXECUTION_ONLY_OPTIONS & RESULT_AFFECTING
+    assert not both, f"options classified both ways: {sorted(both)}"
+
+
+def test_execution_only_entries_are_lowercase():
+    # the membership check lowercases the query's key; a mixed-case
+    # entry here would never match anything
+    assert all(o == o.lower() for o in EXECUTION_ONLY_OPTIONS)
+    assert all(o == o.lower() for o in RESULT_AFFECTING)
+
+
+def test_coalesce_is_execution_only():
+    """The new knob: coalescing changes HOW (shared dispatch), never
+    WHAT — results are bit-identical by construction, so queries with
+    and without it share cache entries."""
+    assert "coalesce" in EXECUTION_ONLY_OPTIONS
