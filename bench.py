@@ -8,6 +8,7 @@ Configs (BASELINE.md, scaled to BENCH_ROWS total rows each):
   q5  NYC-Taxi-style COUNT DISTINCT + PERCENTILE_TDIGEST GROUP BY day
   q6  sparse COUNT DISTINCT inside a high-card group-by
   q7  LOOKUP star join    q8  MSE equi-join    q9  3-SUM group-by
+  q9j MSE LEFT join (residual ON filter)   q10  MSE 2-join chain
 
 Architecture (hardened after rounds 1-2 produced zero TPU artifacts):
   * The PARENT process never touches the accelerator. It probes it in a
@@ -55,7 +56,8 @@ _START = time.monotonic()
 # q6 runs LAST: its sparse-distinct program has the slowest cold compile,
 # and a hung/abandoned child skips every config after it
 CONFIGS = [c for c in os.environ.get(
-    "BENCH_CONFIGS", "q1,q2,q9,q3,q4,q5,q7,q8,q3m,q6m,q6").split(",") if c]
+    "BENCH_CONFIGS",
+    "q1,q2,q9,q3,q4,q5,q7,q8,q9j,q10,q3m,q6m,q6").split(",") if c]
 ROOT = Path(__file__).parent
 CACHE = ROOT / ".bench_cache"
 # smoke/dev runs point this elsewhere (BENCH_PARTIAL_DIR) so they never
@@ -100,6 +102,23 @@ Q8 = ("SELECT a.d_year, COUNT(*), SUM(b.lo_revenue) FROM {t} a "
 Q9 = ("SELECT d_year, p_brand, SUM(lo_revenue), SUM(lo_extendedprice), "
       "SUM(lo_quantity) FROM {t} WHERE s_region = 'ASIA' "
       "GROUP BY d_year, p_brand LIMIT 10000")
+# LEFT outer variant of q8: the build-side ON conjunct must stay join
+# residual (a WHERE would flip the semantics to INNER), exercising the
+# fused kernel's masked-count path; unmatched probe rows keep COUNT(*)=1
+# and NULL SUM. Selectivities match q8 → same ~0.036·N pair bound.
+Q9J = ("SELECT a.d_year, COUNT(*), SUM(b.lo_revenue) FROM {t} a "
+       "LEFT JOIN {t} b ON a.lo_orderkey = b.lo_orderkey "
+       "AND b.lo_discount = 0 WHERE a.lo_quantity < 3 "
+       "GROUP BY a.d_year ORDER BY a.d_year LIMIT 100")
+# 2-join chain: the middle join is absorbed into the top fused stage
+# (runtime chain absorption) so the whole pipeline crosses the host once.
+# c's filter multiplies q8's pair bound by ~0.2 → ~0.007·N output pairs.
+Q10 = ("SELECT a.d_year, COUNT(*), SUM(c.lo_revenue) FROM {t} a "
+       "JOIN {t} b ON a.lo_orderkey = b.lo_orderkey "
+       "JOIN {t} c ON b.lo_orderkey = c.lo_orderkey "
+       "WHERE a.lo_quantity < 3 AND b.lo_discount = 0 "
+       "AND c.lo_quantity < 2 "
+       "GROUP BY a.d_year ORDER BY a.d_year LIMIT 100")
 
 RUNS = {
     "q1": ("q1_filter_sum", Q1.format(t="ssb"), "ssb", 1.0, 0.0),
@@ -118,6 +137,8 @@ RUNS = {
     "q7": ("q7_lookup_join", Q7.format(t="ssb"), "ssb", 1.0, 0.0),
     "q8": ("q8_mse_join", Q8.format(t="ssb"), "ssb", 1 / 3, 0.0),
     "q9": ("q9_groupby_3sums", Q9.format(t="ssb"), "ssb", 1.0, 0.0),
+    "q9j": ("q9j_mse_left_join", Q9J.format(t="ssb"), "ssb", 1 / 3, 0.0),
+    "q10": ("q10_mse_join_chain", Q10.format(t="ssb"), "ssb", 1 / 3, 0.0),
     # multi-segment (16) variants: the stacked segment-batching configs —
     # num_device_dispatches should track batch FAMILIES, not segments
     "q3m": ("q3m_highcard_groupby16", Q3.format(t="ssb16"), "ssb16",
@@ -997,6 +1018,11 @@ def run_single(cfg: str, outpath: str):
         # count 0); the bench gate fails MSE configs that regress this
         payload["shuffled_bytes"] = sum(
             st.get("cross_stage_bytes", st.get("shuffled_bytes", 0))
+            for st in stage_stats.values())
+        # device→host round-trips taken by fused stages (1 per fused plan;
+        # a regression here means a plan fell back to per-operator hops)
+        payload["host_crossings"] = sum(
+            int(st.get("host_crossings", 0) or 0)
             for st in stage_stats.values())
     if kernel_s is not None:
         # measured pure-kernel time for ONE segment's program (all fixed

@@ -88,8 +88,18 @@ class DataTableCorruptionError(DataTableError):
 
 # -- tagged value encoding ----------------------------------------------------
 
+# lifetime count of tagged-value encodes (the row-wise wire path). The
+# device-packed exchange ships one PTDP blob instead; its perf guard pins
+# this counter's delta to ZERO across a packed send.
+_ROW_ENCODES = [0]
+
+
+def row_encodes() -> int:
+    return _ROW_ENCODES[0]
+
 
 def _w_value(out: bytearray, v: Any) -> None:
+    _ROW_ENCODES[0] += 1
     if v is None:
         out.append(_T_NONE)
     elif isinstance(v, (bool, np.bool_)):
@@ -376,3 +386,97 @@ def decode(blob: bytes):
 
 def _to_tag(t):
     return tuple(t) if isinstance(t, list) else t
+
+
+# -- device-packed exchange block (PTDP) --------------------------------------
+#
+# The MSE cross-server shuffle's fast wire format: every numeric column of
+# an exchange block is byte-packed into ONE buffer by the device kernel
+# (ops/kernels._pack_u8 — the PR-12 mesh combine pack), so the host path
+# is memcpy→socket with zero per-row Python encodes. Its own magic keeps
+# it loudly incompatible with the row-wise PTDT container: an old reader
+# handed a PTDP blob raises DataTableError instead of misparsing.
+#
+# Layout (little-endian):
+#
+#     magic  b"PTDP"
+#     u16    version (=1)
+#     u32    column-header JSON length, then the JSON
+#            {"cols": [{"name", "dtype", "shape"}, ...]}
+#     u32    crc32 of the packed payload  ┐ integrity, checked before the
+#     u64    payload length               ┘ receiver touches the bytes
+#     ...    payload: the packed u8 buffer
+
+PACKED_MAGIC = b"PTDP"
+PACKED_VERSION = 1
+
+
+def packable_block(block: dict) -> bool:
+    """True iff every column is a 1-D numeric/bool numpy array — the
+    shapes the device pack kernel serializes. Object (string) columns keep
+    the row-wise path."""
+    return bool(block) and all(
+        isinstance(v, np.ndarray) and v.ndim == 1 and v.dtype.kind in "biuf"
+        for v in block.values())
+
+
+def is_packed_blob(blob) -> bool:
+    return isinstance(blob, (bytes, bytearray, memoryview)) \
+        and bytes(blob[:4]) == PACKED_MAGIC
+
+
+def encode_packed_block(block: dict) -> bytes:
+    """Pack an exchange block into one PTDP blob via the on-device byte
+    pack. The only host work is the header JSON and one memcpy of the
+    packed buffer."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import kernels
+
+    jax.config.update("jax_enable_x64", True)
+    cols, arrs = [], []
+    for name, v in block.items():
+        a = np.ascontiguousarray(v)
+        cols.append({"name": name, "dtype": a.dtype.str,
+                     "shape": list(a.shape)})
+        arrs.append(jnp.asarray(a))
+    payload = np.asarray(kernels._pack_u8(tuple(arrs))).tobytes()
+    header = json.dumps({"cols": cols}).encode()
+    out = bytearray(PACKED_MAGIC)
+    out += struct.pack("<H", PACKED_VERSION)
+    out += struct.pack("<I", len(header)) + header
+    out += struct.pack("<IQ", zlib.crc32(payload), len(payload))
+    out += payload
+    return bytes(out)
+
+
+def decode_packed_block(blob: bytes) -> dict:
+    """PTDP blob → column block (zero-copy views over the payload where
+    the dtype allows; the receiver's device_put consumes them)."""
+    from ..ops import kernels
+
+    if bytes(blob[:4]) != PACKED_MAGIC:
+        raise DataTableError("not a PTDP packed block")
+    (version,) = struct.unpack_from("<H", blob, 4)
+    if version != PACKED_VERSION:
+        raise DataTableError(
+            f"unsupported packed-block version {version}")
+    (hlen,) = struct.unpack_from("<I", blob, 6)
+    pos = 10
+    header = json.loads(bytes(blob[pos:pos + hlen]).decode())
+    pos += hlen
+    crc, plen = struct.unpack_from("<IQ", blob, pos)
+    pos += 12
+    payload = bytes(blob[pos:pos + plen])
+    if len(payload) != plen:
+        raise DataTableCorruptionError("truncated packed block")
+    if zlib.crc32(payload) != crc:
+        raise DataTableCorruptionError(
+            f"packed block checksum mismatch (crc32 "
+            f"{zlib.crc32(payload):08x} != header {crc:08x})")
+    flat = np.frombuffer(payload, dtype=np.uint8)
+    metas = [(np.dtype(c["dtype"]), tuple(c["shape"]))
+             for c in header["cols"]]
+    arrs = kernels._split_flat(flat, metas)
+    return {c["name"]: a for c, a in zip(header["cols"], arrs)}

@@ -26,7 +26,10 @@ compile; deserialize+compile work happens only at prewarm time.
 
 Knobs: ``PINOT_TPU_AOT_CACHE_DIR`` (unset = disabled),
 ``PINOT_TPU_AOT_CACHE_MB`` (byte budget, default 256),
-``PINOT_TPU_AOT_PREWARM_TOP_K`` (families prewarmed per table, default 4).
+``PINOT_TPU_AOT_PREWARM_BUDGET_MS`` (expected-compile-cost budget per
+prewarm, default 5000 — ranked by live registry cost×recency score),
+``PINOT_TPU_AOT_PREWARM_TOP_K`` (optional flat-count override of the
+budget).
 """
 
 from __future__ import annotations
@@ -353,27 +356,60 @@ def _raw_table(name) -> str:
     return s
 
 
+def _budget_candidates(items: list) -> list:
+    """Cost-budgeted prewarm order: rank families by the LIVE registry
+    score when the fingerprint is tracked in this process (compile cost ×
+    dispatch recency — a family hot NOW outranks one that was merely
+    expensive once), falling back to the persisted manifest score, then
+    admit best-first while the summed expected compile cost stays within
+    PINOT_TPU_AOT_PREWARM_BUDGET_MS (greedy fill: a family too costly for
+    the remaining budget is skipped, cheaper ones behind it may still fit).
+    Always admits at least one family so a cold process warms its most
+    valuable executable."""
+    budget_ms = float(os.environ.get(
+        "PINOT_TPU_AOT_PREWARM_BUDGET_MS", 5000.0))
+    from .compile_registry import COMPILE_REGISTRY
+
+    live = {fp: score for fp, score, _fam in COMPILE_REGISTRY.aot_priority()}
+    ranked = sorted(
+        ((live.get(m.get("fingerprint"), float(m.get("score", 0.0))),
+          float(m.get("score", 0.0)), name) for name, m in items),
+        reverse=True)
+    out, spent = [], 0.0
+    for _rank, cost_ms, name in ranked:
+        if out and spent + cost_ms > budget_ms:
+            continue
+        out.append(name)
+        spent += cost_ms
+    return out
+
+
 def prewarm_table(table, top_k: int = None) -> dict:
     """Deserialize + warm the table's top-scored persisted families
     (segment-load / prefetch hook). All compile cost lands HERE, off the
-    serving path, timed as aotPrewarmMs."""
+    serving path, timed as aotPrewarmMs. Admission is budgeted by expected
+    compile cost (PINOT_TPU_AOT_PREWARM_BUDGET_MS) unless a flat count is
+    forced via the top_k arg or PINOT_TPU_AOT_PREWARM_TOP_K."""
     if not enabled():
         return {"loaded": 0, "refused": 0}
     d = cache_dir()
-    k = int(top_k if top_k is not None else
-            os.environ.get("PINOT_TPU_AOT_PREWARM_TOP_K", 4))
+    env_k = os.environ.get("PINOT_TPU_AOT_PREWARM_TOP_K")
     t0 = time.perf_counter()
     want = None if table is None else _raw_table(table)
     with _LOCK:
         manifest = _load_manifest(d)
-        cand = sorted(
-            ((float(m.get("score", 0.0)), name)
-             for name, m in manifest["files"].items()
-             if want is None or _raw_table(m.get("table")) == want),
-            reverse=True)[:k]
+        items = [(name, m) for name, m in manifest["files"].items()
+                 if want is None or _raw_table(m.get("table")) == want]
+    if top_k is not None or env_k:
+        k = int(top_k if top_k is not None else env_k)
+        cand = [name for _, name in sorted(
+            ((float(m.get("score", 0.0)), name) for name, m in items),
+            reverse=True)[:k]]
+    else:
+        cand = _budget_candidates(items)
     loaded = refused = 0
     tag = env_tag()
-    for _, name in cand:
+    for name in cand:
         if load_artifact(os.path.join(d, name), expect_tag=tag) is not None:
             loaded += 1
         else:

@@ -611,7 +611,17 @@ class TpuSegmentExecutor:
                     dspan.set_attribute("deviceExecMs", ms)
         t2 = time.perf_counter()
         if pack:
-            result = pmesh.pack_outputs_gathered(outs, len(segments))
+            try:
+                # preferred: shuffle-inside-the-program — all_gather over
+                # the mesh axis + on-device pack, no dev0 funnel of raw outs
+                result = pmesh.pack_outputs_collective(
+                    outs, len(segments), ndev)
+            except Exception as e:
+                from .oom import HbmExhaustedError
+
+                if isinstance(e, HbmExhaustedError):
+                    raise
+                result = pmesh.pack_outputs_gathered(outs, len(segments))
             sync_target = result.flat
         else:
             result = pmesh.gather_outputs(outs, len(segments))

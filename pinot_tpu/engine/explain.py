@@ -191,7 +191,8 @@ _ANALYZE_ATTRS = ("segment", "numSegments", "segments", "device",
                   "meshDevices", "mode", "padded",
                   "fused", "workers", "leaf_pushdown", "rows_in", "rows_out",
                   "shuffled_rows", "shuffled_bytes", "join_impl",
-                  "cross_stage_bytes", "device_partition_ms", "compileMs",
+                  "cross_stage_bytes", "device_partition_ms",
+                  "host_crossings", "compileMs",
                   "deviceExecMs", "crossChipCombineMs", "transferBytes",
                   "cache")
 

@@ -213,10 +213,11 @@ def env_mode() -> str:
 
 @dataclass
 class FusedStagePlan:
-    """Shape proof that a stage is ``Aggregate ← INNER equi-Join ← two hash
-    receives`` with aggregates the device kernel can produce. Built once
-    per query by plan_fused_stage; None means the stage keeps the generic
-    host operator tree."""
+    """Shape proof that a stage is ``Aggregate ← equi-Join ← two hash
+    receives`` (INNER/LEFT/SEMI/ANTI, optional side-separable residual)
+    with aggregates the device kernel can produce. Built once per query by
+    plan_fused_stage; None means the stage keeps the generic host operator
+    tree."""
     agg_node: object
     join_node: object
     receives: tuple            # (left recv, right recv) MailboxReceiveNodes
@@ -224,6 +225,37 @@ class FusedStagePlan:
     group_cols: list = field(default_factory=list)   # (schema name, probe col)
     # (kind, "probe"|"build"|None, value col name|None, out_name) per agg
     aggs: list = field(default_factory=list)
+    join_type: str = "INNER"
+    # residual conjuncts: (rel "probe"|"build", expr, [(blk key, side col)])
+    residual: list = field(default_factory=list)
+    # absorbed upstream join chain: which join input it replaces + source
+    chain_side: Optional[str] = None    # "left" | "right" | None
+    chain: object = None                # ChainSource | None
+
+
+@dataclass
+class ChainSource:
+    """An upstream join stage absorbed into a fused stage: its output
+    table never materializes — the fused stage expands the join on row
+    INDICES and its leaf blocks hand off raw through the mailbox, so
+    intermediates stay in HBM (values) or never exist (pairs)."""
+    stage_id: int
+    join_node: object
+    left: object     # MailboxReceiveNode | ChainSource
+    right: object    # MailboxReceiveNode | ChainSource
+
+    def leaf_receives(self):
+        for side in (self.left, self.right):
+            if isinstance(side, ChainSource):
+                yield from side.leaf_receives()
+            else:
+                yield side
+
+    def stage_ids(self):
+        yield self.stage_id
+        for side in (self.left, self.right):
+            if isinstance(side, ChainSource):
+                yield from side.stage_ids()
 
 
 def _match_col(name: str, schema: list) -> Optional[str]:
@@ -231,6 +263,58 @@ def _match_col(name: str, schema: list) -> Optional[str]:
         return name
     suffix = [c for c in schema if c.endswith("." + name)]
     return suffix[0] if len(suffix) == 1 else None
+
+
+def _conjuncts(e) -> list:
+    """Flatten an AND-tree into its conjunct expressions."""
+    if e.is_function and e.function.name == "and":
+        out = []
+        for a in e.function.arguments:
+            out.extend(_conjuncts(a))
+        return out
+    return [e]
+
+
+def _plan_residual(residual, lschema, rschema) -> Optional[list]:
+    """Decompose a residual filter into per-side conjuncts the device can
+    apply as row masks. Each conjunct must reference exactly ONE side
+    (then pair-filtering factorizes into a probe mask × a build mask) and
+    resolve unambiguously under the same naming rule the host's
+    _residual_block applies (right-side duplicate names carry a "0"
+    suffix). Returns [(side, expr, [(eval-block key, side column)])] or
+    None — ambiguous/cross-side conjuncts keep the host path, which also
+    owns the host's error behavior for unresolvable names."""
+    from . import operators
+
+    out = []
+    for conj in _conjuncts(residual):
+        ids: set = set()
+        operators._expr_ids(conj, ids)
+        if not ids:
+            return None       # literal-only conjunct: host path
+        side, cols = None, []
+        for i in ids:
+            lc, rc = _match_col(i, lschema), _match_col(i, rschema)
+            if lc is not None and rc is not None:
+                return None   # ambiguous across sides (host raises)
+            if lc is not None:
+                got, key, col = "left", lc, lc
+            elif rc is not None:
+                got, key, col = "right", rc, rc
+            elif (i.endswith("0") and i[:-1] in rschema
+                    and i[:-1] in lschema):
+                # the host's dup rename: right column shadowed by a
+                # same-named left column surfaces as <name>0
+                got, key, col = "right", i, i[:-1]
+            else:
+                return None
+            if side is None:
+                side = got
+            elif side != got:
+                return None   # conjunct spans both sides
+            cols.append((key, col))
+        out.append((side, conj, cols))
+    return out
 
 
 def plan_fused_stage(stage) -> Optional[FusedStagePlan]:
@@ -241,9 +325,9 @@ def plan_fused_stage(stage) -> Optional[FusedStagePlan]:
     if not isinstance(agg, AggregateNode) or not agg.group_exprs:
         return None
     join = agg.inputs[0]
-    if (not isinstance(join, JoinNode) or join.join_type != "INNER"
-            or join.residual is not None or not join.left_keys
-            or len(join.inputs) != 2):
+    if (not isinstance(join, JoinNode)
+            or join.join_type not in ("INNER", "LEFT", "SEMI", "ANTI")
+            or not join.left_keys or len(join.inputs) != 2):
         return None
     recv_l, recv_r = join.inputs
     if not all(isinstance(r, MailboxReceiveNode) and r.dist == "hash"
@@ -271,6 +355,10 @@ def plan_fused_stage(stage) -> Optional[FusedStagePlan]:
         # codes — host path handles it
         return None
     probe_side = sides.pop()
+    if join.join_type in ("LEFT", "SEMI", "ANTI") and probe_side != "left":
+        # LEFT preserves the left side (probe must be the preserved side);
+        # SEMI/ANTI project the left side only
+        return None
 
     aggs = []
     for call in agg.agg_calls:
@@ -286,30 +374,201 @@ def plan_fused_stage(stage) -> Optional[FusedStagePlan]:
         if got is None:
             return None
         rel = "probe" if got[0] == probe_side else "build"
+        if rel == "build" and join.join_type in ("SEMI", "ANTI"):
+            return None    # output schema is probe-side only
         aggs.append((call.name, rel, got[1], call.out_name))
+
+    residual = []
+    if join.residual is not None:
+        planned = _plan_residual(join.residual, lschema, rschema)
+        if planned is None:
+            return None
+        residual = [("probe" if side == probe_side else "build", expr, cols)
+                    for side, expr, cols in planned]
     return FusedStagePlan(agg, join, (recv_l, recv_r), probe_side,
-                          group_cols, aggs)
+                          group_cols, aggs, join.join_type, residual)
+
+
+def plan_chain_source(stage) -> Optional[ChainSource]:
+    """One absorbable chain level: a stage whose whole output is a plain
+    INNER equi-join of two hash receives (no residual, no other
+    operators). The runtime nests these and rewires the leaves' mailboxes
+    straight to the consuming fused stage."""
+    from .fragmenter import MailboxReceiveNode
+    from .logical import JoinNode
+
+    join = stage.root
+    if (not isinstance(join, JoinNode) or join.join_type != "INNER"
+            or join.residual is not None or not join.left_keys
+            or len(join.inputs) != 2):
+        return None
+    if not all(isinstance(r, MailboxReceiveNode) and r.dist == "hash"
+               for r in join.inputs):
+        return None
+    return ChainSource(stage.stage_id, join, join.inputs[0], join.inputs[1])
+
+
+def _src_schema(side) -> list:
+    return list(side.join_node.schema if isinstance(side, ChainSource)
+                else side.schema)
+
+
+def chain_resolve(src: ChainSource, name: str):
+    """Resolve an output column of an absorbed join to its leaf receive
+    node + leaf column, through the host joiner's naming rule (left wins
+    name collisions; the shadowed right column carries a "0" suffix).
+    None when the fused consumer could not reconstruct the column."""
+    lsch, rsch = _src_schema(src.left), _src_schema(src.right)
+    if name in lsch:
+        side, col = src.left, name
+    elif name in rsch:
+        side, col = src.right, name
+    elif name.endswith("0") and name[:-1] in rsch:
+        side, col = src.right, name[:-1]
+    else:
+        return None
+    if isinstance(side, ChainSource):
+        return chain_resolve(side, col)
+    return (side, col)
+
+
+# -- chain expansion: joins as composed row indices --------------------------
+
+
+class _SideView:
+    """A join input as (leaf array, composed row index) pairs: column
+    VALUES stay in their leaf blocks; only int indices materialize."""
+    n: int
+
+    def raw(self, name):
+        raise NotImplementedError
+
+    def host_col(self, name) -> np.ndarray:
+        arr, idx = self.raw(name)
+        return arr if idx is None else arr[idx]
+
+
+class _LeafView(_SideView):
+    def __init__(self, block: dict, n: int):
+        self.block, self.n = block, n
+
+    def raw(self, name):
+        return np.asarray(self.block[name]), None
+
+
+class _JoinView(_SideView):
+    """An expanded chain level: left/right views + the (lidx, ridx) pair
+    indices of the equi-join between them (exactly the host joiner's
+    argsort/searchsorted expansion, so pair sets match bit-for-bit)."""
+
+    def __init__(self, src: ChainSource, left, right, lidx, ridx, n):
+        self.src, self.left, self.right = src, left, right
+        self.lidx, self.ridx, self.n = lidx, ridx, n
+        self._memo: dict = {}
+
+    def raw(self, name):
+        lsch, rsch = _src_schema(self.src.left), _src_schema(self.src.right)
+        if name in lsch:
+            side, col, idx = self.left, name, self.lidx
+        elif name in rsch:
+            side, col, idx = self.right, name, self.ridx
+        elif name.endswith("0") and name[:-1] in rsch:
+            side, col, idx = self.right, name[:-1], self.ridx
+        else:
+            raise KeyError(name)
+        arr, sub = side.raw(col)
+        key = (id(side), sub is None)
+        if sub is not None:
+            key = (id(side), id(sub))
+        if key not in self._memo:
+            self._memo[key] = idx if sub is None else sub[idx]
+        return arr, self._memo[key]
+
+
+def expand_chain(src: ChainSource, get_leaf, ctx=None):
+    """Expand an absorbed chain into a _JoinView bottom-up on the host's
+    OWN join machinery (joint codes + stable argsort + searchsorted +
+    repeat — the exact expansion op_join performs), but producing only
+    index vectors. Returns None when a level's pair count exceeds
+    MAX_ROWS_IN_JOIN — the host fallback owns THROW/BREAK semantics."""
+    from . import operators
+
+    def build(node):
+        if not isinstance(node, ChainSource):
+            block, n = get_leaf(node)
+            return _LeafView(block, n)
+        lv, rv = build(node.left), build(node.right)
+        if lv is None or rv is None:
+            return None
+        join = node.join_node
+        lcodes, rcodes = operators._joint_codes(
+            [lv.host_col(k) for k in join.left_keys],
+            [rv.host_col(k) for k in join.right_keys], lv.n, rv.n, ctx)
+        rs = np.argsort(rcodes, kind="stable")
+        rsorted = rcodes[rs]
+        starts = np.searchsorted(rsorted, lcodes, side="left")
+        ends = np.searchsorted(rsorted, lcodes, side="right")
+        counts = ends - starts
+        total = int(counts.sum())
+        if total > operators.MAX_ROWS_IN_JOIN:
+            return None
+        lidx = np.repeat(np.arange(lv.n), counts)
+        offs = np.arange(total) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        ridx = rs[np.repeat(starts, counts) + offs]
+        return _JoinView(node, lv, rv, lidx, ridx, total)
+
+    return build(src)
+
+
+def host_expand_chain(src: ChainSource, get_leaf, ctx=None) -> dict:
+    """Materialize an absorbed chain as the block its stage would have
+    sent, via the host joiner itself — the fused fallback path for
+    absorbed plans (exact semantics including the join-row guards)."""
+    from . import operators
+
+    def build(node):
+        if not isinstance(node, ChainSource):
+            return get_leaf(node)[0]
+        lb, rb = build(node.left), build(node.right)
+        j = node.join_node
+        return operators.op_join(lb, rb, j.join_type, j.left_keys,
+                                 j.right_keys, j.residual, list(j.schema),
+                                 ctx)
+
+    return build(src)
+
+
+def _as_view(side) -> _SideView:
+    if isinstance(side, _SideView):
+        return side
+    from .mailbox import block_len
+
+    return _LeafView(side, block_len(side))
 
 
 def run_fused(left, right, plan: FusedStagePlan, ctx=None):
-    """Execute a fused stage device-resident. Returns (block, info) or
-    None when any gate fails (dtype, empty side, plane overflow, join row
-    limit) — the caller's host fallback owns exact semantics for those."""
+    """Execute a fused stage device-resident. ``left``/``right`` are
+    blocks or chain _SideViews (absorbed upstream joins). Returns
+    (block, info) or None when any gate fails (dtype, empty side, plane
+    overflow, join row limit, non-bool residual) — the caller's host
+    fallback owns exact semantics for those."""
     if _FAILED:
         return None
     from . import operators
     from ..ops import join_pipeline as jp
-    from .mailbox import block_len
 
-    ln, rn = block_len(left), block_len(right)
+    lview, rview = _as_view(left), _as_view(right)
+    ln, rn = lview.n, rview.n
     if ln == 0 or rn == 0:
         return None
     join = plan.join_node
     lcodes, rcodes = operators._joint_codes(
-        [np.asarray(left[k]) for k in join.left_keys],
-        [np.asarray(right[k]) for k in join.right_keys], ln, rn, ctx)
+        [lview.host_col(k) for k in join.left_keys],
+        [rview.host_col(k) for k in join.right_keys], ln, rn, ctx)
 
-    probe, build = (left, right) if plan.probe_side == "left" else (right, left)
+    probe, build = ((lview, rview) if plan.probe_side == "left"
+                    else (rview, lview))
     pcodes, bcodes = ((lcodes, rcodes) if plan.probe_side == "left"
                       else (rcodes, lcodes))
     pn, bn = len(pcodes), len(bcodes)
@@ -326,12 +585,30 @@ def run_fused(left, right, plan: FusedStagePlan, ctx=None):
     # reduction-order-free; float args would make partition order visible
     pv_names = [c for k, s, c, _ in plan.aggs if s == "probe"]
     bv_names = [c for k, s, c, _ in plan.aggs if s == "build"]
-    for side_block, names in ((probe, pv_names), (build, bv_names)):
+    for side_view, names in ((probe, pv_names), (build, bv_names)):
         for nm in dict.fromkeys(names):
-            if not operators._int_like(np.asarray(side_block[nm])):
+            arr, _ = side_view.raw(nm)
+            if not operators._int_like(np.asarray(arr)):
                 return None
 
-    gcols = [np.asarray(probe[c]) for _, c in plan.group_cols]
+    # residual conjuncts factorize into per-side row masks; each must
+    # evaluate to a real boolean vector (then the host's AND/_truthy and
+    # the device's mask multiply agree exactly — NaN truthiness never
+    # enters), else the host path owns the semantics
+    pmask = bmask = None
+    for rel, expr, cols in plan.residual:
+        view = probe if rel == "probe" else build
+        blk = {key: view.host_col(col) for key, col in cols}
+        m = np.asarray(operators.eval_expr(
+            expr, blk, probe.n if rel == "probe" else build.n))
+        if m.ndim != 1 or m.dtype != np.bool_:
+            return None
+        if rel == "probe":
+            pmask = m if pmask is None else (pmask & m)
+        else:
+            bmask = m if bmask is None else (bmask & m)
+
+    gcols = [probe.host_col(c) for _, c in plan.group_cols]
     gcodes, num, first = operators.group_codes(gcols)
     if num == 0:
         return None
@@ -355,20 +632,47 @@ def run_fused(left, right, plan: FusedStagePlan, ctx=None):
         out[:len(a)] = a
         return out
 
+    def padmask(m, n_to):
+        out = np.zeros(n_to, dtype=bool)
+        out[:len(m)] = m
+        return out
+
+    dispatches = [3]
+
+    def side_vals(view, order, n_to):
+        """Value plane of one side: plain blocks pad on host; chained
+        sides gather ON DEVICE through the composed chain indices (one
+        dispatch per distinct leaf), so chain values never materialize
+        host-side."""
+        if not order:
+            return np.zeros((1, n_to))
+        if isinstance(view, _LeafView):
+            return np.stack([pad1(np.asarray(view.block[c], np.float64),
+                                  n_to, np.float64) for c in order])
+        import jax.numpy as jnp
+
+        groups: dict = {}
+        for pos, c in enumerate(order):
+            arr, idx = view.raw(c)
+            groups.setdefault(id(idx), (idx, []))[1].append((pos, arr))
+        parts = [None] * len(order)
+        for idx, cols in groups.values():
+            plane = jp.gather_stack([a for _, a in cols], idx, view.n, n_to)
+            dispatches[0] += 1
+            for row, (pos, _) in enumerate(cols):
+                parts[pos] = plane[row]
+        return jnp.stack(parts)
+
     pv_order = list(dict.fromkeys(pv_names))
     bv_order = list(dict.fromkeys(bv_names))
-    pvals = np.stack([pad1(np.asarray(probe[c], dtype=np.float64), Np,
-                           np.float64) for c in pv_order]) \
-        if pv_order else np.zeros((1, Np))
-    bvals = np.stack([pad1(np.asarray(build[c], dtype=np.float64), Nb,
-                           np.float64) for c in bv_order]) \
-        if bv_order else np.zeros((1, Nb))
     spec = tuple(
         ("count", "probe", 0) if k == "count"
         else (k, s, (pv_order if s == "probe" else bv_order).index(c))
         for k, s, c, _ in plan.aggs)
 
     try:
+        pvals = side_vals(probe, pv_order, Np)
+        bvals = side_vals(build, bv_order, Nb)
         pk = pad1(pcodes, Np, np.int64)
         bk = pad1(bcodes, Nb, np.int64)
         pg = pad1(gcodes, Np, np.int64)
@@ -377,28 +681,41 @@ def run_fused(left, right, plan: FusedStagePlan, ctx=None):
         pplane, pcounts = jp.partition_planes(pk, pn, P, cap_l)
         bplane, bcounts = jp.partition_planes(bk, bn, P, cap_r,
                                               key_sorted=True, cmin=bmin)
-        packed = jp.fused_join_agg(pk, pg, pvals, pplane, pcounts,
-                                   bk, bvals, bplane, bcounts,
-                                   pn, bn, spec, P, Gp)
+        packed = jp.fused_join_agg(
+            pk, pg, pvals, pplane, pcounts, bk, bvals, bplane, bcounts,
+            pn, bn, spec, P, Gp, join_type=plan.join_type,
+            pmask=padmask(pmask, Np) if pmask is not None else None,
+            bmask=padmask(bmask, Nb) if bmask is not None else None)
         out = jp.fetch_packed(packed)
     except Exception as e:
         note_failure(e)
         return None
 
     n_aggs = len(plan.aggs)
-    meta = out[n_aggs + 1]
+    meta = out[n_aggs + 2]
     total_pairs = int(meta[0])
     if meta[1] != 0.0 or total_pairs > operators.MAX_ROWS_IN_JOIN:
         # plane overflow (key skew beyond the cap headroom) or the join row
         # guard: the host path owns THROW/BREAK semantics
         return None
-    pair_cnt = out[n_aggs][:num]
-    present = pair_cnt > 0
+    w_row = out[n_aggs][:num]         # output rows per group
+    match_row = out[n_aggs + 1][:num]  # matched pairs per group
+    present = w_row > 0
 
     block = {}
     for (out_name, col), kv in zip(plan.group_cols, gcols):
         block[out_name] = kv[first][present]
-    for i, (kind, _s, _c, out_name) in enumerate(plan.aggs):
+    no_match = match_row[present] == 0
+    for i, (kind, s, _c, out_name) in enumerate(plan.aggs):
         vals = out[i][:num][present]
-        block[out_name] = vals.astype(np.int64) if kind == "count" else vals
-    return block, {"total_pairs": total_pairs, "dispatches": 3}
+        if kind == "count":
+            block[out_name] = vals.astype(np.int64)
+            continue
+        if s == "build" and no_match.any():
+            # a group whose every output row is LEFT-padded aggregates
+            # NULL build payload — the host emits NaN there
+            vals = vals.copy()
+            vals[no_match] = np.nan
+        block[out_name] = vals
+    return block, {"total_pairs": total_pairs,
+                   "dispatches": dispatches[0]}

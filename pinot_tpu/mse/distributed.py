@@ -43,10 +43,12 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..cluster import datatable
 from ..engine.aggregation import UnsupportedQueryError
 from ..engine.reduce import BrokerReducer
 from ..engine.results import BrokerResponse
 from ..spi import faults
+from ..spi.metrics import SERVER_METRICS, ServerMeter
 from ..spi.trace import TRACING
 from ..query.converter import filter_from_expression
 from ..query.expressions import ExpressionContext
@@ -72,10 +74,34 @@ MAILBOX_BUFFER_BYTES = int(os.environ.get(
     "PINOT_TPU_MSE_MAILBOX_BUFFER_BYTES", 64 << 20))
 # ceiling on waiting for senders (a crashed producer must not hang a worker)
 MAILBOX_WAIT_S = float(os.environ.get("PINOT_TPU_MSE_MAILBOX_WAIT_S", 300))
+# blocks at least this large cross servers as ONE device-packed byte blob
+# (the PR-12 byte-pack kernel flattens the columns on device; the host side
+# is a single memcpy to the socket instead of per-row DataTable encodes)
+DEVICE_PACK_MIN_BYTES = int(os.environ.get(
+    "PINOT_TPU_DEVICE_PACK_MIN_BYTES", 1 << 20))
 
 
 def _block_nbytes(block: Block) -> int:
     return sum(np.asarray(v).nbytes for v in block.values())
+
+
+def _wire_packable(block: Block) -> bool:
+    """Eligible for the device-packed wire format: numeric columnar block at
+    least DEVICE_PACK_MIN_BYTES (below that, framing a second format is not
+    worth skipping the row encodes)."""
+    return (block is not None and _block_nbytes(block) >= DEVICE_PACK_MIN_BYTES
+            and datatable.packable_block(block))
+
+
+def _pack_for_wire(block: Block):
+    """Device-serialize an eligible block for a cross-server hop, or None
+    to fall back to shipping the raw column dict."""
+    if not _wire_packable(block):
+        return None
+    try:
+        return datatable.encode_packed_block(block)
+    except Exception:
+        return None  # e.g. no device available — raw dict still works
 
 
 class MailboxCancelled(Exception):
@@ -171,10 +197,16 @@ class MailboxStore:
     def deliver(self, request: dict) -> None:
         """Apply one mse_mailbox request (chunk and/or EOS) — the single
         decode point shared by worker and broker endpoints."""
-        if request.get("block") is not None:
+        block = request.get("block")
+        if block is None and request.get("packed") is not None:
+            # device-packed exchange: one contiguous byte blob → device,
+            # split back into columns there (CRC-checked; a corrupted frame
+            # raises instead of materializing garbage rows)
+            block = datatable.decode_packed_block(request["packed"])
+        if block is not None:
             self.put(request["query_id"], request["from_stage"],
                      request["to_stage"], request["partition"],
-                     request["block"], sender=request.get("sender", 0),
+                     block, sender=request.get("sender", 0),
                      seq=request.get("seq"))
         if request.get("eos"):
             self.mark_eos(request["query_id"], request["from_stage"],
@@ -336,6 +368,12 @@ class RoutedMailbox:
                "from_stage": from_stage, "to_stage": to_stage,
                "partition": partition, "block": block,
                "sender": self.sender, "seq": seq}
+        packed = _pack_for_wire(block)
+        if packed is not None:
+            req["block"] = None
+            req["packed"] = packed
+            SERVER_METRICS.add_meter(
+                ServerMeter.DEVICE_PACKED_EXCHANGE_BYTES, len(packed))
         if eos:
             req["eos"] = True
         self.send_rpc(tuple(addr), req)
@@ -354,7 +392,11 @@ class RoutedMailbox:
         consumer starts while later chunks are still in flight). With
         ``final`` (the default, one-shot producers) EOS follows the last
         chunk; chunked producers pass final=False and call finish()."""
-        for chunk in _iter_chunks(block):
+        # a pack-eligible block skips row-chunking: it crosses the wire as
+        # ONE device-packed blob, so splitting it first would re-introduce
+        # the per-chunk host encodes the packed path exists to avoid
+        chunks = [block] if _wire_packable(block) else _iter_chunks(block)
+        for chunk in chunks:
             if dist == "partitioned" and keys and num_partitions > 1:
                 # colocated join: route by the TABLE partition function — a
                 # leaf whose segments are all one partition sends one
@@ -1058,15 +1100,21 @@ class DistributedMseDispatcher:
                     agg = stage_stats_agg.setdefault(int(sid), {
                         "workers": 0, "leaf_pushdown": False, "rows_in": 0,
                         "rows_out": 0, "shuffled_rows": 0,
-                        "shuffled_bytes": 0, "wall_ms": 0.0})
+                        "shuffled_bytes": 0, "cross_stage_bytes": 0,
+                        "host_crossings": 0, "device_partition_ms": 0.0,
+                        "join_impl": "", "wall_ms": 0.0})
                     for k in ("workers", "rows_in", "rows_out",
-                              "shuffled_rows", "shuffled_bytes"):
+                              "shuffled_rows", "shuffled_bytes",
+                              "cross_stage_bytes", "host_crossings"):
                         agg[k] += ss.get(k, 0)
+                    agg["device_partition_ms"] += float(
+                        ss.get("device_partition_ms", 0.0))
                     # workers run concurrently: the stage's wall time is
                     # its slowest worker, not the sum
                     agg["wall_ms"] = max(agg["wall_ms"],
                                          float(ss.get("wall_ms", 0.0)))
                     agg["leaf_pushdown"] |= bool(ss.get("leaf_pushdown"))
+                    agg["join_impl"] = ss.get("join_impl") or agg["join_impl"]
 
             final_sid = stages[0].child_stages[0]
             block = concat_blocks(

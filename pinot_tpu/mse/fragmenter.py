@@ -134,6 +134,8 @@ def explain_stages(stages: list[Stage],
                          "cross_stage_bytes={cross_stage_bytes} "
                          "device_partition_ms={device_partition_ms:.1f}"
                          .format(**st))
+                if st.get("host_crossings"):
+                    line += " hostCrossings={host_crossings}".format(**st)
             elif "cross_stage_bytes" in st:
                 line += " cross_stage_bytes={cross_stage_bytes}".format(**st)
             lines.append(line)

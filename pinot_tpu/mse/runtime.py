@@ -122,6 +122,11 @@ class StageRunner:
         # distributed per-stage runners (they never call run()).
         self._fused: dict[int, object] = {}
         self._handoff: dict[int, int] = {}
+        # join stages absorbed INTO a fused consumer (multi-join chains):
+        # absorbed stage_id → fused stage_id. Absorbed stages never
+        # execute — the fused stage expands their join from the leaf
+        # blocks, which hand off raw straight to it.
+        self._absorbed: dict[int, int] = {}
 
     def _sstat(self, stage_id: int) -> dict:
         st = self.stage_stats.get(stage_id)
@@ -130,7 +135,7 @@ class StageRunner:
                 "workers": 0, "leaf_pushdown": False, "rows_in": 0,
                 "rows_out": 0, "shuffled_rows": 0, "shuffled_bytes": 0,
                 "cross_stage_bytes": 0, "device_partition_ms": 0.0,
-                "join_impl": "", "wall_ms": 0.0}
+                "join_impl": "", "host_crossings": 0, "wall_ms": 0.0}
         return st
 
     def _null_handling_requested(self) -> bool:
@@ -161,6 +166,7 @@ class StageRunner:
             return
         if device_join.env_mode() in ("0", "off", "false"):
             return
+        by_id = {s.stage_id: s for s in self.stages}
         for stage in self.stages:
             if stage.stage_id == 0:
                 continue
@@ -170,6 +176,57 @@ class StageRunner:
             self._fused[stage.stage_id] = plan
             for recv in plan.receives:
                 self._handoff[recv.from_stage] = stage.stage_id
+        # multi-join chains: a fused stage whose input is itself a plain
+        # INNER-join stage absorbs it — the child never executes, its leaf
+        # blocks hand off raw to the fused stage, and the chain expands as
+        # composed row indices (values gathered on device)
+        for sid, plan in self._fused.items():
+            if plan.residual:
+                continue
+            for pos, recv in zip(("left", "right"), plan.receives):
+                src = self._build_chain(by_id.get(recv.from_stage), by_id, 0)
+                if src is None or not self._chain_resolvable(plan, pos, src):
+                    continue
+                plan.chain_side, plan.chain = pos, src
+                for csid in src.stage_ids():
+                    self._absorbed[csid] = sid
+                    self._handoff.pop(csid, None)
+                for leaf in src.leaf_receives():
+                    self._handoff[leaf.from_stage] = sid
+                break   # at most one chained input per fused stage
+
+    def _build_chain(self, stage, by_id: dict, depth: int):
+        """ChainSource for an absorbable join stage, nesting absorbable
+        grandchildren (up to 3 chained joins) when the level's own join
+        keys stay resolvable through the nested source."""
+        if stage is None or depth > 2 or stage.stage_id in self._fused:
+            return None
+        src = device_join.plan_chain_source(stage)
+        if src is None:
+            return None
+        for attr, keys in (("left", src.join_node.left_keys),
+                           ("right", src.join_node.right_keys)):
+            recv = getattr(src, attr)
+            nested = self._build_chain(by_id.get(recv.from_stage), by_id,
+                                       depth + 1)
+            if nested is not None and all(
+                    device_join.chain_resolve(nested, k) is not None
+                    for k in keys):
+                setattr(src, attr, nested)
+        return src
+
+    def _chain_resolvable(self, plan, pos: str, src) -> bool:
+        """Every column the fused stage needs from the chained side must
+        reconstruct from the leaf blocks."""
+        join = plan.join_node
+        need = list(join.left_keys if pos == "left" else join.right_keys)
+        chain_rel = "probe" if plan.probe_side == pos else "build"
+        if chain_rel == "probe":
+            need += [c for _, c in plan.group_cols]
+        need += [c for _k, rel, c, _o in plan.aggs
+                 if rel == chain_rel and c is not None]
+        return all(device_join.chain_resolve(src, c) is not None
+                   for c in need)
 
     # -- topology ----------------------------------------------------------
     def workers_of(self, stage: Stage) -> int:
@@ -184,9 +241,10 @@ class StageRunner:
     # -- run ---------------------------------------------------------------
     def run(self) -> Block:
         self._plan_fused()
-        # children have higher ids than parents: run bottom-up
+        # children have higher ids than parents: run bottom-up. Absorbed
+        # chain stages never run — their fused consumer expands them.
         for stage in sorted(self.stages, key=lambda s: -s.stage_id):
-            if stage.stage_id == 0:
+            if stage.stage_id == 0 or stage.stage_id in self._absorbed:
                 continue
             self._run_stage(stage)
         self.stats["join_ctx"] = dict(self._join_ctx.counters)
@@ -222,7 +280,8 @@ class StageRunner:
             st = self._sstat(stage.stage_id)
             for k in ("workers", "rows_in", "rows_out", "shuffled_rows",
                       "shuffled_bytes", "cross_stage_bytes",
-                      "device_partition_ms", "join_impl", "leaf_pushdown"):
+                      "device_partition_ms", "join_impl", "host_crossings",
+                      "leaf_pushdown"):
                 if k in st and st[k] != "":
                     span.set_attribute(k, st[k])
 
@@ -281,14 +340,18 @@ class StageRunner:
                           for w in range(st["workers"])]
         # a stage feeding a fused consumer hands its block over whole: the
         # consumer partitions on device (or re-partitions itself on
-        # fallback), so nothing is encoded or split here
-        handoff = self._handoff.get(stage.stage_id) == parent.stage_id
+        # fallback), so nothing is encoded or split here. A chain leaf's
+        # direct parent is an ABSORBED stage — its blocks skip that stage
+        # entirely and hand off to the fused consumer.
+        target = self._handoff.get(stage.stage_id)
+        handoff = target is not None and (
+            target == parent.stage_id
+            or self._absorbed.get(parent.stage_id) == target)
         for block in blocks:
             st["rows_out"] += block_len(block)
             trimmed = self._trim_to_send(stage, block)
             if handoff:
-                self.mailbox.send_raw(stage.stage_id, parent.stage_id,
-                                      trimmed)
+                self.mailbox.send_raw(stage.stage_id, target, trimmed)
             else:
                 self.mailbox.send_partitioned(
                     stage.stage_id, parent.stage_id, trimmed,
@@ -314,31 +377,81 @@ class StageRunner:
 
         plan = self._fused[stage.stage_id]
         recv_l, recv_r = plan.receives
-        left = self.mailbox.receive_raw(recv_l.from_stage, stage.stage_id,
-                                        recv_l.schema)
-        right = self.mailbox.receive_raw(recv_r.from_stage, stage.stage_id,
-                                         recv_r.schema)
-        st["rows_in"] += block_len(left) + block_len(right)
+        chain_sids = list(plan.chain.stage_ids()) if plan.chain else []
+
+        def _recv(r):
+            return self.mailbox.receive_raw(r.from_stage, stage.stage_id,
+                                            r.schema)
+
+        leaf_blocks: dict[int, tuple] = {}
+        rows_in = 0
+        sides: dict[str, object] = {}
+        for pos, recv in (("left", recv_l), ("right", recv_r)):
+            if plan.chain_side == pos:
+                for leaf in plan.chain.leaf_receives():
+                    blk = _recv(leaf)
+                    leaf_blocks[id(leaf)] = (blk, block_len(blk))
+                    rows_in += block_len(blk)
+                sides[pos] = None     # expanded below
+            else:
+                sides[pos] = _recv(recv)
+                rows_in += block_len(sides[pos])
+        st["rows_in"] += rows_in
         forced = self._device_join_option() is True \
             or device_join.env_mode() in ("1", "on", "force", "true")
-        eligible = forced or (block_len(left) + block_len(right)
-                              >= device_join.fused_min_rows())
+        eligible = forced or rows_in >= device_join.fused_min_rows()
         ctx = self._join_ctx.for_stage(stage.stage_id)
+
+        def get_leaf(r):
+            return leaf_blocks[id(r)]
+
         if eligible:
             t0 = time.perf_counter()
-            result = device_join.run_fused(left, right, plan, ctx)
+            result = None
+            try:
+                if plan.chain_side is not None:
+                    # host expands the chain's pair INDICES (the same
+                    # argsort expansion the host joiner runs); values stay
+                    # put and gather on device
+                    view = device_join.expand_chain(plan.chain, get_leaf,
+                                                    ctx)
+                    if view is not None:
+                        sides[plan.chain_side] = view
+                        result = device_join.run_fused(
+                            sides["left"], sides["right"], plan, ctx)
+                else:
+                    result = device_join.run_fused(
+                        sides["left"], sides["right"], plan, ctx)
+            except Exception as e:
+                device_join.note_failure(e)
             if result is not None:
                 block, info = result
                 st["device_partition_ms"] += (time.perf_counter() - t0) * 1000
                 st["join_impl"] = "device-fused"
                 st["workers"] = 1
+                st["host_crossings"] = 1
                 self.stats["num_device_dispatches"] += info["dispatches"]
                 SERVER_METRICS.add_meter(ServerMeter.MSE_DEVICE_JOINS)
+                SERVER_METRICS.add_meter(ServerMeter.MSE_FUSED_STAGES,
+                                         1 + len(chain_sids))
+                SERVER_METRICS.add_meter(ServerMeter.MSE_HOST_CROSSINGS)
+                for csid in chain_sids:
+                    self._sstat(csid)["join_impl"] = "device-fused"
                 return [block]
             SERVER_METRICS.add_meter(ServerMeter.MSE_DEVICE_JOIN_FALLBACKS)
         # host fallback: same hash routing the children would have used,
-        # then the exact host join+aggregate operators per partition
+        # then the exact host join+aggregate operators per partition. An
+        # absorbed chain re-materializes through the host joiner itself —
+        # exact semantics including the join-row guards.
         st["join_impl"] = "host"
+        for csid in chain_sids:
+            self._sstat(csid)["join_impl"] = "host"
+        if plan.chain_side is not None:
+            sides[plan.chain_side] = device_join.host_expand_chain(
+                plan.chain, get_leaf, ctx)
+            if pop_join_overflow():
+                self.stats["join_overflow"] = True
+        left, right = sides["left"], sides["right"]
         workers = self.workers_of(stage)
         st["workers"] = workers
         lparts = hash_partition(left, recv_l.keys, workers)

@@ -41,9 +41,10 @@ import numpy as np
 
 from . import kernels
 
-# output plane layout: one row per aggregate, then these two bookkeeping
-# rows (pair count per group; [total_pairs, overflow, ...] metadata)
-META_ROWS = 2
+# output plane layout: one row per aggregate, then these three bookkeeping
+# rows (output-row weight per group; matched-pair count per group;
+# [total_pairs, overflow, ...] metadata)
+META_ROWS = 3
 
 # pad-slot sentinels: distinct per side so a padded probe row can never
 # binary-search onto a padded build row
@@ -188,16 +189,31 @@ def _jit_fused_kernel():
 
     jax.config.update("jax_enable_x64", True)
     return functools.partial(
-        jax.jit, static_argnames=("spec", "P", "Gp"))(_fused_join_agg)
+        jax.jit, static_argnames=("spec", "P", "Gp", "join_type",
+                                  "use_masks"))(_fused_join_agg)
 
 
 def _fused_join_agg(pcodes, pg, pvals, pplane, pcounts,
                     bcodes, bvals, bplane, bcounts,
-                    pn, bn, spec: tuple, P: int, Gp: int):
+                    pn, bn, pmask, bmask, spec: tuple, P: int, Gp: int,
+                    join_type: str, use_masks: bool):
     """spec: tuple of ("count"|"sum"|"min"|"max", "probe"|"build",
     value-row index) per aggregate. Returns a packed f64 plane
     ``[len(spec) + META_ROWS, Gp]``: one group-table row per aggregate,
-    then pair counts per group, then [total_pairs, overflow] metadata."""
+    then the per-group output-row weight (count(*) semantics for the join
+    type), then the per-group matched-pair count (the LEFT-join
+    all-unmatched → NULL rule rides it), then [total_pairs, overflow]
+    metadata (total_pairs is PRE-residual, mirroring the host guard).
+
+    ``join_type`` picks the per-probe-row output weight ``w`` from the
+    (residual-masked) match count ``cnt``: INNER emits ``cnt`` rows, LEFT
+    ``max(cnt, 1)`` (the unmatched probe row survives with NULL build
+    payload), SEMI ``cnt > 0`` and ANTI ``cnt == 0`` (one row per
+    [non-]matching probe row, never per pair). ``use_masks`` gates the
+    residual-filter masks: per-side boolean rows evaluated on the host
+    (each conjunct references one side only), applied on device as a probe
+    multiplier and a masked build prefix-sum — exactly the pairs the host
+    residual filter would keep."""
     import jax
     import jax.numpy as jnp
 
@@ -222,9 +238,30 @@ def _fused_join_agg(pcodes, pg, pvals, pplane, pcounts,
         rs_row = rrows
         s = jnp.searchsorted(rs_k, lk, side="left")
         e = jnp.searchsorted(rs_k, lk, side="right")
-        cnt = jnp.where(lvalid, e - s, 0).astype(jnp.int64)
-        has = cnt > 0
+        cnt_raw = jnp.where(lvalid, e - s, 0).astype(jnp.int64)
         bsorted_valid = rs_k < _SENT_BUILD
+        if use_masks:
+            pm = lvalid & pmask[lrows]
+            bm = bsorted_valid & bmask[rs_row]
+            # matched pairs surviving the residual: prefix-sum of the
+            # build mask over each probe row's [s, e) key run, zeroed
+            # where the probe row itself fails its side's conjuncts
+            prefm = jnp.concatenate(
+                [jnp.zeros(1, jnp.int64),
+                 jnp.cumsum(bm.astype(jnp.int64))])
+            cnt = jnp.where(pm, prefm[e] - prefm[s], 0)
+        else:
+            bm = bsorted_valid
+            cnt = cnt_raw
+        has = cnt > 0
+        if join_type == "LEFT":
+            w = jnp.where(lvalid, jnp.maximum(cnt, 1), 0)
+        elif join_type == "SEMI":
+            w = has.astype(jnp.int64)
+        elif join_type == "ANTI":
+            w = jnp.where(lvalid, 1 - has.astype(jnp.int64), 0)
+        else:  # INNER: one output row per surviving pair
+            w = cnt
         if masked_groups:
             gmask = lg[:, None] == jnp.arange(Gp, dtype=lg.dtype)[None, :]
 
@@ -250,38 +287,42 @@ def _fused_join_agg(pcodes, pg, pvals, pplane, pcounts,
             run_id = jnp.cumsum(change)
             s_run = run_id[jnp.clip(s, 0, capR - 1)]
 
-        pair_row = group_sum(jnp.where(lvalid, cnt.astype(jnp.float64), 0.0))
+        w_row = group_sum(jnp.where(lvalid, w.astype(jnp.float64), 0.0))
+        match_row = group_sum(jnp.where(lvalid, cnt.astype(jnp.float64),
+                                        0.0))
         rows = []
         for kind, side, vrow in spec:
             if kind == "count":
-                rows.append(pair_row)
+                rows.append(w_row)
                 continue
             if side == "probe":
                 val = pvals[vrow][lrows]
                 if kind == "sum":
-                    contrib = val * cnt.astype(jnp.float64)
+                    contrib = val * w.astype(jnp.float64)
                     rows.append(group_sum(jnp.where(lvalid, contrib, 0.0)))
-                else:  # min/max: the probe row's own value, where matched
+                else:  # min/max: the probe row's own value, where emitted
                     pad = jnp.inf if kind == "min" else -jnp.inf
                     rows.append(group_ext(
-                        kind, jnp.where(lvalid & has, val, pad), pad))
+                        kind, jnp.where(lvalid & (w > 0), val, pad), pad))
                 continue
-            # build-side value column, gathered through the sorted plane
+            # build-side value column, gathered through the sorted plane;
+            # only MATCHED pairs contribute (a LEFT join's padded rows
+            # carry NULL build payload, which the host aggregate drops)
             if kind == "sum":
-                bv = jnp.where(bsorted_valid, bvals[vrow][rs_row], 0.0)
+                bv = jnp.where(bm, bvals[vrow][rs_row], 0.0)
                 pref = jnp.concatenate(
                     [jnp.zeros(1), jnp.cumsum(bv)])
-                contrib = pref[e] - pref[s]
+                contrib = jnp.where(has, pref[e] - pref[s], 0.0)
                 rows.append(group_sum(jnp.where(lvalid, contrib, 0.0)))
             else:
                 pad = jnp.inf if kind == "min" else -jnp.inf
-                bvm = jnp.where(bsorted_valid, bvals[vrow][rs_row], pad)
+                bvm = jnp.where(bm, bvals[vrow][rs_row], pad)
                 seg = (jnp.full(capR, pad).at[run_id].min(bvm)
                        if kind == "min"
                        else jnp.full(capR, pad).at[run_id].max(bvm))
                 contrib = jnp.where(lvalid & has, seg[s_run], pad)
                 rows.append(group_ext(kind, contrib, pad))
-        return jnp.stack(rows + [pair_row]), jnp.sum(cnt)
+        return jnp.stack(rows + [w_row, match_row]), jnp.sum(cnt_raw)
 
     per_part, totals = jax.vmap(one_partition)(
         pplane, pcounts, bplane, bcounts)
@@ -297,7 +338,8 @@ def _fused_join_agg(pcodes, pg, pvals, pplane, pcounts,
             combined.append(jnp.max(col, axis=0))
         else:
             combined.append(jnp.sum(col, axis=0))
-    combined.append(jnp.sum(per_part[:, len(spec), :], axis=0))  # pairs
+    combined.append(jnp.sum(per_part[:, len(spec), :], axis=0))     # weight
+    combined.append(jnp.sum(per_part[:, len(spec) + 1, :], axis=0))  # pairs
     overflow = ((jnp.max(pcounts) > capL) | (jnp.max(bcounts) > capR)
                 | (pn > pplane.shape[0] * capL)
                 | (bn > bplane.shape[0] * capR)).astype(jnp.float64)
@@ -309,14 +351,28 @@ def _fused_join_agg(pcodes, pg, pvals, pplane, pcounts,
 
 def fused_join_agg(pcodes, pg, pvals, pplane, pcounts,
                    bcodes, bvals, bplane, bcounts,
-                   pn: int, bn: int, spec: tuple, P: int, Gp: int):
+                   pn: int, bn: int, spec: tuple, P: int, Gp: int,
+                   join_type: str = "INNER", pmask=None, bmask=None):
     """One dispatch: probe every partition plane against its sorted build
-    plane and return the packed ``[n_aggs + 2, Gp]`` group table — the
-    single array that crosses back to the host for the whole stage."""
+    plane and return the packed ``[n_aggs + 3, Gp]`` group table — the
+    single array that crosses back to the host for the whole stage.
+    ``pmask``/``bmask`` are optional per-row residual masks (padded bool
+    arrays aligned with pcodes/bcodes); pass neither for an unfiltered
+    join."""
     _DISPATCHES[0] += 1
+    use_masks = pmask is not None or bmask is not None
+    if use_masks:
+        if pmask is None:
+            pmask = np.ones(len(pcodes), dtype=bool)
+        if bmask is None:
+            bmask = np.ones(len(bcodes), dtype=bool)
+    else:
+        pmask = np.zeros(1, dtype=bool)
+        bmask = np.zeros(1, dtype=bool)
     return _jit_fused_kernel()(
         pcodes, pg, pvals, pplane, pcounts, bcodes, bvals, bplane, bcounts,
-        np.int64(pn), np.int64(bn), spec=spec, P=P, Gp=Gp)
+        np.int64(pn), np.int64(bn), pmask, bmask, spec=spec, P=P, Gp=Gp,
+        join_type=join_type, use_masks=use_masks)
 
 
 def fetch_packed(packed) -> np.ndarray:
@@ -324,3 +380,38 @@ def fetch_packed(packed) -> np.ndarray:
     process-lifetime site the mesh perf guards watch."""
     kernels.count_host_fetch()
     return np.asarray(packed)
+
+
+@functools.cache
+def _jit_gather_stack():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    return functools.partial(jax.jit, static_argnames=("n_cols",))(
+        _gather_stack_kernel)
+
+
+def _gather_stack_kernel(cols, idx, n, n_cols: int):
+    """Stack ``n_cols`` f64 source columns gathered through one composed
+    index vector into a ``[n_cols, len(idx)]`` plane (pad slots past ``n``
+    zeroed)."""
+    import jax.numpy as jnp
+
+    valid = jnp.arange(idx.shape[0]) < n
+    safe = jnp.where(valid, idx, 0)
+    return jnp.stack(
+        [jnp.where(valid, jnp.take(cols[i], safe, mode="clip"), 0.0)
+         for i in range(n_cols)])
+
+
+def gather_stack(cols, idx: np.ndarray, n: int, n_to: int):
+    """One dispatch: gather each f64 column in ``cols`` through the
+    host-composed chain index ``idx[:n]`` and stack into a padded
+    ``[len(cols), n_to]`` device plane — the expanded chain's value
+    columns, built in HBM without ever materializing host-side."""
+    _DISPATCHES[0] += 1
+    idx_pad = np.zeros(n_to, dtype=np.int64)
+    idx_pad[:n] = idx[:n]
+    stacked = np.stack([np.asarray(c, dtype=np.float64) for c in cols])
+    return _jit_gather_stack()(stacked, idx_pad, np.int64(n),
+                               n_cols=len(cols))

@@ -290,6 +290,43 @@ def pack_outputs_gathered(outs: tuple, s_real: int) -> PackedOuts:
     return PackedOuts(flat, metas)
 
 
+@partial(jax.jit, static_argnames=("s_real", "ndev"))
+def _pack_collective(outs: tuple, s_real: int, ndev: int):
+    mesh = segment_mesh(ndev)
+
+    def shard_fn(outs_l):
+        # all-gather the family stacks over ICI so every chip holds the
+        # full [S_pad, ...] outputs, then slice + byte-pack locally — the
+        # byte order is exactly _pack_sliced's, so the host decode is shared
+        gathered = tuple(
+            jax.lax.all_gather(o, SEGMENT_AXIS, axis=0, tiled=True)
+            for o in outs_l)
+        return _pack_u8(tuple(g[:s_real] for g in gathered))
+
+    fn = shard_map_compat(
+        shard_fn, mesh=mesh,
+        in_specs=(tuple(P(SEGMENT_AXIS) for _ in outs),),
+        out_specs=P(),
+        # the gathered pack is replicated by construction; skip the rep
+        # analysis for the same reason _batch_sharded_call does
+        check_vma=False,
+    )
+    return fn(tuple(outs))
+
+
+def pack_outputs_collective(outs: tuple, s_real: int, ndev: int) -> PackedOuts:
+    """Mesh-collective variant of pack_outputs_gathered: the shuffle to one
+    chip happens INSIDE the sharded program (all_gather over the segment
+    axis) and every chip byte-packs the full stack, instead of funneling raw
+    outputs to device 0 with per-output device_puts first. One collective +
+    one pack kernel; the flat is replicated, so the host still crosses once."""
+    metas = [(np.dtype(str(o.dtype)), (s_real,) + tuple(o.shape[1:]))
+             for o in outs]
+    flat = jax.device_put(_pack_collective(tuple(outs), s_real, ndev),
+                          jax.devices()[0])
+    return PackedOuts(flat, metas)
+
+
 def gather_outputs(outs: tuple, s_real: int) -> tuple:
     """Cross-chip gather for the raw path (sparse device combine): commit
     every [S_pad, ...] output to device 0 over ICI — no host crossing — so

@@ -53,6 +53,13 @@ class ServerMeter:
     # (dtype/overflow/empty side) that fell back to the host operators
     MSE_DEVICE_JOINS = "mseDeviceJoins"
     MSE_DEVICE_JOIN_FALLBACKS = "mseDeviceJoinFallbacks"
+    # whole-query device residency: stages executed inside a fused device
+    # plan (the fused stage itself + absorbed chain stages), device→host
+    # crossings taken by fused plans (one per plan per server), and bytes
+    # shipped cross-server as device-packed PTDP DataTable blocks
+    MSE_FUSED_STAGES = "mseFusedStages"
+    MSE_HOST_CROSSINGS = "mseHostCrossings"
+    DEVICE_PACKED_EXCHANGE_BYTES = "devicePackedExchangeBytes"
     # tiered storage (storage/tier.py via cluster/server.py): cold
     # metadata-only segments fetched on demand, budget-pressure evictions
     # back to metadata-only, and prefetch-nudge warms that completed

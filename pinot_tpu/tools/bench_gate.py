@@ -30,6 +30,10 @@ Checks per config present in the baseline:
   ``shuffled_bytes`` > baseline × (1 + ``--threshold``) AND at least
   4096 bytes more — a plan regression (lost pushdown, widened exchange
   schema), same WARN-across-platforms downgrade as p50;
+- **host-crossings regression** (MSE fused configs that record it): ANY
+  increase in ``host_crossings`` fails — the count of device→host
+  round-trips is a plan property with zero noise, and an increase means
+  a fused stage fell back to per-operator hops;
 - **tiered cold/warm regression** (configs that record them): candidate
   ``cold_p50_s`` / ``warm_p50_s`` past the same ratio + ``--min-abs-ms``
   rules (WARN across platforms); a ``warm_match`` flip true → false
@@ -237,6 +241,33 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
         elif bs is not None and cs is None:
             warnings.append(f"{cfg}: baseline recorded shuffled_bytes but "
                             "candidate did not (exchange telemetry dropped)")
+        # host crossings (MSE fused configs): the count of device→host
+        # round-trips the plan took — a PLAN property with no noise, so ANY
+        # increase fails (a fused stage falling back to per-operator hops
+        # is exactly the regression this PR class guards against). Same
+        # missing-side and cross-platform rules as shuffled bytes (the
+        # device-eligibility gate can differ across backends).
+        bh = b.get("host_crossings")
+        ch = c.get("host_crossings")
+        if bh is not None and ch is not None:
+            bhc, chc = int(bh), int(ch)
+            row.update({"baselineHostCrossings": bhc,
+                        "candidateHostCrossings": chc})
+            if chc > bhc:
+                if cross_platform:
+                    if verdict == "PASS":
+                        verdict = "WARN"
+                    warnings.append(
+                        f"{cfg}: host crossings {bhc} -> {chc} across "
+                        "platforms")
+                else:
+                    verdict = "FAIL"
+                    failures.append(
+                        f"{cfg}: host crossings regressed {bhc} -> {chc} "
+                        "(fused plan lost device residency)")
+        elif bh is not None and ch is None:
+            warnings.append(f"{cfg}: baseline recorded host_crossings but "
+                            "candidate did not (residency telemetry dropped)")
         # tiered-storage round (cold-start vs warm-resident p50): compared
         # only when BOTH rounds measured it, same missing-side rule as
         # mesh. cold_p50_s times the first-query lazy fetch path;
