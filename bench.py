@@ -412,6 +412,39 @@ def _record_dir(platform) -> Path:
     return PARTIAL
 
 
+def _runner_shape(results=None) -> dict:
+    """Self-describing runner-shape block for the round payload: physical
+    and logical core counts plus the mesh device count any config actually
+    ran with. Rounds recorded on differently-shaped machines are not
+    timing-comparable (the r05→r06 q5/q7 'regressions' tracked a core-count
+    change, not the code) — bench_gate downgrades same-platform timing
+    FAILs to WARNs when these blocks differ."""
+    logical = os.cpu_count() or 1
+    physical = None
+    try:
+        pairs = set()
+        for block in Path("/proc/cpuinfo").read_text().split("\n\n"):
+            phys = core = None
+            for line in block.splitlines():
+                if line.startswith("physical id"):
+                    phys = line.split(":", 1)[1].strip()
+                elif line.startswith("core id"):
+                    core = line.split(":", 1)[1].strip()
+            if phys is not None and core is not None:
+                pairs.add((phys, core))
+        physical = len(pairs) or None
+    except OSError:
+        pass
+    shape = {"logicalCores": logical, "physicalCores": physical or logical}
+    mesh = None
+    for v in (results or {}).values():
+        if isinstance(v, dict) and v.get("mesh_devices"):
+            mesh = v["mesh_devices"]
+    if mesh:
+        shape["meshDevices"] = mesh
+    return shape
+
+
 def _emit(results, platform, notes, skipped, final=False, statuses=None,
           probe=None):
     """(Re-)print the one-line summary JSON; also persist to the record
@@ -448,6 +481,7 @@ def _emit(results, platform, notes, skipped, final=False, statuses=None,
         # here — compare rows/s + roofline fractions, not just speedup
         "host_baseline": f"numpy engine, {os.cpu_count() or 1} core(s)",
         "platform": platform,
+        "runner": _runner_shape(results),
         "final": final,
     }
     if not results:

@@ -22,6 +22,7 @@ from typing import Optional
 from ..engine.combine import combine_aggregation, combine_group_by, combine_selection
 from ..engine.aggregation import semantics_for
 from ..engine.reduce import BrokerReducer
+from ..engine.perf_ledger import ALERTS, PERF_LEDGER
 from ..engine.results import (
     AggIntermediate,
     BrokerResponse,
@@ -181,6 +182,11 @@ class Broker:
                                  lambda: self.trace_store.stats()["traces"])
         BROKER_METRICS.set_gauge("traceStoreBytes",
                                  lambda: self.trace_store.stats()["bytes"])
+        BROKER_METRICS.set_gauge("ledgerFingerprints",
+                                 lambda: len(PERF_LEDGER))
+        BROKER_METRICS.set_gauge(
+            "exemplarsPinned",
+            lambda: self.trace_store.stats()["alertExemplars"])
         BROKER_METRICS.set_gauge(
             "traceStoreEvictions",
             lambda: self.trace_store.stats()["evictions"])
@@ -417,6 +423,7 @@ class Broker:
         self._retain_trace(resp, table)
         self.query_logger.log(sql, resp, table=table)
         self.workload.note_response(sql, resp, table=table)
+        self._record_ledger(sql, resp, table)
         if getattr(resp, "trace_sampled", False):
             # the client never asked for this trace: the store and the
             # query log took their copies above — the response ships plain
@@ -455,13 +462,54 @@ class Broker:
             reason = "sampled"
         else:
             reason = "traced"
+        alert_id = getattr(resp, "_alert_id", "") or ""
         try:
             resp.trace_id = self.trace_store.offer(
                 qid, trace_info, reason=reason,
-                pinned=bool(n_exc or partial or slow), table=table,
-                time_ms=time_ms, exceptions=n_exc, partial=partial)
+                pinned=bool(n_exc or partial or slow or alert_id),
+                table=table, time_ms=time_ms, exceptions=n_exc,
+                partial=partial, alert_id=alert_id)
+            if alert_id:
+                # the alert record links back to its pinned exemplars
+                ALERTS.note_exemplar(alert_id, resp.trace_id)
         except Exception:
             pass  # retention is best-effort; never fail the query for it
+
+    def _record_ledger(self, sql: str, resp: BrokerResponse,
+                       table: str) -> None:
+        """Per-plan performance ledger bump (engine/perf_ledger.py): pure
+        counter arithmetic over fields the response already carries. The
+        key is the plan fingerprint when the result-cache path computed
+        one, a crc of the SQL text otherwise — NEVER a fresh
+        canonicalization walk (the warm path is perf-guard-pinned to zero
+        fingerprint work)."""
+        try:
+            key = getattr(resp, "_ledger_key", None)
+            if key is None:
+                key = "sql:%08x" % (zlib.crc32(sql.encode()) & 0xFFFFFFFF)
+            crossings = bytes_shuffled = 0
+            stages = getattr(resp, "mse_stage_stats", None)
+            if stages:
+                for st in stages.values():
+                    crossings += int(st.get("host_crossings", 0) or 0)
+                    bytes_shuffled += int(st.get("shuffled_bytes", 0) or 0)
+            PERF_LEDGER.record(
+                key, table=table,
+                time_ms=getattr(resp, "time_used_ms", 0.0) or 0.0,
+                error=bool(getattr(resp, "exceptions", None)),
+                partial=bool(getattr(resp, "partial_result", False)),
+                dispatches=getattr(resp, "num_device_dispatches", 0) or 0,
+                compiles=getattr(resp, "num_compiles", 0) or 0,
+                cache_outcome=getattr(resp, "cache_outcome", "") or "",
+                seg_cache_hits=getattr(resp, "num_segments_cache_hit", 0)
+                or 0,
+                seg_cache_misses=getattr(resp, "num_segments_cache_miss", 0)
+                or 0,
+                coalesced=getattr(resp, "num_coalesced_queries", 0) or 0,
+                host_crossings=crossings, bytes_shuffled=bytes_shuffled,
+                sql=sql)
+        except Exception:
+            pass  # the ledger must never fail a query
 
     def _execute_sql_impl(self, sql: str,
                           segments: Optional[dict]) -> BrokerResponse:
@@ -517,7 +565,17 @@ class Broker:
                 cached.cache_outcome = "hit"
                 cached.time_used_ms = (time.perf_counter() - t0) * 1000
                 cached._log_table = query.table_name
+                cached._ledger_key = f"fp:{str(ck[0])[:16]}"
                 return cached
+        # exemplar pinning (engine/perf_ledger.py): ONE attribute read on
+        # the disarmed path; when the sentinel armed this plan or table,
+        # the claim forces head-sampling and tags the trace with the alert
+        exemplar_alert = None
+        if PERF_LEDGER.exemplar_armed:
+            lkey = f"fp:{str(ck[0])[:16]}" if ck is not None else \
+                "sql:%08x" % (zlib.crc32(sql.encode()) & 0xFFFFFFFF)
+            exemplar_alert = PERF_LEDGER.claim_exemplar(
+                lkey, query.table_name)
         # admission control (load shedding): the budget starts ticking NOW,
         # so time spent queued for a broker slot comes out of the query's
         # own deadline — an overloaded broker sheds with a 429-style
@@ -530,7 +588,8 @@ class Broker:
                     cost_hint_ms=self.workload.expected_cost_ms(
                         raw_table_name(query.table_name))):
                 resp = self._execute(query, only_segments=segments,
-                                     budget=budget)
+                                     budget=budget,
+                                     force_trace=bool(exemplar_alert))
         except AdmissionRejectedError as e:
             resp = self._rejected_response(e)
         except Exception as e:
@@ -538,6 +597,10 @@ class Broker:
         resp.time_used_ms = (time.perf_counter() - t0) * 1000
         resp._log_table = query.table_name
         resp.cache_outcome = "miss" if ck is not None else "bypass"
+        if ck is not None:
+            resp._ledger_key = f"fp:{str(ck[0])[:16]}"
+        if exemplar_alert:
+            resp._alert_id = exemplar_alert
         if ck is not None and not resp.exceptions \
                 and not resp.partial_result \
                 and resp.result_table is not None:
@@ -776,7 +839,8 @@ class Broker:
 
     def _execute(self, query: QueryContext,
                  only_segments: Optional[dict] = None,
-                 budget: Optional[_QueryBudget] = None) -> BrokerResponse:
+                 budget: Optional[_QueryBudget] = None,
+                 force_trace: bool = False) -> BrokerResponse:
         raw = raw_table_name(query.table_name)
         offline = table_name_with_type(raw, "OFFLINE")
         realtime = table_name_with_type(raw, "REALTIME")
@@ -828,7 +892,10 @@ class Broker:
                     f"broker:{raw}",
                     analyze=query.query_options.get("analyze") in
                     (True, "true", 1))
-            elif sample_decision(budget.query_id, trace_sample_rate()):
+            elif force_trace or sample_decision(budget.query_id,
+                                                trace_sample_rate()):
+                # force_trace: sentinel exemplar pinning — sample this
+                # query regardless of the configured head-sampling rate
                 sampled = True
                 trace = TRACING.start_trace(f"broker:{raw}", analyze=True)
         all_results = []

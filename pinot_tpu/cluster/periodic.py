@@ -506,6 +506,7 @@ class ClusterHealthChecker:
         t0 = time.perf_counter()
         servers, anomalies = self._scrape_servers()
         brokers = self._collect_brokers(anomalies)
+        self._collect_perf_alerts(anomalies)
         fleet = self._fleet_rollup(servers, anomalies)
         self._last_reachable = fleet["serversReachable"]
         snapshot = {
@@ -571,6 +572,20 @@ class ClusterHealthChecker:
                               f"(threshold {self.breaker_flap_count})"})
             self._prev_breaker_opens[bid] = opens
         return brokers
+
+    def _collect_perf_alerts(self, anomalies: list) -> None:
+        """Fold the regression sentinel's active alerts into the fleet
+        snapshot so GET /debug/cluster shows perf drift next to infra
+        anomalies (lazy import: periodic.py must not pull the engine in)."""
+        from ..engine.perf_ledger import ALERTS
+
+        if not ALERTS.active_count:
+            return
+        for rec in ALERTS.active():
+            anomalies.append({
+                "type": rec["type"], "instance": rec.get("table", ""),
+                "alertId": rec["id"],
+                "detail": rec.get("summary", "")})
 
     # -- anomaly math --------------------------------------------------------
     def _fleet_rollup(self, servers: dict, anomalies: list) -> dict:
@@ -722,4 +737,16 @@ def build_default_scheduler(store: PropertyStore, controller: ClusterController,
     prefetch_s = float(os.environ.get("PINOT_TPU_PREFETCH_TICK_S",
                                       interval_s))
     sched.register("StoragePrefetcher", prefetch_s, _storage_prefetcher)
+
+    def _perf_sentinel():
+        # built lazily so importing periodic.py never pulls the engine in
+        from .sentinel import SCRAPE_S_ENV, PerfRegressionSentinel  # noqa: F401
+
+        if not hasattr(_perf_sentinel, "task"):
+            _perf_sentinel.task = PerfRegressionSentinel(store, controller)
+        return _perf_sentinel.task()
+
+    sentinel_s = float(os.environ.get("PINOT_TPU_SENTINEL_SCRAPE_S",
+                                      interval_s))
+    sched.register("PerfRegressionSentinel", sentinel_s, _perf_sentinel)
     return sched

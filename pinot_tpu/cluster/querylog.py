@@ -96,6 +96,17 @@ class QueryLogger:
             # chaos runs: stamp the cumulative injected-fault count so a
             # slow entry can be correlated with the fault schedule
             entry["injectedFaults"] = faults.FAULTS.total_fired()
+        # regression-sentinel cross-link: a slow query whose plan or table
+        # has an active alert names the alert ids, so /debug/queries and
+        # /debug/alerts triangulate without a third lookup. active_count
+        # is a plain attribute read — the no-alerts path pays nothing.
+        from ..engine.perf_ledger import ALERTS
+
+        if ALERTS.active_count:
+            alert_ids = ALERTS.active_ids_for(
+                getattr(response, "_ledger_key", "") or "", table)
+            if alert_ids:
+                entry["alertIds"] = alert_ids
         outcome = getattr(response, "cache_outcome", None)
         if outcome:
             # a "slow but cached" query is an anomaly worth seeing: the

@@ -206,6 +206,10 @@ class BrokerRestServer(_RestServer):
                 (r"/debug/traces/([^/]+)",
                  lambda h, m, q: srv._debug_trace(m.group(1), q)),
                 (r"/debug/compiles", lambda h, m, q: srv._debug_compiles()),
+                (r"/debug/ledger", lambda h, m, q: srv._debug_ledger()),
+                (r"/debug/alerts", lambda h, m, q: srv._debug_alerts()),
+                (r"/debug/alerts/([^/]+)",
+                 lambda h, m, q: srv._debug_alert(m.group(1))),
                 # cursor ids are not table names: no group-based table check
                 (r"/resultStore/([^/]+)", lambda h, m, q: srv._cursor_fetch(
                     m.group(1), int(q.get("offset", ["0"])[0]),
@@ -295,6 +299,41 @@ class BrokerRestServer(_RestServer):
 
             return 200, to_chrome_trace(ent["spans"], query_id=query_id)
         return 200, ent
+
+    def _debug_ledger(self):
+        """Per-plan performance ledger (engine/perf_ledger.py): rolling
+        short/reference window summaries per fingerprint, global fallback
+        events, per-table SLO burn rates, and the sentinel's last report
+        when one has been published to the store."""
+        from ..engine.perf_ledger import PERF_LEDGER
+
+        out = PERF_LEDGER.snapshot()
+        out["burnRates"] = {t: PERF_LEDGER.burn_rates(t)
+                            for t in PERF_LEDGER.tables()}
+        try:
+            from .sentinel import SENTINEL_REPORT_PATH
+
+            out["sentinel"] = self.broker.store.get(SENTINEL_REPORT_PATH)
+        except Exception:
+            out["sentinel"] = None
+        return 200, out
+
+    def _debug_alerts(self):
+        """Regression-sentinel alert book: firing + recently cleared
+        alerts, newest-first, each carrying its exemplar trace ids."""
+        from ..engine.perf_ledger import ALERTS
+
+        return 200, ALERTS.snapshot()
+
+    def _debug_alert(self, alert_id: str):
+        """One alert record; ``exemplarTraceIds`` resolve against
+        GET /debug/traces/{id} (``?format=chrome`` for Perfetto)."""
+        from ..engine.perf_ledger import ALERTS
+
+        rec = ALERTS.get(alert_id)
+        if rec is None:
+            return 404, {"error": f"no alert {alert_id}"}
+        return 200, rec
 
     def _debug_compiles(self):
         """Compile & HBM telemetry (engine/compile_registry.py +

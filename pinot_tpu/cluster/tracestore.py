@@ -52,10 +52,13 @@ class TraceStore:
 
     def offer(self, query_id: str, spans: list, *, reason: str = "sampled",
               pinned: bool = False, table: str = "", time_ms: float = 0.0,
-              exceptions: int = 0, partial: bool = False) -> str:
+              exceptions: int = 0, partial: bool = False,
+              alert_id: str = "") -> str:
         """Retain one finished trace. ``pinned`` marks tail-captured
         traces (slow/partial/failed) that outlive budget pressure from
-        healthy samples. Returns the retained trace id (the queryId).
+        healthy samples. ``alert_id`` tags sentinel-pinned exemplars
+        (engine/perf_ledger.py) so the alert record and the trace link
+        both ways. Returns the retained trace id (the queryId).
         A re-offer under the same id replaces the old entry (hedged
         EXPLAIN reruns of one id keep the latest)."""
         # sizing by serialized span JSON: that is exactly what the debug
@@ -79,6 +82,8 @@ class TraceStore:
             "timestamp": round(time.time(), 3),
             "spans": spans,
         }
+        if alert_id:
+            entry["alertIds"] = [alert_id]
         with self._lock:
             old = self._traces.pop(query_id, None)
             if old is not None:
@@ -126,8 +131,11 @@ class TraceStore:
     def stats(self) -> dict:
         with self._lock:
             pinned = sum(1 for e in self._traces.values() if e["pinned"])
+            exemplars = sum(1 for e in self._traces.values()
+                            if e.get("alertIds"))
             return {"traces": len(self._traces),
                     "pinnedTraces": pinned,
+                    "alertExemplars": exemplars,
                     "bytes": self._bytes,
                     "budgetBytes": self.budget_bytes,
                     "maxTraces": self.max_traces,

@@ -140,6 +140,11 @@ _MESH_WARNED: set = set()
 
 
 def _warn_mesh_fallback(program, err: Exception) -> None:
+    # every fallback counts toward the sentinel's fallback-surge window,
+    # even when the once-per-key warning below stays quiet
+    from .perf_ledger import PERF_LEDGER
+
+    PERF_LEDGER.note_event("mesh-solo")
     key = (getattr(program, "mode", "?"), type(err).__name__)
     if key not in _MESH_WARNED:
         _MESH_WARNED.add(key)
@@ -402,6 +407,9 @@ class TpuSegmentExecutor:
             # path — with the ORIGINAL params so this compile is the one
             # every later (post-disable) dispatch of the program reuses
             fused_groupby.note_failure(e)
+            from .perf_ledger import PERF_LEDGER
+
+            PERF_LEDGER.note_event("fused-host")
             outs = run_program(plan.program, arrays, base_params,
                                np.int32(segment.num_docs), view.padded,
                                packed=packed, fused="")

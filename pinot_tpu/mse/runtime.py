@@ -439,6 +439,9 @@ class StageRunner:
                     self._sstat(csid)["join_impl"] = "device-fused"
                 return [block]
             SERVER_METRICS.add_meter(ServerMeter.MSE_DEVICE_JOIN_FALLBACKS)
+            from ..engine.perf_ledger import PERF_LEDGER
+
+            PERF_LEDGER.note_event("device-join-host")
         # host fallback: same hash routing the children would have used,
         # then the exact host join+aggregate operators per partition. An
         # absorbed chain re-materializes through the host joiner itself —

@@ -163,6 +163,8 @@ class ControllerGauge:
     CLUSTER_SERVERS_REACHABLE = "clusterServersReachable"
     # rebalance jobs currently IN_PROGRESS/ABORTING across all tables
     REBALANCE_ACTIVE = "rebalanceActive"
+    # regression-sentinel alerts currently firing (cluster/sentinel.py)
+    PERF_ANOMALIES_ACTIVE = "perfAnomaliesActive"
 
 
 class ControllerTimer:

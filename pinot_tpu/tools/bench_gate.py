@@ -115,6 +115,23 @@ def load_round(path: str) -> dict:
     return _extract_payload(json.loads(Path(path).read_text()))
 
 
+def _runner_shape_diff(baseline: dict, candidate: dict):
+    """Human-readable diff of the two rounds' runner-shape blocks, or None
+    when they match (or either round predates the block). A core-count or
+    mesh-size change makes same-platform wall-clock numbers incomparable —
+    the r05→r06 q5/q7 "regressions" tracked the runner dropping to one
+    physical core, not the code — so timing FAILs downgrade to WARNs that
+    name the shape change instead of blaming the candidate round."""
+    br = baseline.get("runner")
+    cr = candidate.get("runner")
+    if not isinstance(br, dict) or not isinstance(cr, dict):
+        return None
+    diffs = [f"{key} {br.get(key)} -> {cr.get(key)}"
+             for key in sorted(set(br) | set(cr))
+             if br.get(key) != cr.get(key)]
+    return ", ".join(diffs) or None
+
+
 def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
             min_abs_ms: float = 2.0) -> dict:
     """Pure comparison (importable by tests): returns the gate report
@@ -124,6 +141,13 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
     base_plat = baseline.get("platform")
     cand_plat = candidate.get("platform")
     cross_platform = bool(base_plat and cand_plat and base_plat != cand_plat)
+    shape_diff = _runner_shape_diff(baseline, candidate)
+    # wall-clock checks are only comparable on the same platform AND the
+    # same runner shape; plan-property checks (match, shuffled bytes, host
+    # crossings) ignore the shape — a core count can't change a plan
+    timing_noise = cross_platform or bool(shape_diff)
+    noise_label = "platforms" if cross_platform \
+        else f"runner shapes ({shape_diff})"
     rows = []
     failures = []
     warnings = []
@@ -131,6 +155,10 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
         warnings.append(
             f"platform mismatch (baseline={base_plat}, "
             f"candidate={cand_plat}): p50 checks downgraded to warnings")
+    elif shape_diff:
+        warnings.append(
+            f"runner shape differs ({shape_diff}): timing checks "
+            "downgraded to warnings")
     for cfg in base_cfg:
         b = base_cfg[cfg]
         c = cand_cfg.get(cfg)
@@ -154,11 +182,12 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
             failures.append(f"{cfg}: result match flipped true -> false "
                             "(correctness regression)")
         elif bp > 0 and ratio > 1.0 + threshold and delta_ms >= min_abs_ms:
-            if cross_platform:
+            if timing_noise:
                 verdict = "WARN"
                 warnings.append(
                     f"{cfg}: p50 {bp:.4f}s -> {cp:.4f}s "
-                    f"({(ratio - 1) * 100:.1f}% slower) across platforms")
+                    f"({(ratio - 1) * 100:.1f}% slower) across "
+                    f"{noise_label}")
             else:
                 verdict = "FAIL"
                 failures.append(
@@ -190,13 +219,13 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
                     "(sharded-dispatch correctness regression)")
             elif bmp > 0 and mesh_ratio > 1.0 + threshold \
                     and mesh_delta_ms >= min_abs_ms:
-                if cross_platform or mesh_devices_differ:
+                if timing_noise or mesh_devices_differ:
                     if verdict == "PASS":
                         verdict = "WARN"
                     warnings.append(
                         f"{cfg}: mesh p50 {bmp:.4f}s -> {cmp_:.4f}s "
                         f"({(mesh_ratio - 1) * 100:.1f}% slower) across "
-                        + ("platforms" if cross_platform else
+                        + (noise_label if timing_noise else
                            f"mesh sizes ({b.get('mesh_devices')} -> "
                            f"{c.get('mesh_devices')} devices)"))
                 else:
@@ -303,13 +332,13 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
                     "(tiered-storage correctness regression)")
             elif btp > 0 and t_ratio > 1.0 + threshold \
                     and t_delta_ms >= min_abs_ms:
-                if cross_platform:
+                if timing_noise:
                     if verdict == "PASS":
                         verdict = "WARN"
                     warnings.append(
                         f"{cfg}: {label} p50 {btp:.4f}s -> {ctp:.4f}s "
                         f"({(t_ratio - 1) * 100:.1f}% slower) across "
-                        "platforms")
+                        f"{noise_label}")
                 else:
                     verdict = "FAIL"
                     failures.append(
@@ -320,6 +349,7 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
         rows.append(row)
     return {"pass": not failures, "threshold": threshold,
             "minAbsMs": min_abs_ms, "configs": len(base_cfg),
+            "runnerShapeDiff": shape_diff,
             "failures": failures, "warnings": warnings, "rows": rows}
 
 

@@ -1714,6 +1714,155 @@ def soak_tiered(seconds: float = 20.0, seed: int = 0, n_tables: int = 6,
 
 
 # ════════════════════════════════════════════════════════════════════════════
+# Suite 8: regression sentinel — seeded slowdown → alert → exemplar → clear
+# ════════════════════════════════════════════════════════════════════════════
+
+
+def soak_sentinel(seconds: float = 30.0, seed: int = 0,
+                  progress=None) -> dict:
+    """Sentinel smoke: the full detect→pin→recover loop on a live
+    cluster. A small table is hammered with an uncached group-by to
+    build a reference window, then a seeded ``device.dispatch`` delay
+    fault makes every dispatch slow; the sentinel must classify the
+    shift as a named ``latency-drift`` alert within its fast window,
+    pin at least one exemplar trace linked by alert id, and — once the
+    fault lifts and clean evaluations accumulate — resolve the alert
+    on its own. Any missed phase raises SoakFailure."""
+    from pinot_tpu.cluster import (Broker, ClusterController, PropertyStore,
+                                   ServerInstance)
+    from pinot_tpu.cluster.sentinel import PerfRegressionSentinel
+    from pinot_tpu.engine.perf_ledger import ALERTS, PERF_LEDGER, PerfLedger
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.spi import faults
+    from pinot_tpu.spi.data_types import Schema
+
+    progress = progress or (lambda m: None)
+    rng = np.random.default_rng(seed)
+    t0 = time.time()
+    deadline = t0 + max(seconds, 20.0)
+    tmp = tempfile.TemporaryDirectory(prefix="pinot_soak_sentinel_")
+    d = Path(tmp.name)
+    PERF_LEDGER.clear()
+    ALERTS.clear()
+    store = PropertyStore()
+    controller = ClusterController(store)
+    # backend="auto": the injected fault point lives on the device
+    # dispatch path — the pure-host combine would never consult it
+    server = ServerInstance(store, "Server_0", backend="auto")
+    server.start()
+    schema = Schema.build("sentinel_t",
+                          dimensions=[("sk", "STRING")],
+                          metrics=[("sv", "INT")])
+    controller.add_schema(schema.to_json())
+    controller.create_table({"tableName": "sentinel_t", "replication": 1})
+    for i in range(2):
+        n = 200
+        cols = {"sk": np.asarray(["a", "b", "c", "d"], dtype=object)[
+                    rng.integers(0, 4, n)],
+                "sv": rng.integers(0, 100, n).astype(np.int32)}
+        name = f"sentinel_t_{i}"
+        SegmentBuilder(schema, segment_name=name).build(cols, d / name)
+        controller.add_segment("sentinel_t_OFFLINE", name,
+                               {"location": str(d / name), "numDocs": n})
+    broker = Broker(store)
+    sql = ("SET resultCache = false; SET segmentCache = false; "
+           "SELECT sk, SUM(sv) FROM sentinel_t GROUP BY sk")
+    stats = {"queries": 0, "alerts_fired": 0, "exemplars_pinned": 0,
+             "rounds_to_fire": 0, "rounds_to_clear": 0}
+
+    def _burst(n=6):
+        for _ in range(n):
+            resp = broker.execute_sql(sql)
+            if resp.exceptions:
+                raise SoakFailure(
+                    f"sentinel soak (seed {seed}): query error "
+                    f"{resp.exceptions}")
+            stats["queries"] += 1
+
+    try:
+        progress("building reference window")
+        _burst(8)
+        PERF_LEDGER.rotate_now()
+        sentinel = PerfRegressionSentinel(store, controller, min_queries=3,
+                                          breaches=2, clears=2)
+        report = sentinel.evaluate()
+        if report["anomalies"]:
+            raise SoakFailure(
+                f"sentinel soak (seed {seed}): anomalies on a clean "
+                f"baseline: {report['anomalies']}")
+
+        progress("injecting device.dispatch delay fault")
+        alert = None
+        with faults.injected("device.dispatch", kind="delay",
+                             delay_s=0.05, times=None):
+            for rnd in range(1, 13):
+                if time.time() > deadline:
+                    break
+                _burst(6)
+                sentinel.evaluate()
+                if ALERTS.active_count:
+                    stats["rounds_to_fire"] = rnd
+                    alert = ALERTS.active()[0]
+                    break
+            if alert is None:
+                raise SoakFailure(
+                    f"sentinel soak (seed {seed}): injected 50ms dispatch "
+                    "delay never produced an active alert")
+            if alert["type"] != "latency-drift":
+                raise SoakFailure(
+                    f"sentinel soak (seed {seed}): expected latency-drift, "
+                    f"got {alert['type']}")
+            stats["alerts_fired"] = 1
+            # exemplar pinning: the next matching queries run force-traced
+            _burst(4)
+        rec = ALERTS.get(alert["id"])
+        exemplars = rec.get("exemplarTraceIds") or []
+        stats["exemplars_pinned"] = len(exemplars)
+        if not exemplars:
+            raise SoakFailure(
+                f"sentinel soak (seed {seed}): alert {alert['id']} fired "
+                "but pinned no exemplar traces")
+        entry = broker.trace_store.get(exemplars[0])
+        if not entry or alert["id"] not in (entry.get("alertIds") or []):
+            raise SoakFailure(
+                f"sentinel soak (seed {seed}): exemplar {exemplars[0]} "
+                "not cross-linked to its alert in the trace store")
+
+        progress("fault lifted — waiting for recovery")
+        for rnd in range(1, 13):
+            if time.time() > deadline and rnd > 2:
+                break
+            _burst(6)
+            sentinel.evaluate()
+            if not ALERTS.active_count:
+                stats["rounds_to_clear"] = rnd
+                break
+        if ALERTS.active_count:
+            raise SoakFailure(
+                f"sentinel soak (seed {seed}): alert {alert['id']} never "
+                "cleared after the fault lifted")
+
+        # ledger persistence round-trip through the live store
+        PERF_LEDGER.persist(store)
+        if PerfLedger().restore(store) < 1:
+            raise SoakFailure(
+                f"sentinel soak (seed {seed}): persisted ledger restored "
+                "zero plans")
+    finally:
+        faults.FAULTS.reset()
+        PERF_LEDGER.clear()
+        ALERTS.clear()
+        try:
+            server.stop()
+        except Exception:
+            pass
+        tmp.cleanup()
+    stats.update({"suite": "sentinel", "seed": seed,
+                  "elapsed_s": round(time.time() - t0, 1)})
+    return stats
+
+
+# ════════════════════════════════════════════════════════════════════════════
 # CLI
 # ════════════════════════════════════════════════════════════════════════════
 
@@ -1723,7 +1872,7 @@ def main(argv=None) -> int:
         description="pinot_tpu soak/chaos harness (committed, reproducible)")
     p.add_argument("--suite", choices=["sql", "chaos", "qps", "realtime",
                                        "failover", "rebalance", "tiered",
-                                       "all"],
+                                       "sentinel", "all"],
                    default="all")
     p.add_argument("--seconds", type=float, default=45.0,
                    help="wall-clock budget per time-based suite "
@@ -1816,6 +1965,9 @@ def main(argv=None) -> int:
                 capture_report=bool(args.report)))
         if args.suite == "tiered":
             results.append(soak_tiered(
+                seconds=args.seconds, seed=args.seed, progress=progress))
+        if args.suite == "sentinel":
+            results.append(soak_sentinel(
                 seconds=args.seconds, seed=args.seed, progress=progress))
     except SoakFailure as e:
         failed = str(e)

@@ -308,3 +308,57 @@ def test_tiered_cross_platform_warns_and_missing_side_rules(tmp_path,
     c = _write(tmp_path, "c.json", cand2)
     assert main([a, c]) == 0
     assert "tiered coverage dropped" in capsys.readouterr().out
+
+
+def test_runner_shape_diff_downgrades_timing_to_warning(tmp_path, capsys):
+    """Same platform, but the runner changed shape (core count): a p50
+    blow-up downgrades to a WARN that names the shape diff — the timing
+    moved with the hardware, not the code."""
+    base = _payload(runner={"physicalCores": 8, "logicalCores": 16})
+    cand = _payload(runner={"physicalCores": 1, "logicalCores": 2})
+    cand["detail"]["q2_groupby"]["tpu_p50_s"] = 0.800  # 4x slower
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "GATE: PASS" in out
+    assert "runner shape differs" in out
+    assert "physicalCores 8 -> 1" in out, (
+        "the warning must name the shape change it excused")
+
+
+def test_runner_shape_diff_never_excuses_match_flip(tmp_path, capsys):
+    """Plan properties ignore the runner shape: a correctness flip fails
+    no matter what the hardware did."""
+    base = _payload(runner={"physicalCores": 8, "logicalCores": 16})
+    cand = _payload(runner={"physicalCores": 1, "logicalCores": 2})
+    cand["detail"]["q1_filter_sum"]["match"] = False
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    assert "match flipped" in capsys.readouterr().out
+
+
+def test_same_runner_shape_still_fails_timing(tmp_path, capsys):
+    """Identical runner blocks add no noise excuse: regressions fail."""
+    base = _payload(runner={"physicalCores": 8, "logicalCores": 16})
+    cand = _payload(runner={"physicalCores": 8, "logicalCores": 16})
+    cand["detail"]["q2_groupby"]["tpu_p50_s"] = 0.800
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "GATE: FAIL" in out and "regressed" in out
+
+
+def test_missing_runner_block_keeps_old_behavior(tmp_path, capsys):
+    """Rounds that predate the runner block compare exactly as before —
+    no spurious shape warnings, timing checks stay armed."""
+    base = _payload()  # no runner key
+    cand = _payload(runner={"physicalCores": 8})
+    cand["detail"]["q2_groupby"]["tpu_p50_s"] = 0.800
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "runner shape differs" not in out

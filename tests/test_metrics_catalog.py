@@ -37,6 +37,9 @@ _DYNAMIC = {
     "traceStoreTraces",                      # cluster/broker.py
     "traceStoreBytes",                       # cluster/broker.py
     "traceStoreEvictions",                   # cluster/broker.py
+    "ledgerFingerprints",                    # cluster/broker.py
+    "exemplarsPinned",                       # cluster/broker.py
+    "sloBurnRate.{table}",                   # cluster/sentinel.py
 }
 
 _ENUMS = (m.ServerMeter, m.BrokerMeter, m.ServerTimer, m.BrokerTimer,

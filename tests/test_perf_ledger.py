@@ -1,0 +1,254 @@
+"""Per-plan performance ledger + alert book unit tests.
+
+The ledger is the regression sentinel's substrate: rolling log-bucketed
+latency histograms and counter windows per plan fingerprint, folded into
+an exponentially decayed reference on rotation, bounded under fingerprint
+churn, persisted through the property store. The AlertBook is the
+dedup/hysteresis bookkeeping the sentinel fires into.
+
+Companion tests: test_sentinel_rest.py (end-to-end detect→pin→clear over
+REST), test_tracing_perf_guard.py (warm-path zero-cost pins).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pinot_tpu.cluster import PropertyStore
+from pinot_tpu.engine import perf_ledger as pl
+from pinot_tpu.engine.perf_ledger import (AlertBook, PerfLedger,
+                                          bucket_quantile)
+
+
+@pytest.fixture
+def ledger():
+    return PerfLedger(window_s=60.0, max_plans=64, ref_decay=0.8)
+
+
+# -- log-bucketed histogram ---------------------------------------------------
+
+
+def test_bucket_quantile_bounds_error():
+    """4 buckets/octave ⇒ any estimate is within one bucket (≤ 2^(1/4) ≈
+    19%) of the true value, from above."""
+    for true_ms in (0.7, 3.0, 47.0, 512.0, 9000.0):
+        buckets = {pl._bucket_index(true_ms): 100}
+        est = bucket_quantile(buckets, 0.5)
+        assert true_ms <= est <= true_ms * 2 ** 0.25 * 1.0001, (true_ms, est)
+
+
+def test_bucket_quantile_orders_mixed_population():
+    fast = pl._bucket_index(2.0)
+    slow = pl._bucket_index(200.0)
+    buckets = {fast: 90, slow: 10}
+    assert bucket_quantile(buckets, 0.5) < 3.0
+    assert bucket_quantile(buckets, 0.99) > 150.0
+    assert bucket_quantile({}, 0.5) == 0.0
+
+
+# -- windows, rotation, reference decay ---------------------------------------
+
+
+def test_record_accumulates_and_rotation_folds(ledger):
+    for _ in range(10):
+        ledger.record("fp:a", table="t", time_ms=5.0, dispatches=2,
+                      compiles=1, cache_outcome="miss")
+    cur, ref, w, table = ledger.plan_windows("fp:a")
+    assert cur["queries"] == 10 and cur["dispatches"] == 20
+    assert cur["compiles"] == 10 and cur["cacheMisses"] == 10
+    assert w == 0.0 and table == "t"
+    ledger.rotate_now()
+    cur, ref, w, _ = ledger.plan_windows("fp:a")
+    assert cur["queries"] == 0 and ref["queries"] == 10 and w == 1.0
+    # second cycle: ref decays toward the steady-state rate
+    for _ in range(4):
+        ledger.record("fp:a", table="t", time_ms=5.0)
+    ledger.rotate_now()
+    _, ref, w, _ = ledger.plan_windows("fp:a")
+    assert ref["queries"] == pytest.approx(10 * 0.8 + 4)
+    assert w == pytest.approx(0.8 + 1.0)
+    # per-window average is ref/weight: between the two observed windows
+    assert 4 < ref["queries"] / w < 10
+
+
+def test_empty_window_rotation_keeps_reference(ledger):
+    ledger.record("fp:a", table="t", time_ms=5.0)
+    ledger.rotate_now()
+    _, ref1, w1, _ = ledger.plan_windows("fp:a")
+    ledger.rotate_now()  # nothing recorded since: no fold, no decay
+    _, ref2, w2, _ = ledger.plan_windows("fp:a")
+    assert ref2 == ref1 and w2 == w1
+
+
+def test_eviction_bounds_plan_count_under_churn(ledger):
+    for i in range(1000):
+        ledger.record(f"sql:{i:08x}", table="t", time_ms=1.0)
+    assert len(ledger) <= ledger.max_plans
+    assert ledger._evictions >= 1000 - ledger.max_plans
+
+
+def test_fallback_event_windows(ledger):
+    ledger.note_event("mesh-solo")
+    ledger.note_event("mesh-solo")
+    ledger.note_event("fused-host")
+    cur, ref, w, tot = ledger.events_windows()
+    assert cur == {"mesh-solo": 2, "fused-host": 1}
+    ledger.rotate_now()
+    cur, ref, w, tot = ledger.events_windows()
+    assert cur == {} and ref["mesh-solo"] == 2.0 and w == 1.0
+    assert tot == {"mesh-solo": 2, "fused-host": 1}
+
+
+# -- SLO burn rates -----------------------------------------------------------
+
+
+def test_burn_rates_multiwindow(ledger):
+    ledger.set_slo_override("t", {"errorRate": 0.1, "latencyMs": 100.0})
+    for i in range(20):
+        ledger.record("fp:a", table="t", time_ms=5.0, error=(i % 5 == 0))
+    br = ledger.burn_rates("t")
+    assert br["fast"]["queries"] == 20
+    # 4/20 errors against a 10% objective burns at 2x
+    assert br["fast"]["errorBurn"] == pytest.approx(2.0)
+    assert br["fast"]["latencyBurn"] == 0.0
+    assert br["slo"]["errorRate"] == 0.1
+    assert ledger.burn_rates("unseen") == {}
+
+
+def test_latency_breach_burns_budget(ledger):
+    ledger.set_slo_override("t", {"latencyMs": 10.0, "latencyPct": 0.9})
+    for i in range(10):
+        ledger.record("fp:a", table="t", time_ms=50.0 if i < 2 else 1.0)
+    br = ledger.burn_rates("t")
+    # 2/10 over the objective vs a 10% budget = 2x burn
+    assert br["fast"]["latencyBurn"] == pytest.approx(2.0)
+
+
+# -- persistence --------------------------------------------------------------
+
+
+def test_persist_restore_roundtrip(ledger):
+    store = PropertyStore()
+    for _ in range(6):
+        ledger.record("fp:a", table="t", time_ms=12.0, sql="SELECT 1")
+    ledger.rotate_now()
+    ledger.record("fp:a", table="t", time_ms=12.0)
+    ledger.persist(store)
+    fresh = PerfLedger(window_s=60.0, ref_decay=0.8)
+    assert fresh.restore(store) == 1
+    cur, ref, w, table = fresh.plan_windows("fp:a")
+    assert ref["queries"] == 6 and w == 1.0 and table == "t"
+    # histogram bucket keys survive the str()-keyed JSON round trip
+    assert ref["latBuckets"] == {pl._bucket_index(12.0): 6}
+    # live state wins: a second restore must not clobber fresher windows
+    fresh.record("fp:a", table="t", time_ms=1.0)
+    fresh.restore(store)
+    cur, _, _, _ = fresh.plan_windows("fp:a")
+    assert cur["queries"] == 1
+
+
+def test_restore_empty_store(ledger):
+    assert ledger.restore(PropertyStore()) == 0
+
+
+# -- exemplar arming ----------------------------------------------------------
+
+
+def test_exemplar_arm_claim_disarm(ledger):
+    assert ledger.exemplar_armed is False
+    assert ledger.claim_exemplar("fp:a", "t") is None
+    ledger.arm_exemplars("latency-drift-0001", plan_key="fp:a", count=2)
+    assert ledger.exemplar_armed is True
+    assert ledger.claim_exemplar("fp:b", "other") is None
+    assert ledger.claim_exemplar("fp:a", "t") == "latency-drift-0001"
+    assert ledger.claim_exemplar("fp:a", "t") == "latency-drift-0001"
+    # budget exhausted: auto-disarm
+    assert ledger.exemplar_armed is False
+    assert ledger.claim_exemplar("fp:a", "t") is None
+
+
+def test_exemplar_table_scope_and_targeted_disarm(ledger):
+    ledger.arm_exemplars("slo-burn-0001", table="t", count=5)
+    ledger.arm_exemplars("latency-drift-0002", plan_key="fp:x", count=5)
+    assert ledger.claim_exemplar("fp:anything", "t") == "slo-burn-0001"
+    ledger.disarm_exemplars("slo-burn-0001")
+    assert ledger.exemplar_armed is True  # the plan target survives
+    assert ledger.claim_exemplar("fp:anything", "t") is None
+    assert ledger.claim_exemplar("fp:x", "t") == "latency-drift-0002"
+    ledger.disarm_exemplars()
+    assert ledger.exemplar_armed is False
+
+
+# -- snapshot -----------------------------------------------------------------
+
+
+def test_snapshot_shape(ledger):
+    for ms in (2.0, 4.0, 100.0):
+        ledger.record("fp:a", table="t", time_ms=ms, sql="SELECT 1")
+    ledger.rotate_now()
+    ledger.record("fp:a", table="t", time_ms=3.0)
+    ledger.note_event("mesh-solo")
+    snap = ledger.snapshot()
+    p = snap["plans"][0]
+    assert p["fingerprint"] == "fp:a"
+    assert p["totals"]["queries"] == 4
+    assert p["refP50Ms"] > 0 and p["shortP50Ms"] > 0
+    assert snap["fallbackEvents"]["total"] == {"mesh-solo": 1}
+
+
+# -- alert book ---------------------------------------------------------------
+
+
+def test_alertbook_fire_dedup_resolve():
+    book = AlertBook()
+    aid, new = book.fire("latency-drift", "fp:a", "t", "p50 2x", {})
+    assert new and aid == "latency-drift-0001"
+    assert book.active_count == 1
+    aid2, new2 = book.fire("latency-drift", "fp:a", "t", "p50 3x", {})
+    assert aid2 == aid and not new2, "same (type,key) must dedup"
+    assert book.get(aid)["fireCount"] == 2
+    assert book.get(aid)["summary"] == "p50 3x"
+    aid3, new3 = book.fire("compile-storm", "fp:a", "t", "x", {})
+    assert new3 and aid3 != aid
+    assert book.active_count == 2
+    book.resolve("latency-drift", "fp:a")
+    assert book.active_count == 1
+    rec = book.get(aid)
+    assert rec["state"] == "cleared" and rec["clearReason"] == "recovered"
+    assert "clearedMs" in rec
+    # refire after clear: a NEW alert id (new incident)
+    aid4, new4 = book.fire("latency-drift", "fp:a", "t", "again", {})
+    assert new4 and aid4 != aid
+
+
+def test_alertbook_exemplars_and_query_crosslink():
+    book = AlertBook()
+    aid, _ = book.fire("latency-drift", "fp:a", "t", "s", {})
+    book.note_exemplar(aid, "trace-1")
+    book.note_exemplar(aid, "trace-2")
+    book.note_exemplar("no-such-alert", "trace-3")
+    assert book.get(aid)["exemplarTraceIds"] == ["trace-1", "trace-2"]
+    assert book.exemplars_pinned() == 2
+    assert book.active_ids_for("fp:a", "other") == [aid]
+    assert book.active_ids_for("fp:zzz", "t") == [aid]
+    assert book.active_ids_for("fp:zzz", "other") == []
+    book.resolve("latency-drift", "fp:a")
+    assert book.active_ids_for("fp:a", "t") == []
+
+
+def test_alertbook_bounded_history():
+    book = AlertBook(max_history=10)
+    for i in range(40):
+        aid, _ = book.fire("latency-drift", f"fp:{i}", "t", "s", {})
+        book.resolve("latency-drift", f"fp:{i}")
+    assert len(book.snapshot()["alerts"]) <= 10
+
+
+def test_alertbook_snapshot_lists_both_active():
+    book = AlertBook()
+    book.fire("latency-drift", "fp:a", "t", "s", {})
+    book.fire("compile-storm", "fp:b", "t", "s", {})
+    assert {a["type"] for a in book.active()} == {"compile-storm",
+                                                 "latency-drift"}
+    snap = book.snapshot()
+    assert snap["active"] == 2 and len(snap["alerts"]) == 2

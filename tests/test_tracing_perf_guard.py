@@ -226,6 +226,105 @@ def test_warm_dispatch_counts_without_fingerprint_work(warm_engine,
     assert span_allocations() == spans_before
 
 
+# -- performance-ledger guard: the per-plan ledger records every broker
+# -- query as pure counter bumps — zero syncs, zero span allocations,
+# -- zero store writes, zero fingerprint (IR-walk) computations, and a
+# -- single attribute read for the disarmed exemplar check
+
+
+def test_ledger_records_warm_query_at_zero_cost(warm_cluster, monkeypatch):
+    from pinot_tpu.cache import keys as cache_keys
+    from pinot_tpu.engine.perf_ledger import PERF_LEDGER
+
+    store, broker, _ = warm_cluster
+    monkeypatch.delenv("PINOT_TPU_TRACE_SAMPLE", raising=False)
+    assert PERF_LEDGER.exemplar_armed is False
+    sync = _CountingSync(monkeypatch)
+    walks = {"n": 0}
+    real_cb = cache_keys.canonical_bytes
+
+    def counting_cb(obj):
+        walks["n"] += 1
+        return real_cb(obj)
+
+    monkeypatch.setattr(cache_keys, "canonical_bytes", counting_cb)
+    writes = {"n": 0}
+    real_set = store.set
+
+    def counting_set(path, value, *a, **kw):
+        writes["n"] += 1
+        return real_set(path, value, *a, **kw)
+
+    monkeypatch.setattr(store, "set", counting_set)
+    spans_before = span_allocations()
+
+    def ledger_queries():
+        return sum(p["totals"]["queries"]
+                   for p in PERF_LEDGER.snapshot()["plans"]
+                   if p["table"] == "pgclu")
+
+    q_before = ledger_queries()
+    r = broker.execute_sql(CSQL)
+    assert not r.exceptions, r.exceptions
+    assert ledger_queries() == q_before + 1, (
+        "the ledger must record every broker query")
+    assert sync.block_calls == 0 and sync.device_get_calls == 0, (
+        "ledger recording must not add device syncs")
+    assert span_allocations() == spans_before, (
+        "ledger recording must allocate zero Span objects")
+    assert writes["n"] == 0, (
+        "ledger persistence belongs to the sentinel scrape, never the "
+        "query thread")
+    assert walks["n"] == 0, (
+        "the ledger key must reuse the result-cache fingerprint or a "
+        "crc32 — never a fresh canonical-bytes IR walk")
+
+
+def test_ledger_memory_bounded_under_fingerprint_churn(warm_cluster,
+                                                       monkeypatch):
+    """A fingerprint flood (distinct SQL per query) must not grow the
+    ledger past its plan cap — batch eviction absorbs the churn."""
+    from pinot_tpu.engine.perf_ledger import PERF_LEDGER
+
+    _store, broker, _ = warm_cluster
+    PERF_LEDGER.clear()  # drop plans accumulated by earlier test files
+    monkeypatch.setattr(PERF_LEDGER, "max_plans", 8)
+    for i in range(40):
+        r = broker.execute_sql(
+            f"SET resultCache = false; SELECT pck, SUM(pcv) FROM pgclu "
+            f"WHERE pcv < {1000 + i} GROUP BY pck")
+        assert not r.exceptions, r.exceptions
+        assert len(PERF_LEDGER) <= 8, (
+            "fingerprint churn must stay inside the plan cap")
+
+
+def test_armed_exemplar_pins_a_trace(warm_cluster, monkeypatch):
+    """Sanity for the zero-cost guard: arming exemplars DOES force-trace
+    the next matching query and link it to the alert."""
+    from pinot_tpu.engine.perf_ledger import ALERTS, PERF_LEDGER
+
+    _store, broker, _ = warm_cluster
+    monkeypatch.delenv("PINOT_TPU_TRACE_SAMPLE", raising=False)
+    aid, _new = ALERTS.fire("latency-drift", "pgclu-test", "pgclu",
+                            "guard sanity", {})
+    PERF_LEDGER.arm_exemplars(aid, table="pgclu", count=1)
+    try:
+        spans_before = span_allocations()
+        r = broker.execute_sql(CSQL)
+        assert not r.exceptions, r.exceptions
+        assert span_allocations() > spans_before, (
+            "armed exemplar must force a sampled trace")
+        rec = ALERTS.get(aid)
+        assert r.query_id in rec["exemplarTraceIds"]
+        ent = broker.trace_store.get(r.query_id)
+        assert ent and aid in ent["alertIds"] and ent["pinned"]
+        assert PERF_LEDGER.exemplar_armed is False, (
+            "a one-shot budget must auto-disarm")
+    finally:
+        PERF_LEDGER.disarm_exemplars()
+        ALERTS.resolve("latency-drift", "pgclu-test")
+
+
 def test_analyze_and_beacon_move_the_new_counters(warm_cluster):
     """Sanity for the guard above: an armed run DOES move the new
     observability counters — ANALYZE allocates spans, the workload
