@@ -57,7 +57,7 @@ _START = time.monotonic()
 # and a hung/abandoned child skips every config after it
 CONFIGS = [c for c in os.environ.get(
     "BENCH_CONFIGS",
-    "q1,q2,q9,q3,q4,q5,q7,q8,q9j,q10,q3m,q6m,q6").split(",") if c]
+    "q1,q2,q9,q3,q4,q5,q7,q8,q9j,q10,q3m,q6m,q11r,q6").split(",") if c]
 ROOT = Path(__file__).parent
 CACHE = ROOT / ".bench_cache"
 # smoke/dev runs point this elsewhere (BENCH_PARTIAL_DIR) so they never
@@ -119,6 +119,13 @@ Q10 = ("SELECT a.d_year, COUNT(*), SUM(c.lo_revenue) FROM {t} a "
        "WHERE a.lo_quantity < 3 AND b.lo_discount = 0 "
        "AND c.lo_quantity < 2 "
        "GROUP BY a.d_year ORDER BY a.d_year LIMIT 100")
+# live-ingest config: a CONSUMING (mutable) segment executed on the
+# realtime device planes (realtime/device_plane.py). The timed loop runs
+# against a plane-resident snapshot; the config additionally records the
+# delta-upload economics (rt_full_bytes vs rt_delta_bytes vs
+# rt_warm_bytes) that the bench gate pins.
+Q11R = ("SELECT site, SUM(clicks), SUM(revenue), COUNT(*) FROM rt "
+        "GROUP BY site ORDER BY site LIMIT 100")
 
 RUNS = {
     "q1": ("q1_filter_sum", Q1.format(t="ssb"), "ssb", 1.0, 0.0),
@@ -145,6 +152,9 @@ RUNS = {
             1 / 3, 0.0),
     "q6m": ("q6m_sparse_distinct16", Q6.format(t="ssb16"), "ssb16",
             1 / 3, 0.0),
+    # live-ingest table built in-process (tname "rt" needs no prebuilt
+    # table dirs); run_single short-circuits into _run_realtime_single
+    "q11r": ("q11r_realtime_ingest", Q11R, "rt", 1 / 3, 0.0),
 }
 
 N_BRANDS = 1000
@@ -831,7 +841,157 @@ def _measure_rtt(jax) -> float:
     return float(np.median(ts[1:]))
 
 
+def _run_realtime_single(outpath: str):
+    """q11r: a CONSUMING (mutable) segment executed on the realtime device
+    planes. Beyond the usual cold/warm p50s the payload records the
+    delta-upload economics the bench gate pins:
+
+      rt_full_bytes  — bytes uploaded by the FIRST query (cold: the whole
+                       snapshot crosses to the device),
+      rt_delta_bytes — bytes uploaded by the first query AFTER appending
+                       ~1% more rows (only the new tail may cross;
+                       rt_delta_bytes >= rt_full_bytes means the
+                       incremental path is gone),
+      rt_warm_bytes  — bytes uploaded by a repeat on an unchanged
+                       generation (must stay 0: plane-resident fast path).
+
+    The row count is deliberately modest (BENCH_RT_ROWS, default 200k):
+    MutableSegment.index() is per-row host-side work, and the quantity
+    under test is upload BYTES, which scale linearly anyway.
+    """
+    name = RUNS["q11r"][0]
+    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S", 600))
+    jax, platform, note = _init_backend()
+    from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.ingestion.transform import build_transform_pipeline
+    from pinot_tpu.realtime.device_plane import (realtime_stats,
+                                                 reset_realtime_stats)
+    from pinot_tpu.segment.mutable import MutableSegment
+    from pinot_tpu.spi.data_types import Schema
+
+    n = int(os.environ.get("BENCH_RT_ROWS", 200_000))
+    delta_n = max(256, n // 100)
+    total = n + delta_n
+    schema = Schema.build(
+        "rt",
+        dimensions=[("site", "STRING"), ("code", "INT")],
+        metrics=[("clicks", "INT"), ("revenue", "LONG")])
+    rng = np.random.default_rng(7)
+    sites = [f"site{i:02d}" for i in range(64)]
+    site_idx = rng.integers(0, 64, total)
+    code = rng.integers(0, 1000, total)
+    clicks = rng.integers(0, 100, total)
+    revenue = rng.integers(0, 10_000, total)
+    seg = MutableSegment(schema, "rt_live_0")
+    pipe = build_transform_pipeline(schema)
+
+    def feed(lo: int, hi: int):
+        for i in range(lo, hi):
+            seg.index(pipe.transform({
+                "site": sites[site_idx[i]], "code": int(code[i]),
+                "clicks": int(clicks[i]), "revenue": int(revenue[i])}))
+
+    feed(0, n)
+    tpu = QueryExecutor(backend="tpu")
+    host = QueryExecutor(backend="host")
+    for qe in (tpu, host):
+        qe.add_table(schema, [seg], name="rt")
+    sql = RUNS["q11r"][1]
+    # caches off so every timed iteration exercises the device execution
+    # path; the planes themselves are NOT a cache tier — they persist
+    # across iterations, so only the first run uploads
+    nocache = "SET segmentCache = false; SET resultCache = false; " + sql
+
+    reset_realtime_stats()
+    r = tpu.execute_sql(nocache)  # cold: full snapshot upload + compile
+    if r.exceptions:
+        raise RuntimeError(f"{nocache}: {r.exceptions}")
+    rt_full_bytes = int(realtime_stats()["deltaBytes"])
+
+    # steady-state loop: generation unchanged → plane-resident, 0 uploads
+    target_iters = max(3, round(ITERS / 3))
+    times = []
+    while len(times) < target_iters and (
+            not times or time.monotonic() + min(times) < deadline):
+        t0 = time.perf_counter()
+        r = tpu.execute_sql(nocache)
+        times.append(time.perf_counter() - t0)
+    if r.exceptions:
+        raise RuntimeError(f"{nocache}: {r.exceptions}")
+    p50 = float(np.median(times))
+
+    # warm repeat with caching at defaults on the SAME generation: the
+    # partial tiers serve it and the planes must upload nothing
+    warm_p50 = warm_match = None
+    rt_warm_bytes = None
+    try:
+        rw = tpu.execute_sql(sql)  # populate
+        reset_realtime_stats()
+        warm_times = []
+        while len(warm_times) < min(target_iters, 5) and (
+                not warm_times
+                or time.monotonic() + min(warm_times) < deadline):
+            t0 = time.perf_counter()
+            rw = tpu.execute_sql(sql)
+            warm_times.append(time.perf_counter() - t0)
+        if not rw.exceptions:
+            warm_p50 = float(np.median(warm_times))
+            warm_match = _rows_match(r.result_table.rows,
+                                     rw.result_table.rows, 0.0)
+            rt_warm_bytes = int(realtime_stats()["deltaBytes"])
+    except Exception:
+        pass  # warm numbers are additive; never fail the config
+
+    # ingest ~1% more rows, query again with caches off: only the new
+    # tail should cross (delta upload, generation bump)
+    feed(n, total)
+    reset_realtime_stats()
+    t0 = time.perf_counter()
+    rd = tpu.execute_sql(nocache)
+    delta_query_s = time.perf_counter() - t0
+    if rd.exceptions:
+        raise RuntimeError(f"post-delta {nocache}: {rd.exceptions}")
+    rt_delta_bytes = int(realtime_stats()["deltaBytes"])
+
+    # host baseline at the SAME generation: live-ingest bit-identity
+    rh = host.execute_sql(sql)
+    if rh.exceptions:
+        raise RuntimeError(f"host {sql}: {rh.exceptions}")
+    match = _rows_match(rd.result_table.rows, rh.result_table.rows, 0.0)
+
+    payload = {
+        "tpu_p50_s": p50,
+        "rows_per_sec": n / p50,
+        "cold_p50_s": p50,
+        "warm_p50_s": warm_p50,
+        "warm_speedup": (p50 / warm_p50) if warm_p50 else None,
+        "warm_match": warm_match,
+        "match": match,
+        "iters": len(times),
+        "platform": platform,
+        "num_device_dispatches": getattr(rd, "num_device_dispatches", 0),
+        "num_compiles": getattr(rd, "num_compiles", 0),
+        "rt_rows": n,
+        "rt_delta_rows": delta_n,
+        "rt_full_bytes": rt_full_bytes,
+        "rt_delta_bytes": rt_delta_bytes,
+        "rt_warm_bytes": rt_warm_bytes,
+        "rt_delta_query_s": delta_query_s,
+    }
+    if note:
+        payload["note"] = note
+    print(f"[bench] {name}: p50 {p50*1000:.1f}ms, full upload "
+          f"{rt_full_bytes}B, +{delta_n} rows → delta {rt_delta_bytes}B, "
+          f"warm {rt_warm_bytes}B, match={match}, warm_match={warm_match}",
+          file=sys.stderr)
+    tmp = Path(outpath + ".tmp")
+    tmp.write_text(json.dumps(payload))
+    tmp.replace(outpath)
+
+
 def run_single(cfg: str, outpath: str):
+    if cfg == "q11r":
+        return _run_realtime_single(outpath)
     name, sql, tname, iter_frac, tol = RUNS[cfg]
     deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S", 600))
     jax, platform, note = _init_backend()

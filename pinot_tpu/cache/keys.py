@@ -33,7 +33,7 @@ import numpy as np
 EXECUTION_ONLY_OPTIONS = frozenset({
     "segmentbatch", "devicecombine", "segmentcache", "resultcache",
     "trace", "timeoutms", "usemultistageengine", "meshexecution",
-    "devicejoin", "coalesce",
+    "devicejoin", "coalesce", "realtimedeviceplanes",
 })
 
 # Lifetime fingerprint computations in this process — the perf guard
@@ -184,13 +184,22 @@ def mse_plan_fingerprint(stages, query_options,
 
 
 def segment_token(segment) -> Optional[tuple]:
-    """Content identity of an immutable segment: (name, crc). Returns None
-    for realtime/mutable snapshots (content changes between queries) and
-    for segments without a crc — those always bypass the cache. The crc is
-    part of the key, so a replaced segment reusing its name can never
-    serve stale partials even before eager invalidation runs."""
+    """Content identity of an immutable segment: (name, crc). Realtime
+    snapshot views with a pinned generation get ("rt", name, generation):
+    the row prefix below the pinned count is append-only immutable and the
+    upsert validity generation rides in the tuple, so equal tokens imply
+    byte-identical snapshot contents — stale reuse is impossible by
+    construction. Mutable objects WITHOUT a pinned generation, and
+    segments without a crc, return None and always bypass the cache. The
+    crc is part of the immutable key, so a replaced segment reusing its
+    name can never serve stale partials even before eager invalidation
+    runs."""
     if getattr(segment, "is_mutable", False):
-        return None
+        gen = getattr(segment, "snapshot_generation", None)
+        name = getattr(segment, "name", None)
+        if gen is None or not name:
+            return None
+        return ("rt", str(name), tuple(gen))
     meta = getattr(segment, "metadata", None)
     name = getattr(segment, "name", None) or getattr(meta, "segment_name", None)
     crc = getattr(meta, "crc", None)

@@ -271,7 +271,24 @@ class TpuSegmentExecutor:
         self.cache = cache or GLOBAL_DEVICE_CACHE
 
     def plan(self, query: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
+        if getattr(segment, "is_mutable", False):
+            # consuming-segment snapshots lower through the realtime
+            # planner (value-space ranges, no MV/rebased planes); its
+            # UnsupportedQueryError falls back to host like any other
+            from ..realtime.device_plane import realtime_plan
+
+            return realtime_plan(query, segment)
         return SegmentPlanner(query, segment).plan()
+
+    def _view_for(self, segment):
+        """Device view: the HBM cache for immutable segments, the
+        realtime plane registry (delta-uploaded append-only planes) for
+        consuming-segment snapshots."""
+        if getattr(segment, "is_mutable", False):
+            from ..realtime.device_plane import REALTIME_PLANES
+
+            return REALTIME_PLANES.view(segment)
+        return self.cache.view(segment)
 
     def execute(self, query: QueryContext, segment: ImmutableSegment):
         plan = self.plan(query, segment)
@@ -314,7 +331,7 @@ class TpuSegmentExecutor:
 
     def _dispatch_plan(self, segment: ImmutableSegment, plan: SegmentPlan,
                        span):
-        view = self.cache.view(segment)
+        view = self._view_for(segment)
         arrays, packed = plan.gather_arrays_packed(view)
         # params pass as host numpy: jit converts arguments itself — an
         # eager jnp.asarray per param costs a device dispatch each (~1ms ×
@@ -445,7 +462,7 @@ class TpuSegmentExecutor:
 
     def _dispatch_plan_raw(self, segment: ImmutableSegment,
                            plan: SegmentPlan, span):
-        view = self.cache.view(segment)
+        view = self._view_for(segment)
         arrays, packed = plan.gather_arrays_packed(view)
         params = tuple(p if isinstance(p, (np.ndarray, np.generic))
                        else np.asarray(p) for p in plan.params)
@@ -491,7 +508,7 @@ class TpuSegmentExecutor:
         gathered planes disagree in dtype/shape/packing — the host-side
         family key should prevent that; the check makes a drift fall back,
         not corrupt."""
-        views = [self.cache.view(s) for s in segments]
+        views = [self._view_for(s) for s in segments]
         gathered = [pl.gather_arrays_packed(v)
                     for pl, v in zip(plans, views)]
         packed = gathered[0][1]

@@ -79,6 +79,17 @@ def relieve_pressure(keep_segment=None, cache=None) -> int:
     freed, victims = cache.evict_all_except(keep_segment)
     if victims:
         SERVER_METRICS.add_meter(ServerMeter.HBM_OOM_EVICTIONS, victims)
+    # realtime device planes are rebuildable from the host segment (the
+    # next query re-uploads from row 0) — under OOM they are cold cache
+    # like any other plane. keep_segment is a snapshot view; keep its
+    # UNDERLYING segment's planes (they back the retry's uploads).
+    try:
+        from ..realtime.device_plane import REALTIME_PLANES
+
+        keep = getattr(keep_segment, "_seg", keep_segment)
+        freed += REALTIME_PLANES.clear(keep=keep)
+    except Exception:  # pragma: no cover - relief must never raise
+        pass
     gc.collect()  # drop dangling jax.Array refs so XLA can free HBM now
     return freed
 

@@ -192,9 +192,14 @@ class SegmentPlan:
 
 
 class SegmentPlanner(AggPlanContext):
+    # realtime/device_plane.py's planner subclass lifts this: a pinned
+    # MutableSegmentView exposes enough immutable state (snapshot dict,
+    # pinned metadata, pinned validity) to lower device plans safely
+    allow_mutable = False
+
     def __init__(self, query: QueryContext, segment: ImmutableSegment):
         super().__init__()
-        if getattr(segment, "is_mutable", False):
+        if not self.allow_mutable and getattr(segment, "is_mutable", False):
             raise UnsupportedQueryError(
                 "consuming (mutable) segments execute on the host engine")
         self.query = query

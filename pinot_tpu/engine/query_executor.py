@@ -309,9 +309,10 @@ class QueryExecutor:
             table = self.tables.get(query.table_name.rsplit("_", 1)[0])
         if table is None:
             return None
-        segments = list(table.segments)
-        if any(getattr(s, "is_mutable", False) for s in segments):
-            return None
+        # consuming segments join through a pinned snapshot; if the plan
+        # can't lower on the realtime planner the except below falls back
+        segments = [s.snapshot_view() if getattr(s, "is_mutable", False)
+                    else s for s in table.segments]
         from ..query.optimizer import optimize_filter
         from ..segment.bitpack import unpack_bitmap
 
@@ -349,6 +350,10 @@ class QueryExecutor:
             # any planning/device hiccup: the row path re-runs the leaf
             # with identical semantics (and surfaces real failures)
             return None
+        if any(getattr(s, "is_mutable", False) for s in kept):
+            from ..realtime.device_plane import note_realtime_device_query
+
+            note_realtime_device_query()
         cols: dict = {}
         for c, ps in parts.items():
             if not ps:
@@ -472,16 +477,20 @@ class QueryExecutor:
         for idx, segment in enumerate(kept):
             check(idx)
             run_query, run_segment, rewrite = self._segment_route(query, segment)
-            if self.backend == "host" or getattr(run_segment, "is_mutable", False):
-                # consuming segments execute on host (unsorted mutable
-                # dictionaries have no device predicate form until commit)
+            if self.backend == "host":
                 host_work.append((idx, run_query, run_segment, rewrite))
                 continue
             try:
+                # consuming-segment snapshots lower through the realtime
+                # planner (realtime/device_plane.py) and join the device
+                # path; unsupported shapes fall back per segment
                 device_entries.append((idx, run_query, run_segment, rewrite,
                                        self.tpu.plan(run_query, run_segment)))
             except UnsupportedQueryError:
-                if self.backend == "tpu":
+                if self.backend == "tpu" \
+                        and not getattr(run_segment, "is_mutable", False):
+                    # mutable snapshots stay best-effort even under the
+                    # forced-device backend: realtime tables must answer
                     raise
                 host_work.append((idx, run_query, run_segment, rewrite))
 
@@ -532,15 +541,23 @@ class QueryExecutor:
         # traced queries never coalesce (their spans must describe their
         # own device work)
         from .coalesce import coalesce_enabled
+        from ..realtime.device_plane import (RealtimeUploadError,
+                                             note_realtime_device_query)
 
         co_on = coalesce_enabled(query) and TRACING.active_trace() is None
+        rt_device = False  # any consuming segment answered on device
         for fkey, positions in self._batch_families(
                 query, [(e[2], e[4]) for e in device_entries], mesh=msig):
             entries = [device_entries[p] for p in positions]
             if fkey is not None and len(entries) > 1:
                 segs_f = [e[2] for e in entries]
                 plans_f = [e[4] for e in entries]
-                if co_on:
+                fam_mutable = any(getattr(s, "is_mutable", False)
+                                  for s in segs_f)
+                # the coalescer's family key carries no snapshot
+                # generation, so a held group could serve one generation's
+                # stack to a later query — consuming families never join
+                if co_on and not fam_mutable:
                     def _co_runner(segs_all, plans_all,
                                    _keep=segs_f[0], _m=msig):
                         pack = with_oom_retry(
@@ -570,6 +587,8 @@ class QueryExecutor:
                         keep_segment=segs_f[0], cache=self.tpu.cache)
                 except BatchFamilyMismatch:
                     pass  # host key over-grouped; per-segment is always valid
+                except RealtimeUploadError:
+                    pass  # per-segment path below host-falls the faulted one
                 except HbmExhaustedError:
                     # the [S, N] stacks ~double the family's footprint, so a
                     # family that fits per-segment can OOM batched even after
@@ -577,6 +596,8 @@ class QueryExecutor:
                     # per-segment path (below, with its own retry) completes
                     pass
                 else:
+                    if fam_mutable:
+                        rt_device = True
                     fam_packs[fkey] = pack
                     fam_inputs[fkey] = (segs_f, plans_f)
                     for row, e in enumerate(entries):
@@ -584,9 +605,23 @@ class QueryExecutor:
                     continue
             for e in entries:
                 idx, run_query, run_segment, rewrite, plan = e
-                outs = with_oom_retry(
-                    lambda: self.tpu.dispatch_plan(run_segment, plan),
-                    keep_segment=run_segment, cache=self.tpu.cache)
+                try:
+                    outs = with_oom_retry(
+                        lambda: self.tpu.dispatch_plan(run_segment, plan),
+                        keep_segment=run_segment, cache=self.tpu.cache)
+                except RealtimeUploadError:
+                    # delta upload faulted/overran its budget: THIS query
+                    # answers on host (bit-identical); plane state is
+                    # pre-fault-consistent or dropped for full re-upload
+                    inter = self._account(
+                        tracker, lambda rq=run_query, rs=run_segment:
+                        self.host.execute(rq, rs), run_segment)
+                    intermediates[idx] = (
+                        self._remap_star_tree(rewrite, inter) if rewrite
+                        else inter)
+                    continue
+                if getattr(run_segment, "is_mutable", False):
+                    rt_device = True
                 pending.append((idx, run_query, run_segment, rewrite, plan,
                                 outs))
 
@@ -649,11 +684,29 @@ class QueryExecutor:
                 return fetch_packed_batch(packs)
 
             if solo or fam_keys:
-                fetched = with_oom_retry(
-                    lambda: fetch_packed_batch(
-                        [p[5] for p in solo]
-                        + [fam_packs[k] for k in fam_keys]),
-                    cache=self.tpu.cache, retry_fn=_refetch)
+                try:
+                    fetched = with_oom_retry(
+                        lambda: fetch_packed_batch(
+                            [p[5] for p in solo]
+                            + [fam_packs[k] for k in fam_keys]),
+                        cache=self.tpu.cache, retry_fn=_refetch)
+                except RealtimeUploadError:
+                    # double fault: OOM relief dropped the realtime planes
+                    # mid-query and the re-dispatch's re-upload faulted too.
+                    # Upload faults must never fail a query — host-execute
+                    # every still-pending segment instead.
+                    for p in pending:
+                        idx, run_query, run_segment, rewrite = p[:4]
+                        inter = self._account(
+                            tracker, lambda rq=run_query, rs=run_segment:
+                            self.host.execute(rq, rs), run_segment)
+                        intermediates[idx] = (
+                            self._remap_star_tree(rewrite, inter)
+                            if rewrite else inter)
+                        done += 1
+                    pending = []
+                    fetched = []
+                    solo, fam_keys, fam_hosts = [], [], {}
             else:
                 fetched = []  # coalesced families arrive host-side already
             solo_outs = {id(p): raw for p, raw in zip(solo, fetched)}
@@ -734,6 +787,8 @@ class QueryExecutor:
                 # not device work); agg/group partials are pure merges
                 if isinstance(inter, (AggIntermediate, GroupByIntermediate)):
                     GLOBAL_PARTIAL_CACHE.put(key, inter, (seg_name,))
+        if rt_device:
+            note_realtime_device_query()
         return intermediates
 
     def _segment_cache_enabled(self, query: QueryContext) -> bool:
@@ -751,8 +806,12 @@ class QueryExecutor:
     def _partial_cache_key(self, run_query, run_segment, rewrite, plan):
         """(program_fp, segment_token) for one routed segment, or None when
         this segment can't participate: star-tree rewrites (the cached
-        partial would be pre-remap against a derived view), mutable/
-        crc-less segments, or plans with unfingerprintable state."""
+        partial would be pre-remap against a derived view), crc-less
+        immutable segments, mutable snapshots without a generation stamp,
+        or plans with unfingerprintable state. Generation-stamped realtime
+        snapshots DO participate — their token folds (rows, upsert_gen), so
+        a new row or upsert flip mints a fresh key and stale partials are
+        invalidated by name at commit."""
         if rewrite is not None:
             return None
         from ..cache.keys import program_fingerprint, segment_token
@@ -842,8 +901,7 @@ class QueryExecutor:
         for segment in kept:
             run_query, run_segment, rewrite = self._segment_route(
                 query, segment)
-            if rewrite is not None or \
-                    getattr(run_segment, "is_mutable", False):
+            if rewrite is not None:
                 return None
             try:
                 plans.append(self.tpu.plan(run_query, run_segment))
@@ -1006,6 +1064,10 @@ class QueryExecutor:
                 tuple(getattr(s, "name", "?") for s in segs))
         if tracker is not None:
             GLOBAL_ACCOUNTANT.on_allocation(tracker, _estimate_bytes(ga))
+        if any(getattr(s, "is_mutable", False) for s in segs):
+            from ..realtime.device_plane import note_realtime_device_query
+
+            note_realtime_device_query()
         return [ga]
 
     def _segment_route(self, query: QueryContext, segment):

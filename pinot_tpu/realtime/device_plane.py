@@ -1,0 +1,501 @@
+"""Realtime device planes: consuming segments join the device fast path.
+
+A consuming (mutable) segment is append-only below every published row
+count: once ``MutableSegment.index()`` publishes ``num_docs = n``, rows
+``< n`` — forward ids, raw values, null docs, dictionary entries they
+reference — never change again. This module exploits that invariant to
+keep per-column *device-resident planes* for each consuming segment:
+
+- Planes live in pow2 row buckets (``pad_bucket``, the engine's shared
+  kernel shape bucket). Capacity grows device-side (no host re-upload)
+  when a snapshot outgrows its bucket.
+- On query, only the rows appended since the last uploaded watermark are
+  shipped host→device (``jax.lax.dynamic_update_slice`` with a *runtime*
+  start index, so the write executable is cached per shape bucket — no
+  per-offset recompiles). Delta bytes are metered
+  (``realtimeDeltaUploadBytes``) and are proportional to new rows, never
+  to snapshot size; an unchanged generation uploads zero bytes.
+- Kernels slice the plane to the snapshot's pad bucket and mask rows
+  ``>= num_docs`` (the engine-wide pad-row invariant), so device results
+  are bit-identical to the host path over the same pinned snapshot.
+- Upsert tables ride the same planes: the snapshot view pins the
+  validity mask together with its upsert generation
+  (``ValidDocIds.snapshot``), and the mask ships as a kernel param plane
+  exactly like the immutable upsert path — host and device AND the same
+  bits.
+
+The ``RealtimeSegmentPlanner`` lowers plans against a pinned
+``MutableSegmentView``: the insertion-ordered mutable dictionary breaks
+the sorted-id-interval RANGE lowering, so ranges lower to value-space
+boolean LUTs instead; MV and rebased-float planes stay host-side.
+
+Fault point ``realtime.upload`` covers the delta upload: an error fault
+fails ONLY this query over to the host (planes and watermark keep their
+pre-fault state); a corrupt fault poisons the whole plane set so the next
+query re-uploads from scratch — degraded, never wrong; a delay fault that
+overruns ``PINOT_TPU_RT_UPLOAD_BUDGET_MS`` falls back to host inside the
+query deadline without advancing the watermark.
+
+Layout reference: Ragged Paged Attention's append-only paged device
+buffers (pages grow without recompiling; readers bound by a row
+watermark) — the same shape a consuming segment needs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine import ir
+from ..engine.aggregation import UnsupportedQueryError
+from ..engine.plan import SegmentPlanner, _coerce_like
+from ..query.filter import PredicateType
+from ..segment.device_cache import _note_upload, pad_bucket
+from ..spi import faults
+from ..spi.data_types import DataType
+from ..spi.metrics import SERVER_METRICS, ServerMeter
+
+# smallest delta chunk shipped in one update-slice write: pow2 chunking
+# bounds the distinct update shapes (and thus cached write executables)
+# to log2(bucket) variants while keeping upload bytes ∝ new rows
+_MIN_CHUNK = 256
+
+
+class RealtimeUploadError(Exception):
+    """A delta upload failed (injected fault / budget overrun). The query
+    executor answers this query on the HOST path; device planes keep a
+    consistent pre-fault state (or were dropped wholesale on corruption),
+    so no wrong bytes can survive to a later query."""
+
+
+def realtime_device_enabled(query=None) -> bool:
+    """Master knob: env PINOT_TPU_REALTIME_DEVICE (default on) with a
+    per-query ``SET realtimeDevicePlanes = true|false`` override."""
+    on = os.environ.get("PINOT_TPU_REALTIME_DEVICE", "1").strip().lower() \
+        not in ("0", "false", "off")
+    if query is not None:
+        for k, v in getattr(query, "query_options", {}).items():
+            if str(k).lower() == "realtimedeviceplanes":
+                return str(v).strip().lower() not in ("0", "false", "off")
+    return on
+
+
+def _upload_budget_ms() -> float:
+    try:
+        return float(os.environ.get("PINOT_TPU_RT_UPLOAD_BUDGET_MS", "100"))
+    except ValueError:
+        return 100.0
+
+
+# -- per-query upload attribution (tests / bench payloads) --------------------
+
+_TLS = threading.local()
+
+
+def reset_realtime_stats() -> None:
+    """Arm per-thread delta-upload counters (test/bench attribution)."""
+    _TLS.stats = {"deltaBytes": 0, "uploads": 0, "deviceQueries": 0}
+
+
+def realtime_stats() -> Optional[dict]:
+    return getattr(_TLS, "stats", None)
+
+
+def _note_delta(nbytes: int) -> None:
+    st = getattr(_TLS, "stats", None)
+    if st is not None:
+        st["deltaBytes"] += nbytes
+        st["uploads"] += 1
+
+
+def note_realtime_device_query() -> None:
+    """One query answered over a consuming segment on the device path."""
+    SERVER_METRICS.add_meter(ServerMeter.REALTIME_DEVICE_QUERIES, 1)
+    st = getattr(_TLS, "stats", None)
+    if st is not None:
+        st["deviceQueries"] += 1
+
+
+# -- device plane store -------------------------------------------------------
+
+
+class _Plane:
+    """One device array + its uploaded-row watermark. For dictionary
+    planes ``rows`` counts uploaded dictionary entries instead."""
+
+    __slots__ = ("arr", "rows")
+
+    def __init__(self):
+        self.arr = None
+        self.rows = 0
+
+
+def _chunk_len(delta: int, room: int) -> int:
+    """Pow2 write-chunk ≥ delta, clipped to the rows remaining before the
+    plane's capacity. Zeros beyond the delta land strictly above the new
+    watermark (still-unuploaded territory), so they can never clobber
+    uploaded data."""
+    c = _MIN_CHUNK
+    while c < delta:
+        c <<= 1
+    return min(c, room)
+
+
+class RealtimePlaneSet:
+    """Append-only device planes for ONE consuming segment, shared by
+    every query/snapshot over it. Holds the segment's NAME only — the
+    registry's weak key owns the lifetime; a strong segment ref here
+    would leak the entry forever."""
+
+    def __init__(self, name: str, registry: "RealtimePlaneRegistry"):
+        self.name = name
+        self.registry = registry
+        self._planes: dict[tuple[str, str], _Plane] = {}
+        self._lock = threading.Lock()
+        self._gen_rows = 0  # highest row watermark any plane reached
+
+    # -- fault seam ---------------------------------------------------------
+    def _fire_fault(self, column: str, kind: str, nbytes: int) -> None:
+        """Called with self._lock held, BEFORE the delta touches device
+        state — error faults leave planes and watermarks exactly as they
+        were."""
+        if not faults.ACTIVE:
+            return
+        t0 = time.perf_counter()
+        try:
+            faults.FAULTS.fire("realtime.upload", segment=self.name,
+                               column=column, plane=kind, nbytes=nbytes)
+        except faults.InjectedCorruption as c:
+            # a damaged delta on device could silently poison every later
+            # query — drop the WHOLE set; next query re-uploads from zero
+            self._planes.clear()
+            raise RealtimeUploadError(
+                f"injected corruption uploading {self.name}.{column}: "
+                f"plane set dropped, full re-upload next query") from c
+        except RealtimeUploadError:
+            raise
+        except faults.InjectedFault as e:
+            raise RealtimeUploadError(
+                f"injected fault uploading {self.name}.{column}") from e
+        # delay faults sleep inside fire(): enforce the upload budget so a
+        # stalled PCIe/DMA degrades to host INSIDE the query deadline
+        waited_ms = (time.perf_counter() - t0) * 1000.0
+        budget = _upload_budget_ms()
+        if waited_ms > budget:
+            raise RealtimeUploadError(
+                f"delta upload for {self.name}.{column} stalled "
+                f"{waited_ms:.0f}ms > budget {budget:.0f}ms")
+
+    def _account(self, column: str, kind: str, nbytes: int) -> None:
+        _note_upload((f"rt:{self.name}:{column}", kind), nbytes)
+        SERVER_METRICS.add_meter(
+            ServerMeter.REALTIME_DELTA_UPLOAD_BYTES, nbytes)
+        _note_delta(nbytes)
+        self.registry._note(nbytes)
+
+    def _ensure_capacity(self, st: _Plane, padded: int, dtype,
+                         shape_tail: tuple = ()) -> None:
+        if st.arr is None:
+            st.arr = jnp.zeros((padded,) + shape_tail, dtype=dtype)
+        elif st.arr.shape[0] < padded:
+            # device-side grow: copy the old plane into a bigger zero
+            # bucket without any host→device traffic
+            grown = jnp.zeros((padded,) + st.arr.shape[1:],
+                              dtype=st.arr.dtype)
+            st.arr = jax.lax.dynamic_update_slice(
+                grown, st.arr, (0,) * st.arr.ndim)
+
+    # -- plane builders -----------------------------------------------------
+    def row_plane(self, view, column: str, kind: str):
+        """Device plane for (column, kind ∈ ids|raw|null), delta-uploaded
+        up to the view's pinned row count and sliced to its pad bucket."""
+        col = view._seg.column(column)
+        n = view.num_docs
+        padded = pad_bucket(max(1, n))
+        if kind == "ids":
+            slicer, dtype = col.ids_slice, np.dtype(np.int32)
+        elif kind == "raw":
+            if not col.data_type.is_numeric:
+                raise RealtimeUploadError(
+                    f"{column}: non-numeric raw plane")
+            # the SPI storage dtype, NOT the mutable buffer dtype, so the
+            # plane matches what the immutable path would upload (family
+            # keys and kernel dtypes line up across hybrid members)
+            slicer, dtype = col.raw_slice, col.data_type.numpy_dtype
+        elif kind == "null":
+            slicer, dtype = col.null_slice, np.dtype(bool)
+        else:  # pragma: no cover - planner only requests the kinds above
+            raise ValueError(kind)
+        with self._lock:
+            st = self._planes.setdefault((column, kind), _Plane())
+            self._ensure_capacity(st, padded, dtype)
+            if st.rows < n:
+                delta = slicer(st.rows, n)
+                self._fire_fault(column, kind, int(delta.nbytes))
+                chunk = _chunk_len(len(delta), st.arr.shape[0] - st.rows)
+                upd = np.zeros(chunk, dtype=dtype)
+                upd[: len(delta)] = delta
+                st.arr = jax.lax.dynamic_update_slice(
+                    st.arr, jnp.asarray(upd), (np.int32(st.rows),))
+                st.rows = n
+                self._account(column, kind, int(upd.nbytes))
+                if n > self._gen_rows:
+                    self._gen_rows = n
+                    SERVER_METRICS.add_meter(
+                        ServerMeter.REALTIME_PLANE_GENERATIONS, 1)
+                    self.registry.generations += 1
+            arr = st.arr
+        return arr if arr.shape[0] == padded else arr[:padded]
+
+    def dict_plane(self, view, column: str):
+        """Device dictionary-values plane, delta-uploaded up to the view's
+        pinned cardinality and padded to its _dict_pad bucket (pad entries
+        are never gathered — prefix ids stay below the pinned card)."""
+        col = view._seg.column(column)
+        card = view.pinned_cardinality(column)
+        if not col.data_type.is_numeric:
+            raise RealtimeUploadError(f"{column}: non-numeric dict plane")
+        dtype = col.data_type.numpy_dtype
+        target = _dict_pad(card)
+        with self._lock:
+            st = self._planes.setdefault((column, "dict"), _Plane())
+            if st.arr is None:
+                st.arr = jnp.zeros((max(target, 1),), dtype=dtype)
+            elif st.arr.shape[0] < target:
+                grown = jnp.zeros((target,), dtype=st.arr.dtype)
+                st.arr = jax.lax.dynamic_update_slice(grown, st.arr, (0,))
+            if st.rows < card:
+                delta = col.dict_values_numeric(st.rows, card)
+                self._fire_fault(column, "dict", int(delta.nbytes))
+                room = st.arr.shape[0] - st.rows
+                chunk = _chunk_len(len(delta), room)
+                upd = np.zeros(chunk, dtype=dtype)
+                upd[: len(delta)] = delta
+                st.arr = jax.lax.dynamic_update_slice(
+                    st.arr, jnp.asarray(upd), (np.int32(st.rows),))
+                st.rows = card
+                self._account(column, "dict", int(upd.nbytes))
+            arr = st.arr
+        return arr if arr.shape[0] == target else arr[:target]
+
+    # -- bookkeeping --------------------------------------------------------
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(p.arr.nbytes for p in self._planes.values()
+                       if p.arr is not None)
+
+    def evict(self) -> None:
+        with self._lock:
+            self._planes.clear()
+
+    def watermark(self, column: str, kind: str) -> int:
+        """Uploaded-row watermark for one plane (tests/observability)."""
+        with self._lock:
+            st = self._planes.get((column, kind))
+            return st.rows if st is not None else 0
+
+
+def _dict_pad(card: int) -> int:
+    """Pow2 shape bucket for dictionary planes — mirrors
+    engine/executor._dict_pad (redeclared: the executor imports this
+    module lazily, not the other way around)."""
+    b = 1
+    while b < card:
+        b <<= 1
+    return b
+
+
+class RealtimeDeviceView:
+    """Per-query adapter: duck-types SegmentDeviceView's gather API over
+    one snapshot view + the segment's shared plane set. ``padded`` is the
+    SNAPSHOT's pad bucket — a plane whose capacity outgrew it is sliced
+    device-side, so every kernel shape matches what an immutable segment
+    of this bucket would produce."""
+
+    def __init__(self, planes: RealtimePlaneSet, snapshot):
+        self.planes = planes
+        self.snapshot = snapshot
+        self.padded = pad_bucket(max(1, snapshot.num_docs))
+
+    def dict_ids(self, column: str):
+        return self.planes.row_plane(self.snapshot, column, "ids")
+
+    def dict_ids_packed(self, column: str):
+        # realtime ids planes are always unpacked int32 (mutable metadata
+        # carries no bits_per_value) — width 0 matches the family key
+        return self.dict_ids(column), 0
+
+    def mv_dict_ids(self, column: str):
+        raise RealtimeUploadError(
+            f"{column}: MV planes stay host-side for consuming segments")
+
+    def raw(self, column: str):
+        return self.planes.row_plane(self.snapshot, column, "raw")
+
+    def raw_f32_rebased(self, column: str):
+        # the rebase base (column min) is unstable while consuming —
+        # planner refuses the slot; this guard is defense in depth
+        raise RealtimeUploadError(
+            f"{column}: rebased f32 planes stay host-side while consuming")
+
+    def dict_values(self, column: str):
+        return self.planes.dict_plane(self.snapshot, column)
+
+    def null_plane(self, column: str):
+        return self.planes.row_plane(self.snapshot, column, "null")
+
+    def nbytes(self) -> int:
+        return self.planes.nbytes()
+
+    def evict(self) -> None:
+        self.planes.evict()
+
+
+class RealtimePlaneRegistry:
+    """Process-wide plane sets, weakly keyed by the live MutableSegment:
+    GC reclaims a set when its segment dies; commit/discard paths drop
+    eagerly by name (realtime/manager.py) and OOM relief clears wholesale
+    (engine/oom.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stat_lock = threading.Lock()
+        self._sets: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        self.delta_bytes = 0
+        self.uploads = 0
+        self.generations = 0
+
+    def _note(self, nbytes: int) -> None:
+        with self._stat_lock:
+            self.delta_bytes += nbytes
+            self.uploads += 1
+
+    def plane_set(self, segment) -> RealtimePlaneSet:
+        with self._lock:
+            ps = self._sets.get(segment)
+            if ps is None:
+                ps = RealtimePlaneSet(
+                    str(getattr(segment, "name", segment)), self)
+                self._sets[segment] = ps
+            return ps
+
+    def view(self, snapshot) -> RealtimeDeviceView:
+        """Device view for one pinned MutableSegmentView. Plane state is
+        keyed by the UNDERLYING segment so consecutive snapshots share
+        (and incrementally advance) the same planes."""
+        seg = getattr(snapshot, "_seg", snapshot)
+        return RealtimeDeviceView(self.plane_set(seg), snapshot)
+
+    def drop_named(self, name: str) -> int:
+        """Release planes for every set of this segment name (commit /
+        discard / departure). Returns bytes freed."""
+        name = str(name)
+        freed = 0
+        with self._lock:
+            victims = [(seg, ps) for seg, ps in self._sets.items()
+                       if ps.name == name]
+            for seg, _ in victims:
+                del self._sets[seg]
+        for _, ps in victims:
+            freed += ps.nbytes()
+            ps.evict()
+        return freed
+
+    def clear(self, keep=None) -> int:
+        """Drop every plane set (HBM-pressure relief), optionally sparing
+        the segment currently executing — its planes back the retry's
+        uploads. Returns bytes freed."""
+        freed = 0
+        with self._lock:
+            victims = [(seg, ps) for seg, ps in self._sets.items()
+                       if seg is not keep]
+            for seg, _ in victims:
+                del self._sets[seg]
+        for _, ps in victims:
+            freed += ps.nbytes()
+            ps.evict()
+        return freed
+
+    def nbytes(self) -> int:
+        with self._lock:
+            sets = list(self._sets.values())
+        return sum(ps.nbytes() for ps in sets)
+
+    def stats(self) -> dict:
+        with self._stat_lock:
+            return {"deltaBytes": self.delta_bytes,
+                    "uploads": self.uploads,
+                    "generations": self.generations,
+                    "planeBytes": self.nbytes()}
+
+
+REALTIME_PLANES = RealtimePlaneRegistry()
+
+
+# -- planner ------------------------------------------------------------------
+
+
+class RealtimeSegmentPlanner(SegmentPlanner):
+    """Per-segment planner over a pinned MutableSegmentView. Differences
+    from the immutable planner:
+
+    - mutable segments are allowed (the view pins row count, dictionary
+      cardinalities and upsert validity, so lowering is deterministic);
+    - RANGE over a dict column lowers in VALUE space (boolean LUT over
+      snapshot dictionary values) — the insertion-ordered mutable
+      dictionary has no sorted id intervals;
+    - MV id planes and rebased-f32 planes are refused (host fallback):
+      ragged MV matrices and a min-value rebase base are unstable while
+      the segment is consuming.
+    """
+
+    allow_mutable = True
+
+    def slot(self, column: str, kind: str) -> int:
+        if kind in ("rawf32r", "mvids"):
+            raise UnsupportedQueryError(
+                f"realtime device planes: no {kind} plane for "
+                f"consuming segments")
+        if kind == "dict":
+            m = self._meta(column)
+            if not DataType(m.data_type).is_numeric:
+                raise UnsupportedQueryError(
+                    f"realtime device planes: non-numeric dictionary "
+                    f"for {column}")
+        return super().slot(column, kind)
+
+    def _lower_dict_predicate(self, p, lhs, info):
+        if p.type != PredicateType.RANGE:
+            return super()._lower_dict_predicate(p, lhs, info)
+        ids_slot, card, d = info
+        mv = not self._meta(lhs.identifier).single_value
+        vals = d.values
+        m = np.ones(card, dtype=bool)
+        if card:
+            if p.lower is not None:
+                lo = _coerce_like(vals, p.lower)
+                m &= (vals >= lo) if p.lower_inclusive else (vals > lo)
+            if p.upper is not None:
+                hi = _coerce_like(vals, p.upper)
+                m &= (vals <= hi) if p.upper_inclusive else (vals < hi)
+        lut = np.zeros(card + 1, dtype=bool)
+        lut[:card] = m
+        return ir.Lut(ids_slot, self.param(lut), mv=mv)
+
+
+def realtime_plan(query, segment):
+    """Lower a device plan for a pinned consuming-segment snapshot, or
+    raise UnsupportedQueryError so the caller falls back to host."""
+    if getattr(segment, "snapshot_generation", None) is None:
+        raise UnsupportedQueryError(
+            "mutable segment without a pinned snapshot view")
+    if not realtime_device_enabled(query):
+        raise UnsupportedQueryError("realtime device planes disabled")
+    return RealtimeSegmentPlanner(query, segment).plan()

@@ -587,6 +587,25 @@ class RealtimeTableDataManager:
         # the mutable segment is NOT destroyed here: in-flight queries may
         # hold snapshot views of it; it drops out of the live list above and
         # the GC reclaims it once the last query releases its snapshot
+        self._drop_device_state(mgr.segment.segment_name)
+
+    def _drop_device_state(self, name: str) -> None:
+        """Retire the mutable segment's device footprint once its immutable
+        replacement is queryable: realtime planes, generation-keyed stacked
+        views, and partial-cache entries all carry the segment name, so one
+        name-drop clears them. Best-effort — these are performance caches,
+        never correctness (a stale plane would simply never be consulted
+        again since the name left the live list)."""
+        try:
+            from ..cache.partial import GLOBAL_PARTIAL_CACHE
+            from ..segment.device_cache import GLOBAL_DEVICE_CACHE
+            from .device_plane import REALTIME_PLANES
+
+            REALTIME_PLANES.drop_named(name)
+            GLOBAL_DEVICE_CACHE.drop_named(name)
+            GLOBAL_PARTIAL_CACHE.invalidate_segment(name)
+        except Exception:  # pragma: no cover - cleanup must never fail a commit
+            pass
 
     # -- replica completion protocol callbacks ------------------------------
     def _handle_build(self, mgr: RealtimeSegmentDataManager) -> str:
@@ -620,6 +639,7 @@ class RealtimeTableDataManager:
                                                mgr.current_offset)
             # pauseless: the successor is already consuming
             self._refresh_view()
+        self._drop_device_state(mgr.segment.segment_name)
 
     def _handle_discard(self, mgr: RealtimeSegmentDataManager,
                         location: str, end_offset: int) -> None:
@@ -650,6 +670,7 @@ class RealtimeTableDataManager:
                     self._start_partition_from(mgr.partition,
                                                LongMsgOffset(end_offset))
             self._refresh_view()
+        self._drop_device_state(name)
 
     def _start_partition_from(self, partition: int, offset: LongMsgOffset):
         seq = self._seq.get(partition, 0)

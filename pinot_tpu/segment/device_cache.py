@@ -322,15 +322,48 @@ class DeviceSegmentCache:
 
     def stacked_view(self, segments: list) -> StackedSegmentView:
         """Get-or-create the stacked [S, ...] view for a batch family
-        (identified by its ordered member segments). Families containing a
-        realtime snapshot view get an UNCACHED view: snapshot objects are
-        fresh per query, so an id()-keyed cache entry could never be hit
-        again and would only pin dead HBM bytes until eviction."""
-        key = tuple(id(s) for s in segments)
+        (identified by its ordered member segments). Realtime snapshot
+        views are keyed by (name, snapshot_generation) instead of id():
+        snapshot objects are fresh per query, but an unchanged generation
+        has byte-identical plane contents, so warm repeats reuse the
+        cached stack. A newer generation supersedes the old stack — the
+        stale one is evicted eagerly (it can never be requested again).
+        A mutable object WITHOUT a pinned generation still gets an
+        uncached view (could never be hit again; would only pin dead HBM
+        bytes until eviction)."""
+        members = []
+        rt_names = set()
+        uncached = False
+        for s in segments:
+            if getattr(s, "is_mutable", False):
+                gen = getattr(s, "snapshot_generation", None)
+                if gen is None:
+                    uncached = True
+                    members.append(id(s))
+                else:
+                    name = str(getattr(s, "name", ""))
+                    rt_names.add(name)
+                    members.append(("rt", name, gen))
+            else:
+                members.append(id(s))
+        key = tuple(members)
         names = tuple(getattr(s, "name", "") for s in segments)
-        if any(getattr(s, "is_mutable", False) for s in segments):
+        if uncached:
             return StackedSegmentView(key, names)
         with self._lock:
+            if rt_names and key not in self._stacks:
+                # superseded generations of the same consuming segment(s)
+                for skey in [k for k, s in self._stacks.items()
+                             if k != key and any(
+                                 isinstance(m, tuple) and len(m) == 3
+                                 and m[0] == "rt" and m[1] in rt_names
+                                 for m in k)]:
+                    self._stacks.pop(skey).evict()
+                    if skey in self._stack_order:
+                        self._stack_order.remove(skey)
+                    self.evictions += 1
+                    self.eviction_stats["stacks"] += 1
+                    self.eviction_stats["lineage"] += 1
             sv = self._stacks.get(key)
             if sv is None:
                 sv = self._stacks[key] = StackedSegmentView(key, names)

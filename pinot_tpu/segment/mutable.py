@@ -20,6 +20,8 @@ forward index impls (.../realtime/impl/). Design differences, TPU-first:
 
 from __future__ import annotations
 
+import bisect
+import dataclasses
 import threading
 import time
 from typing import Any, Optional
@@ -67,6 +69,35 @@ class MutableDictionary:
 
     def __len__(self) -> int:
         return len(self._values)
+
+
+class SnapshotDictionary:
+    """Read-only view of a MutableDictionary pinned at a cardinality.
+
+    The live dictionary is insertion-ordered and append-only, so its first
+    ``card`` entries never change — pinning the cardinality makes every
+    lookup deterministic for one snapshot even while ingestion keeps
+    inserting new values. Values indexed after the pin report -1 (absent),
+    which is consistent: no row inside the snapshot prefix can reference
+    them."""
+
+    def __init__(self, live: MutableDictionary, card: int):
+        self._live = live
+        self._card = card
+
+    def index_of(self, value) -> int:
+        did = self._live.index_of(value)
+        return did if 0 <= did < self._card else -1
+
+    def get(self, dict_id: int):
+        return self._live.get(dict_id)
+
+    @property
+    def values(self) -> np.ndarray:
+        return np.asarray(self._live._values[: self._card])
+
+    def __len__(self) -> int:
+        return self._card
 
 
 class _MutableColumn:
@@ -158,6 +189,40 @@ class _MutableColumn:
             return [np.asarray([vals[i] for i in row]) for row in self.mv_ids[:n]]
         return [np.asarray(row) for row in self.mv_ids[:n]]
 
+    # -- device-plane delta reads (realtime/device_plane.py) ---------------
+    # Rows below any published num_docs are immutable, so slicing [a, b)
+    # with b <= num_docs is race-free against the consumer thread.
+
+    def ids_slice(self, a: int, b: int) -> np.ndarray:
+        """SV dict-id rows [a, b) as int32 (unpacked device ids plane)."""
+        return np.asarray(self.dict_ids[a:b], dtype=np.int32)
+
+    def raw_slice(self, a: int, b: int) -> np.ndarray:
+        """Raw (non-dict) SV metric rows [a, b) at the column's np dtype."""
+        dtype = _NUMERIC_NP.get(self.data_type)
+        if dtype is None:
+            raise ValueError(f"{self.spec.name}: non-numeric raw plane")
+        return np.asarray(self.dict_ids[a:b], dtype=dtype)
+
+    def null_slice(self, a: int, b: int) -> np.ndarray:
+        """Null bitmap for rows [a, b). null_docs is monotonic (appended in
+        doc order) so a bisected window is exact."""
+        out = np.zeros(b - a, dtype=bool)
+        nd = self.null_docs
+        lo = bisect.bisect_left(nd, a)
+        hi = bisect.bisect_left(nd, b)
+        for d in nd[lo:hi]:
+            out[d - a] = True
+        return out
+
+    def dict_values_numeric(self, a: int, b: int) -> np.ndarray:
+        """Dictionary values [a, b) at the column's np dtype — delta feed
+        for the device dict-values plane (append-only, stable prefix)."""
+        dtype = _NUMERIC_NP.get(self.data_type)
+        if dtype is None:
+            raise ValueError(f"{self.spec.name}: non-numeric dict plane")
+        return np.asarray(self.dictionary._values[a:b], dtype=dtype)
+
 
 class MutableSegment:
     """Duck-types the ImmutableSegment read API (segment/loader.py) over
@@ -203,6 +268,10 @@ class MutableSegment:
 
     def column_metadata(self, column: str) -> ColumnMetadata:
         return self._columns[column].metadata(self._num_docs)
+
+    def column(self, name: str) -> _MutableColumn:
+        """Raw column buffer access for the realtime device-plane reader."""
+        return self._columns[name]
 
     def get_dictionary(self, column: str) -> MutableDictionary:
         return self._columns[column].dictionary
@@ -295,18 +364,66 @@ class MutableSegment:
         return MutableSegmentView(self)
 
 
+class _PinnedValidity:
+    """Immutable upsert-validity snapshot, duck-typing ValidDocIds reads so
+    the host filter and the device mask param see the exact same bits."""
+
+    def __init__(self, mask: np.ndarray):
+        self._mask = mask
+
+    def mask(self, n: int) -> np.ndarray:
+        out = np.zeros(n, dtype=bool)
+        m = min(n, len(self._mask))
+        out[:m] = self._mask[:m]
+        return out
+
+    def num_valid(self, n: Optional[int] = None) -> int:
+        m = self._mask if n is None else self._mask[:n]
+        return int(m.sum())
+
+
 class MutableSegmentView:
-    """Read-only consistent-prefix view over a MutableSegment."""
+    """Read-only consistent-prefix view over a MutableSegment.
+
+    Beyond the row count, the view pins everything a query plan can
+    observe: per-column dictionary cardinality (SnapshotDictionary), the
+    upsert validity plane, and column metadata — so the host path, the
+    device plane path, and every cache key derived from this view agree on
+    one immutable snapshot identified by ``snapshot_generation``."""
 
     is_mutable = True
 
     def __init__(self, segment: MutableSegment):
         self._seg = segment
         self._n = segment._num_docs
+        vd = segment.valid_doc_ids
+        if vd is not None and hasattr(vd, "snapshot"):
+            mask, ugen = vd.snapshot(self._n)
+            self._valid: Optional[_PinnedValidity] = _PinnedValidity(mask)
+            self._upsert_gen = ugen
+        else:
+            self._valid = None
+            self._upsert_gen = 0
+        # card read AFTER _num_docs: every dict id in the prefix is < card
+        self._cards = {
+            name: (len(col.dictionary) if col.dict_encoded else 0)
+            for name, col in segment._columns.items()}
+        self._dicts: dict[str, Optional[SnapshotDictionary]] = {}
+        self._meta: dict[str, ColumnMetadata] = {}
 
     @property
     def valid_doc_ids(self):
-        return self._seg.valid_doc_ids
+        return self._valid
+
+    @property
+    def snapshot_generation(self) -> tuple:
+        """Stable identity of this snapshot's contents: the row prefix plus
+        the upsert validity generation. Two views with equal generations
+        answer every query identically."""
+        return (self._n, self._upsert_gen)
+
+    def pinned_cardinality(self, column: str) -> int:
+        return self._cards[column]
 
     def read_cell(self, column: str, doc_id: int):
         return self._seg.read_cell(column, doc_id)
@@ -330,10 +447,22 @@ class MutableSegmentView:
         return self._seg.has_column(column)
 
     def column_metadata(self, column: str) -> ColumnMetadata:
-        return self._seg._columns[column].metadata(self._n)
+        md = self._meta.get(column)
+        if md is None:
+            col = self._seg._columns[column]
+            md = col.metadata(self._n)
+            if col.dict_encoded:
+                md = dataclasses.replace(md, cardinality=self._cards[column])
+            self._meta[column] = md
+        return md
 
     def get_dictionary(self, column: str):
-        return self._seg._columns[column].dictionary
+        if column not in self._dicts:
+            live = self._seg._columns[column].dictionary
+            self._dicts[column] = (
+                SnapshotDictionary(live, self._cards[column])
+                if live is not None else None)
+        return self._dicts[column]
 
     def get_values(self, column: str) -> np.ndarray:
         return self._seg._columns[column].values_snapshot(self._n)

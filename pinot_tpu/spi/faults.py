@@ -70,6 +70,13 @@ POINTS = (
                          # bitflip/truncate the serialized executable —
                          # the loader must refuse it and fall back to a
                          # fresh compile, never a wrong answer or crash)
+    "realtime.upload",   # realtime/device_plane.py delta upload of newly
+                         # appended rows (error → this query answers on
+                         # host, planes untouched; corrupt → the whole
+                         # plane set is dropped and the next query fully
+                         # re-uploads — never a wrong answer; delay →
+                         # upload budget exceeded, host fallback inside
+                         # the deadline)
 )
 
 

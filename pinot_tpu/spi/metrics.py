@@ -34,6 +34,13 @@ class ServerMeter:
     QUERY_EXECUTION_EXCEPTIONS = "queryExecutionExceptions"
     DELETED_SEGMENT_COUNT = "deletedSegmentCount"
     REALTIME_ROWS_CONSUMED = "realtimeRowsConsumed"
+    # realtime device planes (realtime/device_plane.py): bytes of newly
+    # appended rows delta-uploaded to device (∝ new rows, NOT snapshot
+    # size), watermark advances across all plane sets, and queries that
+    # answered over a consuming segment on the device path
+    REALTIME_DELTA_UPLOAD_BYTES = "realtimeDeltaUploadBytes"
+    REALTIME_PLANE_GENERATIONS = "realtimePlaneGenerations"
+    REALTIME_DEVICE_QUERIES = "realtimeDeviceQueries"
     QUERIES_KILLED = "queriesKilled"
     QUERIES_REJECTED = "queriesRejected"
     HBM_OOM_EVENTS = "hbmOomEvents"

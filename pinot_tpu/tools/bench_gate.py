@@ -34,6 +34,12 @@ Checks per config present in the baseline:
   increase in ``host_crossings`` fails — the count of device→host
   round-trips is a plan property with zero noise, and an increase means
   a fused stage fell back to per-operator hops;
+- **realtime delta-upload regression** (q11r): candidate
+  ``rt_delta_bytes`` >= ``rt_full_bytes`` always fails (the incremental
+  upload path re-ships the whole snapshot), candidate ``rt_warm_bytes``
+  > 0 always fails (the plane-resident fast path re-uploaded on an
+  unchanged generation), and delta-bytes growth vs the baseline follows
+  the same ratio + 4096-byte-floor rule as shuffled bytes;
 - **tiered cold/warm regression** (configs that record them): candidate
   ``cold_p50_s`` / ``warm_p50_s`` past the same ratio + ``--min-abs-ms``
   rules (WARN across platforms); a ``warm_match`` flip true → false
@@ -297,6 +303,60 @@ def compare(baseline: dict, candidate: dict, threshold: float = 0.25,
         elif bh is not None and ch is None:
             warnings.append(f"{cfg}: baseline recorded host_crossings but "
                             "candidate did not (residency telemetry dropped)")
+        # realtime delta-upload economics (q11r — realtime/device_plane.py
+        # records the bytes uploaded by the first query, by the first
+        # query after appending ~1% more rows, and by a warm repeat on an
+        # unchanged generation). Two candidate-ONLY invariants need no
+        # baseline and are plan properties with zero noise:
+        #   delta >= full  — the incremental upload path is gone (every
+        #                    query re-ships the whole snapshot);
+        #   warm > 0       — the plane-resident fast path re-uploaded on
+        #                    an unchanged generation.
+        # Both fail even across platforms (upload bytes measure the plan,
+        # not the machine). Baseline-relative growth uses the same ratio +
+        # 4096-byte-floor rule as shuffled bytes.
+        cfb = c.get("rt_full_bytes")
+        cdb = c.get("rt_delta_bytes")
+        if cfb is not None and cdb is not None:
+            cfbi, cdbi = int(cfb), int(cdb)
+            row.update({"candidateRtFullBytes": cfbi,
+                        "candidateRtDeltaBytes": cdbi})
+            if cfbi > 0 and cdbi >= cfbi:
+                verdict = "FAIL"
+                failures.append(
+                    f"{cfg}: realtime delta upload ({cdbi}B) reached "
+                    f"full-snapshot size ({cfbi}B) — incremental upload "
+                    "path lost")
+        cwb = c.get("rt_warm_bytes")
+        if cwb is not None and int(cwb) > 0:
+            verdict = "FAIL"
+            failures.append(
+                f"{cfg}: warm repeat on an unchanged generation uploaded "
+                f"{int(cwb)}B (plane-resident fast path must upload 0)")
+        bdb = b.get("rt_delta_bytes")
+        if bdb is not None and cdb is not None:
+            bdbi = int(bdb)
+            delta_ratio = (int(cdb) / bdbi) if bdbi > 0 else float("inf")
+            row.update({"baselineRtDeltaBytes": bdbi,
+                        "rtDeltaBytesRatio": round(delta_ratio, 4)
+                        if bdbi > 0 else None})
+            if int(cdb) > bdbi * (1.0 + threshold) \
+                    and int(cdb) - bdbi >= 4096:
+                if cross_platform:
+                    if verdict == "PASS":
+                        verdict = "WARN"
+                    warnings.append(
+                        f"{cfg}: realtime delta bytes {bdbi} -> {int(cdb)} "
+                        "across platforms")
+                else:
+                    verdict = "FAIL"
+                    failures.append(
+                        f"{cfg}: realtime delta bytes regressed {bdbi} -> "
+                        f"{int(cdb)} ({(delta_ratio - 1) * 100:.1f}% more, "
+                        f"threshold {threshold * 100:.0f}%)")
+        elif bdb is not None and cdb is None:
+            warnings.append(f"{cfg}: baseline recorded rt_delta_bytes but "
+                            "candidate did not (delta telemetry dropped)")
         # tiered-storage round (cold-start vs warm-resident p50): compared
         # only when BOTH rounds measured it, same missing-side rule as
         # mesh. cold_p50_s times the first-query lazy fetch path;

@@ -847,11 +847,192 @@ def soak_qps(seconds: float = 30.0, seed: int = 0, qps: float = 50.0,
 # ════════════════════════════════════════════════════════════════════════════
 
 
+def _soak_realtime_device(seconds: float = 15.0, seed: int = 0,
+                          fault_rate: float = 0.0, progress=None) -> dict:
+    """Live-ingest churn on the realtime device planes
+    (realtime/device_plane.py): a feeder thread appends rows into a
+    CONSUMING segment while a query thread hammers it on the device path;
+    at every settle point (feeder parked) the device result, the host
+    result and a Python-side running aggregate must agree EXACTLY.
+
+    With ``fault_rate`` > 0 a seeded schedule is armed on
+    ``realtime.upload`` (kind=error for the first half of the run,
+    re-armed kind=corrupt for the second half). Unlike the chaos suite
+    the invariant does NOT relax: every realtime.upload fault kind is
+    TRANSPARENT by design (error/delay → host fallback this query,
+    corrupt → plane drop + full re-upload next query), so even faulted
+    queries must return full, exact answers."""
+    import threading
+
+    from pinot_tpu.engine.query_executor import QueryExecutor
+    from pinot_tpu.ingestion.transform import build_transform_pipeline
+    from pinot_tpu.realtime.device_plane import REALTIME_PLANES
+    from pinot_tpu.segment.mutable import MutableSegment
+    from pinot_tpu.spi import faults
+    from pinot_tpu.spi.data_types import Schema
+
+    schema = Schema.build(
+        "live",
+        dimensions=[("team", "STRING"), ("code", "INT")],
+        metrics=[("runs", "INT")])
+    seg = MutableSegment(schema, "live_dev_0")
+    pipe = build_transform_pipeline(schema)
+    dev = QueryExecutor(backend="auto")
+    host = QueryExecutor(backend="host")
+    for qe in (dev, host):
+        qe.add_table(schema, [seg], name="live")
+    sql = "SELECT team, SUM(runs), COUNT(*) FROM live GROUP BY team LIMIT 50"
+    # caches off on the device side so every settle re-executes the plane
+    # path instead of serving the generation-stamped partial entry
+    nocache = "SET segmentCache = false; SET resultCache = false; " + sql
+    if fault_rate > 0:
+        faults.seed_schedule(seed, fault_rate, points=("realtime.upload",))
+        if progress:
+            progress(f"realtime-device: armed realtime.upload faults "
+                     f"(rate={fault_rate}, seed={seed})")
+
+    teams = [f"t{i}" for i in range(8)]
+    stop = threading.Event()
+    pause = threading.Event()
+    idle = threading.Event()
+    lock = threading.Lock()
+    expected: dict = {}
+    fed = {"rows": 0}
+    fail: list = []
+
+    def feeder():
+        i = 0
+        while not stop.is_set():
+            if pause.is_set():
+                idle.set()
+                time.sleep(0.002)
+                continue
+            idle.clear()
+            team = teams[i % len(teams)]
+            runs = i % 7
+            seg.index(pipe.transform(
+                {"team": team, "code": i % 100, "runs": runs}))
+            with lock:
+                expected[team] = expected.get(team, 0) + runs
+                fed["rows"] += 1
+            i += 1
+            if i % 40 == 0:
+                time.sleep(0.001)  # let queries interleave
+        idle.set()
+
+    qstats = {"queries": 0}
+
+    def querier():
+        # concurrent reads under churn: full well-formed answers only, and
+        # the visible row count may never go backwards (append-only
+        # snapshot invariant — rows below the published generation are
+        # immutable)
+        last_total = 0
+        while not stop.is_set():
+            try:
+                resp = dev.execute_sql(nocache)
+            except Exception as e:  # noqa: BLE001 — surfaced as soak failure
+                fail.append(f"realtime-device: concurrent query raised "
+                            f"{e!r}")
+                return
+            if resp.exceptions:
+                fail.append(f"realtime-device: concurrent query error "
+                            f"under churn: {resp.exceptions}")
+                return
+            total = sum(int(r[2]) for r in resp.result_table.rows)
+            if total < last_total:
+                fail.append(f"realtime-device: append-only violated — "
+                            f"visible COUNT went {last_total} -> {total}")
+                return
+            last_total = total
+            qstats["queries"] += 1
+
+    base = REALTIME_PLANES.stats()
+    fault_base = faults.FAULTS.fired("realtime.upload") if fault_rate > 0 \
+        else 0
+    feeder_th = threading.Thread(target=feeder, daemon=True)
+    query_th = threading.Thread(target=querier, daemon=True)
+    t0 = time.time()
+    settles = dispatches = nrows = 0
+    flipped = False
+    feeder_th.start()
+    query_th.start()
+    try:
+        while time.time() - t0 < seconds and not fail:
+            time.sleep(min(1.0, max(0.2, seconds / 10)))
+            if fault_rate > 0 and not flipped \
+                    and time.time() - t0 > seconds / 2:
+                # second half: corruption strikes (plane drop + full
+                # re-upload) replace plain upload errors
+                faults.seed_schedule(seed ^ 0xC0FFEE, fault_rate,
+                                     kind="corrupt",
+                                     points=("realtime.upload",))
+                flipped = True
+            pause.set()
+            if not idle.wait(10.0):
+                raise SoakFailure("realtime-device: feeder failed to park")
+            with lock:
+                want = dict(expected)
+                nrows = fed["rows"]
+            rd = dev.execute_sql(nocache)
+            rh = host.execute_sql(sql)
+            if rd.exceptions or rh.exceptions:
+                raise SoakFailure(
+                    f"realtime-device: settle {settles} errored "
+                    f"(device={rd.exceptions}, host={rh.exceptions})")
+            got_d = {r[0]: int(r[1]) for r in rd.result_table.rows}
+            got_h = {r[0]: int(r[1]) for r in rh.result_table.rows}
+            if got_d != want or got_h != want:
+                raise SoakFailure(
+                    f"realtime-device: settle {settles} mismatch at "
+                    f"{nrows} rows — device={got_d} host={got_h} "
+                    f"expected={want} (seed {seed})")
+            dispatches += getattr(rd, "num_device_dispatches", 0)
+            settles += 1
+            if progress:
+                progress(f"realtime-device: settle {settles} exact at "
+                         f"{nrows} rows")
+            pause.clear()
+    finally:
+        stop.set()
+        pause.clear()
+        feeder_th.join(5.0)
+        query_th.join(5.0)
+        fault_fired = (faults.FAULTS.fired("realtime.upload") - fault_base
+                       if fault_rate > 0 else 0)
+        if fault_rate > 0:
+            faults.FAULTS.reset()
+        REALTIME_PLANES.drop_named("live_dev_0")
+    if fail:
+        raise SoakFailure(fail[0])
+    if settles == 0:
+        raise SoakFailure("realtime-device: no settle point reached")
+    if dispatches == 0 and fault_rate < 0.5:
+        # the whole point of the phase: consuming segments must actually
+        # ride the device fast path (at high fault rates every upload may
+        # legitimately fall back to host, so only enforce below 0.5)
+        raise SoakFailure("realtime-device: no device dispatches — "
+                          "consuming segment never took the device path")
+    end = REALTIME_PLANES.stats()
+    out = {"device_settles": settles, "device_rows": nrows,
+           "device_concurrent_queries": qstats["queries"],
+           "device_dispatches": dispatches,
+           "device_delta_uploads": end["uploads"] - base["uploads"],
+           "device_delta_upload_bytes":
+               end["deltaBytes"] - base["deltaBytes"]}
+    if fault_rate > 0:
+        out["device_faulted_uploads"] = fault_fired
+    return out
+
+
 def soak_realtime(rounds: int = 3, seed: int = 0, rows_per_round: int = 50,
+                  seconds: float = 15.0, fault_rate: float = 0.0,
                   progress=None) -> dict:
     """Repeated committer-crash/re-election rounds; every round must commit
     all published rows with zero loss after the first-elected committer dies
-    between build and commit."""
+    between build and commit. Followed by the device-plane churn phase
+    (``_soak_realtime_device``): live ingest + concurrent device queries
+    with an exact-vs-host-control invariant at every settle point."""
     from pinot_tpu.cluster.store import PropertyStore
     from pinot_tpu.realtime.completion import SegmentCompletionManager
     from pinot_tpu.realtime.manager import RealtimeTableDataManager
@@ -948,9 +1129,13 @@ def soak_realtime(rounds: int = 3, seed: int = 0, rows_per_round: int = 50,
             finally:
                 a.stop()
                 b.stop()
-    return {"suite": "realtime", "rounds": completed,
-            "rows_per_round": rows_per_round,
-            "elapsed_s": round(time.time() - t0, 1), "seed": seed}
+    out = {"suite": "realtime", "rounds": completed,
+           "rows_per_round": rows_per_round, "seed": seed}
+    out.update(_soak_realtime_device(seconds=seconds, seed=seed,
+                                     fault_rate=fault_rate,
+                                     progress=progress))
+    out["elapsed_s"] = round(time.time() - t0, 1)
+    return out
 
 
 # ════════════════════════════════════════════════════════════════════════════
@@ -1906,11 +2091,17 @@ def main(argv=None) -> int:
                    help="chaos suite: probability (0..1) of a seeded "
                         "injected fault per call at transport.call, "
                         "server.query and device.dispatch (rebalance "
-                        "suite: at rebalance.move); queries run "
-                        "with allowPartialResults=true and degraded "
-                        "(partial/error) responses are counted as "
-                        "faulted_queries instead of failing the soak — "
-                        "full responses must still match exactly")
+                        "suite: at rebalance.move; realtime suite: at "
+                        "realtime.upload during the device-plane churn "
+                        "phase — error first half, corrupt second half); "
+                        "chaos queries run with allowPartialResults=true "
+                        "and degraded (partial/error) responses are "
+                        "counted as faulted_queries instead of failing "
+                        "the soak — full responses must still match "
+                        "exactly. realtime.upload faults are transparent "
+                        "(host fallback / plane re-upload), so the "
+                        "realtime invariant stays exact even under "
+                        "faults")
     p.add_argument("--corrupt-rate", type=float, default=0.0,
                    help="chaos/qps suites: probability (0..1) of a seeded "
                         "data CORRUPTION per call (segment.load, "
@@ -1953,7 +2144,8 @@ def main(argv=None) -> int:
                 capture_report=bool(args.report)))
         if args.suite in ("realtime", "all"):
             results.append(soak_realtime(
-                rounds=args.rounds, seed=args.seed, progress=progress))
+                rounds=args.rounds, seed=args.seed, seconds=args.seconds,
+                fault_rate=args.fault_rate, progress=progress))
         if args.suite == "failover":
             results.append(soak_failover(
                 seconds=args.seconds, seed=args.seed, progress=progress,

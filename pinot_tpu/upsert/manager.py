@@ -35,6 +35,7 @@ class ValidDocIds:
     def __init__(self, n: int = 0):
         self._mask = np.zeros(max(n, 64), dtype=bool)
         self._n = n
+        self._generation = 0
         self._lock = threading.Lock()
 
     def ensure(self, n: int) -> None:
@@ -54,6 +55,7 @@ class ValidDocIds:
         with self._lock:
             self._ensure_nolock(doc_id + 1)
             self._mask[doc_id] = valid
+            self._generation += 1
 
     def mask(self, n: int) -> np.ndarray:
         """Validity for the first n docs (query snapshot)."""
@@ -62,6 +64,18 @@ class ValidDocIds:
             m = min(n, len(self._mask))
             out[:m] = self._mask[:m]
             return out
+
+    def snapshot(self, n: int) -> tuple:
+        """Atomic (mask, generation) pair for the first n docs.
+
+        A snapshot view pins this pair so the host and device paths read
+        identical validity even while upserts continue to mutate the live
+        plane."""
+        with self._lock:
+            out = np.zeros(n, dtype=bool)
+            m = min(n, len(self._mask))
+            out[:m] = self._mask[:m]
+            return out, self._generation
 
     def num_valid(self, n: Optional[int] = None) -> int:
         with self._lock:

@@ -310,6 +310,82 @@ def test_tiered_cross_platform_warns_and_missing_side_rules(tmp_path,
     assert "tiered coverage dropped" in capsys.readouterr().out
 
 
+def test_rt_delta_reaching_full_snapshot_fails(tmp_path, capsys):
+    """q11r invariant: the post-append query must upload only the new
+    tail. delta >= full means every query re-ships the whole snapshot —
+    a candidate-only check, no baseline delta needed."""
+    base = _payload()
+    cand = _payload()
+    cand["detail"]["q2_groupby"].update(
+        {"rt_full_bytes": 524_288, "rt_delta_bytes": 524_288,
+         "rt_warm_bytes": 0})
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "q2_groupby" in out and "incremental upload path lost" in out
+
+
+def test_rt_delta_fails_even_cross_platform(tmp_path, capsys):
+    """Upload bytes measure the plan, not the machine: the full-snapshot
+    check stays a FAIL across platforms."""
+    base = _payload()
+    cand = _payload(platform="cpu")
+    cand["detail"]["q2_groupby"].update(
+        {"rt_full_bytes": 524_288, "rt_delta_bytes": 600_000})
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    assert "incremental upload path lost" in capsys.readouterr().out
+
+
+def test_rt_warm_upload_fails(tmp_path, capsys):
+    """A warm repeat on an unchanged generation must upload 0 bytes."""
+    cand = _payload()
+    cand["detail"]["q2_groupby"].update(
+        {"rt_full_bytes": 524_288, "rt_delta_bytes": 4096,
+         "rt_warm_bytes": 2048})
+    a = _write(tmp_path, "a.json", _payload())
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 1
+    assert "unchanged generation uploaded" in capsys.readouterr().out
+
+
+def test_rt_healthy_delta_passes_and_growth_vs_baseline_fails(tmp_path,
+                                                              capsys):
+    """Proportional delta passes; a delta-bytes blow-up vs the baseline
+    (past the ratio AND the 4096-byte floor) fails like shuffled bytes."""
+    base = _payload()
+    base["detail"]["q2_groupby"].update(
+        {"rt_full_bytes": 524_288, "rt_delta_bytes": 4096,
+         "rt_warm_bytes": 0})
+    cand = _payload()
+    cand["detail"]["q2_groupby"].update(
+        {"rt_full_bytes": 524_288, "rt_delta_bytes": 4096,
+         "rt_warm_bytes": 0})
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+    cand2 = _payload()
+    cand2["detail"]["q2_groupby"].update(
+        {"rt_full_bytes": 524_288, "rt_delta_bytes": 65_536,
+         "rt_warm_bytes": 0})
+    c = _write(tmp_path, "c.json", cand2)
+    assert main([a, c]) == 1
+    assert "realtime delta bytes regressed" in capsys.readouterr().out
+
+
+def test_rt_missing_candidate_telemetry_warns(tmp_path, capsys):
+    base = _payload()
+    base["detail"]["q2_groupby"].update(
+        {"rt_full_bytes": 524_288, "rt_delta_bytes": 4096})
+    cand = _payload()  # no rt_* keys at all
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cand)
+    assert main([a, b]) == 0
+    assert "delta telemetry dropped" in capsys.readouterr().out
+
+
 def test_runner_shape_diff_downgrades_timing_to_warning(tmp_path, capsys):
     """Same platform, but the runner changed shape (core count): a p50
     blow-up downgrades to a WARN that names the shape diff — the timing

@@ -1,0 +1,434 @@
+"""Realtime device planes (realtime/device_plane.py): consuming segments
+on the device fast path.
+
+Pins the subsystem's four contracts:
+
+- **Delta economics** — the first query over a consuming segment uploads
+  the whole snapshot; a query after appending rows uploads only the new
+  tail (pow2-chunked, metered); a repeat on an unchanged generation
+  uploads ZERO bytes (the generation-keyed plane set is resident).
+- **Exactness** — device ≡ host ≡ sqlite oracle at EVERY generation, for
+  dense aggs, sparse group-bys, timeseries-style per-timestamp counts,
+  FUNNEL, and upsert overwrite visibility (the validity plane flips with
+  the upsert generation).
+- **Hybrid batching** — immutable siblings of a consuming segment still
+  ride the batch-family dispatch (pinned via num_device_dispatches): one
+  family dispatch for the immutables + one realtime dispatch, never
+  per-segment solo drops.
+- **Fault containment** (``realtime.upload``) — error → transparent host
+  fallback, planes intact; delay past the upload budget → host fallback
+  inside the deadline; corrupt → the WHOLE plane set is dropped and the
+  next query re-uploads from row zero. Never a wrong answer.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.query_executor import QueryExecutor
+from pinot_tpu.ingestion.transform import build_transform_pipeline
+from pinot_tpu.realtime.device_plane import (
+    REALTIME_PLANES,
+    realtime_stats,
+    reset_realtime_stats,
+)
+from pinot_tpu.segment.mutable import MutableSegment
+from pinot_tpu.spi import faults
+from pinot_tpu.spi.data_types import Schema
+
+LIVE = Schema.build(
+    "live",
+    dimensions=[("site", "STRING"), ("code", "INT"), ("ts", "LONG")],
+    metrics=[("clicks", "INT"), ("revenue", "LONG")])
+
+NOCACHE = "SET segmentCache = false; SET resultCache = false; "
+
+
+def _gen_rows(n, seed=0, t0=1_700_000_000):
+    rng = np.random.default_rng(seed)
+    sites = [f"s{i}" for i in range(12)]
+    return [{"site": sites[int(rng.integers(12))],
+             "code": int(rng.integers(0, 40)),
+             "ts": t0 + int(i // 7),
+             "clicks": int(rng.integers(1, 10)),
+             "revenue": int(rng.integers(0, 1000))}
+            for i in range(n)]
+
+
+def _feed(seg, pipe, rows):
+    for r in rows:
+        seg.index(pipe.transform(dict(r)))
+
+
+def _live_env(n=4000, seed=0):
+    seg = MutableSegment(LIVE, "live_dp_0")
+    pipe = build_transform_pipeline(LIVE)
+    _feed(seg, pipe, _gen_rows(n, seed))
+    dev = QueryExecutor(backend="auto")
+    host = QueryExecutor(backend="host")
+    for qe in (dev, host):
+        qe.add_table(LIVE, [seg], name="live")
+    return seg, pipe, dev, host
+
+
+def _canon(rows):
+    out = []
+    for r in rows:
+        out.append(tuple(round(float(v), 6) if isinstance(v, (int, float))
+                         and not isinstance(v, bool) else v for v in r))
+    return sorted(out)
+
+
+def _oracle(fed_rows, sql):
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE live (site TEXT, code INT, ts INT, "
+                "clicks INT, revenue INT)")
+    con.executemany(
+        "INSERT INTO live VALUES (?, ?, ?, ?, ?)",
+        [(r["site"], r["code"], r["ts"], r["clicks"], r["revenue"])
+         for r in fed_rows])
+    return con.execute(sql).fetchall()
+
+
+def _exec(qe, sql):
+    r = qe.execute_sql(sql)
+    assert not r.exceptions, f"{sql}: {r.exceptions}"
+    return r
+
+
+# ---------------------------------------------------------------------------
+# delta-upload economics (the PR's acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_consuming_segment_rides_device_with_delta_uploads():
+    """Cold query = full-snapshot upload + device dispatch; unchanged
+    generation = zero uploads; appended tail = a small delta, never a
+    re-ship of the whole snapshot."""
+    seg, pipe, dev, host = _live_env(n=20_000, seed=1)
+    sql = ("SELECT site, SUM(clicks), COUNT(*) FROM live "
+           "GROUP BY site ORDER BY site LIMIT 100")
+
+    reset_realtime_stats()
+    r = _exec(dev, NOCACHE + sql)
+    cold = dict(realtime_stats())
+    assert getattr(r, "num_device_dispatches", 0) >= 1, \
+        "consuming segment never took the device path"
+    assert cold["deviceQueries"] >= 1
+    assert cold["deltaBytes"] > 0 and cold["uploads"] > 0
+    assert _canon(r.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows)
+
+    # unchanged generation: plane-resident, zero uploads even with the
+    # partial caches off (the planes are NOT a cache tier)
+    reset_realtime_stats()
+    r2 = _exec(dev, NOCACHE + sql)
+    warm = dict(realtime_stats())
+    assert warm["uploads"] == 0 and warm["deltaBytes"] == 0
+    assert _canon(r2.result_table.rows) == _canon(r.result_table.rows)
+
+    # +300 rows: only the tail crosses — ∝ new rows, far below full size
+    _feed(seg, pipe, _gen_rows(300, seed=2))
+    reset_realtime_stats()
+    r3 = _exec(dev, NOCACHE + sql)
+    delta = dict(realtime_stats())
+    assert 0 < delta["deltaBytes"] < cold["deltaBytes"] / 8, \
+        (f"delta upload {delta['deltaBytes']}B not proportional to the "
+         f"appended tail (full snapshot was {cold['deltaBytes']}B)")
+    assert _canon(r3.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows)
+
+
+def test_warm_repeat_perf_guard_zero_uploads_default_caches():
+    """Generation-keyed caching end to end: with the caches at their
+    defaults a repeat query on an unchanged generation does zero uploads
+    AND zero device dispatches (generation-stamped partial entry)."""
+    seg, pipe, dev, host = _live_env(n=3000, seed=3)
+    sql = "SELECT code, SUM(revenue) FROM live GROUP BY code LIMIT 50"
+    r = _exec(dev, sql)
+    reset_realtime_stats()
+    r2 = _exec(dev, sql)
+    st = dict(realtime_stats())
+    assert st["uploads"] == 0 and st["deltaBytes"] == 0
+    assert getattr(r2, "num_device_dispatches", 0) == 0
+    assert _canon(r2.result_table.rows) == _canon(r.result_table.rows)
+    # a new generation invalidates exactly that: the appended rows are
+    # visible on the very next query
+    _feed(seg, pipe, _gen_rows(100, seed=4))
+    r3 = _exec(dev, sql)
+    assert _canon(r3.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows)
+    assert _canon(r3.result_table.rows) != _canon(r.result_table.rows)
+
+
+# ---------------------------------------------------------------------------
+# hybrid table: immutable siblings keep the batch-family fast path
+# ---------------------------------------------------------------------------
+
+
+def test_hybrid_immutable_segments_still_batch(tmp_path):
+    """Regression pin: a query touching one consuming segment must NOT
+    drag its sealed immutable siblings off the batch path. 3 immutables
+    + 1 mutable ⇒ exactly 2 dispatches (1 batched family + 1 realtime);
+    3+1=4 would mean the immutables regressed to solo dispatches, 1 would
+    mean they fell to host entirely."""
+    from pinot_tpu.segment.builder import SegmentBuilder
+    from pinot_tpu.segment.loader import load_segment
+
+    rng = np.random.default_rng(7)
+    segs = []
+    expected = {}
+    for i in range(3):
+        n = 500
+        cols = {
+            "site": np.asarray([f"s{int(v)}" for v in rng.integers(0, 12, n)],
+                               dtype=object),
+            "code": rng.integers(0, 40, n).astype(np.int32),
+            "ts": (1_700_000_000 + rng.integers(0, 50, n)).astype(np.int64),
+            "clicks": rng.integers(1, 10, n).astype(np.int32),
+            "revenue": rng.integers(0, 1000, n).astype(np.int64),
+        }
+        name = f"live_imm_{i}"
+        SegmentBuilder(LIVE, segment_name=name).build(
+            cols, tmp_path / name)
+        segs.append(load_segment(tmp_path / name))
+        for s, c in zip(cols["site"], cols["clicks"]):
+            expected[s] = expected.get(s, 0) + int(c)
+    mseg = MutableSegment(LIVE, "live_cons_0")
+    pipe = build_transform_pipeline(LIVE)
+    live_rows = _gen_rows(800, seed=8)
+    _feed(mseg, pipe, live_rows)
+    for r in live_rows:
+        expected[r["site"]] = expected.get(r["site"], 0) + r["clicks"]
+
+    dev = QueryExecutor(backend="auto")
+    dev.add_table(LIVE, segs + [mseg], name="live")
+    sql = "SELECT site, SUM(clicks) FROM live GROUP BY site LIMIT 100"
+    r = _exec(dev, NOCACHE + sql)
+    assert getattr(r, "num_device_dispatches", 0) == 2, \
+        (f"hybrid dispatch count {getattr(r, 'num_device_dispatches', 0)} "
+         f"!= 2: immutable siblings left the batch family")
+    assert {row[0]: int(row[1]) for row in r.result_table.rows} == expected
+
+
+# ---------------------------------------------------------------------------
+# sqlite-oracle parity matrix at every generation
+# ---------------------------------------------------------------------------
+
+
+PARITY_MATRIX = [
+    # (engine sql, sqlite sql) — dense agg, filtered agg, sparse
+    # group-by, string group-by, timeseries-style per-bucket counts
+    ("SELECT SUM(clicks), COUNT(*), MIN(revenue), MAX(revenue) FROM live",
+     "SELECT SUM(clicks), COUNT(*), MIN(revenue), MAX(revenue) FROM live"),
+    ("SELECT SUM(revenue) FROM live WHERE code < 13 AND clicks > 2",
+     "SELECT SUM(revenue) FROM live WHERE code < 13 AND clicks > 2"),
+    ("SELECT code, SUM(clicks), COUNT(*) FROM live GROUP BY code "
+     "ORDER BY code LIMIT 1000",
+     "SELECT code, SUM(clicks), COUNT(*) FROM live GROUP BY code "
+     "ORDER BY code"),
+    ("SELECT site, SUM(revenue), MAX(clicks) FROM live GROUP BY site "
+     "ORDER BY site LIMIT 100",
+     "SELECT site, SUM(revenue), MAX(clicks) FROM live GROUP BY site "
+     "ORDER BY site"),
+    ("SELECT ts, COUNT(*), SUM(clicks) FROM live GROUP BY ts "
+     "ORDER BY ts LIMIT 5000",
+     "SELECT ts, COUNT(*), SUM(clicks) FROM live GROUP BY ts "
+     "ORDER BY ts"),
+]
+
+
+def test_live_ingest_parity_matrix_every_generation():
+    """Append-only generations g0 → g1 → g2: at each settle the full
+    matrix must agree device ≡ host ≡ sqlite on the SAME fed rows."""
+    seg = MutableSegment(LIVE, "live_par_0")
+    pipe = build_transform_pipeline(LIVE)
+    dev = QueryExecutor(backend="auto")
+    host = QueryExecutor(backend="host")
+    for qe in (dev, host):
+        qe.add_table(LIVE, [seg], name="live")
+    fed = []
+    for gen, (n, seed) in enumerate([(2000, 10), (700, 11), (64, 12)]):
+        batch = _gen_rows(n, seed=seed)
+        _feed(seg, pipe, batch)
+        fed.extend(batch)
+        for esql, osql in PARITY_MATRIX:
+            got_d = _canon(_exec(dev, NOCACHE + esql).result_table.rows)
+            got_h = _canon(_exec(host, esql).result_table.rows)
+            want = _canon(_oracle(fed, osql))
+            assert got_d == want, \
+                f"gen {gen}: device diverged from oracle on {esql!r}"
+            assert got_h == want, \
+                f"gen {gen}: host diverged from oracle on {esql!r}"
+
+
+def test_live_ingest_funnel_parity_every_generation():
+    """FUNNEL_COUNT over a consuming segment, checked against an
+    independent per-entity set-intersection oracle at each generation."""
+    schema = Schema.build(
+        "ev",
+        dimensions=[("uid", "INT"), ("url", "STRING"), ("ts", "LONG")],
+        metrics=[("n", "INT")])
+    seg = MutableSegment(schema, "live_fun_0")
+    pipe = build_transform_pipeline(schema)
+    dev = QueryExecutor(backend="auto")
+    host = QueryExecutor(backend="host")
+    for qe in (dev, host):
+        qe.add_table(schema, [seg], name="ev")
+    steps = ["/home", "/cart", "/buy"]
+    sql = ("SELECT FUNNEL_COUNT(STEPS("
+           + ", ".join(f"url = '{s}'" for s in steps)
+           + "), CORRELATE_BY(uid)) FROM ev")
+    rng = np.random.default_rng(13)
+    urls = steps + ["/other"]
+    fed = []
+    for n in (400, 150, 37):
+        batch = [{"uid": int(rng.integers(0, 60)),
+                  "url": urls[int(rng.integers(len(urls)))],
+                  "ts": 1000 + len(fed) + i, "n": 1}
+                 for i in range(n)]
+        _feed(seg, pipe, batch)
+        fed.extend(batch)
+        sets = [set(r["uid"] for r in fed if r["url"] == s) for s in steps]
+        run, want = None, []
+        for s in sets:
+            run = set(s) if run is None else run & s
+            want.append(len(run))
+        got_d = _exec(dev, NOCACHE + sql).result_table.rows[0][0]
+        got_h = _exec(host, sql).result_table.rows[0][0]
+        assert list(got_d) == want and list(got_h) == want
+
+
+def test_upsert_overwrite_visibility_flips_with_generation():
+    """Upsert tables ride the same planes with a device-side validity
+    mask keyed by the upsert generation: an overwrite arriving after a
+    query must flip visibility on the very next query, device ≡ host."""
+    from pinot_tpu.spi.table_config import TableConfig, UpsertConfig
+    from pinot_tpu.upsert import TableUpsertMetadataManager
+
+    schema = Schema.build(
+        "events",
+        dimensions=[("pk", "STRING"), ("city", "STRING")],
+        metrics=[("clicks", "INT")],
+        date_times=[("ts", "LONG")],
+        primary_key_columns=["pk"])
+    cfg = TableConfig(table_name="events",
+                      upsert=UpsertConfig(mode="FULL",
+                                          comparison_columns=["ts"]))
+    mgr = TableUpsertMetadataManager(schema, cfg)
+    seg = MutableSegment(schema, "live_ups_0")
+    dev = QueryExecutor(backend="auto")
+    host = QueryExecutor(backend="host")
+    for qe in (dev, host):
+        qe.add_table(schema, [seg], name="events")
+
+    def put(r):
+        d = seg.index(r)
+        mgr.add_record(seg, d, r)
+
+    for i in range(40):
+        put({"pk": f"k{i}", "city": "sf", "clicks": 1, "ts": 100})
+    sql = ("SELECT city, SUM(clicks), COUNT(*) FROM events "
+           "GROUP BY city ORDER BY city")
+    r1 = _exec(dev, NOCACHE + sql)
+    assert _canon(r1.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows) == [("sf", 40.0, 40)]
+    # overwrite half the keys into a new city at a newer ts
+    for i in range(20):
+        put({"pk": f"k{i}", "city": "la", "clicks": 5, "ts": 200})
+    r2 = _exec(dev, NOCACHE + sql)
+    assert _canon(r2.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows) == \
+        [("la", 100.0, 20), ("sf", 20.0, 20)]
+    # stale overwrite (older ts) must lose — visibility does NOT flip
+    put({"pk": "k0", "city": "ny", "clicks": 9, "ts": 50})
+    r3 = _exec(dev, NOCACHE + sql)
+    assert _canon(r3.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows) == \
+        [("la", 100.0, 20), ("sf", 20.0, 20)]
+
+
+# ---------------------------------------------------------------------------
+# fault point realtime.upload
+# ---------------------------------------------------------------------------
+
+
+def test_upload_error_fault_falls_back_to_host_planes_intact():
+    """kind=error fires BEFORE any device mutation: the faulted query
+    transparently degrades to host (exact), and because the planes and
+    watermarks were untouched the NEXT query needs only the normal delta."""
+    seg, pipe, dev, host = _live_env(n=2000, seed=20)
+    sql = "SELECT site, SUM(clicks) FROM live GROUP BY site LIMIT 100"
+    _exec(dev, NOCACHE + sql)  # planes resident at gen 0
+    _feed(seg, pipe, _gen_rows(200, seed=21))  # force an upload next query
+    try:
+        with faults.injected("realtime.upload", kind="error", times=1):
+            reset_realtime_stats()
+            r = _exec(dev, NOCACHE + sql)  # no exceptions: host fallback
+            st = dict(realtime_stats())
+    finally:
+        faults.FAULTS.reset()
+    assert st["deviceQueries"] == 0, "faulted query still claimed device"
+    assert _canon(r.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows)
+    # planes survived: the next query delta-uploads the 200-row tail,
+    # not the whole 2200-row snapshot
+    reset_realtime_stats()
+    r2 = _exec(dev, NOCACHE + sql)
+    st2 = dict(realtime_stats())
+    assert st2["uploads"] > 0 and st2["deviceQueries"] >= 1
+    assert _canon(r2.result_table.rows) == _canon(r.result_table.rows)
+
+
+def test_upload_delay_fault_degrades_within_budget(monkeypatch):
+    """A delta upload stalled past PINOT_TPU_RT_UPLOAD_BUDGET_MS degrades
+    to host inside the query deadline instead of hanging the query."""
+    monkeypatch.setenv("PINOT_TPU_RT_UPLOAD_BUDGET_MS", "40")
+    seg, pipe, dev, host = _live_env(n=1500, seed=22)
+    sql = "SELECT SUM(revenue), COUNT(*) FROM live"
+    try:
+        with faults.injected("realtime.upload", kind="delay",
+                             delay_s=0.15, times=1):
+            reset_realtime_stats()
+            r = _exec(dev, NOCACHE + sql)
+            st = dict(realtime_stats())
+    finally:
+        faults.FAULTS.reset()
+    assert st["deviceQueries"] == 0
+    assert _canon(r.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows)
+
+
+def test_upload_corrupt_fault_drops_planes_full_reupload():
+    """kind=corrupt could have poisoned device state: the WHOLE plane set
+    is dropped, the faulted query degrades to host (exact), and the next
+    query re-uploads from row zero — degraded, never wrong."""
+    seg, pipe, dev, host = _live_env(n=2000, seed=23)
+    sql = "SELECT code, COUNT(*), SUM(clicks) FROM live GROUP BY code LIMIT 50"
+    reset_realtime_stats()
+    _exec(dev, NOCACHE + sql)
+    full0 = realtime_stats()["deltaBytes"]  # cold full-snapshot size
+    assert full0 > 0
+    _feed(seg, pipe, _gen_rows(100, seed=24))  # make the next query upload
+    try:
+        with faults.injected("realtime.upload", kind="corrupt", times=1):
+            r = _exec(dev, NOCACHE + sql)
+    finally:
+        faults.FAULTS.reset()
+    assert _canon(r.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows)
+    assert REALTIME_PLANES.plane_set(seg).nbytes() == 0, \
+        "corrupt fault must drop the whole plane set"
+    # next query: full re-upload (>= the original cold size — the segment
+    # only grew), then bit-identical to host again
+    reset_realtime_stats()
+    r2 = _exec(dev, NOCACHE + sql)
+    st2 = dict(realtime_stats())
+    assert st2["deltaBytes"] >= full0, \
+        (f"post-corrupt re-upload {st2['deltaBytes']}B < original full "
+         f"{full0}B — planes were not rebuilt from row zero")
+    assert _canon(r2.result_table.rows) == \
+        _canon(_exec(host, sql).result_table.rows)
